@@ -1,0 +1,125 @@
+"""Headline benchmark: flagship-model training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric is tokens/sec/chip for a full train step (fwd+bwd+adamw, remat) on
+the Llama-architecture `bench` preset. `vs_baseline` follows BASELINE.md's
+north star (tokens/sec/chip vs TorchTrainer+NCCL on A100): the reference
+publishes no committed numbers (BASELINE.json.published is empty), so we
+normalize by model FLOPs utilization against a 40% MFU torch/A100 proxy —
+vs_baseline = our_MFU / 0.40. Extra keys document the inputs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import PRESETS
+from ray_tpu.train.step import (
+    init_train_state,
+    jit_train_step,
+    make_optimizer,
+)
+
+# Peak bf16 FLOP/s per chip by TPU generation (public spec sheets).
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+BASELINE_MFU = 0.40  # TorchTrainer+NCCL A100 proxy (see module docstring)
+
+
+def _peak_flops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for name, flops in PEAK_FLOPS.items():
+        if name in kind.replace(" ", ""):
+            return flops
+    return 197e12  # default to v5e
+
+
+def run(batch_size: int, seq: int, steps: int = 10) -> dict:
+    cfg = PRESETS["bench"]
+    opt = make_optimizer(total_steps=1000)
+
+    from ray_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": len(jax.devices())})
+    step = jit_train_step(cfg, opt, mesh)
+
+    state = init_train_state(jax.random.key(0), cfg, opt)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch_size, seq + 1), 0, cfg.vocab_size
+    )
+    batch = {"tokens": tokens}
+
+    # Warmup (compile + 2 steps). Sync via host transfer of the loss — on
+    # the axon TPU platform block_until_ready does not reliably wait.
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    # Each step consumes the previous state; materializing an *updated
+    # parameter* of the final step forces the whole chain including the
+    # last backward + adamw update (loss alone would leave the final
+    # update un-awaited).
+    float(state.params["final_norm"][0])
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch_size * seq
+    tokens_per_sec = tokens_per_step * steps / dt
+    n_chips = len(jax.devices())
+    tokens_per_sec_per_chip = tokens_per_sec / n_chips
+    flops_per_token = cfg.flops_per_token(seq)
+    mfu = tokens_per_sec_per_chip * flops_per_token / _peak_flops()
+    return {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "mfu": round(mfu, 4),
+        "model_params": cfg.num_params(),
+        "batch_size": batch_size,
+        "seq": seq,
+        "n_chips": n_chips,
+        "step_time_s": round(dt / steps, 4),
+        "device_kind": jax.devices()[0].device_kind,
+    }
+
+
+def main() -> None:
+    # Back off batch size on OOM so the bench always reports.
+    last_err = None
+    for batch_size in (8, 4, 2, 1):
+        try:
+            result = run(batch_size=batch_size, seq=2048)
+            print(json.dumps(result))
+            return
+        except Exception as e:  # noqa: BLE001 - report whatever happened
+            last_err = e
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "tokens/s/chip",
+                "vs_baseline": 0.0,
+                "error": str(last_err)[:500],
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""ray_tpu: a TPU-native distributed computing framework.
+
+Capability-equivalent to Ray (tasks / actors / objects / placement groups /
+collectives / Train / Tune / Data / Serve / RL) but designed for TPU from
+the ground up: the accelerator data plane is XLA collectives over ICI/DCN
+compiled into programs (jax / pjit / shard_map / Pallas), and the CPU-side
+runtime orchestrates processes the way the reference's C++ core does
+(SURVEY.md maps every subsystem to its reference counterpart).
+"""
+
+from ray_tpu.version import __version__
+
+__all__ = ["__version__"]
+
+
+def __getattr__(name):
+    # Lazy-load the core API so `import ray_tpu.models` does not drag in the
+    # runtime (and vice versa).
+    _core_api = {
+        "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+        "kill", "cancel", "get_actor", "method", "ObjectRef", "available_resources",
+        "cluster_resources",
+    }
+    if name in _core_api:
+        try:
+            import ray_tpu.api as _api
+        except ImportError as e:
+            raise AttributeError(
+                f"ray_tpu.{name} requires the core runtime (ray_tpu.api), "
+                f"which failed to import: {e}"
+            ) from e
+        return getattr(_api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

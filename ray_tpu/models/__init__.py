@@ -1,0 +1,18 @@
+"""Model zoo: flagship Llama-3-style decoder (GQA + SwiGLU + RoPE), plus
+smaller configs for tests and single-chip benchmarks."""
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    PRESETS,
+    forward,
+    init_params,
+    param_logical_axes,
+)
+
+__all__ = [
+    "LlamaConfig",
+    "PRESETS",
+    "forward",
+    "init_params",
+    "param_logical_axes",
+]

@@ -1,0 +1,197 @@
+"""Flagship model: Llama-3-style decoder-only transformer in pure JAX.
+
+GQA attention + RoPE + SwiGLU + RMSNorm, parameters stored fp32 and cast to
+bf16 at use (mixed precision), layers stacked on a leading dim and executed
+with `lax.scan` (+ optional rematerialization) so XLA compiles one layer
+body regardless of depth — static shapes, no Python-level per-layer loop.
+
+Every parameter carries logical axes (see ray_tpu.parallel.sharding) so a
+single rule table gives DP/FSDP/TP/SP shardings under pjit. This is the
+model behind BASELINE.json configs 2–3 (the reference's equivalent role is
+filled by user torch code under TorchTrainer; SURVEY.md section 2.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import causal_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel.sharding import constrain
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+    # "none" | "full": remat policy for the scanned layer body.
+    remat: str = "full"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v = self.d_model, self.d_ff, self.vocab_size
+        attn = d * (self.n_heads * self.head_dim) * 2 + d * (
+            self.n_kv_heads * self.head_dim
+        ) * 2
+        mlp = 3 * d * f
+        per_layer = attn + mlp + 2 * d
+        return self.n_layers * per_layer + 2 * v * d + d
+
+    def flops_per_token(self, seq: int) -> float:
+        """Training (fwd+bwd) FLOPs per token: 6*N_matmul + attention term."""
+        d, v = self.d_model, self.vocab_size
+        matmul_params = self.num_params() - v * d  # exclude embedding lookup
+        attn_flops = 12 * self.n_layers * d * seq  # 6 * 2 * L * d * s
+        return 6.0 * matmul_params + attn_flops
+
+
+PRESETS: dict[str, LlamaConfig] = {
+    # CPU-test scale.
+    "tiny": LlamaConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=256, dtype=jnp.float32, remat="none",
+    ),
+    # Single-chip graft-entry scale (~125M).
+    "mini": LlamaConfig(
+        vocab_size=32768, d_model=768, n_layers=12, n_heads=12, n_kv_heads=4,
+        d_ff=2048, max_seq=2048,
+    ),
+    # Single-chip benchmark scale (~430M).
+    "bench": LlamaConfig(
+        vocab_size=32768, d_model=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        d_ff=4096, max_seq=2048,
+    ),
+    # Llama-3-8B (BASELINE.json config 3).
+    "llama3_8b": LlamaConfig(),
+}
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    """Pytree of logical-axis tuples, mirroring init_params' structure."""
+    del cfg
+    return {
+        "tok_emb": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", "embed"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "embed"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+    """Initialize fp32 parameters (truncated-normal, 1/sqrt(fan_in))."""
+    d, f = cfg.d_model, cfg.d_ff
+    hq = cfg.n_heads * cfg.head_dim
+    hkv = cfg.n_kv_heads * cfg.head_dim
+    L = cfg.n_layers
+    keys = jax.random.split(key, 9)
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32)
+            * fan_in**-0.5
+        )
+
+    return {
+        "tok_emb": w(keys[0], (cfg.vocab_size, d), d),
+        "blocks": {
+            "attn_norm": jnp.zeros((L, d), jnp.float32),
+            "wq": w(keys[1], (L, d, hq), d),
+            "wk": w(keys[2], (L, d, hkv), d),
+            "wv": w(keys[3], (L, d, hkv), d),
+            "wo": w(keys[4], (L, hq, d), hq),
+            "mlp_norm": jnp.zeros((L, d), jnp.float32),
+            "w_gate": w(keys[5], (L, d, f), d),
+            "w_up": w(keys[6], (L, d, f), d),
+            "w_down": w(keys[7], (L, f, d), f),
+        },
+        "final_norm": jnp.zeros((d,), jnp.float32),
+        "lm_head": w(keys[8], (d, cfg.vocab_size), d),
+    }
+
+
+AttnFn = Callable[..., jnp.ndarray]
+
+
+def _block(
+    x: jnp.ndarray,
+    p: Params,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_fn: AttnFn,
+) -> jnp.ndarray:
+    b, s, d = x.shape
+    dt = cfg.dtype
+
+    x = constrain(x, "batch", "act_seq", "act_embed")
+    h = rms_norm(x, p["attn_norm"])
+    q = (h @ p["wq"].astype(dt)).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ p["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    attn = attn_fn(q, k, v)
+    x = x + attn.reshape(b, s, -1) @ p["wo"].astype(dt)
+
+    h = rms_norm(x, p["mlp_norm"])
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
+    up = h @ p["w_up"].astype(dt)
+    x = x + (gate * up) @ p["w_down"].astype(dt)
+    return x
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_fn: AttnFn | None = None,
+) -> jnp.ndarray:
+    """tokens [B, S] int32 → logits [B, S, V] fp32."""
+    attn_fn = attn_fn or causal_attention
+    seq = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    x = constrain(x, "batch", "act_seq", "act_embed")
+
+    body = partial(_block, cos=cos, sin=sin, cfg=cfg, attn_fn=attn_fn)
+    if cfg.remat == "full":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    def scan_fn(carry, layer_params):
+        return body(carry, layer_params), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)

@@ -1,0 +1,13 @@
+"""TPU-friendly model ops: norms, rotary embeddings, attention.
+
+All ops are shape-static, bf16-matmul-first, and written so XLA can fuse
+the elementwise work into the surrounding matmuls (MXU-friendly). Pallas
+kernels, where present, are optional fast paths with XLA fallbacks so the
+same code runs on the CPU test mesh.
+"""
+
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.ops.attention import causal_attention
+
+__all__ = ["rms_norm", "apply_rope", "rope_frequencies", "causal_attention"]

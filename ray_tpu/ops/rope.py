@@ -1,0 +1,43 @@
+"""Rotary position embeddings (RoPE), precomputed frequencies + fused apply."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(
+    head_dim: int, max_seq: int, theta: float = 500000.0
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (cos, sin) tables of shape [max_seq, head_dim // 2], fp32."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(max_seq, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # [S, D/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Rotate ``x`` of shape [..., S, H, D] by position.
+
+    ``cos``/``sin`` are [max_seq, D/2]; ``positions`` (optional, [..., S])
+    selects rows, defaulting to arange(S). Split-halves convention.
+    """
+    seq = x.shape[-3]
+    if positions is None:
+        c = cos[:seq]
+        s = sin[:seq]
+        # broadcast over batch and heads: [S, 1, D/2]
+        c = c[:, None, :]
+        s = s[:, None, :]
+    else:
+        c = cos[positions][..., :, None, :]
+        s = sin[positions][..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

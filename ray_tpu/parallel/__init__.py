@@ -1,0 +1,31 @@
+"""Parallelism layer: device meshes, sharding rules, SP/PP/EP strategies.
+
+TPU-native replacement for the reference's parallelism surface
+(reference: python/ray/util/collective/collective.py, python/ray/dag/ for PP,
+and the gap analysis in SURVEY.md section 2.3 — the reference delegates
+TP/PP/EP/SP to external engines; here they are first-class jax shardings).
+"""
+
+from ray_tpu.parallel.mesh import (
+    MESH_AXES,
+    default_axis_sizes,
+    make_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_sharding,
+    logical_spec,
+    shard_pytree,
+    tree_shardings,
+)
+
+__all__ = [
+    "MESH_AXES",
+    "default_axis_sizes",
+    "make_mesh",
+    "DEFAULT_RULES",
+    "logical_spec",
+    "logical_sharding",
+    "tree_shardings",
+    "shard_pytree",
+]

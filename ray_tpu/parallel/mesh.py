@@ -1,0 +1,112 @@
+"""Device-mesh construction for TPU pods.
+
+The mesh is the TPU-native unit of parallel execution: instead of the
+reference's per-rank process groups (reference:
+python/ray/util/collective/collective.py:171 `init_collective_group` with
+explicit world_size/rank), a JAX `Mesh` names the parallelism axes and XLA
+compiles collectives over ICI/DCN into the program.
+
+Canonical axis order (outer → inner, DCN-ish → ICI-ish):
+
+    dp    pure data parallelism (gradient psum, no param sharding)
+    fsdp  data parallelism with parameters/optimizer sharded (ZeRO-3 style)
+    ep    expert parallelism (MoE experts spread over chips)
+    tp    tensor parallelism (heads / mlp / vocab sharded)
+    sp    sequence/context parallelism (ring attention, Ulysses)
+
+Pipeline parallelism is not a mesh axis here; it is expressed as a stage
+loop over a `pp` axis by `ray_tpu.parallel.pipeline` (see that module).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# Canonical mesh axes, outer-to-inner. Axes of size 1 are always present so
+# sharding rules never need to special-case a missing axis.
+MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp")
+
+
+def default_axis_sizes(n_devices: int) -> dict[str, int]:
+    """Pick a reasonable axis factorization for ``n_devices``.
+
+    Heuristic for tests/dry-runs: give tp, sp, then fsdp a factor of 2
+    when it divides, put the remainder in dp — exercising every axis kind
+    that fits. Real jobs should pass explicit sizes.
+    """
+    sizes = {a: 1 for a in MESH_AXES}
+    rem = int(n_devices)
+    for axis in ("tp", "sp", "fsdp"):
+        if rem % 2 == 0 and rem > 1:
+            sizes[axis] = 2
+            rem //= 2
+    sizes["dp"] = rem
+    return sizes
+
+
+def _resolve_sizes(
+    axis_sizes: Mapping[str, int], n_devices: int
+) -> dict[str, int]:
+    sizes = {a: int(axis_sizes.get(a, 1)) for a in MESH_AXES}
+    unknown = set(axis_sizes) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)}; valid axes: {MESH_AXES}"
+        )
+    wildcards = [a for a, s in sizes.items() if s == -1]
+    if len(wildcards) > 1:
+        raise ValueError("at most one axis size may be -1")
+    fixed = 1
+    for a, s in sizes.items():
+        if s != -1:
+            if s < 1:
+                raise ValueError(f"axis {a!r} has invalid size {s}")
+            fixed *= s
+    if wildcards:
+        if n_devices % fixed != 0:
+            raise ValueError(
+                f"cannot fill axis {wildcards[0]!r}: {n_devices} devices not "
+                f"divisible by {fixed}"
+            )
+        sizes[wildcards[0]] = n_devices // fixed
+        fixed = n_devices
+    if fixed != n_devices:
+        raise ValueError(
+            f"mesh axis sizes {sizes} multiply to {fixed}, but there are "
+            f"{n_devices} devices"
+        )
+    return sizes
+
+
+def make_mesh(
+    axis_sizes: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a `jax.sharding.Mesh` over ``devices`` with canonical axes.
+
+    ``axis_sizes`` maps axis name → size; missing axes get size 1; one axis
+    may be -1 to absorb the remaining device count. With no ``axis_sizes``
+    at all, all devices land on ``dp``.
+
+    On real TPU slices, `jax.devices()` ordering already reflects the
+    physical torus, so reshaping in canonical order keeps `tp`/`sp` (the
+    innermost axes, where collectives are latency-sensitive) on nearest-
+    neighbor ICI links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = {"dp": n}
+    sizes = _resolve_sizes(axis_sizes, n)
+    dev_array = np.asarray(devices, dtype=object).reshape(
+        [sizes[a] for a in MESH_AXES]
+    )
+    return Mesh(dev_array, MESH_AXES)

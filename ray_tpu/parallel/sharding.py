@@ -1,0 +1,145 @@
+"""Logical-axis sharding rules (t5x/maxtext-style) for the canonical mesh.
+
+Every tensor in a model carries a tuple of *logical* axis names; rules map
+each logical axis to zero or more mesh axes. This is the TPU-native
+equivalent of the reference's per-strategy process-group plumbing: the
+reference wires DDP/FSDP through torch process groups
+(reference: python/ray/train/torch/config.py:73) and delegates TP/SP to
+external engines (SURVEY.md section 2.3); here one rule table expresses
+DP, FSDP(ZeRO-3), TP, SP and EP simultaneously and XLA inserts the
+collectives (all-gather of fsdp-sharded params, psum of grads, all-to-all
+for experts) during SPMD partitioning.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# (logical axis, mesh axis or tuple of mesh axes or None)
+#
+# Activation axes:
+#   batch      → sharded over both data axes (dp outer, fsdp inner)
+#   act_seq    → sequence parallelism
+#   act_embed  → replicated (activations keep full model dim)
+#   act_heads  → tensor parallelism over attention heads
+#   act_mlp    → tensor parallelism over the ffn hidden dim
+# Parameter axes:
+#   embed      → fsdp-sharded (ZeRO-3: each data shard owns a param slice)
+#   heads      → tp-sharded fused (n_heads * head_dim) dim
+#   kv_heads   → tp-sharded fused kv dim
+#   mlp        → tp-sharded ffn hidden dim
+#   vocab      → tp-sharded vocabulary dim
+#   layers     → stacked-layer leading dim (scan), never sharded
+#   expert     → expert parallelism
+DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),
+    ("act_seq", "sp"),
+    ("act_embed", None),
+    ("act_heads", "tp"),
+    ("act_mlp", "tp"),
+    ("embed", "fsdp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("layers", None),
+    ("expert", "ep"),
+    (None, None),
+)
+
+
+def logical_spec(
+    logical_axes: Sequence[str | None],
+    rules: Sequence[tuple[str | None, Any]] = DEFAULT_RULES,
+) -> PartitionSpec:
+    """Translate a tuple of logical axis names into a PartitionSpec."""
+    table = dict(rules)
+    parts = []
+    for ax in logical_axes:
+        if ax not in table:
+            raise ValueError(f"no sharding rule for logical axis {ax!r}")
+        parts.append(table[ax])
+    return PartitionSpec(*parts)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    logical_axes: Sequence[str | None],
+    rules: Sequence[tuple[str | None, Any]] = DEFAULT_RULES,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_spec(logical_axes, rules))
+
+
+def is_axes_leaf(x: Any) -> bool:
+    """True for a tuple of logical axis names (not a NamedTuple container)."""
+    return (
+        isinstance(x, tuple)
+        and not hasattr(x, "_fields")
+        and all(e is None or isinstance(e, str) for e in x)
+    )
+
+
+def tree_shardings(
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: Sequence[tuple[str | None, Any]] = DEFAULT_RULES,
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings.
+
+    ``logical_tree`` must be a pytree whose leaves are tuples of logical
+    axis names (plain tuples of str/None are treated as leaves; NamedTuple
+    containers like TrainState are traversed).
+    """
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, axes, rules),
+        logical_tree,
+        is_leaf=is_axes_leaf,
+    )
+
+
+_ACTIVE = threading.local()
+
+
+@contextlib.contextmanager
+def use_mesh(
+    mesh: Mesh, rules: Sequence[tuple[str | None, Any]] = DEFAULT_RULES
+):
+    """Make (mesh, rules) ambient for `constrain` during jit tracing.
+
+    Model code calls `constrain(x, "batch", "act_seq", ...)` without
+    threading a mesh through every function; outside a use_mesh scope the
+    call is a no-op so the same model runs unsharded.
+    """
+    prev = getattr(_ACTIVE, "ctx", None)
+    _ACTIVE.ctx = (mesh, tuple(rules))
+    try:
+        yield
+    finally:
+        _ACTIVE.ctx = prev
+
+
+def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:
+    """with_sharding_constraint by logical axes, no-op without use_mesh."""
+    ctx = getattr(_ACTIVE, "ctx", None)
+    if ctx is None:
+        return x
+    mesh, rules = ctx
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, logical_axes, rules)
+    )
+
+
+def shard_pytree(
+    tree: Any,
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: Sequence[tuple[str | None, Any]] = DEFAULT_RULES,
+) -> Any:
+    """Device-put a pytree of arrays according to its logical axes."""
+    shardings = tree_shardings(mesh, logical_tree, rules)
+    return jax.tree.map(jax.device_put, tree, shardings)

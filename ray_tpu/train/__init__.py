@@ -1,0 +1,20 @@
+"""Training library: sharded train step (this module) and, above it, the
+controller/worker-group `JaxTrainer` (ray_tpu.train.trainer), mirroring the
+reference's Train v2 architecture (reference:
+python/ray/train/v2/api/data_parallel_trainer.py:66)."""
+
+from ray_tpu.train.step import (
+    TrainState,
+    make_optimizer,
+    make_train_step,
+    init_train_state,
+    state_logical_axes,
+)
+
+__all__ = [
+    "TrainState",
+    "make_optimizer",
+    "make_train_step",
+    "init_train_state",
+    "state_logical_axes",
+]

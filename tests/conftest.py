@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's strategy of testing multi-node behavior on one
+machine (reference: python/ray/cluster_utils.py:135 starts multiple raylets
+in-process; python/ray/experimental/channel/conftest.py mocks NCCL) — here
+multi-chip behavior runs on XLA's forced host-platform device count.
+"""
+
+import os
+
+# The image presets JAX_PLATFORMS=axon (the real TPU tunnel) and a
+# sitecustomize hook re-registers it at interpreter start; tests always run
+# on the virtual CPU mesh, so override both the env var and jax.config
+# before any backend initialization.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from ray_tpu.parallel import make_mesh
+
+    assert len(jax.devices()) == 8
+    return make_mesh({"dp": 2, "fsdp": 2, "tp": 2})

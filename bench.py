@@ -60,11 +60,13 @@ def run(batch_size: int, seq: int, steps: int = 10) -> dict:
     )
     batch = {"tokens": tokens}
 
-    # Warmup (compile + 2 steps). Sync via host transfer of the loss — on
-    # the axon TPU platform block_until_ready does not reliably wait.
+    # Warmup (compile + 2 steps). Sync via host transfer of an updated
+    # param — on the axon TPU platform block_until_ready does not reliably
+    # wait, and loss alone would leave the update tail overlapping into
+    # the timed region.
     for _ in range(3):
         state, metrics = step(state, batch)
-        float(metrics["loss"])
+        float(state.params["final_norm"][0])
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -99,7 +101,9 @@ def run(batch_size: int, seq: int, steps: int = 10) -> dict:
 
 
 def main() -> None:
-    # Back off batch size on OOM so the bench always reports.
+    # Back off batch size on OOM so the bench always reports. Keep only
+    # the error *string*: holding the exception would pin run()'s frame
+    # (and its ~GBs of device buffers) via the traceback across retries.
     last_err = None
     for batch_size in (8, 4, 2, 1):
         try:
@@ -107,7 +111,11 @@ def main() -> None:
             print(json.dumps(result))
             return
         except Exception as e:  # noqa: BLE001 - report whatever happened
-            last_err = e
+            last_err = f"{type(e).__name__}: {e}"
+            del e
+            import gc
+
+            gc.collect()
     print(
         json.dumps(
             {
@@ -115,7 +123,7 @@ def main() -> None:
                 "value": 0.0,
                 "unit": "tokens/s/chip",
                 "vs_baseline": 0.0,
-                "error": str(last_err)[:500],
+                "error": (last_err or "")[:500],
             }
         )
     )

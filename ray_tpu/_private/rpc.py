@@ -1,0 +1,225 @@
+"""Asyncio framed-message RPC with request multiplexing and server push.
+
+Fills the role of the reference's gRPC wrapper layer (reference:
+src/ray/rpc/grpc_server.h:86, retryable client retryable_grpc_client.h) for
+the Python control plane: length-prefixed frames, each a pickled tuple
+``(kind, req_id, payload)`` with kind ∈ {REQ, RESP, ERR, PUSH}. One
+persistent connection per peer pair; calls multiplex on req_id; PUSH frames
+deliver server-initiated messages (pubsub). A chaos hook mirrors the
+reference's rpc_chaos.h fault injection for protocol tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import random
+import struct
+from typing import Any, Awaitable, Callable
+
+REQ, RESP, ERR, PUSH = 0, 1, 2, 3
+_HDR = struct.Struct("<I")
+_MAX_FRAME = 1 << 31
+
+# Chaos injection: RAY_TPU_RPC_FAILURE="method:probability" drops requests
+# before send with the given probability (reference: rpc_chaos.h:24,
+# RAY_testing_rpc_failure in ray_config_def.h:850).
+_CHAOS = os.environ.get("RAY_TPU_RPC_FAILURE", "")
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+def _chaos_drop(method: str) -> bool:
+    if not _CHAOS:
+        return False
+    name, _, prob = _CHAOS.partition(":")
+    return method == name and random.random() < float(prob or 0)
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> tuple:
+    hdr = await reader.readexactly(_HDR.size)
+    (length,) = _HDR.unpack(hdr)
+    if length > _MAX_FRAME:
+        raise RpcError(f"oversized frame: {length}")
+    return pickle.loads(await reader.readexactly(length))
+
+
+def _write_frame(writer: asyncio.StreamWriter, frame: tuple) -> None:
+    data = pickle.dumps(frame, protocol=5)
+    writer.write(_HDR.pack(len(data)) + data)
+
+
+Handler = Callable[[str, dict, "Connection"], Awaitable[Any]]
+
+
+class Connection:
+    """One live peer connection, usable from both server and client side."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Handler | None = None,
+        on_push: Callable[[Any], None] | None = None,
+        on_close: Callable[["Connection"], None] | None = None,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.on_push = on_push
+        self.on_close = on_close
+        self._next_id = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._task = asyncio.ensure_future(self._recv_loop())
+        # Server handlers can stash per-connection state (e.g. subscriber
+        # registration) here.
+        self.state: dict[str, Any] = {}
+
+    @property
+    def peer(self) -> str:
+        try:
+            host, port = self.writer.get_extra_info("peername")[:2]
+            return f"{host}:{port}"
+        except Exception:
+            return "?"
+
+    async def call(self, method: str, timeout: float | None = None, **kw):
+        if self._closed:
+            raise ConnectionLost(f"connection to {self.peer} closed")
+        if _chaos_drop(method):
+            raise ConnectionLost(f"chaos: dropped {method}")
+        self._next_id += 1
+        req_id = self._next_id
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        _write_frame(self.writer, (REQ, req_id, (method, kw)))
+        await self.writer.drain()
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(req_id, None)
+
+    def push(self, payload: Any) -> None:
+        if not self._closed:
+            _write_frame(self.writer, (PUSH, 0, payload))
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                kind, req_id, payload = await _read_frame(self.reader)
+                if kind == REQ:
+                    asyncio.ensure_future(self._serve(req_id, payload))
+                elif kind == RESP:
+                    fut = self._pending.get(req_id)
+                    if fut and not fut.done():
+                        fut.set_result(payload)
+                elif kind == ERR:
+                    fut = self._pending.get(req_id)
+                    if fut and not fut.done():
+                        fut.set_exception(RpcError(payload))
+                elif kind == PUSH and self.on_push:
+                    self.on_push(payload)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            asyncio.CancelledError,
+        ):
+            pass
+        finally:
+            self._shutdown()
+
+    async def _serve(self, req_id: int, payload):
+        method, kw = payload
+        try:
+            if self.handler is None:
+                raise RpcError("connection has no handler")
+            result = await self.handler(method, kw, self)
+            _write_frame(self.writer, (RESP, req_id, result))
+        except Exception as e:  # noqa: BLE001 - errors travel to the caller
+            try:
+                _write_frame(self.writer, (ERR, req_id, f"{type(e).__name__}: {e}"))
+            except Exception:
+                pass
+        try:
+            await self.writer.drain()
+        except Exception:
+            pass
+
+    def _shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close:
+            self.on_close(self)
+
+    async def close(self):
+        self._task.cancel()
+        self._shutdown()
+
+
+class Server:
+    """TCP server dispatching REQ frames to an async handler."""
+
+    def __init__(self, handler: Handler):
+        self.handler = handler
+        self.connections: set[Connection] = set()
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        async def on_conn(reader, writer):
+            conn = Connection(
+                reader,
+                writer,
+                handler=self.handler,
+                on_close=self.connections.discard,
+            )
+            self.connections.add(conn)
+
+        self._server = await asyncio.start_server(on_conn, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self):
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for conn in list(self.connections):
+            await conn.close()
+
+
+async def connect(
+    addr: str,
+    handler: Handler | None = None,
+    on_push: Callable[[Any], None] | None = None,
+    retries: int = 3,
+    retry_delay: float = 0.2,
+) -> Connection:
+    """Dial ``host:port`` with simple connection retry (reference:
+    retryable_grpc_client.h)."""
+    host, _, port = addr.rpartition(":")
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            reader, writer = await asyncio.open_connection(host, int(port))
+            return Connection(reader, writer, handler=handler, on_push=on_push)
+        except ConnectionError as e:
+            last = e
+            await asyncio.sleep(retry_delay * (2**attempt))
+    raise ConnectionLost(f"cannot connect to {addr}: {last}")

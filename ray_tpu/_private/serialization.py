@@ -1,0 +1,60 @@
+"""Object serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Analogue of the reference's SerializationContext (reference:
+python/ray/_private/serialization.py:149): cloudpickle for arbitrary
+Python (lambdas, closures, classes), pickle-5 out-of-band buffers so large
+numpy/jax host arrays are captured as contiguous memoryviews and written to
+the shared-memory store without an extra copy.
+
+ObjectRef semantics match the reference: refs nested inside values pickle
+into reconstructable refs on the receiving side (ObjectRef.__reduce__ in
+ray_tpu.api); only *top-level* task arguments that are refs get resolved
+to values before execution (core_worker builds those as by-ref args).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from dataclasses import dataclass, field
+from typing import Any
+
+import cloudpickle
+
+# Buffers at or above this size are kept out-of-band (zero-copy path).
+OUT_OF_BAND_MIN = 4096
+
+
+@dataclass
+class Serialized:
+    """A serialized object: in-band pickle stream + out-of-band buffers."""
+
+    inband: bytes
+    buffers: list = field(default_factory=list)
+
+    def total_bytes(self) -> int:
+        return len(self.inband) + sum(len(b) for b in self.buffers)
+
+    def materialize_buffers(self) -> "Serialized":
+        return Serialized(self.inband, [bytes(b) for b in self.buffers])
+
+
+def serialize(value: Any) -> Serialized:
+    buffers: list[memoryview] = []
+
+    def buffer_callback(buf) -> bool:
+        raw = buf.raw()
+        if raw.nbytes >= OUT_OF_BAND_MIN:
+            buffers.append(raw)
+            return False  # keep out-of-band
+        return True  # small: keep in-band
+
+    sink = io.BytesIO()
+    cloudpickle.CloudPickler(
+        sink, protocol=5, buffer_callback=buffer_callback
+    ).dump(value)
+    return Serialized(sink.getvalue(), buffers)
+
+
+def deserialize(inband: bytes, buffers: list | None = None) -> Any:
+    return pickle.loads(inband, buffers=buffers or [])

@@ -1,0 +1,376 @@
+"""Public core API: init / remote / get / put / wait / actors.
+
+Mirrors the reference's Python surface (reference:
+python/ray/_private/worker.py `init` :1412, `get` :2846, `put` :3015;
+python/ray/remote_function.py:314 `_remote`; python/ray/actor.py) over the
+ray_tpu runtime. All public calls are synchronous wrappers around the
+runtime's asyncio loop, which runs on a background thread in the driver
+and on the main thread in workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import functools
+import threading
+from typing import Any, Sequence
+
+from ray_tpu._private.ids import JobID
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.runtime.core_worker import ActorSubmitTarget, CoreWorker
+
+_DEFAULT_TIMEOUT = None
+
+
+class _Runtime:
+    def __init__(self):
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self.thread: threading.Thread | None = None
+        self.head = None
+        self.node = None
+        self.core: CoreWorker | None = None
+        self.mode: str | None = None
+        self.session: str | None = None
+
+    @property
+    def ready(self) -> bool:
+        return self.core is not None
+
+    def run(self, coro, timeout=None):
+        if self.loop is None:
+            raise RayTpuError("ray_tpu.init() has not been called")
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(
+            timeout
+        )
+
+
+_runtime = _Runtime()
+
+
+def is_initialized() -> bool:
+    return _runtime.ready
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: float | None = None,
+    resources: dict | None = None,
+    object_store_dir: str | None = None,
+) -> dict:
+    """Start (or connect to) a cluster and attach this process as driver.
+
+    With no ``address``, starts an in-process head service plus a node
+    manager for this host (reference: ray.init head path, worker.py:1412 →
+    node.py start_head_processes :1316).
+    """
+    if _runtime.ready:
+        raise RayTpuError("ray_tpu is already initialized")
+
+    loop = asyncio.new_event_loop()
+    thread = threading.Thread(
+        target=loop.run_forever, name="ray_tpu_runtime", daemon=True
+    )
+    thread.start()
+    _runtime.loop = loop
+    _runtime.thread = thread
+
+    async def _bootstrap():
+        from ray_tpu.runtime.head import HeadService
+        from ray_tpu.runtime.node import NodeManager, detect_resources
+        from ray_tpu.runtime.object_store import default_store_dir
+
+        session = JobID.random().hex()[:12]
+        if address is None:
+            head = HeadService()
+            head_addr = await head.start()
+        else:
+            head = None
+            head_addr = address
+
+        total = detect_resources()
+        if num_cpus is not None:
+            total["CPU"] = float(num_cpus)
+        total.update(resources or {})
+        store_dir = object_store_dir or default_store_dir(session)
+        node = NodeManager(head_addr, store_dir, resources=total)
+        await node.start()
+
+        core = CoreWorker(
+            mode="driver",
+            head_addr=head_addr,
+            node_addr=node.addr,
+            store_dir=store_dir,
+        )
+        await core.start()
+        return head, node, core, session, head_addr
+
+    head, node, core, session, head_addr = _runtime.run(_bootstrap())
+    _runtime.head = head
+    _runtime.node = node
+    _runtime.core = core
+    _runtime.mode = "driver"
+    _runtime.session = session
+    atexit.register(shutdown)
+    return {"address": head_addr, "session": session, "node_id": node.node_id}
+
+
+def shutdown() -> None:
+    if not _runtime.ready:
+        return
+
+    async def _teardown():
+        await _runtime.core.stop()
+        if _runtime.node is not None:
+            await _runtime.node.stop()
+        if _runtime.head is not None:
+            await _runtime.head.stop()
+
+    try:
+        _runtime.run(_teardown(), timeout=10)
+    except Exception:
+        pass
+    if _runtime.node is not None:
+        _runtime.core.store.destroy()
+    _runtime.loop.call_soon_threadsafe(_runtime.loop.stop)
+    _runtime.thread.join(timeout=5)
+    _runtime.__init__()
+
+
+def _attach_worker(core: CoreWorker, loop: asyncio.AbstractEventLoop):
+    """Called by worker_main so tasks can use the public API re-entrantly."""
+    _runtime.loop = loop
+    _runtime.core = core
+    _runtime.mode = "worker"
+
+
+# ----------------------------------------------------------------- refs
+class ObjectRef:
+    """A reference to a (possibly pending) object; carries its owner's
+    address so any holder can resolve it (ownership model, SURVEY.md §5)."""
+
+    __slots__ = ("hex", "owner_addr")
+
+    def __init__(self, hex_id: str, owner_addr: str | None):
+        self.hex = hex_id
+        self.owner_addr = owner_addr
+
+    def __reduce__(self):
+        return (ObjectRef, (self.hex, self.owner_addr))
+
+    def __hash__(self):
+        return hash(self.hex)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.hex == self.hex
+
+    def __repr__(self):
+        return f"ObjectRef({self.hex[:12]}…@{self.owner_addr})"
+
+
+# ----------------------------------------------------------- task verbs
+def put(value: Any) -> ObjectRef:
+    return _runtime.run(_runtime.core.put(value))
+
+
+def get(refs, timeout: float | None = _DEFAULT_TIMEOUT):
+    single = isinstance(refs, ObjectRef)
+    if single:
+        refs = [refs]
+    if not all(isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("ray_tpu.get() takes an ObjectRef or a list of them")
+    values = _runtime.run(_runtime.core.get(refs, timeout))
+    return values[0] if single else values
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+):
+    return _runtime.run(
+        _runtime.core.wait(list(refs), num_returns, timeout)
+    )
+
+
+def kill(actor: "ActorHandle") -> None:
+    _runtime.run(_runtime.core.kill_actor(actor._actor_id, actor._addr))
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    raise NotImplementedError(
+        "task cancellation is not wired yet (tracked for a later round)"
+    )
+
+
+def available_resources() -> dict:
+    table = _runtime.run(_runtime.core.head.call("node_table"))
+    out: dict[str, float] = {}
+    for node in table.values():
+        for k, v in node["available"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+def cluster_resources() -> dict:
+    table = _runtime.run(_runtime.core.head.call("node_table"))
+    out: dict[str, float] = {}
+    for node in table.values():
+        for k, v in node["resources"].items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+# ------------------------------------------------------------- @remote
+class RemoteFunction:
+    def __init__(self, fn, *, num_returns=1, resources=None, max_retries=3):
+        self._fn = fn
+        self._num_returns = num_returns
+        self._resources = resources
+        self._max_retries = max_retries
+        functools.update_wrapper(self, fn)
+
+    def options(self, *, num_returns=None, resources=None, max_retries=None):
+        return RemoteFunction(
+            self._fn,
+            num_returns=num_returns or self._num_returns,
+            resources=resources or self._resources,
+            max_retries=(
+                max_retries if max_retries is not None else self._max_retries
+            ),
+        )
+
+    def remote(self, *args, **kwargs):
+        refs = _runtime.run(
+            _runtime.core.submit_task(
+                self._fn,
+                args,
+                kwargs,
+                num_returns=self._num_returns,
+                resources=self._resources,
+                max_retries=self._max_retries,
+            )
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *a, **kw):
+        raise TypeError(
+            f"remote function {self.__name__} cannot be called directly; "
+            "use .remote()"
+        )
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, *, num_returns=1):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        target = ActorSubmitTarget(self._handle._actor_id, self._handle._addr)
+        refs = _runtime.run(
+            _runtime.core.submit_task(
+                self._name,
+                args,
+                kwargs,
+                num_returns=self._num_returns,
+                actor=target,
+            )
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, addr: str, class_name: str = ""):
+        self._actor_id = actor_id
+        self._addr = addr
+        self._class_name = class_name
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._addr, self._class_name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id[:12]}…)"
+
+
+class ActorClass:
+    def __init__(self, cls, *, resources=None, name=None, detached=False):
+        self._cls = cls
+        self._resources = resources
+        self._name = name
+        self._detached = detached
+
+    def options(self, *, name=None, resources=None, lifetime=None):
+        return ActorClass(
+            self._cls,
+            resources=resources or self._resources,
+            name=name or self._name,
+            detached=(lifetime == "detached") or self._detached,
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        actor_id, addr = _runtime.run(
+            _runtime.core.create_actor(
+                self._cls,
+                args,
+                kwargs,
+                name=self._name,
+                resources=self._resources,
+                detached=self._detached,
+            )
+        )
+        return ActorHandle(actor_id, addr, self._cls.__name__)
+
+
+def _normalize_options(options: dict) -> dict:
+    """Translate ray-style num_cpus/num_tpus into the resources dict."""
+    resources = dict(options.pop("resources", None) or {})
+    if "num_cpus" in options:
+        resources["CPU"] = float(options.pop("num_cpus"))
+    if "num_tpus" in options:
+        resources["TPU"] = float(options.pop("num_tpus"))
+    if resources:
+        options["resources"] = resources
+    return options
+
+
+def remote(*args, **options):
+    """@ray_tpu.remote decorator for functions and classes."""
+    options = _normalize_options(options)
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+
+    if len(args) == 1 and not options and callable(args[0]):
+        return wrap(args[0])
+    if args:
+        raise TypeError("use @remote or @remote(**options)")
+    return wrap
+
+
+def get_actor(name: str) -> ActorHandle:
+    reply = _runtime.run(_runtime.core.head.call("get_actor", name=name))
+    if not reply["ok"]:
+        raise ValueError(f"no actor named {name!r}")
+    return ActorHandle(reply["actor_id"], reply["addr"], reply["class_name"])
+
+
+def method(**kwargs):
+    """Decorator stub for per-method options (reference: ray.method)."""
+
+    def deco(fn):
+        return fn
+
+    return deco

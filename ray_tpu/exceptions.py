@@ -1,0 +1,30 @@
+"""Public exception types (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """A task raised; the original traceback is in the message and the
+    original exception (when picklable) in ``.cause``."""
+
+    cause: Exception | None = None
+
+
+class WorkerDiedError(RayTpuError):
+    """The worker executing a task died (all retries exhausted)."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor's worker process is gone."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """ray_tpu.get timed out."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object's value is unrecoverable (owner and copies gone)."""

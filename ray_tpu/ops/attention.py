@@ -58,8 +58,16 @@ def causal_attention(
 
     q_pos = jnp.arange(q.shape[1]) + q_offset
     k_pos = jnp.arange(k.shape[1]) + kv_offset
-    mask = q_pos[:, None] >= k_pos[None, :]
-    logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
+    mask = (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
+    logits = jnp.where(mask, logits, _NEG_INF)
 
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    # A query row with no visible keys (routine in ring attention: a
+    # shard's whole KV block can be in the query's future) must produce
+    # 0, not mean(V). Softmax of the all-_NEG_INF row is uniform, so
+    # multiply by row validity — for a causal mask a row is fully masked
+    # iff q_pos < min(k_pos) = kv_offset, a [Sq] predicate that keeps
+    # XLA's fused softmax intact.
+    probs = jax.nn.softmax(logits, axis=-1)
+    row_valid = (q_pos >= kv_offset).astype(probs.dtype)
+    probs = (probs * row_valid[None, None, :, None]).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

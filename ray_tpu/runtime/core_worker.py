@@ -1,0 +1,638 @@
+"""Core worker: in-process runtime for every driver and worker process.
+
+Mirrors the reference core worker (reference:
+src/ray/core_worker/core_worker.h:167): task submission with leased
+workers (normal_task_submitter.h:86), ordered actor-task submission
+(actor_task_submitter.h:68), an in-memory store for small results owned by
+the submitting process (memory_store.h:47), shared-memory store access for
+large objects, task retries on worker death (task_manager.h:175), and the
+task-execution callback on the worker side (task_receiver.h:43 /
+_raylet.pyx:1602 execute_task).
+
+Ownership model: the process that submits a task (or calls put) owns the
+returned objects — it holds their values (inline) or locations (store) and
+serves `get_object` to any process holding the ref. This is the
+reference's ownership design (SURVEY.md section 5, failure detection row).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Sequence
+
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import ActorID, FunctionID, ObjectID, TaskID
+from ray_tpu._private.serialization import Serialized, deserialize, serialize
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    RayTaskError,
+    WorkerDiedError,
+)
+from ray_tpu.runtime.object_store import ObjectStore
+
+INLINE_MAX_BYTES = 100_000
+DEFAULT_RETRIES = 3
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        head_addr: str,
+        node_addr: str,
+        store_dir: str,
+        worker_id: str | None = None,
+    ):
+        self.mode = mode
+        self.head_addr = head_addr
+        self.node_addr = node_addr
+        self.store = ObjectStore(store_dir)
+        self.worker_id = worker_id
+        self.addr: str | None = None  # own serve addr (ownership identity)
+        self.server = rpc.Server(self._handle)
+        self.head: rpc.Connection | None = None
+        self.node: rpc.Connection | None = None
+        self._conns: dict[str, rpc.Connection] = {}
+        self._conn_locks: dict[str, asyncio.Lock] = {}
+
+        # memory store: oid hex → ("value", inband, buffers) | ("error", e)
+        # | ("in_store",)
+        self.memory: dict[str, tuple] = {}
+        self._waiters: dict[str, list[asyncio.Future]] = {}
+
+        # function table
+        self._exported: dict[int, str] = {}  # id(fn) → fn_id hex
+        self._fn_cache: dict[str, Any] = {}  # fn_id hex → callable/class
+
+        # lease cache: sched key → list[(lease dict, idle_since)]. Cached
+        # leases are returned to the node after an idle timeout so they
+        # don't pin resources (reference: normal_task_submitter.h lease
+        # caching with idle timeout + ReturnWorkerLease).
+        self._lease_cache: dict[tuple, list[tuple[dict, float]]] = {}
+        self._lease_cap = 8
+        self._lease_idle_s = 1.0
+        self._lease_reaper: asyncio.Task | None = None
+
+        # worker-side execution
+        self._exec_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ray_tpu_exec"
+        )
+        self._exec_queue: asyncio.Queue | None = None
+        self._exec_task: asyncio.Task | None = None
+        self._actor_instance: Any = None
+        self._actor_id: str | None = None
+
+        self._put_index = 0
+        self._root_task = TaskID.random()
+
+    # ----------------------------------------------------------- startup
+    async def start(self, host: str = "127.0.0.1") -> str:
+        port = await self.server.start(host, 0)
+        self.addr = f"{host}:{port}"
+        self.head = await rpc.connect(self.head_addr)
+        self.node = await rpc.connect(self.node_addr)
+        self._exec_queue = asyncio.Queue()
+        self._exec_task = asyncio.ensure_future(self._exec_loop())
+        self._lease_reaper = asyncio.ensure_future(self._lease_reap_loop())
+        return self.addr
+
+    async def stop(self):
+        if self._exec_task:
+            self._exec_task.cancel()
+        if self._lease_reaper:
+            self._lease_reaper.cancel()
+        self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        for conn in list(self._conns.values()):
+            await conn.close()
+        if self.head:
+            await self.head.close()
+        if self.node:
+            await self.node.close()
+        await self.server.stop()
+
+    async def _connect(self, addr: str) -> rpc.Connection:
+        conn = self._conns.get(addr)
+        if conn is not None and not conn._closed:
+            return conn
+        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn._closed:
+                return conn
+            conn = await rpc.connect(addr)
+            self._conns[addr] = conn
+            return conn
+
+    # ---------------------------------------------------- function table
+    async def export_function(self, fn: Any) -> str:
+        key = id(fn)
+        fn_id = self._exported.get(key)
+        if fn_id is not None:
+            return fn_id
+        blob = serialize(fn).materialize_buffers()
+        data = blob.inband + b"".join(blob.buffers)
+        fn_id = hashlib.sha1(data).hexdigest()[: FunctionID.LENGTH * 2]
+        await self.head.call(
+            "kv_put", key=f"fn:{fn_id}", value=data, overwrite=True
+        )
+        self._exported[key] = fn_id
+        self._fn_cache[fn_id] = fn
+        return fn_id
+
+    async def _fetch_function(self, fn_id: str) -> Any:
+        fn = self._fn_cache.get(fn_id)
+        if fn is not None:
+            return fn
+        reply = await self.head.call("kv_get", key=f"fn:{fn_id}")
+        if not reply["ok"]:
+            raise RayTaskError(f"function {fn_id} not found in cluster KV")
+        fn = deserialize(reply["value"])
+        self._fn_cache[fn_id] = fn
+        return fn
+
+    # ------------------------------------------------------------- args
+    def _encode_args(self, args: Sequence, kwargs: dict) -> list:
+        """Top-level ObjectRef args go by-ref; everything else by value
+        (reference: LocalDependencyResolver dependency_resolver.h:36)."""
+        from ray_tpu.api import ObjectRef
+
+        encoded = []
+        for slot, value in [(None, a) for a in args] + list(kwargs.items()):
+            if isinstance(value, ObjectRef):
+                encoded.append((slot, "ref", value.hex, value.owner_addr))
+            else:
+                s = serialize(value).materialize_buffers()
+                encoded.append((slot, "val", s.inband, s.buffers))
+        return encoded
+
+    async def _decode_args(self, encoded: list) -> tuple[list, dict]:
+        args, kwargs = [], {}
+        for entry in encoded:
+            slot = entry[0]
+            if entry[1] == "ref":
+                value = await self._get_one(entry[2], entry[3], timeout=None)
+            else:
+                value = deserialize(entry[2], entry[3])
+            if slot is None:
+                args.append(value)
+            else:
+                kwargs[slot] = value
+        return args, kwargs
+
+    # ------------------------------------------------------ memory store
+    def _store_result(self, oid_hex: str, record: tuple):
+        self.memory[oid_hex] = record
+        for fut in self._waiters.pop(oid_hex, []):
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _wait_local(self, oid_hex: str, timeout: float | None):
+        if oid_hex in self.memory:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.setdefault(oid_hex, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"timed out waiting for {oid_hex[:12]}…")
+
+    def _read_record(self, oid_hex: str):
+        """memory-store record → python value (may raise stored error)."""
+        kind, *rest = self.memory[oid_hex]
+        if kind == "error":
+            raise rest[0]
+        if kind == "value":
+            return deserialize(rest[0], rest[1])
+        if kind == "in_store":
+            view = self.store.get(ObjectID.from_hex(oid_hex))
+            if view is None:
+                raise RayTaskError(f"object {oid_hex[:12]}… lost from store")
+            return deserialize(view.inband, view.buffers)
+        raise AssertionError(kind)
+
+    # -------------------------------------------------------------- put
+    async def put(self, value: Any):
+        from ray_tpu.api import ObjectRef
+
+        self._put_index += 1
+        oid = ObjectID.for_put(self._root_task, self._put_index)
+        data = serialize(value)
+        if data.total_bytes() <= INLINE_MAX_BYTES:
+            m = data.materialize_buffers()
+            self._store_result(oid.hex(), ("value", m.inband, m.buffers))
+        else:
+            self.store.put(oid, data)
+            self._store_result(oid.hex(), ("in_store",))
+        return ObjectRef(oid.hex(), self.addr)
+
+    # -------------------------------------------------------------- get
+    async def _get_one(
+        self, oid_hex: str, owner_addr: str, timeout: float | None
+    ) -> Any:
+        if oid_hex in self.memory:
+            return self._read_record(oid_hex)
+        oid = ObjectID.from_hex(oid_hex)
+        view = self.store.get(oid)
+        if view is not None:
+            return deserialize(view.inband, view.buffers)
+        if owner_addr == self.addr or oid_hex in self._waiters or (
+            owner_addr is None
+        ):
+            await self._wait_local(oid_hex, timeout)
+            return self._read_record(oid_hex)
+        # Ask the owner (reference: OwnershipBasedObjectDirectory).
+        conn = await self._connect(owner_addr)
+        reply = await asyncio.wait_for(
+            conn.call("get_object", oid_hex=oid_hex), timeout
+        )
+        if reply["kind"] == "value":
+            return deserialize(reply["inband"], reply["buffers"])
+        if reply["kind"] == "in_store":
+            view = self.store.get(oid)
+            if view is not None:
+                return deserialize(view.inband, view.buffers)
+            raise RayTaskError(
+                f"object {oid_hex[:12]}… is in a remote node's store; "
+                "multi-node object transfer not yet wired"
+            )
+        if reply["kind"] == "error":
+            raise deserialize(reply["inband"])
+        raise AssertionError(reply["kind"])
+
+    async def get(self, refs: Sequence, timeout: float | None = None) -> list:
+        return list(
+            await asyncio.gather(
+                *(self._get_one(r.hex, r.owner_addr, timeout) for r in refs)
+            )
+        )
+
+    async def wait(
+        self,
+        refs: Sequence,
+        num_returns: int,
+        timeout: float | None,
+        fetch_local: bool = True,
+    ):
+        """Split refs into (ready, not_ready) — reference: wait_manager.h."""
+
+        async def ready(r):
+            await self._get_one(r.hex, r.owner_addr, None)
+            return r
+
+        pending = {
+            asyncio.ensure_future(ready(r)): r for r in refs
+        }
+        done_refs = []
+        try:
+            while pending and len(done_refs) < num_returns:
+                done, _ = await asyncio.wait(
+                    pending,
+                    timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    break  # timeout
+                for fut in done:
+                    r = pending.pop(fut)
+                    # Objects that errored still count as ready.
+                    done_refs.append(r)
+        finally:
+            for fut in pending:
+                fut.cancel()
+        not_ready = [r for r in refs if r not in done_refs]
+        return done_refs, not_ready
+
+    # ----------------------------------------------------- task submit
+    async def submit_task(
+        self,
+        fn: Any,
+        args: Sequence,
+        kwargs: dict,
+        num_returns: int = 1,
+        resources: dict | None = None,
+        max_retries: int = DEFAULT_RETRIES,
+        actor: "ActorSubmitTarget | None" = None,
+    ) -> list:
+        """Submit; returns ObjectRefs immediately, result delivery is
+        async (the reply fulfils the local futures)."""
+        from ray_tpu.api import ObjectRef
+
+        task_id = TaskID.random()
+        oids = [
+            ObjectID.for_return(task_id, i).hex() for i in range(num_returns)
+        ]
+        for oid_hex in oids:
+            self._waiters.setdefault(oid_hex, [])
+
+        # Actor calls carry the method *name*; normal tasks export the
+        # function to the cluster KV and carry its id.
+        fn_id = fn if actor is not None else await self.export_function(fn)
+        spec = {
+            "task_id": task_id.hex(),
+            "fn_id": fn_id,
+            "args": self._encode_args(args, kwargs),
+            "num_returns": num_returns,
+            "owner_addr": self.addr,
+        }
+        asyncio.ensure_future(
+            self._drive_task(spec, oids, resources, max_retries, actor)
+        )
+        return [ObjectRef(o, self.addr) for o in oids]
+
+    async def _drive_task(self, spec, oids, resources, retries, actor):
+        try:
+            if actor is not None:
+                await self._drive_actor_task(spec, oids, actor)
+            else:
+                await self._drive_normal_task(spec, oids, resources, retries)
+        except Exception as e:  # noqa: BLE001 - becomes the task's result
+            for oid_hex in oids:
+                self._store_result(oid_hex, ("error", e))
+
+    async def _drive_normal_task(self, spec, oids, resources, retries):
+        last_err: Exception | None = None
+        for attempt in range(retries + 1):
+            lease = None
+            try:
+                lease = await self._lease(resources)
+                conn = await self._connect(lease["addr"])
+                reply = await conn.call("push_task", spec=spec)
+                self._apply_reply(reply, oids)
+                return
+            except (rpc.ConnectionLost, rpc.RpcError) as e:
+                last_err = e
+                lease = None  # worker is gone; do not return the lease
+                continue
+            finally:
+                if lease is not None:
+                    await self._return_lease(lease)
+        raise WorkerDiedError(
+            f"task failed after {retries + 1} attempts: {last_err}"
+        )
+
+    async def _drive_actor_task(self, spec, oids, actor):
+        try:
+            conn = await self._connect(actor.addr)
+            reply = await conn.call(
+                "actor_call", spec=spec, actor_id=actor.actor_id
+            )
+            self._apply_reply(reply, oids)
+        except (rpc.ConnectionLost, rpc.RpcError) as e:
+            raise ActorDiedError(
+                f"actor {actor.actor_id[:12]}… died: {e}"
+            ) from e
+
+    def _apply_reply(self, reply: dict, oids: list):
+        if reply["status"] == "error":
+            err = deserialize(reply["error"])
+            for oid_hex in oids:
+                self._store_result(oid_hex, ("error", err))
+            return
+        for oid_hex, kind, *rest in reply["results"]:
+            if kind == "inline":
+                self._store_result(oid_hex, ("value", rest[0], rest[1]))
+            else:  # in the node-shared store
+                self._store_result(oid_hex, ("in_store",))
+
+    # ------------------------------------------------------------ leases
+    def _sched_key(self, resources: dict | None) -> tuple:
+        return tuple(sorted((resources or {"CPU": 1.0}).items()))
+
+    async def _lease(self, resources: dict | None) -> dict:
+        key = self._sched_key(resources)
+        cache = self._lease_cache.setdefault(key, [])
+        while cache:
+            lease, _ = cache.pop()
+            conn = self._conns.get(lease["addr"])
+            if conn is None or not conn._closed:
+                return lease
+        reply = await self.node.call(
+            "lease_worker", resources=dict(resources or {"CPU": 1.0})
+        )
+        if not reply.get("ok"):
+            raise rpc.RpcError(reply.get("error", "lease failed"))
+        reply["sched_key"] = key
+        return reply
+
+    async def _return_lease(self, lease: dict):
+        import time
+
+        cache = self._lease_cache.setdefault(lease["sched_key"], [])
+        if len(cache) < self._lease_cap:
+            cache.append((lease, time.monotonic()))
+        else:
+            await self._give_back(lease)
+
+    async def _give_back(self, lease: dict):
+        try:
+            await self.node.call("return_lease", lease_id=lease["lease_id"])
+        except rpc.RpcError:
+            pass
+
+    async def _lease_reap_loop(self):
+        import time
+
+        while True:
+            await asyncio.sleep(self._lease_idle_s / 2)
+            now = time.monotonic()
+            for cache in self._lease_cache.values():
+                keep = []
+                for lease, since in cache:
+                    if now - since > self._lease_idle_s:
+                        asyncio.ensure_future(self._give_back(lease))
+                    else:
+                        keep.append((lease, since))
+                cache[:] = keep
+
+    # ----------------------------------------------------------- actors
+    async def create_actor(
+        self,
+        cls: type,
+        args: Sequence,
+        kwargs: dict,
+        name: str | None = None,
+        resources: dict | None = None,
+        detached: bool = False,
+    ):
+        actor_id = ActorID.random().hex()
+        reply = await self.node.call(
+            "lease_worker", resources=dict(resources or {"CPU": 1.0}), actor=True
+        )
+        if not reply.get("ok"):
+            raise rpc.RpcError(reply.get("error", "actor lease failed"))
+        fn_id = await self.export_function(cls)
+        conn = await self._connect(reply["addr"])
+        create = await conn.call(
+            "create_actor",
+            actor_id=actor_id,
+            fn_id=fn_id,
+            args=self._encode_args(args, kwargs),
+        )
+        if create["status"] == "error":
+            raise deserialize(create["error"])
+        info = await self.node.call("node_info")
+        await self.head.call(
+            "register_actor",
+            actor_id=actor_id,
+            name=name,
+            class_name=cls.__name__,
+            addr=reply["addr"],
+            node_id=info["node_id"],
+            detached=detached,
+        )
+        return actor_id, reply["addr"]
+
+    async def kill_actor(self, actor_id: str, addr: str):
+        try:
+            conn = await self._connect(addr)
+            await conn.call("exit_worker")
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+        await self.head.call("update_actor", actor_id=actor_id, state="DEAD")
+
+    # ------------------------------------------------- worker-side serve
+    async def _handle(self, method: str, kw: dict, conn: rpc.Connection):
+        fn = getattr(self, f"_on_{method}", None)
+        if fn is None:
+            raise rpc.RpcError(f"core_worker: unknown method {method!r}")
+        return await fn(conn=conn, **kw)
+
+    async def _on_ping(self, conn):
+        return {"ok": True}
+
+    async def _on_get_object(self, conn, oid_hex: str):
+        """Serve an object I own (reference: PushTaskReply + owner memory
+        store; pull protocol object_manager.proto:60)."""
+        if oid_hex not in self.memory:
+            oid = ObjectID.from_hex(oid_hex)
+            if self.store.contains(oid):
+                return {"kind": "in_store"}
+            await self._wait_local(oid_hex, timeout=None)
+        kind, *rest = self.memory[oid_hex]
+        if kind == "error":
+            return {"kind": "error", "inband": _dumps_small(rest[0])}
+        if kind == "value":
+            return {"kind": "value", "inband": rest[0], "buffers": rest[1]}
+        return {"kind": "in_store"}
+
+    async def _on_push_task(self, conn, spec: dict):
+        fut = asyncio.get_running_loop().create_future()
+        await self._exec_queue.put(("task", spec, None, fut))
+        return await fut
+
+    async def _on_actor_call(self, conn, spec: dict, actor_id: str):
+        fut = asyncio.get_running_loop().create_future()
+        await self._exec_queue.put(("task", spec, actor_id, fut))
+        return await fut
+
+    async def _on_create_actor(self, conn, actor_id: str, fn_id: str, args):
+        try:
+            cls = await self._fetch_function(fn_id)
+            a, kw = await self._decode_args(args)
+            loop = asyncio.get_running_loop()
+            self._actor_instance = await loop.run_in_executor(
+                self._exec_pool, lambda: cls(*a, **kw)
+            )
+            self._actor_id = actor_id
+            return {"status": "ok"}
+        except Exception as e:  # noqa: BLE001
+            return {"status": "error", "error": _dumps_small(_as_task_error(e))}
+
+    async def _on_exit_worker(self, conn):
+        asyncio.get_running_loop().call_later(0.05, _hard_exit)
+        return {"ok": True}
+
+    # -------------------------------------------------- execution loop
+    async def _exec_loop(self):
+        """Strictly ordered execution (reference: ActorSchedulingQueue /
+        NormalSchedulingQueue, task_receiver.h:43): tasks run one at a
+        time, in arrival order, on the executor thread."""
+        while True:
+            kind, spec, actor_id, fut = await self._exec_queue.get()
+            reply = await self._execute(spec, actor_id)
+            if not fut.done():
+                fut.set_result(reply)
+
+    async def _execute(self, spec: dict, actor_id: str | None) -> dict:
+        loop = asyncio.get_running_loop()
+        try:
+            args, kwargs = await self._decode_args(spec["args"])
+            if actor_id is not None:
+                method_name = spec["fn_id"]  # actor calls carry the name
+                instance = self._actor_instance
+                if instance is None or actor_id != self._actor_id:
+                    raise ActorDiedError("no such actor in this worker")
+                fn = getattr(instance, method_name)
+            else:
+                fn = await self._fetch_function(spec["fn_id"])
+            result = await loop.run_in_executor(
+                self._exec_pool, lambda: fn(*args, **kwargs)
+            )
+            n = spec["num_returns"]
+            values = (
+                [result]
+                if n == 1
+                else list(result)
+                if n > 1
+                else []
+            )
+            if n > 1 and len(values) != n:
+                raise RayTaskError(
+                    f"task declared num_returns={n} but returned "
+                    f"{len(values)} values"
+                )
+            results = []
+            task_id = TaskID.from_hex(spec["task_id"])
+            for i, value in enumerate(values):
+                oid = ObjectID.for_return(task_id, i)
+                data = serialize(value)
+                if data.total_bytes() <= INLINE_MAX_BYTES:
+                    m = data.materialize_buffers()
+                    results.append((oid.hex(), "inline", m.inband, m.buffers))
+                else:
+                    self.store.put(oid, data)
+                    results.append((oid.hex(), "in_store"))
+            return {"status": "ok", "results": results}
+        except Exception as e:  # noqa: BLE001 - travels to the owner
+            return {"status": "error", "error": _dumps_small(_as_task_error(e))}
+
+
+class ActorSubmitTarget:
+    __slots__ = ("actor_id", "addr")
+
+    def __init__(self, actor_id: str, addr: str):
+        self.actor_id = actor_id
+        self.addr = addr
+
+
+def _dumps_small(value: Any) -> bytes:
+    """Serialize fully in-band (no out-of-band buffers) — for errors and
+    other payloads that must survive as a single bytes blob."""
+    import cloudpickle
+
+    try:
+        return cloudpickle.dumps(value)
+    except Exception:
+        return cloudpickle.dumps(RayTaskError(repr(value)))
+
+
+def _as_task_error(e: Exception) -> Exception:
+    if isinstance(e, RayTaskError):
+        return e
+    tb = traceback.format_exc()
+    try:
+        wrapped = RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
+        wrapped.cause = e
+        return wrapped
+    except Exception:
+        return RayTaskError(tb)
+
+
+def _hard_exit():
+    import os
+
+    os._exit(0)

@@ -1,0 +1,206 @@
+"""Head service: cluster-metadata authority (GCS equivalent).
+
+Mirrors the reference's GCS server responsibilities (reference:
+src/ray/gcs/gcs_server.h:100 — node table, actor registry, KV store,
+pubsub, health checks, cluster-level scheduling) in one asyncio service.
+State lives in process memory behind a tiny storage interface so a
+Redis/file backend can slot in for fault tolerance (reference:
+gcs/store_client/redis_store_client.h:126).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import ActorID, NodeID
+
+HEALTH_TIMEOUT_S = 30.0
+
+
+class HeadService:
+    def __init__(self):
+        self.server = rpc.Server(self._handle)
+        self.addr: str | None = None
+        # node_id hex → {addr, resources, labels, last_seen, conn}
+        self.nodes: dict[str, dict] = {}
+        self.kv: dict[str, bytes] = {}
+        # actor_id hex → {name, state, addr, node_id, class_name}
+        self.actors: dict[str, dict] = {}
+        self.named_actors: dict[str, str] = {}  # name → actor_id hex
+        # channel → set[Connection]
+        self.subs: dict[str, set[rpc.Connection]] = {}
+        self._reaper: asyncio.Task | None = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        p = await self.server.start(host, port)
+        self.addr = f"{host}:{p}"
+        self._reaper = asyncio.ensure_future(self._health_loop())
+        return self.addr
+
+    async def stop(self):
+        if self._reaper:
+            self._reaper.cancel()
+        await self.server.stop()
+
+    # ------------------------------------------------------------ pubsub
+    def publish(self, channel: str, msg: Any):
+        for conn in list(self.subs.get(channel, ())):
+            conn.push({"channel": channel, "msg": msg})
+
+    # ----------------------------------------------------------- handler
+    async def _handle(self, method: str, kw: dict, conn: rpc.Connection):
+        fn = getattr(self, f"_on_{method}", None)
+        if fn is None:
+            raise rpc.RpcError(f"head: unknown method {method!r}")
+        return await fn(conn=conn, **kw)
+
+    async def _on_register_node(
+        self, conn, node_id: str, addr: str, resources: dict, labels=None
+    ):
+        self.nodes[node_id] = {
+            "addr": addr,
+            "resources": dict(resources),
+            "available": dict(resources),
+            "labels": labels or {},
+            "last_seen": time.monotonic(),
+            "conn": conn,
+        }
+        conn.state["node_id"] = node_id
+        self.publish("node", {"event": "added", "node_id": node_id, "addr": addr})
+        return {"ok": True}
+
+    async def _on_heartbeat(self, conn, node_id: str, available: dict):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False, "reregister": True}
+        node["last_seen"] = time.monotonic()
+        node["available"] = available
+        return {"ok": True}
+
+    async def _on_node_table(self, conn):
+        return {
+            nid: {k: v for k, v in n.items() if k != "conn"}
+            for nid, n in self.nodes.items()
+        }
+
+    async def _on_pick_node(self, conn, resources: dict | None = None):
+        """Cluster-level placement: pick a feasible node for a lease.
+
+        Reference analogue: the hybrid scheduling policy's feasibility +
+        availability scoring (reference:
+        src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:25);
+        centralized here (GCS-style) rather than spilled raylet-to-raylet.
+        """
+        resources = resources or {}
+        best, best_score = None, None
+        for nid, node in self.nodes.items():
+            avail = node["available"]
+            total = node["resources"]
+            if any(total.get(k, 0) < v for k, v in resources.items()):
+                continue  # infeasible
+            free = sum(avail.get(k, 0) for k in resources) if resources else 1
+            score = (
+                all(avail.get(k, 0) >= v for k, v in resources.items()),
+                free,
+            )
+            if best_score is None or score > best_score:
+                best, best_score = nid, score
+        if best is None:
+            return {"ok": False, "error": "no feasible node"}
+        return {"ok": True, "node_id": best, "addr": self.nodes[best]["addr"]}
+
+    # ------------------------------------------------------------- kv
+    async def _on_kv_put(self, conn, key: str, value: bytes, overwrite=True):
+        if not overwrite and key in self.kv:
+            return {"ok": False, "exists": True}
+        self.kv[key] = value
+        return {"ok": True}
+
+    async def _on_kv_get(self, conn, key: str):
+        return {"ok": key in self.kv, "value": self.kv.get(key)}
+
+    async def _on_kv_del(self, conn, key: str):
+        return {"ok": self.kv.pop(key, None) is not None}
+
+    async def _on_kv_keys(self, conn, prefix: str = ""):
+        return {"keys": [k for k in self.kv if k.startswith(prefix)]}
+
+    # ----------------------------------------------------------- actors
+    async def _on_register_actor(
+        self,
+        conn,
+        actor_id: str,
+        name: str | None,
+        class_name: str,
+        addr: str,
+        node_id: str,
+        detached: bool = False,
+    ):
+        if name:
+            existing = self.named_actors.get(name)
+            if existing and self.actors[existing]["state"] != "DEAD":
+                return {"ok": False, "error": f"actor name {name!r} taken"}
+            self.named_actors[name] = actor_id
+        self.actors[actor_id] = {
+            "name": name,
+            "state": "ALIVE",
+            "addr": addr,
+            "node_id": node_id,
+            "class_name": class_name,
+            "detached": detached,
+        }
+        self.publish("actor", {"event": "alive", "actor_id": actor_id})
+        return {"ok": True}
+
+    async def _on_update_actor(self, conn, actor_id: str, state: str):
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"ok": False}
+        actor["state"] = state
+        self.publish("actor", {"event": state.lower(), "actor_id": actor_id})
+        return {"ok": True}
+
+    async def _on_get_actor(
+        self, conn, name: str | None = None, actor_id: str | None = None
+    ):
+        if name is not None:
+            actor_id = self.named_actors.get(name)
+        if actor_id is None or actor_id not in self.actors:
+            return {"ok": False, "error": "actor not found"}
+        return {"ok": True, "actor_id": actor_id, **self.actors[actor_id]}
+
+    async def _on_list_actors(self, conn):
+        return {"actors": dict(self.actors)}
+
+    # ----------------------------------------------------------- pubsub
+    async def _on_subscribe(self, conn, channel: str):
+        self.subs.setdefault(channel, set()).add(conn)
+        conn.state.setdefault("channels", []).append(channel)
+        return {"ok": True}
+
+    async def _on_publish(self, conn, channel: str, msg):
+        self.publish(channel, msg)
+        return {"ok": True}
+
+    # ----------------------------------------------------------- health
+    async def _health_loop(self):
+        """Mark nodes dead on heartbeat timeout (reference:
+        gcs_health_check_manager.h:45 does active gRPC probes)."""
+        while True:
+            await asyncio.sleep(5.0)
+            now = time.monotonic()
+            for nid, node in list(self.nodes.items()):
+                if now - node["last_seen"] > HEALTH_TIMEOUT_S:
+                    del self.nodes[nid]
+                    self.publish(
+                        "node", {"event": "removed", "node_id": nid}
+                    )
+                    for aid, actor in self.actors.items():
+                        if actor["node_id"] == nid and actor["state"] == "ALIVE":
+                            actor["state"] = "DEAD"
+                            self.publish(
+                                "actor", {"event": "dead", "actor_id": aid}
+                            )

@@ -1,0 +1,331 @@
+"""Node manager: per-host daemon — worker pool + lease scheduling.
+
+Mirrors the reference raylet's local responsibilities (reference:
+src/ray/raylet/node_manager.h:140 `HandleRequestWorkerLease`,
+worker_pool.h:280): it spawns/caches Python worker processes, grants
+worker leases against local resource accounting, queues infeasible
+requests, reaps dead workers, and owns the node's shared-memory object
+store directory. TPU twist: TPU chips are first-class resources — the
+node detects them from the JAX runtime / environment and registers
+"TPU" alongside "CPU" (reference handles TPU via a Python plugin,
+python/ray/_private/accelerators/tpu.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Any
+
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import NodeID, WorkerID
+
+IDLE_WORKER_CAP = 4  # idle processes kept warm per node
+SPAWN_TIMEOUT_S = 30.0
+
+
+def detect_resources() -> dict[str, float]:
+    resources: dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
+    n_tpu = os.environ.get("RAY_TPU_FAKE_CHIPS")
+    if n_tpu is not None:
+        resources["TPU"] = float(n_tpu)
+    else:
+        try:
+            import jax
+
+            tpus = [d for d in jax.devices() if d.platform != "cpu"]
+            if tpus:
+                resources["TPU"] = float(len(tpus))
+        except Exception:
+            pass
+    return resources
+
+
+class Lease:
+    __slots__ = ("lease_id", "worker", "resources", "actor")
+
+    def __init__(self, lease_id: str, worker: dict, resources: dict, actor: bool):
+        self.lease_id = lease_id
+        self.worker = worker
+        self.resources = resources
+        self.actor = actor
+
+
+class NodeManager:
+    def __init__(
+        self,
+        head_addr: str,
+        store_dir: str,
+        resources: dict[str, float] | None = None,
+        worker_env: dict[str, str] | None = None,
+    ):
+        self.node_id = NodeID.random().hex()
+        self.head_addr = head_addr
+        self.store_dir = store_dir
+        self.total = resources or detect_resources()
+        self.available = dict(self.total)
+        self.worker_env = worker_env or {}
+        self.server = rpc.Server(self._handle)
+        self.addr: str | None = None
+        self.head: rpc.Connection | None = None
+        # worker_id → {proc, conn, addr, pid, state: spawning|idle|leased}
+        self.workers: dict[str, dict] = {}
+        self.idle: list[str] = []
+        self.leases: dict[str, Lease] = {}
+        self._pending: list[tuple[dict, bool, asyncio.Future]] = []
+        self._spawn_waiters: dict[str, asyncio.Future] = {}
+        self._next_lease = 0
+        self._tasks: list[asyncio.Task] = []
+
+    # ----------------------------------------------------------- startup
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        p = await self.server.start(host, port)
+        self.addr = f"{host}:{p}"
+        self.head = await rpc.connect(self.head_addr)
+        await self.head.call(
+            "register_node",
+            node_id=self.node_id,
+            addr=self.addr,
+            resources=self.total,
+        )
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        return self.addr
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        for w in self.workers.values():
+            proc = w.get("proc")
+            if proc and proc.poll() is None:
+                proc.terminate()
+        for w in self.workers.values():
+            proc = w.get("proc")
+            if proc:
+                try:
+                    proc.wait(timeout=2)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        if self.head:
+            await self.head.close()
+        await self.server.stop()
+
+    # ------------------------------------------------------------ workers
+    def _spawn_worker(self) -> str:
+        worker_id = WorkerID.random().hex()
+        env = {
+            **os.environ,
+            **self.worker_env,
+            "RAY_TPU_HEAD_ADDR": self.head_addr,
+            "RAY_TPU_NODE_ADDR": self.addr or "",
+            "RAY_TPU_STORE_DIR": self.store_dir,
+            "RAY_TPU_WORKER_ID": worker_id,
+            # Workers must not grab the TPU chip the driver holds; they run
+            # host code (and JAX CPU) unless a lease says otherwise.
+            "JAX_PLATFORMS": env_jax_platform(),
+        }
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.runtime.worker_main"],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        self.workers[worker_id] = {"proc": proc, "state": "spawning"}
+        return worker_id
+
+    async def _wait_registered(self, worker_id: str) -> dict:
+        w = self.workers.get(worker_id)
+        if w and w.get("conn"):
+            return w
+        fut = asyncio.get_running_loop().create_future()
+        self._spawn_waiters[worker_id] = fut
+        try:
+            return await asyncio.wait_for(fut, SPAWN_TIMEOUT_S)
+        finally:
+            self._spawn_waiters.pop(worker_id, None)
+
+    # ------------------------------------------------------------ leases
+    def _feasible(self, resources: dict) -> bool:
+        return all(self.total.get(k, 0) >= v for k, v in resources.items())
+
+    def _available(self, resources: dict) -> bool:
+        return all(self.available.get(k, 0) >= v for k, v in resources.items())
+
+    def _acquire(self, resources: dict):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0) - v
+
+    def _release(self, resources: dict):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0) + v
+
+    async def _grant_lease(self, resources: dict, actor: bool) -> dict:
+        self._acquire(resources)
+        try:
+            if self.idle:
+                worker_id = self.idle.pop()
+            else:
+                worker_id = self._spawn_worker()
+            w = await self._wait_registered(worker_id)
+            w["state"] = "leased"
+            self._next_lease += 1
+            lease_id = f"{self.node_id[:8]}-{self._next_lease}"
+            self.leases[lease_id] = Lease(
+                lease_id, {**w, "worker_id": worker_id}, resources, actor
+            )
+            return {
+                "ok": True,
+                "lease_id": lease_id,
+                "worker_id": worker_id,
+                "addr": w["addr"],
+            }
+        except Exception:
+            self._release(resources)
+            raise
+
+    async def _handle(self, method: str, kw: dict, conn: rpc.Connection):
+        fn = getattr(self, f"_on_{method}", None)
+        if fn is None:
+            raise rpc.RpcError(f"node: unknown method {method!r}")
+        return await fn(conn=conn, **kw)
+
+    async def _on_register_worker(
+        self, conn, worker_id: str, addr: str, pid: int
+    ):
+        w = self.workers.setdefault(worker_id, {})
+        w.update(conn=conn, addr=addr, pid=pid, state="idle")
+        conn.state["worker_id"] = worker_id
+        fut = self._spawn_waiters.get(worker_id)
+        if fut and not fut.done():
+            fut.set_result(w)
+        else:
+            self.idle.append(worker_id)
+        return {"ok": True, "node_id": self.node_id}
+
+    async def _on_lease_worker(
+        self, conn, resources: dict | None = None, actor: bool = False
+    ):
+        """Grant a worker lease (reference: NodeManager::
+        HandleRequestWorkerLease node_manager.h:290). Infeasible requests
+        fail fast; unavailable ones queue until resources free up."""
+        resources = dict(resources or {"CPU": 1.0})
+        if not self._feasible(resources):
+            return {
+                "ok": False,
+                "infeasible": True,
+                "error": f"infeasible request {resources} on {self.total}",
+            }
+        if self._available(resources):
+            return await self._grant_lease(resources, actor)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((resources, actor, fut))
+        return await fut
+
+    async def _on_return_lease(self, conn, lease_id: str):
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return {"ok": False}
+        self._release(lease.resources)
+        worker_id = lease.worker["worker_id"]
+        w = self.workers.get(worker_id)
+        if w and w.get("state") == "leased":
+            if len(self.idle) < IDLE_WORKER_CAP:
+                w["state"] = "idle"
+                self.idle.append(worker_id)
+            else:
+                self._kill_worker(worker_id)
+        self._drain_pending()
+        return {"ok": True}
+
+    async def _on_kill_worker(self, conn, worker_id: str, force: bool = True):
+        self._kill_worker(worker_id)
+        return {"ok": True}
+
+    async def _on_node_info(self, conn):
+        return {
+            "node_id": self.node_id,
+            "addr": self.addr,
+            "resources": self.total,
+            "available": self.available,
+            "n_workers": len(self.workers),
+            "store_dir": self.store_dir,
+        }
+
+    def _kill_worker(self, worker_id: str):
+        w = self.workers.pop(worker_id, None)
+        if not w:
+            return
+        if worker_id in self.idle:
+            self.idle.remove(worker_id)
+        proc = w.get("proc")
+        if proc and proc.poll() is None:
+            proc.kill()
+
+    def _drain_pending(self):
+        still = []
+        for resources, actor, fut in self._pending:
+            if not fut.done() and self._available(resources):
+                asyncio.ensure_future(self._fulfil(resources, actor, fut))
+            elif not fut.done():
+                still.append((resources, actor, fut))
+        self._pending = still
+
+    async def _fulfil(self, resources, actor, fut):
+        try:
+            result = await self._grant_lease(resources, actor)
+            if not fut.done():
+                fut.set_result(result)
+        except Exception as e:  # noqa: BLE001
+            if not fut.done():
+                fut.set_exception(e)
+
+    # ------------------------------------------------------------- loops
+    async def _heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(2.0)
+            try:
+                await self.head.call(
+                    "heartbeat", node_id=self.node_id, available=self.available
+                )
+            except rpc.RpcError:
+                pass
+
+    async def _reap_loop(self):
+        """Detect worker process death and fail affected leases
+        (reference: raylet detects worker death via process wait + IPC
+        disconnect, SURVEY.md section 5)."""
+        while True:
+            await asyncio.sleep(1.0)
+            dead = [
+                wid
+                for wid, w in self.workers.items()
+                if w.get("proc") is not None and w["proc"].poll() is not None
+            ]
+            for wid in dead:
+                w = self.workers.pop(wid, None)
+                if wid in self.idle:
+                    self.idle.remove(wid)
+                for lease_id, lease in list(self.leases.items()):
+                    if lease.worker["worker_id"] == wid:
+                        self.leases.pop(lease_id)
+                        self._release(lease.resources)
+                if self.head:
+                    try:
+                        await self.head.call(
+                            "publish",
+                            channel="worker",
+                            msg={"event": "died", "worker_id": wid},
+                        )
+                    except rpc.RpcError:
+                        pass
+            if dead:
+                self._drain_pending()
+
+
+def env_jax_platform() -> str:
+    # Worker processes default to CPU JAX; TPU-holding workers are
+    # configured explicitly by the trainer/collective layer.
+    return os.environ.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")

@@ -1,0 +1,160 @@
+"""Per-node shared-memory object store (plasma equivalent).
+
+The reference runs a slab-allocated shared-memory daemon inside the raylet
+(reference: src/ray/object_manager/plasma/store.h:55, dlmalloc pool,
+fd-passing over unix sockets). TPU-native design note: on Linux, POSIX shm
+*is* files under /dev/shm — so instead of a daemon brokering fds, each
+sealed object is one mmap'd file in a session directory. Create-then-seal
+is an atomic rename; readers mmap the sealed file and get zero-copy
+memoryviews (pickle-5 out-of-band buffers point straight into the map).
+Eviction/spilling hooks live here; a C++ pool allocator can replace the
+file-per-object layout behind this same interface.
+
+Layout of a sealed object file:
+    [u64 magic][u64 inband_len][u32 n_buffers][u64 len * n_buffers]
+    inband bytes, then each buffer 64-byte aligned.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import tempfile
+from pathlib import Path
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import Serialized
+
+_MAGIC = 0x52545055_53544F52  # "RTPUSTOR"
+_HEADER = struct.Struct("<QQI")
+_LEN = struct.Struct("<Q")
+_ALIGN = 64
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class PlasmaView:
+    """Zero-copy view of a sealed object; keeps its mmap alive."""
+
+    __slots__ = ("inband", "buffers", "_map", "_file_size")
+
+    def __init__(self, mapping: mmap.mmap):
+        self._map = mapping
+        mv = memoryview(mapping)
+        magic, inband_len, n_buffers = _HEADER.unpack_from(mv, 0)
+        if magic != _MAGIC:
+            raise ValueError("corrupt object store entry")
+        off = _HEADER.size
+        lens = []
+        for _ in range(n_buffers):
+            (length,) = _LEN.unpack_from(mv, off)
+            lens.append(length)
+            off += _LEN.size
+        self.inband = mv[off : off + inband_len]
+        off = _aligned(off + inband_len)
+        self.buffers = []
+        for length in lens:
+            self.buffers.append(mv[off : off + length])
+            off = _aligned(off + length)
+        self._file_size = len(mv)
+
+
+class ObjectStore:
+    """One store per node; all processes on the node share the directory."""
+
+    def __init__(self, directory: str | Path, capacity_bytes: int | None = None):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.capacity = capacity_bytes
+        # Views handed out by this process; held so mmaps stay valid.
+        self._views: dict[ObjectID, PlasmaView] = {}
+
+    def _path(self, object_id: ObjectID) -> Path:
+        return self.dir / object_id.hex()
+
+    def put(self, object_id: ObjectID, data: Serialized) -> int:
+        """Create + seal in one step. Returns bytes written."""
+        path = self._path(object_id)
+        if path.exists():
+            return path.stat().st_size  # immutable: double-put is a no-op
+        header = _HEADER.pack(_MAGIC, len(data.inband), len(data.buffers))
+        lens = b"".join(_LEN.pack(len(b)) for b in data.buffers)
+        meta_len = len(header) + len(lens)
+
+        total = _aligned(meta_len + len(data.inband))
+        for b in data.buffers:
+            total = _aligned(total + len(b))
+        total = max(total, 1)
+
+        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".create-")
+        try:
+            os.ftruncate(fd, total)
+            with mmap.mmap(fd, total) as m:
+                m[: len(header)] = header
+                off = len(header)
+                m[off : off + len(lens)] = lens
+                off += len(lens)
+                m[off : off + len(data.inband)] = bytes(data.inband)
+                off = _aligned(off + len(data.inband))
+                for b in data.buffers:
+                    m[off : off + len(b)] = bytes(b) if not isinstance(
+                        b, (bytes, memoryview)
+                    ) else b
+                    off = _aligned(off + len(b))
+            os.close(fd)
+            os.rename(tmp, path)  # seal
+        except BaseException:
+            os.close(fd) if fd >= 0 else None
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return total
+
+    def get(self, object_id: ObjectID) -> PlasmaView | None:
+        view = self._views.get(object_id)
+        if view is not None:
+            return view
+        path = self._path(object_id)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except FileNotFoundError:
+            return None
+        try:
+            size = os.fstat(fd).st_size
+            mapping = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        view = PlasmaView(mapping)
+        self._views[object_id] = view
+        return view
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return object_id in self._views or self._path(object_id).exists()
+
+    def delete(self, object_id: ObjectID) -> None:
+        self._views.pop(object_id, None)
+        try:
+            os.unlink(self._path(object_id))
+        except FileNotFoundError:
+            pass
+
+    def used_bytes(self) -> int:
+        return sum(
+            p.stat().st_size for p in self.dir.iterdir() if p.is_file()
+        )
+
+    def destroy(self) -> None:
+        self._views.clear()
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def default_store_dir(session: str) -> str:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    return os.path.join(base, f"ray_tpu-{session}")

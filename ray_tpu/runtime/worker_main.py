@@ -1,0 +1,43 @@
+"""Worker process entry point (reference: the default_worker.py the raylet
+execs, python/ray/_private/workers/default_worker.py + worker_pool.h:280).
+
+Spawned by the node manager with connection info in env vars; registers
+back, then serves tasks until told to exit or the node dies.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+
+
+async def main() -> None:
+    from ray_tpu.runtime.core_worker import CoreWorker
+    import ray_tpu.api as api
+
+    head_addr = os.environ["RAY_TPU_HEAD_ADDR"]
+    node_addr = os.environ["RAY_TPU_NODE_ADDR"]
+    store_dir = os.environ["RAY_TPU_STORE_DIR"]
+    worker_id = os.environ["RAY_TPU_WORKER_ID"]
+
+    core = CoreWorker(
+        mode="worker",
+        head_addr=head_addr,
+        node_addr=node_addr,
+        store_dir=store_dir,
+        worker_id=worker_id,
+    )
+    addr = await core.start()
+    api._attach_worker(core, asyncio.get_running_loop())
+    await core.node.call(
+        "register_worker", worker_id=worker_id, addr=addr, pid=os.getpid()
+    )
+    # Serve until the node connection drops (node death ⇒ worker exit).
+    while not core.node._closed:
+        await asyncio.sleep(0.5)
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,42 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops import causal_attention
+
+
+def _qkv(key, b=1, s=8, h=2, d=16):
+    ks = jax.random.split(key, 3)
+    return tuple(
+        jax.random.normal(k, (b, s, h, d), jnp.float32) for k in ks
+    )
+
+
+def test_fully_masked_block_contributes_zero():
+    """A KV block entirely in the query's future (ring attention case)
+    must produce exactly zero output, not mean(V)."""
+    q, k, v = _qkv(jax.random.key(0))
+    out = causal_attention(q, k, v, q_offset=0, kv_offset=64)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+
+def test_offsets_match_unshifted():
+    """Shifting both q and kv by the same offset must not change output."""
+    q, k, v = _qkv(jax.random.key(1))
+    base = causal_attention(q, k, v)
+    shifted = causal_attention(q, k, v, q_offset=100, kv_offset=100)
+    np.testing.assert_allclose(base, shifted, rtol=1e-6)
+
+
+def test_gqa_matches_repeated_kv():
+    """GQA with repeated KV must equal full MHA with tiled heads."""
+    b, s, hq, hkv, d = 1, 8, 4, 2, 16
+    keys = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(keys[0], (b, s, hq, d))
+    k = jax.random.normal(keys[1], (b, s, hkv, d))
+    v = jax.random.normal(keys[2], (b, s, hkv, d))
+    gqa = causal_attention(q, k, v)
+    k_full = jnp.repeat(k, 2, axis=2)
+    v_full = jnp.repeat(v, 2, axis=2)
+    full = causal_attention(q, k_full, v_full)
+    np.testing.assert_allclose(gqa, full, rtol=1e-5, atol=1e-6)

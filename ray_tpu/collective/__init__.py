@@ -1,0 +1,177 @@
+"""Collective communication API (reference surface:
+python/ray/util/collective/collective.py — init_collective_group :171,
+allreduce :328, barrier :368, reduce :381, broadcast :443, allgather :493,
+reducescatter :542, send :601, recv :664).
+
+The default data plane is XLA collectives (ICI within a slice, DCN across
+slices) instead of NCCL/Gloo; host-memory tensors use the CPU backend over
+the runtime RPC. Groups are process-wide, keyed by name, and rendezvous
+through the cluster head's KV store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from ray_tpu.collective.types import Backend, ReduceOp
+
+_groups: dict[str, Any] = {}
+
+
+def _runtime():
+    import ray_tpu.api as api
+
+    if not api._runtime.ready:
+        raise RuntimeError("ray_tpu.init() must be called before collectives")
+    return api._runtime
+
+
+def _resolve_backend(backend) -> Backend:
+    backend = Backend(backend)
+    if backend is Backend.AUTO:
+        import jax
+
+        accel = [d for d in jax.devices() if d.platform != "cpu"]
+        return Backend.XLA_MESH if len(accel) > 1 else Backend.CPU
+    return backend
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str | Backend = Backend.AUTO,
+    group_name: str = "default",
+) -> None:
+    """Join this process into a named collective group."""
+    if group_name in _groups:
+        raise ValueError(f"collective group {group_name!r} already exists")
+    backend = _resolve_backend(backend)
+    rt = _runtime()
+    if backend is Backend.CPU:
+        from ray_tpu.collective.backends.cpu_group import CpuGroup
+
+        async def make():
+            g = CpuGroup(rt.core, group_name, world_size, rank)
+            await g.init()
+            return g
+
+        _groups[group_name] = rt.run(make())
+    elif backend is Backend.XLA_MESH:
+        from ray_tpu.collective.backends.xla_group import XlaMeshGroup
+
+        g = XlaMeshGroup()
+        if g.world != world_size:
+            raise ValueError(
+                f"xla_mesh backend: {g.world} local devices != "
+                f"world_size {world_size}"
+            )
+        _groups[group_name] = g
+    elif backend is Backend.XLA_DIST:
+        from ray_tpu.collective.backends.xla_group import (
+            XlaDistGroup,
+            bootstrap_distributed,
+        )
+
+        rt.run(
+            bootstrap_distributed(rt.core, group_name, world_size, rank)
+        )
+        _groups[group_name] = XlaDistGroup(world_size, rank)
+    else:
+        raise ValueError(f"unsupported backend {backend}")
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return group_name in _groups
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    g = _groups.pop(group_name, None)
+    if g is not None and hasattr(g, "destroy"):
+        _runtime().run(g.destroy())
+
+
+def get_group(group_name: str = "default"):
+    g = _groups.get(group_name)
+    if g is None:
+        raise ValueError(f"collective group {group_name!r} not initialized")
+    return g
+
+
+def get_rank(group_name: str = "default") -> int:
+    return getattr(get_group(group_name), "rank", 0)
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return get_group(group_name).world
+
+
+def _dispatch(name: str, group_name: str, *args, **kw):
+    g = get_group(group_name)
+    if (
+        getattr(g, "expects_per_rank_tensors", False)
+        and args
+        and args[0] is not None
+        and not isinstance(args[0], (list, tuple))
+    ):
+        raise TypeError(
+            f"group {group_name!r} uses the single-controller xla_mesh "
+            f"backend: pass a list of {g.world} per-rank tensors, one per "
+            "device (each rank is a local device, not a process)"
+        )
+    fn = getattr(g, name)
+    import inspect
+
+    if inspect.iscoroutinefunction(fn):
+        return _runtime().run(fn(*args, **kw))
+    return fn(*args, **kw)
+
+
+def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM):
+    return _dispatch("allreduce", group_name, tensor, op=ReduceOp(op))
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op=ReduceOp.SUM):
+    return _dispatch("reduce", group_name, tensor, root=dst_rank, op=ReduceOp(op))
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _dispatch("broadcast", group_name, tensor, root=src_rank)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _dispatch("allgather", group_name, tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op=ReduceOp.SUM):
+    return _dispatch("reducescatter", group_name, tensor, op=ReduceOp(op))
+
+
+def barrier(group_name: str = "default"):
+    return _dispatch("barrier", group_name)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", seq: int = 0):
+    return _dispatch("send", group_name, tensor, dst_rank, seq=seq)
+
+
+def recv(src_rank: int, group_name: str = "default", seq: int = 0):
+    return _dispatch("recv", group_name, src_rank, seq=seq)
+
+
+__all__ = [
+    "Backend",
+    "ReduceOp",
+    "init_collective_group",
+    "destroy_collective_group",
+    "is_group_initialized",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "reduce",
+    "broadcast",
+    "allgather",
+    "reducescatter",
+    "barrier",
+    "send",
+    "recv",
+]

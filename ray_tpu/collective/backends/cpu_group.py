@@ -1,0 +1,203 @@
+"""CPU collective backend: host tensors over the runtime RPC.
+
+Fills the role of the reference's gloo backend (reference:
+python/ray/util/collective/collective_group/torch_gloo_collective_group.py)
+as the CPU baseline and test stand-in. Topology is hub-reduce: rank 0
+collects contributions, reduces with numpy, and answers every member's
+in-flight RPC with the result — one round trip per op, fine for control-
+plane-sized tensors (accelerator tensors take the XLA backends).
+
+Rendezvous replaces the reference's NCCLUniqueID named-actor store
+(nccl_collective_group.py:29): members publish rank→addr in the head KV
+and poll until the group is complete.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+import numpy as np
+
+from ray_tpu._private import rpc
+from ray_tpu._private.serialization import deserialize, serialize
+from ray_tpu.collective.types import ReduceOp
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
+    ReduceOp.PRODUCT: lambda xs: np.prod(xs, axis=0),
+    ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
+    ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
+}
+
+
+class _Pending:
+    __slots__ = ("contrib", "futures", "arrived")
+
+    def __init__(self, world: int):
+        self.contrib: list = [None] * world
+        self.futures: list = []
+        self.arrived = 0
+
+
+def _pack(value) -> tuple[bytes, list[bytes]]:
+    s = serialize(value).materialize_buffers()
+    return s.inband, s.buffers
+
+
+def _unpack(packed: tuple) -> Any:
+    return deserialize(packed[0], packed[1])
+
+
+class CpuGroup:
+    def __init__(self, core, group_name: str, world_size: int, rank: int):
+        self.core = core  # CoreWorker (for RPC + head KV)
+        self.name = group_name
+        self.world = world_size
+        self.rank = rank
+        self.root_addr: str | None = None
+        self._seq = 0
+        self._pending: dict[tuple, _Pending] = {}  # (op_kind, seq) → state
+        # (src, seq) → (deque[payload], deque[waiter futures])
+        self._mailbox: dict[tuple, tuple] = {}
+        if rank == 0:
+            self.core.ext_handlers[f"col_op:{self.name}"] = self._on_op
+        self.core.ext_handlers[f"col_sendrecv:{self.name}"] = self._on_sendrecv
+
+    # --------------------------------------------------------- bootstrap
+    async def init(self):
+        key = f"collective:{self.name}:{self.rank}"
+        await self.core.head.call("kv_put", key=key, value=self.core.addr.encode())
+        root_key = f"collective:{self.name}:0"
+        while True:
+            reply = await self.core.head.call("kv_get", key=root_key)
+            if reply["ok"]:
+                self.root_addr = reply["value"].decode()
+                break
+            await asyncio.sleep(0.05)
+
+    async def destroy(self):
+        self.core.ext_handlers.pop(f"col_op:{self.name}", None)
+        self.core.ext_handlers.pop(f"col_sendrecv:{self.name}", None)
+        if self.rank == 0:
+            for r in range(self.world):
+                await self.core.head.call(
+                    "kv_del", key=f"collective:{self.name}:{r}"
+                )
+
+    # -------------------------------------------------------- hub (rank0)
+    async def _on_op(
+        self, conn, kind: str, seq: int, rank: int, payload: tuple, meta: dict
+    ):
+        key = (kind, seq)
+        st = self._pending.get(key)
+        if st is None:
+            st = self._pending[key] = _Pending(self.world)
+        st.contrib[rank] = _unpack(payload)
+        st.arrived += 1
+        fut = asyncio.get_running_loop().create_future()
+        st.futures.append((rank, fut))
+        if st.arrived == self.world:
+            self._complete(key, st, kind, meta)
+        return await fut
+
+    def _complete(self, key, st: _Pending, kind: str, meta: dict):
+        del self._pending[key]
+        op = ReduceOp(meta.get("op", "sum"))
+        if kind == "allreduce" or kind == "reduce":
+            result = _REDUCERS[op](np.stack(st.contrib))
+        elif kind == "allgather":
+            result = list(st.contrib)
+        elif kind == "reducescatter":
+            red = _REDUCERS[op](np.stack(st.contrib))
+            result = np.array_split(red, self.world, axis=0)
+        elif kind == "broadcast":
+            result = st.contrib[meta.get("root", 0)]
+        elif kind == "barrier":
+            result = None
+        else:
+            raise rpc.RpcError(f"unknown collective {kind}")
+        for rank, fut in st.futures:
+            if fut.done():
+                continue
+            if kind == "reducescatter":
+                fut.set_result(_pack(result[rank]))
+            elif kind == "reduce" and rank != meta.get("root", 0):
+                fut.set_result(_pack(None))
+            else:
+                fut.set_result(_pack(result))
+
+    # ----------------------------------------------------------- verbs
+    async def _op(self, kind: str, tensor: Any, **meta):
+        self._seq += 1
+        conn = await self.core._connect(self.root_addr)
+        reply = await conn.call(
+            f"col_op:{self.name}",
+            kind=kind,
+            seq=self._seq,
+            rank=self.rank,
+            payload=_pack(tensor),
+            meta=meta,
+        )
+        return _unpack(reply)
+
+    async def allreduce(self, tensor, op=ReduceOp.SUM):
+        return await self._op("allreduce", np.asarray(tensor), op=op.value)
+
+    async def reduce(self, tensor, root=0, op=ReduceOp.SUM):
+        return await self._op("reduce", np.asarray(tensor), root=root, op=op.value)
+
+    async def broadcast(self, tensor, root=0):
+        return await self._op("broadcast", np.asarray(tensor), root=root)
+
+    async def allgather(self, tensor):
+        return await self._op("allgather", np.asarray(tensor))
+
+    async def reducescatter(self, tensor, op=ReduceOp.SUM):
+        return await self._op("reducescatter", np.asarray(tensor), op=op.value)
+
+    async def barrier(self):
+        await self._op("barrier", None)
+
+    # ------------------------------------------------------- send / recv
+    # Mailbox is a queue per (src, seq) so back-to-back sends with the
+    # same tag enqueue rather than clobbering an already-resolved future.
+    def _mail_queues(self, key):
+        q = self._mailbox.get(key)
+        if q is None:
+            from collections import deque
+
+            q = self._mailbox[key] = (deque(), deque())  # payloads, waiters
+        return q
+
+    async def _on_sendrecv(self, conn, src_rank: int, seq: int, payload: tuple):
+        payloads, waiters = self._mail_queues((src_rank, seq))
+        while waiters:
+            fut = waiters.popleft()
+            if not fut.done():
+                fut.set_result(payload)
+                return {"ok": True}
+        payloads.append(payload)
+        return {"ok": True}
+
+    async def send(self, tensor, dst_rank: int, seq: int = 0):
+        reply = await self.core.head.call(
+            "kv_get", key=f"collective:{self.name}:{dst_rank}"
+        )
+        if not reply["ok"]:
+            raise rpc.RpcError(f"rank {dst_rank} not in group {self.name}")
+        conn = await self.core._connect(reply["value"].decode())
+        await conn.call(
+            f"col_sendrecv:{self.name}",
+            src_rank=self.rank,
+            seq=seq,
+            payload=_pack(np.asarray(tensor)),
+        )
+
+    async def recv(self, src_rank: int, seq: int = 0):
+        payloads, waiters = self._mail_queues((src_rank, seq))
+        if payloads:
+            return _unpack(payloads.popleft())
+        fut = asyncio.get_running_loop().create_future()
+        waiters.append(fut)
+        return _unpack(await fut)

@@ -1,0 +1,320 @@
+"""XLA collective backends: compiled ICI/DCN collectives behind eager verbs.
+
+Replaces the reference's NCCL backend (reference:
+python/ray/util/collective/collective_group/nccl_collective_group.py).
+On TPU there is no user-level NCCL-like library: collectives are XLA ops
+compiled into programs and scheduled on the ICI. The eager verbs here are
+therefore *cached compiled programs* — one jit per (op, shape, dtype,
+group) with donated inputs — which is the TPU-native answer to
+"allreduce(tensor) must be fast" (SURVEY.md section 5, comm-backend row).
+
+Two flavors:
+  XlaMeshGroup — the group is a set of devices visible to this process
+      ("ranks" = devices, SPMD single-controller).
+  bootstrap_distributed — multi-host: ranks are processes; coordinator
+      rendezvous via the head KV replaces the NCCLUniqueID named-actor
+      store; after jax.distributed.initialize the same compiled-verb
+      machinery works over ICI + DCN.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from functools import partial
+from typing import Any, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.collective.types import ReduceOp
+
+_PSUM_OPS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+}
+
+
+class XlaMeshGroup:
+    """Eager collectives over the devices visible to this process.
+
+    Single-controller semantics: every verb takes a *sequence* of
+    world_size per-rank tensors (rank = device) and returns the per-rank
+    results."""
+
+    expects_per_rank_tensors = True
+
+    def __init__(self, devices: Sequence[jax.Device] | None = None):
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.world = len(self.devices)
+        self.mesh = Mesh(np.array(self.devices), ("ranks",))
+        self._programs: dict[tuple, Any] = {}
+
+    # ------------------------------------------------------------ plumbing
+    def _stack(self, tensors: Sequence[Any]) -> jax.Array:
+        """Per-rank tensors → one global array sharded on 'ranks'."""
+        if len(tensors) != self.world:
+            raise ValueError(
+                f"expected {self.world} per-rank tensors, got {len(tensors)}"
+            )
+        sharding = NamedSharding(self.mesh, P("ranks"))
+        arrs = [jnp.asarray(t)[None] for t in tensors]
+        return jax.make_array_from_single_device_arrays(
+            (self.world, *arrs[0].shape[1:]),
+            sharding,
+            [jax.device_put(a, d) for a, d in zip(arrs, self.devices)],
+        )
+
+    def _unstack(self, stacked: jax.Array) -> list[jax.Array]:
+        return [s.data[0] for s in stacked.addressable_shards]
+
+    def _program(self, key: tuple, build):
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = self._programs[key] = build()
+        return prog
+
+    def _shmap(self, fn, donate=True):
+        mapped = jax.shard_map(
+            fn, mesh=self.mesh, in_specs=P("ranks"), out_specs=P("ranks")
+        )
+        return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+    # ------------------------------------------------------------- verbs
+    def allreduce(self, tensors: Sequence[Any], op=ReduceOp.SUM) -> list:
+        x = self._stack(tensors)
+        key = ("allreduce", x.shape, str(x.dtype), op)
+        if op is ReduceOp.PRODUCT:
+            # No pprod primitive: exp∘psum∘log is wrong for negatives, so
+            # run an all_gather and reduce locally.
+            prog = self._program(
+                key,
+                lambda: self._shmap(
+                    lambda s: jnp.prod(
+                        jax.lax.all_gather(s, "ranks", axis=0), axis=(0, 1)
+                    )[None]
+                ),
+            )
+        else:
+            psum = _PSUM_OPS[op]
+            prog = self._program(
+                key, lambda: self._shmap(lambda s: psum(s, "ranks"))
+            )
+        return self._unstack(prog(x))
+
+    def broadcast(self, tensors: Sequence[Any], root: int = 0) -> list:
+        src = jnp.asarray(tensors[root])
+        return [jax.device_put(src, d) for d in self.devices]
+
+    def allgather(self, tensors: Sequence[Any]) -> list:
+        x = self._stack(tensors)
+        key = ("allgather", x.shape, str(x.dtype))
+        prog = self._program(
+            key,
+            lambda: self._shmap(
+                # s is [1, ...] (this rank's slice); gather the unstacked
+                # tensors tiled along their first data axis.
+                lambda s: jax.lax.all_gather(s[0], "ranks", axis=0, tiled=True)[
+                    None
+                ],
+                donate=False,
+            ),
+        )
+        return self._unstack(prog(x))
+
+    def reducescatter(self, tensors: Sequence[Any], op=ReduceOp.SUM) -> list:
+        x = self._stack(tensors)
+        if x.shape[1] % self.world:
+            raise ValueError(
+                f"reducescatter dim0 {x.shape[1]} not divisible by world "
+                f"{self.world}"
+            )
+        key = ("reducescatter", x.shape, str(x.dtype), op)
+        if op is ReduceOp.SUM:
+            psum_scatter = partial(jax.lax.psum_scatter, axis_name="ranks")
+            prog = self._program(
+                key,
+                lambda: self._shmap(
+                    lambda s: psum_scatter(
+                        s[0], scatter_dimension=0, tiled=True
+                    )[None]
+                ),
+            )
+            return self._unstack(prog(x))
+        # Non-sum reductions: reduce via the matching allreduce, then each
+        # rank keeps its slice (no fused primitive for max/min/product).
+        reduced = self.allreduce(tensors, op=op)
+        chunk = reduced[0].shape[0] // self.world
+        return [
+            r[i * chunk : (i + 1) * chunk] for i, r in enumerate(reduced)
+        ]
+
+    def permute(self, tensors: Sequence[Any], perm: list[tuple[int, int]]):
+        """collective_permute: the P2P primitive TPU channels are built on
+        (replaces NCCL send/recv, reference: nccl_group.py)."""
+        x = self._stack(tensors)
+        key = ("permute", x.shape, str(x.dtype), tuple(perm))
+        prog = self._program(
+            key,
+            lambda: self._shmap(
+                lambda s: jax.lax.ppermute(s, "ranks", perm=perm)
+            ),
+        )
+        return self._unstack(prog(x))
+
+    def reduce(self, tensors: Sequence[Any], root: int = 0, op=ReduceOp.SUM):
+        """Single-controller semantics: returns the reduced tensor (the
+        'root' distinction is process-level and meaningless in-process)."""
+        del root
+        return self.allreduce(tensors, op=op)
+
+    def send(self, *a, **kw):
+        raise NotImplementedError(
+            "xla_mesh is single-controller: point-to-point movement is "
+            "`permute` (collective_permute over ICI), not send/recv"
+        )
+
+    recv = send
+
+    def barrier(self):
+        ones = [jnp.zeros((), jnp.int32) for _ in range(self.world)]
+        self.allreduce(ones)
+
+
+class XlaDistGroup:
+    """Multi-host eager collectives: rank = process, data over ICI + DCN.
+
+    Standard multi-host JAX pattern: every process calls the same verb
+    with *its own* tensor; the global array is assembled from addressable
+    shards only (jax.make_array_from_single_device_arrays), and the
+    compiled psum runs SPMD across all hosts. Requires
+    jax.distributed.initialize first (see bootstrap_distributed).
+    Untestable on this single-host rig; exercised by multi-host deploys.
+    """
+
+    expects_per_rank_tensors = False
+
+    def __init__(self, world_size: int, rank: int):
+        self.world = world_size
+        self.rank = rank
+        by_proc: dict[int, jax.Device] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        if len(by_proc) != world_size:
+            raise ValueError(
+                f"jax.distributed reports {len(by_proc)} processes, "
+                f"expected {world_size}"
+            )
+        self.devices = [by_proc[p] for p in sorted(by_proc)]
+        self.my_device = by_proc[jax.process_index()]
+        self.mesh = Mesh(np.array(self.devices), ("ranks",))
+        self._programs: dict[tuple, Any] = {}
+
+    def _global(self, tensor) -> jax.Array:
+        local = jax.device_put(jnp.asarray(tensor)[None], self.my_device)
+        sharding = NamedSharding(self.mesh, P("ranks"))
+        return jax.make_array_from_single_device_arrays(
+            (self.world, *local.shape[1:]), sharding, [local]
+        )
+
+    def _local(self, arr: jax.Array):
+        return arr.addressable_shards[0].data[0]
+
+    def _run(self, key, fn, x):
+        prog = self._programs.get(key)
+        if prog is None:
+            mapped = jax.shard_map(
+                fn, mesh=self.mesh, in_specs=P("ranks"), out_specs=P("ranks")
+            )
+            prog = self._programs[key] = jax.jit(mapped)
+        return prog(x)
+
+    def allreduce(self, tensor, op=ReduceOp.SUM):
+        x = self._global(tensor)
+        psum = _PSUM_OPS[op]
+        out = self._run(
+            ("allreduce", x.shape, str(x.dtype), op),
+            lambda s: psum(s, "ranks"),
+            x,
+        )
+        return self._local(out)
+
+    def allgather(self, tensor):
+        x = self._global(tensor)
+        out = self._run(
+            ("allgather", x.shape, str(x.dtype)),
+            lambda s: jax.lax.all_gather(s[0], "ranks", axis=0, tiled=True)[
+                None
+            ],
+            x,
+        )
+        return self._local(out)
+
+    def broadcast(self, tensor, root: int = 0):
+        gathered = self.allgather(jnp.asarray(tensor)[None])
+        return gathered[root]
+
+    def reducescatter(self, tensor, op=ReduceOp.SUM):
+        x = self._global(tensor)
+        if op is ReduceOp.SUM:
+            out = self._run(
+                ("reducescatter", x.shape, str(x.dtype), op),
+                lambda s: jax.lax.psum_scatter(
+                    s[0], "ranks", scatter_dimension=0, tiled=True
+                )[None],
+                x,
+            )
+            return self._local(out)
+        full = self.allreduce(tensor, op=op)
+        chunk = full.shape[0] // self.world
+        return full[self.rank * chunk : (self.rank + 1) * chunk]
+
+    def barrier(self):
+        self.allreduce(jnp.zeros((), jnp.int32))
+
+
+async def bootstrap_distributed(
+    core,
+    group_name: str,
+    world_size: int,
+    rank: int,
+    local_device_ids: Sequence[int] | None = None,
+):
+    """Multi-host jax.distributed bootstrap with head-KV rendezvous.
+
+    Rank 0 publishes a coordinator address in the cluster KV; every rank
+    then calls jax.distributed.initialize. This replaces the reference's
+    NCCLUniqueID rendezvous actor (nccl_collective_group.py:29-56) with
+    the jax coordination service.
+    """
+    import socket
+
+    key = f"jaxdist:{group_name}:coordinator"
+    if rank == 0:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        host = socket.gethostbyname(socket.gethostname())
+        coord = f"{host}:{port}"
+        await core.head.call("kv_put", key=key, value=coord.encode())
+    else:
+        while True:
+            reply = await core.head.call("kv_get", key=key)
+            if reply["ok"]:
+                coord = reply["value"].decode()
+                break
+            await asyncio.sleep(0.05)
+
+    def _init():
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=world_size,
+            process_id=rank,
+            local_device_ids=local_device_ids,
+        )
+
+    await asyncio.get_running_loop().run_in_executor(None, _init)
+    return coord

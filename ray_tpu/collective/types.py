@@ -1,0 +1,32 @@
+"""Collective types (reference: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Backend(str, enum.Enum):
+    """Which data plane carries the collective.
+
+    XLA_MESH  — devices visible to this process; ops compile to XLA
+                collectives over ICI (psum / all_gather / ppermute).
+    XLA_DIST  — multi-host jax.distributed; same compiled ops over ICI+DCN.
+    CPU       — host-memory tensors over the runtime RPC (the reference's
+                gloo role, torch_gloo_collective_group.py).
+    AUTO      — XLA_MESH if >1 accelerator device is visible, else CPU.
+    """
+
+    XLA_MESH = "xla_mesh"
+    XLA_DIST = "xla_dist"
+    CPU = "cpu"
+    AUTO = "auto"
+
+
+class ReduceOp(str, enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+UNSET_RANK = -1

@@ -41,6 +41,9 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # "none" | "full": remat policy for the scanned layer body.
     remat: str = "full"
+    # "dense" | "ring" | "ulysses": attention strategy. ring/ulysses need a
+    # mesh with sp>1 (built by ray_tpu.train.step.jit_train_step).
+    attn_impl: str = "dense"
 
     @property
     def head_dim(self) -> int:

@@ -89,6 +89,10 @@ class CoreWorker:
         self._put_index = 0
         self._root_task = TaskID.random()
 
+        # Extension RPC handlers (collective groups, channels, ...):
+        # name → async fn(conn=..., **kw). Checked before built-ins.
+        self.ext_handlers: dict[str, Any] = {}
+
     # ----------------------------------------------------------- startup
     async def start(self, host: str = "127.0.0.1") -> str:
         port = await self.server.start(host, 0)
@@ -496,6 +500,9 @@ class CoreWorker:
 
     # ------------------------------------------------- worker-side serve
     async def _handle(self, method: str, kw: dict, conn: rpc.Connection):
+        ext = self.ext_handlers.get(method)
+        if ext is not None:
+            return await ext(conn=conn, **kw)
         fn = getattr(self, f"_on_{method}", None)
         if fn is None:
             raise rpc.RpcError(f"core_worker: unknown method {method!r}")

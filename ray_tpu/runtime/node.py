@@ -116,8 +116,16 @@ class NodeManager:
     # ------------------------------------------------------------ workers
     def _spawn_worker(self) -> str:
         worker_id = WorkerID.random().hex()
+        # Workers must find the ray_tpu package regardless of their cwd.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+        pypath = os.environ.get("PYTHONPATH", "")
+        if pkg_root not in pypath.split(os.pathsep):
+            pypath = f"{pkg_root}{os.pathsep}{pypath}" if pypath else pkg_root
         env = {
             **os.environ,
+            "PYTHONPATH": pypath,
             **self.worker_env,
             "RAY_TPU_HEAD_ADDR": self.head_addr,
             "RAY_TPU_NODE_ADDR": self.addr or "",

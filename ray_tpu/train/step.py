@@ -95,12 +95,15 @@ def state_logical_axes(
 
 
 def loss_fn(
-    params: Any, batch: dict[str, jnp.ndarray], cfg: LlamaConfig
+    params: Any,
+    batch: dict[str, jnp.ndarray],
+    cfg: LlamaConfig,
+    attn_fn=None,
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
     """Next-token cross entropy. batch["tokens"]: [B, S+1] int32."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg)
+    logits = forward(params, inputs, cfg, attn_fn=attn_fn)
     logz = jax.nn.logsumexp(logits, axis=-1)
     tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - tgt_logit
@@ -108,12 +111,16 @@ def loss_fn(
     return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
 
 
-def make_train_step(cfg: LlamaConfig, optimizer: optax.GradientTransformation):
+def make_train_step(
+    cfg: LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    attn_fn=None,
+):
     """Returns train_step(state, batch) -> (state, metrics), ready to jit."""
 
     def train_step(state: TrainState, batch: dict[str, jnp.ndarray]):
         grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
-        (_, metrics), grads = grad_fn(state.params, batch, cfg)
+        (_, metrics), grads = grad_fn(state.params, batch, cfg, attn_fn)
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )
@@ -139,7 +146,19 @@ def jit_train_step(
     axes = state_logical_axes(cfg, optimizer)
     state_sh = tree_shardings(mesh, axes)
     batch_sh = {"tokens": tree_shardings(mesh, batch_axes)}
-    step = make_train_step(cfg, optimizer)
+
+    attn_fn = None
+    if cfg.attn_impl == "ring":
+        from ray_tpu.parallel.ring_attention import make_ring_attention
+
+        attn_fn = make_ring_attention(mesh)
+    elif cfg.attn_impl == "ulysses":
+        from ray_tpu.parallel.ulysses import make_ulysses_attention
+
+        attn_fn = make_ulysses_attention(mesh)
+    elif cfg.attn_impl != "dense":
+        raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
+    step = make_train_step(cfg, optimizer, attn_fn=attn_fn)
 
     def step_in_mesh(state, batch):
         with use_mesh(mesh):

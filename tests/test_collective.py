@@ -1,0 +1,208 @@
+"""Collective layer tests: XLA-mesh backend on the virtual 8-device CPU
+mesh, and the CPU backend across real actor processes (the reference tests
+NCCL with mocked communicators + gloo on CPU; SURVEY.md section 4)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective.backends.xla_group import XlaMeshGroup
+from ray_tpu.collective.types import ReduceOp
+
+
+@pytest.fixture(scope="module")
+def xg():
+    return XlaMeshGroup()
+
+
+def _ranks_data(world, shape=(8, 4)):
+    rng = np.random.default_rng(0)
+    return [rng.normal(size=shape).astype(np.float32) for _ in range(world)]
+
+
+def test_xla_allreduce_sum(xg):
+    xs = _ranks_data(xg.world)
+    out = xg.allreduce(xs)
+    expect = np.sum(xs, axis=0)
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-5)
+
+
+def test_xla_allreduce_max_and_product(xg):
+    xs = _ranks_data(xg.world, shape=(4,))
+    for op, ref in [(ReduceOp.MAX, np.max), (ReduceOp.PRODUCT, np.prod)]:
+        out = xg.allreduce(xs, op=op)
+        np.testing.assert_allclose(
+            np.asarray(out[0]), ref(np.stack(xs), axis=0), rtol=1e-5
+        )
+
+
+def test_xla_allgather(xg):
+    xs = [np.full((2,), i, np.float32) for i in range(xg.world)]
+    out = xg.allgather(xs)
+    expect = np.concatenate(xs)
+    for o in out:
+        np.testing.assert_array_equal(np.asarray(o), expect)
+
+
+def test_xla_reducescatter(xg):
+    xs = _ranks_data(xg.world, shape=(xg.world * 2, 3))
+    out = xg.reducescatter(xs)
+    full = np.sum(xs, axis=0)
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(
+            np.asarray(o), full[i * 2 : (i + 1) * 2], rtol=1e-5
+        )
+
+
+def test_xla_reducescatter_max(xg):
+    """Non-sum reducescatter must honor the op (was silently SUM)."""
+    xs = _ranks_data(xg.world, shape=(xg.world * 2, 3))
+    out = xg.reducescatter(xs, op=ReduceOp.MAX)
+    full = np.max(np.stack(xs), axis=0)
+    for i, o in enumerate(out):
+        np.testing.assert_allclose(
+            np.asarray(o), full[i * 2 : (i + 1) * 2], rtol=1e-5
+        )
+
+
+def test_xla_single_tensor_rejected(xg):
+    import ray_tpu.collective as col
+
+    col._groups["xm-test"] = xg
+    try:
+        with pytest.raises(TypeError, match="per-rank tensors"):
+            col.allreduce(np.ones((4,), np.float32), group_name="xm-test")
+    finally:
+        del col._groups["xm-test"]
+
+
+def test_xla_permute_ring(xg):
+    xs = [np.full((2,), i, np.float32) for i in range(xg.world)]
+    perm = [(i, (i + 1) % xg.world) for i in range(xg.world)]
+    out = xg.permute(xs, perm)
+    for i in range(xg.world):
+        np.testing.assert_array_equal(
+            np.asarray(out[(i + 1) % xg.world]), xs[i]
+        )
+
+
+# ---------------------------------------------------------------- actors
+@pytest.fixture(scope="module")
+def cluster():
+    # Actors hold their worker lease for life, so give the module's tests
+    # enough CPU slots for all actors across tests (3 + 2).
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_cpu_backend_across_actors(cluster):
+    @ray_tpu.remote
+    class Member:
+        def setup(self, world, rank, group):
+            import ray_tpu.collective as col
+
+            col.init_collective_group(
+                world, rank, backend="cpu", group_name=group
+            )
+            return rank
+
+        def do_allreduce(self, value):
+            import numpy as np
+
+            import ray_tpu.collective as col
+
+            out = col.allreduce(
+                np.full((4,), value, np.float32), group_name="g1"
+            )
+            return np.asarray(out)
+
+        def do_big_allreduce(self, value):
+            """>4KB tensors take the out-of-band buffer path."""
+            import numpy as np
+
+            import ray_tpu.collective as col
+
+            out = col.allreduce(
+                np.full((64, 64), value, np.float32), group_name="g1"
+            )
+            return np.asarray(out)
+
+        def do_broadcast(self, value, root):
+            import numpy as np
+
+            import ray_tpu.collective as col
+
+            return np.asarray(
+                col.broadcast(
+                    np.full((2,), value, np.float32),
+                    src_rank=root,
+                    group_name="g1",
+                )
+            )
+
+    world = 3
+    members = [Member.remote() for _ in range(world)]
+    ray_tpu.get(
+        [m.setup.remote(world, i, "g1") for i, m in enumerate(members)]
+    )
+
+    outs = ray_tpu.get(
+        [m.do_allreduce.remote(float(i + 1)) for i, m in enumerate(members)]
+    )
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((4,), 6.0))
+
+    outs = ray_tpu.get(
+        [m.do_broadcast.remote(float(i), 2) for i, m in enumerate(members)]
+    )
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((2,), 2.0))
+
+    outs = ray_tpu.get(
+        [
+            m.do_big_allreduce.remote(float(i + 1))
+            for i, m in enumerate(members)
+        ]
+    )
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((64, 64), 6.0))
+
+
+def test_cpu_send_recv(cluster):
+    @ray_tpu.remote
+    class P2P:
+        def setup(self, world, rank):
+            import ray_tpu.collective as col
+
+            col.init_collective_group(
+                world, rank, backend="cpu", group_name="p2p"
+            )
+
+        def sender(self):
+            import numpy as np
+
+            import ray_tpu.collective as col
+
+            # Two back-to-back sends with the same tag must both queue.
+            col.send(np.arange(5, dtype=np.int64), 1, group_name="p2p")
+            col.send(np.arange(5, dtype=np.int64) * 10, 1, group_name="p2p")
+            return True
+
+        def receiver(self):
+            import numpy as np
+
+            import ray_tpu.collective as col
+
+            first = np.asarray(col.recv(0, group_name="p2p"))
+            second = np.asarray(col.recv(0, group_name="p2p"))
+            return first, second
+
+    a, b = P2P.remote(), P2P.remote()
+    ray_tpu.get([a.setup.remote(2, 0), b.setup.remote(2, 1)])
+    recv_ref = b.receiver.remote()
+    ray_tpu.get(a.sender.remote())
+    first, second = ray_tpu.get(recv_ref)
+    np.testing.assert_array_equal(first, np.arange(5, dtype=np.int64))
+    np.testing.assert_array_equal(second, np.arange(5, dtype=np.int64) * 10)

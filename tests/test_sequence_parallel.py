@@ -1,0 +1,105 @@
+"""Ring attention + Ulysses SP vs dense attention on the virtual mesh."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import PRESETS, forward, init_params, param_logical_axes
+from ray_tpu.ops.attention import causal_attention
+from ray_tpu.parallel import make_mesh
+from ray_tpu.parallel.ring_attention import make_ring_attention
+from ray_tpu.parallel.sharding import shard_pytree, tree_shardings
+from ray_tpu.parallel.ulysses import make_ulysses_attention
+from ray_tpu.train.step import (
+    init_train_state,
+    jit_train_step,
+    make_optimizer,
+    state_logical_axes,
+)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return make_mesh({"sp": 4, "tp": 2})
+
+
+def _qkv(key, b=2, s=32, h=4, hkv=2, d=16):
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, hkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, hkv, d), jnp.float32)
+    return q, k, v
+
+
+def test_ring_matches_dense(sp_mesh):
+    q, k, v = _qkv(jax.random.key(0))
+    ref = causal_attention(q, k, v)
+    ring = make_ring_attention(sp_mesh)
+    out = jax.jit(ring)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ulysses_matches_dense(sp_mesh):
+    # ulysses needs per-tp-shard heads divisible by sp: h=8, tp=2 → local
+    # heads 4, sp=4.
+    q, k, v = _qkv(jax.random.key(1), h=8, hkv=8)
+    ref = causal_attention(q, k, v)
+    uly = make_ulysses_attention(sp_mesh)
+    out = jax.jit(uly)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_grads(sp_mesh):
+    """Ring attention must be differentiable and match dense grads."""
+    q, k, v = _qkv(jax.random.key(2))
+    ring = make_ring_attention(sp_mesh)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gd, gr):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4)
+
+
+def test_train_step_with_ring_attention(sp_mesh):
+    cfg = dataclasses.replace(PRESETS["tiny"], attn_impl="ring")
+    opt = make_optimizer(total_steps=10)
+    step = jit_train_step(cfg, opt, sp_mesh)
+    state = init_train_state(jax.random.key(0), cfg, opt)
+    state = jax.device_put(
+        state, tree_shardings(sp_mesh, state_logical_axes(cfg, opt))
+    )
+    tokens = jax.random.randint(jax.random.key(1), (2, 65), 0, cfg.vocab_size)
+    batch = {
+        "tokens": jax.device_put(
+            tokens, tree_shardings(sp_mesh, ("batch", None))
+        )
+    }
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+    # Same step with dense attention on a dp-only mesh must agree.
+    cfg_d = dataclasses.replace(cfg, attn_impl="dense")
+    mesh_d = make_mesh({"dp": 2, "tp": 4})
+    step_d = jit_train_step(cfg_d, opt, mesh_d)
+    state_d = init_train_state(jax.random.key(0), cfg_d, opt)
+    state_d = jax.device_put(
+        state_d, tree_shardings(mesh_d, state_logical_axes(cfg_d, opt))
+    )
+    batch_d = {
+        "tokens": jax.device_put(
+            tokens, tree_shardings(mesh_d, ("batch", None))
+        )
+    }
+    _, metrics_d = step_d(state_d, batch_d)
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(metrics_d["loss"]), rtol=1e-4
+    )

@@ -224,23 +224,42 @@ def cluster_resources() -> dict:
 
 
 # ------------------------------------------------------------- @remote
+def _placement_tuple(pg, bundle_index: int):
+    if pg is None:
+        return None
+    return (pg.bundle_node_addr(bundle_index), pg.id, bundle_index)
+
+
 class RemoteFunction:
-    def __init__(self, fn, *, num_returns=1, resources=None, max_retries=3):
+    def __init__(
+        self,
+        fn,
+        *,
+        num_returns=1,
+        resources=None,
+        max_retries=3,
+        placement_group=None,
+        placement_group_bundle_index=0,
+    ):
         self._fn = fn
         self._num_returns = num_returns
         self._resources = resources
         self._max_retries = max_retries
+        self._pg = placement_group
+        self._pg_bundle = placement_group_bundle_index
         functools.update_wrapper(self, fn)
 
-    def options(self, *, num_returns=None, resources=None, max_retries=None):
-        return RemoteFunction(
-            self._fn,
-            num_returns=num_returns or self._num_returns,
-            resources=resources or self._resources,
-            max_retries=(
-                max_retries if max_retries is not None else self._max_retries
-            ),
-        )
+    def options(self, **opts):
+        opts = _normalize_options(opts)
+        merged = {
+            "num_returns": self._num_returns,
+            "resources": self._resources,
+            "max_retries": self._max_retries,
+            "placement_group": self._pg,
+            "placement_group_bundle_index": self._pg_bundle,
+        }
+        merged.update(opts)
+        return RemoteFunction(self._fn, **merged)
 
     def remote(self, *args, **kwargs):
         refs = _runtime.run(
@@ -251,6 +270,7 @@ class RemoteFunction:
                 num_returns=self._num_returns,
                 resources=self._resources,
                 max_retries=self._max_retries,
+                placement=_placement_tuple(self._pg, self._pg_bundle),
             )
         )
         return refs[0] if self._num_returns == 1 else refs
@@ -304,19 +324,34 @@ class ActorHandle:
 
 
 class ActorClass:
-    def __init__(self, cls, *, resources=None, name=None, detached=False):
+    def __init__(
+        self,
+        cls,
+        *,
+        resources=None,
+        name=None,
+        detached=False,
+        placement_group=None,
+        placement_group_bundle_index=0,
+    ):
         self._cls = cls
         self._resources = resources
         self._name = name
         self._detached = detached
+        self._pg = placement_group
+        self._pg_bundle = placement_group_bundle_index
 
-    def options(self, *, name=None, resources=None, lifetime=None):
-        return ActorClass(
-            self._cls,
-            resources=resources or self._resources,
-            name=name or self._name,
-            detached=(lifetime == "detached") or self._detached,
-        )
+    def options(self, *, lifetime=None, **opts):
+        opts = _normalize_options(opts)
+        merged = {
+            "resources": self._resources,
+            "name": self._name,
+            "detached": (lifetime == "detached") or self._detached,
+            "placement_group": self._pg,
+            "placement_group_bundle_index": self._pg_bundle,
+        }
+        merged.update(opts)
+        return ActorClass(self._cls, **merged)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         actor_id, addr = _runtime.run(
@@ -327,6 +362,7 @@ class ActorClass:
                 name=self._name,
                 resources=self._resources,
                 detached=self._detached,
+                placement=_placement_tuple(self._pg, self._pg_bundle),
             )
         )
         return ActorHandle(actor_id, addr, self._cls.__name__)

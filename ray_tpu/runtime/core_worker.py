@@ -320,6 +320,7 @@ class CoreWorker:
         resources: dict | None = None,
         max_retries: int = DEFAULT_RETRIES,
         actor: "ActorSubmitTarget | None" = None,
+        placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
     ) -> list:
         """Submit; returns ObjectRefs immediately, result delivery is
         async (the reply fulfils the local futures)."""
@@ -343,26 +344,28 @@ class CoreWorker:
             "owner_addr": self.addr,
         }
         asyncio.ensure_future(
-            self._drive_task(spec, oids, resources, max_retries, actor)
+            self._drive_task(spec, oids, resources, max_retries, actor, placement)
         )
         return [ObjectRef(o, self.addr) for o in oids]
 
-    async def _drive_task(self, spec, oids, resources, retries, actor):
+    async def _drive_task(self, spec, oids, resources, retries, actor, placement):
         try:
             if actor is not None:
                 await self._drive_actor_task(spec, oids, actor)
             else:
-                await self._drive_normal_task(spec, oids, resources, retries)
+                await self._drive_normal_task(
+                    spec, oids, resources, retries, placement
+                )
         except Exception as e:  # noqa: BLE001 - becomes the task's result
             for oid_hex in oids:
                 self._store_result(oid_hex, ("error", e))
 
-    async def _drive_normal_task(self, spec, oids, resources, retries):
+    async def _drive_normal_task(self, spec, oids, resources, retries, placement=None):
         last_err: Exception | None = None
         for attempt in range(retries + 1):
             lease = None
             try:
-                lease = await self._lease(resources)
+                lease = await self._lease(resources, placement)
                 conn = await self._connect(lease["addr"])
                 reply = await conn.call("push_task", spec=spec)
                 self._apply_reply(reply, oids)
@@ -406,7 +409,27 @@ class CoreWorker:
     def _sched_key(self, resources: dict | None) -> tuple:
         return tuple(sorted((resources or {"CPU": 1.0}).items()))
 
-    async def _lease(self, resources: dict | None) -> dict:
+    async def _lease(
+        self, resources: dict | None, placement: tuple | None = None
+    ) -> dict:
+        if placement is not None:
+            # Bundle-backed lease on the bundle's node; never cached.
+            node_addr, pg_id, index = placement
+            node_conn = (
+                self.node
+                if node_addr is None
+                else await self._connect(node_addr)
+            )
+            reply = await node_conn.call(
+                "lease_worker",
+                resources=dict(resources or {"CPU": 1.0}),
+                bundle=(pg_id, index),
+            )
+            if not reply.get("ok"):
+                raise rpc.RpcError(reply.get("error", "bundle lease failed"))
+            reply["sched_key"] = None
+            reply["node_conn"] = node_conn
+            return reply
         key = self._sched_key(resources)
         cache = self._lease_cache.setdefault(key, [])
         while cache:
@@ -425,6 +448,14 @@ class CoreWorker:
     async def _return_lease(self, lease: dict):
         import time
 
+        if lease.get("sched_key") is None:  # bundle lease: return directly
+            try:
+                await lease["node_conn"].call(
+                    "return_lease", lease_id=lease["lease_id"]
+                )
+            except rpc.RpcError:
+                pass
+            return
         cache = self._lease_cache.setdefault(lease["sched_key"], [])
         if len(cache) < self._lease_cap:
             cache.append((lease, time.monotonic()))
@@ -461,11 +492,29 @@ class CoreWorker:
         name: str | None = None,
         resources: dict | None = None,
         detached: bool = False,
+        placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
     ):
         actor_id = ActorID.random().hex()
-        reply = await self.node.call(
-            "lease_worker", resources=dict(resources or {"CPU": 1.0}), actor=True
-        )
+        if placement is not None:
+            node_addr, pg_id, index = placement
+            node_conn = (
+                self.node
+                if node_addr is None
+                else await self._connect(node_addr)
+            )
+            reply = await node_conn.call(
+                "lease_worker",
+                resources=dict(resources or {"CPU": 1.0}),
+                actor=True,
+                bundle=(pg_id, index),
+            )
+        else:
+            node_conn = self.node
+            reply = await node_conn.call(
+                "lease_worker",
+                resources=dict(resources or {"CPU": 1.0}),
+                actor=True,
+            )
         if not reply.get("ok"):
             raise rpc.RpcError(reply.get("error", "actor lease failed"))
         fn_id = await self.export_function(cls)
@@ -478,7 +527,7 @@ class CoreWorker:
         )
         if create["status"] == "error":
             raise deserialize(create["error"])
-        info = await self.node.call("node_info")
+        info = await node_conn.call("node_info")
         await self.head.call(
             "register_actor",
             actor_id=actor_id,

@@ -32,6 +32,10 @@ class HeadService:
         self.named_actors: dict[str, str] = {}  # name → actor_id hex
         # channel → set[Connection]
         self.subs: dict[str, set[rpc.Connection]] = {}
+        # pg_id → {bundles: [dict], strategy, nodes: [node_id per bundle]}
+        self.placement_groups: dict[str, dict] = {}
+        # head-initiated client conns to each node (for PG prepare/commit)
+        self._node_conns: dict[str, rpc.Connection] = {}
         self._reaper: asyncio.Task | None = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -69,6 +73,10 @@ class HeadService:
             "conn": conn,
         }
         conn.state["node_id"] = node_id
+        old = self._node_conns.pop(node_id, None)
+        if old is not None:
+            await old.close()
+        self._node_conns[node_id] = await rpc.connect(addr)
         self.publish("node", {"event": "added", "node_id": node_id, "addr": addr})
         return {"ok": True}
 
@@ -185,6 +193,132 @@ class HeadService:
         self.publish(channel, msg)
         return {"ok": True}
 
+    # -------------------------------------------------- placement groups
+    async def _on_create_placement_group(
+        self, conn, pg_id: str, bundles: list, strategy: str = "PACK"
+    ):
+        """Gang-reserve resource bundles (reference:
+        GcsPlacementGroupManager gcs_placement_group_manager.h:50 with the
+        2PC prepare/commit scheduler gcs_placement_group_scheduler.h:115;
+        strategies python/ray/util/placement_group.py)."""
+        placed: list[tuple[str, int]] = []  # (node_id, bundle_idx)
+        avail = {
+            nid: dict(n["available"]) for nid, n in self.nodes.items()
+        }
+
+        def fits(nid, bundle):
+            return all(avail[nid].get(k, 0) >= v for k, v in bundle.items())
+
+        def take(nid, bundle):
+            for k, v in bundle.items():
+                avail[nid][k] = avail[nid].get(k, 0) - v
+
+        node_ids = list(self.nodes)
+        if not node_ids:
+            return {"ok": False, "error": "no nodes"}
+
+        def fits_all(nid) -> bool:
+            need: dict[str, float] = {}
+            for b in bundles:
+                for k, v in b.items():
+                    need[k] = need.get(k, 0) + v
+            return all(avail[nid].get(k, 0) >= v for k, v in need.items())
+
+        if strategy == "STRICT_PACK":
+            # All bundles on ONE node: try each node as the sole host.
+            host = next((n for n in node_ids if fits_all(n)), None)
+            if host is None:
+                return {
+                    "ok": False,
+                    "error": "STRICT_PACK: no single node fits all bundles",
+                }
+            for i, bundle in enumerate(bundles):
+                take(host, bundle)
+                placed.append((host, i))
+        else:
+            used: set[str] = set()
+            for i, bundle in enumerate(bundles):
+                if strategy == "PACK":
+                    order = node_ids
+                elif strategy == "STRICT_SPREAD":
+                    # Each bundle on a DISTINCT node, or fail.
+                    order = [n for n in node_ids if n not in used]
+                else:  # SPREAD: best-effort rotation
+                    order = (
+                        node_ids[i % len(node_ids) :]
+                        + node_ids[: i % len(node_ids)]
+                    )
+                chosen = next((n for n in order if fits(n, bundle)), None)
+                if chosen is None:
+                    return {
+                        "ok": False,
+                        "error": f"bundle {i} {bundle} infeasible"
+                        + (
+                            " (STRICT_SPREAD needs a distinct node per bundle)"
+                            if strategy == "STRICT_SPREAD"
+                            else ""
+                        ),
+                    }
+                take(chosen, bundle)
+                used.add(chosen)
+                placed.append((chosen, i))
+
+        # Prepare/commit on the owning nodes.
+        committed = []
+        try:
+            for (nid, i), bundle in zip(placed, bundles):
+                reply = await self._node_conns[nid].call(
+                    "reserve_bundle", pg_id=pg_id, index=i, resources=bundle
+                )
+                if not reply.get("ok"):
+                    raise rpc.RpcError(reply.get("error", "reserve failed"))
+                committed.append((nid, i))
+        except Exception as e:  # noqa: BLE001 - roll back prepared bundles
+            for nid, i in committed:
+                try:
+                    await self._node_conns[nid].call(
+                        "free_bundle", pg_id=pg_id, index=i
+                    )
+                except rpc.RpcError:
+                    pass
+            return {"ok": False, "error": str(e)}
+
+        self.placement_groups[pg_id] = {
+            "bundles": bundles,
+            "strategy": strategy,
+            "nodes": [nid for nid, _ in placed],
+        }
+        return {
+            "ok": True,
+            "nodes": [
+                {"node_id": nid, "addr": self.nodes[nid]["addr"]}
+                for nid, _ in placed
+            ],
+        }
+
+    async def _on_remove_placement_group(self, conn, pg_id: str):
+        pg = self.placement_groups.pop(pg_id, None)
+        if pg is None:
+            return {"ok": False}
+        for i, nid in enumerate(pg["nodes"]):
+            node_conn = self._node_conns.get(nid)
+            if node_conn is not None:
+                try:
+                    await node_conn.call("free_bundle", pg_id=pg_id, index=i)
+                except rpc.RpcError:
+                    pass
+        return {"ok": True}
+
+    async def _on_get_placement_group(self, conn, pg_id: str):
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return {"ok": False}
+        return {
+            "ok": True,
+            **pg,
+            "node_addrs": [self.nodes[n]["addr"] for n in pg["nodes"]],
+        }
+
     # ----------------------------------------------------------- health
     async def _health_loop(self):
         """Mark nodes dead on heartbeat timeout (reference:
@@ -195,6 +329,9 @@ class HeadService:
             for nid, node in list(self.nodes.items()):
                 if now - node["last_seen"] > HEALTH_TIMEOUT_S:
                     del self.nodes[nid]
+                    conn = self._node_conns.pop(nid, None)
+                    if conn is not None:
+                        await conn.close()
                     self.publish(
                         "node", {"event": "removed", "node_id": nid}
                     )

@@ -45,13 +45,15 @@ def detect_resources() -> dict[str, float]:
 
 
 class Lease:
-    __slots__ = ("lease_id", "worker", "resources", "actor")
+    __slots__ = ("lease_id", "worker", "resources", "actor", "bundle", "bundle_resources")
 
     def __init__(self, lease_id: str, worker: dict, resources: dict, actor: bool):
         self.lease_id = lease_id
         self.worker = worker
         self.resources = resources
         self.actor = actor
+        self.bundle: tuple | None = None  # (pg_id, index) if bundle-backed
+        self.bundle_resources: dict | None = None
 
 
 class NodeManager:
@@ -76,6 +78,8 @@ class NodeManager:
         self.idle: list[str] = []
         self.leases: dict[str, Lease] = {}
         self._pending: list[tuple[dict, bool, asyncio.Future]] = []
+        # (pg_id, index) → {"total": resources, "available": resources}
+        self.bundles: dict[tuple, dict] = {}
         self._spawn_waiters: dict[str, asyncio.Future] = {}
         self._next_lease = 0
         self._tasks: list[asyncio.Task] = []
@@ -214,12 +218,43 @@ class NodeManager:
         return {"ok": True, "node_id": self.node_id}
 
     async def _on_lease_worker(
-        self, conn, resources: dict | None = None, actor: bool = False
+        self,
+        conn,
+        resources: dict | None = None,
+        actor: bool = False,
+        bundle: tuple | list | None = None,
     ):
         """Grant a worker lease (reference: NodeManager::
         HandleRequestWorkerLease node_manager.h:290). Infeasible requests
-        fail fast; unavailable ones queue until resources free up."""
+        fail fast; unavailable ones queue until resources free up. With
+        ``bundle`` = (pg_id, index), resources come from that reserved
+        placement-group bundle instead of the node's general pool."""
         resources = dict(resources or {"CPU": 1.0})
+        if bundle is not None:
+            b = self.bundles.get(tuple(bundle))
+            if b is None:
+                return {"ok": False, "error": f"no bundle {bundle} here"}
+            if any(b["available"].get(k, 0) < v for k, v in resources.items()):
+                return {
+                    "ok": False,
+                    "error": f"bundle {bundle} lacks {resources}",
+                }
+            for k, v in resources.items():
+                b["available"][k] -= v
+            # The lease draws on the bundle, not the general pool — spawn
+            # a worker without double-charging node resources. Credit the
+            # bundle back if the grant itself fails (worker spawn error).
+            try:
+                grant = await self._grant_lease({}, actor)
+            except Exception:
+                for k, v in resources.items():
+                    b["available"][k] += v
+                raise
+            lease = self.leases[grant["lease_id"]]
+            lease.bundle = tuple(bundle)
+            lease.bundle_resources = resources
+            grant["bundle"] = tuple(bundle)
+            return grant
         if not self._feasible(resources):
             return {
                 "ok": False,
@@ -232,11 +267,20 @@ class NodeManager:
         self._pending.append((resources, actor, fut))
         return await fut
 
+    def _credit_bundle(self, lease: "Lease"):
+        if lease.bundle is None:
+            return
+        b = self.bundles.get(lease.bundle)
+        if b is not None and lease.bundle_resources:
+            for k, v in lease.bundle_resources.items():
+                b["available"][k] = b["available"].get(k, 0) + v
+
     async def _on_return_lease(self, conn, lease_id: str):
         lease = self.leases.pop(lease_id, None)
         if lease is None:
             return {"ok": False}
         self._release(lease.resources)
+        self._credit_bundle(lease)
         worker_id = lease.worker["worker_id"]
         w = self.workers.get(worker_id)
         if w and w.get("state") == "leased":
@@ -245,6 +289,30 @@ class NodeManager:
                 self.idle.append(worker_id)
             else:
                 self._kill_worker(worker_id)
+        self._drain_pending()
+        return {"ok": True}
+
+    async def _on_reserve_bundle(
+        self, conn, pg_id: str, index: int, resources: dict
+    ):
+        resources = dict(resources)
+        if not self._available(resources):
+            return {
+                "ok": False,
+                "error": f"bundle {resources} unavailable on {self.node_id[:8]}",
+            }
+        self._acquire(resources)
+        self.bundles[(pg_id, index)] = {
+            "total": resources,
+            "available": dict(resources),
+        }
+        return {"ok": True}
+
+    async def _on_free_bundle(self, conn, pg_id: str, index: int):
+        b = self.bundles.pop((pg_id, index), None)
+        if b is None:
+            return {"ok": False}
+        self._release(b["total"])
         self._drain_pending()
         return {"ok": True}
 
@@ -320,6 +388,7 @@ class NodeManager:
                     if lease.worker["worker_id"] == wid:
                         self.leases.pop(lease_id)
                         self._release(lease.resources)
+                        self._credit_bundle(lease)
                 if self.head:
                     try:
                         await self.head.call(

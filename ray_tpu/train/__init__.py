@@ -10,6 +10,14 @@ from ray_tpu.train.step import (
     init_train_state,
     state_logical_axes,
 )
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.trainer import (
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
 
 __all__ = [
     "TrainState",
@@ -17,4 +25,12 @@ __all__ = [
     "make_train_step",
     "init_train_state",
     "state_logical_axes",
+    "get_checkpoint",
+    "get_context",
+    "report",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
 ]

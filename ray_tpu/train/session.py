@@ -1,0 +1,78 @@
+"""Per-worker training session: get_context() / report() from inside the
+user's train loop (reference: ray.train.report and
+ray.train.get_context(), python/ray/train/v2/_internal/execution/context).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class TrainContext:
+    world_size: int = 1
+    rank: int = 0
+    experiment_name: str = "default"
+    storage_path: str = ""
+    latest_checkpoint: str | None = None
+    config: dict = field(default_factory=dict)
+    # mutated by report():
+    reports: list = field(default_factory=list)
+    latest_metrics: dict = field(default_factory=dict)
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.rank
+
+
+_context: TrainContext | None = None
+
+
+def _set_context(ctx: TrainContext | None):
+    global _context
+    _context = ctx
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_context() is only valid inside a train loop"
+        )
+    return _context
+
+
+def get_checkpoint() -> str | None:
+    """Latest checkpoint directory to restore from (None on fresh start)."""
+    return get_context().latest_checkpoint
+
+
+def report(metrics: dict, checkpoint: str | None = None) -> None:
+    """Report metrics (all ranks) and optionally a checkpoint directory
+    (rank 0's is persisted; reference: ray.train.report semantics)."""
+    ctx = get_context()
+    ctx.latest_metrics = dict(metrics)
+    entry: dict[str, Any] = {"metrics": dict(metrics)}
+    if checkpoint is not None and ctx.rank == 0:
+        # Index continues from what's already persisted so a retry attempt
+        # appends after the restored checkpoint instead of overwriting
+        # earlier ones (which would make the newest-named dir stale).
+        run_dir = os.path.join(ctx.storage_path, ctx.experiment_name)
+        os.makedirs(run_dir, exist_ok=True)
+        existing = [
+            int(p.split("_")[1])
+            for p in os.listdir(run_dir)
+            if p.startswith("checkpoint_")
+        ]
+        step = max(existing, default=-1) + 1
+        dest = os.path.join(run_dir, f"checkpoint_{step:06d}")
+        if os.path.abspath(checkpoint) != os.path.abspath(dest):
+            if os.path.exists(dest):
+                shutil.rmtree(dest)
+            shutil.copytree(checkpoint, dest)
+        entry["checkpoint"] = dest
+    ctx.reports.append(entry)

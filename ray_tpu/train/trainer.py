@@ -1,0 +1,242 @@
+"""JaxTrainer: controller + worker-group training (Train v2 architecture).
+
+Mirrors the reference's Train v2 control plane (reference:
+python/ray/train/v2/api/data_parallel_trainer.py:66 `fit` :154 →
+TrainController controller.py:103 → WorkerGroup worker_group.py:112 on a
+placement group → per-framework Backend.on_start; JaxTrainer
+python/ray/train/v2/jax/jax_trainer.py:19 with jax.distributed bootstrap
+config.py:32). Differences, deliberately TPU-first:
+
+- The worker group reserves a *slice-shaped* placement group (one bundle
+  per worker) so a multi-host TPU slice is the scheduling unit.
+- The backend hands each worker the jax.distributed coordinator through
+  the cluster KV (same rendezvous as the collective layer) instead of a
+  torch process group.
+- Failure policy: a slice is atomic — any worker death fails the whole
+  attempt; the controller re-creates the group and restores from the
+  latest checkpoint (reference: failure_policy.py RETRY semantics).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.placement import placement_group, remove_placement_group
+from ray_tpu.train.session import TrainContext, _set_context
+
+
+@dataclass
+class ScalingConfig:
+    """(reference: ray.train.ScalingConfig incl. the TPU fields
+    use_tpu/topology in the JaxTrainer docstring jax_trainer.py:50)"""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: dict = field(default_factory=dict)
+    topology: str | None = None
+    placement_strategy: str = "PACK"
+
+    def bundle(self) -> dict:
+        b = {"CPU": 1.0}
+        b.update(self.resources_per_worker)
+        if self.use_tpu and self.chips_per_worker:
+            b["TPU"] = float(self.chips_per_worker)
+        return b
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: str = "train_run"
+    storage_path: str = "/tmp/ray_tpu_results"
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+
+
+@dataclass
+class Result:
+    metrics: dict
+    checkpoint: str | None
+    path: str
+    error: Exception | None = None
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """One member of the worker group (reference: worker_group.py actors)."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self.ctx: TrainContext | None = None
+
+    def setup(
+        self,
+        experiment_name: str,
+        storage_path: str,
+        config: dict,
+        latest_checkpoint: str | None,
+        backend_env: dict,
+    ):
+        import os
+
+        os.environ.update(backend_env)
+        self.ctx = TrainContext(
+            world_size=self.world_size,
+            rank=self.rank,
+            experiment_name=experiment_name,
+            storage_path=storage_path,
+            latest_checkpoint=latest_checkpoint,
+            config=config,
+        )
+        return True
+
+    def run_loop(self, train_loop: Callable, use_context_arg: bool):
+        _set_context(self.ctx)
+        try:
+            if use_context_arg:
+                train_loop(self.ctx.config)
+            else:
+                train_loop()
+        finally:
+            _set_context(None)
+        return {
+            "rank": self.rank,
+            "reports": self.ctx.reports,
+            "latest_metrics": self.ctx.latest_metrics,
+        }
+
+
+class JaxTrainer:
+    """Data-parallel / FSDP JAX training over a gang-scheduled worker
+    group. The user's ``train_loop_per_worker`` builds its mesh with
+    ray_tpu.parallel.make_mesh and shards with the rule table — the
+    trainer owns process placement, rendezvous env, checkpoints, and
+    retries; XLA owns the collectives."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: dict | None = None,
+        scaling_config: ScalingConfig | None = None,
+        run_config: RunConfig | None = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+
+    # ------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        failures = 0
+        latest_checkpoint: str | None = None
+        last_err: Exception | None = None
+        while True:
+            try:
+                return self._run_attempt(latest_checkpoint)
+            except Exception as e:  # noqa: BLE001 - controller retry loop
+                last_err = e
+                failures += 1
+                latest_checkpoint = (
+                    self._find_latest_checkpoint() or latest_checkpoint
+                )
+                if failures > self.run_config.failure_config.max_failures:
+                    break
+        return Result(
+            metrics={},
+            checkpoint=latest_checkpoint,
+            path=self._run_dir(),
+            error=last_err,
+        )
+
+    def _run_dir(self) -> str:
+        import os
+
+        return os.path.join(
+            self.run_config.storage_path, self.run_config.name
+        )
+
+    def _find_latest_checkpoint(self) -> str | None:
+        import os
+
+        d = self._run_dir()
+        if not os.path.isdir(d):
+            return None
+        cks = sorted(
+            p for p in os.listdir(d) if p.startswith("checkpoint_")
+        )
+        return os.path.join(d, cks[-1]) if cks else None
+
+    def _backend_env(self, rank: int) -> dict:
+        """Worker env for the JAX backend (reference: _JaxBackend
+        v2/jax/config.py:32 _setup_jax_distributed_environment)."""
+        env = {
+            "RAY_TPU_TRAIN_RANK": str(rank),
+            "RAY_TPU_TRAIN_WORLD": str(self.scaling.num_workers),
+        }
+        if self.scaling.topology:
+            env["TPU_TOPOLOGY"] = self.scaling.topology
+        if self.scaling.use_tpu:
+            # TPU workers own the chip runtime; everything else stays on
+            # the JAX CPU backend so it never contends for the slice.
+            env["RAY_TPU_WORKER_JAX_PLATFORMS"] = ""
+        return env
+
+    def _run_attempt(self, latest_checkpoint: str | None) -> Result:
+        n = self.scaling.num_workers
+        pg = placement_group(
+            [self.scaling.bundle() for _ in range(n)],
+            strategy=self.scaling.placement_strategy,
+        )
+        workers = []
+        try:
+            workers = [
+                TrainWorker.options(
+                    placement_group=pg,
+                    placement_group_bundle_index=i,
+                ).remote(i, n)
+                for i in range(n)
+            ]
+            ray_tpu.get(
+                [
+                    w.setup.remote(
+                        self.run_config.name,
+                        self.run_config.storage_path,
+                        self.config,
+                        latest_checkpoint,
+                        self._backend_env(i),
+                    )
+                    for i, w in enumerate(workers)
+                ],
+                timeout=60,
+            )
+            import inspect
+
+            use_arg = len(inspect.signature(self.train_loop).parameters) > 0
+            refs = [
+                w.run_loop.remote(self.train_loop, use_arg) for w in workers
+            ]
+            results = ray_tpu.get(refs)
+            rank0 = next(r for r in results if r["rank"] == 0)
+            return Result(
+                metrics=rank0["latest_metrics"],
+                checkpoint=self._find_latest_checkpoint(),
+                path=self._run_dir(),
+            )
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except RayTpuError:
+                    pass
+            remove_placement_group(pg)
+            time.sleep(0.1)  # let worker teardown settle before re-slicing

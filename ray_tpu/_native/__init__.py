@@ -1,0 +1,59 @@
+"""Native (C++) components, built on demand with the system toolchain.
+
+The image has g++/cmake/ninja but no pybind11, so native code exposes a
+flat C ABI consumed via ctypes (see native/shmstore/shmstore.cpp). The
+first import compiles the shared library into a cache directory; later
+imports reuse it keyed by a source hash.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+_CACHE = os.environ.get(
+    "RAY_TPU_NATIVE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu", "native"),
+)
+_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(name: str, sources: list[str], extra_flags: list[str] | None = None) -> str:
+    """Compile `sources` (repo-relative) into lib<name>.so; returns path."""
+    srcs = [os.path.join(_REPO_ROOT, s) for s in sources]
+    h = hashlib.sha1()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    tag = h.hexdigest()[:16]
+    out = os.path.join(_CACHE, f"lib{name}-{tag}.so")
+    if os.path.exists(out):
+        return out
+    with _lock:
+        if os.path.exists(out):
+            return out
+        os.makedirs(_CACHE, exist_ok=True)
+        # Per-process temp name: concurrent cold-cache builds from
+        # several worker processes must not scribble on one .tmp file
+        # (the rename is atomic; last writer wins with identical bytes).
+        tmp = f"{out}.tmp{os.getpid()}"
+        cmd = (
+            ["g++", "-O2", "-g", "-fPIC", "-shared", "-std=c++17"]
+            + (extra_flags or [])
+            + srcs
+            + ["-lpthread", "-o", tmp]
+        )
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"g++ failed for {name}:\n{proc.stderr[-4000:]}"
+            )
+        os.rename(tmp, out)
+    return out

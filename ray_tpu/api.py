@@ -304,6 +304,13 @@ class ActorMethod:
         )
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Record a compiled-graph edge instead of executing (reference:
+        dag building via actor.method.bind, python/ray/dag/class_node.py)."""
+        from ray_tpu.dag.node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
 
 class ActorHandle:
     def __init__(self, actor_id: str, addr: str, class_name: str = ""):

@@ -1,0 +1,28 @@
+"""DAG execution knobs (reference: python/ray/dag/context.py
+`DAGContext` — buffer size, max buffered results, timeouts; env-var
+overridable the same way)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+
+@dataclass
+class DAGContext:
+    buffer_size: int = int(
+        os.environ.get("RAY_TPU_DAG_BUFFER_SIZE", 256 * 1024)
+    )
+    max_buffered: int = int(os.environ.get("RAY_TPU_DAG_MAX_BUFFERED", 8))
+    submit_timeout: float = float(
+        os.environ.get("RAY_TPU_DAG_SUBMIT_TIMEOUT", 30.0)
+    )
+    get_timeout: float = float(os.environ.get("RAY_TPU_DAG_GET_TIMEOUT", 30.0))
+
+    _instance = None
+
+    @classmethod
+    def get(cls) -> "DAGContext":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance

@@ -18,6 +18,7 @@ reference's ownership design (SURVEY.md section 5, failure detection row).
 from __future__ import annotations
 
 import asyncio
+import functools
 import hashlib
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -622,7 +623,14 @@ class CoreWorker:
                 instance = self._actor_instance
                 if instance is None or actor_id != self._actor_id:
                     raise ActorDiedError("no such actor in this worker")
-                fn = getattr(instance, method_name)
+                if method_name.startswith("@sys:"):
+                    # System task: an exported function applied to the
+                    # actor instance (used by compiled graphs to inject
+                    # the exec loop without touching user classes).
+                    sys_fn = await self._fetch_function(method_name[5:])
+                    fn = functools.partial(sys_fn, instance)
+                else:
+                    fn = getattr(instance, method_name)
             else:
                 fn = await self._fetch_function(spec["fn_id"])
             result = await loop.run_in_executor(

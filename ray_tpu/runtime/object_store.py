@@ -63,7 +63,13 @@ class PlasmaView:
 
 
 class ObjectStore:
-    """One store per node; all processes on the node share the directory."""
+    """One store per node; all processes on the node share the directory.
+
+    Backend: the C++ shared-memory pool (ray_tpu/_native/shmstore.py —
+    slab allocator + LRU eviction, the plasma equivalent) when the native
+    toolchain is available; the file-per-object layout below is the
+    fallback and also serves as the layout spec.
+    """
 
     def __init__(self, directory: str | Path, capacity_bytes: int | None = None):
         self.dir = Path(directory)
@@ -71,12 +77,37 @@ class ObjectStore:
         self.capacity = capacity_bytes
         # Views handed out by this process; held so mmaps stay valid.
         self._views: dict[ObjectID, PlasmaView] = {}
+        self.pool = None
+        if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE") != "1":
+            try:
+                from ray_tpu._native.shmstore import ShmPool
+
+                self.pool = ShmPool(
+                    str(self.dir / "pool"), _pool_capacity(self.dir)
+                )
+            except Exception as e:  # noqa: BLE001 - fall back to file store
+                import logging
+
+                logging.getLogger("ray_tpu").warning(
+                    "native shared-memory pool unavailable (%s: %s); "
+                    "falling back to the file-per-object store",
+                    type(e).__name__,
+                    e,
+                )
+                self.pool = None
 
     def _path(self, object_id: ObjectID) -> Path:
         return self.dir / object_id.hex()
 
     def put(self, object_id: ObjectID, data: Serialized) -> int:
         """Create + seal in one step. Returns bytes written."""
+        if self.pool is not None:
+            try:
+                return self.pool.put(
+                    object_id.binary(), data.materialize_buffers()
+                )
+            except MemoryError:
+                pass  # over-capacity object: fall through to a file
         path = self._path(object_id)
         if path.exists():
             return path.stat().st_size  # immutable: double-put is a no-op
@@ -115,10 +146,14 @@ class ObjectStore:
             raise
         return total
 
-    def get(self, object_id: ObjectID) -> PlasmaView | None:
+    def get(self, object_id: ObjectID):
         view = self._views.get(object_id)
         if view is not None:
             return view
+        if self.pool is not None:
+            pv = self.pool.get(object_id.binary())
+            if pv is not None:
+                return pv
         path = self._path(object_id)
         try:
             fd = os.open(path, os.O_RDONLY)
@@ -134,25 +169,50 @@ class ObjectStore:
         return view
 
     def contains(self, object_id: ObjectID) -> bool:
-        return object_id in self._views or self._path(object_id).exists()
+        if object_id in self._views or self._path(object_id).exists():
+            return True
+        return self.pool is not None and self.pool.contains(
+            object_id.binary()
+        )
 
     def delete(self, object_id: ObjectID) -> None:
         self._views.pop(object_id, None)
+        if self.pool is not None:
+            self.pool.delete(object_id.binary())
         try:
             os.unlink(self._path(object_id))
         except FileNotFoundError:
             pass
 
     def used_bytes(self) -> int:
-        return sum(
-            p.stat().st_size for p in self.dir.iterdir() if p.is_file()
+        pool = self.pool.used_bytes() if self.pool is not None else 0
+        return pool + sum(
+            p.stat().st_size
+            for p in self.dir.iterdir()
+            if p.is_file() and p.name != "pool"
         )
 
     def destroy(self) -> None:
         self._views.clear()
+        if self.pool is not None:
+            self.pool.destroy()
         import shutil
 
         shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def _pool_capacity(directory: Path) -> int:
+    env = os.environ.get("RAY_TPU_POOL_BYTES")
+    if env:
+        return int(env)
+    try:
+        st = os.statvfs(directory)
+        free = st.f_bavail * st.f_frsize
+    except OSError:
+        free = 4 << 30
+    # Reference sizes plasma at 30% of system memory by default
+    # (ray_config_def.h object_store defaults); cap at 2 GiB here.
+    return max(64 << 20, min(2 << 30, int(free * 0.3)))
 
 
 def default_store_dir(session: str) -> str:

@@ -88,7 +88,17 @@ class TrainWorker:
     ):
         import os
 
+        # The JAX platform override must be applied by this process (the
+        # node manager only bakes env into *newly spawned* workers): an
+        # empty value means "let jax pick the TPU runtime", anything else
+        # pins the named platform.
+        jax_platform = backend_env.pop("RAY_TPU_WORKER_JAX_PLATFORMS", None)
         os.environ.update(backend_env)
+        if jax_platform is not None:
+            if jax_platform:
+                os.environ["JAX_PLATFORMS"] = jax_platform
+            else:
+                os.environ.pop("JAX_PLATFORMS", None)
         self.ctx = TrainContext(
             world_size=self.world_size,
             rank=self.rank,

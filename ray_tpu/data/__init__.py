@@ -1,0 +1,85 @@
+"""ray_tpu.data: distributed datasets with streaming execution.
+
+Capability-equivalent of the reference's Data library (reference:
+python/ray/data/ — lazy logical plan, streaming executor over blocks in
+the object store, datasources, groupby/shuffle/sort, Train integration),
+re-based on columnar-numpy blocks that feed JAX input pipelines without
+conversion.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.data import block, datasource
+from ray_tpu.data.dataset import DataIterator, Dataset, GroupedData, MaterializedDataset
+from ray_tpu.data.executor import DataContext
+from ray_tpu.data.plan import LogicalPlan, Read
+
+
+def _from_read_tasks(tasks) -> Dataset:
+    return Dataset(LogicalPlan([Read(tasks)]))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    if parallelism <= 0:
+        parallelism = min(200, max(1, n // 1000 or 1))
+    return _from_read_tasks(datasource.range_tasks(n, parallelism))
+
+
+def from_items(items: list, *, parallelism: int = -1) -> Dataset:
+    if parallelism <= 0:
+        parallelism = min(200, max(1, len(items) // 100 or 1))
+    return _from_read_tasks(datasource.items_tasks(list(items), parallelism))
+
+
+def from_numpy(arr, *, parallelism: int = 4) -> Dataset:
+    import numpy as np
+
+    chunks = np.array_split(arr, max(1, parallelism))
+    return from_blocks([{"data": c} for c in chunks])
+
+
+def from_blocks(blocks: list) -> Dataset:
+    import ray_tpu
+
+    refs = [ray_tpu.put(b) for b in blocks]
+    return MaterializedDataset(refs)
+
+
+def from_pandas(dfs) -> Dataset:
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return from_blocks([block.from_pandas(df) for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return from_blocks([block.from_arrow(t) for t in tables])
+
+
+def read_parquet(paths, *, columns=None) -> Dataset:
+    return _from_read_tasks(datasource.file_tasks(paths, "parquet", columns=columns))
+
+
+def read_csv(paths) -> Dataset:
+    return _from_read_tasks(datasource.file_tasks(paths, "csv"))
+
+
+def read_json(paths) -> Dataset:
+    return _from_read_tasks(datasource.file_tasks(paths, "json"))
+
+
+def read_text(paths) -> Dataset:
+    return _from_read_tasks(datasource.file_tasks(paths, "text"))
+
+
+def read_numpy(paths) -> Dataset:
+    return _from_read_tasks(datasource.file_tasks(paths, "numpy"))
+
+
+__all__ = [
+    "Dataset", "MaterializedDataset", "GroupedData", "DataIterator",
+    "DataContext", "range", "from_items", "from_blocks", "from_pandas",
+    "from_arrow", "from_numpy", "read_parquet", "read_csv", "read_json",
+    "read_text", "read_numpy",
+]

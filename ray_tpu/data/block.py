@@ -1,0 +1,142 @@
+"""Blocks: the unit of data held in the object store.
+
+The reference's block is a pyarrow Table in plasma (reference:
+python/ray/data/block.py, `BlockAccessor`). Here the canonical block is a
+**columnar dict of numpy arrays** — the zero-copy host format for feeding
+JAX/TPU input pipelines — with pandas/arrow conversion at the edges.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+# A block is dict[str, np.ndarray]; all columns share length.
+Block = dict
+
+
+def _as_array(values) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    arr = np.asarray(values)
+    if arr.dtype == object:
+        # Ragged / mixed values stay as object arrays (mirrors ArrowVariableShapedTensor).
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+    return arr
+
+
+def from_rows(rows: Iterable[dict]) -> Block:
+    rows = list(rows)
+    if not rows:
+        return {}
+    cols: dict[str, list] = {k: [] for k in rows[0]}
+    for r in rows:
+        if r.keys() != cols.keys():
+            for k in r:
+                cols.setdefault(k, [None] * (len(next(iter(cols.values()), [])) ))
+        for k in cols:
+            cols[k].append(r.get(k))
+    return {k: _as_array(v) for k, v in cols.items()}
+
+
+def from_items(items: Iterable[Any]) -> Block:
+    items = list(items)
+    if items and isinstance(items[0], dict):
+        return from_rows(items)
+    return {"item": _as_array(items)}
+
+
+def from_pandas(df) -> Block:
+    return {c: df[c].to_numpy() for c in df.columns}
+
+
+def from_arrow(table) -> Block:
+    return {name: col.to_numpy(zero_copy_only=False) for name, col in zip(table.column_names, table.columns)}
+
+
+def num_rows(block: Block) -> int:
+    if not block:
+        return 0
+    return len(next(iter(block.values())))
+
+
+def size_bytes(block: Block) -> int:
+    total = 0
+    for arr in block.values():
+        if arr.dtype == object:
+            total += sum(getattr(v, "nbytes", 64) for v in arr)
+        else:
+            total += arr.nbytes
+    return total
+
+
+def schema(block: Block) -> dict[str, Any]:
+    return {k: v.dtype for k, v in block.items()}
+
+
+def slice_block(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+
+def take_idx(block: Block, idx: np.ndarray) -> Block:
+    return {k: v[idx] for k, v in block.items()}
+
+
+def concat(blocks: list[Block]) -> Block:
+    blocks = [b for b in blocks if num_rows(b) > 0]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def to_rows(block: Block) -> Iterator[dict]:
+    n = num_rows(block)
+    keys = list(block.keys())
+    for i in range(n):
+        yield {k: block[k][i] for k in keys}
+
+
+def to_pandas(block: Block):
+    import pandas as pd
+
+    return pd.DataFrame({k: list(v) if v.dtype == object else v for k, v in block.items()})
+
+
+def to_batch(block: Block, batch_format: str):
+    """Convert a block to the user-facing batch format."""
+    if batch_format in ("numpy", "default", None):
+        return dict(block)
+    if batch_format == "pandas":
+        return to_pandas(block)
+    if batch_format == "pyarrow":
+        import pyarrow as pa
+
+        return pa.table({k: list(v) if v.dtype == object else v for k, v in block.items()})
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def from_batch(batch) -> Block:
+    """Normalize a user-returned batch back into a block."""
+    if batch is None:
+        return {}
+    if isinstance(batch, dict):
+        return {k: _as_array(v) for k, v in batch.items()}
+    try:
+        import pandas as pd
+
+        if isinstance(batch, pd.DataFrame):
+            return from_pandas(batch)
+    except ImportError:
+        pass
+    try:
+        import pyarrow as pa
+
+        if isinstance(batch, pa.Table):
+            return from_arrow(batch)
+    except ImportError:
+        pass
+    raise TypeError(f"map_batches must return dict/DataFrame/Table, got {type(batch)}")

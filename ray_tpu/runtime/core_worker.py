@@ -69,12 +69,17 @@ class CoreWorker:
         self._exported: dict[int, str] = {}  # id(fn) → fn_id hex
         self._fn_cache: dict[str, Any] = {}  # fn_id hex → callable/class
 
-        # lease cache: sched key → list[(lease dict, idle_since)]. Cached
-        # leases are returned to the node after an idle timeout so they
-        # don't pin resources (reference: normal_task_submitter.h lease
-        # caching with idle timeout + ReturnWorkerLease).
-        self._lease_cache: dict[tuple, list[tuple[dict, float]]] = {}
-        self._lease_cap = 8
+        # Lease pools: sched key → {"free": [(lease, idle_since)],
+        # "waiters": deque[Future], "inflight": int}. A finished task's
+        # lease is handed straight to the next queued task of the same
+        # scheduling class — no node round-trip on the steady-state path
+        # (reference: normal_task_submitter.h lease caching + pipelined
+        # lease requests, ClusterSizeBasedLeaseRequestRateLimiter :74);
+        # free leases return to the node after an idle timeout so they
+        # don't pin resources (ReturnWorkerLease).
+        self._lease_pools: dict[tuple, dict] = {}
+        self._lease_cap = 8              # max parked free leases per key
+        self._max_inflight_leases = 16   # max pending lease requests per key
         self._lease_idle_s = 1.0
         self._lease_reaper: asyncio.Task | None = None
 
@@ -432,23 +437,70 @@ class CoreWorker:
             reply["node_conn"] = node_conn
             return reply
         key = self._sched_key(resources)
-        cache = self._lease_cache.setdefault(key, [])
-        while cache:
-            lease, _ = cache.pop()
+        pool = self._pool(key)
+        while pool["free"]:
+            lease, _ = pool["free"].pop()
             conn = self._conns.get(lease["addr"])
             if conn is None or not conn._closed:
                 return lease
-        reply = await self.node.call(
-            "lease_worker", resources=dict(resources or {"CPU": 1.0})
-        )
-        if not reply.get("ok"):
-            raise rpc.RpcError(reply.get("error", "lease failed"))
-        reply["sched_key"] = key
-        return reply
+        fut = asyncio.get_running_loop().create_future()
+        pool["waiters"].append(fut)
+        self._maybe_request_lease(key, dict(resources or {"CPU": 1.0}))
+        return await fut
 
-    async def _return_lease(self, lease: dict):
+    def _pool(self, key: tuple) -> dict:
+        import collections
+
+        return self._lease_pools.setdefault(
+            key, {"free": [], "waiters": collections.deque(), "inflight": 0}
+        )
+
+    def _maybe_request_lease(self, key: tuple, resources: dict):
+        """Pipeline lease requests: keep at most min(#waiters, cap)
+        requests in flight per scheduling class."""
+        pool = self._pool(key)
+        if pool["inflight"] >= min(
+            len(pool["waiters"]), self._max_inflight_leases
+        ):
+            return
+        pool["inflight"] += 1
+
+        async def request():
+            try:
+                reply = await self.node.call("lease_worker", resources=resources)
+                if not reply.get("ok"):
+                    raise rpc.RpcError(reply.get("error", "lease failed"))
+                reply["sched_key"] = key
+                pool["inflight"] -= 1
+                self._offer_lease(key, reply)
+            except Exception as e:  # noqa: BLE001 - propagate to one waiter
+                pool["inflight"] -= 1
+                while pool["waiters"]:
+                    fut = pool["waiters"].popleft()
+                    if not fut.done():
+                        fut.set_exception(e)
+                        break
+            # Top up if demand still outstrips supply.
+            if pool["waiters"]:
+                self._maybe_request_lease(key, resources)
+
+        asyncio.ensure_future(request())
+
+    def _offer_lease(self, key: tuple, lease: dict):
         import time
 
+        pool = self._pool(key)
+        while pool["waiters"]:
+            fut = pool["waiters"].popleft()
+            if not fut.done():
+                fut.set_result(lease)
+                return
+        if len(pool["free"]) < self._lease_cap:
+            pool["free"].append((lease, time.monotonic()))
+        else:
+            asyncio.ensure_future(self._give_back(lease))
+
+    async def _return_lease(self, lease: dict):
         if lease.get("sched_key") is None:  # bundle lease: return directly
             try:
                 await lease["node_conn"].call(
@@ -457,11 +509,7 @@ class CoreWorker:
             except rpc.RpcError:
                 pass
             return
-        cache = self._lease_cache.setdefault(lease["sched_key"], [])
-        if len(cache) < self._lease_cap:
-            cache.append((lease, time.monotonic()))
-        else:
-            await self._give_back(lease)
+        self._offer_lease(lease["sched_key"], lease)
 
     async def _give_back(self, lease: dict):
         try:
@@ -475,14 +523,14 @@ class CoreWorker:
         while True:
             await asyncio.sleep(self._lease_idle_s / 2)
             now = time.monotonic()
-            for cache in self._lease_cache.values():
+            for pool in self._lease_pools.values():
                 keep = []
-                for lease, since in cache:
+                for lease, since in pool["free"]:
                     if now - since > self._lease_idle_s:
                         asyncio.ensure_future(self._give_back(lease))
                     else:
                         keep.append((lease, since))
-                cache[:] = keep
+                pool["free"][:] = keep
 
     # ----------------------------------------------------------- actors
     async def create_actor(

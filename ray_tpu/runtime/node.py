@@ -14,6 +14,7 @@ python/ray/_private/accelerators/tpu.py).
 from __future__ import annotations
 
 import asyncio
+import collections
 import os
 import subprocess
 import sys
@@ -28,19 +29,40 @@ SPAWN_TIMEOUT_S = 30.0
 
 
 def detect_resources() -> dict[str, float]:
+    """Detect node resources WITHOUT initializing a JAX backend: grabbing
+    jax.devices() here would lock the TPU chip into the daemon process
+    (and hang if another process holds the tunnel). Mirrors the
+    reference's passive detection via env vars and devfs (reference:
+    python/ray/_private/accelerators/tpu.py:18–66 TPU_VISIBLE_CHIPS /
+    GKE env / chip device files)."""
     resources: dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
     n_tpu = os.environ.get("RAY_TPU_FAKE_CHIPS")
     if n_tpu is not None:
         resources["TPU"] = float(n_tpu)
-    else:
-        try:
-            import jax
+        return resources
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible is None:
+        visible = os.environ.get("TPU_VISIBLE_DEVICES")
+    if visible is not None:
+        # "" means explicitly zero visible chips — do not fall through.
+        n = len([c for c in visible.split(",") if c])
+        if n:
+            resources["TPU"] = float(n)
+        return resources
+    try:
+        import glob
 
-            tpus = [d for d in jax.devices() if d.platform != "cpu"]
-            if tpus:
-                resources["TPU"] = float(len(tpus))
-        except Exception:
-            pass
+        chips = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+        chips = [c for c in chips if c != "/dev/vfio/vfio"]
+        if chips:
+            resources["TPU"] = float(len(chips))
+            return resources
+    except OSError:
+        pass
+    # The axon tunnel exposes one chip without devfs entries; report it
+    # from the env marker only (never by initializing the backend).
+    if "axon" in os.environ.get("JAX_PLATFORMS", ""):
+        resources["TPU"] = 1.0
     return resources
 
 
@@ -80,7 +102,9 @@ class NodeManager:
         self._pending: list[tuple[dict, bool, asyncio.Future]] = []
         # (pg_id, index) → {"total": resources, "available": resources}
         self.bundles: dict[tuple, dict] = {}
-        self._spawn_waiters: dict[str, asyncio.Future] = {}
+        self._worker_waiters: "collections.deque[asyncio.Future]" = (
+            collections.deque()
+        )
         self._next_lease = 0
         self._tasks: list[asyncio.Task] = []
 
@@ -97,6 +121,11 @@ class NodeManager:
         )
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        # Prestart workers up to the CPU count so the first task burst
+        # doesn't pay Python-interpreter spawn latency per lease
+        # (reference: WorkerPool prestarts workers, worker_pool.h:280).
+        for _ in range(min(int(self.total.get("CPU", 1)), IDLE_WORKER_CAP)):
+            self._spawn_worker()
         return self.addr
 
     async def stop(self):
@@ -127,6 +156,18 @@ class NodeManager:
         pypath = os.environ.get("PYTHONPATH", "")
         if pkg_root not in pypath.split(os.pathsep):
             pypath = f"{pkg_root}{os.pathsep}{pypath}" if pypath else pkg_root
+        jax_platform = env_jax_platform()
+        argv = [sys.executable, "-m", "ray_tpu.runtime.worker_main"]
+        if jax_platform == "cpu":
+            # CPU workers skip site initialization (the image's
+            # sitecustomize imports jax + the TPU plugin, ~1.7 s per
+            # interpreter); site-packages comes back via PYTHONPATH.
+            import site
+
+            for sp in site.getsitepackages():
+                if sp not in pypath.split(os.pathsep):
+                    pypath = f"{pypath}{os.pathsep}{sp}" if pypath else sp
+            argv = [sys.executable, "-S", "-m", "ray_tpu.runtime.worker_main"]
         env = {
             **os.environ,
             "PYTHONPATH": pypath,
@@ -137,27 +178,16 @@ class NodeManager:
             "RAY_TPU_WORKER_ID": worker_id,
             # Workers must not grab the TPU chip the driver holds; they run
             # host code (and JAX CPU) unless a lease says otherwise.
-            "JAX_PLATFORMS": env_jax_platform(),
+            "JAX_PLATFORMS": jax_platform,
         }
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu.runtime.worker_main"],
+            argv,
             env=env,
             stdout=None,
             stderr=None,
         )
         self.workers[worker_id] = {"proc": proc, "state": "spawning"}
         return worker_id
-
-    async def _wait_registered(self, worker_id: str) -> dict:
-        w = self.workers.get(worker_id)
-        if w and w.get("conn"):
-            return w
-        fut = asyncio.get_running_loop().create_future()
-        self._spawn_waiters[worker_id] = fut
-        try:
-            return await asyncio.wait_for(fut, SPAWN_TIMEOUT_S)
-        finally:
-            self._spawn_waiters.pop(worker_id, None)
 
     # ------------------------------------------------------------ leases
     def _feasible(self, resources: dict) -> bool:
@@ -174,14 +204,27 @@ class NodeManager:
         for k, v in resources.items():
             self.available[k] = self.available.get(k, 0) + v
 
+    async def _get_worker(self) -> str:
+        """Pop an idle worker, else wait for any spawning worker to
+        register; only spawn a fresh process when demand exceeds the
+        number already spawning (avoids a thundering herd of Python
+        interpreters on cold bursts)."""
+        if self.idle:
+            return self.idle.pop()
+        n_spawning = sum(
+            1 for w in self.workers.values() if w.get("state") == "spawning"
+        )
+        if n_spawning <= len(self._worker_waiters):
+            self._spawn_worker()
+        fut = asyncio.get_running_loop().create_future()
+        self._worker_waiters.append(fut)
+        return await asyncio.wait_for(fut, SPAWN_TIMEOUT_S)
+
     async def _grant_lease(self, resources: dict, actor: bool) -> dict:
         self._acquire(resources)
         try:
-            if self.idle:
-                worker_id = self.idle.pop()
-            else:
-                worker_id = self._spawn_worker()
-            w = await self._wait_registered(worker_id)
+            worker_id = await self._get_worker()
+            w = self.workers[worker_id]
             w["state"] = "leased"
             self._next_lease += 1
             lease_id = f"{self.node_id[:8]}-{self._next_lease}"
@@ -210,12 +253,16 @@ class NodeManager:
         w = self.workers.setdefault(worker_id, {})
         w.update(conn=conn, addr=addr, pid=pid, state="idle")
         conn.state["worker_id"] = worker_id
-        fut = self._spawn_waiters.get(worker_id)
-        if fut and not fut.done():
-            fut.set_result(w)
-        else:
-            self.idle.append(worker_id)
+        self._offer_worker(worker_id)
         return {"ok": True, "node_id": self.node_id}
+
+    def _offer_worker(self, worker_id: str):
+        while self._worker_waiters:
+            fut = self._worker_waiters.popleft()
+            if not fut.done():
+                fut.set_result(worker_id)
+                return
+        self.idle.append(worker_id)
 
     async def _on_lease_worker(
         self,
@@ -284,8 +331,13 @@ class NodeManager:
         worker_id = lease.worker["worker_id"]
         w = self.workers.get(worker_id)
         if w and w.get("state") == "leased":
-            if len(self.idle) < IDLE_WORKER_CAP:
-                w["state"] = "idle"
+            w["state"] = "idle"
+            if self._worker_waiters:
+                # Hand the warm worker straight to a blocked lease grant
+                # rather than parking (or killing) it while the grant
+                # waits out an interpreter spawn.
+                self._offer_worker(worker_id)
+            elif len(self.idle) < IDLE_WORKER_CAP:
                 self.idle.append(worker_id)
             else:
                 self._kill_worker(worker_id)
@@ -384,6 +436,11 @@ class NodeManager:
                 w = self.workers.pop(wid, None)
                 if wid in self.idle:
                     self.idle.remove(wid)
+                if w and w.get("state") == "spawning" and self._worker_waiters:
+                    # A worker died mid-spawn with grants still blocked on
+                    # registration — spawn a replacement immediately rather
+                    # than letting the waiter run out the 30 s spawn timeout.
+                    self._spawn_worker()
                 for lease_id, lease in list(self.leases.items()):
                     if lease.worker["worker_id"] == wid:
                         self.leases.pop(lease_id)

@@ -11,6 +11,16 @@ import asyncio
 import os
 import sys
 
+if sys.flags.no_site:
+    # Fast-start workers run with -S to skip the image's sitecustomize
+    # (which imports the TPU plugin, ~1.7 s). Recover .pth-based packages
+    # (editable installs, namespace hooks) by processing site dirs
+    # explicitly — addsitedir executes .pth files but not sitecustomize.
+    import site
+
+    for _sp in site.getsitepackages():
+        site.addsitedir(_sp)
+
 
 async def main() -> None:
     from ray_tpu.runtime.core_worker import CoreWorker

@@ -77,13 +77,16 @@ class Dataset:
         return GroupedData(self, key)
 
     # ------------------------------------------------------- aggregations
-    def _global_agg(self, kind: str, on: str | None):
-        out = kind if on is None else f"{kind}({on})"
+    def _global_agg(self, *specs: tuple):
+        """One aggregation pass over the plan for all (kind, on) specs."""
+        aggs = [(k, on, k if on is None else f"{k}({on})") for k, on in specs]
         refs = list(execute(self._plan.with_op(
-            P.GroupByAggregate(None, [(kind, on, out)]))))
+            P.GroupByAggregate(None, aggs))))
         blocks = ray_tpu.get(refs)
         blk = B.concat([b for b in blocks if b])
-        return blk[out][0] if B.num_rows(blk) else None
+        if not B.num_rows(blk):
+            return [None] * len(aggs)
+        return [blk[out][0] for _, _, out in aggs]
 
     def count(self) -> int:
         from ray_tpu.data.executor import _count_rows
@@ -92,18 +95,18 @@ class Dataset:
         return int(sum(ray_tpu.get([_count_rows.remote(r) for r in refs])))
 
     def sum(self, on: str):
-        return self._global_agg("sum", on)
+        return self._global_agg(("sum", on))[0]
 
     def min(self, on: str):
-        return self._global_agg("min", on)
+        return self._global_agg(("min", on))[0]
 
     def max(self, on: str):
-        return self._global_agg("max", on)
+        return self._global_agg(("max", on))[0]
 
     def mean(self, on: str):
-        # exact: sum / count (the partition-mean average would be biased)
-        total = self.sum(on)
-        n = self.count()
+        # exact sum/count in ONE pass over the plan (partition-mean
+        # averaging would be biased; two passes would double the work)
+        total, n = self._global_agg(("sum", on), ("count", on))
         return total / n if n else None
 
     # ------------------------------------------------------- consumption

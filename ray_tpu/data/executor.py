@@ -178,12 +178,22 @@ def _merge_sorted(key, descending, *parts):
     return B.take_idx(blk, order)
 
 
+def _stable_hash(k, n: int) -> int:
+    """Deterministic across processes (builtin str hash is per-process
+    randomized, which would scatter equal keys across partitions)."""
+    if isinstance(k, (int, np.integer)):
+        return int(k) % n
+    import zlib
+
+    return zlib.crc32(repr(k).encode()) % n
+
+
 @ray_tpu.remote
 def _hash_part(key, n, blk):
     if not blk:
         return tuple({} for _ in range(n)) if n > 1 else {}
     keys = blk[key]
-    hashes = np.array([hash(k) % n for k in keys.tolist()], dtype=np.int64)
+    hashes = np.array([_stable_hash(k, n) for k in keys.tolist()], dtype=np.int64)
     parts = tuple(B.take_idx(blk, np.nonzero(hashes == j)[0]) for j in range(n))
     return parts if n > 1 else parts[0]
 
@@ -316,14 +326,17 @@ def execute(plan: P.LogicalPlan, ctx: DataContext | None = None) -> Iterator:
                 j += 1
             fused = ops[i:j] if op.kind != "read" else ops[i + 1 : j]
             chain = _chain_spec(fused)
-            window = ctx.prefetch_blocks
+            cap = None  # explicit user concurrency cap survives fusion
             for f in fused:
                 if getattr(f, "concurrency", None):
-                    window = min(window, f.concurrency)
+                    cap = f.concurrency if cap is None else min(cap, f.concurrency)
             if op.kind == "read":
+                window = cap if cap else max(ctx.prefetch_blocks,
+                                             ctx.parallelism())
                 stream = _windowed(lambda t, c=chain: _exec_read.remote(t, c),
-                                   iter(op.tasks), max(window, ctx.parallelism()))
+                                   iter(op.tasks), window)
             else:
+                window = cap if cap else ctx.prefetch_blocks
                 stream = _windowed(lambda r, c=chain: _exec_chain.remote(c, r),
                                    stream, window)
             i = j
@@ -347,7 +360,20 @@ def execute(plan: P.LogicalPlan, ctx: DataContext | None = None) -> Iterator:
                 return a.apply.remote(_op.batch_size, _op.batch_format,
                                       _op.fn_args, _op.fn_kwargs, r)
 
-            stream = _windowed(submit, stream, max(2, 2 * n_actors))
+            def actor_stage(up, _actors=actors, _n=n_actors):
+                inner = _windowed(submit, up, max(2, 2 * _n))
+                try:
+                    yield from inner
+                finally:
+                    # Drain in-flight calls, then release the leased
+                    # workers — actors would otherwise pin CPUs forever.
+                    for a in _actors:
+                        try:
+                            ray_tpu.kill(a)
+                        except Exception:  # noqa: BLE001
+                            pass
+
+            stream = actor_stage(stream)
             i = j
             continue
         # ---- all-to-all / terminal ops materialize upstream refs
@@ -363,7 +389,12 @@ def execute(plan: P.LogicalPlan, ctx: DataContext | None = None) -> Iterator:
         elif op.kind == "random_shuffle":
             refs = list(stream)
             n = op.n_out or ctx.shuffle_partitions or len(refs) or 1
-            base = op.seed if op.seed is not None else 0xC0FFEE
+            if op.seed is not None:
+                base = op.seed
+            else:  # fresh order every execution, like an unseeded shuffle
+                import os as _os
+
+                base = int.from_bytes(_os.urandom(4), "little")
             mapped = [_shuffle_map.options(num_returns=n).remote(n, base + mi, r)
                       for mi, r in enumerate(refs)]
             mapped = [m if isinstance(m, list) else [m] for m in mapped]
@@ -456,10 +487,6 @@ def _localize(pl: list[tuple]) -> list[tuple]:
     order = sorted({t[0] for t in pl})
     remap = {k: i for i, k in enumerate(order)}
     return [(remap[i], s, e) for i, s, e in pl]
-
-
-def _remap(pl):
-    return True
 
 
 def _row_align(lcounts: list[int], rcounts: list[int]) -> list[list[tuple]]:

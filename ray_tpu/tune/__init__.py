@@ -1,0 +1,75 @@
+"""ray_tpu.tune: hyperparameter search over trial actors.
+
+Capability-equivalent of the reference's Tune (reference:
+python/ray/tune/ — Tuner.fit → TuneController event loop over trial
+actors, searchers, schedulers, ResultGrid), reduced to the surfaces the
+rest of this framework uses: function and class trainables, grid/random
+search, ASHA / median-stopping / PBT schedulers.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Choice,
+    Domain,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.trial import StopTrial, Trainable, Trial
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    RunConfig,
+    TrialResult,
+    TuneConfig,
+    Tuner,
+)
+
+# ---------------------------------------------------------------- session
+_session = None
+
+
+def _set_session(s):
+    global _session
+    _session = s
+
+
+def report(metrics: dict, checkpoint: str | None = None) -> None:
+    """Report metrics from inside a function trainable (reference:
+    ray.tune.report / session.report)."""
+    if _session is None:
+        raise RuntimeError(
+            "tune.report() is only valid inside a running trial"
+        )
+    _session.report(metrics, checkpoint=checkpoint)
+
+
+def get_checkpoint() -> str | None:
+    """Checkpoint directory to restore from, if the trial was resumed
+    (reference: ray.tune.get_checkpoint)."""
+    if _session is None:
+        raise RuntimeError(
+            "tune.get_checkpoint() is only valid inside a running trial"
+        )
+    return _session.latest_checkpoint
+
+
+__all__ = [
+    "Tuner", "TuneConfig", "RunConfig", "ResultGrid", "TrialResult",
+    "Trainable", "Trial", "StopTrial", "report", "get_checkpoint",
+    "uniform", "loguniform", "randint", "choice", "grid_search",
+    "Domain", "Choice", "Searcher", "BasicVariantGenerator",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining",
+]

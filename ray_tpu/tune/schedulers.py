@@ -1,0 +1,201 @@
+"""Trial schedulers: early stopping and population-based training.
+
+Mirrors the reference's scheduler surface (reference:
+python/ray/tune/schedulers/ — ASHAScheduler async_hyperband.py,
+MedianStoppingRule median_stopping_rule.py, PopulationBasedTraining
+pbt.py) on the reduced Trial model in this package. Decisions are made
+per reported result: CONTINUE, STOP, or (PBT) EXPLOIT.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+EXPLOIT = "EXPLOIT"
+
+
+class TrialScheduler:
+    """Two-phase protocol: _record ingests a result, _decide returns a
+    decision. The controller batch-records all results from a lockstep
+    tick before deciding, so rung comparisons see every peer that
+    reached the milestone in the same tick."""
+
+    def _record(self, trial, result: dict) -> None:
+        pass
+
+    def _decide(self, trial, result: dict, trials: list) -> str:
+        return CONTINUE
+
+    def on_result(self, trial, result: dict, trials: list) -> str:
+        self._record(trial, result)
+        return self._decide(trial, result, trials)
+
+    def on_batch(self, batch: list, trials: list) -> dict:
+        for tr, res in batch:
+            self._record(tr, res)
+        return {
+            tr.trial_id: self._decide(tr, res, trials) for tr, res in batch
+        }
+
+    def choose_exploit_source(self, trial, trials: list):
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous successive halving (reference: async_hyperband.py).
+
+    Rungs at grace_period * reduction_factor**k; a trial reaching a rung
+    stops unless its metric is in the top 1/reduction_factor of results
+    recorded at that rung so far.
+    """
+
+    def __init__(self, metric: str, mode: str = "max", time_attr: str =
+                 "training_iteration", grace_period: int = 1,
+                 reduction_factor: int = 4, max_t: int = 100):
+        assert mode in ("max", "min")
+        self.metric, self.mode, self.time_attr = metric, mode, time_attr
+        self.grace, self.rf, self.max_t = grace_period, reduction_factor, max_t
+        self._rungs: dict[int, list[float]] = {}
+        milestones = []
+        t = grace_period
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        self._milestones = milestones
+
+    def _record(self, trial, result: dict) -> None:
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return
+        if t in self._milestones:
+            self._rungs.setdefault(t, []).append(float(v))
+
+    def _decide(self, trial, result: dict, trials: list) -> str:
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        if t in self._milestones:
+            rung = self._rungs.get(t, [])
+            if rung:
+                k = max(1, len(rung) // self.rf)
+                top = sorted(rung, reverse=(self.mode == "max"))[:k]
+                worst_top = top[-1]
+                good = (v >= worst_top) if self.mode == "max" else (v <= worst_top)
+                if not good:
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step (reference:
+    median_stopping_rule.py)."""
+
+    def __init__(self, metric: str, mode: str = "max", time_attr: str =
+                 "training_iteration", grace_period: int = 1,
+                 min_samples_required: int = 3):
+        self.metric, self.mode, self.time_attr = metric, mode, time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: dict[str, tuple[float, int]] = {}  # trial_id → (sum, n)
+
+    def _record(self, trial, result: dict) -> None:
+        v = result.get(self.metric)
+        if v is None:
+            return
+        s, n = self._avgs.get(trial.trial_id, (0.0, 0))
+        self._avgs[trial.trial_id] = (s + float(v), n + 1)
+
+    def _decide(self, trial, result: dict, trials: list) -> str:
+        t = result.get(self.time_attr, 0)
+        v = result.get(self.metric)
+        if v is None:
+            return CONTINUE
+        if t < self.grace:
+            return CONTINUE
+        others = [
+            s_ / n_ for tid, (s_, n_) in self._avgs.items()
+            if tid != trial.trial_id and n_ > 0
+        ]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        s, n = self._avgs[trial.trial_id]
+        avg = s / n
+        bad = (avg < median) if self.mode == "max" else (avg > median)
+        return STOP if bad else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: pbt.py): every perturbation_interval steps, a
+    bottom-quantile trial clones a top-quantile trial's checkpoint and
+    perturbs its hyperparameters (resample or *1.2 / *0.8)."""
+
+    def __init__(self, metric: str, mode: str = "max", time_attr: str =
+                 "training_iteration", perturbation_interval: int = 5,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25, seed=None):
+        self.metric, self.mode, self.time_attr = metric, mode, time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self._last: dict[str, float] = {}  # trial_id → last metric
+
+    def _record(self, trial, result: dict) -> None:
+        v = result.get(self.metric)
+        if v is not None:
+            self._last[trial.trial_id] = float(v)
+
+    def _decide(self, trial, result: dict, trials: list) -> str:
+        t = result.get(self.time_attr, 0)
+        if t == 0 or t % self.interval != 0:
+            return CONTINUE
+        scored = [
+            (self._last[tr.trial_id], tr) for tr in trials
+            if tr.trial_id in self._last
+        ]
+        if len(scored) < 2:
+            return CONTINUE
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        bottom_ids = {tr.trial_id for _, tr in scored[-k:]}
+        if trial.trial_id in bottom_ids:
+            return EXPLOIT
+        return CONTINUE
+
+    def choose_exploit_source(self, trial, trials: list):
+        scored = [
+            (self._last[tr.trial_id], tr) for tr in trials
+            if tr.trial_id in self._last and tr.trial_id != trial.trial_id
+        ]
+        if not scored:
+            return None
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        return self.rng.choice([tr for _, tr in scored[:k]])
+
+    def perturb(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            else:  # numeric: jitter
+                factor = self.rng.choice([0.8, 1.2])
+                out[key] = out.get(key, spec) * factor
+        return out

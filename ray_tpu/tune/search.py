@@ -1,0 +1,130 @@
+"""Search spaces and suggestion algorithms.
+
+Mirrors the reference's tune.search surface (reference:
+python/ray/tune/search/ — sample.py distributions, grid_search,
+BasicVariantGenerator basic_variant.py) in reduced form: distribution
+objects + a variant generator that expands grid axes and samples the
+rest; pluggable Searcher ABC for smarter algorithms.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self.lo, self.hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Choice(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def grid_search(values) -> dict:
+    return {"grid_search": list(values)}
+
+
+class Searcher:
+    """ABC (reference: tune/search/searcher.py Searcher)."""
+
+    def suggest(self, trial_id: str) -> dict | None:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: dict | None):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Expand grid_search axes into a cross product; sample Domain leaves
+    num_samples times (reference: basic_variant.py semantics)."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1, seed=None):
+        self.rng = random.Random(seed)
+        grid_axes: list[tuple[str, list]] = []
+        for k, v in param_space.items():
+            if isinstance(v, dict) and set(v.keys()) == {"grid_search"}:
+                grid_axes.append((k, v["grid_search"]))
+        self.param_space = param_space
+        if grid_axes:
+            keys = [k for k, _ in grid_axes]
+            combos = list(itertools.product(*[vals for _, vals in grid_axes]))
+            self._grid = [dict(zip(keys, c)) for c in combos]
+        else:
+            self._grid = [{}]
+        self._queue = [
+            (g, s) for s in range(num_samples) for g in self._grid
+        ]
+        self._i = 0
+
+    @property
+    def total(self) -> int:
+        return len(self._queue)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._i >= len(self._queue):
+            return None
+        grid_part, _ = self._queue[self._i]
+        self._i += 1
+        config = {}
+        for k, v in self.param_space.items():
+            if k in grid_part:
+                config[k] = grid_part[k]
+            elif isinstance(v, Domain):
+                config[k] = v.sample(self.rng)
+            elif isinstance(v, dict) and set(v.keys()) == {"grid_search"}:
+                pass  # handled via grid_part
+            else:
+                config[k] = v
+        config.update(grid_part)
+        return config

@@ -1,0 +1,275 @@
+"""Tuner + controller event loop.
+
+Mirrors the reference's Tune v2 control plane (reference:
+python/ray/tune/execution/tune_controller.py:68 — an event loop over
+trial actors that starts trials up to the resource cap, consumes
+results, and applies scheduler decisions; tuner.py Tuner.fit →
+ResultGrid). PBT exploitation uses the class-API save/restore path.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.tune import schedulers as S
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trial import (
+    ERROR,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trainable,
+    Trial,
+    TrialActor,
+)
+
+
+@dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    metric: str | None = None
+    mode: str = "max"
+    scheduler: S.TrialScheduler | None = None
+    search_alg: Searcher | None = None
+    seed: Any = None
+    max_iterations: int | None = None  # class-API step cap
+
+
+@dataclass
+class RunConfig:
+    name: str = "tune_run"
+    storage_path: str = "/tmp/ray_tpu_results"
+
+
+@dataclass
+class TrialResult:
+    config: dict
+    metrics: dict
+    checkpoint: str | None
+    path: str
+    error: str | None = None
+
+
+class ResultGrid:
+    def __init__(self, results: list[TrialResult], metric=None, mode="max"):
+        self._results = results
+        self._metric, self._mode = metric, mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: str | None = None, mode: str | None = None):
+        metric = metric or self._metric
+        mode = mode or self._mode
+        ok = [r for r in self._results if not r.error and metric in r.metrics]
+        if not ok:
+            raise ValueError("no successful trial reported " + str(metric))
+        return (max if mode == "max" else min)(
+            ok, key=lambda r: r.metrics[metric]
+        )
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics)
+            row.update({f"config/{k}": v for k, v in r.config.items()})
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(self, trainable, *, param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config: RunConfig | None = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        cfg = self.tune_config
+        searcher = cfg.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=cfg.num_samples, seed=cfg.seed
+        )
+        scheduler = cfg.scheduler or S.FIFOScheduler()
+        exp_dir = os.path.join(self.run_config.storage_path, self.run_config.name)
+        os.makedirs(exp_dir, exist_ok=True)
+        is_class = inspect.isclass(self.trainable) and issubclass(
+            self.trainable, Trainable
+        )
+        controller = _TuneController(
+            self.trainable, is_class, searcher, scheduler, cfg, exp_dir
+        )
+        results = controller.run()
+        return ResultGrid(results, metric=cfg.metric, mode=cfg.mode)
+
+
+class _TuneController:
+    """(reference: TuneController tune_controller.py:68 — state machine
+    stepping trials and consuming results.)"""
+
+    def __init__(self, trainable, is_class, searcher, scheduler, cfg, exp_dir):
+        self.trainable = trainable
+        self.is_class = is_class
+        self.searcher = searcher
+        self.scheduler = scheduler
+        self.cfg = cfg
+        self.exp_dir = exp_dir
+        self.trials: list[Trial] = []
+        self._next_id = 0
+        self._exhausted = False
+
+    def _new_trial(self) -> Trial | None:
+        config = self.searcher.suggest(f"t{self._next_id}")
+        if config is None:
+            self._exhausted = True
+            return None
+        trial = Trial(
+            f"t{self._next_id:04d}", config,
+            os.path.join(self.exp_dir, f"trial_{self._next_id:04d}"),
+        )
+        self._next_id += 1
+        self.trials.append(trial)
+        return trial
+
+    def _start(self, trial: Trial):
+        trial.actor = TrialActor.remote(trial.local_dir)
+        trial.is_class_api = self.is_class
+        if self.is_class:
+            ray_tpu.get(trial.actor.setup_class.remote(
+                self.trainable, trial.config, trial.checkpoint))
+        else:
+            ray_tpu.get(trial.actor.start_fn.remote(
+                self.trainable, trial.config, trial.checkpoint))
+        trial.status = RUNNING
+
+    def _finish(self, trial: Trial, status: str, error: str | None = None):
+        trial.status = status
+        trial.error = error
+        if trial.actor is not None:
+            try:
+                if trial.is_class_api:
+                    ray_tpu.get(trial.actor.shutdown.remote())
+                ray_tpu.kill(trial.actor)
+            except Exception:  # noqa: BLE001 - actor may already be dead
+                pass
+            trial.actor = None
+
+    def _running(self):
+        return [t for t in self.trials if t.status == RUNNING]
+
+    def run(self) -> list:
+        cap = max(1, self.cfg.max_concurrent_trials)
+        while True:
+            # Fill free slots.
+            while not self._exhausted and len(self._running()) < cap:
+                t = self._new_trial()
+                if t is None:
+                    break
+                self._start(t)
+            running = self._running()
+            if not running:
+                if self._exhausted:
+                    break
+                continue
+            if self.is_class:
+                self._step_class_trials(running)
+            else:
+                self._poll_fn_trials(running)
+        return [
+            TrialResult(
+                config=t.config, metrics=t.last_result,
+                checkpoint=t.checkpoint, path=t.local_dir, error=t.error,
+            )
+            for t in self.trials
+        ]
+
+    # ------------------------------------------------------- class API
+    def _step_class_trials(self, running: list):
+        # One synchronous step per running trial per tick; all results are
+        # recorded before any decision so rung/quantile comparisons see
+        # every peer at the same milestone (schedulers' two-phase hook).
+        step_refs = [(t, t.actor.train_step.remote()) for t in running]
+        batch = []
+        for t, ref in step_refs:
+            try:
+                metrics = ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001
+                self._finish(t, ERROR, error=str(e))
+                continue
+            t.iteration = metrics.get("training_iteration", t.iteration + 1)
+            t.results.append(metrics)
+            t.last_result = metrics
+            batch.append((t, metrics))
+        decisions = self.scheduler.on_batch(batch, self.trials)
+        max_it = self.cfg.max_iterations
+        for t, metrics in batch:
+            decision = decisions.get(t.trial_id, S.CONTINUE)
+            if decision == S.STOP or (max_it and t.iteration >= max_it):
+                t.checkpoint = ray_tpu.get(t.actor.save.remote())
+                self._finish(t, TERMINATED)
+            elif decision == S.EXPLOIT:
+                self._exploit(t)
+
+    def _exploit(self, trial: Trial):
+        """PBT: clone a top trial's checkpoint + perturbed config
+        (reference: pbt.py _exploit)."""
+        source = self.scheduler.choose_exploit_source(trial, self._running())
+        if source is None or source.actor is None:
+            return
+        ckpt = ray_tpu.get(source.actor.save.remote())
+        new_config = self.scheduler.perturb(source.config)
+        trial.config = new_config
+        ray_tpu.get(trial.actor.restore.remote(
+            ckpt, config=new_config, iteration=source.iteration))
+        trial.iteration = source.iteration
+
+    # ---------------------------------------------------- function API
+    def _poll_fn_trials(self, running: list):
+        time.sleep(0.05)
+        polls = [(t, t.actor.poll.remote()) for t in running]
+        for t, ref in polls:
+            try:
+                out = ray_tpu.get(ref)
+            except Exception as e:  # noqa: BLE001
+                self._finish(t, ERROR, error=str(e))
+                continue
+            stopped = False
+            for entry in out["reports"]:
+                metrics = entry["metrics"]
+                t.iteration = metrics.get("training_iteration", t.iteration + 1)
+                metrics.setdefault("training_iteration", t.iteration)
+                t.results.append(metrics)
+                t.last_result = metrics
+                if "checkpoint" in entry:
+                    t.checkpoint = entry["checkpoint"]
+                decision = self.scheduler.on_result(t, metrics, self.trials)
+                if decision == S.STOP:
+                    ray_tpu.get(t.actor.stop_fn.remote())
+                    self._finish(t, TERMINATED)
+                    stopped = True
+                    break
+            if stopped:
+                continue
+            if out["done"]:
+                t.checkpoint = out["checkpoint"] or t.checkpoint
+                if out["error"]:
+                    self._finish(t, ERROR, error=out["error"])
+                else:
+                    self._finish(t, TERMINATED)

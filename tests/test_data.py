@@ -100,6 +100,17 @@ def test_groupby(cluster):
     assert cnt == {0: 10, 1: 10, 2: 10}
 
 
+def test_groupby_string_keys(cluster):
+    # String keys must hash identically across worker processes (builtin
+    # hash() is per-process randomized).
+    ds = rd.from_items(
+        [{"k": ["a", "b", "c"][i % 3], "v": 1} for i in range(30)],
+        parallelism=5,
+    )
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert out == {"a": 10, "b": 10, "c": 10}
+
+
 def test_map_groups(cluster):
     ds = rd.from_items([{"k": i % 2, "v": i} for i in range(10)], parallelism=2)
     out = ds.groupby("k").map_groups(

@@ -340,6 +340,7 @@ class ActorClass:
         detached=False,
         placement_group=None,
         placement_group_bundle_index=0,
+        max_concurrency=None,
     ):
         self._cls = cls
         self._resources = resources
@@ -347,6 +348,7 @@ class ActorClass:
         self._detached = detached
         self._pg = placement_group
         self._pg_bundle = placement_group_bundle_index
+        self._max_concurrency = max_concurrency
 
     def options(self, *, lifetime=None, **opts):
         opts = _normalize_options(opts)
@@ -356,6 +358,7 @@ class ActorClass:
             "detached": (lifetime == "detached") or self._detached,
             "placement_group": self._pg,
             "placement_group_bundle_index": self._pg_bundle,
+            "max_concurrency": self._max_concurrency,
         }
         merged.update(opts)
         return ActorClass(self._cls, **merged)
@@ -370,6 +373,7 @@ class ActorClass:
                 resources=self._resources,
                 detached=self._detached,
                 placement=_placement_tuple(self._pg, self._pg_bundle),
+                max_concurrency=self._max_concurrency,
             )
         )
         return ActorHandle(actor_id, addr, self._cls.__name__)

@@ -91,6 +91,11 @@ class CoreWorker:
         self._exec_task: asyncio.Task | None = None
         self._actor_instance: Any = None
         self._actor_id: str | None = None
+        # Async (coroutine) actor methods run concurrently, out of order,
+        # bounded by max_concurrency (reference: asyncio actors via
+        # OutOfOrderActorSchedulingQueue + ConcurrencyGroupManager fibers,
+        # core_worker/task_execution/fiber.h).
+        self._async_sema = asyncio.Semaphore(100)
 
         self._put_index = 0
         self._root_task = TaskID.random()
@@ -542,6 +547,7 @@ class CoreWorker:
         resources: dict | None = None,
         detached: bool = False,
         placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
+        max_concurrency: int | None = None,
     ):
         actor_id = ActorID.random().hex()
         if placement is not None:
@@ -573,6 +579,7 @@ class CoreWorker:
             actor_id=actor_id,
             fn_id=fn_id,
             args=self._encode_args(args, kwargs),
+            max_concurrency=max_concurrency,
         )
         if create["status"] == "error":
             raise deserialize(create["error"])
@@ -634,8 +641,12 @@ class CoreWorker:
         await self._exec_queue.put(("task", spec, actor_id, fut))
         return await fut
 
-    async def _on_create_actor(self, conn, actor_id: str, fn_id: str, args):
+    async def _on_create_actor(
+        self, conn, actor_id: str, fn_id: str, args, max_concurrency=None
+    ):
         try:
+            if max_concurrency:
+                self._async_sema = asyncio.Semaphore(int(max_concurrency))
             cls = await self._fetch_function(fn_id)
             a, kw = await self._decode_args(args)
             loop = asyncio.get_running_loop()
@@ -658,9 +669,25 @@ class CoreWorker:
         time, in arrival order, on the executor thread."""
         while True:
             kind, spec, actor_id, fut = await self._exec_queue.get()
+            if actor_id is not None and self._is_async_method(spec):
+                asyncio.ensure_future(self._run_async(spec, actor_id, fut))
+                continue
             reply = await self._execute(spec, actor_id)
             if not fut.done():
                 fut.set_result(reply)
+
+    def _is_async_method(self, spec: dict) -> bool:
+        name = spec["fn_id"]
+        if name.startswith("@sys:") or self._actor_instance is None:
+            return False
+        fn = getattr(self._actor_instance, name, None)
+        return asyncio.iscoroutinefunction(fn)
+
+    async def _run_async(self, spec: dict, actor_id: str, fut):
+        async with self._async_sema:
+            reply = await self._execute(spec, actor_id)
+        if not fut.done():
+            fut.set_result(reply)
 
     async def _execute(self, spec: dict, actor_id: str | None) -> dict:
         loop = asyncio.get_running_loop()
@@ -681,9 +708,12 @@ class CoreWorker:
                     fn = getattr(instance, method_name)
             else:
                 fn = await self._fetch_function(spec["fn_id"])
-            result = await loop.run_in_executor(
-                self._exec_pool, lambda: fn(*args, **kwargs)
-            )
+            if asyncio.iscoroutinefunction(fn):
+                result = await fn(*args, **kwargs)
+            else:
+                result = await loop.run_in_executor(
+                    self._exec_pool, lambda: fn(*args, **kwargs)
+                )
             n = spec["num_returns"]
             values = (
                 [result]

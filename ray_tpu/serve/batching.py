@@ -1,0 +1,105 @@
+"""@serve.batch: dynamic request batching inside a replica.
+
+(reference: python/ray/serve/batching.py — single-element calls queue up;
+a flusher invokes the wrapped method with a list once max_batch_size is
+reached or batch_wait_timeout_s elapses; the wrapped method returns a
+list of per-element results.)
+
+On TPU this is the tool that turns concurrent single requests into one
+batched forward pass (MXU wants large batches).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import inspect
+
+
+class _BatchQueue:
+    def __init__(self, fn, self_arg, max_batch_size: int, timeout_s: float):
+        self._fn = fn
+        self._self_arg = self_arg
+        self._max = max_batch_size
+        self._timeout = timeout_s
+        self._pending: list[tuple] = []  # (arg, future)
+        self._flusher: asyncio.Task | None = None
+
+    async def submit(self, arg):
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._pending.append((arg, fut))
+        if len(self._pending) >= self._max:
+            self._flush_now()
+        elif self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.ensure_future(self._delayed_flush())
+        return await fut
+
+    async def _delayed_flush(self):
+        await asyncio.sleep(self._timeout)
+        self._flush_now()
+
+    def _flush_now(self):
+        if not self._pending:
+            return
+        batch, self._pending = self._pending, []
+        if self._flusher is not None and not self._flusher.done():
+            self._flusher.cancel()
+        self._flusher = None
+        asyncio.ensure_future(self._run_batch(batch))
+
+    async def _run_batch(self, batch: list[tuple]):
+        args = [a for a, _ in batch]
+        try:
+            if self._self_arg is not None:
+                results = self._fn(self._self_arg, args)
+            else:
+                results = self._fn(args)
+            if inspect.isawaitable(results):
+                results = await results
+            if len(results) != len(args):
+                raise ValueError(
+                    f"batched function returned {len(results)} results "
+                    f"for a batch of {len(args)}"
+                )
+            for (_, fut), r in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(r)
+        except Exception as e:  # noqa: BLE001 - fan the error out
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(_fn=None, *, max_batch_size: int = 10, batch_wait_timeout_s: float = 0.01):
+    """Decorator for methods/functions taking a list of items.
+
+    The decorated callable is invoked with single items; the underlying
+    implementation receives a list and returns a same-length list.
+    """
+
+    def deco(fn):
+        attr = f"__serve_batch_queue_{fn.__name__}"
+
+        @functools.wraps(fn)
+        async def method_wrapper(self, arg):
+            q = getattr(self, attr, None)
+            if q is None:
+                q = _BatchQueue(fn, self, max_batch_size, batch_wait_timeout_s)
+                setattr(self, attr, q)
+            return await q.submit(arg)
+
+        @functools.wraps(fn)
+        async def func_wrapper(arg):
+            q = func_wrapper.__dict__.get("_queue")
+            if q is None:
+                q = _BatchQueue(fn, None, max_batch_size, batch_wait_timeout_s)
+                func_wrapper._queue = q
+            return await q.submit(arg)
+
+        params = list(inspect.signature(fn).parameters)
+        return method_wrapper if params and params[0] == "self" else func_wrapper
+
+    if _fn is not None:
+        return deco(_fn)
+    return deco

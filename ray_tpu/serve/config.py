@@ -1,0 +1,41 @@
+"""Deployment and autoscaling configuration.
+
+(reference: python/ray/serve/config.py AutoscalingConfig /
+DeploymentConfig; schema.py)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class AutoscalingConfig:
+    """Scale replicas to hold per-replica ongoing requests near target
+    (reference: serve/_private/autoscaling_state.py decision logic)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 5
+    autoscaling_config: AutoscalingConfig | None = None
+    ray_actor_options: dict = field(default_factory=dict)
+    user_config: dict | None = None
+
+    def to_dict(self) -> dict:
+        return {
+            "num_replicas": self.num_replicas,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "autoscaling": None
+            if self.autoscaling_config is None
+            else vars(self.autoscaling_config),
+            "ray_actor_options": dict(self.ray_actor_options),
+            "user_config": self.user_config,
+        }

@@ -1,0 +1,294 @@
+"""ServeController: the reconciliation brain of serve.
+
+(reference: python/ray/serve/_private/controller.py:106 ServeController —
+owns application/deployment target state, reconciles replica actors to
+target counts (deployment_state.py), restarts dead replicas, and applies
+autoscaling decisions from replica-reported queue lengths
+(autoscaling_state.py).)
+
+Runs as a detached named actor. Mutating RPCs are sync methods (the core
+worker executes them in arrival order, serializing state changes); the
+control loop is a long-lived async method running concurrently, which
+talks to replicas through the core worker's coroutine API directly (it
+cannot block the loop thread).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+from ray_tpu import api as core_api
+from ray_tpu.runtime.core_worker import ActorSubmitTarget
+from ray_tpu.serve.replica import ReplicaActor
+
+_CONTROL_PERIOD_S = 0.25
+
+
+class ServeController:
+    def __init__(self):
+        # (app_name, deployment_name) → deployment record
+        self._deployments: dict[tuple, dict] = {}
+        # app_name → {"ingress": str, "route_prefix": str, "deployments": [str]}
+        self._apps: dict[str, dict] = {}
+        # (app, dep) → {router_id: (demand, t)} — handle-reported queued +
+        # in-flight requests (reference: handles push queue metrics used
+        # by autoscaling_state.py; replica-side ongoing alone misses
+        # client-side queuing).
+        self._handle_demand: dict[tuple, dict] = {}
+        self._shutdown = False
+
+    # ------------------------------------------------------ deploy API
+    def deploy_application(self, app_name: str, spec: dict):
+        """spec: {"route_prefix", "ingress", "deployments": [
+        {"name", "callable", "init_args", "init_kwargs", "config"}]}"""
+        self._apps[app_name] = {
+            "ingress": spec["ingress"],
+            "route_prefix": spec.get("route_prefix", f"/{app_name}"),
+            "deployments": [d["name"] for d in spec["deployments"]],
+        }
+        for d in spec["deployments"]:
+            key = (app_name, d["name"])
+            cfg = d["config"]
+            auto = cfg.get("autoscaling")
+            target = (
+                auto["min_replicas"] if auto else cfg.get("num_replicas", 1)
+            )
+            old = self._deployments.get(key)
+            if old is not None and old["replicas"]:
+                # Redeploy replaces replicas all-at-once so new code /
+                # config actually takes effect (reference: deployment
+                # version change triggers replica restart,
+                # deployment_state.py).
+                asyncio.run_coroutine_threadsafe(
+                    self._drain_replicas(dict(old)), core_api._runtime.loop
+                )
+            now = time.monotonic()
+            self._deployments[key] = {
+                "name": d["name"],
+                "app": app_name,
+                "callable": d["callable"],
+                "init_args": d["init_args"],
+                "init_kwargs": d["init_kwargs"],
+                "config": cfg,
+                "target": target,
+                # replicas: list of dicts {actor_id, addr}
+                "replicas": [],
+                "version": (old["version"] + 1) if old else 0,
+                "last_scale_up": now,
+                "last_scale_down": now,
+                "status": "UPDATING",
+            }
+        return True
+
+    def delete_application(self, app_name: str):
+        """Blocks until replicas are torn down (sync actor methods run on
+        the executor thread, so waiting on the loop-side drain is safe)."""
+        app = self._apps.pop(app_name, None)
+        if app is None:
+            return False
+        drains = []
+        loop = core_api._runtime.loop
+        for name in app["deployments"]:
+            dep = self._deployments.pop((app_name, name), None)
+            self._handle_demand.pop((app_name, name), None)
+            if dep:
+                dep["target"] = 0
+                drains.append(
+                    asyncio.run_coroutine_threadsafe(
+                        self._drain_replicas(dep), loop
+                    )
+                )
+        for d in drains:
+            try:
+                d.result(timeout=10)
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        return True
+
+    async def _drain_replicas(self, dep: dict):
+        core = core_api._runtime.core
+        for r in list(dep["replicas"]):
+            try:
+                await core.kill_actor(r["actor_id"], r["addr"])
+            except Exception:  # noqa: BLE001 - already dead is fine
+                pass
+        dep["replicas"] = []
+
+    # ------------------------------------------------------- query API
+    def get_replicas(self, deployment_name: str, app_name: str):
+        dep = self._deployments.get((app_name, deployment_name))
+        if dep is None:
+            raise ValueError(
+                f"no deployment {deployment_name!r} in app {app_name!r}"
+            )
+        max_ongoing = dep["config"].get("max_ongoing_requests", 5)
+        return (
+            dep["version"],
+            [(r["actor_id"], r["addr"], max_ongoing) for r in dep["replicas"]],
+        )
+
+    def record_handle_demand(
+        self, deployment_name: str, app_name: str, router_id: str, demand: int
+    ):
+        self._handle_demand.setdefault((app_name, deployment_name), {})[
+            router_id
+        ] = (int(demand), time.monotonic())
+        return True
+
+    def get_route_table(self):
+        return {
+            app["route_prefix"]: (name, app["ingress"])
+            for name, app in self._apps.items()
+        }
+
+    def get_status(self):
+        out = {}
+        for (app, name), dep in self._deployments.items():
+            out.setdefault(app, {})[name] = {
+                "status": dep["status"],
+                "target": dep["target"],
+                "replicas": len(dep["replicas"]),
+            }
+        return out
+
+    def graceful_shutdown(self):
+        self._shutdown = True
+        for app in list(self._apps):
+            self.delete_application(app)
+        return True
+
+    # ---------------------------------------------------- control loop
+    async def run_control_loop(self):
+        """Reconcile forever (reference: ServeController.run_control_loop).
+        Runs as a concurrent async actor task; returns on shutdown."""
+        while not self._shutdown:
+            try:
+                await self._reconcile_once()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                pass
+            await asyncio.sleep(_CONTROL_PERIOD_S)
+        return True
+
+    async def _reconcile_once(self):
+        core = core_api._runtime.core
+        # Evict handle-demand entries from routers that stopped reporting.
+        now = time.monotonic()
+        for key, routers in list(self._handle_demand.items()):
+            for rid, (_d, t) in list(routers.items()):
+                if now - t > 10.0:
+                    del routers[rid]
+            if not routers:
+                del self._handle_demand[key]
+        for dep in list(self._deployments.values()):
+            # 1. Health-check replicas: poll stats, drop the dead.
+            stats = await self._poll_stats(core, dep)
+            # 2. Autoscale: move target toward ongoing/target ratio.
+            auto = dep["config"].get("autoscaling")
+            if auto is not None and stats is not None:
+                self._autoscale(dep, auto, stats)
+            # 3. Reconcile count toward target.
+            while len(dep["replicas"]) < dep["target"]:
+                await self._start_replica(core, dep)
+            excess = len(dep["replicas"]) - dep["target"]
+            if excess > 0:
+                victims = dep["replicas"][-excess:]
+                dep["replicas"] = dep["replicas"][:-excess]
+                dep["version"] += 1
+                for r in victims:
+                    try:
+                        await core.kill_actor(r["actor_id"], r["addr"])
+                    except Exception:  # noqa: BLE001
+                        pass
+            dep["status"] = (
+                "HEALTHY"
+                if len(dep["replicas"]) == dep["target"]
+                else "UPDATING"
+            )
+
+    async def _poll_stats(self, core, dep: dict):
+        if not dep["replicas"]:
+            return {"num_ongoing_requests": 0}
+
+        async def poll_one(r):
+            refs = await core.submit_task(
+                "get_stats",
+                (),
+                {},
+                num_returns=1,
+                actor=ActorSubmitTarget(r["actor_id"], r["addr"]),
+            )
+            return (await core.get(refs, timeout=2))[0]
+
+        # Concurrent polls: one hung replica must not stall the control
+        # loop for every other deployment.
+        results = await asyncio.gather(
+            *(poll_one(r) for r in dep["replicas"]), return_exceptions=True
+        )
+        total_ongoing = 0
+        dead = []
+        for r, s in zip(list(dep["replicas"]), results):
+            if isinstance(s, BaseException):
+                dead.append(r)
+            else:
+                total_ongoing += s["num_ongoing_requests"]
+        if dead:
+            dep["replicas"] = [r for r in dep["replicas"] if r not in dead]
+            dep["version"] += 1
+        return {"num_ongoing_requests": total_ongoing}
+
+    def _autoscale(self, dep: dict, auto: dict, stats: dict):
+        now = time.monotonic()
+        reported = self._handle_demand.get((dep["app"], dep["name"]), {})
+        handle_demand = sum(
+            d for d, t in reported.values() if now - t < 2.0
+        )
+        ongoing = max(stats["num_ongoing_requests"], handle_demand)
+        desired = max(
+            auto["min_replicas"],
+            min(
+                auto["max_replicas"],
+                -(-ongoing // max(auto["target_ongoing_requests"], 1e-9))
+                if ongoing
+                else auto["min_replicas"],
+            ),
+        )
+        desired = int(desired)
+        if desired > dep["target"]:
+            if now - dep["last_scale_up"] >= auto.get("upscale_delay_s", 0):
+                dep["target"] = desired
+                dep["last_scale_up"] = now
+        elif desired < dep["target"]:
+            if now - dep["last_scale_down"] >= auto.get(
+                "downscale_delay_s", 2.0
+            ):
+                dep["target"] = desired
+                dep["last_scale_down"] = now
+        else:
+            dep["last_scale_down"] = now
+
+    async def _start_replica(self, core, dep: dict):
+        cfg = dep["config"]
+        actor_opts = cfg.get("ray_actor_options", {})
+        resources = dict(actor_opts.get("resources", {}))
+        if "num_cpus" in actor_opts:
+            resources["CPU"] = float(actor_opts["num_cpus"])
+        if "num_tpus" in actor_opts:
+            resources["TPU"] = float(actor_opts["num_tpus"])
+        actor_id, addr = await core.create_actor(
+            ReplicaActor,
+            (
+                dep["name"],
+                dep["callable"],
+                dep["init_args"],
+                dep["init_kwargs"],
+                cfg.get("user_config"),
+            ),
+            {},
+            resources=resources or {"CPU": 0.1},
+            max_concurrency=max(
+                2 * cfg.get("max_ongoing_requests", 5), 16
+            ),
+        )
+        dep["replicas"].append({"actor_id": actor_id, "addr": addr})
+        dep["version"] += 1

@@ -1,0 +1,332 @@
+"""DeploymentHandle: the client-side router to a deployment's replicas.
+
+(reference: python/ray/serve/handle.py:757 DeploymentHandle →
+_private/router.py AsyncioRouter with power-of-two-choices replica
+picking over queue-length caps, request_router/; replica membership is
+pushed by long-poll in the reference — here the router polls the
+controller's versioned replica list and refreshes on miss/death.)
+
+All routing state lives on the runtime event loop, so in-flight counts
+need no locks. ``remote()`` works from sync code (driver threads, the
+HTTP proxy) and from async code running on the runtime loop (other
+replicas, the controller).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+import uuid
+from dataclasses import dataclass
+
+from ray_tpu import api as core_api
+from ray_tpu.runtime.core_worker import ActorSubmitTarget
+
+CONTROLLER_NAME = "_SERVE_CONTROLLER"
+_REFRESH_S = 2.0
+
+
+@dataclass
+class _ReplicaTarget:
+    actor_id: str
+    addr: str
+    max_ongoing: int
+
+
+class DeploymentResponse:
+    """Future-like result of a handle call (reference: handle.py
+    DeploymentResponse). ``result()`` from sync code; ``await`` from
+    async code on the runtime loop."""
+
+    def __init__(self, inner, sync: bool):
+        self._inner = inner  # concurrent.futures.Future | asyncio.Task
+        self._sync = sync
+
+    def result(self, timeout: float | None = None):
+        if not self._sync:
+            raise RuntimeError(
+                "result() would deadlock on the runtime loop; use "
+                "`await response` in async code"
+            )
+        return self._inner.result(timeout)
+
+    def __await__(self):
+        if self._sync:
+            # Bridge a concurrent future into the awaiting loop.
+            return asyncio.wrap_future(self._inner).__await__()
+        return self._inner.__await__()
+
+
+class _Router:
+    def __init__(self, deployment_name: str, app_name: str):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._controller: ActorSubmitTarget | None = None
+        self._replicas: list[_ReplicaTarget] = []
+        self._version = -1
+        self._last_refresh = 0.0
+        self._inflight: dict[str, int] = {}
+        # Requests waiting for a replica slot; reported to the controller
+        # as autoscaling demand (reference: handles push queued-request
+        # metrics to the controller, serve/_private/router.py).
+        self._queued = 0
+        self._reporter: asyncio.Task | None = None
+
+    def _demand(self) -> int:
+        return self._queued + sum(self._inflight.values())
+
+    def _ensure_reporter(self):
+        if self._reporter is None or self._reporter.done():
+            self._reporter = asyncio.ensure_future(self._report_loop())
+
+    async def _report_loop(self):
+        """Report demand while there is any; exit after a short idle
+        period (a final 0 report) so dropped handles don't leak an
+        eternal task + RPC stream."""
+        router_id = f"{id(self):x}"
+        idle_since = None
+        try:
+            while True:
+                demand = self._demand()
+                controller = await self._resolve_controller()
+                await self._call_actor(
+                    controller,
+                    "record_handle_demand",
+                    self.deployment_name,
+                    self.app_name,
+                    router_id,
+                    demand,
+                )
+                if demand == 0:
+                    if idle_since is None:
+                        idle_since = time.monotonic()
+                    elif time.monotonic() - idle_since > 3.0:
+                        return
+                else:
+                    idle_since = None
+                await asyncio.sleep(0.3)
+        except Exception:  # noqa: BLE001 - controller gone; stop quietly
+            pass
+
+    async def _core(self):
+        core = core_api._runtime.core
+        if core is None:
+            raise RuntimeError("ray_tpu.init() has not been called")
+        return core
+
+    async def _resolve_controller(self):
+        if self._controller is None:
+            core = await self._core()
+            reply = await core.head.call("get_actor", name=CONTROLLER_NAME)
+            if not reply["ok"]:
+                raise RuntimeError(
+                    "serve controller is not running (serve.run first)"
+                )
+            self._controller = ActorSubmitTarget(
+                reply["actor_id"], reply["addr"]
+            )
+        return self._controller
+
+    async def _call_actor(self, target: ActorSubmitTarget, method, *args):
+        core = await self._core()
+        refs = await core.submit_task(
+            method, args, {}, num_returns=1, actor=target
+        )
+        values = await core.get(refs)
+        return values[0]
+
+    async def _refresh(self, force: bool = False):
+        # Forced refreshes (saturation, replica death) are still rate
+        # limited so N queued requests don't hammer the controller with
+        # N/20ms get_replicas calls exactly when the system is loaded.
+        now = time.monotonic()
+        min_interval = 0.1 if force else _REFRESH_S
+        if now - self._last_refresh < min_interval:
+            return
+        controller = await self._resolve_controller()
+        version, replicas = await self._call_actor(
+            controller, "get_replicas", self.deployment_name, self.app_name
+        )
+        self._last_refresh = time.monotonic()
+        if version != self._version:
+            self._version = version
+            self._replicas = [_ReplicaTarget(*r) for r in replicas]
+            self._inflight = {
+                r.actor_id: self._inflight.get(r.actor_id, 0)
+                for r in self._replicas
+            }
+
+    def _pick(self, model_id: str) -> _ReplicaTarget | None:
+        avail = [
+            r
+            for r in self._replicas
+            if self._inflight.get(r.actor_id, 0) < r.max_ongoing
+        ]
+        if not avail:
+            return None
+        if model_id:
+            # Hash-affinity for multiplexed models: keep a model's
+            # requests on a stable replica so its LRU cache stays warm
+            # (reference approximates this with cache-locality routing,
+            # multiplex.py); spill to power-of-two when saturated.
+            ordered = sorted(
+                self._replicas, key=lambda r: hash((model_id, r.actor_id))
+            )
+            for r in ordered:
+                if self._inflight.get(r.actor_id, 0) < r.max_ongoing:
+                    return r
+            return None
+        if len(avail) == 1:
+            return avail[0]
+        a, b = random.sample(avail, 2)
+        return (
+            a
+            if self._inflight.get(a.actor_id, 0)
+            <= self._inflight.get(b.actor_id, 0)
+            else b
+        )
+
+    async def _acquire_replica(self, model_id: str) -> _ReplicaTarget:
+        waiting = False
+        try:
+            while True:
+                await self._refresh()
+                replica = self._pick(model_id)
+                if replica is not None:
+                    return replica
+                if not waiting:
+                    waiting = True
+                    self._queued += 1
+                await self._refresh(force=True)
+                await asyncio.sleep(0.02)
+        finally:
+            if waiting:
+                self._queued -= 1
+
+    async def route_and_call(
+        self, method_name: str, args: tuple, kwargs: dict, model_id: str = ""
+    ):
+        # Resolve composed-handle responses passed as arguments.
+        args = tuple(
+            [await a if isinstance(a, DeploymentResponse) else a for a in args]
+        )
+        ctx = {
+            "request_id": uuid.uuid4().hex[:16],
+            "multiplexed_model_id": model_id,
+            "app_name": self.app_name,
+        }
+        self._ensure_reporter()
+        deaths = 0
+        while True:
+            replica = await self._acquire_replica(model_id)
+            self._inflight[replica.actor_id] = (
+                self._inflight.get(replica.actor_id, 0) + 1
+            )
+            try:
+                return await self._call_actor(
+                    ActorSubmitTarget(replica.actor_id, replica.addr),
+                    "handle_request",
+                    method_name,
+                    args,
+                    kwargs,
+                    ctx,
+                )
+            except Exception as e:  # noqa: BLE001
+                from ray_tpu.exceptions import ActorDiedError
+                from ray_tpu._private import rpc
+
+                if isinstance(
+                    e, (ActorDiedError, rpc.ConnectionLost, rpc.RpcError)
+                ) and deaths < 3:
+                    # Replica died mid-request: drop it and re-route.
+                    deaths += 1
+                    self._replicas = [
+                        r
+                        for r in self._replicas
+                        if r.actor_id != replica.actor_id
+                    ]
+                    await self._refresh(force=True)
+                    continue
+                raise
+            finally:
+                if replica.actor_id in self._inflight:
+                    self._inflight[replica.actor_id] -= 1
+
+
+class DeploymentHandle:
+    """Serializable, lazy handle: resolves the controller and replica
+    set on first call, so it can be shipped into replicas for model
+    composition (reference: handles injected for `.bind()` children)."""
+
+    def __init__(
+        self,
+        deployment_name: str,
+        app_name: str = "default",
+        method_name: str = "__call__",
+        multiplexed_model_id: str = "",
+    ):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._model_id = multiplexed_model_id
+        self._router: _Router | None = None
+
+    def __reduce__(self):
+        return (
+            DeploymentHandle,
+            (
+                self.deployment_name,
+                self.app_name,
+                self._method_name,
+                self._model_id,
+            ),
+        )
+
+    def options(
+        self,
+        *,
+        method_name: str | None = None,
+        multiplexed_model_id: str | None = None,
+    ) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name,
+            self.app_name,
+            method_name or self._method_name,
+            self._model_id
+            if multiplexed_model_id is None
+            else multiplexed_model_id,
+        )
+        h._router = self._router  # share routing state across options()
+        return h
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def _get_router(self) -> _Router:
+        if self._router is None:
+            self._router = _Router(self.deployment_name, self.app_name)
+        return self._router
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = self._get_router()
+        coro = router.route_and_call(
+            self._method_name, args, kwargs, self._model_id
+        )
+        loop = core_api._runtime.loop
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            return DeploymentResponse(asyncio.ensure_future(coro), sync=False)
+        fut = asyncio.run_coroutine_threadsafe(coro, loop)
+        return DeploymentResponse(fut, sync=True)
+
+    def __repr__(self):
+        return (
+            f"DeploymentHandle({self.app_name}/{self.deployment_name}"
+            f".{self._method_name})"
+        )

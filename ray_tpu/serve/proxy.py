@@ -1,0 +1,118 @@
+"""HTTP proxy actor: routes HTTP requests to application ingress handles.
+
+(reference: python/ray/serve/_private/proxy.py HTTPProxy :710 — uvicorn/
+starlette there; here a stdlib ThreadingHTTPServer inside the proxy
+actor. Handler threads use the sync DeploymentHandle path, which is safe
+off the runtime loop.)
+
+Request mapping: the ingress deployment is called with a single dict
+argument {"method", "path", "query", "body"} where body is parsed JSON
+when the content type (or payload) is JSON, else raw bytes. A str/bytes
+return value is sent verbatim; anything else is JSON-encoded.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import ray_tpu
+from ray_tpu.serve.handle import CONTROLLER_NAME, DeploymentHandle
+
+_ROUTE_TTL_S = 2.0
+
+
+class ProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes: dict[str, tuple] = {}  # prefix → (app, ingress)
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._routes_ts = 0.0
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _serve(self, body: bytes | None):
+                try:
+                    status, payload = proxy._dispatch(
+                        self.command, self.path, body
+                    )
+                except Exception as e:  # noqa: BLE001
+                    status, payload = 500, str(e).encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802 (stdlib API)
+                self._serve(None)
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0))
+                self._serve(self.rfile.read(n) if n else b"")
+
+            do_PUT = do_POST  # noqa: N815
+            do_DELETE = do_GET  # noqa: N815
+
+            def log_message(self, *a):  # silence per-request stderr
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def get_port(self) -> int:
+        return self._server.server_address[1]
+
+    def _refresh_routes(self):
+        now = time.monotonic()
+        if now - self._routes_ts < _ROUTE_TTL_S and self._routes:
+            return
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        self._routes = ray_tpu.get(controller.get_route_table.remote())
+        self._routes_ts = time.monotonic()
+
+    def _dispatch(self, method: str, path: str, body: bytes | None):
+        self._refresh_routes()
+        parsed = urllib.parse.urlparse(path)
+        route = parsed.path
+        match = None
+        for prefix in sorted(self._routes, key=len, reverse=True):
+            if route == prefix or route.startswith(
+                prefix.rstrip("/") + "/"
+            ) or prefix == "/":
+                match = prefix
+                break
+        if match is None:
+            return 404, b"no route"
+        app_name, ingress = self._routes[match]
+        handle = self._handles.get(app_name)
+        if handle is None or handle.deployment_name != ingress:
+            handle = DeploymentHandle(ingress, app_name)
+            self._handles[app_name] = handle
+
+        payload: object = body
+        if body:
+            try:
+                payload = json.loads(body)
+            except ValueError:
+                payload = body
+        request = {
+            "method": method,
+            "path": route,
+            "query": dict(urllib.parse.parse_qsl(parsed.query)),
+            "body": payload,
+        }
+        result = handle.remote(request).result(timeout=60)
+        if isinstance(result, bytes):
+            return 200, result
+        if isinstance(result, str):
+            return 200, result.encode()
+        return 200, json.dumps(result).encode()
+
+    def shutdown(self):
+        self._server.shutdown()
+        return True

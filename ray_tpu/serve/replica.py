@@ -1,0 +1,91 @@
+"""Replica actor: hosts one copy of the user's deployment callable.
+
+(reference: python/ray/serve/_private/replica.py:1139 `Replica` — wraps
+the user callable, tracks ongoing requests for autoscaling stats, applies
+user_config reconfiguration.)
+
+Requests arrive as concurrent async actor calls (``handle_request`` is a
+coroutine, so the core worker runs them out-of-order under
+max_concurrency) — the replica itself enforces no queue; admission is the
+router's job via in-flight caps.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+
+from ray_tpu.serve.context import RequestContext, set_request_context
+
+
+class ReplicaActor:
+    def __init__(
+        self,
+        deployment_name: str,
+        user_callable,  # class or function (cloudpickled by the runtime)
+        init_args: tuple,
+        init_kwargs: dict,
+        user_config=None,
+    ):
+        self.deployment_name = deployment_name
+        self._num_ongoing = 0
+        self._num_served = 0
+        if isinstance(user_callable, type):
+            self._callable = user_callable(*init_args, **init_kwargs)
+        else:
+            self._callable = user_callable
+        if user_config is not None:
+            self._reconfigure(user_config)
+
+    def _reconfigure(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is None:
+            raise ValueError(
+                f"deployment {self.deployment_name} got user_config but "
+                "defines no reconfigure() method"
+            )
+        fn(user_config)
+
+    def reconfigure(self, user_config):
+        self._reconfigure(user_config)
+        return True
+
+    async def handle_request(
+        self,
+        method_name: str,
+        request_args: tuple,
+        request_kwargs: dict,
+        request_context: dict | None = None,
+    ):
+        self._num_ongoing += 1
+        try:
+            set_request_context(RequestContext(**(request_context or {})))
+            if inspect.isfunction(self._callable):
+                fn = self._callable  # function deployment
+            else:
+                fn = getattr(self._callable, method_name)
+            if inspect.iscoroutinefunction(fn):
+                return await fn(*request_args, **request_kwargs)
+            # Run sync user code off the event loop, propagating the
+            # request contextvars into the executor thread.
+            ctx = contextvars.copy_context()
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                None, lambda: ctx.run(fn, *request_args, **request_kwargs)
+            )
+        finally:
+            self._num_ongoing -= 1
+            self._num_served += 1
+
+    def get_stats(self) -> dict:
+        return {
+            "num_ongoing_requests": self._num_ongoing,
+            "num_served": self._num_served,
+        }
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True

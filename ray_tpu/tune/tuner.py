@@ -136,12 +136,13 @@ class _TuneController:
         self._exhausted = False
 
     def _new_trial(self) -> Trial | None:
-        config = self.searcher.suggest(f"t{self._next_id}")
+        trial_id = f"t{self._next_id:04d}"
+        config = self.searcher.suggest(trial_id)
         if config is None:
             self._exhausted = True
             return None
         trial = Trial(
-            f"t{self._next_id:04d}", config,
+            trial_id, config,
             os.path.join(self.exp_dir, f"trial_{self._next_id:04d}"),
         )
         self._next_id += 1
@@ -162,6 +163,14 @@ class _TuneController:
     def _finish(self, trial: Trial, status: str, error: str | None = None):
         trial.status = status
         trial.error = error
+        # Feed the searcher so adaptive algorithms learn from outcomes
+        # (reference: SearchAlgorithm.on_trial_complete, tune/search/).
+        try:
+            self.searcher.on_trial_complete(
+                trial.trial_id, trial.last_result if error is None else None
+            )
+        except Exception:  # noqa: BLE001 - searcher bugs must not kill the run
+            pass
         if trial.actor is not None:
             try:
                 if trial.is_class_api:

@@ -1,0 +1,47 @@
+"""TPU-native RL library (RLlib equivalent).
+
+Architecture mirrors the reference's split (reference:
+rllib/algorithms/algorithm.py:212, rllib/env/env_runner_group.py:70,
+rllib/core/learner/learner_group.py:101, rllib/core/rl_module/):
+
+- :class:`RLModule` — the neural net, a pure-JAX (init, forward) pair.
+- :class:`EnvRunnerGroup` — CPU rollout actors stepping vectorized envs.
+- :class:`Learner`/`LearnerGroup` — one pjit'd update program over the
+  device mesh (data-parallel across chips) instead of the reference's
+  DDP-across-learner-actors.
+- :class:`Algorithm` — the driver loop: sample → learn → broadcast.
+"""
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.dqn import DQN, DQNConfig
+from ray_tpu.rl.env import CartPole, Env, make_env, register_env
+from ray_tpu.rl.env_runner import EnvRunnerGroup
+from ray_tpu.rl.module import MLPModule, RLModule
+from ray_tpu.rl.ppo import PPO, PPOConfig
+from ray_tpu.rl.replay import ReplayBuffer
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPole",
+    "DQN",
+    "DQNConfig",
+    "Env",
+    "EnvRunnerGroup",
+    "Learner",
+    "MLPModule",
+    "PPO",
+    "PPOConfig",
+    "RLModule",
+    "ReplayBuffer",
+    "make_env",
+    "register_env",
+]
+
+
+def __getattr__(name):
+    if name == "Learner":
+        from ray_tpu.rl.learner import Learner
+
+        return Learner
+    raise AttributeError(f"module 'ray_tpu.rl' has no attribute {name!r}")

@@ -1,0 +1,144 @@
+"""Algorithm: the driver-side train loop (sample → learn → broadcast).
+
+Reference: rllib/algorithms/algorithm.py:212 (`step` :1191 /
+`training_step` :2301) — config object builds the algorithm, `train()`
+runs one iteration and returns a metrics dict, checkpoints via
+save/restore. Here the learner is a mesh-sharded jit program in the driver
+process (the TPU owner) and sampling fans out over EnvRunner actors.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import numpy as np
+import optax
+
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.env_runner import EnvRunnerGroup
+from ray_tpu.rl.module import MLPModule, RLModule
+
+
+@dataclass(frozen=True)
+class AlgorithmConfig:
+    """Builder-style config (reference: AlgorithmConfig.environment()/
+    .env_runners()/.training() chains; here a frozen dataclass with
+    replace())."""
+
+    env: str = "CartPole"
+    env_kwargs: dict = field(default_factory=dict)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 4
+    rollout_len: int = 64
+    lr: float = 3e-4
+    gamma: float = 0.99
+    hidden: tuple = (64, 64)
+    seed: int = 0
+    mesh: Any = None  # jax.sharding.Mesh with a 'dp' axis, or None
+
+    def copy(self, **kwargs) -> "AlgorithmConfig":
+        return replace(self, **kwargs)
+
+    def build(self) -> "Algorithm":
+        raise NotImplementedError
+
+
+class Algorithm:
+    """Base: holds module, learner, runner group; subclass implements
+    training_step()."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        probe = make_env(config.env, **config.env_kwargs)
+        self.module = self._make_module(probe)
+        self.learner = self._make_learner()
+        self.runners = EnvRunnerGroup(
+            config.env,
+            self.module,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            rollout_len=config.rollout_len,
+            env_kwargs=config.env_kwargs,
+            seed=config.seed,
+        )
+        self.runners.set_weights(self.learner.get_weights())
+        self.iteration = 0
+        self._return_window: list[float] = []
+
+    # -- subclass hooks ----------------------------------------------------
+    def _make_module(self, probe_env) -> RLModule:
+        return MLPModule(
+            observation_size=probe_env.observation_size,
+            num_actions=probe_env.num_actions,
+            hidden=self.config.hidden,
+        )
+
+    def _make_learner(self):
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------
+    def train(self) -> dict:
+        metrics = self.training_step()
+        self.iteration += 1
+        window = self._return_window[-100:]
+        metrics.update(
+            training_iteration=self.iteration,
+            episode_return_mean=float(np.mean(window)) if window else float("nan"),
+            episodes_total=len(self._return_window),
+        )
+        return metrics
+
+    def _record_episodes(self, samples: list[dict]) -> None:
+        for s in samples:
+            self._return_window.extend(s["episode_returns"])
+
+    def stop(self) -> None:
+        """Kill rollout actors and release their resources (reference:
+        Algorithm.stop / EnvRunnerGroup.stop)."""
+        import ray_tpu
+
+        for r in self.runners.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm.pkl"), "wb") as f:
+            pickle.dump(
+                {
+                    "weights": self.learner.get_weights(),
+                    "iteration": self.iteration,
+                    "config": self.config,
+                },
+                f,
+            )
+        return path
+
+    def restore(self, path: str) -> None:
+        with open(os.path.join(path, "algorithm.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner.set_weights(state["weights"])
+        self.iteration = state["iteration"]
+        self.runners.set_weights(self.learner.get_weights())
+
+    def get_policy_weights(self) -> Any:
+        return self.learner.get_weights()
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Greedy action for a batch of observations (serving path)."""
+        import jax.numpy as jnp
+
+        out = self.module.forward(self.learner.params, jnp.asarray(obs))
+        return np.asarray(out["logits"].argmax(-1))
+
+
+def make_adam(lr: float, grad_clip: float = 0.5) -> optax.GradientTransformation:
+    return optax.chain(optax.clip_by_global_norm(grad_clip), optax.adam(lr))

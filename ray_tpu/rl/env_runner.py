@@ -1,0 +1,144 @@
+"""EnvRunner actors: CPU rollout workers sampling trajectories.
+
+Mirrors the reference's EnvRunnerGroup of remote workers (reference:
+rllib/env/env_runner_group.py:70, single_agent_env_runner.py): each runner
+actor holds a vector of envs plus a CPU copy of the module params, samples
+fixed-length rollouts, and returns flat numpy batches. Inference inside the
+runner is jitted on the CPU backend — rollouts never touch the TPU, which
+stays dedicated to the learner (SURVEY.md §7 stage 8: "TPU learner group +
+CPU rollout env runners").
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import make_env
+from ray_tpu.rl.module import RLModule
+
+
+class EnvRunner:
+    """Steps `num_envs` env copies for `rollout_len` steps per sample() call."""
+
+    def __init__(
+        self,
+        env_name: str,
+        env_kwargs: dict,
+        module: RLModule,
+        num_envs: int,
+        rollout_len: int,
+        seed: int,
+    ):
+        import jax
+
+        self._jax = jax
+        self.module = module
+        self.rollout_len = rollout_len
+        self.envs = [make_env(env_name, **env_kwargs) for _ in range(num_envs)]
+        self.obs = np.stack([e.reset(seed + i) for i, e in enumerate(self.envs)])
+        self.params = None
+        self._rng = np.random.default_rng(seed)
+        self._episode_returns = np.zeros(num_envs)
+        self._completed: list[float] = []
+        self._fwd = jax.jit(module.forward, backend="cpu")
+
+    def set_weights(self, params: Any) -> None:
+        self.params = params
+
+    def sample(self, epsilon: float = 0.0) -> dict:
+        """Collect [T, N, ...] batches; also returns logp/value for PPO."""
+        T, N = self.rollout_len, len(self.envs)
+        obs_buf = np.zeros((T, N, self.envs[0].observation_size), np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            out = self._fwd(self.params, self.obs)
+            logits = np.asarray(out["logits"])
+            values = np.asarray(out["value"])
+            # Sample from the categorical policy (Gumbel trick), with
+            # optional epsilon-greedy override for DQN-style exploration.
+            noise = self._rng.gumbel(size=logits.shape)
+            actions = np.argmax(logits + noise, axis=-1)
+            if epsilon > 0.0:
+                randomize = self._rng.random(N) < epsilon
+                actions = np.where(
+                    randomize,
+                    self._rng.integers(0, self.envs[0].num_actions, N),
+                    actions,
+                )
+            logp = logits - _logsumexp(logits)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            val_buf[t] = values
+            logp_buf[t] = logp[np.arange(N), actions]
+            for i, env in enumerate(self.envs):
+                nobs, r, done = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                done_buf[t, i] = float(done)
+                self._episode_returns[i] += r
+                if done:
+                    self._completed.append(self._episode_returns[i])
+                    self._episode_returns[i] = 0.0
+                    nobs = env.reset()
+                self.obs[i] = nobs
+
+        # Bootstrap value for the state after the last step (PPO GAE).
+        last_val = np.asarray(self._fwd(self.params, self.obs)["value"])
+        completed, self._completed = self._completed, []
+        return {
+            "obs": obs_buf,
+            "actions": act_buf,
+            "rewards": rew_buf,
+            "dones": done_buf,
+            "logp": logp_buf,
+            "values": val_buf,
+            "last_value": last_val,
+            "next_obs": self.obs.copy(),
+            "episode_returns": completed,
+        }
+
+
+def _logsumexp(x: np.ndarray) -> np.ndarray:
+    m = x.max(-1, keepdims=True)
+    return m + np.log(np.exp(x - m).sum(-1, keepdims=True))
+
+
+class EnvRunnerGroup:
+    """Fan-out over EnvRunner actors (reference: EnvRunnerGroup.foreach_worker)."""
+
+    def __init__(
+        self,
+        env_name: str,
+        module: RLModule,
+        *,
+        num_runners: int = 2,
+        num_envs_per_runner: int = 4,
+        rollout_len: int = 64,
+        env_kwargs: dict | None = None,
+        seed: int = 0,
+    ):
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            runner_cls.remote(
+                env_name,
+                env_kwargs or {},
+                module,
+                num_envs_per_runner,
+                rollout_len,
+                seed + 1000 * i,
+            )
+            for i in range(num_runners)
+        ]
+
+    def set_weights(self, params) -> None:
+        ray_tpu.get([r.set_weights.remote(params) for r in self.runners])
+
+    def sample(self, epsilon: float = 0.0) -> list[dict]:
+        return ray_tpu.get([r.sample.remote(epsilon) for r in self.runners])

@@ -1,0 +1,83 @@
+"""RLModule: the policy/value network as a pure-JAX (init, forward) pair.
+
+The reference's RLModule (reference: rllib/core/rl_module/rl_module.py) is
+a torch nn.Module with forward_inference/forward_train methods; here the
+module is functional — params are an explicit pytree so the same weights
+move freely between CPU rollout actors (numpy) and the TPU learner
+(sharded jax.Arrays) without framework glue.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any  # pytree of arrays
+
+
+@dataclass(frozen=True)
+class RLModule:
+    """Base: subclasses define init(key) and forward(params, obs)."""
+
+    observation_size: int
+    num_actions: int
+
+    def init(self, key: jax.Array) -> Params:
+        raise NotImplementedError
+
+    def forward(self, params: Params, obs: jnp.ndarray) -> dict:
+        """obs [B, obs_size] -> {"logits": [B, A], "value": [B]}."""
+        raise NotImplementedError
+
+
+def _dense_init(key, n_in, n_out, scale=None):
+    if scale is None:
+        scale = float(np.sqrt(2.0 / n_in))
+    w = jax.random.normal(key, (n_in, n_out), jnp.float32) * scale
+    return {"w": w, "b": jnp.zeros((n_out,), jnp.float32)}
+
+
+def _dense(p, x):
+    return x @ p["w"] + p["b"]
+
+
+@dataclass(frozen=True)
+class MLPModule(RLModule):
+    """Shared-trunk MLP with policy and value heads (the reference's default
+    fcnet, rllib/core/models/configs.py MLPHeadConfig)."""
+
+    hidden: tuple = (64, 64)
+    dueling: bool = False  # DQN dueling heads: value + advantage
+
+    def init(self, key: jax.Array) -> Params:
+        keys = jax.random.split(key, len(self.hidden) + 3)
+        trunk = []
+        n_in = self.observation_size
+        for i, h in enumerate(self.hidden):
+            trunk.append(_dense_init(keys[i], n_in, h))
+            n_in = h
+        return {
+            "trunk": trunk,
+            "policy": _dense_init(keys[-2], n_in, self.num_actions, scale=0.01),
+            "value": _dense_init(keys[-1], n_in, 1, scale=1.0),
+        }
+
+    def forward(self, params: Params, obs: jnp.ndarray) -> dict:
+        x = obs.astype(jnp.float32)
+        for layer in params["trunk"]:
+            x = jnp.tanh(_dense(layer, x))
+        logits = _dense(params["policy"], x)
+        value = _dense(params["value"], x)[..., 0]
+        if self.dueling:
+            # logits are advantages; combine with state value (dueling DQN).
+            logits = value[..., None] + logits - logits.mean(-1, keepdims=True)
+        return {"logits": logits, "value": value}
+
+
+def params_to_numpy(params: Params) -> Params:
+    """Device → host copy for shipping weights to CPU rollout actors."""
+    return jax.tree.map(lambda a: np.asarray(a), params)

@@ -1,0 +1,155 @@
+"""RL library tests: envs, replay, GAE, PPO/DQN training on a real cluster.
+
+Modeled on the reference's fast-suite pattern (reference:
+rllib/algorithms/tests/test_algorithm.py, toy envs in rllib/examples) —
+tiny nets, few iterations, assert mechanics + learning signal on a
+trivially learnable env.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import (
+    CartPole,
+    DQNConfig,
+    PPOConfig,
+    ReplayBuffer,
+    make_env,
+)
+from ray_tpu.rl.ppo import compute_gae
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_cartpole_dynamics():
+    env = CartPole(seed=0)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0.0
+    done = False
+    while not done:
+        obs, r, done = env.step(1)  # constant push falls over quickly
+        total += r
+    assert 1 <= total < 500
+
+
+def test_env_registry():
+    env = make_env("Chain", n=5)
+    obs = env.reset()
+    assert obs.argmax() == 0
+    for _ in range(4):
+        obs, r, done = env.step(1)
+    assert done and r == 1.0
+
+
+def test_replay_buffer_wraps():
+    buf = ReplayBuffer(capacity=10, observation_size=3)
+    for i in range(4):
+        n = 4
+        buf.add_batch(
+            np.full((n, 3), i, np.float32),
+            np.zeros(n, np.int64),
+            np.ones(n, np.float32),
+            np.zeros(n, np.float32),
+            np.zeros((n, 3), np.float32),
+        )
+    assert len(buf) == 10
+    batch = buf.sample(8)
+    assert batch["obs"].shape == (8, 3)
+
+
+def test_gae_matches_manual():
+    # Single env, 3 steps, no terminations: check recursion by hand.
+    r = np.array([[1.0], [1.0], [1.0]], np.float32)
+    v = np.array([[0.5], [0.5], [0.5]], np.float32)
+    d = np.zeros((3, 1), np.float32)
+    last = np.array([0.5], np.float32)
+    adv, ret = compute_gae(r, v, d, last, gamma=1.0, lam=1.0)
+    # delta_t = 1 + v_{t+1} - v_t = 1; adv_t = sum of remaining deltas
+    np.testing.assert_allclose(adv[:, 0], [3.0, 2.0, 1.0])
+    np.testing.assert_allclose(ret, adv + v)
+
+
+def test_ppo_learns_chain(cluster):
+    cfg = PPOConfig(
+        env="Chain",
+        env_kwargs={"n": 6},
+        num_env_runners=2,
+        num_envs_per_runner=4,
+        rollout_len=32,
+        hidden=(32,),
+        lr=3e-3,
+        seed=0,
+    )
+    algo = cfg.build()
+    try:
+        first = algo.train()
+        assert np.isfinite(first["loss"])
+        for _ in range(14):
+            result = algo.train()
+        # The optimal policy reaches the chain end every 5 steps → mean
+        # return near 1.0 per episode; random policy rarely finishes.
+        assert result["episode_return_mean"] > 0.5
+        assert result["training_iteration"] == 15
+
+        # Greedy policy walks right from the start state.
+        obs = np.zeros((1, 6), np.float32)
+        obs[0, 0] = 1.0
+        assert algo.compute_actions(obs)[0] == 1
+    finally:
+        algo.stop()
+
+
+def test_ppo_checkpoint_roundtrip(cluster, tmp_path):
+    cfg = PPOConfig(
+        env="Chain", env_kwargs={"n": 4}, num_env_runners=1,
+        num_envs_per_runner=2, rollout_len=8, hidden=(16,), seed=1,
+    )
+    algo = cfg.build()
+    algo2 = None
+    try:
+        algo.train()
+        path = algo.save(str(tmp_path / "ckpt"))
+
+        algo2 = cfg.build()
+        algo2.restore(path)
+        assert algo2.iteration == 1
+        w1 = algo.get_policy_weights()
+        w2 = algo2.get_policy_weights()
+        np.testing.assert_allclose(
+            w1["policy"]["w"], w2["policy"]["w"], rtol=1e-6
+        )
+    finally:
+        algo.stop()
+        if algo2 is not None:
+            algo2.stop()
+
+
+def test_dqn_trains(cluster):
+    cfg = DQNConfig(
+        env="Chain",
+        env_kwargs={"n": 5},
+        num_env_runners=1,
+        num_envs_per_runner=4,
+        rollout_len=32,
+        hidden=(32,),
+        learning_starts=64,
+        epsilon_decay_iters=8,
+        num_updates_per_iter=8,
+        seed=0,
+    )
+    algo = cfg.build()
+    try:
+        for _ in range(10):
+            result = algo.train()
+        assert result["buffer_size"] > 64
+        assert np.isfinite(result["loss"])
+        assert result["epsilon"] < 1.0
+    finally:
+        algo.stop()

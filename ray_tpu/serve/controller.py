@@ -187,9 +187,16 @@ class ServeController:
             auto = dep["config"].get("autoscaling")
             if auto is not None and stats is not None:
                 self._autoscale(dep, auto, stats)
-            # 3. Reconcile count toward target.
-            while len(dep["replicas"]) < dep["target"]:
-                await self._start_replica(core, dep)
+            # 3. Reconcile count toward target. Starts run concurrently:
+            # a deployment whose __init__ jits a model for tens of
+            # seconds must not freeze health checks and autoscaling for
+            # every other deployment.
+            need = dep["target"] - len(dep["replicas"])
+            if need > 0:
+                await asyncio.gather(
+                    *(self._start_replica(core, dep) for _ in range(need)),
+                    return_exceptions=True,
+                )
             excess = len(dep["replicas"]) - dep["target"]
             if excess > 0:
                 victims = dep["replicas"][-excess:]
@@ -235,7 +242,19 @@ class ServeController:
         if dead:
             dep["replicas"] = [r for r in dep["replicas"] if r not in dead]
             dep["version"] += 1
+            # Kill what we dropped: a replica that merely missed the poll
+            # deadline would otherwise keep running (and keep its chips)
+            # forever while a replacement starts beside it.
+            for r in dead:
+                asyncio.ensure_future(self._kill_quietly(core, r))
         return {"num_ongoing_requests": total_ongoing}
+
+    @staticmethod
+    async def _kill_quietly(core, r: dict):
+        try:
+            await core.kill_actor(r["actor_id"], r["addr"])
+        except Exception:  # noqa: BLE001 - already dead is fine
+            pass
 
     def _autoscale(self, dep: dict, auto: dict, stats: dict):
         now = time.monotonic()
@@ -290,5 +309,11 @@ class ServeController:
                 2 * cfg.get("max_ongoing_requests", 5), 16
             ),
         )
+        key = (dep["app"], dep["name"])
+        if self._deployments.get(key) is not dep:
+            # The deployment was redeployed or deleted while this replica
+            # was starting; appending to the stale record would orphan it.
+            await self._kill_quietly(core, {"actor_id": actor_id, "addr": addr})
+            return
         dep["replicas"].append({"actor_id": actor_id, "addr": addr})
         dep["version"] += 1

@@ -18,6 +18,7 @@ import asyncio
 import random
 import time
 import uuid
+import zlib
 from dataclasses import dataclass
 
 from ray_tpu import api as core_api
@@ -72,6 +73,10 @@ class _Router:
         # metrics to the controller, serve/_private/router.py).
         self._queued = 0
         self._reporter: asyncio.Task | None = None
+        # Stable across the router's life, unique across processes (id()
+        # values repeat across address spaces and would alias demand
+        # reports at the controller).
+        self._router_id = uuid.uuid4().hex
 
     def _demand(self) -> int:
         return self._queued + sum(self._inflight.values())
@@ -84,7 +89,7 @@ class _Router:
         """Report demand while there is any; exit after a short idle
         period (a final 0 report) so dropped handles don't leak an
         eternal task + RPC stream."""
-        router_id = f"{id(self):x}"
+        router_id = self._router_id
         idle_since = None
         try:
             while True:
@@ -170,8 +175,14 @@ class _Router:
             # requests on a stable replica so its LRU cache stays warm
             # (reference approximates this with cache-locality routing,
             # multiplex.py); spill to power-of-two when saturated.
+            # crc32, not hash(): PYTHONHASHSEED randomization would send
+            # the same model to different replicas from different
+            # processes, thrashing every replica's model LRU.
             ordered = sorted(
-                self._replicas, key=lambda r: hash((model_id, r.actor_id))
+                self._replicas,
+                key=lambda r: zlib.crc32(
+                    f"{model_id}:{r.actor_id}".encode()
+                ),
             )
             for r in ordered:
                 if self._inflight.get(r.actor_id, 0) < r.max_ongoing:
@@ -205,12 +216,21 @@ class _Router:
                 self._queued -= 1
 
     async def route_and_call(
-        self, method_name: str, args: tuple, kwargs: dict, model_id: str = ""
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        model_id: str = "",
+        retry_on_failure: bool = True,
     ):
         # Resolve composed-handle responses passed as arguments.
         args = tuple(
             [await a if isinstance(a, DeploymentResponse) else a for a in args]
         )
+        kwargs = {
+            k: (await v if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
         ctx = {
             "request_id": uuid.uuid4().hex[:16],
             "multiplexed_model_id": model_id,
@@ -236,10 +256,17 @@ class _Router:
                 from ray_tpu.exceptions import ActorDiedError
                 from ray_tpu._private import rpc
 
-                if isinstance(
-                    e, (ActorDiedError, rpc.ConnectionLost, rpc.RpcError)
-                ) and deaths < 3:
+                if (
+                    retry_on_failure
+                    and isinstance(
+                        e, (ActorDiedError, rpc.ConnectionLost, rpc.RpcError)
+                    )
+                    and deaths < 3
+                ):
                     # Replica died mid-request: drop it and re-route.
+                    # NOTE: at-least-once — the dead replica may already
+                    # have executed the request. Non-idempotent callers
+                    # opt out via .options(retry_on_failure=False).
                     deaths += 1
                     self._replicas = [
                         r
@@ -265,11 +292,13 @@ class DeploymentHandle:
         app_name: str = "default",
         method_name: str = "__call__",
         multiplexed_model_id: str = "",
+        retry_on_failure: bool = True,
     ):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         self._model_id = multiplexed_model_id
+        self._retry = retry_on_failure
         self._router: _Router | None = None
 
     def __reduce__(self):
@@ -280,6 +309,7 @@ class DeploymentHandle:
                 self.app_name,
                 self._method_name,
                 self._model_id,
+                self._retry,
             ),
         )
 
@@ -288,6 +318,7 @@ class DeploymentHandle:
         *,
         method_name: str | None = None,
         multiplexed_model_id: str | None = None,
+        retry_on_failure: bool | None = None,
     ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name,
@@ -296,6 +327,7 @@ class DeploymentHandle:
             self._model_id
             if multiplexed_model_id is None
             else multiplexed_model_id,
+            self._retry if retry_on_failure is None else retry_on_failure,
         )
         h._router = self._router  # share routing state across options()
         return h
@@ -313,7 +345,7 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         router = self._get_router()
         coro = router.route_and_call(
-            self._method_name, args, kwargs, self._model_id
+            self._method_name, args, kwargs, self._model_id, self._retry
         )
         loop = core_api._runtime.loop
         try:

@@ -1,0 +1,220 @@
+"""LLMEngine: continuous-batching inference over the static-shape
+prefill/decode programs.
+
+Plays the role of vLLM's engine in the reference stack (SURVEY.md §2.4:
+ray.llm passes TP/PP sizes to vLLM and gang-schedules its workers).
+TPU-native shape: tensor parallelism is not worker processes — it is the
+same two XLA programs pjit-sharded over a mesh's 'tp' axis, so adding
+chips changes a sharding annotation, not the orchestration.
+
+Slot model: the KV cache holds `max_batch` rows. add_request() parks
+requests in a FIFO; step() admits queued requests into free slots
+(one prefill each, bucketed to power-of-two lengths to bound compile
+count) and then advances all active slots with one decode program.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.kv_cache import forward_decode, forward_prefill, init_kv_cache
+from ray_tpu.models.llama import LlamaConfig, PRESETS, init_params, param_logical_axes
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0  # 0 = full vocab
+    stop_token_ids: tuple = ()
+    seed: int = 0
+
+
+@dataclass
+class _Request:
+    request_id: str
+    prompt: list[int]
+    sampling: SamplingParams
+    out_tokens: list = field(default_factory=list)
+    slot: int = -1
+    position: int = 0  # index the NEXT token will be written at
+    last_token: int = 0
+    done: bool = False
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        model: str | LlamaConfig = "tiny",
+        *,
+        max_batch: int = 4,
+        max_seq: int | None = None,
+        mesh=None,
+        params=None,
+        seed: int = 0,
+    ):
+        cfg = PRESETS[model] if isinstance(model, str) else model
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_seq = max_seq or cfg.max_seq
+        self.mesh = mesh
+        if params is None:
+            params = init_params(jax.random.key(seed), cfg)
+        if mesh is not None:
+            from ray_tpu.parallel.sharding import shard_pytree
+
+            params = shard_pytree(params, mesh, param_logical_axes(cfg))
+        self.params = params
+        self.cache = init_kv_cache(cfg, max_batch, self.max_seq)
+
+        self._prefill = jax.jit(partial(forward_prefill, cfg=cfg))
+        self._decode = jax.jit(partial(forward_decode, cfg=cfg))
+        self._queue: list[_Request] = []
+        self._active: dict[int, _Request] = {}  # slot → request
+        self._free = list(range(max_batch))
+        self._ids = itertools.count()
+        self._rng = np.random.default_rng(seed)
+        # Host mirrors of the decode inputs, one entry per slot.
+        self._tokens = np.zeros((max_batch, 1), np.int32)
+        self._positions = np.zeros((max_batch,), np.int32)
+        # add_request may run on a different thread than step() (the serve
+        # pump runs step in an executor); guard the queue/slot state.
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------ request API
+    def add_request(
+        self,
+        prompt: list[int],
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+    ) -> str:
+        if len(prompt) >= self.max_seq:
+            raise ValueError(
+                f"prompt length {len(prompt)} >= max_seq {self.max_seq}"
+            )
+        rid = request_id or f"req-{next(self._ids)}"
+        with self._lock:
+            self._queue.append(
+                _Request(rid, list(prompt), sampling or SamplingParams())
+            )
+        return rid
+
+    def has_unfinished(self) -> bool:
+        return bool(self._queue or self._active)
+
+    def _sample(self, logits: np.ndarray, s: SamplingParams) -> int:
+        if s.temperature <= 0.0:
+            return int(logits.argmax())
+        logits = logits / s.temperature
+        if s.top_k:
+            kth = np.partition(logits, -s.top_k)[-s.top_k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        logits = logits - logits.max()
+        probs = np.exp(logits)
+        probs /= probs.sum()
+        return int(self._rng.choice(len(probs), p=probs))
+
+    def _finish_if_done(self, req: _Request, finished: list[dict]) -> bool:
+        """Evaluate stop conditions on req's latest token (shared by the
+        prefill-sampled token and decode-sampled tokens)."""
+        s = req.sampling
+        tok = req.out_tokens[-1]
+        if not (
+            tok in s.stop_token_ids
+            or len(req.out_tokens) >= s.max_tokens
+            or req.position >= self.max_seq - 1
+        ):
+            return False
+        if tok in s.stop_token_ids:
+            req.out_tokens.pop()  # don't return the stop token
+        req.done = True
+        finished.append(
+            {
+                "request_id": req.request_id,
+                "prompt": req.prompt,
+                "tokens": req.out_tokens,
+            }
+        )
+        if req.slot in self._active:
+            del self._active[req.slot]
+            self._free.append(req.slot)
+        return True
+
+    def _admit(self, finished: list[dict]) -> None:
+        while self._queue and self._free:
+            req = self._queue.pop(0)
+            slot = self._free.pop(0)
+            pad = min(_bucket(len(req.prompt)), self.max_seq)
+            tokens = np.zeros((1, pad), np.int32)
+            tokens[0, : len(req.prompt)] = req.prompt
+            logits, self.cache = self._prefill(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.int32(slot),
+            )
+            last = np.asarray(logits[0, len(req.prompt) - 1])
+            req.slot = slot
+            req.position = len(req.prompt)
+            req.last_token = self._sample(last, req.sampling)
+            req.out_tokens.append(req.last_token)
+            self._active[slot] = req
+            # The prefill-sampled token can already hit max_tokens=1 or a
+            # stop token; finishing here frees the slot for this _admit
+            # loop itself.
+            if not self._finish_if_done(req, finished):
+                self._tokens[slot, 0] = req.last_token
+                self._positions[slot] = req.position
+
+    def step(self) -> list[dict]:
+        """Admit + one decode step. Returns finished request dicts."""
+        finished: list[dict] = []
+        with self._lock:
+            self._admit(finished)
+            if not self._active:
+                return finished
+
+            logits, self.cache = self._decode(
+                self.params,
+                jnp.asarray(self._tokens),
+                self.cache,
+                jnp.asarray(self._positions),
+            )
+            logits = np.asarray(logits)
+            for slot, req in list(self._active.items()):
+                tok = self._sample(logits[slot], req.sampling)
+                req.position += 1
+                req.out_tokens.append(tok)
+                req.last_token = tok
+                self._tokens[slot, 0] = tok
+                self._positions[slot] = req.position
+                self._finish_if_done(req, finished)
+        return finished
+
+    def generate(
+        self,
+        prompts: list[list[int]],
+        sampling: SamplingParams | None = None,
+    ) -> list[list[int]]:
+        """Synchronous convenience: run all prompts to completion."""
+        order = {}
+        for i, p in enumerate(prompts):
+            order[self.add_request(p, sampling)] = i
+        results: list = [None] * len(prompts)
+        while self.has_unfinished():
+            for fin in self.step():
+                results[order[fin["request_id"]]] = fin["tokens"]
+        return results

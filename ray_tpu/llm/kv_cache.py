@@ -1,0 +1,157 @@
+"""KV-cached forward passes for autoregressive decoding.
+
+TPU-first: both programs have fully static shapes. The cache is a
+[L, B, S_max, Hkv, Dh] ring of slots; prefill writes one slot's prompt,
+decode advances every active slot by one token. Padding/garbage cache
+entries are never attended (position mask) and are overwritten as
+generation proceeds, so no dynamic shapes or host-side cache surgery are
+needed — the whole decode loop is two cached XLA programs.
+
+The reference has no native engine (SURVEY.md §2.4: ray.llm wraps vLLM);
+this module is the compute core its vLLM dependency provided.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, Params
+from ray_tpu.ops.attention import causal_attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+_NEG_INF = -2.0e38
+
+KVCache = dict[str, jnp.ndarray]  # {"k": [L,B,S,Hkv,Dh], "v": same}
+
+
+def init_kv_cache(
+    cfg: LlamaConfig, max_batch: int, max_seq: int
+) -> KVCache:
+    shape = (cfg.n_layers, max_batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+def _project_qkv(x, p, cfg):
+    b, s, _ = x.shape
+    dt = cfg.dtype
+    h = rms_norm(x, p["attn_norm"])
+    q = (h @ p["wq"].astype(dt)).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ p["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _mlp(x, p, cfg):
+    dt = cfg.dtype
+    h = rms_norm(x, p["mlp_norm"])
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
+    up = h @ p["w_up"].astype(dt)
+    return x + (gate * up) @ p["w_down"].astype(dt)
+
+
+def forward_prefill(
+    params: Params,
+    tokens: jnp.ndarray,  # [1, S_pad] int32 (one slot's prompt, padded)
+    cache: KVCache,
+    slot: jnp.ndarray,  # scalar int32: which cache row to fill
+    cfg: LlamaConfig,
+) -> tuple[jnp.ndarray, KVCache]:
+    """Run the prompt through the model, writing K/V into cache[:, slot].
+
+    Returns logits [1, S_pad, V] (caller reads position true_len-1) and
+    the updated cache. Padding tokens write garbage K/V beyond true_len —
+    harmless: decode masks keys at positions > its own current length and
+    overwrites them one by one.
+    """
+    seq = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+
+    def body(x, layer):
+        p, k_row, v_row = layer
+        q, k, v = _project_qkv(x, p, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = causal_attention(q, k, v)
+        x = x + attn.reshape(x.shape) @ p["wo"].astype(cfg.dtype)
+        x = _mlp(x, p, cfg)
+        # [B=1, S, Hkv, Dh] → write into this layer's [Bmax, Smax, ...] row.
+        k_row = jax.lax.dynamic_update_slice(
+            k_row, k.astype(cfg.dtype), (slot, 0, 0, 0)
+        )
+        v_row = jax.lax.dynamic_update_slice(
+            v_row, v.astype(cfg.dtype), (slot, 0, 0, 0)
+        )
+        return x, (k_row, v_row)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": k_cache, "v": v_cache}
+
+
+def forward_decode(
+    params: Params,
+    tokens: jnp.ndarray,  # [B, 1] int32: current token of every slot
+    cache: KVCache,
+    positions: jnp.ndarray,  # [B] int32: position each token sits at
+    cfg: LlamaConfig,
+) -> tuple[jnp.ndarray, KVCache]:
+    """One decode step for all slots. Returns logits [B, V] + cache."""
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]  # [B, 1, d]
+    b = tokens.shape[0]
+    max_seq = cache["k"].shape[2]
+    # Table sized to the CACHE length, not cfg.max_seq: an engine may run
+    # with a longer max_seq than the config default, and an out-of-range
+    # gather would silently clamp to the last row (wrong rotations).
+    cos, sin = rope_frequencies(cfg.head_dim, max_seq, cfg.rope_theta)
+
+    # Keys at index > position are stale (padding or other requests'
+    # leftovers); mask them. Index == position is this step's token.
+    key_idx = jnp.arange(max_seq)[None, :]  # [1, S]
+    mask = key_idx > positions[:, None]  # [B, S] True = masked
+
+    def write_row(row, val, pos):
+        # row [Smax, Hkv, Dh], val [1, Hkv, Dh]
+        return jax.lax.dynamic_update_slice(row, val, (pos, 0, 0))
+
+    def body(x, layer):
+        p, k_row, v_row = layer  # k_row [B, Smax, Hkv, Dh]
+        q, k, v = _project_qkv(x, p, cfg)  # q [B,1,H,Dh]
+        pos2d = positions[:, None]  # [B, 1]
+        q = apply_rope(q, cos, sin, positions=pos2d)
+        k = apply_rope(k, cos, sin, positions=pos2d)
+        k_row = jax.vmap(write_row)(k_row, k.astype(cfg.dtype), positions)
+        v_row = jax.vmap(write_row)(v_row, v.astype(cfg.dtype), positions)
+
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        kk = jnp.repeat(k_row, n_rep, axis=2)  # [B, S, H, Dh]
+        vv = jnp.repeat(v_row, n_rep, axis=2)
+        scale = cfg.head_dim**-0.5
+        logits = (
+            jnp.einsum("bqhd,bkhd->bhqk", q, kk, preferred_element_type=jnp.float32)
+            * scale
+        )  # [B, H, 1, S]
+        logits = jnp.where(mask[:, None, None, :], _NEG_INF, logits)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        x = x + attn.reshape(b, 1, -1) @ p["wo"].astype(cfg.dtype)
+        x = _mlp(x, p, cfg)
+        return x, (k_row, v_row)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits[:, 0], {"k": k_cache, "v": v_cache}

@@ -1,0 +1,102 @@
+"""Serve integration: an LLM deployment wrapping the engine.
+
+Reference shape: ray.llm builds Serve deployments around vLLM engines
+(reference: python/ray/llm/_internal/serve/, serve/llm/). Here the replica
+owns an LLMEngine; requests are enqueued into the engine's continuous
+batcher and a single background pump drives step() while any request is
+in flight, so concurrent callers share decode batches instead of queueing
+behind each other.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ray_tpu.llm.engine import LLMEngine, SamplingParams
+from ray_tpu.llm.tokenizer import ByteTokenizer
+
+
+class LLMServer:
+    """Deployment callable. Use via build_llm_deployment()."""
+
+    def __init__(self, model="tiny", engine_kwargs=None, tokenizer=None):
+        self.engine = LLMEngine(model, **(engine_kwargs or {}))
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self._waiters: dict[str, asyncio.Future] = {}
+        self._pump_task: asyncio.Task | None = None
+
+    async def _pump(self):
+        loop = asyncio.get_running_loop()
+        try:
+            while self.engine.has_unfinished():
+                # step() is blocking JAX compute (seconds on a first
+                # compile) — run it off-loop so this replica keeps
+                # answering RPCs, including the controller's health polls.
+                finished = await loop.run_in_executor(None, self.engine.step)
+                for fin in finished:
+                    fut = self._waiters.pop(fin["request_id"], None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(fin["tokens"])
+        except Exception as e:  # noqa: BLE001
+            # Fail every pending caller rather than hanging them forever.
+            waiters, self._waiters = self._waiters, {}
+            for fut in waiters.values():
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def _ensure_pump(self):
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def generate(
+        self,
+        prompt: str | list[int],
+        max_tokens: int = 64,
+        temperature: float = 0.0,
+        stop_token_ids: tuple = (),
+    ) -> dict:
+        tokens = (
+            self.tokenizer.encode(prompt) if isinstance(prompt, str) else prompt
+        )
+        sampling = SamplingParams(
+            max_tokens=max_tokens,
+            temperature=temperature,
+            stop_token_ids=tuple(stop_token_ids),
+        )
+        rid = self.engine.add_request(tokens, sampling)
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters[rid] = fut
+        self._ensure_pump()
+        out = await fut
+        return {
+            "tokens": out,
+            "text": self.tokenizer.decode(out),
+            "num_generated": len(out),
+        }
+
+    async def __call__(self, request: dict) -> dict:
+        return await self.generate(
+            request["prompt"],
+            max_tokens=request.get("max_tokens", 64),
+            temperature=request.get("temperature", 0.0),
+        )
+
+
+def build_llm_deployment(
+    model="tiny",
+    *,
+    num_replicas: int = 1,
+    engine_kwargs: dict | None = None,
+    tokenizer=None,
+    ray_actor_options: dict | None = None,
+):
+    """Returns a bound serve deployment; pass to serve.run()."""
+    from ray_tpu import serve
+
+    dep = serve.deployment(
+        LLMServer,
+        num_replicas=num_replicas,
+        ray_actor_options=ray_actor_options or {},
+        max_ongoing_requests=32,
+    )
+    return dep.bind(model, engine_kwargs, tokenizer)

@@ -1,0 +1,23 @@
+"""Byte-level tokenizer: zero-dependency default for tests and demos.
+
+The reference relies on HF tokenizers via vLLM; any object with
+encode(str)->list[int] / decode(list[int])->str (e.g. a transformers
+tokenizer) can be passed wherever a tokenizer is accepted — this is the
+built-in fallback with a 256-byte vocabulary plus specials.
+"""
+
+from __future__ import annotations
+
+
+class ByteTokenizer:
+    PAD = 256
+    BOS = 257
+    EOS = 258
+    vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.BOS] + ids if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8", "replace")

@@ -37,6 +37,15 @@ class ServeController:
         # client-side queuing).
         self._handle_demand: dict[tuple, dict] = {}
         self._shutdown = False
+        # Strong refs to fire-and-forget tasks (kills, background replica
+        # starts): the loop only weak-refs tasks, so an untracked one can
+        # be GC'd before it runs.
+        self._bg_tasks: set = set()
+
+    def _spawn_bg(self, coro) -> None:
+        task = asyncio.ensure_future(coro)
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     # ------------------------------------------------------ deploy API
     def deploy_application(self, app_name: str, spec: dict):
@@ -187,16 +196,17 @@ class ServeController:
             auto = dep["config"].get("autoscaling")
             if auto is not None and stats is not None:
                 self._autoscale(dep, auto, stats)
-            # 3. Reconcile count toward target. Starts run concurrently:
-            # a deployment whose __init__ jits a model for tens of
+            # 3. Reconcile count toward target. Starts are background
+            # tasks: a deployment whose __init__ jits a model for tens of
             # seconds must not freeze health checks and autoscaling for
-            # every other deployment.
-            need = dep["target"] - len(dep["replicas"])
-            if need > 0:
-                await asyncio.gather(
-                    *(self._start_replica(core, dep) for _ in range(need)),
-                    return_exceptions=True,
-                )
+            # every other deployment (the stale-record guard in
+            # _start_replica makes late completions safe).
+            need = (
+                dep["target"] - len(dep["replicas"]) - dep.get("starting", 0)
+            )
+            for _ in range(max(0, need)):
+                dep["starting"] = dep.get("starting", 0) + 1
+                self._spawn_bg(self._start_replica_tracked(core, dep))
             excess = len(dep["replicas"]) - dep["target"]
             if excess > 0:
                 victims = dep["replicas"][-excess:]
@@ -236,17 +246,24 @@ class ServeController:
         dead = []
         for r, s in zip(list(dep["replicas"]), results):
             if isinstance(s, BaseException):
-                dead.append(r)
+                # A single missed poll is not death: a replica blocked in
+                # a long jit compile (first LLM request) must not be
+                # killed mid-request. Three consecutive misses ≈ 3 control
+                # periods + timeouts before we declare it gone.
+                r["misses"] = r.get("misses", 0) + 1
+                if r["misses"] >= 3:
+                    dead.append(r)
             else:
+                r["misses"] = 0
                 total_ongoing += s["num_ongoing_requests"]
         if dead:
             dep["replicas"] = [r for r in dep["replicas"] if r not in dead]
             dep["version"] += 1
-            # Kill what we dropped: a replica that merely missed the poll
-            # deadline would otherwise keep running (and keep its chips)
-            # forever while a replacement starts beside it.
+            # Kill what we dropped: a replica that stopped answering polls
+            # would otherwise keep running (and keep its chips) forever
+            # while a replacement starts beside it.
             for r in dead:
-                asyncio.ensure_future(self._kill_quietly(core, r))
+                self._spawn_bg(self._kill_quietly(core, r))
         return {"num_ongoing_requests": total_ongoing}
 
     @staticmethod
@@ -285,6 +302,12 @@ class ServeController:
                 dep["last_scale_down"] = now
         else:
             dep["last_scale_down"] = now
+
+    async def _start_replica_tracked(self, core, dep: dict):
+        try:
+            await self._start_replica(core, dep)
+        finally:
+            dep["starting"] = max(0, dep.get("starting", 0) - 1)
 
     async def _start_replica(self, core, dep: dict):
         cfg = dep["config"]

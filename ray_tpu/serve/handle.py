@@ -77,6 +77,9 @@ class _Router:
         # values repeat across address spaces and would alias demand
         # reports at the controller).
         self._router_id = uuid.uuid4().hex
+        # model_id → (version, replicas ordered by affinity hash); the
+        # order only changes when the replica set does.
+        self._affinity: dict[str, tuple[int, list[_ReplicaTarget]]] = {}
 
     def _demand(self) -> int:
         return self._queued + sum(self._inflight.values())
@@ -174,16 +177,21 @@ class _Router:
             # Hash-affinity for multiplexed models: keep a model's
             # requests on a stable replica so its LRU cache stays warm
             # (reference approximates this with cache-locality routing,
-            # multiplex.py); spill to power-of-two when saturated.
+            # multiplex.py); spill down the ordering when saturated.
             # crc32, not hash(): PYTHONHASHSEED randomization would send
             # the same model to different replicas from different
             # processes, thrashing every replica's model LRU.
-            ordered = sorted(
-                self._replicas,
-                key=lambda r: zlib.crc32(
-                    f"{model_id}:{r.actor_id}".encode()
-                ),
-            )
+            cached = self._affinity.get(model_id)
+            if cached is None or cached[0] != self._version:
+                ordered = sorted(
+                    self._replicas,
+                    key=lambda r: zlib.crc32(
+                        f"{model_id}:{r.actor_id}".encode()
+                    ),
+                )
+                self._affinity[model_id] = (self._version, ordered)
+            else:
+                ordered = cached[1]
             for r in ordered:
                 if self._inflight.get(r.actor_id, 0) < r.max_ongoing:
                     return r

@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import atexit
 import functools
+import os
 import threading
 from typing import Any, Sequence
 
@@ -67,6 +68,11 @@ def init(
     """
     if _runtime.ready:
         raise RayTpuError("ray_tpu is already initialized")
+    if address is None:
+        # Job drivers launched by the job manager inherit the cluster
+        # address (reference: RAY_ADDRESS env for `ray job submit`
+        # entrypoints).
+        address = os.environ.get("RAY_TPU_ADDRESS") or None
 
     loop = asyncio.new_event_loop()
     thread = threading.Thread(

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import functools
 import hashlib
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Sequence
@@ -100,6 +101,12 @@ class CoreWorker:
         self._put_index = 0
         self._root_task = TaskID.random()
 
+        # Task-event buffer, flushed to the head periodically (reference:
+        # worker-side TaskEventBuffer core_worker/task_event_buffer.h →
+        # GcsTaskManager). Bounded: observability must not OOM the worker.
+        self._task_events: list[dict] = []
+        self._event_flusher: asyncio.Task | None = None
+
         # Extension RPC handlers (collective groups, channels, ...):
         # name → async fn(conn=..., **kw). Checked before built-ins.
         self.ext_handlers: dict[str, Any] = {}
@@ -113,6 +120,7 @@ class CoreWorker:
         self._exec_queue = asyncio.Queue()
         self._exec_task = asyncio.ensure_future(self._exec_loop())
         self._lease_reaper = asyncio.ensure_future(self._lease_reap_loop())
+        self._event_flusher = asyncio.ensure_future(self._flush_events_loop())
         return self.addr
 
     async def stop(self):
@@ -120,6 +128,9 @@ class CoreWorker:
             self._exec_task.cancel()
         if self._lease_reaper:
             self._lease_reaper.cancel()
+        if self._event_flusher:
+            self._event_flusher.cancel()
+            await self._flush_events()  # final drain
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
         for conn in list(self._conns.values()):
             await conn.close()
@@ -350,10 +361,16 @@ class CoreWorker:
         spec = {
             "task_id": task_id.hex(),
             "fn_id": fn_id,
+            "name": (
+                fn if isinstance(fn, str) else getattr(fn, "__name__", "")
+            ),
             "args": self._encode_args(args, kwargs),
             "num_returns": num_returns,
             "owner_addr": self.addr,
         }
+        self.record_task_event(
+            spec, "SUBMITTED", kind="actor_task" if actor else "task"
+        )
         asyncio.ensure_future(
             self._drive_task(spec, oids, resources, max_retries, actor, placement)
         )
@@ -362,14 +379,56 @@ class CoreWorker:
     async def _drive_task(self, spec, oids, resources, retries, actor, placement):
         try:
             if actor is not None:
-                await self._drive_actor_task(spec, oids, actor)
+                errored = await self._drive_actor_task(spec, oids, actor)
             else:
-                await self._drive_normal_task(
+                errored = await self._drive_normal_task(
                     spec, oids, resources, retries, placement
                 )
+            self.record_task_event(
+                spec, "FAILED" if errored else "FINISHED"
+            )
         except Exception as e:  # noqa: BLE001 - becomes the task's result
+            self.record_task_event(spec, "FAILED", error=repr(e))
             for oid_hex in oids:
                 self._store_result(oid_hex, ("error", e))
+
+    # -------------------------------------------------------- task events
+    def record_task_event(self, spec: dict, state: str, **extra):
+        ev = {
+            "task_id": spec.get("task_id", ""),
+            "name": spec.get("name", spec.get("fn_id", ""))[:80],
+            "state": state,
+            "ts": time.time(),
+            "worker": self.addr,
+        }
+        ev.update(extra)
+        self._task_events.append(ev)
+        if len(self._task_events) > 10000:  # drop oldest under pressure
+            del self._task_events[:5000]
+
+    async def _flush_events(self):
+        if not self._task_events or self.head is None:
+            return
+        batch, self._task_events = self._task_events, []
+        try:
+            await self.head.call("add_task_events", events=batch)
+        except Exception:  # noqa: BLE001 - observability is best-effort
+            pass
+
+    async def _flush_events_loop(self):
+        from ray_tpu.util import metrics as _metrics
+
+        while True:
+            await asyncio.sleep(1.0)
+            await self._flush_events()
+            snap = _metrics.snapshot()
+            if snap:
+                try:
+                    await self.head.call(
+                        "report_metrics", worker=self.addr, metrics=snap
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
 
     async def _drive_normal_task(self, spec, oids, resources, retries, placement=None):
         last_err: Exception | None = None
@@ -379,8 +438,7 @@ class CoreWorker:
                 lease = await self._lease(resources, placement)
                 conn = await self._connect(lease["addr"])
                 reply = await conn.call("push_task", spec=spec)
-                self._apply_reply(reply, oids)
-                return
+                return self._apply_reply(reply, oids)
             except (rpc.ConnectionLost, rpc.RpcError) as e:
                 last_err = e
                 lease = None  # worker is gone; do not return the lease
@@ -398,23 +456,25 @@ class CoreWorker:
             reply = await conn.call(
                 "actor_call", spec=spec, actor_id=actor.actor_id
             )
-            self._apply_reply(reply, oids)
+            return self._apply_reply(reply, oids)
         except (rpc.ConnectionLost, rpc.RpcError) as e:
             raise ActorDiedError(
                 f"actor {actor.actor_id[:12]}… died: {e}"
             ) from e
 
-    def _apply_reply(self, reply: dict, oids: list):
+    def _apply_reply(self, reply: dict, oids: list) -> bool:
+        """Returns True when the reply carries a task error."""
         if reply["status"] == "error":
             err = deserialize(reply["error"])
             for oid_hex in oids:
                 self._store_result(oid_hex, ("error", err))
-            return
+            return True
         for oid_hex, kind, *rest in reply["results"]:
             if kind == "inline":
                 self._store_result(oid_hex, ("value", rest[0], rest[1]))
             else:  # in the node-shared store
                 self._store_result(oid_hex, ("in_store",))
+        return False
 
     # ------------------------------------------------------------ leases
     def _sched_key(self, resources: dict | None) -> tuple:
@@ -691,6 +751,7 @@ class CoreWorker:
 
     async def _execute(self, spec: dict, actor_id: str | None) -> dict:
         loop = asyncio.get_running_loop()
+        exec_start = time.time()
         try:
             args, kwargs = await self._decode_args(spec["args"])
             if actor_id is not None:
@@ -738,8 +799,15 @@ class CoreWorker:
                 else:
                     self.store.put(oid, data)
                     results.append((oid.hex(), "in_store"))
+            self.record_task_event(
+                spec, "RUNNING", ts=exec_start, dur=time.time() - exec_start
+            )
             return {"status": "ok", "results": results}
         except Exception as e:  # noqa: BLE001 - travels to the owner
+            self.record_task_event(
+                spec, "RUNNING", ts=exec_start,
+                dur=time.time() - exec_start, failed=True,
+            )
             return {"status": "error", "error": _dumps_small(_as_task_error(e))}
 
 

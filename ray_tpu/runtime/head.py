@@ -11,6 +11,7 @@ gcs/store_client/redis_store_client.h:126).
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 from typing import Any
 
@@ -37,6 +38,13 @@ class HeadService:
         # head-initiated client conns to each node (for PG prepare/commit)
         self._node_conns: dict[str, rpc.Connection] = {}
         self._reaper: asyncio.Task | None = None
+        # Task-event store (reference: GcsTaskManager gcs_task_manager.h:97
+        # buffers worker-flushed task state transitions for the state API
+        # and `ray timeline`). Ring-bounded; per-task latest state capped.
+        self.task_events: collections.deque = collections.deque(maxlen=20000)
+        self.task_latest: collections.OrderedDict = collections.OrderedDict()
+        # worker addr → latest metrics snapshot {name: record}
+        self.metrics: dict[str, dict] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         p = await self.server.start(host, port)
@@ -309,6 +317,14 @@ class HeadService:
                     pass
         return {"ok": True}
 
+    async def _on_list_placement_groups(self, conn):
+        return {
+            "placement_groups": {
+                pid: {k: v for k, v in pg.items()}
+                for pid, pg in self.placement_groups.items()
+            }
+        }
+
     async def _on_get_placement_group(self, conn, pg_id: str):
         pg = self.placement_groups.get(pg_id)
         if pg is None:
@@ -317,6 +333,56 @@ class HeadService:
             "ok": True,
             **pg,
             "node_addrs": [self.nodes[n]["addr"] for n in pg["nodes"]],
+        }
+
+    # ------------------------------------------------- task events/metrics
+    _STATE_RANK = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+
+    async def _on_add_task_events(self, conn, events: list):
+        for ev in events:
+            self.task_events.append(ev)
+            tid = ev.get("task_id")
+            if tid:
+                prev = self.task_latest.pop(tid, None)
+                merged = dict(prev or {})
+                # Events from different processes arrive out of order
+                # (driver flushes FINISHED; the worker's RUNNING may land
+                # later) — never let a terminal state regress.
+                old_state = merged.get("state")
+                merged.update(ev)
+                if old_state is not None and self._STATE_RANK.get(
+                    ev.get("state"), 0
+                ) < self._STATE_RANK.get(old_state, 0):
+                    merged["state"] = old_state
+                self.task_latest[tid] = merged
+                while len(self.task_latest) > 20000:
+                    self.task_latest.popitem(last=False)
+        return {"ok": True}
+
+    async def _on_list_task_events(
+        self, conn, limit: int = 1000, raw: bool = False
+    ):
+        if raw:
+            return {"events": list(self.task_events)[-limit:]}
+        items = list(self.task_latest.values())[-limit:]
+        return {"events": items}
+
+    METRICS_TTL_S = 60.0
+
+    async def _on_report_metrics(self, conn, worker: str, metrics: dict):
+        self.metrics[worker] = {"ts": time.monotonic(), "snap": metrics}
+        return {"ok": True}
+
+    async def _on_cluster_metrics(self, conn):
+        # Entries from workers that stopped reporting (exited job
+        # drivers, dead workers) age out — otherwise the map grows with
+        # every short-lived job and dead gauges report forever.
+        now = time.monotonic()
+        for w, rec in list(self.metrics.items()):
+            if now - rec["ts"] > self.METRICS_TTL_S:
+                del self.metrics[w]
+        return {
+            "workers": {w: rec["snap"] for w, rec in self.metrics.items()}
         }
 
     # ----------------------------------------------------------- health

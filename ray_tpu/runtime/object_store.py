@@ -184,6 +184,22 @@ class ObjectStore:
         except FileNotFoundError:
             pass
 
+    def list_objects(self) -> list[tuple[str, int]]:
+        """(object_id hex, size) pairs. Best-effort: covers the
+        file-backed objects; the native pool does not expose a scan."""
+        out = []
+        for p in self.dir.iterdir():
+            # Skip the pool file and in-flight temp files from concurrent
+            # put()s; an entry may also vanish between iterdir and stat.
+            if not all(c in "0123456789abcdef" for c in p.name):
+                continue
+            try:
+                if p.is_file():
+                    out.append((p.name, p.stat().st_size))
+            except OSError:
+                continue
+        return out
+
     def used_bytes(self) -> int:
         pool = self.pool.used_bytes() if self.pool is not None else 0
         return pool + sum(

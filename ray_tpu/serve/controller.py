@@ -306,6 +306,10 @@ class ServeController:
     async def _start_replica_tracked(self, core, dep: dict):
         try:
             await self._start_replica(core, dep)
+        except Exception:  # noqa: BLE001 - e.g. no feasible node; the
+            # reconcile loop will retry next period, so swallow rather
+            # than spam "Task exception was never retrieved".
+            pass
         finally:
             dep["starting"] = max(0, dep.get("starting", 0) - 1)
 

@@ -164,6 +164,10 @@ class _Router:
                 r.actor_id: self._inflight.get(r.actor_id, 0)
                 for r in self._replicas
             }
+            # Orderings cached against the old replica set are dead
+            # weight now; dropping the whole map also bounds its growth
+            # across high-cardinality model ids.
+            self._affinity.clear()
 
     def _pick(self, model_id: str) -> _ReplicaTarget | None:
         avail = [
@@ -181,6 +185,8 @@ class _Router:
             # crc32, not hash(): PYTHONHASHSEED randomization would send
             # the same model to different replicas from different
             # processes, thrashing every replica's model LRU.
+            if len(self._affinity) > 4096:  # hard cap per router
+                self._affinity.clear()
             cached = self._affinity.get(model_id)
             if cached is None or cached[0] != self._version:
                 ordered = sorted(
@@ -281,6 +287,10 @@ class _Router:
                         for r in self._replicas
                         if r.actor_id != replica.actor_id
                     ]
+                    # The controller may not bump the version for several
+                    # missed polls; cached affinity orderings still point
+                    # at the dead replica until then.
+                    self._affinity.clear()
                     await self._refresh(force=True)
                     continue
                 raise

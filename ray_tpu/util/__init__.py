@@ -1,0 +1,2 @@
+"""ray_tpu.util: state API, metrics, actor pool, queue, and friends
+(reference: python/ray/util/)."""

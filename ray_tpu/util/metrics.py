@@ -1,0 +1,196 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Reference: python/ray/util/metrics.py — tagged metrics defined in any
+worker, exported cluster-wide. Here each process keeps a registry whose
+snapshot rides the core worker's event-flush loop to the head
+(reference pipeline: stats/metric.h → OpenTelemetryMetricRecorder →
+per-node MetricsAgent → Prometheus scrape,
+python/ray/_private/metrics_agent.py:628); `cluster_metrics()` merges
+worker snapshots and `prometheus_text()` renders the exposition format a
+scraper would consume.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Sequence
+
+_REGISTRY: dict[tuple, "_Metric"] = {}
+_LOCK = threading.Lock()
+
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0
+)
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: Sequence[str] = (),
+    ):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: dict[str, str] = {}
+        # tag-value tuple → value (float for counter/gauge, list for hist)
+        self._series: dict[tuple, object] = {}
+        with _LOCK:
+            _REGISTRY[(self.kind, name)] = self
+
+    def set_default_tags(self, tags: dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: dict[str, str] | None) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        unknown = set(merged) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(
+                f"tags {sorted(unknown)} not in tag_keys {self.tag_keys}"
+            )
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = self._key(tags)
+        with _LOCK:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: dict | None = None):
+        with _LOCK:
+            self._series[self._key(tags)] = float(value)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Sequence[float] = DEFAULT_BUCKETS,
+        tag_keys: Sequence[str] = (),
+    ):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(sorted(boundaries))
+
+    def observe(self, value: float, tags: dict | None = None):
+        key = self._key(tags)
+        with _LOCK:
+            series = self._series.get(key)
+            if series is None:
+                # bucket counts (len+1 for +Inf), sum, count
+                series = [[0] * (len(self.boundaries) + 1), 0.0, 0]
+                self._series[key] = series
+            idx = bisect.bisect_left(self.boundaries, value)
+            series[0][idx] += 1
+            series[1] += value
+            series[2] += 1
+
+
+def snapshot() -> dict:
+    """Serializable {name: record} for this process's registry."""
+    out = {}
+    with _LOCK:
+        for (kind, name), m in _REGISTRY.items():
+            series = {}
+            for key, val in m._series.items():
+                tag_str = ",".join(
+                    f'{k}="{v}"' for k, v in zip(m.tag_keys, key)
+                )
+                series[tag_str] = (
+                    [list(val[0]), val[1], val[2]]
+                    if kind == "histogram"
+                    else val
+                )
+            if series:
+                out[name] = {
+                    "kind": kind,
+                    "description": m.description,
+                    "series": series,
+                    "boundaries": getattr(m, "boundaries", None),
+                }
+    return out
+
+
+def clear_registry():
+    """Test helper."""
+    with _LOCK:
+        _REGISTRY.clear()
+
+
+def merge_snapshots(worker_snaps: dict[str, dict]) -> dict:
+    """Merge per-worker snapshots: counters/histograms sum, gauges keep
+    the per-worker latest under a worker tag."""
+    merged: dict[str, dict] = {}
+    for worker, snap in worker_snaps.items():
+        for name, rec in snap.items():
+            m = merged.setdefault(
+                name,
+                {
+                    "kind": rec["kind"],
+                    "description": rec["description"],
+                    "series": {},
+                    "boundaries": rec.get("boundaries"),
+                },
+            )
+            for tag_str, val in rec["series"].items():
+                if rec["kind"] == "gauge":
+                    wtag = f'{tag_str},worker="{worker}"'.lstrip(",")
+                    m["series"][wtag] = val
+                elif rec["kind"] == "counter":
+                    m["series"][tag_str] = m["series"].get(tag_str, 0.0) + val
+                else:  # histogram
+                    cur = m["series"].get(tag_str)
+                    if cur is None:
+                        m["series"][tag_str] = [
+                            list(val[0]), val[1], val[2]
+                        ]
+                    else:
+                        cur[0] = [a + b for a, b in zip(cur[0], val[0])]
+                        cur[1] += val[1]
+                        cur[2] += val[2]
+    return merged
+
+
+def prometheus_text(merged: dict) -> str:
+    """Render merged metrics in Prometheus exposition format."""
+    lines = []
+    for name, rec in merged.items():
+        if rec["description"]:
+            lines.append(f"# HELP {name} {rec['description']}")
+        lines.append(f"# TYPE {name} {rec['kind']}")
+        for tag_str, val in rec["series"].items():
+            braces = f"{{{tag_str}}}" if tag_str else ""
+            if rec["kind"] == "histogram":
+                counts, total, n = val
+                cum = 0
+                for bound, c in zip(rec["boundaries"], counts):
+                    cum += c
+                    sep = "," if tag_str else ""
+                    lines.append(
+                        f'{name}_bucket{{{tag_str}{sep}le="{bound}"}} {cum}'
+                    )
+                sep = "," if tag_str else ""
+                lines.append(
+                    f'{name}_bucket{{{tag_str}{sep}le="+Inf"}} {n}'
+                )
+                lines.append(f"{name}_sum{braces} {total}")
+                lines.append(f"{name}_count{braces} {n}")
+            else:
+                lines.append(f"{name}{braces} {val}")
+    return "\n".join(lines) + "\n"

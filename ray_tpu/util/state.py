@@ -1,0 +1,123 @@
+"""State API: inspect live cluster state (reference:
+python/ray/util/state/api.py — `ray list tasks/actors/nodes/...` backed by
+GCS task events and tables).
+
+All calls query the head service through the driver's core worker.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ray_tpu import api as core_api
+
+
+def _call_head(method: str, **kw) -> dict:
+    rt = core_api._runtime
+    if rt.core is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+
+    async def go():
+        return await rt.core.head.call(method, **kw)
+
+    return rt.run(go())
+
+
+def list_nodes() -> list[dict]:
+    table = _call_head("node_table")
+    return [
+        {
+            "node_id": nid,
+            "addr": n["addr"],
+            "resources": n["resources"],
+            "available": n["available"],
+            "labels": n.get("labels", {}),
+        }
+        for nid, n in table.items()
+    ]
+
+
+def list_actors(state: str | None = None) -> list[dict]:
+    actors = _call_head("list_actors")["actors"]
+    out = [
+        {"actor_id": aid, **info}
+        for aid, info in actors.items()
+        if state is None or info["state"] == state
+    ]
+    return out
+
+
+def list_tasks(limit: int = 1000, state: str | None = None) -> list[dict]:
+    events = _call_head("list_task_events", limit=limit)["events"]
+    if state is not None:
+        events = [e for e in events if e.get("state") == state]
+    return events
+
+
+def list_placement_groups() -> list[dict]:
+    pgs = _call_head("list_placement_groups")["placement_groups"]
+    return [{"pg_id": pid, **pg} for pid, pg in pgs.items()]
+
+
+def list_objects() -> list[dict]:
+    """Objects in this node's shared-memory store."""
+    rt = core_api._runtime
+    store = rt.core.store
+    out = []
+    for oid_hex, size in store.list_objects():
+        out.append({"object_id": oid_hex, "size_bytes": size})
+    return out
+
+
+def summarize_tasks() -> dict:
+    counts: dict[str, int] = {}
+    for ev in list_tasks(limit=20000):
+        counts[ev.get("state", "?")] = counts.get(ev.get("state", "?"), 0) + 1
+    return counts
+
+
+def cluster_metrics() -> dict:
+    """Merged user metrics across all workers."""
+    from ray_tpu.util import metrics as m
+
+    workers = _call_head("cluster_metrics")["workers"]
+    # Refresh this process's entry from the live registry (its periodic
+    # flusher may lag); same key as the flusher uses so the local
+    # snapshot replaces — never double-counts — the reported one.
+    local = m.snapshot()
+    if local:
+        workers = {**workers, core_api._runtime.core.addr: local}
+    return m.merge_snapshots(workers)
+
+
+def prometheus_metrics() -> str:
+    from ray_tpu.util import metrics as m
+
+    return m.prometheus_text(cluster_metrics())
+
+
+def timeline(path: str | None = None) -> list[dict] | str:
+    """Chrome-trace export of task execution spans (reference:
+    `ray timeline`, powered by GcsTaskManager events)."""
+    events = _call_head("list_task_events", limit=20000, raw=True)["events"]
+    trace = []
+    for ev in events:
+        if ev.get("state") != "RUNNING" or "dur" not in ev:
+            continue
+        trace.append(
+            {
+                "ph": "X",
+                "name": ev.get("name") or ev.get("task_id", "")[:8],
+                "ts": ev["ts"] * 1e6,
+                "dur": ev["dur"] * 1e6,
+                "pid": ev.get("worker", "?"),
+                "tid": 0,
+                "args": {"task_id": ev.get("task_id")},
+            }
+        )
+    if path is None:
+        return trace
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path

@@ -1,0 +1,177 @@
+"""State API, task events, metrics, timeline, and job submission tests.
+
+Reference test models: python/ray/tests/test_state_api.py (list
+nodes/actors/tasks), test_metrics_agent.py, dashboard/modules/job tests.
+"""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics, state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_list_nodes(cluster):
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1
+    assert all("CPU" in n["resources"] for n in nodes)
+
+
+def test_list_actors_and_tasks(cluster):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote()) == 1
+
+    actors = state.list_actors(state="ALIVE")
+    assert any(a["class_name"] == "Counter" for a in actors)
+
+    @ray_tpu.remote
+    def named_task():
+        return 42
+
+    ray_tpu.get([named_task.remote() for _ in range(3)])
+    time.sleep(1.5)  # event flush period
+    tasks = state.list_tasks(limit=5000)
+    names = [t.get("name") for t in tasks]
+    assert "named_task" in names
+    finished = [
+        t for t in tasks
+        if t.get("name") == "named_task" and t.get("state") == "FINISHED"
+    ]
+    assert len(finished) >= 3
+
+    summary = state.summarize_tasks()
+    assert summary.get("FINISHED", 0) >= 3
+
+
+def test_task_events_record_failures(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("intentional")
+
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+    time.sleep(1.5)
+    failed = state.list_tasks(state="FAILED")
+    assert any(t.get("name") == "boom" for t in failed)
+
+
+def test_timeline_export(cluster, tmp_path):
+    @ray_tpu.remote
+    def sleepy():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([sleepy.remote() for _ in range(2)])
+    time.sleep(1.5)
+    path = state.timeline(str(tmp_path / "trace.json"))
+    trace = json.load(open(path))
+    spans = [e for e in trace if e["name"] == "sleepy"]
+    assert len(spans) >= 2
+    assert all(e["ph"] == "X" and e["dur"] > 0 for e in spans)
+
+
+def test_metrics_local_and_prometheus(cluster):
+    metrics.clear_registry()
+    c = metrics.Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(2, tags={"route": "/a"})
+    c.inc(1, tags={"route": "/b"})
+    g = metrics.Gauge("test_queue_depth", "depth")
+    g.set(7)
+    h = metrics.Histogram(
+        "test_latency_s", "lat", boundaries=(0.1, 1.0), tag_keys=()
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    merged = state.cluster_metrics()
+    assert merged["test_requests_total"]["series"]['route="/a"'] == 2
+    text = state.prometheus_metrics()
+    assert "# TYPE test_requests_total counter" in text
+    assert 'test_latency_s_bucket{le="0.1"} 1' in text
+    assert "test_latency_s_count 3" in text
+    assert "test_queue_depth" in text
+
+
+def test_metrics_from_workers(cluster):
+    @ray_tpu.remote
+    def work(i):
+        from ray_tpu.util import metrics as wm
+
+        counter = wm.Counter("test_worker_units", "units")
+        counter.inc(10)
+        time.sleep(1.5)  # survive until the flush loop runs
+        return i
+
+    ray_tpu.get([work.remote(i) for i in range(2)])
+    merged = state.cluster_metrics()
+    rec = merged.get("test_worker_units")
+    assert rec is not None
+    assert sum(rec["series"].values()) >= 20
+
+
+def test_job_submission_roundtrip(cluster):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint="python -c \"print('job ran ok')\"",
+    )
+    status = client.wait_until_finish(job_id, timeout=60)
+    assert status == "SUCCEEDED"
+    assert "job ran ok" in client.get_job_logs(job_id)
+    jobs = client.list_jobs()
+    assert any(j["job_id"] == job_id for j in jobs)
+
+
+def test_job_failure_and_stop(cluster):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint="python -c 'raise SystemExit(3)'")
+    assert client.wait_until_finish(bad, timeout=60) == "FAILED"
+
+    slow = client.submit_job(entrypoint="sleep 60")
+    time.sleep(0.5)
+    assert client.stop_job(slow) is True
+    assert client.get_job_status(slow) in ("STOPPED", "FAILED")
+
+
+def test_job_driver_connects_to_cluster(cluster, tmp_path):
+    """A submitted driver can init against the running cluster via env."""
+    from ray_tpu.job import JobSubmissionClient
+
+    script = tmp_path / "driver.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # picks up RAY_TPU_ADDRESS from env
+        "@ray_tpu.remote\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+        "print('driver result', ray_tpu.get(f.remote(21)))\n"
+        "ray_tpu.shutdown()\n"
+    )
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=f"python {script}")
+    status = client.wait_until_finish(job_id, timeout=120)
+    logs = client.get_job_logs(job_id)
+    assert status == "SUCCEEDED", logs
+    assert "driver result 42" in logs

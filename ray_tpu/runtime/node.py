@@ -156,6 +156,16 @@ class NodeManager:
         pypath = os.environ.get("PYTHONPATH", "")
         if pkg_root not in pypath.split(os.pathsep):
             pypath = f"{pkg_root}{os.pathsep}{pypath}" if pypath else pkg_root
+        # Workers inherit the driver's module search path so functions
+        # pickled by reference (top-level defs in driver-side modules)
+        # import cleanly (reference: ray workers inherit PYTHONPATH/cwd;
+        # runtime_env py_modules covers the multi-host case).
+        seen = set(pypath.split(os.pathsep))
+        for entry in sys.path:
+            # exists (not isdir): zipimport archives are valid entries.
+            if entry and entry not in seen and os.path.exists(entry):
+                pypath = f"{pypath}{os.pathsep}{entry}"
+                seen.add(entry)
         jax_platform = env_jax_platform()
         argv = [sys.executable, "-m", "ray_tpu.runtime.worker_main"]
         if jax_platform == "cpu":

@@ -1,0 +1,167 @@
+"""multiprocessing.Pool shim over cluster tasks.
+
+Reference: python/ray/util/multiprocessing/pool.py — drop-in Pool whose
+workers are cluster processes, so `Pool.map` scales past one host.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: list, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: float | None = None):
+        results = ray_tpu.get(self._refs, timeout=timeout)
+        return results[0] if self._single else results
+
+    def wait(self, timeout: float | None = None):
+        ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=timeout
+        )
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(
+            self._refs, num_returns=len(self._refs), timeout=0
+        )
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    """Chunked task fan-out. `processes` bounds in-flight chunks on the
+    lazy paths (map/starmap/imap*); the *_async paths submit everything
+    up front since they must return immediately."""
+
+    def __init__(self, processes: int | None = None):
+        self._processes = processes or 8
+        self._run_chunk = ray_tpu.remote(_run_chunk)
+        self._closed = False
+
+    def _windowed(self, fn, chunks, star: bool):
+        """Yield chunk results in order with ≤ `processes` in flight."""
+        chunks = list(chunks)
+        inflight: list = []
+        next_submit = 0
+        for i in range(len(chunks)):
+            while next_submit < len(chunks) and (
+                len(inflight) < self._processes
+            ):
+                inflight.append(
+                    self._run_chunk.remote(fn, chunks[next_submit], star)
+                )
+                next_submit += 1
+            yield ray_tpu.get(inflight.pop(0))
+
+    def _chunks(self, iterable: Iterable, chunksize: int | None):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        for i in range(0, len(items), chunksize):
+            yield items[i : i + chunksize]
+
+    def map(self, fn: Callable, iterable: Iterable, chunksize=None) -> list:
+        self._check_open()
+        return list(
+            itertools.chain.from_iterable(
+                self._windowed(fn, self._chunks(iterable, chunksize), False)
+            )
+        )
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        self._check_open()
+        refs = [
+            self._run_chunk.remote(fn, chunk, False)
+            for chunk in self._chunks(iterable, chunksize)
+        ]
+        return _FlattenResult(refs)
+
+    def starmap(self, fn, iterable, chunksize=None) -> list:
+        self._check_open()
+        return list(
+            itertools.chain.from_iterable(
+                self._windowed(fn, self._chunks(iterable, chunksize), True)
+            )
+        )
+
+    def apply(self, fn, args=(), kwds=None) -> Any:
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+        self._check_open()
+        task = ray_tpu.remote(fn)
+        return AsyncResult([task.remote(*args, **(kwds or {}))], single=True)
+
+    def imap(self, fn, iterable, chunksize=1):
+        self._check_open()
+        for chunk_result in self._windowed(
+            fn, self._chunks(iterable, chunksize), False
+        ):
+            yield from chunk_result
+
+    def imap_unordered(self, fn, iterable, chunksize=1):
+        self._check_open()
+        chunks = list(self._chunks(iterable, chunksize))
+        inflight: list = []
+        next_submit = 0
+        while next_submit < len(chunks) or inflight:
+            while next_submit < len(chunks) and (
+                len(inflight) < self._processes
+            ):
+                inflight.append(
+                    self._run_chunk.remote(fn, chunks[next_submit], False)
+                )
+                next_submit += 1
+            ready, inflight = ray_tpu.wait(inflight, num_returns=1)
+            for ref in ready:  # wait may report more than num_returns
+                yield from ray_tpu.get(ref)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+
+
+class _FlattenResult(AsyncResult):
+    def __init__(self, refs: list):
+        super().__init__(refs, single=False)
+
+    def get(self, timeout: float | None = None):
+        return list(
+            itertools.chain.from_iterable(
+                ray_tpu.get(self._refs, timeout=timeout)
+            )
+        )
+
+
+def _run_chunk(fn: Callable, chunk: list, star: bool) -> list:
+    if star:
+        return [fn(*item) for item in chunk]
+    return [fn(item) for item in chunk]

@@ -1,0 +1,132 @@
+"""Tests for ray_tpu.util extras: ActorPool, Queue, multiprocessing Pool.
+
+Reference models: python/ray/tests/test_actor_pool.py, test_queue.py,
+util/multiprocessing tests.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Queue
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def pool_actors(cluster):
+    @ray_tpu.remote
+    class Doubler:
+        def double(self, x):
+            return 2 * x
+
+        def slow_double(self, x):
+            time.sleep(0.05 * (3 - x % 3))
+            return 2 * x
+
+    actors = [Doubler.options(num_cpus=0.5).remote() for _ in range(2)]
+    yield actors
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_map_ordered(pool_actors):
+    pool = ActorPool(pool_actors)
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [2 * i for i in range(8)]
+
+
+def test_actor_pool_map_unordered(pool_actors):
+    pool = ActorPool(pool_actors)
+    out = list(
+        pool.map_unordered(lambda a, v: a.slow_double.remote(v), range(6))
+    )
+    assert sorted(out) == [2 * i for i in range(6)]
+
+
+def test_actor_pool_submit_get_next(pool_actors):
+    pool = ActorPool(pool_actors)
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)
+    assert pool.get_next() == 20
+    assert pool.get_next() == 40
+    assert not pool.has_next()
+
+
+def test_queue_basic(cluster):
+    q = Queue(maxsize=3)
+    try:
+        q.put("a")
+        q.put("b")
+        assert q.qsize() == 2
+        assert q.get() == "a"
+        assert q.get() == "b"
+        with pytest.raises(Empty):
+            q.get_nowait()
+    finally:
+        q.shutdown()
+
+
+def test_queue_get_timeout(cluster):
+    q = Queue()
+    try:
+        with pytest.raises(Empty):
+            q.get(timeout=0.2)
+    finally:
+        q.shutdown()
+
+
+def test_queue_cross_process(cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return n
+
+    try:
+        ref = producer.remote(q, 5)
+        got = [q.get(timeout=10) for _ in range(5)]
+        assert got == list(range(5))
+        assert ray_tpu.get(ref) == 5
+    finally:
+        q.shutdown()
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_mp_pool_map(cluster):
+    with Pool(processes=2) as pool:
+        assert pool.map(_square, range(10)) == [i * i for i in range(10)]
+
+
+def test_mp_pool_starmap_apply(cluster):
+    with Pool(processes=2) as pool:
+        assert pool.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert pool.apply(_add, (5, 6)) == 11
+        r = pool.apply_async(_square, (9,))
+        assert r.get(timeout=30) == 81
+
+
+def test_mp_pool_imap_unordered(cluster):
+    with Pool(processes=2) as pool:
+        out = sorted(pool.imap_unordered(_square, range(6), chunksize=2))
+        assert out == sorted(i * i for i in range(6))
+    with pytest.raises(ValueError):
+        pool.map(_square, [1])

@@ -10,6 +10,11 @@ from ray_tpu.train.step import (
     init_train_state,
     state_logical_axes,
 )
+from ray_tpu.train.checkpoint import (
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from ray_tpu.train.session import get_checkpoint, get_context, report
 from ray_tpu.train.trainer import (
     FailureConfig,
@@ -20,6 +25,9 @@ from ray_tpu.train.trainer import (
 )
 
 __all__ = [
+    "CheckpointManager",
+    "restore_checkpoint",
+    "save_checkpoint",
     "TrainState",
     "make_optimizer",
     "make_train_step",

@@ -54,15 +54,21 @@ class ActorPool:
                 f"result #{idx} was already taken (mixed get_next with "
                 "get_next_unordered?)"
             )
-        # Read without mutating: on timeout the result must stay
-        # retrievable and the actor must not leak.
         ref = self._index_to_future[idx]
-        value = ray_tpu.get(ref, timeout=timeout)
+        if timeout is not None:
+            # Probe readiness without consuming pool state: a timeout
+            # must leave the result retrievable and the actor tracked.
+            ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+            if not ready:
+                raise TimeoutError(f"result #{idx} not ready in {timeout}s")
+        # Free the actor BEFORE fetching: a task that raised must not
+        # wedge the pool (its error re-raises here, but the actor is back
+        # in rotation and the index has advanced).
         del self._index_to_future[idx]
         self._next_return_index += 1
         self._idle.append(self._future_to_actor.pop(ref))
         self._drain_pending()
-        return value
+        return ray_tpu.get(ref)
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:
         """Next result in completion order."""
@@ -78,10 +84,9 @@ class ActorPool:
             if r is ref:
                 del self._index_to_future[idx]
                 break
-        value = ray_tpu.get(ref)
         self._idle.append(self._future_to_actor.pop(ref))
         self._drain_pending()
-        return value
+        return ray_tpu.get(ref)  # may re-raise the task's error
 
     def map(self, fn: Callable, values: Iterable) -> Iterator:
         for v in values:

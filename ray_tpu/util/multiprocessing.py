@@ -33,6 +33,10 @@ class AsyncResult:
         return len(ready) == len(self._refs)
 
     def successful(self) -> bool:
+        if not self.ready():
+            # stdlib contract: raises rather than conflating "pending"
+            # with "failed".
+            raise ValueError("result is not ready")
         try:
             self.get(timeout=0)
             return True
@@ -49,6 +53,8 @@ class Pool:
         self._processes = processes or 8
         self._run_chunk = ray_tpu.remote(_run_chunk)
         self._closed = False
+        # Refs handed out via *_async: join() must block on them.
+        self._outstanding: list = []
 
     def _windowed(self, fn, chunks, star: bool):
         """Yield chunk results in order with ≤ `processes` in flight."""
@@ -86,6 +92,7 @@ class Pool:
             self._run_chunk.remote(fn, chunk, False)
             for chunk in self._chunks(iterable, chunksize)
         ]
+        self._outstanding.extend(refs)
         return _FlattenResult(refs)
 
     def starmap(self, fn, iterable, chunksize=None) -> list:
@@ -102,7 +109,9 @@ class Pool:
     def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
         self._check_open()
         task = ray_tpu.remote(fn)
-        return AsyncResult([task.remote(*args, **(kwds or {}))], single=True)
+        ref = task.remote(*args, **(kwds or {}))
+        self._outstanding.append(ref)
+        return AsyncResult([ref], single=True)
 
     def imap(self, fn, iterable, chunksize=1):
         self._check_open()
@@ -137,6 +146,15 @@ class Pool:
     def join(self):
         if not self._closed:
             raise ValueError("Pool is still open")
+        # Block until everything submitted via *_async has finished
+        # (stdlib contract: close()+join() waits for outstanding work).
+        if self._outstanding:
+            ray_tpu.wait(
+                self._outstanding,
+                num_returns=len(self._outstanding),
+                timeout=None,
+            )
+            self._outstanding = []
 
     def _check_open(self):
         if self._closed:

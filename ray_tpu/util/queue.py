@@ -7,17 +7,18 @@ a _QueueActor; put/get work from any process holding the handle.
 from __future__ import annotations
 
 import asyncio
+import queue as _stdlib_queue
 from typing import Any
 
 import ray_tpu
 
 
-class Empty(Exception):
-    pass
+class Empty(_stdlib_queue.Empty):
+    """Subclasses queue.Empty so `except queue.Empty` keeps working."""
 
 
-class Full(Exception):
-    pass
+class Full(_stdlib_queue.Full):
+    """Subclasses queue.Full so `except queue.Full` keeps working."""
 
 
 class _QueueActor:

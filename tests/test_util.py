@@ -103,6 +103,24 @@ def test_queue_cross_process(cluster):
         q.shutdown()
 
 
+def test_actor_pool_survives_task_error(cluster):
+    @ray_tpu.remote
+    class Flaky:
+        def run(self, x):
+            if x == 0:
+                raise ValueError("bad input")
+            return x
+
+    pool = ActorPool([Flaky.options(num_cpus=0.5).remote()])
+    for v in (0, 1, 2):
+        pool.submit(lambda a, v: a.run.remote(v), v)
+    with pytest.raises(Exception):
+        pool.get_next()
+    # The error must not wedge the pool: later results still arrive.
+    assert pool.get_next() == 1
+    assert pool.get_next() == 2
+
+
 def _square(x):
     return x * x
 
@@ -122,6 +140,8 @@ def test_mp_pool_starmap_apply(cluster):
         assert pool.apply(_add, (5, 6)) == 11
         r = pool.apply_async(_square, (9,))
         assert r.get(timeout=30) == 81
+        assert r.successful() is True
+    pool.join()  # closed by __exit__; join drains outstanding refs
 
 
 def test_mp_pool_imap_unordered(cluster):

@@ -82,7 +82,12 @@ class LLMEngine:
         self.params = params
         self.cache = init_kv_cache(cfg, max_batch, self.max_seq)
 
-        self._prefill = jax.jit(partial(forward_prefill, cfg=cfg))
+        # Flash prefill on a bare TPU backend; under a mesh the dense
+        # path keeps XLA's SPMD partitioner in charge.
+        use_flash = mesh is None and jax.default_backend() == "tpu"
+        self._prefill = jax.jit(
+            partial(forward_prefill, cfg=cfg, use_flash=use_flash)
+        )
         self._decode = jax.jit(partial(forward_decode, cfg=cfg))
         self._queue: list[_Request] = []
         self._active: dict[int, _Request] = {}  # slot → request

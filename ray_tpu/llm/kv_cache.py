@@ -63,24 +63,38 @@ def forward_prefill(
     cache: KVCache,
     slot: jnp.ndarray,  # scalar int32: which cache row to fill
     cfg: LlamaConfig,
+    use_flash: bool = False,
 ) -> tuple[jnp.ndarray, KVCache]:
     """Run the prompt through the model, writing K/V into cache[:, slot].
 
     Returns logits [1, S_pad, V] (caller reads position true_len-1) and
     the updated cache. Padding tokens write garbage K/V beyond true_len —
     harmless: decode masks keys at positions > its own current length and
-    overwrites them one by one.
+    overwrites them one by one. ``use_flash`` routes attention through the
+    Pallas flash kernel (forward-only path, so no VJP needed).
     """
     seq = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    # 512 = the kernel's default block_kv: seq must divide by it.
+    flash_ok = use_flash and seq >= 512 and seq % 512 == 0
+
+    def attend(q, k, v):
+        if flash_ok:
+            from ray_tpu.ops.pallas import flash_attention
+
+            # interpret mode runs the same kernel on CPU (tests).
+            return flash_attention(
+                q, k, v, interpret=jax.default_backend() != "tpu"
+            )
+        return causal_attention(q, k, v)
 
     def body(x, layer):
         p, k_row, v_row = layer
         q, k, v = _project_qkv(x, p, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        attn = causal_attention(q, k, v)
+        attn = attend(q, k, v)
         x = x + attn.reshape(x.shape) @ p["wo"].astype(cfg.dtype)
         x = _mlp(x, p, cfg)
         # [B=1, S, Hkv, Dh] → write into this layer's [Bmax, Smax, ...] row.

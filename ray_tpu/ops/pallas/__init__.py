@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7: "pallas kernels for
+the hot ops"). CPU tests run these with interpret=True."""
+
+from ray_tpu.ops.pallas.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

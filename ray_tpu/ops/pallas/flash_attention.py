@@ -1,0 +1,157 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Online-softmax attention (Dao et al.) tiled for the MXU: the kernel never
+materializes the [S, S] score matrix — each (q-block, kv-block) grid step
+rescales a running (max, denom, acc) triple held in VMEM scratch, which
+persists across the innermost (sequential) grid dimension on TPU. Causal
+blocks strictly above the diagonal are skipped entirely, halving the work.
+
+The reference has no attention kernels at all (SURVEY.md §5 long-context
+row: delegated to vLLM/user code); this is native.
+
+Layout: [B, S, H, D] (the model's convention). GQA is handled by index
+mapping: q head h reads kv head h // (H // Hkv) — no materialized repeat.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = float("-inf")
+_LANES = 128  # TPU vector lane count: scratch stats are lane-replicated
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, block_q: int, block_kv: int, num_kv: int, scale: float, causal: bool,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # Causal: a kv block strictly above the diagonal contributes nothing.
+    first_masked = (qi + 1) * block_q  # kv positions >= this are masked
+    run = jnp.logical_or(
+        not causal, ki * block_kv < first_masked
+    )
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)  # [block_kv, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_kv]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(kv_pos > q_pos, _NEG_INF, s)
+
+        m_prev = m_ref[:, 0]  # [block_q]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        # All-masked rows keep m == -inf; exp(-inf - -inf) would be NaN.
+        safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - safe_m[:, None])
+        p = jnp.where(s == _NEG_INF, 0.0, p)
+        alpha = jnp.where(
+            m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - safe_m)
+        )
+        l_new = alpha * l_prev + p.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        denom = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → 0 output
+        o_ref[0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_kv", "interpret", "scale"),
+)
+def flash_attention(
+    q: jnp.ndarray,  # [B, S, H, D]
+    k: jnp.ndarray,  # [B, S, Hkv, D]
+    v: jnp.ndarray,  # [B, S, Hkv, D]
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 256,
+    block_kv: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if h % hkv:
+        raise ValueError(f"n_heads={h} not divisible by n_kv={hkv}")
+    n_rep = h // hkv
+    block_q = min(block_q, s)
+    block_kv = min(block_kv, s)
+    if s % block_q or s % block_kv:
+        raise ValueError(f"seq {s} not divisible by blocks {block_q}/{block_kv}")
+    if scale is None:
+        scale = d**-0.5
+    num_q, num_kv = s // block_q, s // block_kv
+
+    # [B, S, H, D] → [B*H, S, D]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+
+    kernel = functools.partial(
+        _flash_kernel,
+        block_q=block_q,
+        block_kv=block_kv,
+        num_kv=num_kv,
+        scale=scale,
+        causal=causal,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec(
+                (1, block_kv, d),
+                lambda bh, qi, ki, n_rep=n_rep: (bh // n_rep, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_kv, d),
+                lambda bh, qi, ki, n_rep=n_rep: (bh // n_rep, ki, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)

@@ -35,7 +35,11 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None) -> str:
     before restoring.
     """
     path = os.path.abspath(path)
-    tmp = f"{path}.tmp-{os.getpid()}"
+    _recover_interrupted_swap(path)
+    # Deterministic suffixes: in multi-host mode every process must
+    # target the SAME tmp dir for orbax's collective write.
+    tmp = f"{path}.tmp"
+    old = f"{path}.old"
     is_lead = jax.process_index() == 0
     if is_lead and os.path.exists(tmp):
         shutil.rmtree(tmp)
@@ -47,12 +51,24 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None) -> str:
     if metadata is not None:
         with open(os.path.join(tmp, "metadata.json"), "w") as f:
             json.dump(metadata, f)
-    old = f"{path}.old-{os.getpid()}"
     if os.path.exists(path):
         os.rename(path, old)
     os.rename(tmp, path)
     shutil.rmtree(old, ignore_errors=True)
     return path
+
+
+def _recover_interrupted_swap(path: str) -> None:
+    """A crash between the two renames in save_checkpoint leaves the
+    previous copy at `<path>.old` and nothing at `path`; put it back."""
+    old = f"{path}.old"
+    if not os.path.exists(old):
+        return
+    if os.path.exists(path):
+        # Crash landed after the swap but before cleanup: drop the stale copy.
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(old, path)
 
 
 def restore_checkpoint(
@@ -63,7 +79,9 @@ def restore_checkpoint(
     the restored arrays — pass the training mesh's shardings to resume a
     run on a different mesh layout than it was saved from."""
     ckptr = _checkpointer()
-    state_path = os.path.join(os.path.abspath(path), "state")
+    path = os.path.abspath(path)
+    _recover_interrupted_swap(path)
+    state_path = os.path.join(path, "state")
     if target is None:
         return ckptr.restore(state_path)
     if shardings is not None:
@@ -107,6 +125,13 @@ class CheckpointManager:
         self.score_order = score_order
 
     def _entries(self) -> list[tuple[int, str]]:
+        # Recover any checkpoint whose save crashed mid-swap first, so
+        # latest()/best() never silently skip it.
+        for name in os.listdir(self.dir):
+            if name.endswith(".old"):
+                _recover_interrupted_swap(
+                    os.path.join(self.dir, name[: -len(".old")])
+                )
         out = []
         for name in os.listdir(self.dir):
             if name.startswith("ckpt-"):

@@ -13,12 +13,19 @@ import ray_tpu
 
 
 class AsyncResult:
-    def __init__(self, refs: list, single: bool):
+    def __init__(self, refs: list, single: bool, on_consumed=None):
         self._refs = refs
         self._single = single
+        self._on_consumed = on_consumed
+
+    def _consumed(self):
+        if self._on_consumed is not None:
+            self._on_consumed(self._refs)
+            self._on_consumed = None
 
     def get(self, timeout: float | None = None):
         results = ray_tpu.get(self._refs, timeout=timeout)
+        self._consumed()
         return results[0] if self._single else results
 
     def wait(self, timeout: float | None = None):
@@ -53,8 +60,17 @@ class Pool:
         self._processes = processes or 8
         self._run_chunk = ray_tpu.remote(_run_chunk)
         self._closed = False
-        # Refs handed out via *_async: join() must block on them.
+        self._terminated = False
+        # Refs handed out via *_async: join() after close() must block on
+        # them. Consumed results are pruned so the pool doesn't pin every
+        # historical result in the object store.
         self._outstanding: list = []
+
+    def _drop_refs(self, refs: list):
+        ids = {id(r) for r in refs}
+        self._outstanding = [
+            r for r in self._outstanding if id(r) not in ids
+        ]
 
     def _windowed(self, fn, chunks, star: bool):
         """Yield chunk results in order with ≤ `processes` in flight."""
@@ -93,7 +109,7 @@ class Pool:
             for chunk in self._chunks(iterable, chunksize)
         ]
         self._outstanding.extend(refs)
-        return _FlattenResult(refs)
+        return _FlattenResult(refs, on_consumed=self._drop_refs)
 
     def starmap(self, fn, iterable, chunksize=None) -> list:
         self._check_open()
@@ -111,7 +127,7 @@ class Pool:
         task = ray_tpu.remote(fn)
         ref = task.remote(*args, **(kwds or {}))
         self._outstanding.append(ref)
-        return AsyncResult([ref], single=True)
+        return AsyncResult([ref], single=True, on_consumed=self._drop_refs)
 
     def imap(self, fn, iterable, chunksize=1):
         self._check_open()
@@ -142,19 +158,20 @@ class Pool:
 
     def terminate(self):
         self._closed = True
+        self._terminated = True  # join() must NOT wait for abandoned work
 
     def join(self):
         if not self._closed:
             raise ValueError("Pool is still open")
-        # Block until everything submitted via *_async has finished
-        # (stdlib contract: close()+join() waits for outstanding work).
-        if self._outstanding:
+        # stdlib contract: close()+join() waits for outstanding work;
+        # terminate()+join() returns without completing it.
+        if self._outstanding and not self._terminated:
             ray_tpu.wait(
                 self._outstanding,
                 num_returns=len(self._outstanding),
                 timeout=None,
             )
-            self._outstanding = []
+        self._outstanding = []
 
     def _check_open(self):
         if self._closed:
@@ -168,15 +185,17 @@ class Pool:
 
 
 class _FlattenResult(AsyncResult):
-    def __init__(self, refs: list):
-        super().__init__(refs, single=False)
+    def __init__(self, refs: list, on_consumed=None):
+        super().__init__(refs, single=False, on_consumed=on_consumed)
 
     def get(self, timeout: float | None = None):
-        return list(
+        out = list(
             itertools.chain.from_iterable(
                 ray_tpu.get(self._refs, timeout=timeout)
             )
         )
+        self._consumed()
+        return out
 
 
 def _run_chunk(fn: Callable, chunk: list, star: bool) -> list:

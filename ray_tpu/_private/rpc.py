@@ -199,9 +199,17 @@ class Server:
     async def stop(self):
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        # Close accepted connections BEFORE wait_closed: since 3.12,
+        # wait_closed blocks until every connection the server is
+        # handling finishes — a live peer (e.g. the head's conn to a
+        # stopping node) would hang shutdown forever.
         for conn in list(self.connections):
             await conn.close()
+        if self._server:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2)
+            except asyncio.TimeoutError:
+                pass
 
 
 async def connect(

@@ -1,0 +1,25 @@
+"""Autoscaler (v2-style): poll demand → bin-pack → drive a node provider.
+
+Reference architecture: python/ray/autoscaler/v2/autoscaler.py:50 polls
+GcsAutoscalerStateManager, v2/scheduler.py bin-packs pending demand onto
+node types, InstanceManager (v2/instance_manager/instance_manager.py:29)
+drives cloud providers. TPU twist: a slice is the atomic unit — a
+node type models a whole slice (all its hosts come and go together).
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, NodeTypeConfig
+from ray_tpu.autoscaler.providers import (
+    FakeNodeProvider,
+    GkeTpuNodeProvider,
+    NodeProvider,
+)
+from ray_tpu.autoscaler.scheduler import fit_demand
+
+__all__ = [
+    "Autoscaler",
+    "FakeNodeProvider",
+    "GkeTpuNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "fit_demand",
+]

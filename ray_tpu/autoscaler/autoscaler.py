@@ -1,0 +1,178 @@
+"""The autoscaler control loop.
+
+Reference: v2 Autoscaler (autoscaler/v2/autoscaler.py:50): each tick,
+read cluster resource state from the head, bin-pack unmet demand into new
+nodes (scheduler.py), launch via the provider, and reap nodes idle past
+the timeout. Runs in the driver process as a plain thread-driven loop
+(the reference runs it in the monitor process on the head node).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger("ray_tpu.autoscaler")
+
+from ray_tpu import api as core_api
+from ray_tpu.autoscaler.providers import NodeProvider
+from ray_tpu.autoscaler.scheduler import fit_demand
+
+
+@dataclass
+class NodeTypeConfig:
+    resources: dict
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class _TrackedNode:
+    provider_id: str
+    node_type: str
+    idle_since: float | None = None
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        provider: NodeProvider,
+        node_types: dict[str, NodeTypeConfig],
+        *,
+        idle_timeout_s: float = 30.0,
+        interval_s: float = 1.0,
+    ):
+        self.provider = provider
+        self.node_types = node_types
+        self.idle_timeout_s = idle_timeout_s
+        self.interval_s = interval_s
+        self._tracked: dict[str, _TrackedNode] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.last_status: dict = {}
+
+    # ----------------------------------------------------------- control
+    def start(self):
+        for name, cfg in self.node_types.items():
+            for _ in range(cfg.min_workers):
+                self._launch(name)
+        self._thread = threading.Thread(
+            target=self._loop, name="ray_tpu_autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.update()
+            except Exception as e:  # noqa: BLE001 - keep autoscaling alive
+                logger.exception("autoscaler tick failed")
+                self.last_status = {"error": repr(e), "ts": time.time()}
+
+    # ------------------------------------------------------------- tick
+    def _cluster_status(self) -> dict:
+        rt = core_api._runtime
+
+        async def go():
+            return await rt.core.head.call("cluster_status")
+
+        return rt.run(go())
+
+    def _launch(self, node_type: str):
+        pid = self.provider.create_node(
+            node_type, self.node_types[node_type].resources
+        )
+        self._tracked[pid] = _TrackedNode(pid, node_type)
+
+    def update(self):
+        """One reconcile tick (public for deterministic tests)."""
+        status = self._cluster_status()
+        nodes = status["nodes"]
+
+        # Demand = per-node queued leases + cluster-wide unschedulable.
+        demand = list(status.get("unschedulable", []))
+        for n in nodes.values():
+            demand.extend(n.get("pending", []))
+
+        counts: dict[str, int] = {}
+        for t in self._tracked.values():
+            counts[t.node_type] = counts.get(t.node_type, 0) + 1
+
+        free = [dict(n["available"]) for n in nodes.values()]
+        # Credit capacity of launched-but-not-yet-registered nodes (real
+        # providers take minutes to boot a slice): without this, every
+        # tick re-launches for the same unmet demand.
+        registered = set(nodes)
+        for pid, tracked in self._tracked.items():
+            rid = self.provider.runtime_node_id(pid)
+            if rid is None or rid not in registered:
+                free.append(
+                    dict(self.node_types[tracked.node_type].resources)
+                )
+        to_add = fit_demand(
+            demand,
+            {
+                name: {
+                    "resources": cfg.resources,
+                    "max_workers": cfg.max_workers,
+                }
+                for name, cfg in self.node_types.items()
+            },
+            counts,
+            free,
+        )
+        for name, count in to_add.items():
+            for _ in range(count):
+                self._launch(name)
+
+        # Idle termination: a provider-launched node whose available ==
+        # total (nothing leased) for idle_timeout_s goes away, floored at
+        # min_workers per type.
+        now = time.monotonic()
+        runtime_ids = {
+            self.provider.runtime_node_id(pid): pid for pid in self._tracked
+        }
+        for nid, n in nodes.items():
+            pid = runtime_ids.get(nid)
+            if pid is None:
+                continue
+            tracked = self._tracked[pid]
+            busy = any(
+                n["available"].get(k, 0) < v
+                for k, v in n["resources"].items()
+            ) or n.get("pending")
+            if busy:
+                tracked.idle_since = None
+            elif tracked.idle_since is None:
+                tracked.idle_since = now
+
+        for pid, tracked in list(self._tracked.items()):
+            cfg = self.node_types[tracked.node_type]
+            alive_of_type = sum(
+                1
+                for t in self._tracked.values()
+                if t.node_type == tracked.node_type
+            )
+            if (
+                tracked.idle_since is not None
+                and now - tracked.idle_since > self.idle_timeout_s
+                and alive_of_type > cfg.min_workers
+            ):
+                self.provider.terminate_node(pid)
+                del self._tracked[pid]
+
+        self.last_status = {
+            "demand": demand,
+            "added": to_add,
+            "tracked": {
+                pid: t.node_type for pid, t in self._tracked.items()
+            },
+        }
+        return self.last_status

@@ -1,0 +1,125 @@
+"""Node providers: how the autoscaler actually adds/removes capacity.
+
+Reference: autoscaler node providers (aws/gcp/kuberay under
+python/ray/autoscaler/_private and v2/instance_manager); tests use a fake
+provider (reference: cluster_utils.py:26 AutoscalingCluster). Here the
+fake provider starts real NodeManager daemons in-process — the same
+multi-raylet-on-one-host strategy the reference test suite uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any
+
+
+class NodeProvider:
+    """ABC: create/terminate cluster nodes of a given node type."""
+
+    def create_node(self, node_type: str, resources: dict) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        """provider_node_id → node_type."""
+        raise NotImplementedError
+
+    def runtime_node_id(self, provider_node_id: str) -> str | None:
+        """Map a provider node to the runtime node_id it registered as."""
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Launch NodeManager daemons inside the driver's runtime loop."""
+
+    def __init__(self):
+        from ray_tpu import api as core_api
+
+        self._rt = core_api._runtime
+        self._nodes: dict[str, dict] = {}  # pid → {node, type}
+
+    def create_node(self, node_type: str, resources: dict) -> str:
+        from ray_tpu.runtime.node import NodeManager
+
+        rt = self._rt
+
+        async def launch():
+            node = NodeManager(
+                rt.core.head_addr,
+                rt.core.store.dir.as_posix(),
+                resources=dict(resources),
+            )
+            await node.start()
+            return node
+
+        node = self._rt.run(launch())
+        pid = f"fake-{uuid.uuid4().hex[:8]}"
+        self._nodes[pid] = {"node": node, "type": node_type}
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        rec = self._nodes.pop(provider_node_id, None)
+        if rec is None:
+            return
+        self._rt.run(rec["node"].stop())
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        return {pid: rec["type"] for pid, rec in self._nodes.items()}
+
+    def runtime_node_id(self, provider_node_id: str) -> str | None:
+        rec = self._nodes.get(provider_node_id)
+        return rec["node"].node_id if rec else None
+
+
+class GkeTpuNodeProvider(NodeProvider):
+    """GKE TPU slice provider (stub: zero-egress image — documents the
+    protocol; real deployments implement `_gke_api` with the Kubernetes
+    client).
+
+    TPU specifics vs generic cloud VMs (reference:
+    python/ray/_private/accelerators/tpu.py metadata env handling,
+    util/tpu.py SlicePlacementGroup):
+    - The unit is a SLICE (node pool with tpu-topology); hosts within a
+      slice share ICI and must be created/deleted together.
+    - `create_node(node_type)` → scale the matching node pool by one
+      replica group; all hosts of the new slice register as nodes
+      carrying `TPU-<gen>-head` + slice labels.
+    - Losing any host kills the slice: terminate reaps the whole group.
+    """
+
+    def __init__(self, cluster: str, node_pools: dict[str, dict]):
+        self.cluster = cluster
+        self.node_pools = node_pools
+        self._nodes: dict[str, str] = {}
+
+    def _gke_api(self, verb: str, **kw: Any):
+        raise NotImplementedError(
+            "GKE API access is not available in this environment; "
+            "subclass GkeTpuNodeProvider and implement _gke_api with "
+            "the kubernetes client."
+        )
+
+    def create_node(self, node_type: str, resources: dict) -> str:
+        pool = self.node_pools[node_type]
+        reply = self._gke_api(
+            "scale_node_pool",
+            pool=pool["name"],
+            delta=+1,
+            topology=pool.get("topology"),
+        )
+        pid = reply["instance_group_id"]
+        self._nodes[pid] = node_type
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self._gke_api("delete_instance_group", group=provider_node_id)
+        self._nodes.pop(provider_node_id, None)
+
+    def non_terminated_nodes(self) -> dict[str, str]:
+        return dict(self._nodes)
+
+    def runtime_node_id(self, provider_node_id: str) -> str | None:
+        return None  # resolved via node labels at registration time

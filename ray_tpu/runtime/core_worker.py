@@ -533,6 +533,16 @@ class CoreWorker:
         async def request():
             try:
                 reply = await self.node.call("lease_worker", resources=resources)
+                if not reply.get("ok") and (
+                    reply.get("infeasible") or reply.get("retry_spill")
+                ):
+                    # Local node can never satisfy this (infeasible) or
+                    # kept us queued past its age limit (retry_spill):
+                    # spill via the head (reference: lease spillback,
+                    # retry_at_raylet_address node_manager.proto:78). If
+                    # the whole cluster is infeasible, poll — the
+                    # autoscaler may add a node.
+                    reply = await self._spill_lease(resources)
                 if not reply.get("ok"):
                     raise rpc.RpcError(reply.get("error", "lease failed"))
                 reply["sched_key"] = key
@@ -550,6 +560,50 @@ class CoreWorker:
                 self._maybe_request_lease(key, resources)
 
         asyncio.ensure_future(request())
+
+    async def _spill_lease(self, resources: dict, actor: bool = False) -> dict:
+        """Find a feasible node through the head and lease there.
+
+        The timeout clock only runs while the WHOLE cluster is infeasible
+        (waiting for the autoscaler); when a feasible node exists but is
+        saturated, we keep cycling through its queue indefinitely — a
+        busy cluster must not fail queued tasks.
+        """
+        import os
+        import uuid
+
+        loop = asyncio.get_running_loop()
+        timeout_s = float(os.environ.get("RAY_TPU_SCHED_TIMEOUT_S", "60"))
+        deadline = loop.time() + timeout_s
+        requester = uuid.uuid4().hex  # dedups this wait's demand at the head
+        while True:
+            reply = await self.head.call(
+                "pick_node", resources=resources, requester=requester
+            )
+            if reply.get("ok"):
+                deadline = loop.time() + timeout_s  # feasible: clock resets
+                if reply["addr"] == self.node_addr:
+                    conn = self.node
+                else:
+                    conn = await self._connect(reply["addr"])
+                granted = await conn.call(
+                    "lease_worker", resources=resources, actor=actor
+                )
+                if granted.get("ok"):
+                    granted["node_conn"] = conn
+                    return granted
+                # Chosen node raced away, filled up, or bounced us after
+                # its queue-age limit; re-pick.
+            if loop.time() >= deadline:
+                return {
+                    "ok": False,
+                    "error": (
+                        f"no node can satisfy {resources} (waited "
+                        f"{timeout_s}s for scale-up; set "
+                        "RAY_TPU_SCHED_TIMEOUT_S to wait longer)"
+                    ),
+                }
+            await asyncio.sleep(0.5)
 
     def _offer_lease(self, key: tuple, lease: dict):
         import time
@@ -577,8 +631,11 @@ class CoreWorker:
         self._offer_lease(lease["sched_key"], lease)
 
     async def _give_back(self, lease: dict):
+        # Spilled leases carry the conn of the (remote) node that granted
+        # them; returning to the local node would leak the remote lease.
+        conn = lease.get("node_conn") or self.node
         try:
-            await self.node.call("return_lease", lease_id=lease["lease_id"])
+            await conn.call("return_lease", lease_id=lease["lease_id"])
         except rpc.RpcError:
             pass
 
@@ -625,11 +682,18 @@ class CoreWorker:
             )
         else:
             node_conn = self.node
+            req = dict(resources or {"CPU": 1.0})
             reply = await node_conn.call(
-                "lease_worker",
-                resources=dict(resources or {"CPU": 1.0}),
-                actor=True,
+                "lease_worker", resources=req, actor=True
             )
+            if not reply.get("ok") and (
+                reply.get("infeasible") or reply.get("retry_spill")
+            ):
+                # Same spillback as normal tasks: find a feasible node
+                # via the head (and wait out autoscaler scale-up).
+                reply = await self._spill_lease(req, actor=True)
+                if reply.get("ok"):
+                    node_conn = reply["node_conn"]
         if not reply.get("ok"):
             raise rpc.RpcError(reply.get("error", "actor lease failed"))
         fn_id = await self.export_function(cls)

@@ -45,6 +45,12 @@ class HeadService:
         self.task_latest: collections.OrderedDict = collections.OrderedDict()
         # worker addr → latest metrics snapshot {name: record}
         self.metrics: dict[str, dict] = {}
+        # Cluster-wide infeasible lease demand, deduped per waiting
+        # request: requester id → (resources, ts). Each spill-waiting
+        # request refreshes its single entry, so one pending lease reads
+        # as ONE demand unit, and entries age out seconds after the
+        # requester stops polling (granted or gave up).
+        self.unschedulable: dict[str, tuple[dict, float]] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         p = await self.server.start(host, port)
@@ -88,13 +94,34 @@ class HeadService:
         self.publish("node", {"event": "added", "node_id": node_id, "addr": addr})
         return {"ok": True}
 
-    async def _on_heartbeat(self, conn, node_id: str, available: dict):
+    async def _on_heartbeat(
+        self, conn, node_id: str, available: dict, pending: list | None = None
+    ):
         node = self.nodes.get(node_id)
         if node is None:
             return {"ok": False, "reregister": True}
         node["last_seen"] = time.monotonic()
         node["available"] = available
+        node["pending"] = pending or []
         return {"ok": True}
+
+    async def _on_cluster_status(self, conn):
+        """Autoscaler poll: per-node totals/available/pending demand
+        (reference: GcsAutoscalerStateManager.GetClusterResourceState)."""
+        self._expire_unschedulable()
+        return {
+            "unschedulable": [r for r, _ts in self.unschedulable.values()],
+            "nodes": {
+                nid: {
+                    "addr": n["addr"],
+                    "resources": n["resources"],
+                    "available": n["available"],
+                    "pending": n.get("pending", []),
+                    "labels": n.get("labels", {}),
+                }
+                for nid, n in self.nodes.items()
+            }
+        }
 
     async def _on_node_table(self, conn):
         return {
@@ -102,7 +129,9 @@ class HeadService:
             for nid, n in self.nodes.items()
         }
 
-    async def _on_pick_node(self, conn, resources: dict | None = None):
+    async def _on_pick_node(
+        self, conn, resources: dict | None = None, requester: str | None = None
+    ):
         """Cluster-level placement: pick a feasible node for a lease.
 
         Reference analogue: the hybrid scheduling policy's feasibility +
@@ -125,8 +154,25 @@ class HeadService:
             if best_score is None or score > best_score:
                 best, best_score = nid, score
         if best is None:
+            # Record cluster-wide unschedulable demand: the autoscaler's
+            # strongest scale-up signal (reference: pending demand in
+            # GetClusterResourceState feeding v2/scheduler.py).
+            if requester is not None:
+                self.unschedulable[requester] = (
+                    dict(resources), time.monotonic()
+                )
+                if len(self.unschedulable) > 10000:
+                    self._expire_unschedulable()
             return {"ok": False, "error": "no feasible node"}
+        if requester is not None:
+            self.unschedulable.pop(requester, None)
         return {"ok": True, "node_id": best, "addr": self.nodes[best]["addr"]}
+
+    def _expire_unschedulable(self, ttl: float = 5.0):
+        now = time.monotonic()
+        for key, (_r, ts) in list(self.unschedulable.items()):
+            if now - ts > ttl:
+                del self.unschedulable[key]
 
     # ------------------------------------------------------------- kv
     async def _on_kv_put(self, conn, key: str, value: bytes, overwrite=True):

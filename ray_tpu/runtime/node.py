@@ -26,6 +26,7 @@ from ray_tpu._private.ids import NodeID, WorkerID
 
 IDLE_WORKER_CAP = 4  # idle processes kept warm per node
 SPAWN_TIMEOUT_S = 30.0
+PENDING_SPILL_S = 2.0  # queued lease age before bouncing to spillback
 
 
 def detect_resources() -> dict[str, float]:
@@ -99,7 +100,12 @@ class NodeManager:
         self.workers: dict[str, dict] = {}
         self.idle: list[str] = []
         self.leases: dict[str, Lease] = {}
-        self._pending: list[tuple[dict, bool, asyncio.Future]] = []
+        # (resources, actor, fut, enqueued_at): queued feasible-but-
+        # unavailable lease requests. Entries older than PENDING_SPILL_S
+        # are bounced with retry_spill so the caller can try another
+        # node via the head (lease spillback) instead of camping here
+        # while new capacity sits idle elsewhere.
+        self._pending: list[tuple[dict, bool, asyncio.Future, float]] = []
         # (pg_id, index) → {"total": resources, "available": resources}
         self.bundles: dict[tuple, dict] = {}
         self._worker_waiters: "collections.deque[asyncio.Future]" = (
@@ -321,7 +327,9 @@ class NodeManager:
         if self._available(resources):
             return await self._grant_lease(resources, actor)
         fut = asyncio.get_running_loop().create_future()
-        self._pending.append((resources, actor, fut))
+        self._pending.append(
+            (resources, actor, fut, asyncio.get_running_loop().time())
+        )
         return await fut
 
     def _credit_bundle(self, lease: "Lease"):
@@ -403,12 +411,20 @@ class NodeManager:
             proc.kill()
 
     def _drain_pending(self):
+        now = asyncio.get_event_loop().time()
         still = []
-        for resources, actor, fut in self._pending:
-            if not fut.done() and self._available(resources):
+        for resources, actor, fut, ts in self._pending:
+            if fut.done():
+                continue
+            if self._available(resources):
                 asyncio.ensure_future(self._fulfil(resources, actor, fut))
-            elif not fut.done():
-                still.append((resources, actor, fut))
+            elif now - ts > PENDING_SPILL_S:
+                fut.set_result(
+                    {"ok": False, "retry_spill": True,
+                     "error": "queued past age limit; spill via head"}
+                )
+            else:
+                still.append((resources, actor, fut, ts))
         self._pending = still
 
     async def _fulfil(self, resources, actor, fut):
@@ -426,7 +442,15 @@ class NodeManager:
             await asyncio.sleep(2.0)
             try:
                 await self.head.call(
-                    "heartbeat", node_id=self.node_id, available=self.available
+                    "heartbeat",
+                    node_id=self.node_id,
+                    available=self.available,
+                    # Feasible-but-queued lease demand: a scale-up signal
+                    # (reference: raylets report resource_load_by_shape
+                    # to GCS for GcsAutoscalerStateManager). Cluster-wide
+                    # infeasible demand is recorded by the head itself in
+                    # pick_node.
+                    pending=[dict(r) for r, _a, _f, _t in self._pending],
                 )
             except rpc.RpcError:
                 pass
@@ -437,6 +461,9 @@ class NodeManager:
         disconnect, SURVEY.md section 5)."""
         while True:
             await asyncio.sleep(1.0)
+            # Age-bounce stale queued leases even when no grant/return
+            # event fires (the age check lives in _drain_pending).
+            self._drain_pending()
             dead = [
                 wid
                 for wid, w in self.workers.items()

@@ -35,14 +35,17 @@ def save_checkpoint(path: str, state: Any, metadata: dict | None = None) -> str:
     before restoring.
     """
     path = os.path.abspath(path)
-    _recover_interrupted_swap(path)
     # Deterministic suffixes: in multi-host mode every process must
     # target the SAME tmp dir for orbax's collective write.
     tmp = f"{path}.tmp"
     old = f"{path}.old"
     is_lead = jax.process_index() == 0
-    if is_lead and os.path.exists(tmp):
-        shutil.rmtree(tmp)
+    if is_lead:
+        # Lead-only: a non-lead recovering concurrently with the lead's
+        # two-rename swap would resurrect the old dir mid-swap.
+        _recover_interrupted_swap(path)
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
     ckptr = _checkpointer()
     ckptr.save(os.path.join(tmp, "state"), state)
     ckptr.wait_until_finished()
@@ -80,7 +83,8 @@ def restore_checkpoint(
     run on a different mesh layout than it was saved from."""
     ckptr = _checkpointer()
     path = os.path.abspath(path)
-    _recover_interrupted_swap(path)
+    if jax.process_index() == 0:
+        _recover_interrupted_swap(path)
     state_path = os.path.join(path, "state")
     if target is None:
         return ckptr.restore(state_path)

@@ -24,7 +24,15 @@ class AsyncResult:
             self._on_consumed = None
 
     def get(self, timeout: float | None = None):
-        results = ray_tpu.get(self._refs, timeout=timeout)
+        from ray_tpu.exceptions import GetTimeoutError
+
+        try:
+            results = ray_tpu.get(self._refs, timeout=timeout)
+        except GetTimeoutError:
+            raise  # still in flight: keep the refs tracked
+        except Exception:
+            self._consumed()  # terminal task error: don't pin the refs
+            raise
         self._consumed()
         return results[0] if self._single else results
 

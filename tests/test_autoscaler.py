@@ -1,0 +1,157 @@
+"""Autoscaler tests: bin-packing unit tests + a live scale-up/scale-down
+cycle against the fake provider (reference pattern: cluster_utils.py:26
+AutoscalingCluster over a fake node provider).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    FakeNodeProvider,
+    NodeTypeConfig,
+    fit_demand,
+)
+
+
+def test_fit_demand_uses_headroom():
+    to_add = fit_demand(
+        demand=[{"CPU": 1}, {"CPU": 1}],
+        node_types={"cpu": {"resources": {"CPU": 4}, "max_workers": 5}},
+        existing_counts={},
+        free_by_node=[{"CPU": 2}],
+    )
+    assert to_add == {}  # fits in existing headroom
+
+
+def test_fit_demand_packs_new_nodes():
+    to_add = fit_demand(
+        demand=[{"CPU": 2} for _ in range(4)],
+        node_types={"cpu4": {"resources": {"CPU": 4}, "max_workers": 5}},
+        existing_counts={},
+        free_by_node=[],
+    )
+    assert to_add == {"cpu4": 2}  # 4×2 CPU packs into 2×4-CPU nodes
+
+
+def test_fit_demand_prefers_cheapest_feasible():
+    to_add = fit_demand(
+        demand=[{"TPU": 4}],
+        node_types={
+            "cpu": {"resources": {"CPU": 8}, "max_workers": 5},
+            "v5e-4": {"resources": {"CPU": 4, "TPU": 4}, "max_workers": 2},
+            "v5e-8": {"resources": {"CPU": 8, "TPU": 8}, "max_workers": 2},
+        },
+        existing_counts={},
+        free_by_node=[],
+    )
+    assert to_add == {"v5e-4": 1}
+
+
+def test_fit_demand_respects_max_workers():
+    to_add = fit_demand(
+        demand=[{"CPU": 4} for _ in range(5)],
+        node_types={"cpu4": {"resources": {"CPU": 4}, "max_workers": 2}},
+        existing_counts={"cpu4": 1},
+        free_by_node=[],
+    )
+    assert to_add == {"cpu4": 1}  # cap: 1 existing + 1 new
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=2)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_autoscaler_scales_up_for_infeasible_task(cluster):
+    """A task needing a resource no node has blocks, the autoscaler adds
+    a node of the right type, and the task completes (lease spillback
+    finds the new node)."""
+    provider = FakeNodeProvider()
+    autoscaler = Autoscaler(
+        provider,
+        {"gpuish": NodeTypeConfig(resources={"CPU": 2, "WIDGET": 4})},
+        idle_timeout_s=3600,
+        interval_s=0.25,
+    )
+    autoscaler.start()
+    try:
+        @ray_tpu.remote(resources={"WIDGET": 1})
+        def widget_task():
+            return "made a widget"
+
+        ref = widget_task.remote()
+        assert ray_tpu.get(ref, timeout=60) == "made a widget"
+        assert len(provider.non_terminated_nodes()) == 1
+        assert autoscaler.last_status["tracked"]
+    finally:
+        autoscaler.stop()
+        for pid in list(provider.non_terminated_nodes()):
+            provider.terminate_node(pid)
+
+
+def test_actor_spills_to_scaled_up_node(cluster):
+    """Actor creation (not just tasks) rides the same spillback path."""
+    provider = FakeNodeProvider()
+    autoscaler = Autoscaler(
+        provider,
+        {"gadget": NodeTypeConfig(resources={"CPU": 2, "GADGET": 2})},
+        idle_timeout_s=3600,
+        interval_s=0.25,
+    )
+    autoscaler.start()
+    try:
+        @ray_tpu.remote(resources={"GADGET": 1})
+        class GadgetActor:
+            def ping(self):
+                return "pong"
+
+        a = GadgetActor.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+        ray_tpu.kill(a)
+    finally:
+        autoscaler.stop()
+        for pid in list(provider.non_terminated_nodes()):
+            provider.terminate_node(pid)
+
+
+def test_autoscaler_min_workers_and_idle_termination(cluster):
+    provider = FakeNodeProvider()
+    autoscaler = Autoscaler(
+        provider,
+        {
+            "extra": NodeTypeConfig(
+                resources={"CPU": 1, "EXTRA": 1}, min_workers=1, max_workers=3
+            )
+        },
+        idle_timeout_s=1.0,
+        interval_s=0.2,
+    )
+    autoscaler.start()
+    try:
+        time.sleep(0.5)
+        assert len(provider.non_terminated_nodes()) == 1  # min_workers
+
+        # Drive demand above min: two concurrent EXTRA tasks.
+        @ray_tpu.remote(resources={"EXTRA": 1})
+        def hold(t):
+            time.sleep(t)
+            return 1
+
+        refs = [hold.remote(2.0) for _ in range(2)]
+        assert ray_tpu.get(refs, timeout=60) == [1, 1]
+        # Idle nodes above min_workers are reaped after the timeout.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if len(provider.non_terminated_nodes()) == 1:
+                break
+            time.sleep(0.3)
+        assert len(provider.non_terminated_nodes()) == 1
+    finally:
+        autoscaler.stop()
+        for pid in list(provider.non_terminated_nodes()):
+            provider.terminate_node(pid)

@@ -22,12 +22,6 @@ REQ, RESP, ERR, PUSH = 0, 1, 2, 3
 _HDR = struct.Struct("<I")
 _MAX_FRAME = 1 << 31
 
-# Chaos injection: RAY_TPU_RPC_FAILURE="method:probability" drops requests
-# before send with the given probability (reference: rpc_chaos.h:24,
-# RAY_testing_rpc_failure in ray_config_def.h:850).
-_CHAOS = os.environ.get("RAY_TPU_RPC_FAILURE", "")
-
-
 class RpcError(Exception):
     pass
 
@@ -37,10 +31,17 @@ class ConnectionLost(RpcError):
 
 
 def _chaos_drop(method: str) -> bool:
-    if not _CHAOS:
+    """Chaos injection: RAY_TPU_RPC_FAILURE="method:probability" drops
+    matching requests before send (reference: rpc_chaos.h:24,
+    RAY_testing_rpc_failure ray_config_def.h:850). Read per-call so
+    tests can flip it at runtime; method="*" matches everything."""
+    chaos = os.environ.get("RAY_TPU_RPC_FAILURE", "")
+    if not chaos:
         return False
-    name, _, prob = _CHAOS.partition(":")
-    return method == name and random.random() < float(prob or 0)
+    name, _, prob = chaos.partition(":")
+    return (name == "*" or method == name) and random.random() < float(
+        prob or 0
+    )
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> tuple:
@@ -92,10 +93,19 @@ class Connection:
             return "?"
 
     async def call(self, method: str, timeout: float | None = None, **kw):
+        # Failures raised BEFORE the request hits the wire carry
+        # sent=False: callers holding side resources (e.g. a worker
+        # lease) know the peer never saw the request and can safely
+        # reuse them (reference: rpc_chaos distinguishes request vs
+        # response failures for idempotence testing).
         if self._closed:
-            raise ConnectionLost(f"connection to {self.peer} closed")
+            err = ConnectionLost(f"connection to {self.peer} closed")
+            err.sent = False
+            raise err
         if _chaos_drop(method):
-            raise ConnectionLost(f"chaos: dropped {method}")
+            err = ConnectionLost(f"chaos: dropped {method}")
+            err.sent = False
+            raise err
         self._next_id += 1
         req_id = self._next_id
         fut = asyncio.get_running_loop().create_future()

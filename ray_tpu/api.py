@@ -347,6 +347,7 @@ class ActorClass:
         placement_group=None,
         placement_group_bundle_index=0,
         max_concurrency=None,
+        max_restarts=0,
     ):
         self._cls = cls
         self._resources = resources
@@ -355,6 +356,7 @@ class ActorClass:
         self._pg = placement_group
         self._pg_bundle = placement_group_bundle_index
         self._max_concurrency = max_concurrency
+        self._max_restarts = max_restarts
 
     def options(self, *, lifetime=None, **opts):
         opts = _normalize_options(opts)
@@ -365,6 +367,7 @@ class ActorClass:
             "placement_group": self._pg,
             "placement_group_bundle_index": self._pg_bundle,
             "max_concurrency": self._max_concurrency,
+            "max_restarts": self._max_restarts,
         }
         merged.update(opts)
         return ActorClass(self._cls, **merged)
@@ -380,6 +383,7 @@ class ActorClass:
                 detached=self._detached,
                 placement=_placement_tuple(self._pg, self._pg_bundle),
                 max_concurrency=self._max_concurrency,
+                max_restarts=self._max_restarts,
             )
         )
         return ActorHandle(actor_id, addr, self._cls.__name__)

@@ -33,6 +33,7 @@ class _TrackedNode:
     provider_id: str
     node_type: str
     idle_since: float | None = None
+    launched_at: float = field(default_factory=time.monotonic)
 
 
 class Autoscaler:
@@ -43,11 +44,13 @@ class Autoscaler:
         *,
         idle_timeout_s: float = 30.0,
         interval_s: float = 1.0,
+        boot_grace_s: float = 600.0,
     ):
         self.provider = provider
         self.node_types = node_types
         self.idle_timeout_s = idle_timeout_s
         self.interval_s = interval_s
+        self.boot_grace_s = boot_grace_s
         self._tracked: dict[str, _TrackedNode] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -108,11 +111,18 @@ class Autoscaler:
         free = [dict(n["available"]) for n in nodes.values()]
         # Credit capacity of launched-but-not-yet-registered nodes (real
         # providers take minutes to boot a slice): without this, every
-        # tick re-launches for the same unmet demand.
+        # tick re-launches for the same unmet demand. The credit expires
+        # after boot_grace_s — a provider that cannot map provider ids to
+        # runtime node ids (runtime_node_id → None) must not accrue
+        # phantom capacity forever.
         registered = set(nodes)
+        now = time.monotonic()
         for pid, tracked in self._tracked.items():
             rid = self.provider.runtime_node_id(pid)
-            if rid is None or rid not in registered:
+            booting = (rid is None or rid not in registered) and (
+                now - tracked.launched_at < self.boot_grace_s
+            )
+            if booting:
                 free.append(
                     dict(self.node_types[tracked.node_type].resources)
                 )

@@ -101,6 +101,10 @@ class CoreWorker:
         self._put_index = 0
         self._root_task = TaskID.random()
 
+        # actor_id → freshest known address (updated on head-driven
+        # restarts; handles carry the birth address only).
+        self._actor_addrs: dict[str, str] = {}
+
         # Task-event buffer, flushed to the head periodically (reference:
         # worker-side TaskEventBuffer core_worker/task_event_buffer.h →
         # GcsTaskManager). Bounded: observability must not OOM the worker.
@@ -441,7 +445,12 @@ class CoreWorker:
                 return self._apply_reply(reply, oids)
             except (rpc.ConnectionLost, rpc.RpcError) as e:
                 last_err = e
-                lease = None  # worker is gone; do not return the lease
+                if not getattr(e, "sent", True):
+                    # The request never reached the worker (closed conn
+                    # caught locally, chaos drop): the lease is intact —
+                    # the finally clause returns it for reuse.
+                    continue
+                lease = None  # worker may be gone; do not return the lease
                 continue
             finally:
                 if lease is not None:
@@ -451,16 +460,51 @@ class CoreWorker:
         )
 
     async def _drive_actor_task(self, spec, oids, actor):
-        try:
-            conn = await self._connect(actor.addr)
-            reply = await conn.call(
-                "actor_call", spec=spec, actor_id=actor.actor_id
-            )
-            return self._apply_reply(reply, oids)
-        except (rpc.ConnectionLost, rpc.RpcError) as e:
+        # Prefer the freshest known address: the actor may have been
+        # restarted on a different worker since this handle was created.
+        failure: Exception | None = None
+        addr = actor.addr
+        for _ in range(5):
+            addr = self._actor_addrs.get(actor.actor_id, actor.addr)
+            try:
+                conn = await self._connect(addr)
+                reply = await conn.call(
+                    "actor_call", spec=spec, actor_id=actor.actor_id
+                )
+                return self._apply_reply(reply, oids)
+            except (rpc.ConnectionLost, rpc.RpcError) as e:
+                failure = e
+                if not getattr(e, "sent", True):
+                    # Never reached the wire (chaos drop / locally-closed
+                    # conn): the actor is fine — resend, don't restart.
+                    self._conns.pop(addr, None)
+                    continue
+                break
+        else:
             raise ActorDiedError(
-                f"actor {actor.actor_id[:12]}… died: {e}"
-            ) from e
+                f"actor {actor.actor_id[:12]}…: request could not be sent"
+            ) from failure
+
+        # The request was (possibly) delivered and the connection died.
+        # Report to the head; it restarts the actor if max_restarts
+        # allows. THIS call still fails (it may have half-executed —
+        # actor methods are not idempotent by default), but later calls
+        # pick up the restarted actor's address.
+        try:
+            reply = await self.head.call(
+                "restart_actor", actor_id=actor.actor_id, failed_addr=addr
+            )
+        except rpc.RpcError:
+            reply = {"ok": False}
+        if reply.get("ok"):
+            self._actor_addrs[actor.actor_id] = reply["addr"]
+            raise ActorDiedError(
+                f"actor {actor.actor_id[:12]}… died mid-call and was "
+                f"restarted; this call was lost: {failure}"
+            ) from failure
+        raise ActorDiedError(
+            f"actor {actor.actor_id[:12]}… died: {failure}"
+        ) from failure
 
     def _apply_reply(self, reply: dict, oids: list) -> bool:
         """Returns True when the reply carries a task error."""
@@ -665,6 +709,7 @@ class CoreWorker:
         detached: bool = False,
         placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
         max_concurrency: int | None = None,
+        max_restarts: int = 0,
     ):
         actor_id = ActorID.random().hex()
         if placement is not None:
@@ -716,10 +761,30 @@ class CoreWorker:
             addr=reply["addr"],
             node_id=info["node_id"],
             detached=detached,
+            # Restart spec: everything the head needs to re-create this
+            # actor on a fresh worker (reference: GcsActorManager keeps
+            # the creation TaskSpec for restarts, gcs_actor_manager.h:93).
+            restart_spec={
+                "fn_id": fn_id,
+                "args": self._encode_args(args, kwargs),
+                "resources": dict(resources or {"CPU": 1.0}),
+                "max_concurrency": max_concurrency,
+                "max_restarts": max_restarts,
+            },
         )
         return actor_id, reply["addr"]
 
     async def kill_actor(self, actor_id: str, addr: str):
+        # The handle carries the birth address; a restarted actor lives
+        # elsewhere — kill the CURRENT instance and tell the head this
+        # death is intentional (no restart, name freed).
+        addr = self._actor_addrs.get(actor_id, addr)
+        try:
+            await self.head.call(
+                "update_actor", actor_id=actor_id, state="DEAD"
+            )
+        except rpc.RpcError:
+            pass
         try:
             conn = await self._connect(addr)
             await conn.call("exit_worker")

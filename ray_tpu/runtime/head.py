@@ -200,6 +200,7 @@ class HeadService:
         addr: str,
         node_id: str,
         detached: bool = False,
+        restart_spec: dict | None = None,
     ):
         if name:
             existing = self.named_actors.get(name)
@@ -213,9 +214,87 @@ class HeadService:
             "node_id": node_id,
             "class_name": class_name,
             "detached": detached,
+            "restart_spec": restart_spec,
+            "restarts_used": 0,
         }
         self.publish("actor", {"event": "alive", "actor_id": actor_id})
         return {"ok": True}
+
+    async def _on_restart_actor(self, conn, actor_id: str, failed_addr: str):
+        """Caller-reported actor death → restart if budget remains
+        (reference: GcsActorManager::RestartActor on worker-failure
+        notice; callers resubmit per max_task_retries). Idempotent: all
+        concurrent reporters get the single restart's outcome."""
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"ok": False, "state": "DEAD"}
+        lock = actor.setdefault("_restart_lock", asyncio.Lock())
+        async with lock:
+            if actor["state"] == "ALIVE" and actor["addr"] != failed_addr:
+                # Another reporter already drove the restart.
+                return {"ok": True, "state": "ALIVE", "addr": actor["addr"]}
+            if actor["state"] == "DEAD":
+                return {"ok": False, "state": "DEAD"}
+            spec = actor.get("restart_spec") or {}
+            budget = spec.get("max_restarts", 0)
+            if budget != -1 and actor["restarts_used"] >= budget:
+                actor["state"] = "DEAD"
+                self.publish("actor", {"event": "dead", "actor_id": actor_id})
+                return {"ok": False, "state": "DEAD"}
+            actor["restarts_used"] += 1
+            actor["state"] = "RESTARTING"
+            self.publish(
+                "actor", {"event": "restarting", "actor_id": actor_id}
+            )
+            try:
+                addr = await self._recreate_actor(actor_id, actor, spec)
+            except Exception as e:  # noqa: BLE001 - no node fits, etc.
+                actor["state"] = "DEAD"
+                self.publish("actor", {"event": "dead", "actor_id": actor_id})
+                return {"ok": False, "state": "DEAD", "error": repr(e)}
+            actor.update(state="ALIVE", addr=addr)
+            self.publish(
+                "actor",
+                {"event": "alive", "actor_id": actor_id, "addr": addr},
+            )
+            return {"ok": True, "state": "ALIVE", "addr": addr}
+
+    def _spawn_restart(self, actor_id: str, failed_addr: str) -> None:
+        """Fire-and-forget restart attempt (node-death sweep); tracked so
+        the task isn't GC'd. _on_restart_actor handles budget/DEAD."""
+        task = asyncio.ensure_future(
+            self._on_restart_actor(None, actor_id, failed_addr)
+        )
+        self._bg_restarts = getattr(self, "_bg_restarts", set())
+        self._bg_restarts.add(task)
+        task.add_done_callback(self._bg_restarts.discard)
+
+    async def _recreate_actor(self, actor_id: str, actor: dict, spec: dict):
+        """Lease a fresh worker and re-run the actor's constructor."""
+        pick = await self._on_pick_node(None, resources=spec["resources"])
+        if not pick.get("ok"):
+            raise rpc.RpcError(pick.get("error", "no feasible node"))
+        node_conn = self._node_conns[pick["node_id"]]
+        lease = await node_conn.call(
+            "lease_worker", resources=dict(spec["resources"]), actor=True
+        )
+        if not lease.get("ok"):
+            raise rpc.RpcError(lease.get("error", "restart lease failed"))
+        worker_conn = await rpc.connect(lease["addr"])
+        try:
+            create = await worker_conn.call(
+                "create_actor",
+                actor_id=actor_id,
+                fn_id=spec["fn_id"],
+                args=spec["args"],
+                max_concurrency=spec.get("max_concurrency"),
+            )
+        finally:
+            await worker_conn.close()
+        if create.get("status") == "error":
+            raise rpc.RpcError("actor constructor failed on restart")
+        actor["node_id"] = pick["node_id"]
+        return lease["addr"]
 
     async def _on_update_actor(self, conn, actor_id: str, state: str):
         actor = self.actors.get(actor_id)
@@ -232,10 +311,27 @@ class HeadService:
             actor_id = self.named_actors.get(name)
         if actor_id is None or actor_id not in self.actors:
             return {"ok": False, "error": "actor not found"}
-        return {"ok": True, "actor_id": actor_id, **self.actors[actor_id]}
+        return {
+            "ok": True,
+            "actor_id": actor_id,
+            **self._public_actor(self.actors[actor_id]),
+        }
+
+    @staticmethod
+    def _public_actor(actor: dict) -> dict:
+        """Strip non-serializable / internal fields (restart lock, spec)."""
+        return {
+            k: v
+            for k, v in actor.items()
+            if k not in ("_restart_lock", "restart_spec")
+        }
 
     async def _on_list_actors(self, conn):
-        return {"actors": dict(self.actors)}
+        return {
+            "actors": {
+                aid: self._public_actor(a) for aid, a in self.actors.items()
+            }
+        }
 
     # ----------------------------------------------------------- pubsub
     async def _on_subscribe(self, conn, channel: str):
@@ -449,7 +545,8 @@ class HeadService:
                     )
                     for aid, actor in self.actors.items():
                         if actor["node_id"] == nid and actor["state"] == "ALIVE":
-                            actor["state"] = "DEAD"
-                            self.publish(
-                                "actor", {"event": "dead", "actor_id": aid}
-                            )
+                            # Node death goes through the same restart
+                            # budget as worker death (reference: actors
+                            # on dead nodes are rescheduled while
+                            # max_restarts remains, gcs_actor_manager).
+                            self._spawn_restart(aid, actor["addr"])

@@ -85,6 +85,11 @@ def restore_checkpoint(
     path = os.path.abspath(path)
     if jax.process_index() == 0:
         _recover_interrupted_swap(path)
+    if jax.process_count() > 1:
+        # Non-lead readers must not race the lead's recovery rename.
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ray_tpu_ckpt_recover")
     state_path = os.path.join(path, "state")
     if target is None:
         return ckptr.restore(state_path)

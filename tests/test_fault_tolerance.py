@@ -1,0 +1,133 @@
+"""Fault-tolerance tests: worker-kill retries, actor restarts, chaos
+injection.
+
+Reference models: python/ray/tests/test_actor_failures.py (max_restarts
+semantics), test_utils.py WorkerKillerActor chaos pattern, and the
+RAY_testing_rpc_failure idempotence suite (ray_config_def.h:850).
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_task_retries_on_worker_kill(cluster):
+    """A task whose worker is SIGKILLed mid-run is retried elsewhere."""
+
+    @ray_tpu.remote(max_retries=3)
+    def die_once(marker_dir):
+        # First attempt kills its own worker; retries find the marker.
+        marker = os.path.join(marker_dir, "attempted")
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)
+        return "survived"
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        assert ray_tpu.get(die_once.remote(d), timeout=60) == "survived"
+
+
+def test_task_without_retries_fails(cluster):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    with pytest.raises(Exception):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_actor_restart(cluster):
+    @ray_tpu.remote(max_restarts=2)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def pid(self):
+            return os.getpid()
+
+        def bump(self):
+            self.calls += 1
+            return self.calls
+
+    a = Phoenix.remote()
+    assert ray_tpu.get(a.bump.remote()) == 1
+    assert ray_tpu.get(a.bump.remote()) == 2
+    pid = ray_tpu.get(a.pid.remote())
+
+    os.kill(pid, signal.SIGKILL)
+    time.sleep(0.3)
+    # The first call after death fails (methods are not idempotent),
+    # but reporting it triggers the restart.
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.bump.remote(), timeout=30)
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 1  # state reset
+    new_pid = ray_tpu.get(a.pid.remote())
+    assert new_pid != pid
+
+
+def test_actor_without_restarts_stays_dead(cluster):
+    @ray_tpu.remote  # max_restarts defaults to 0
+    class Mortal:
+        def pid(self):
+            return os.getpid()
+
+        def ping(self):
+            return "pong"
+
+    a = Mortal.remote()
+    pid = ray_tpu.get(a.pid.remote())
+    os.kill(pid, signal.SIGKILL)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.ping.remote(), timeout=30)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.ping.remote(), timeout=30)
+
+
+def test_actor_restart_budget_exhausts(cluster):
+    @ray_tpu.remote(max_restarts=1)
+    class OneLife:
+        def pid(self):
+            return os.getpid()
+
+    a = OneLife.remote()
+    pid1 = ray_tpu.get(a.pid.remote())
+    os.kill(pid1, signal.SIGKILL)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.pid.remote(), timeout=30)
+    pid2 = ray_tpu.get(a.pid.remote(), timeout=30)  # restarted once
+    assert pid2 != pid1
+    os.kill(pid2, signal.SIGKILL)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(a.pid.remote(), timeout=30)
+    with pytest.raises(ActorDiedError):  # budget spent: stays dead
+        ray_tpu.get(a.pid.remote(), timeout=30)
+
+
+def test_rpc_chaos_tasks_still_complete(cluster):
+    """With 30% push_task request drops, retries deliver every task."""
+    os.environ["RAY_TPU_RPC_FAILURE"] = "push_task:0.3"
+    try:
+        @ray_tpu.remote(max_retries=10)
+        def add(a, b):
+            return a + b
+
+        results = ray_tpu.get(
+            [add.remote(i, i) for i in range(20)], timeout=120
+        )
+        assert results == [2 * i for i in range(20)]
+    finally:
+        del os.environ["RAY_TPU_RPC_FAILURE"]

@@ -117,15 +117,31 @@ class Autoscaler:
         # phantom capacity forever.
         registered = set(nodes)
         now = time.monotonic()
-        for pid, tracked in self._tracked.items():
+        for pid, tracked in list(self._tracked.items()):
             rid = self.provider.runtime_node_id(pid)
-            booting = (rid is None or rid not in registered) and (
-                now - tracked.launched_at < self.boot_grace_s
-            )
-            if booting:
+            if rid is not None and rid in registered:
+                continue
+            if now - tracked.launched_at < self.boot_grace_s:
                 free.append(
                     dict(self.node_types[tracked.node_type].resources)
                 )
+            elif rid is not None:
+                # Mappable provider, node never registered within the
+                # grace window: a failed launch. Reap it — leaving it
+                # tracked would pin a max_workers slot (and the cloud
+                # bill) forever while contributing nothing.
+                logger.warning(
+                    "node %s (%s) failed to register within %.0fs; "
+                    "terminating",
+                    pid, tracked.node_type, self.boot_grace_s,
+                )
+                try:
+                    self.provider.terminate_node(pid)
+                finally:
+                    del self._tracked[pid]
+            # rid is None (provider can't map ids, e.g. the GKE stub):
+            # keep it tracked but uncredited — reaping on a blind signal
+            # would kill healthy registered nodes.
         to_add = fit_demand(
             demand,
             {

@@ -1,16 +1,21 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
-Online-softmax attention (Dao et al.) tiled for the MXU: the kernel never
-materializes the [S, S] score matrix — each (q-block, kv-block) grid step
-rescales a running (max, denom, acc) triple held in VMEM scratch, which
-persists across the innermost (sequential) grid dimension on TPU. Causal
-blocks strictly above the diagonal are skipped entirely, halving the work.
+Online-softmax attention (Dao et al.) tiled for the MXU: the forward
+never materializes the [S, S] score matrix — each (q-block, kv-block)
+grid step rescales a running (max, denom, acc) triple held in VMEM
+scratch, which persists across the innermost (sequential) grid dimension
+on TPU. Causal blocks strictly above the diagonal are skipped entirely,
+halving the work. The forward also emits the per-row logsumexp so the
+backward can recompute probabilities blockwise (flash-2 style): dq
+accumulates over kv blocks, dk/dv over q blocks, all O(S) memory.
 
 The reference has no attention kernels at all (SURVEY.md §5 long-context
 row: delegated to vLLM/user code); this is native.
 
-Layout: [B, S, H, D] (the model's convention). GQA is handled by index
-mapping: q head h reads kv head h // (H // Hkv) — no materialized repeat.
+Layout: [B, S, H, D] (the model's convention). GQA in the forward is
+handled by index mapping (q head h reads kv head h // n_rep — no
+materialized repeat); the backward expands kv to H heads and sums
+dk/dv over each group's n_rep q heads afterwards.
 """
 
 from __future__ import annotations
@@ -26,8 +31,9 @@ _NEG_INF = float("-inf")
 _LANES = 128  # TPU vector lane count: scratch stats are lane-replicated
 
 
-def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+# --------------------------------------------------------------- forward
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     *, block_q: int, block_kv: int, num_kv: int, scale: float, causal: bool,
 ):
     qi = pl.program_id(1)
@@ -41,9 +47,7 @@ def _flash_kernel(
 
     # Causal: a kv block strictly above the diagonal contributes nothing.
     first_masked = (qi + 1) * block_q  # kv positions >= this are masked
-    run = jnp.logical_or(
-        not causal, ki * block_kv < first_masked
-    )
+    run = jnp.logical_or(not causal, ki * block_kv < first_masked)
 
     @pl.when(run)
     def _compute():
@@ -71,9 +75,7 @@ def _flash_kernel(
         safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
         p = jnp.exp(s - safe_m[:, None])
         p = jnp.where(s == _NEG_INF, 0.0, p)
-        alpha = jnp.where(
-            m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - safe_m)
-        )
+        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - safe_m))
         l_new = alpha * l_prev + p.sum(axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
@@ -84,8 +86,262 @@ def _flash_kernel(
     @pl.when(ki == num_kv - 1)
     def _finalize():
         l = l_ref[:, 0]
+        m = m_ref[:, 0]
         denom = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows → 0 output
         o_ref[0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+        # logsumexp per row, consumed by the backward kernels.
+        lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(denom))
+        lse_ref[0, 0] = lse.astype(jnp.float32)
+
+
+def _fwd_call(qr, kr, vr, n_rep, causal, scale, block_q, block_kv, interpret):
+    bh, s, d = qr.shape
+    num_q, num_kv = s // block_q, s // block_kv
+    kernel = functools.partial(
+        _fwd_kernel,
+        block_q=block_q, block_kv=block_kv, num_kv=num_kv,
+        scale=scale, causal=causal,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, num_q, num_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec(
+                (1, block_kv, d),
+                lambda b, qi, ki, n_rep=n_rep: (b // n_rep, ki, 0),
+            ),
+            pl.BlockSpec(
+                (1, block_kv, d),
+                lambda b, qi, ki, n_rep=n_rep: (b // n_rep, ki, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            # [BH, 1, S]: a (1, 1, block_q) block satisfies the TPU
+            # (8, 128) tile rule (middle dim equals the array dim).
+            pl.BlockSpec((1, 1, block_q), lambda b, qi, ki: (b, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), qr.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+
+
+# -------------------------------------------------------------- backward
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, scale,
+                 causal):
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(kv_pos > q_pos, _NEG_INF, s)
+    lse = lse_ref[0, 0]  # [block_q]
+    safe = jnp.where(lse == _NEG_INF, 0.0, lse)
+    p = jnp.exp(s - safe[:, None])
+    return jnp.where(s == _NEG_INF, 0.0, p), s
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
+    *, block_q: int, block_kv: int, num_kv: int, scale: float, causal: bool,
+):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = jnp.logical_or(not causal, ki * block_kv < (qi + 1) * block_q)
+
+    @pl.when(run)
+    def _compute():
+        p, _ = _recompute_p(
+            q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, scale, causal
+        )
+        do = do_ref[0].astype(jnp.float32)  # [block_q, D]
+        v = v_ref[0].astype(jnp.float32)  # [block_kv, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_kv]
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        acc_ref[...] += jax.lax.dot(
+            ds.astype(k_ref.dtype), k_ref[0],
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, block_q: int, block_kv: int, num_q: int, scale: float, causal: bool,
+):
+    ki = pl.program_id(1)  # NOTE: kv outer, q inner for this kernel
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    # Causal: q blocks entirely before this kv block see none of it.
+    run = jnp.logical_or(not causal, (qi + 1) * block_q > ki * block_kv)
+
+    @pl.when(run)
+    def _compute():
+        p, _ = _recompute_p(
+            q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, scale, causal
+        )
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        # dv += p^T @ do
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        # dk += ds^T @ q
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_impl(q, k, v, causal, scale, block_q, block_kv, interpret):
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    n_rep = h // hkv
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    out, lse = _fwd_call(
+        qr, kr, vr, n_rep, causal, scale, block_q, block_kv, interpret
+    )
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, _ = _flash_impl(
+        q, k, v, causal, scale, block_q, block_kv, interpret
+    )
+    b, s, h, d = q.shape
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, lse = _flash_impl(
+        q, k, v, causal, scale, block_q, block_kv, interpret
+    )
+    b, s, h, d = q.shape
+    return (
+        out.reshape(b, h, s, d).transpose(0, 2, 1, 3),
+        (q, k, v, out, lse),
+    )
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    n_rep = h // hkv
+
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # Expand kv to H heads for the backward (grouped dk/dv summed below).
+    ke = jnp.repeat(k, n_rep, axis=2).transpose(0, 2, 1, 3).reshape(
+        b * h, s, d
+    )
+    ve = jnp.repeat(v, n_rep, axis=2).transpose(0, 2, 1, 3).reshape(
+        b * h, s, d
+    )
+    do = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA.
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    delta = delta[:, None, :]  # [BH, 1, S] to match the lse layout
+
+    num_q, num_kv = s // block_q, s // block_kv
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    kv_spec_dq = pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, block_q=block_q, block_kv=block_kv, num_kv=num_kv,
+            scale=scale, causal=causal,
+        ),
+        grid=(b * h, num_q, num_kv),
+        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, ke, ve, do, lse, delta)
+
+    # dk/dv: kv blocks outer, q blocks inner (accumulate over q).
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    kv_spec2 = pl.BlockSpec((1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0))
+    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi))
+    dk_e, dv_e = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=block_q, block_kv=block_kv, num_q=num_q,
+            scale=scale, causal=causal,
+        ),
+        grid=(b * h, num_kv, num_q),
+        in_specs=[
+            q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2
+        ],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, ke, ve, do, lse, delta)
+
+    dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    # Sum each kv group's n_rep expanded gradients back to Hkv heads.
+    dk = (
+        dk_e.reshape(b, hkv, n_rep, s, d).sum(2).transpose(0, 2, 1, 3)
+    ).astype(k.dtype)
+    dv = (
+        dv_e.reshape(b, hkv, n_rep, s, d).sum(2).transpose(0, 2, 1, 3)
+    ).astype(v.dtype)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
@@ -107,51 +363,36 @@ def flash_attention(
     hkv = k.shape[2]
     if h % hkv:
         raise ValueError(f"n_heads={h} not divisible by n_kv={hkv}")
-    n_rep = h // hkv
     block_q = min(block_q, s)
     block_kv = min(block_kv, s)
     if s % block_q or s % block_kv:
         raise ValueError(f"seq {s} not divisible by blocks {block_q}/{block_kv}")
     if scale is None:
         scale = d**-0.5
-    num_q, num_kv = s // block_q, s // block_kv
+    return _flash(q, k, v, causal, scale, block_q, block_kv, interpret)
 
-    # [B, S, H, D] → [B*H, S, D]
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
-    vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
 
-    kernel = functools.partial(
-        _flash_kernel,
-        block_q=block_q,
-        block_kv=block_kv,
-        num_kv=num_kv,
-        scale=scale,
-        causal=causal,
-    )
-    out = pl.pallas_call(
+def make_flash_attention(mesh, batch_axes=("dp", "fsdp"), head_axis="tp"):
+    """Build a trainer attention fn running the flash kernel per shard
+    under shard_map (batch sharded over the data axes, heads over tp;
+    sequence stays local — combine with ring attention for SP). Drop-in
+    for ray_tpu.models.llama.forward(attn_fn=...)."""
+    from jax.sharding import PartitionSpec as P
+
+    interpret = jax.default_backend() != "tpu"
+    spec = P(batch_axes, None, head_axis, None)
+
+    def kernel(q, k, v):
+        return flash_attention(q, k, v, interpret=interpret)
+
+    if mesh is None or mesh.size == 1:
+        return kernel
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axes
+    # metadata, which the checker would otherwise require.
+    return jax.shard_map(
         kernel,
-        grid=(b * h, num_q, num_kv),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec(
-                (1, block_kv, d),
-                lambda bh, qi, ki, n_rep=n_rep: (bh // n_rep, ki, 0),
-            ),
-            pl.BlockSpec(
-                (1, block_kv, d),
-                lambda bh, qi, ki, n_rep=n_rep: (bh // n_rep, ki, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)
-        ),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
-            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
-            pltpu.VMEM((block_q, d), jnp.float32),  # output accumulator
-        ],
-        interpret=interpret,
-    )(qr, kr, vr)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )

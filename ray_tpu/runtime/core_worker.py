@@ -477,7 +477,12 @@ class CoreWorker:
                 if not getattr(e, "sent", True):
                     # Never reached the wire (chaos drop / locally-closed
                     # conn): the actor is fine — resend, don't restart.
-                    self._conns.pop(addr, None)
+                    # Only evict the cached conn if it actually closed (a
+                    # chaos drop leaves it healthy; evicting would leak
+                    # the socket and its recv task).
+                    cached = self._conns.get(addr)
+                    if cached is not None and cached._closed:
+                        self._conns.pop(addr, None)
                     continue
                 break
         else:
@@ -742,12 +747,13 @@ class CoreWorker:
         if not reply.get("ok"):
             raise rpc.RpcError(reply.get("error", "actor lease failed"))
         fn_id = await self.export_function(cls)
+        encoded_args = self._encode_args(args, kwargs)
         conn = await self._connect(reply["addr"])
         create = await conn.call(
             "create_actor",
             actor_id=actor_id,
             fn_id=fn_id,
-            args=self._encode_args(args, kwargs),
+            args=encoded_args,
             max_concurrency=max_concurrency,
         )
         if create["status"] == "error":
@@ -766,19 +772,28 @@ class CoreWorker:
             # the creation TaskSpec for restarts, gcs_actor_manager.h:93).
             restart_spec={
                 "fn_id": fn_id,
-                "args": self._encode_args(args, kwargs),
+                "args": encoded_args,
                 "resources": dict(resources or {"CPU": 1.0}),
                 "max_concurrency": max_concurrency,
                 "max_restarts": max_restarts,
+                # PG-placed actors must restart on their reserved bundle.
+                "placement": placement,
             },
         )
         return actor_id, reply["addr"]
 
     async def kill_actor(self, actor_id: str, addr: str):
-        # The handle carries the birth address; a restarted actor lives
-        # elsewhere — kill the CURRENT instance and tell the head this
-        # death is intentional (no restart, name freed).
+        # The handle carries the birth address; a head-driven restart may
+        # have moved the actor without THIS client ever seeing a failure
+        # — ask the head for the authoritative address, then mark the
+        # death intentional (no restart, name freed) before killing.
         addr = self._actor_addrs.get(actor_id, addr)
+        try:
+            info = await self.head.call("get_actor", actor_id=actor_id)
+            if info.get("ok") and info.get("addr"):
+                addr = info["addr"]  # head is authoritative
+        except rpc.RpcError:
+            pass
         try:
             await self.head.call(
                 "update_actor", actor_id=actor_id, state="DEAD"
@@ -790,7 +805,6 @@ class CoreWorker:
             await conn.call("exit_worker")
         except (rpc.ConnectionLost, rpc.RpcError):
             pass
-        await self.head.call("update_actor", actor_id=actor_id, state="DEAD")
 
     # ------------------------------------------------- worker-side serve
     async def _handle(self, method: str, kw: dict, conn: rpc.Connection):

@@ -252,12 +252,27 @@ class HeadService:
                 actor["state"] = "DEAD"
                 self.publish("actor", {"event": "dead", "actor_id": actor_id})
                 return {"ok": False, "state": "DEAD", "error": repr(e)}
+            if actor["state"] == "DEAD":
+                # A kill landed while the restart was in flight: the kill
+                # wins — tear down the instance we just created.
+                await self._kill_worker_quietly(addr)
+                return {"ok": False, "state": "DEAD"}
             actor.update(state="ALIVE", addr=addr)
             self.publish(
                 "actor",
                 {"event": "alive", "actor_id": actor_id, "addr": addr},
             )
             return {"ok": True, "state": "ALIVE", "addr": addr}
+
+    async def _kill_worker_quietly(self, addr: str):
+        try:
+            conn = await rpc.connect(addr)
+            try:
+                await conn.call("exit_worker")
+            finally:
+                await conn.close()
+        except Exception:  # noqa: BLE001
+            pass
 
     def _spawn_restart(self, actor_id: str, failed_addr: str) -> None:
         """Fire-and-forget restart attempt (node-death sweep); tracked so
@@ -271,29 +286,62 @@ class HeadService:
 
     async def _recreate_actor(self, actor_id: str, actor: dict, spec: dict):
         """Lease a fresh worker and re-run the actor's constructor."""
-        pick = await self._on_pick_node(None, resources=spec["resources"])
-        if not pick.get("ok"):
-            raise rpc.RpcError(pick.get("error", "no feasible node"))
-        node_conn = self._node_conns[pick["node_id"]]
-        lease = await node_conn.call(
-            "lease_worker", resources=dict(spec["resources"]), actor=True
-        )
+        placement = spec.get("placement")
+        if placement is not None:
+            # PG-placed actor: restart on its reserved bundle so
+            # co-location (and the bundle's accounting) stays intact.
+            pg_id, index = placement[1], placement[2]
+            pg = self.placement_groups.get(pg_id)
+            if pg is None:
+                raise rpc.RpcError(
+                    f"placement group {pg_id} gone; cannot restart"
+                )
+            node_id = pg["nodes"][index]
+            node_conn = self._node_conns.get(node_id)
+            if node_conn is None:
+                raise rpc.RpcError("bundle node is gone; cannot restart")
+            lease = await node_conn.call(
+                "lease_worker",
+                resources=dict(spec["resources"]),
+                actor=True,
+                bundle=(pg_id, index),
+            )
+        else:
+            pick = await self._on_pick_node(None, resources=spec["resources"])
+            if not pick.get("ok"):
+                raise rpc.RpcError(pick.get("error", "no feasible node"))
+            node_id = pick["node_id"]
+            node_conn = self._node_conns[node_id]
+            lease = await node_conn.call(
+                "lease_worker", resources=dict(spec["resources"]), actor=True
+            )
         if not lease.get("ok"):
             raise rpc.RpcError(lease.get("error", "restart lease failed"))
-        worker_conn = await rpc.connect(lease["addr"])
         try:
-            create = await worker_conn.call(
-                "create_actor",
-                actor_id=actor_id,
-                fn_id=spec["fn_id"],
-                args=spec["args"],
-                max_concurrency=spec.get("max_concurrency"),
-            )
-        finally:
-            await worker_conn.close()
-        if create.get("status") == "error":
-            raise rpc.RpcError("actor constructor failed on restart")
-        actor["node_id"] = pick["node_id"]
+            worker_conn = await rpc.connect(lease["addr"])
+            try:
+                create = await worker_conn.call(
+                    "create_actor",
+                    actor_id=actor_id,
+                    fn_id=spec["fn_id"],
+                    args=spec["args"],
+                    max_concurrency=spec.get("max_concurrency"),
+                )
+            finally:
+                await worker_conn.close()
+            if create.get("status") == "error":
+                raise rpc.RpcError("actor constructor failed on restart")
+        except Exception:
+            # Give the lease (and its worker) back: a failed restart must
+            # not strand cluster capacity.
+            try:
+                await node_conn.call(
+                    "return_lease", lease_id=lease["lease_id"]
+                )
+            except rpc.RpcError:
+                pass
+            raise
+        actor["node_id"] = node_id
         return lease["addr"]
 
     async def _on_update_actor(self, conn, actor_id: str, state: str):

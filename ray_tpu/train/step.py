@@ -156,6 +156,10 @@ def jit_train_step(
         from ray_tpu.parallel.ulysses import make_ulysses_attention
 
         attn_fn = make_ulysses_attention(mesh)
+    elif cfg.attn_impl == "flash":
+        from ray_tpu.ops.pallas.flash_attention import make_flash_attention
+
+        attn_fn = make_flash_attention(mesh)
     elif cfg.attn_impl != "dense":
         raise ValueError(f"unknown attn_impl {cfg.attn_impl!r}")
     step = make_train_step(cfg, optimizer, attn_fn=attn_fn)

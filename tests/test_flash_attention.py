@@ -66,6 +66,61 @@ def test_flash_non_causal():
     )
 
 
+def test_flash_backward_matches_dense():
+    """custom-VJP gradients == autodiff through the dense path (incl.
+    GQA head-group summation)."""
+    key = jax.random.key(3)
+    b, s, h, hkv, d = 1, 128, 4, 2, 32
+    q = _rand((b, s, h, d), jax.random.fold_in(key, 1))
+    k = _rand((b, s, hkv, d), jax.random.fold_in(key, 2))
+    v = _rand((b, s, hkv, d), jax.random.fold_in(key, 3))
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, block_q=64, block_kv=64, interpret=True
+            )
+            ** 2
+        ).sum()
+
+    def loss_dense(q, k, v):
+        return (causal_attention(q, k, v) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-3, atol=5e-3
+        )
+
+
+def test_flash_train_step_runs():
+    """attn_impl='flash' wires through jit_train_step (interpret on CPU)."""
+    import dataclasses
+
+    from ray_tpu.models import PRESETS
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.train.step import (
+        init_train_state,
+        jit_train_step,
+        make_optimizer,
+    )
+
+    cfg = dataclasses.replace(
+        PRESETS["tiny"], attn_impl="flash", max_seq=128
+    )
+    opt = make_optimizer(total_steps=10)
+    # 8-device dp mesh: exercises the shard_map path around the kernel.
+    mesh = make_mesh({"dp": 8})
+    step = jit_train_step(cfg, opt, mesh)
+    state = init_train_state(jax.random.key(0), cfg, opt)
+    tokens = jax.random.randint(
+        jax.random.key(1), (8, 129), 0, cfg.vocab_size
+    )
+    state, metrics = step(state, {"tokens": tokens})
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_flash_rejects_bad_shapes():
     q = jnp.zeros((1, 100, 4, 32))
     with pytest.raises(ValueError):

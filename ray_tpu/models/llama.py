@@ -143,6 +143,16 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
 AttnFn = Callable[..., jnp.ndarray]
 
 
+FfnFn = Callable[..., tuple[jnp.ndarray, jnp.ndarray]]
+
+
+def _dense_ffn(h: jnp.ndarray, p: Params, cfg: LlamaConfig):
+    dt = cfg.dtype
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
+    up = h @ p["w_up"].astype(dt)
+    return (gate * up) @ p["w_down"].astype(dt), jnp.float32(0.0)
+
+
 def _block(
     x: jnp.ndarray,
     p: Params,
@@ -150,7 +160,10 @@ def _block(
     sin: jnp.ndarray,
     cfg: LlamaConfig,
     attn_fn: AttnFn,
-) -> jnp.ndarray:
+    ffn_fn: FfnFn,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Pre-norm attention + FFN sublayers; ffn_fn returns (out, aux) so
+    MoE layers (ray_tpu.models.moe) reuse this block unchanged."""
     b, s, d = x.shape
     dt = cfg.dtype
 
@@ -165,10 +178,45 @@ def _block(
     x = x + attn.reshape(b, s, -1) @ p["wo"].astype(dt)
 
     h = rms_norm(x, p["mlp_norm"])
-    gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
-    up = h @ p["w_up"].astype(dt)
-    x = x + (gate * up) @ p["w_down"].astype(dt)
-    return x
+    ffn_out, aux = ffn_fn(h, p, cfg)
+    return x + ffn_out, aux
+
+
+def forward_with_aux(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    attn_fn: AttnFn | None = None,
+    ffn_fn: FfnFn | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [B, S] int32 → (logits [B, S, V] fp32, summed aux loss)."""
+    attn_fn = attn_fn or causal_attention
+    ffn_fn = ffn_fn or _dense_ffn
+    seq = tokens.shape[1]
+    cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    x = constrain(x, "batch", "act_seq", "act_embed")
+
+    body = partial(_block, cos=cos, sin=sin, cfg=cfg, attn_fn=attn_fn,
+                   ffn_fn=ffn_fn)
+    if cfg.remat == "full":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+
+    def scan_fn(carry, layer_params):
+        x, aux_sum = carry
+        x, aux = body(x, layer_params)
+        return (x, aux_sum + aux), None
+
+    (x, aux_total), _ = jax.lax.scan(
+        scan_fn, (x, jnp.float32(0.0)), params["blocks"]
+    )
+
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux_total
 
 
 def forward(
@@ -178,23 +226,5 @@ def forward(
     attn_fn: AttnFn | None = None,
 ) -> jnp.ndarray:
     """tokens [B, S] int32 → logits [B, S, V] fp32."""
-    attn_fn = attn_fn or causal_attention
-    seq = tokens.shape[1]
-    cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
-
-    x = params["tok_emb"].astype(cfg.dtype)[tokens]
-    x = constrain(x, "batch", "act_seq", "act_embed")
-
-    body = partial(_block, cos=cos, sin=sin, cfg=cfg, attn_fn=attn_fn)
-    if cfg.remat == "full":
-        body = jax.checkpoint(
-            body, policy=jax.checkpoint_policies.nothing_saveable
-        )
-
-    def scan_fn(carry, layer_params):
-        return body(carry, layer_params), None
-
-    x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
-
-    x = rms_norm(x, params["final_norm"])
-    return (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits, _ = forward_with_aux(params, tokens, cfg, attn_fn=attn_fn)
+    return logits

@@ -1,0 +1,180 @@
+"""Mixture-of-Experts decoder with native expert parallelism.
+
+GShard/Switch-style MoE done the TPU way: routing is a static-shape
+einsum pipeline (top-k gates → capacity-bounded one-hot dispatch tensor →
+dispatch einsum → expert FFNs → combine einsum). Tokens are routed in
+fixed-size GROUPS (GShard §3.2) so the dispatch tensors stay
+O(groups · g²) with a bounded group size instead of O((B·S)²). Experts
+carry the "expert" logical axis, sharded over the mesh's ep axis — XLA
+inserts the token all-to-alls during SPMD partitioning; there is no
+manual routing code on the host.
+
+The attention sublayer, scan scaffolding, and non-expert parameters are
+the flagship Llama's (ray_tpu.models.llama — this module only swaps the
+FFN hook). The reference ships no MoE/expert parallelism at all
+(SURVEY.md §2.3: TP/PP/EP "not implemented in Ray itself"); this makes
+EP a first-class strategy next to DP/FSDP/TP/SP.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    Params,
+    forward_with_aux,
+    init_params,
+    param_logical_axes,
+)
+from ray_tpu.parallel.sharding import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    num_experts: int = 8
+    top_k: int = 2
+    # capacity per expert per group = capacity_factor * g * top_k / num_experts
+    capacity_factor: float = 1.25
+    # routing group size (tokens); bounds the dispatch tensor at
+    # O(g * capacity) per group regardless of batch*seq.
+    group_size: int = 1024
+    # weight of the load-balancing auxiliary loss (Switch §2.2)
+    aux_loss_weight: float = 0.01
+
+
+MOE_PRESETS: dict[str, MoEConfig] = {
+    "moe_tiny": MoEConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=256, dtype=jnp.float32, remat="none",
+        num_experts=4, top_k=2, group_size=64,
+    ),
+    # Single-chip scale (fp32 master params + adam fit a v5e's HBM).
+    "moe_bench": MoEConfig(
+        vocab_size=32768, d_model=1024, n_layers=6, n_heads=16,
+        n_kv_heads=8, d_ff=2048, max_seq=2048, num_experts=4, top_k=2,
+    ),
+    # Pod scale: experts sharded over the ep axis (won't fit one chip).
+    "moe_8x430m": MoEConfig(
+        vocab_size=32768, d_model=1024, n_layers=12, n_heads=16,
+        n_kv_heads=8, d_ff=4096, max_seq=2048, num_experts=8, top_k=2,
+    ),
+}
+
+
+def moe_param_logical_axes(cfg: MoEConfig) -> Params:
+    axes = param_logical_axes(cfg)
+    axes["blocks"].update(
+        router=("layers", "embed", "expert"),
+        w_gate=("layers", "expert", "embed", "mlp"),
+        w_up=("layers", "expert", "embed", "mlp"),
+        w_down=("layers", "expert", "mlp", "embed"),
+    )
+    return axes
+
+
+def init_moe_params(key: jax.Array, cfg: MoEConfig) -> Params:
+    d, f, e = cfg.d_model, cfg.d_ff, cfg.num_experts
+    L = cfg.n_layers
+    base_key, *keys = jax.random.split(key, 5)
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.truncated_normal(k, -2.0, 2.0, shape, jnp.float32)
+            * fan_in**-0.5
+        )
+
+    params = init_params(base_key, cfg)
+    params["blocks"].update(
+        router=w(keys[0], (L, d, e), d),
+        w_gate=w(keys[1], (L, e, d, f), d),
+        w_up=w(keys[2], (L, e, d, f), d),
+        w_down=w(keys[3], (L, e, f, d), f),
+    )
+    return params
+
+
+def moe_ffn(x: jnp.ndarray, p: Params, cfg: MoEConfig):
+    """FFN hook for llama._block: x [B, S, d] → (out, aux_loss).
+
+    Static-shape grouped dispatch: every expert gets exactly `capacity`
+    slots per group; overflow tokens are dropped (their residual passes
+    through) — the standard TPU MoE trade (GShard §3.2).
+    """
+    b, s, d = x.shape
+    e, k = cfg.num_experts, cfg.top_k
+    n = b * s
+    g = min(cfg.group_size, n)
+    if n % g:
+        g = n  # fall back to one group rather than failing odd shapes
+    G = n // g
+    capacity = max(1, int(cfg.capacity_factor * g * k / e))
+    dt = cfg.dtype
+
+    tokens = x.reshape(G, g, d)
+    logits = (
+        jnp.einsum("Ggd,de->Gge", tokens, p["router"].astype(dt))
+    ).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [G, g, e]
+
+    # Top-k gates, renormalized over the selected experts.
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [G, g, k]
+    gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+
+    # Slot of each (token, choice) within its expert's per-group capacity.
+    sel = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)  # [G, g, k, e]
+    flat_sel = sel.reshape(G, g * k, e)
+    pos_in_expert = jnp.cumsum(flat_sel, axis=1) - flat_sel
+    slot = (pos_in_expert * flat_sel).sum(-1).reshape(G, g, k)
+    keep = (slot < capacity).astype(jnp.float32)
+
+    slot_oh = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)  # [G,g,k,c]
+    masked = slot_oh * keep[..., None]
+    dispatch = jnp.einsum("Ggke,Ggkc->Ggec", sel.astype(jnp.float32), masked)
+    combine = jnp.einsum(
+        "Ggk,Ggke,Ggkc->Ggec", gate_vals, sel.astype(jnp.float32), masked
+    )
+
+    # [e, G, capacity, d] expert inputs — sharding e over ep makes XLA
+    # emit the all-to-all here.
+    expert_in = jnp.einsum(
+        "Ggec,Ggd->eGcd", dispatch, tokens.astype(jnp.float32)
+    )
+    expert_in = constrain(
+        expert_in.astype(dt), "expert", None, None, "act_embed"
+    )
+    gate = jax.nn.silu(
+        jnp.einsum("eGcd,edf->eGcf", expert_in, p["w_gate"].astype(dt))
+    )
+    up = jnp.einsum("eGcd,edf->eGcf", expert_in, p["w_up"].astype(dt))
+    expert_out = jnp.einsum(
+        "eGcf,efd->eGcd", gate * up, p["w_down"].astype(dt)
+    )
+    expert_out = constrain(expert_out, "expert", None, None, "act_embed")
+
+    out = jnp.einsum(
+        "Ggec,eGcd->Ggd", combine, expert_out.astype(jnp.float32)
+    ).astype(dt)
+
+    # Load-balance aux loss: e * sum_e (fraction routed) * (mean prob),
+    # averaged over groups (Switch §2.2).
+    me = probs.mean(1)  # [G, e]
+    ce = sel.astype(jnp.float32).sum(2).mean(1)  # [G, e]
+    aux = e * (me * ce).sum(-1).mean() * cfg.aux_loss_weight
+    return out.reshape(b, s, d), aux
+
+
+def moe_forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    cfg: MoEConfig,
+    attn_fn=None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """tokens [B, S] → (logits [B, S, V] fp32, mean aux loss)."""
+    logits, aux_total = forward_with_aux(
+        params, tokens, cfg, attn_fn=attn_fn, ffn_fn=moe_ffn
+    )
+    return logits, aux_total / cfg.n_layers

@@ -275,13 +275,10 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
     n_rep = h // hkv
 
     qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    # Expand kv to H heads for the backward (grouped dk/dv summed below).
-    ke = jnp.repeat(k, n_rep, axis=2).transpose(0, 2, 1, 3).reshape(
-        b * h, s, d
-    )
-    ve = jnp.repeat(v, n_rep, axis=2).transpose(0, 2, 1, 3).reshape(
-        b * h, s, d
-    )
+    # kv stays at Hkv heads: kernels read the shared head via the same
+    # bh // n_rep index map as the forward (no materialized repeat).
+    kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
     do = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     # delta_i = rowsum(dO_i * O_i) — cheap, fused by XLA.
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
@@ -289,7 +286,10 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
 
     num_q, num_kv = s // block_q, s // block_kv
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
-    kv_spec_dq = pl.BlockSpec((1, block_kv, d), lambda bh, qi, ki: (bh, ki, 0))
+    kv_spec_dq = pl.BlockSpec(
+        (1, block_kv, d),
+        lambda bh, qi, ki, n_rep=n_rep: (bh // n_rep, ki, 0),
+    )
     row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi))
 
     dq = pl.pallas_call(
@@ -303,11 +303,19 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
         out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qr, ke, ve, do, lse, delta)
+    )(qr, kr, vr, do, lse, delta)
 
-    # dk/dv: kv blocks outer, q blocks inner (accumulate over q).
+    # dk/dv: kv blocks outer, q blocks inner (accumulate over q). The
+    # OUTPUTS are per-q-head (grid over B*H) and group-summed below —
+    # only they need the n_rep expansion, not the k/v inputs.
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
-    kv_spec2 = pl.BlockSpec((1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0))
+    kv_in_spec2 = pl.BlockSpec(
+        (1, block_kv, d),
+        lambda bh, ki, qi, n_rep=n_rep: (bh // n_rep, ki, 0),
+    )
+    kv_out_spec2 = pl.BlockSpec(
+        (1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0)
+    )
     row_spec2 = pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi))
     dk_e, dv_e = pl.pallas_call(
         functools.partial(
@@ -316,9 +324,9 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
-            q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2
+            q_spec2, kv_in_spec2, kv_in_spec2, q_spec2, row_spec2, row_spec2
         ],
-        out_specs=[kv_spec2, kv_spec2],
+        out_specs=[kv_out_spec2, kv_out_spec2],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
@@ -328,7 +336,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, ke, ve, do, lse, delta)
+    )(qr, kr, vr, do, lse, delta)
 
     dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     # Sum each kv group's n_rep expanded gradients back to Hkv heads.

@@ -26,6 +26,20 @@ from ray_tpu.models.llama import (
 from ray_tpu.parallel.sharding import is_axes_leaf, tree_shardings, use_mesh
 
 
+def _model_fns(cfg: LlamaConfig):
+    """(init, logical_axes) for the config's model family — dense Llama
+    or MoE (ray_tpu.models.moe adds expert-parallel params)."""
+    from ray_tpu.models.moe import (
+        MoEConfig,
+        init_moe_params,
+        moe_param_logical_axes,
+    )
+
+    if isinstance(cfg, MoEConfig):
+        return init_moe_params, moe_param_logical_axes
+    return init_params, param_logical_axes
+
+
 class TrainState(NamedTuple):
     step: jnp.ndarray  # scalar int32
     params: Any
@@ -51,7 +65,8 @@ def make_optimizer(
 def init_train_state(
     key: jax.Array, cfg: LlamaConfig, optimizer: optax.GradientTransformation
 ) -> TrainState:
-    params = init_params(key, cfg)
+    init, _ = _model_fns(cfg)
+    params = init(key, cfg)
     return TrainState(
         step=jnp.zeros((), jnp.int32),
         params=params,
@@ -78,8 +93,9 @@ def state_logical_axes(
     exactly their parameter's axes (shape coincidences like wq [L,d,hq] vs
     wo [L,hq,d] with hq==d cannot cross-contaminate); non-param leaves
     (e.g. adam's count) get ()."""
-    p_axes = param_logical_axes(cfg)
-    p_shapes = jax.eval_shape(partial(init_params, cfg=cfg), jax.random.key(0))
+    init, logical_axes = _model_fns(cfg)
+    p_axes = logical_axes(cfg)
+    p_shapes = jax.eval_shape(partial(init, cfg=cfg), jax.random.key(0))
     opt_shapes = jax.eval_shape(optimizer.init, p_shapes)
 
     boxed = jax.tree.map(_Box, p_axes, is_leaf=is_axes_leaf)
@@ -101,14 +117,24 @@ def loss_fn(
     attn_fn=None,
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
     """Next-token cross entropy. batch["tokens"]: [B, S+1] int32."""
+    from ray_tpu.models.moe import MoEConfig, moe_forward
+
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg, attn_fn=attn_fn)
+    aux = None
+    if isinstance(cfg, MoEConfig):
+        logits, aux = moe_forward(params, inputs, cfg, attn_fn=attn_fn)
+    else:
+        logits = forward(params, inputs, cfg, attn_fn=attn_fn)
     logz = jax.nn.logsumexp(logits, axis=-1)
     tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - tgt_logit
-    loss = jnp.mean(nll)
-    return loss, {"loss": loss, "perplexity": jnp.exp(loss)}
+    ce = jnp.mean(nll)
+    metrics = {"loss": ce, "perplexity": jnp.exp(ce)}
+    if aux is None:
+        return ce, metrics
+    metrics["aux_loss"] = aux
+    return ce + aux, metrics
 
 
 def make_train_step(

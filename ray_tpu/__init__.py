@@ -18,8 +18,8 @@ def __getattr__(name):
     # runtime (and vice versa).
     _core_api = {
         "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-        "kill", "cancel", "get_actor", "method", "ObjectRef", "available_resources",
-        "cluster_resources",
+        "kill", "cancel", "get_actor", "method", "ObjectRef",
+        "ObjectRefGenerator", "available_resources", "cluster_resources",
     }
     if name in _core_api:
         try:

@@ -236,6 +236,70 @@ def _placement_tuple(pg, bundle_index: int):
     return (pg.bundle_node_addr(bundle_index), pg.id, bundle_index)
 
 
+class ObjectRefGenerator:
+    """Iterates a streaming task's yields as they arrive (reference:
+    python/ray/_private/object_ref_generator.py:32 ObjectRefGenerator).
+    Yields ObjectRefs whose values are already local; works as a sync
+    iterator from driver code and an async iterator on the runtime loop.
+    """
+
+    def __init__(self, task_id: str):
+        self._task_id = task_id
+        self._closed = False
+
+    def close(self):
+        """Stop consuming: undelivered items are dropped and the producer
+        is told to stop at its next report."""
+        if self._closed:
+            return
+        self._closed = True
+        # May run from __del__ during interpreter shutdown: never block
+        # on a loop that is gone (run_coroutine_threadsafe on a stopped
+        # loop would hang forever).
+        if (
+            _runtime.core is None
+            or _runtime.loop is None
+            or not _runtime.loop.is_running()
+        ):
+            return
+        try:
+            asyncio.run_coroutine_threadsafe(
+                _runtime.core.close_generator(self._task_id), _runtime.loop
+            ).result(timeout=2)
+        except Exception:  # noqa: BLE001 - best-effort cleanup
+            pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> "ObjectRef":
+        entry = _runtime.run(
+            _runtime.core.next_generator_item(self._task_id)
+        )
+        return self._unwrap(entry, StopIteration)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self) -> "ObjectRef":
+        entry = await _runtime.core.next_generator_item(self._task_id)
+        return self._unwrap(entry, StopAsyncIteration)
+
+    def _unwrap(self, entry, stop_exc):
+        kind = entry[0]
+        if kind == "done":
+            raise stop_exc
+        if kind == "error":
+            raise entry[1]
+        return ObjectRef(entry[1], _runtime.core.addr)
+
+
 class RemoteFunction:
     def __init__(
         self,
@@ -246,6 +310,7 @@ class RemoteFunction:
         max_retries=3,
         placement_group=None,
         placement_group_bundle_index=0,
+        runtime_env=None,
     ):
         self._fn = fn
         self._num_returns = num_returns
@@ -253,6 +318,7 @@ class RemoteFunction:
         self._max_retries = max_retries
         self._pg = placement_group
         self._pg_bundle = placement_group_bundle_index
+        self._runtime_env = runtime_env
         functools.update_wrapper(self, fn)
 
     def options(self, **opts):
@@ -263,12 +329,13 @@ class RemoteFunction:
             "max_retries": self._max_retries,
             "placement_group": self._pg,
             "placement_group_bundle_index": self._pg_bundle,
+            "runtime_env": self._runtime_env,
         }
         merged.update(opts)
         return RemoteFunction(self._fn, **merged)
 
     def remote(self, *args, **kwargs):
-        refs = _runtime.run(
+        out = _runtime.run(
             _runtime.core.submit_task(
                 self._fn,
                 args,
@@ -277,9 +344,12 @@ class RemoteFunction:
                 resources=self._resources,
                 max_retries=self._max_retries,
                 placement=_placement_tuple(self._pg, self._pg_bundle),
+                runtime_env=self._runtime_env,
             )
         )
-        return refs[0] if self._num_returns == 1 else refs
+        if self._num_returns == "streaming":
+            return ObjectRefGenerator(out)
+        return out[0] if self._num_returns == 1 else out
 
     def __call__(self, *a, **kw):
         raise TypeError(
@@ -299,7 +369,7 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         target = ActorSubmitTarget(self._handle._actor_id, self._handle._addr)
-        refs = _runtime.run(
+        out = _runtime.run(
             _runtime.core.submit_task(
                 self._name,
                 args,
@@ -308,7 +378,9 @@ class ActorMethod:
                 actor=target,
             )
         )
-        return refs[0] if self._num_returns == 1 else refs
+        if self._num_returns == "streaming":
+            return ObjectRefGenerator(out)
+        return out[0] if self._num_returns == 1 else out
 
     def bind(self, *args, **kwargs):
         """Record a compiled-graph edge instead of executing (reference:
@@ -348,6 +420,7 @@ class ActorClass:
         placement_group_bundle_index=0,
         max_concurrency=None,
         max_restarts=0,
+        runtime_env=None,
     ):
         self._cls = cls
         self._resources = resources
@@ -357,6 +430,7 @@ class ActorClass:
         self._pg_bundle = placement_group_bundle_index
         self._max_concurrency = max_concurrency
         self._max_restarts = max_restarts
+        self._runtime_env = runtime_env
 
     def options(self, *, lifetime=None, **opts):
         opts = _normalize_options(opts)
@@ -368,6 +442,7 @@ class ActorClass:
             "placement_group_bundle_index": self._pg_bundle,
             "max_concurrency": self._max_concurrency,
             "max_restarts": self._max_restarts,
+            "runtime_env": self._runtime_env,
         }
         merged.update(opts)
         return ActorClass(self._cls, **merged)
@@ -384,6 +459,7 @@ class ActorClass:
                 placement=_placement_tuple(self._pg, self._pg_bundle),
                 max_concurrency=self._max_concurrency,
                 max_restarts=self._max_restarts,
+                runtime_env=self._runtime_env,
             )
         )
         return ActorHandle(actor_id, addr, self._cls.__name__)

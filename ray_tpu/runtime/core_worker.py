@@ -105,6 +105,10 @@ class CoreWorker:
         # restarts; handles carry the birth address only).
         self._actor_addrs: dict[str, str] = {}
 
+        # Streaming generator tasks this process owns: task_id → queue of
+        # ("item", oid_hex) | ("error", exc) | ("done",).
+        self._generators: dict[str, asyncio.Queue] = {}
+
         # Task-event buffer, flushed to the head periodically (reference:
         # worker-side TaskEventBuffer core_worker/task_event_buffer.h →
         # GcsTaskManager). Bounded: observability must not OOM the worker.
@@ -347,12 +351,17 @@ class CoreWorker:
         max_retries: int = DEFAULT_RETRIES,
         actor: "ActorSubmitTarget | None" = None,
         placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
+        runtime_env: dict | None = None,
     ) -> list:
         """Submit; returns ObjectRefs immediately, result delivery is
         async (the reply fulfils the local futures)."""
         from ray_tpu.api import ObjectRef
 
         task_id = TaskID.random()
+        streaming = num_returns == "streaming"
+        if streaming:
+            num_returns = 0
+            self._generators[task_id.hex()] = asyncio.Queue()
         oids = [
             ObjectID.for_return(task_id, i).hex() for i in range(num_returns)
         ]
@@ -372,21 +381,35 @@ class CoreWorker:
             "num_returns": num_returns,
             "owner_addr": self.addr,
         }
+        if streaming:
+            spec["streaming"] = True
+            # Streaming tasks must not be auto-retried: already-consumed
+            # items would replay (reference: generators restart only from
+            # lineage reconstruction, not mid-stream).
+            max_retries = 0
         self.record_task_event(
             spec, "SUBMITTED", kind="actor_task" if actor else "task"
         )
         asyncio.ensure_future(
-            self._drive_task(spec, oids, resources, max_retries, actor, placement)
+            self._drive_task(
+                spec, oids, resources, max_retries, actor, placement,
+                runtime_env,
+            )
         )
+        if streaming:
+            return task_id.hex()
         return [ObjectRef(o, self.addr) for o in oids]
 
-    async def _drive_task(self, spec, oids, resources, retries, actor, placement):
+    async def _drive_task(
+        self, spec, oids, resources, retries, actor, placement,
+        runtime_env=None,
+    ):
         try:
             if actor is not None:
                 errored = await self._drive_actor_task(spec, oids, actor)
             else:
                 errored = await self._drive_normal_task(
-                    spec, oids, resources, retries, placement
+                    spec, oids, resources, retries, placement, runtime_env
                 )
             self.record_task_event(
                 spec, "FAILED" if errored else "FINISHED"
@@ -395,6 +418,10 @@ class CoreWorker:
             self.record_task_event(spec, "FAILED", error=repr(e))
             for oid_hex in oids:
                 self._store_result(oid_hex, ("error", e))
+            if spec.get("streaming"):
+                q = self._generators.get(spec["task_id"])
+                if q is not None:
+                    q.put_nowait(("error", e))
 
     # -------------------------------------------------------- task events
     def record_task_event(self, spec: dict, state: str, **extra):
@@ -434,15 +461,17 @@ class CoreWorker:
                 except Exception:  # noqa: BLE001
                     pass
 
-    async def _drive_normal_task(self, spec, oids, resources, retries, placement=None):
+    async def _drive_normal_task(
+        self, spec, oids, resources, retries, placement=None, runtime_env=None
+    ):
         last_err: Exception | None = None
         for attempt in range(retries + 1):
             lease = None
             try:
-                lease = await self._lease(resources, placement)
+                lease = await self._lease(resources, placement, runtime_env)
                 conn = await self._connect(lease["addr"])
                 reply = await conn.call("push_task", spec=spec)
-                return self._apply_reply(reply, oids)
+                return self._apply_reply(reply, oids, spec["task_id"])
             except (rpc.ConnectionLost, rpc.RpcError) as e:
                 last_err = e
                 if not getattr(e, "sent", True):
@@ -471,7 +500,7 @@ class CoreWorker:
                 reply = await conn.call(
                     "actor_call", spec=spec, actor_id=actor.actor_id
                 )
-                return self._apply_reply(reply, oids)
+                return self._apply_reply(reply, oids, spec["task_id"])
             except (rpc.ConnectionLost, rpc.RpcError) as e:
                 failure = e
                 if not getattr(e, "sent", True):
@@ -511,12 +540,18 @@ class CoreWorker:
             f"actor {actor.actor_id[:12]}… died: {failure}"
         ) from failure
 
-    def _apply_reply(self, reply: dict, oids: list) -> bool:
+    def _apply_reply(
+        self, reply: dict, oids: list, task_id: str | None = None
+    ) -> bool:
         """Returns True when the reply carries a task error."""
         if reply["status"] == "error":
             err = deserialize(reply["error"])
             for oid_hex in oids:
                 self._store_result(oid_hex, ("error", err))
+            if task_id is not None:
+                q = self._generators.get(task_id)
+                if q is not None:  # streaming task failed mid-iteration
+                    q.put_nowait(("error", err))
             return True
         for oid_hex, kind, *rest in reply["results"]:
             if kind == "inline":
@@ -526,11 +561,21 @@ class CoreWorker:
         return False
 
     # ------------------------------------------------------------ leases
-    def _sched_key(self, resources: dict | None) -> tuple:
-        return tuple(sorted((resources or {"CPU": 1.0}).items()))
+    def _sched_key(
+        self, resources: dict | None, runtime_env: dict | None = None
+    ) -> tuple:
+        from ray_tpu.runtime.node import env_hash
+
+        return (
+            tuple(sorted((resources or {"CPU": 1.0}).items())),
+            env_hash(runtime_env),
+        )
 
     async def _lease(
-        self, resources: dict | None, placement: tuple | None = None
+        self,
+        resources: dict | None,
+        placement: tuple | None = None,
+        runtime_env: dict | None = None,
     ) -> dict:
         if placement is not None:
             # Bundle-backed lease on the bundle's node; never cached.
@@ -544,13 +589,14 @@ class CoreWorker:
                 "lease_worker",
                 resources=dict(resources or {"CPU": 1.0}),
                 bundle=(pg_id, index),
+                runtime_env=runtime_env,
             )
             if not reply.get("ok"):
                 raise rpc.RpcError(reply.get("error", "bundle lease failed"))
             reply["sched_key"] = None
             reply["node_conn"] = node_conn
             return reply
-        key = self._sched_key(resources)
+        key = self._sched_key(resources, runtime_env)
         pool = self._pool(key)
         while pool["free"]:
             lease, _ = pool["free"].pop()
@@ -559,7 +605,9 @@ class CoreWorker:
                 return lease
         fut = asyncio.get_running_loop().create_future()
         pool["waiters"].append(fut)
-        self._maybe_request_lease(key, dict(resources or {"CPU": 1.0}))
+        self._maybe_request_lease(
+            key, dict(resources or {"CPU": 1.0}), runtime_env
+        )
         return await fut
 
     def _pool(self, key: tuple) -> dict:
@@ -569,7 +617,9 @@ class CoreWorker:
             key, {"free": [], "waiters": collections.deque(), "inflight": 0}
         )
 
-    def _maybe_request_lease(self, key: tuple, resources: dict):
+    def _maybe_request_lease(
+        self, key: tuple, resources: dict, runtime_env: dict | None = None
+    ):
         """Pipeline lease requests: keep at most min(#waiters, cap)
         requests in flight per scheduling class."""
         pool = self._pool(key)
@@ -581,7 +631,9 @@ class CoreWorker:
 
         async def request():
             try:
-                reply = await self.node.call("lease_worker", resources=resources)
+                reply = await self.node.call(
+                    "lease_worker", resources=resources, runtime_env=runtime_env
+                )
                 if not reply.get("ok") and (
                     reply.get("infeasible") or reply.get("retry_spill")
                 ):
@@ -591,7 +643,9 @@ class CoreWorker:
                     # retry_at_raylet_address node_manager.proto:78). If
                     # the whole cluster is infeasible, poll — the
                     # autoscaler may add a node.
-                    reply = await self._spill_lease(resources)
+                    reply = await self._spill_lease(
+                        resources, runtime_env=runtime_env
+                    )
                 if not reply.get("ok"):
                     raise rpc.RpcError(reply.get("error", "lease failed"))
                 reply["sched_key"] = key
@@ -606,11 +660,16 @@ class CoreWorker:
                         break
             # Top up if demand still outstrips supply.
             if pool["waiters"]:
-                self._maybe_request_lease(key, resources)
+                self._maybe_request_lease(key, resources, runtime_env)
 
         asyncio.ensure_future(request())
 
-    async def _spill_lease(self, resources: dict, actor: bool = False) -> dict:
+    async def _spill_lease(
+        self,
+        resources: dict,
+        actor: bool = False,
+        runtime_env: dict | None = None,
+    ) -> dict:
         """Find a feasible node through the head and lease there.
 
         The timeout clock only runs while the WHOLE cluster is infeasible
@@ -636,7 +695,10 @@ class CoreWorker:
                 else:
                     conn = await self._connect(reply["addr"])
                 granted = await conn.call(
-                    "lease_worker", resources=resources, actor=actor
+                    "lease_worker",
+                    resources=resources,
+                    actor=actor,
+                    runtime_env=runtime_env,
                 )
                 if granted.get("ok"):
                     granted["node_conn"] = conn
@@ -715,6 +777,7 @@ class CoreWorker:
         placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
         max_concurrency: int | None = None,
         max_restarts: int = 0,
+        runtime_env: dict | None = None,
     ):
         actor_id = ActorID.random().hex()
         if placement is not None:
@@ -729,19 +792,23 @@ class CoreWorker:
                 resources=dict(resources or {"CPU": 1.0}),
                 actor=True,
                 bundle=(pg_id, index),
+                runtime_env=runtime_env,
             )
         else:
             node_conn = self.node
             req = dict(resources or {"CPU": 1.0})
             reply = await node_conn.call(
-                "lease_worker", resources=req, actor=True
+                "lease_worker", resources=req, actor=True,
+                runtime_env=runtime_env,
             )
             if not reply.get("ok") and (
                 reply.get("infeasible") or reply.get("retry_spill")
             ):
                 # Same spillback as normal tasks: find a feasible node
                 # via the head (and wait out autoscaler scale-up).
-                reply = await self._spill_lease(req, actor=True)
+                reply = await self._spill_lease(
+                    req, actor=True, runtime_env=runtime_env
+                )
                 if reply.get("ok"):
                     node_conn = reply["node_conn"]
         if not reply.get("ok"):
@@ -778,6 +845,7 @@ class CoreWorker:
                 "max_restarts": max_restarts,
                 # PG-placed actors must restart on their reserved bundle.
                 "placement": placement,
+                "runtime_env": runtime_env,
             },
         )
         return actor_id, reply["addr"]
@@ -833,6 +901,45 @@ class CoreWorker:
         if kind == "value":
             return {"kind": "value", "inband": rest[0], "buffers": rest[1]}
         return {"kind": "in_store"}
+
+    async def _on_generator_item(
+        self, conn, task_id: str, index: int, inband, buffers, done: bool
+    ):
+        """Owner side of a streaming generator (reference: the owner's
+        handling of ReportGeneratorItemReturns)."""
+        q = self._generators.get(task_id)
+        if q is None:
+            return {"ok": False}  # consumer gone; producer may stop
+        if done:
+            q.put_nowait(("done",))
+            return {"ok": True}
+        oid_hex = ObjectID.for_return(TaskID.from_hex(task_id), index).hex()
+        self._store_result(oid_hex, ("value", inband, buffers))
+        q.put_nowait(("item", oid_hex))
+        return {"ok": True}
+
+    async def next_generator_item(self, task_id: str):
+        """("item", oid_hex) | ("done",) | ("error", exc); cleans up on
+        terminal entries."""
+        q = self._generators.get(task_id)
+        if q is None:
+            return ("done",)
+        entry = await q.get()
+        if entry[0] in ("done", "error"):
+            del self._generators[task_id]
+        return entry
+
+    async def close_generator(self, task_id: str):
+        """Abandon a streaming generator: drop undelivered items from the
+        memory store and deregister, so the producer's next report gets
+        ok=False and stops."""
+        q = self._generators.pop(task_id, None)
+        if q is None:
+            return
+        while not q.empty():
+            entry = q.get_nowait()
+            if entry[0] == "item":
+                self.memory.pop(entry[1], None)
 
     async def _on_push_task(self, conn, spec: dict):
         fut = asyncio.get_running_loop().create_future()
@@ -892,6 +999,46 @@ class CoreWorker:
         if not fut.done():
             fut.set_result(reply)
 
+    async def _stream_generator(self, spec: dict, gen) -> dict:
+        """Report a generator task's yields to the owner incrementally
+        (reference: streaming generators, ReportGeneratorItemReturns in
+        core_worker.proto + ObjectRefGenerator object_ref_generator.py:32).
+        Awaiting each report's ack gives one-item backpressure."""
+        loop = asyncio.get_running_loop()
+        owner = await self._connect(spec["owner_addr"])
+        task_id = spec["task_id"]
+        index = 0
+        _SENTINEL = object()
+        while True:
+            item = await loop.run_in_executor(
+                self._exec_pool, lambda: next(gen, _SENTINEL)
+            )
+            if item is _SENTINEL:
+                break
+            data = serialize(item).materialize_buffers()
+            ack = await owner.call(
+                "generator_item",
+                task_id=task_id,
+                index=index,
+                inband=data.inband,
+                buffers=data.buffers,
+                done=False,
+            )
+            if not ack.get("ok"):
+                # Consumer closed/abandoned the generator: stop producing.
+                getattr(gen, "close", lambda: None)()
+                return {"status": "ok", "results": []}
+            index += 1
+        await owner.call(
+            "generator_item",
+            task_id=task_id,
+            index=index,
+            inband=None,
+            buffers=None,
+            done=True,
+        )
+        return {"status": "ok", "results": []}
+
     async def _execute(self, spec: dict, actor_id: str | None) -> dict:
         loop = asyncio.get_running_loop()
         exec_start = time.time()
@@ -918,6 +1065,17 @@ class CoreWorker:
                 result = await loop.run_in_executor(
                     self._exec_pool, lambda: fn(*args, **kwargs)
                 )
+            if spec.get("streaming"):
+                import inspect
+
+                if not inspect.isgenerator(result):
+                    result = iter(result)  # any iterable streams
+                reply = await self._stream_generator(spec, result)
+                self.record_task_event(
+                    spec, "RUNNING", ts=exec_start,
+                    dur=time.time() - exec_start,
+                )
+                return reply
             n = spec["num_returns"]
             values = (
                 [result]

@@ -305,6 +305,7 @@ class HeadService:
                 resources=dict(spec["resources"]),
                 actor=True,
                 bundle=(pg_id, index),
+                runtime_env=spec.get("runtime_env"),
             )
         else:
             pick = await self._on_pick_node(None, resources=spec["resources"])
@@ -313,7 +314,10 @@ class HeadService:
             node_id = pick["node_id"]
             node_conn = self._node_conns[node_id]
             lease = await node_conn.call(
-                "lease_worker", resources=dict(spec["resources"]), actor=True
+                "lease_worker",
+                resources=dict(spec["resources"]),
+                actor=True,
+                runtime_env=spec.get("runtime_env"),
             )
         if not lease.get("ok"):
             raise rpc.RpcError(lease.get("error", "restart lease failed"))

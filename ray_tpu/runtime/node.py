@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import hashlib
+import json
 import os
 import subprocess
 import sys
@@ -27,6 +29,17 @@ from ray_tpu._private.ids import NodeID, WorkerID
 IDLE_WORKER_CAP = 4  # idle processes kept warm per node
 SPAWN_TIMEOUT_S = 30.0
 PENDING_SPILL_S = 2.0  # queued lease age before bouncing to spillback
+
+
+def env_hash(runtime_env: dict | None) -> str:
+    """Stable key for a runtime_env: workers are pooled per distinct env
+    (reference: runtime_env workers are dedicated + cached by env hash,
+    python/ray/_private/runtime_env/)."""
+    if not runtime_env:
+        return ""
+    return hashlib.sha1(
+        json.dumps(runtime_env, sort_keys=True).encode()
+    ).hexdigest()[:16]
 
 
 def detect_resources() -> dict[str, float]:
@@ -98,18 +111,20 @@ class NodeManager:
         self.head: rpc.Connection | None = None
         # worker_id → {proc, conn, addr, pid, state: spawning|idle|leased}
         self.workers: dict[str, dict] = {}
-        self.idle: list[str] = []
+        # env_hash → idle worker ids (workers are pooled per runtime_env)
+        self.idle: dict[str, list[str]] = collections.defaultdict(list)
         self.leases: dict[str, Lease] = {}
-        # (resources, actor, fut, enqueued_at): queued feasible-but-
-        # unavailable lease requests. Entries older than PENDING_SPILL_S
-        # are bounced with retry_spill so the caller can try another
-        # node via the head (lease spillback) instead of camping here
-        # while new capacity sits idle elsewhere.
-        self._pending: list[tuple[dict, bool, asyncio.Future, float]] = []
+        # (resources, actor, fut, enqueued_at, runtime_env): queued
+        # feasible-but-unavailable lease requests. Entries older than
+        # PENDING_SPILL_S are bounced with retry_spill so the caller can
+        # try another node via the head (lease spillback) instead of
+        # camping here while new capacity sits idle elsewhere.
+        self._pending: list[tuple] = []
         # (pg_id, index) → {"total": resources, "available": resources}
         self.bundles: dict[tuple, dict] = {}
-        self._worker_waiters: "collections.deque[asyncio.Future]" = (
-            collections.deque()
+        # env_hash → waiters for a worker of that env
+        self._worker_waiters: dict[str, collections.deque] = (
+            collections.defaultdict(collections.deque)
         )
         self._next_lease = 0
         self._tasks: list[asyncio.Task] = []
@@ -153,8 +168,9 @@ class NodeManager:
         await self.server.stop()
 
     # ------------------------------------------------------------ workers
-    def _spawn_worker(self) -> str:
+    def _spawn_worker(self, runtime_env: dict | None = None) -> str:
         worker_id = WorkerID.random().hex()
+        ehash = env_hash(runtime_env)
         # Workers must find the ray_tpu package regardless of their cwd.
         import ray_tpu
 
@@ -184,10 +200,18 @@ class NodeManager:
                 if sp not in pypath.split(os.pathsep):
                     pypath = f"{pypath}{os.pathsep}{sp}" if pypath else sp
             argv = [sys.executable, "-S", "-m", "ray_tpu.runtime.worker_main"]
+        renv = runtime_env or {}
+        # py_modules: local dirs importable in the worker (single-host or
+        # shared-FS; the reference ships them via the runtime_env agent).
+        for mod_path in renv.get("py_modules", ()):
+            mod_path = os.path.abspath(mod_path)
+            if mod_path not in pypath.split(os.pathsep):
+                pypath = f"{mod_path}{os.pathsep}{pypath}"
         env = {
             **os.environ,
             "PYTHONPATH": pypath,
             **self.worker_env,
+            **{str(k): str(v) for k, v in renv.get("env_vars", {}).items()},
             "RAY_TPU_HEAD_ADDR": self.head_addr,
             "RAY_TPU_NODE_ADDR": self.addr or "",
             "RAY_TPU_STORE_DIR": self.store_dir,
@@ -202,7 +226,12 @@ class NodeManager:
             stdout=None,
             stderr=None,
         )
-        self.workers[worker_id] = {"proc": proc, "state": "spawning"}
+        self.workers[worker_id] = {
+            "proc": proc,
+            "state": "spawning",
+            "env_hash": ehash,
+            "runtime_env": runtime_env,
+        }
         return worker_id
 
     # ------------------------------------------------------------ leases
@@ -220,26 +249,32 @@ class NodeManager:
         for k, v in resources.items():
             self.available[k] = self.available.get(k, 0) + v
 
-    async def _get_worker(self) -> str:
-        """Pop an idle worker, else wait for any spawning worker to
-        register; only spawn a fresh process when demand exceeds the
-        number already spawning (avoids a thundering herd of Python
+    async def _get_worker(self, runtime_env: dict | None = None) -> str:
+        """Pop an idle worker of the matching runtime_env, else wait for
+        a spawning one; only spawn a fresh process when demand exceeds
+        the number already spawning (avoids a thundering herd of Python
         interpreters on cold bursts)."""
-        if self.idle:
-            return self.idle.pop()
+        ehash = env_hash(runtime_env)
+        bucket = self.idle[ehash]
+        if bucket:
+            return bucket.pop()
         n_spawning = sum(
-            1 for w in self.workers.values() if w.get("state") == "spawning"
+            1
+            for w in self.workers.values()
+            if w.get("state") == "spawning" and w.get("env_hash", "") == ehash
         )
-        if n_spawning <= len(self._worker_waiters):
-            self._spawn_worker()
+        if n_spawning <= len(self._worker_waiters[ehash]):
+            self._spawn_worker(runtime_env)
         fut = asyncio.get_running_loop().create_future()
-        self._worker_waiters.append(fut)
+        self._worker_waiters[ehash].append(fut)
         return await asyncio.wait_for(fut, SPAWN_TIMEOUT_S)
 
-    async def _grant_lease(self, resources: dict, actor: bool) -> dict:
+    async def _grant_lease(
+        self, resources: dict, actor: bool, runtime_env: dict | None = None
+    ) -> dict:
         self._acquire(resources)
         try:
-            worker_id = await self._get_worker()
+            worker_id = await self._get_worker(runtime_env)
             w = self.workers[worker_id]
             w["state"] = "leased"
             self._next_lease += 1
@@ -273,12 +308,14 @@ class NodeManager:
         return {"ok": True, "node_id": self.node_id}
 
     def _offer_worker(self, worker_id: str):
-        while self._worker_waiters:
-            fut = self._worker_waiters.popleft()
+        ehash = self.workers.get(worker_id, {}).get("env_hash", "")
+        waiters = self._worker_waiters[ehash]
+        while waiters:
+            fut = waiters.popleft()
             if not fut.done():
                 fut.set_result(worker_id)
                 return
-        self.idle.append(worker_id)
+        self.idle[ehash].append(worker_id)
 
     async def _on_lease_worker(
         self,
@@ -286,6 +323,7 @@ class NodeManager:
         resources: dict | None = None,
         actor: bool = False,
         bundle: tuple | list | None = None,
+        runtime_env: dict | None = None,
     ):
         """Grant a worker lease (reference: NodeManager::
         HandleRequestWorkerLease node_manager.h:290). Infeasible requests
@@ -308,7 +346,7 @@ class NodeManager:
             # a worker without double-charging node resources. Credit the
             # bundle back if the grant itself fails (worker spawn error).
             try:
-                grant = await self._grant_lease({}, actor)
+                grant = await self._grant_lease({}, actor, runtime_env)
             except Exception:
                 for k, v in resources.items():
                     b["available"][k] += v
@@ -325,10 +363,11 @@ class NodeManager:
                 "error": f"infeasible request {resources} on {self.total}",
             }
         if self._available(resources):
-            return await self._grant_lease(resources, actor)
+            return await self._grant_lease(resources, actor, runtime_env)
         fut = asyncio.get_running_loop().create_future()
         self._pending.append(
-            (resources, actor, fut, asyncio.get_running_loop().time())
+            (resources, actor, fut, asyncio.get_running_loop().time(),
+             runtime_env)
         )
         return await fut
 
@@ -350,15 +389,15 @@ class NodeManager:
         w = self.workers.get(worker_id)
         if w and w.get("state") == "leased":
             w["state"] = "idle"
-            if self._worker_waiters:
+            ehash = w.get("env_hash", "")
+            if self._worker_waiters[ehash]:
                 # Hand the warm worker straight to a blocked lease grant
                 # rather than parking (or killing) it while the grant
                 # waits out an interpreter spawn.
                 self._offer_worker(worker_id)
-            elif len(self.idle) < IDLE_WORKER_CAP:
-                self.idle.append(worker_id)
             else:
-                self._kill_worker(worker_id)
+                self.idle[ehash].append(worker_id)
+                self._enforce_idle_cap()
         self._drain_pending()
         return {"ok": True}
 
@@ -400,12 +439,24 @@ class NodeManager:
             "store_dir": self.store_dir,
         }
 
+    def _enforce_idle_cap(self):
+        """Cap TOTAL idle workers across all runtime_env pools: many
+        distinct envs must not each park IDLE_WORKER_CAP interpreters.
+        Evicts from the fullest bucket (oldest entry first)."""
+        while (
+            sum(len(b) for b in self.idle.values()) > IDLE_WORKER_CAP
+        ):
+            ehash = max(self.idle, key=lambda k: len(self.idle[k]))
+            victim = self.idle[ehash].pop(0)
+            self._kill_worker(victim)
+
     def _kill_worker(self, worker_id: str):
         w = self.workers.pop(worker_id, None)
         if not w:
             return
-        if worker_id in self.idle:
-            self.idle.remove(worker_id)
+        ehash = w.get("env_hash", "")
+        if worker_id in self.idle[ehash]:
+            self.idle[ehash].remove(worker_id)
         proc = w.get("proc")
         if proc and proc.poll() is None:
             proc.kill()
@@ -413,23 +464,25 @@ class NodeManager:
     def _drain_pending(self):
         now = asyncio.get_event_loop().time()
         still = []
-        for resources, actor, fut, ts in self._pending:
+        for resources, actor, fut, ts, runtime_env in self._pending:
             if fut.done():
                 continue
             if self._available(resources):
-                asyncio.ensure_future(self._fulfil(resources, actor, fut))
+                asyncio.ensure_future(
+                    self._fulfil(resources, actor, fut, runtime_env)
+                )
             elif now - ts > PENDING_SPILL_S:
                 fut.set_result(
                     {"ok": False, "retry_spill": True,
                      "error": "queued past age limit; spill via head"}
                 )
             else:
-                still.append((resources, actor, fut, ts))
+                still.append((resources, actor, fut, ts, runtime_env))
         self._pending = still
 
-    async def _fulfil(self, resources, actor, fut):
+    async def _fulfil(self, resources, actor, fut, runtime_env=None):
         try:
-            result = await self._grant_lease(resources, actor)
+            result = await self._grant_lease(resources, actor, runtime_env)
             if not fut.done():
                 fut.set_result(result)
         except Exception as e:  # noqa: BLE001
@@ -450,7 +503,7 @@ class NodeManager:
                     # to GCS for GcsAutoscalerStateManager). Cluster-wide
                     # infeasible demand is recorded by the head itself in
                     # pick_node.
-                    pending=[dict(r) for r, _a, _f, _t in self._pending],
+                    pending=[dict(r) for r, *_rest in self._pending],
                 )
             except rpc.RpcError:
                 pass
@@ -471,13 +524,18 @@ class NodeManager:
             ]
             for wid in dead:
                 w = self.workers.pop(wid, None)
-                if wid in self.idle:
-                    self.idle.remove(wid)
-                if w and w.get("state") == "spawning" and self._worker_waiters:
+                ehash = (w or {}).get("env_hash", "")
+                if wid in self.idle[ehash]:
+                    self.idle[ehash].remove(wid)
+                if (
+                    w
+                    and w.get("state") == "spawning"
+                    and self._worker_waiters[ehash]
+                ):
                     # A worker died mid-spawn with grants still blocked on
-                    # registration — spawn a replacement immediately rather
-                    # than letting the waiter run out the 30 s spawn timeout.
-                    self._spawn_worker()
+                    # registration — spawn a replacement (same runtime_env)
+                    # rather than letting the waiter run out the timeout.
+                    self._spawn_worker(w.get("runtime_env"))
                 for lease_id, lease in list(self.leases.items()):
                     if lease.worker["worker_id"] == wid:
                         self.leases.pop(lease_id)

@@ -1,0 +1,107 @@
+"""Microbenchmark suite (reference: python/ray/_private/ray_perf.py —
+`ray microbenchmark`: put/get/task/actor ops-per-second).
+
+Run: python -m ray_tpu._private.perf [--quick]
+Each line: name, ops/s (mean over trials).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def timeit(name: str, fn, multiplier: int = 1, trials: int = 3) -> dict:
+    fn()  # warmup
+    rates = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        fn()
+        dt = time.perf_counter() - t0
+        rates.append(multiplier / dt)
+    rate = sum(rates) / len(rates)
+    print(f"{name:<46s} {rate:>12.1f} ops/s")
+    return {"name": name, "ops_per_s": rate}
+
+
+def main(quick: bool = False) -> list[dict]:
+    import numpy as np
+
+    import ray_tpu
+
+    n = 100 if quick else 1000
+    results = []
+    ray_tpu.init(num_cpus=4)
+    try:
+        small = b"x" * 100
+        big = np.zeros((1024, 1024), np.uint8)  # 1 MiB
+
+        def put_small():
+            for _ in range(n):
+                ray_tpu.put(small)
+
+        results.append(timeit("put (100 B)", put_small, n))
+
+        ref_small = ray_tpu.put(small)
+
+        def get_small():
+            for _ in range(n):
+                ray_tpu.get(ref_small)
+
+        results.append(timeit("get (100 B, cached owner)", get_small, n))
+
+        def put_big():
+            for _ in range(max(n // 10, 10)):
+                ray_tpu.put(big)
+
+        results.append(timeit("put (1 MiB)", put_big, max(n // 10, 10)))
+
+        @ray_tpu.remote
+        def noop():
+            return b"ok"
+
+        def task_sync():
+            for _ in range(max(n // 10, 10)):
+                ray_tpu.get(noop.remote())
+
+        results.append(
+            timeit("task submit+get (sync)", task_sync, max(n // 10, 10))
+        )
+
+        def task_async():
+            ray_tpu.get([noop.remote() for _ in range(n)])
+
+        results.append(timeit(f"tasks async x{n}", task_async, n))
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.x = 0
+
+            def inc(self):
+                self.x += 1
+                return self.x
+
+        c = Counter.remote()
+
+        def actor_sync():
+            for _ in range(max(n // 10, 10)):
+                ray_tpu.get(c.inc.remote())
+
+        results.append(
+            timeit("actor call (sync)", actor_sync, max(n // 10, 10))
+        )
+
+        def actor_async():
+            ray_tpu.get([c.inc.remote() for _ in range(n)])
+
+        results.append(timeit(f"actor calls async x{n}", actor_async, n))
+        ray_tpu.kill(c)
+    finally:
+        ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    import sys
+
+    main(quick="--quick" in sys.argv)

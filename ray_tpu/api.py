@@ -59,6 +59,7 @@ def init(
     num_cpus: float | None = None,
     resources: dict | None = None,
     object_store_dir: str | None = None,
+    observer: bool = False,
 ) -> dict:
     """Start (or connect to) a cluster and attach this process as driver.
 
@@ -95,18 +96,27 @@ def init(
             head = None
             head_addr = address
 
-        total = detect_resources()
-        if num_cpus is not None:
-            total["CPU"] = float(num_cpus)
-        total.update(resources or {})
         store_dir = object_store_dir or default_store_dir(session)
-        node = NodeManager(head_addr, store_dir, resources=total)
-        await node.start()
+        if observer:
+            # Read-only connection (CLI/dashboard): no schedulable node,
+            # no worker pool — the cluster must not see this process as
+            # capacity (reference: `ray status` attaches without adding
+            # a raylet).
+            if address is None:
+                raise RayTpuError("observer=True requires address=")
+            node = None
+        else:
+            total = detect_resources()
+            if num_cpus is not None:
+                total["CPU"] = float(num_cpus)
+            total.update(resources or {})
+            node = NodeManager(head_addr, store_dir, resources=total)
+            await node.start()
 
         core = CoreWorker(
             mode="driver",
             head_addr=head_addr,
-            node_addr=node.addr,
+            node_addr=node.addr if node else "",
             store_dir=store_dir,
         )
         await core.start()
@@ -119,7 +129,11 @@ def init(
     _runtime.mode = "driver"
     _runtime.session = session
     atexit.register(shutdown)
-    return {"address": head_addr, "session": session, "node_id": node.node_id}
+    return {
+        "address": head_addr,
+        "session": session,
+        "node_id": node.node_id if node else None,
+    }
 
 
 def shutdown() -> None:
@@ -137,7 +151,9 @@ def shutdown() -> None:
         _runtime.run(_teardown(), timeout=10)
     except Exception:
         pass
-    if _runtime.node is not None:
+    if _runtime.mode == "driver":
+        # Driver (and observer) sessions own their store dir; worker
+        # processes share their node's and must not delete it.
         _runtime.core.store.destroy()
     _runtime.loop.call_soon_threadsafe(_runtime.loop.stop)
     _runtime.thread.join(timeout=5)
@@ -263,9 +279,13 @@ class ObjectRefGenerator:
         ):
             return
         try:
-            asyncio.run_coroutine_threadsafe(
+            fut = asyncio.run_coroutine_threadsafe(
                 _runtime.core.close_generator(self._task_id), _runtime.loop
-            ).result(timeout=2)
+            )
+            # On the runtime loop's own thread (async consumers / GC
+            # there), blocking would deadlock the loop — fire and forget.
+            if threading.current_thread() is not _runtime.thread:
+                fut.result(timeout=2)
         except Exception:  # noqa: BLE001 - best-effort cleanup
             pass
 

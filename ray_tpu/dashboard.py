@@ -1,0 +1,146 @@
+"""Dashboard-lite: an HTTP window onto cluster state.
+
+Reference: python/ray/dashboard/ (aiohttp head process + React client +
+per-node agents). TPU-native scope: the data pipeline already terminates
+at the head (task events, metrics, node/actor tables — SURVEY.md §5), so
+the dashboard is a thin stdlib HTTP server over the state API: JSON
+endpoints for machines, a Prometheus endpoint for scrapers, and a small
+HTML status page for humans.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ray_tpu.util import state
+
+_ROUTES = {}
+
+
+def _route(path):
+    def deco(fn):
+        _ROUTES[path] = fn
+        return fn
+
+    return deco
+
+
+@_route("/api/nodes")
+def _nodes():
+    return state.list_nodes()
+
+
+@_route("/api/actors")
+def _actors():
+    return state.list_actors()
+
+
+@_route("/api/tasks")
+def _tasks():
+    return state.list_tasks(limit=1000)
+
+
+@_route("/api/task_summary")
+def _task_summary():
+    return state.summarize_tasks()
+
+
+@_route("/api/placement_groups")
+def _pgs():
+    return state.list_placement_groups()
+
+
+@_route("/api/jobs")
+def _jobs():
+    from ray_tpu.job import JobSubmissionClient
+
+    return JobSubmissionClient().list_jobs()
+
+
+def _index_html() -> str:
+    nodes = state.list_nodes()
+    actors = state.list_actors()
+    summary = state.summarize_tasks()
+    rows = "".join(
+        f"<tr><td>{html.escape(n['node_id'][:12])}</td>"
+        f"<td>{html.escape(n['addr'])}</td>"
+        f"<td>{html.escape(json.dumps(n['resources']))}</td>"
+        f"<td>{html.escape(json.dumps(n['available']))}</td></tr>"
+        for n in nodes
+    )
+    alive = sum(1 for a in actors if a["state"] == "ALIVE")
+    return f"""<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse}}
+td,th{{border:1px solid #999;padding:4px 8px}}</style></head><body>
+<h2>ray_tpu cluster</h2>
+<p>nodes: {len(nodes)} &middot; actors alive: {alive}/{len(actors)}
+&middot; tasks: {html.escape(json.dumps(summary))}</p>
+<table><tr><th>node</th><th>addr</th><th>total</th><th>available</th></tr>
+{rows}</table>
+<p>endpoints: /api/nodes /api/actors /api/tasks /api/task_summary
+/api/placement_groups /api/jobs /metrics</p>
+</body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 - stdlib API
+        try:
+            self.path = self.path.split("?", 1)[0]  # drop query strings
+            if self.path == "/" or self.path == "/index.html":
+                body = _index_html().encode()
+                ctype = "text/html"
+            elif self.path == "/metrics":
+                body = state.prometheus_metrics().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path in _ROUTES:
+                body = json.dumps(_ROUTES[self.path]()).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            self.send_error(500, explain=repr(e))
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="ray_tpu_dashboard",
+            daemon=True,
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> str:
+        self._thread.start()
+        return self.url
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 0) -> Dashboard:
+    """Serve the dashboard from this (driver) process; returns the
+    running Dashboard (use .url)."""
+    dash = Dashboard(host, port)
+    dash.start()
+    return dash

@@ -38,6 +38,7 @@ from ray_tpu.runtime.object_store import ObjectStore
 
 INLINE_MAX_BYTES = 100_000
 DEFAULT_RETRIES = 3
+GENERATOR_BACKPRESSURE_ITEMS = 8  # max undelivered items per stream
 
 
 class CoreWorker:
@@ -106,8 +107,11 @@ class CoreWorker:
         self._actor_addrs: dict[str, str] = {}
 
         # Streaming generator tasks this process owns: task_id → queue of
-        # ("item", oid_hex) | ("error", exc) | ("done",).
+        # ("item", oid_hex) | ("error", exc) | ("done",); plus a count of
+        # items delivered so far (gates retries: only an undelivered
+        # stream may be resubmitted).
         self._generators: dict[str, asyncio.Queue] = {}
+        self._gen_delivered: dict[str, int] = {}
 
         # Task-event buffer, flushed to the head periodically (reference:
         # worker-side TaskEventBuffer core_worker/task_event_buffer.h →
@@ -124,7 +128,11 @@ class CoreWorker:
         port = await self.server.start(host, 0)
         self.addr = f"{host}:{port}"
         self.head = await rpc.connect(self.head_addr)
-        self.node = await rpc.connect(self.node_addr)
+        # Observer connections (read-only CLI/dashboard) have no local
+        # node: head queries and object reads work, task submission does
+        # not.
+        if self.node_addr:
+            self.node = await rpc.connect(self.node_addr)
         self._exec_queue = asyncio.Queue()
         self._exec_task = asyncio.ensure_future(self._exec_loop())
         self._lease_reaper = asyncio.ensure_future(self._lease_reap_loop())
@@ -383,10 +391,6 @@ class CoreWorker:
         }
         if streaming:
             spec["streaming"] = True
-            # Streaming tasks must not be auto-retried: already-consumed
-            # items would replay (reference: generators restart only from
-            # lineage reconstruction, not mid-stream).
-            max_retries = 0
         self.record_task_event(
             spec, "SUBMITTED", kind="actor_task" if actor else "task"
         )
@@ -474,6 +478,15 @@ class CoreWorker:
                 return self._apply_reply(reply, oids, spec["task_id"])
             except (rpc.ConnectionLost, rpc.RpcError) as e:
                 last_err = e
+                if spec.get("streaming") and self._gen_delivered.get(
+                    spec["task_id"], 0
+                ):
+                    # Items were already delivered: a retry would replay
+                    # them. Fail instead (reference: generators restart
+                    # only via lineage reconstruction, not mid-stream).
+                    if getattr(e, "sent", True):
+                        lease = None
+                    break
                 if not getattr(e, "sent", True):
                     # The request never reached the worker (closed conn
                     # caught locally, chaos drop): the lease is intact —
@@ -916,7 +929,14 @@ class CoreWorker:
         oid_hex = ObjectID.for_return(TaskID.from_hex(task_id), index).hex()
         self._store_result(oid_hex, ("value", inband, buffers))
         q.put_nowait(("item", oid_hex))
-        return {"ok": True}
+        self._gen_delivered[task_id] = self._gen_delivered.get(task_id, 0) + 1
+        return {"ok": True, "depth": q.qsize()}
+
+    async def _on_generator_depth(self, conn, task_id: str):
+        q = self._generators.get(task_id)
+        if q is None:
+            return {"ok": False}
+        return {"ok": True, "depth": q.qsize()}
 
     async def next_generator_item(self, task_id: str):
         """("item", oid_hex) | ("done",) | ("error", exc); cleans up on
@@ -927,6 +947,7 @@ class CoreWorker:
         entry = await q.get()
         if entry[0] in ("done", "error"):
             del self._generators[task_id]
+            self._gen_delivered.pop(task_id, None)
         return entry
 
     async def close_generator(self, task_id: str):
@@ -934,6 +955,7 @@ class CoreWorker:
         memory store and deregister, so the producer's next report gets
         ok=False and stops."""
         q = self._generators.pop(task_id, None)
+        self._gen_delivered.pop(task_id, None)
         if q is None:
             return
         while not q.empty():
@@ -1029,6 +1051,14 @@ class CoreWorker:
                 getattr(gen, "close", lambda: None)()
                 return {"status": "ok", "results": []}
             index += 1
+            # Backpressure: pause while the consumer is far behind
+            # (reference: generator_backpressure_num_objects).
+            while ack.get("depth", 0) >= GENERATOR_BACKPRESSURE_ITEMS:
+                await asyncio.sleep(0.02)
+                ack = await owner.call("generator_depth", task_id=task_id)
+                if not ack.get("ok"):
+                    getattr(gen, "close", lambda: None)()
+                    return {"status": "ok", "results": []}
         await owner.call(
             "generator_item",
             task_id=task_id,

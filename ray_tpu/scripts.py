@@ -1,0 +1,134 @@
+"""CLI: `python -m ray_tpu.scripts <command>` (reference: `ray status`,
+`ray list ...`, `ray timeline` from scripts/scripts.py + state_cli.py).
+
+Commands connect to a running cluster via --address (or
+RAY_TPU_ADDRESS).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _connect(address: str | None):
+    import os
+
+    import ray_tpu
+
+    address = address or os.environ.get("RAY_TPU_ADDRESS")
+    if address:
+        # Observer: read-only attach — the CLI must not register itself
+        # as a schedulable node (tasks spilled onto it would die when
+        # the command exits seconds later).
+        return ray_tpu.init(address=address, observer=True)
+    return ray_tpu.init()
+
+
+def cmd_status(args) -> int:
+    from ray_tpu.util import state
+
+    _connect(args.address)
+    nodes = state.list_nodes()
+    print(f"nodes: {len(nodes)}")
+    for n in nodes:
+        print(
+            f"  {n['node_id'][:12]}  {n['addr']}"
+            f"  total={n['resources']}  available={n['available']}"
+        )
+    actors = state.list_actors()
+    alive = [a for a in actors if a["state"] == "ALIVE"]
+    print(f"actors: {len(alive)} alive / {len(actors)} total")
+    print(f"tasks: {state.summarize_tasks()}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state
+
+    _connect(args.address)
+    kind = args.kind
+    if kind == "nodes":
+        out = state.list_nodes()
+    elif kind == "actors":
+        out = state.list_actors()
+    elif kind == "tasks":
+        out = state.list_tasks(limit=args.limit)
+    elif kind == "placement-groups":
+        out = state.list_placement_groups()
+    elif kind == "jobs":
+        from ray_tpu.job import JobSubmissionClient
+
+        out = JobSubmissionClient().list_jobs()
+    else:
+        print(f"unknown kind {kind!r}", file=sys.stderr)
+        return 2
+    json.dump(out, sys.stdout, indent=2, default=str)
+    print()
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    from ray_tpu.util import state
+
+    _connect(args.address)
+    path = state.timeline(args.output)
+    print(f"wrote chrome trace to {path} (open in chrome://tracing)")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    from ray_tpu.util import state
+
+    _connect(args.address)
+    sys.stdout.write(state.prometheus_metrics())
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    import time
+
+    from ray_tpu.dashboard import start_dashboard
+
+    _connect(args.address)
+    dash = start_dashboard(port=args.port)
+    print(f"dashboard at {dash.url} (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    p.add_argument("--address", default=None, help="head address host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status")
+    lp = sub.add_parser("list")
+    lp.add_argument(
+        "kind",
+        choices=["nodes", "actors", "tasks", "placement-groups", "jobs"],
+    )
+    lp.add_argument("--limit", type=int, default=200)
+    tp = sub.add_parser("timeline")
+    tp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    sub.add_parser("metrics")
+    dp = sub.add_parser("dashboard")
+    dp.add_argument("--port", type=int, default=8265)
+
+    args = p.parse_args(argv)
+    return {
+        "status": cmd_status,
+        "list": cmd_list,
+        "timeline": cmd_timeline,
+        "metrics": cmd_metrics,
+        "dashboard": cmd_dashboard,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

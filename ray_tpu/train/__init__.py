@@ -15,7 +15,12 @@ from ray_tpu.train.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
-from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
 from ray_tpu.train.trainer import (
     FailureConfig,
     JaxTrainer,
@@ -35,6 +40,7 @@ __all__ = [
     "state_logical_axes",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "report",
     "FailureConfig",
     "JaxTrainer",

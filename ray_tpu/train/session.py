@@ -19,6 +19,8 @@ class TrainContext:
     storage_path: str = ""
     latest_checkpoint: str | None = None
     config: dict = field(default_factory=dict)
+    # name → list of block ObjectRefs (this worker's split)
+    dataset_shards: dict = field(default_factory=dict)
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)
@@ -49,6 +51,24 @@ def get_context() -> TrainContext:
 def get_checkpoint() -> str | None:
     """Latest checkpoint directory to restore from (None on fresh start)."""
     return get_context().latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of a dataset passed to JaxTrainer(datasets=...)
+    (reference: ray.train.get_dataset_shard → DataIterator). Returns a
+    ray_tpu.data Dataset over the shard's blocks; iterate with
+    .iter_batches(batch_size=...).
+    """
+    ctx = get_context()
+    refs = ctx.dataset_shards.get(name)
+    if refs is None:
+        raise KeyError(
+            f"no dataset {name!r}; trainer got "
+            f"{sorted(ctx.dataset_shards)}"
+        )
+    from ray_tpu.data.dataset import MaterializedDataset
+
+    return MaterializedDataset(list(refs))
 
 
 def report(metrics: dict, checkpoint: str | None = None) -> None:

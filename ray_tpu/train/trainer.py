@@ -85,6 +85,7 @@ class TrainWorker:
         config: dict,
         latest_checkpoint: str | None,
         backend_env: dict,
+        dataset_shards: dict | None = None,
     ):
         import os
 
@@ -106,6 +107,7 @@ class TrainWorker:
             storage_path=storage_path,
             latest_checkpoint=latest_checkpoint,
             config=config,
+            dataset_shards=dataset_shards or {},
         )
         return True
 
@@ -139,11 +141,27 @@ class JaxTrainer:
         train_loop_config: dict | None = None,
         scaling_config: ScalingConfig | None = None,
         run_config: RunConfig | None = None,
+        datasets: dict | None = None,
     ):
         self.train_loop = train_loop_per_worker
         self.config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        # name → ray_tpu.data.Dataset; split per worker at fit() time
+        # (reference: DataConfig splits ray.data streams per worker,
+        # train/v2/_internal/data_integration/).
+        self.datasets = datasets or {}
+
+    def _split_datasets(self, n: int) -> list[dict]:
+        """Materialize each dataset and deal its block refs round-robin:
+        worker i gets shard dicts {name: [refs]} — refs resolve from any
+        process (ownership model), so shards ship as plain messages."""
+        shards: list[dict] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            refs = ds.materialize()._refs
+            for i in range(n):
+                shards[i][name] = refs[i::n]
+        return shards
 
     # ------------------------------------------------------------ fit
     def fit(self) -> Result:
@@ -216,6 +234,7 @@ class JaxTrainer:
                 ).remote(i, n)
                 for i in range(n)
             ]
+            shards = self._split_datasets(n)
             ray_tpu.get(
                 [
                     w.setup.remote(
@@ -224,6 +243,7 @@ class JaxTrainer:
                         self.config,
                         latest_checkpoint,
                         self._backend_env(i),
+                        shards[i],
                     )
                     for i, w in enumerate(workers)
                 ],

@@ -1,0 +1,98 @@
+"""Dashboard HTTP endpoint + CLI tests (reference: dashboard head
+serving /api/* + metrics, python/ray/dashboard/head.py)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def dash(cluster):
+    d = start_dashboard()
+    yield d
+    d.stop()
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read()
+
+
+def test_dashboard_nodes_and_actors(dash):
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    a = Pinger.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    nodes = json.loads(_get(dash.url + "/api/nodes"))
+    assert len(nodes) >= 1 and "resources" in nodes[0]
+    actors = json.loads(_get(dash.url + "/api/actors"))
+    assert any(x["class_name"] == "Pinger" for x in actors)
+    ray_tpu.kill(a)
+
+
+def test_dashboard_tasks_and_metrics(dash):
+    from ray_tpu.util.metrics import Counter
+
+    Counter("dash_hits", "hits").inc(3)
+
+    @ray_tpu.remote
+    def noop():
+        return 1
+
+    ray_tpu.get([noop.remote() for _ in range(2)])
+    time.sleep(1.5)  # event flush
+    tasks = json.loads(_get(dash.url + "/api/tasks"))
+    assert any(t.get("name") == "noop" for t in tasks)
+    summary = json.loads(_get(dash.url + "/api/task_summary"))
+    assert summary.get("FINISHED", 0) >= 2
+
+    metrics = _get(dash.url + "/metrics").decode()
+    assert "dash_hits" in metrics
+
+    page = _get(dash.url + "/").decode()
+    assert "ray_tpu cluster" in page
+
+
+def test_dashboard_404(dash):
+    with pytest.raises(urllib.error.HTTPError):
+        _get(dash.url + "/api/nope")
+
+
+def test_cli_status_and_list(cluster, capsys):
+    from ray_tpu import scripts
+
+    # Already initialized in this process: _connect would re-init; call
+    # the underlying pieces the way the CLI does after connecting.
+    from ray_tpu.util import state
+
+    nodes = state.list_nodes()
+    assert nodes
+    # Exercise the arg parser + dispatch on a fresh subprocess instead.
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "--address",
+         cluster["address"], "status"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "nodes:" in proc.stdout

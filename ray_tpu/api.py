@@ -69,6 +69,10 @@ def init(
     """
     if _runtime.ready:
         raise RayTpuError("ray_tpu is already initialized")
+    if observer and not (address or os.environ.get("RAY_TPU_ADDRESS")):
+        # Validate before the loop thread / head service start so a bad
+        # call leaks nothing.
+        raise RayTpuError("observer=True requires address=")
     if address is None:
         # Job drivers launched by the job manager inherit the cluster
         # address (reference: RAY_ADDRESS env for `ray job submit`
@@ -102,8 +106,6 @@ def init(
             # no worker pool — the cluster must not see this process as
             # capacity (reference: `ray status` attaches without adding
             # a raylet).
-            if address is None:
-                raise RayTpuError("observer=True requires address=")
             node = None
         else:
             total = detect_resources()

@@ -11,6 +11,7 @@ from ray_tpu.parallel.mesh import (
     default_axis_sizes,
     make_mesh,
 )
+from ray_tpu.parallel.pipeline import pipeline_apply, pipeline_loss_fn
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     logical_sharding,
@@ -20,6 +21,8 @@ from ray_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "pipeline_apply",
+    "pipeline_loss_fn",
     "MESH_AXES",
     "default_axis_sizes",
     "make_mesh",

@@ -10,12 +10,11 @@ Canonical axis order (outer → inner, DCN-ish → ICI-ish):
 
     dp    pure data parallelism (gradient psum, no param sharding)
     fsdp  data parallelism with parameters/optimizer sharded (ZeRO-3 style)
+    pp    pipeline parallelism (layer stages; ray_tpu.parallel.pipeline
+          runs the GPipe microbatch schedule over this axis)
     ep    expert parallelism (MoE experts spread over chips)
     tp    tensor parallelism (heads / mlp / vocab sharded)
     sp    sequence/context parallelism (ring attention, Ulysses)
-
-Pipeline parallelism is not a mesh axis here; it is expressed as a stage
-loop over a `pp` axis by `ray_tpu.parallel.pipeline` (see that module).
 """
 
 from __future__ import annotations
@@ -29,7 +28,7 @@ from jax.sharding import Mesh
 
 # Canonical mesh axes, outer-to-inner. Axes of size 1 are always present so
 # sharding rules never need to special-case a missing axis.
-MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp")
+MESH_AXES = ("dp", "fsdp", "pp", "ep", "tp", "sp")
 
 
 def default_axis_sizes(n_devices: int) -> dict[str, int]:

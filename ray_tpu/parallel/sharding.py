@@ -48,6 +48,7 @@ DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
     ("mlp", "tp"),
     ("vocab", "tp"),
     ("layers", None),
+    ("stage", "pp"),
     ("expert", "ep"),
     (None, None),
 )

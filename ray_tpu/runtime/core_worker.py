@@ -112,6 +112,11 @@ class CoreWorker:
         # stream may be resubmitted).
         self._generators: dict[str, asyncio.Queue] = {}
         self._gen_delivered: dict[str, int] = {}
+        # task_id → current submission attempt: reports from a PREVIOUS
+        # attempt (a worker that died after sending but before we saw the
+        # item) are rejected, so a retried stream can never deliver
+        # duplicates.
+        self._gen_attempt: dict[str, int] = {}
 
         # Task-event buffer, flushed to the head periodically (reference:
         # worker-side TaskEventBuffer core_worker/task_event_buffer.h →
@@ -391,6 +396,7 @@ class CoreWorker:
         }
         if streaming:
             spec["streaming"] = True
+            self._gen_attempt[task_id.hex()] = 0
         self.record_task_event(
             spec, "SUBMITTED", kind="actor_task" if actor else "task"
         )
@@ -472,6 +478,11 @@ class CoreWorker:
         for attempt in range(retries + 1):
             lease = None
             try:
+                if spec.get("streaming"):
+                    # Stamp the attempt so late item reports from a dead
+                    # earlier attempt can't interleave with this one.
+                    spec = {**spec, "attempt": attempt}
+                    self._gen_attempt[spec["task_id"]] = attempt
                 lease = await self._lease(resources, placement, runtime_env)
                 conn = await self._connect(lease["addr"])
                 reply = await conn.call("push_task", spec=spec)
@@ -916,13 +927,16 @@ class CoreWorker:
         return {"kind": "in_store"}
 
     async def _on_generator_item(
-        self, conn, task_id: str, index: int, inband, buffers, done: bool
+        self, conn, task_id: str, index: int, inband, buffers, done: bool,
+        attempt: int = 0,
     ):
         """Owner side of a streaming generator (reference: the owner's
         handling of ReportGeneratorItemReturns)."""
         q = self._generators.get(task_id)
         if q is None:
             return {"ok": False}  # consumer gone; producer may stop
+        if attempt != self._gen_attempt.get(task_id, 0):
+            return {"ok": False}  # stale report from a superseded attempt
         if done:
             q.put_nowait(("done",))
             return {"ok": True}
@@ -948,6 +962,7 @@ class CoreWorker:
         if entry[0] in ("done", "error"):
             del self._generators[task_id]
             self._gen_delivered.pop(task_id, None)
+            self._gen_attempt.pop(task_id, None)
         return entry
 
     async def close_generator(self, task_id: str):
@@ -956,6 +971,7 @@ class CoreWorker:
         ok=False and stops."""
         q = self._generators.pop(task_id, None)
         self._gen_delivered.pop(task_id, None)
+        self._gen_attempt.pop(task_id, None)
         if q is None:
             return
         while not q.empty():
@@ -1029,6 +1045,7 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
         owner = await self._connect(spec["owner_addr"])
         task_id = spec["task_id"]
+        attempt = spec.get("attempt", 0)
         index = 0
         _SENTINEL = object()
         while True:
@@ -1045,6 +1062,7 @@ class CoreWorker:
                 inband=data.inband,
                 buffers=data.buffers,
                 done=False,
+                attempt=attempt,
             )
             if not ack.get("ok"):
                 # Consumer closed/abandoned the generator: stop producing.
@@ -1066,6 +1084,7 @@ class CoreWorker:
             inband=None,
             buffers=None,
             done=True,
+            attempt=attempt,
         )
         return {"status": "ok", "results": []}
 

@@ -18,12 +18,20 @@ def _connect(address: str | None):
     import ray_tpu
 
     address = address or os.environ.get("RAY_TPU_ADDRESS")
-    if address:
-        # Observer: read-only attach — the CLI must not register itself
-        # as a schedulable node (tasks spilled onto it would die when
-        # the command exits seconds later).
-        return ray_tpu.init(address=address, observer=True)
-    return ray_tpu.init()
+    if not address:
+        # Booting a fresh cluster just to inspect it would print a
+        # plausible-looking answer about the wrong cluster (reference:
+        # `ray status` errors when no cluster is found).
+        print(
+            "error: no cluster address (pass --address or set "
+            "RAY_TPU_ADDRESS)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    # Observer: read-only attach — the CLI must not register itself as a
+    # schedulable node (tasks spilled onto it would die when the command
+    # exits seconds later).
+    return ray_tpu.init(address=address, observer=True)
 
 
 def cmd_status(args) -> int:

@@ -69,15 +69,15 @@ def init(
     """
     if _runtime.ready:
         raise RayTpuError("ray_tpu is already initialized")
-    if observer and not (address or os.environ.get("RAY_TPU_ADDRESS")):
-        # Validate before the loop thread / head service start so a bad
-        # call leaks nothing.
-        raise RayTpuError("observer=True requires address=")
     if address is None:
         # Job drivers launched by the job manager inherit the cluster
         # address (reference: RAY_ADDRESS env for `ray job submit`
         # entrypoints).
         address = os.environ.get("RAY_TPU_ADDRESS") or None
+    if observer and address is None:
+        # Validate before the loop thread / head service start so a bad
+        # call leaks nothing.
+        raise RayTpuError("observer=True requires address=")
 
     loop = asyncio.new_event_loop()
     thread = threading.Thread(

@@ -39,7 +39,11 @@ class LlamaConfig:
     max_seq: int = 8192
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
-    # "none" | "full": remat policy for the scanned layer body.
+    # Remat policy for the scanned layer body:
+    #   "none"  keep all activations (fastest, most memory)
+    #   "full"  recompute everything in backward (least memory)
+    #   "dots"  save matmul outputs, recompute elementwise (middle ground;
+    #           jax dots_with_no_batch_dims_saveable)
     remat: str = "full"
     # "dense" | "ring" | "ulysses": attention strategy. ring/ulysses need a
     # mesh with sp>1 (built by ray_tpu.train.step.jit_train_step).
@@ -188,8 +192,15 @@ def forward_with_aux(
     cfg: LlamaConfig,
     attn_fn: AttnFn | None = None,
     ffn_fn: FfnFn | None = None,
+    return_hidden: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens [B, S] int32 → (logits [B, S, V] fp32, summed aux loss)."""
+    """tokens [B, S] int32 → (logits [B, S, V] fp32, summed aux loss).
+
+    With ``return_hidden`` the final-norm hidden states [B, S, d] come
+    back instead of logits — the chunked-CE loss projects them to the
+    vocabulary a slice at a time so the full [B, S, V] logits (and their
+    gradient) never materialize.
+    """
     attn_fn = attn_fn or causal_attention
     ffn_fn = ffn_fn or _dense_ffn
     seq = tokens.shape[1]
@@ -204,6 +215,11 @@ def forward_with_aux(
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable
         )
+    elif cfg.remat == "dots":
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
 
     def scan_fn(carry, layer_params):
         x, aux_sum = carry
@@ -215,6 +231,8 @@ def forward_with_aux(
     )
 
     x = rms_norm(x, params["final_norm"])
+    if return_hidden:
+        return x, aux_total
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
     return logits, aux_total
 

@@ -172,9 +172,12 @@ def moe_forward(
     tokens: jnp.ndarray,
     cfg: MoEConfig,
     attn_fn=None,
+    return_hidden: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """tokens [B, S] → (logits [B, S, V] fp32, mean aux loss)."""
-    logits, aux_total = forward_with_aux(
-        params, tokens, cfg, attn_fn=attn_fn, ffn_fn=moe_ffn
+    """tokens [B, S] → (logits [B, S, V] fp32 — or final hidden states
+    with ``return_hidden`` — and the mean aux loss)."""
+    out, aux_total = forward_with_aux(
+        params, tokens, cfg, attn_fn=attn_fn, ffn_fn=moe_ffn,
+        return_hidden=return_hidden,
     )
-    return logits, aux_total / cfg.n_layers
+    return out, aux_total / cfg.n_layers

@@ -44,16 +44,31 @@ def pipeline_apply(
     stage's outputs for the full batch, replicated over ``axis``.
     """
     n_stages = mesh.shape[axis]
+    for leaf in jax.tree.leaves(stage_params):
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage dim {leaf.shape[0]} != mesh {axis}={n_stages}; a "
+                "mismatch would silently drop stages"
+            )
+    # Batch shards over the data axes (pipeline composes with DP); each
+    # dp shard runs its own GPipe schedule on its slice.
+    dp_axes = tuple(
+        a for a in ("dp", "fsdp") if a in mesh.shape and mesh.shape[a] > 1
+    )
+    dp_total = 1
+    for a in dp_axes:
+        dp_total *= mesh.shape[a]
     batch = x.shape[0]
-    if batch % num_microbatches:
+    if batch % (num_microbatches * dp_total):
         raise ValueError(
-            f"batch {batch} not divisible by microbatches {num_microbatches}"
+            f"batch {batch} not divisible by microbatches "
+            f"{num_microbatches} x data shards {dp_total}"
         )
-    mb = batch // num_microbatches
+    mb = batch // dp_total // num_microbatches
 
     def per_device(params_local, x_full):
         # params_local leaves: [1, ...] (this device's stage); x_full is
-        # the whole batch (replicated over pp).
+        # this data shard's slice of the batch (replicated over pp).
         params_one = jax.tree.map(lambda a: a[0], params_local)
         stage = jax.lax.axis_index(axis)
         micro = x_full.reshape(num_microbatches, mb, *x_full.shape[1:])
@@ -101,14 +116,15 @@ def pipeline_apply(
         outputs = jax.lax.psum(
             jnp.where(stage == n_stages - 1, outputs, 0.0), axis
         )
-        return outputs.reshape(batch, *x_full.shape[1:])
+        return outputs.reshape(-1, *x_full.shape[1:])
 
     spec_params = jax.tree.map(lambda _: P(axis), stage_params)
+    batch_spec = P(dp_axes if dp_axes else None)
     return jax.shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(spec_params, P()),
-        out_specs=P(),
+        in_specs=(spec_params, batch_spec),
+        out_specs=batch_spec,
         check_vma=False,
     )(stage_params, x)
 

@@ -19,7 +19,6 @@ import optax
 
 from ray_tpu.models.llama import (
     LlamaConfig,
-    forward,
     init_params,
     param_logical_axes,
 )
@@ -110,6 +109,38 @@ def state_logical_axes(
     return TrainState(step=(), params=p_axes, opt_state=axes_state)
 
 
+def chunked_cross_entropy(
+    hidden: jnp.ndarray,  # [B, S, d] final-norm hidden states
+    lm_head: jnp.ndarray,  # [d, V]
+    targets: jnp.ndarray,  # [B, S] int32
+    dtype,
+    chunk: int = 512,
+) -> jnp.ndarray:
+    """Mean next-token CE without materializing [B, S, V] logits.
+
+    A rematerialized scan projects one sequence-chunk of hidden states at
+    a time, so peak memory is O(B·chunk·V) instead of O(B·S·V) — at
+    32k vocab this is what bounds the trainable batch size on a chip.
+    """
+    b, s, d = hidden.shape
+    if s % chunk:
+        chunk = s  # odd lengths: single chunk (tests, tiny configs)
+    n = s // chunk
+    xc = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)  # [n, B, chunk, d]
+    tc = targets.reshape(b, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(acc, xt):
+        xcb, tcb = xt
+        logits = (xcb @ lm_head.astype(dtype)).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tcb[..., None], axis=-1)[..., 0]
+        return acc + (logz - tgt).sum(), None
+
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), (xc, tc))
+    return total / (b * s)
+
+
 def loss_fn(
     params: Any,
     batch: dict[str, jnp.ndarray],
@@ -117,19 +148,23 @@ def loss_fn(
     attn_fn=None,
 ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
     """Next-token cross entropy. batch["tokens"]: [B, S+1] int32."""
+    from ray_tpu.models.llama import forward_with_aux
     from ray_tpu.models.moe import MoEConfig, moe_forward
 
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    aux = None
     if isinstance(cfg, MoEConfig):
-        logits, aux = moe_forward(params, inputs, cfg, attn_fn=attn_fn)
+        hidden, aux = moe_forward(
+            params, inputs, cfg, attn_fn=attn_fn, return_hidden=True
+        )
     else:
-        logits = forward(params, inputs, cfg, attn_fn=attn_fn)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = logz - tgt_logit
-    ce = jnp.mean(nll)
+        hidden, aux = forward_with_aux(
+            params, inputs, cfg, attn_fn=attn_fn, return_hidden=True
+        )
+        aux = None
+    ce = chunked_cross_entropy(
+        hidden, params["lm_head"], targets, cfg.dtype
+    )
     metrics = {"loss": ce, "perplexity": jnp.exp(ce)}
     if aux is None:
         return ce, metrics

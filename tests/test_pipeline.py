@@ -53,7 +53,7 @@ def test_pipeline_matches_sequential(pp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("microbatches", [1, 2, 16])
+@pytest.mark.parametrize("microbatches", [1, 2, 8])
 def test_pipeline_microbatch_counts(pp_mesh, microbatches):
     params = _make_params(jax.random.key(2))
     x = jax.random.normal(jax.random.key(3), (16, D))
@@ -129,4 +129,15 @@ def test_pipeline_rejects_bad_microbatching(pp_mesh):
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(
             params, x, _stage_fn, mesh=pp_mesh, num_microbatches=3
+        )
+
+
+def test_pipeline_rejects_stage_mismatch(pp_mesh):
+    """Params with a wrong stage count must error, not silently drop
+    stages (shard_map would otherwise split them across devices)."""
+    params = _make_params(jax.random.key(10), n_stages=8)
+    x = jnp.zeros((8, D))
+    with pytest.raises(ValueError, match="stage dim"):
+        pipeline_apply(
+            params, x, _stage_fn, mesh=pp_mesh, num_microbatches=4
         )

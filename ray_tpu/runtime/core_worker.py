@@ -41,6 +41,14 @@ DEFAULT_RETRIES = 3
 GENERATOR_BACKPRESSURE_ITEMS = 8  # max undelivered items per stream
 
 
+class _NeedsPull(Exception):
+    """Internal: the record's bytes live in another node's store."""
+
+    def __init__(self, holder_addr: str):
+        super().__init__(holder_addr)
+        self.holder_addr = holder_addr
+
+
 class CoreWorker:
     def __init__(
         self,
@@ -63,7 +71,8 @@ class CoreWorker:
         self._conn_locks: dict[str, asyncio.Lock] = {}
 
         # memory store: oid hex → ("value", inband, buffers) | ("error", e)
-        # | ("in_store",)
+        # | ("in_store", holder_node_addr | None) — the holder addr names
+        # the node whose store has the bytes (multi-node pulls)
         self.memory: dict[str, tuple] = {}
         self._waiters: dict[str, list[asyncio.Future]] = {}
 
@@ -256,10 +265,28 @@ class CoreWorker:
             return deserialize(rest[0], rest[1])
         if kind == "in_store":
             view = self.store.get(ObjectID.from_hex(oid_hex))
-            if view is None:
-                raise RayTaskError(f"object {oid_hex[:12]}… lost from store")
-            return deserialize(view.inband, view.buffers)
+            if view is not None:
+                return deserialize(view.inband, view.buffers)
+            # Not in THIS node's store: the record may carry the holding
+            # node's address (multi-node result) — callers in async
+            # context pull it chunked via _maybe_pull_record.
+            holder = rest[0] if rest else None
+            if holder:
+                raise _NeedsPull(holder)
+            raise RayTaskError(f"object {oid_hex[:12]}… lost from store")
         raise AssertionError(kind)
+
+    async def _maybe_pull_record(self, oid_hex: str, timeout=None):
+        """_read_record + transparent chunked pull for remote-store
+        records (reference: raylet PullManager drives chunked Push from
+        the holding node, pull_manager.h:50)."""
+        try:
+            return self._read_record(oid_hex)
+        except _NeedsPull as need:
+            conn = await self._connect(need.holder_addr)
+            return await self._pull_remote(
+                ObjectID.from_hex(oid_hex), conn, timeout
+            )
 
     # -------------------------------------------------------------- put
     async def put(self, value: Any):
@@ -281,7 +308,7 @@ class CoreWorker:
         self, oid_hex: str, owner_addr: str, timeout: float | None
     ) -> Any:
         if oid_hex in self.memory:
-            return self._read_record(oid_hex)
+            return await self._maybe_pull_record(oid_hex, timeout)
         oid = ObjectID.from_hex(oid_hex)
         view = self.store.get(oid)
         if view is not None:
@@ -290,7 +317,7 @@ class CoreWorker:
             owner_addr is None
         ):
             await self._wait_local(oid_hex, timeout)
-            return self._read_record(oid_hex)
+            return await self._maybe_pull_record(oid_hex, timeout)
         # Ask the owner (reference: OwnershipBasedObjectDirectory).
         conn = await self._connect(owner_addr)
         reply = await asyncio.wait_for(
@@ -302,13 +329,79 @@ class CoreWorker:
             view = self.store.get(oid)
             if view is not None:
                 return deserialize(view.inband, view.buffers)
-            raise RayTaskError(
-                f"object {oid_hex[:12]}… is in a remote node's store; "
-                "multi-node object transfer not yet wired"
-            )
+            # The object lives in a node store elsewhere: pull it in
+            # chunks from the holding NODE (reference:
+            # ObjectManagerService.Push streams 5 MiB chunks,
+            # object_manager.proto:60), then cache it locally.
+            holder = reply.get("holder")
+            src = await self._connect(holder) if holder else conn
+            return await self._pull_remote(oid, src, timeout)
         if reply["kind"] == "error":
             raise deserialize(reply["inband"])
         raise AssertionError(reply["kind"])
+
+    PULL_CHUNK_BYTES = 5 * 1024 * 1024  # object_manager_default_chunk_size
+
+    async def _pull_remote(self, oid, owner_conn, timeout):
+        """Chunked pull of a store-resident object from its owner.
+
+        ``timeout`` bounds the WHOLE pull (the remaining budget shrinks
+        per chunk), matching get()'s single-deadline semantics."""
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+
+        def remaining():
+            if deadline is None:
+                return None
+            left = deadline - loop.time()
+            if left <= 0:
+                raise GetTimeoutError(
+                    f"timed out pulling {oid.hex()[:12]}…"
+                )
+            return left
+
+        oid_hex = oid.hex()
+        meta = await asyncio.wait_for(
+            owner_conn.call("get_object_meta", oid_hex=oid_hex), remaining()
+        )
+        if not meta.get("ok"):
+            raise RayTaskError(
+                f"object {oid_hex[:12]}… vanished from the owner's store"
+            )
+        total = meta["total"]
+        parts = []
+        offset = 0
+        while offset < total:
+            chunk = await asyncio.wait_for(
+                owner_conn.call(
+                    "get_object_chunk",
+                    oid_hex=oid_hex,
+                    offset=offset,
+                    size=self.PULL_CHUNK_BYTES,
+                ),
+                remaining(),
+            )
+            if not chunk.get("ok"):
+                raise RayTaskError(
+                    f"object {oid_hex[:12]}… pull failed mid-stream"
+                )
+            parts.append(chunk["data"])
+            offset += len(chunk["data"])
+        blob = b"".join(parts)
+        seg_lens = meta["seg_lens"]
+        segs = []
+        pos = 0
+        for n in seg_lens:
+            segs.append(blob[pos : pos + n])
+            pos += n
+        inband, buffers = segs[0], segs[1:]
+        # Cache locally so later readers on this node hit the store.
+        try:
+            self.store.put(oid, Serialized(inband, list(buffers)))
+        except Exception:  # noqa: BLE001 - cache is best-effort
+            pass
+        return deserialize(inband, buffers)
+
 
     async def get(self, refs: Sequence, timeout: float | None = None) -> list:
         return list(
@@ -580,8 +673,10 @@ class CoreWorker:
         for oid_hex, kind, *rest in reply["results"]:
             if kind == "inline":
                 self._store_result(oid_hex, ("value", rest[0], rest[1]))
-            else:  # in the node-shared store
-                self._store_result(oid_hex, ("in_store",))
+            else:  # in a node's shared store (rest = [holder_node_addr])
+                self._store_result(
+                    oid_hex, ("in_store", rest[0] if rest else None)
+                )
         return False
 
     # ------------------------------------------------------------ leases
@@ -924,7 +1019,26 @@ class CoreWorker:
             return {"kind": "error", "inband": _dumps_small(rest[0])}
         if kind == "value":
             return {"kind": "value", "inband": rest[0], "buffers": rest[1]}
-        return {"kind": "in_store"}
+        return {"kind": "in_store", "holder": rest[0] if rest else None}
+
+    async def _on_get_object_meta(self, conn, oid_hex: str):
+        """Segment layout of a store-resident object (chunked pull)."""
+        from ray_tpu.runtime.object_store import segment_meta
+
+        view = self.store.get(ObjectID.from_hex(oid_hex))
+        if view is None:
+            return {"ok": False}
+        return segment_meta(view)
+
+    async def _on_get_object_chunk(
+        self, conn, oid_hex: str, offset: int, size: int
+    ):
+        from ray_tpu.runtime.object_store import segment_window
+
+        view = self.store.get(ObjectID.from_hex(oid_hex))
+        if view is None:
+            return {"ok": False}
+        return {"ok": True, "data": segment_window(view, offset, size)}
 
     async def _on_generator_item(
         self, conn, task_id: str, index: int, inband, buffers, done: bool,
@@ -1148,7 +1262,9 @@ class CoreWorker:
                     results.append((oid.hex(), "inline", m.inband, m.buffers))
                 else:
                     self.store.put(oid, data)
-                    results.append((oid.hex(), "in_store"))
+                    # Carry the holding node's address: the owner may sit
+                    # on another node with a different store.
+                    results.append((oid.hex(), "in_store", self.node_addr))
             self.record_task_event(
                 spec, "RUNNING", ts=exec_start, dur=time.time() - exec_start
             )

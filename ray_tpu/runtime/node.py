@@ -128,6 +128,11 @@ class NodeManager:
         )
         self._next_lease = 0
         self._tasks: list[asyncio.Task] = []
+        # Read view of this node's object store: the node serves chunked
+        # object pulls to other nodes (reference: the raylet's
+        # ObjectManager serves Push/Pull, object_manager.h:128) — workers
+        # come and go, the node daemon persists.
+        self._store_reader = None
 
     # ----------------------------------------------------------- startup
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -297,6 +302,46 @@ class NodeManager:
         if fn is None:
             raise rpc.RpcError(f"node: unknown method {method!r}")
         return await fn(conn=conn, **kw)
+
+    # ---------------------------------------------------- object serving
+    def _store(self):
+        if self._store_reader is None:
+            from ray_tpu.runtime.object_store import ObjectStore
+
+            self._store_reader = ObjectStore(self.store_dir)
+        return self._store_reader
+
+    async def _on_get_object_meta(self, conn, oid_hex: str):
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu.runtime.object_store import segment_meta
+
+        oid = ObjectID.from_hex(oid_hex)
+        store = self._store()
+        view = store.get(oid)
+        if view is None:
+            return {"ok": False}
+        try:
+            return segment_meta(view)
+        finally:
+            # The daemon never exits: cached mmaps would pin shm pages
+            # for every object ever served.
+            store.release(oid)
+
+    async def _on_get_object_chunk(
+        self, conn, oid_hex: str, offset: int, size: int
+    ):
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu.runtime.object_store import segment_window
+
+        oid = ObjectID.from_hex(oid_hex)
+        store = self._store()
+        view = store.get(oid)
+        if view is None:
+            return {"ok": False}
+        try:
+            return {"ok": True, "data": segment_window(view, offset, size)}
+        finally:
+            store.release(oid)
 
     async def _on_register_worker(
         self, conn, worker_id: str, addr: str, pid: int

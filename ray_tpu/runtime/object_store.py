@@ -168,6 +168,11 @@ class ObjectStore:
         self._views[object_id] = view
         return view
 
+    def release(self, object_id: ObjectID) -> None:
+        """Drop this process's cached mmap view (serving paths that touch
+        many objects must not pin every mapping forever)."""
+        self._views.pop(object_id, None)
+
     def contains(self, object_id: ObjectID) -> bool:
         if object_id in self._views or self._path(object_id).exists():
             return True
@@ -215,6 +220,29 @@ class ObjectStore:
         import shutil
 
         shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def segment_meta(view) -> dict:
+    """Segment layout of a serialized object view (chunked-pull meta)."""
+    seg_lens = [len(view.inband)] + [len(b) for b in view.buffers]
+    return {"ok": True, "seg_lens": seg_lens, "total": sum(seg_lens)}
+
+
+def segment_window(view, offset: int, size: int) -> bytes:
+    """One window of the logical byte stream (inband ++ buffers), sliced
+    without copying the parts outside the window."""
+    out = bytearray()
+    pos = 0
+    for seg in [view.inband, *view.buffers]:
+        seg_len = len(seg)
+        if offset < pos + seg_len and len(out) < size:
+            start = max(0, offset - pos)
+            take = min(seg_len - start, size - len(out))
+            out += memoryview(seg)[start : start + take]
+        pos += seg_len
+        if len(out) >= size:
+            break
+    return bytes(out)
 
 
 def _pool_capacity(directory: Path) -> int:

@@ -324,6 +324,27 @@ int shm_delete(void* handle, const uint8_t* id) {
   return 0;
 }
 
+// Scan sealed, unpinned objects (spill candidates). Fills up to
+// `max_entries` of (id, size, lru) triples; returns the count. The spill
+// loop ranks by lru ascending and moves cold objects to disk before the
+// allocator's LRU eviction would drop them.
+int shm_pool_scan(void* handle, uint8_t* out_ids, uint64_t* out_sizes,
+                  uint64_t* out_lru, uint32_t max_entries) {
+  Handle* h = static_cast<Handle*>(handle);
+  MutexGuard g(&h->hdr->mutex);
+  uint32_t n = 0;
+  for (uint32_t i = 0; i < h->hdr->num_slots && n < max_entries; i++) {
+    Slot* s = &h->slots[i];
+    if (s->state == 2 && s->refcount == 0) {
+      memcpy(out_ids + (uint64_t)n * kIdLen, s->id, kIdLen);
+      out_sizes[n] = s->size;
+      out_lru[n] = s->lru;
+      n++;
+    }
+  }
+  return (int)n;
+}
+
 // Abort an in-progress create (creator died or serialization failed).
 int shm_abort(void* handle, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(handle);

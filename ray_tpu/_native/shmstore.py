@@ -62,6 +62,12 @@ def _load():
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.shm_get.restype = ctypes.c_int
+    lib.shm_pool_scan.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint32,
+    ]
+    lib.shm_pool_scan.restype = ctypes.c_int
     _lib = lib
     return lib
 
@@ -191,6 +197,28 @@ class ShmPool:
 
     def used_bytes(self) -> int:
         return self._lib.shm_pool_used(self._h) if self._h else 0
+
+    def capacity_bytes(self) -> int:
+        return self._lib.shm_pool_capacity(self._h) if self._h else 0
+
+    def scan(self, max_entries: int = 8192) -> list[tuple[bytes, int, int]]:
+        """(id_bytes, size, lru_tick) for sealed, unpinned objects —
+        the spill loop's candidate list, coldest-first after sorting."""
+        if not self._h:
+            return []
+        ids = (ctypes.c_uint8 * (max_entries * _ID_LEN))()
+        sizes = (ctypes.c_uint64 * max_entries)()
+        lru = (ctypes.c_uint64 * max_entries)()
+        n = self._lib.shm_pool_scan(
+            self._h, ids, sizes, lru, max_entries
+        )
+        out = []
+        raw = bytes(ids)
+        for i in range(max(n, 0)):
+            out.append(
+                (raw[i * _ID_LEN : (i + 1) * _ID_LEN], sizes[i], lru[i])
+            )
+        return out
 
     def close(self) -> None:
         # Deliberately do NOT munmap: PoolViews hand out zero-copy

@@ -18,6 +18,7 @@ reference's ownership design (SURVEY.md section 5, failure detection row).
 from __future__ import annotations
 
 import asyncio
+import collections
 import functools
 import hashlib
 import time
@@ -31,6 +32,7 @@ from ray_tpu._private.serialization import Serialized, deserialize, serialize
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectLostError,
     RayTaskError,
     WorkerDiedError,
 )
@@ -126,6 +128,21 @@ class CoreWorker:
         # item) are rejected, so a retried stream can never deliver
         # duplicates.
         self._gen_attempt: dict[str, int] = {}
+
+        # Lineage: task_id → resubmit info for normal-task returns, so a
+        # lost store object can be reconstructed by re-executing its
+        # creating task (reference: ObjectRecoveryManager
+        # object_recovery_manager.h:41 + TaskManager lineage,
+        # task_manager.h:175). Bounded FIFO: oldest lineage is dropped
+        # first (its objects then fail as unreconstructable, like the
+        # reference under lineage eviction).
+        self._lineage: collections.OrderedDict[str, dict] = (
+            collections.OrderedDict()
+        )
+        self._lineage_cap = 16384
+        self._oid_to_task: dict[str, str] = {}
+        # task_id → in-flight reconstruction future (dedupe).
+        self._reconstructing: dict[str, asyncio.Future] = {}
 
         # Task-event buffer, flushed to the head periodically (reference:
         # worker-side TaskEventBuffer core_worker/task_event_buffer.h →
@@ -273,20 +290,57 @@ class CoreWorker:
             holder = rest[0] if rest else None
             if holder:
                 raise _NeedsPull(holder)
-            raise RayTaskError(f"object {oid_hex[:12]}… lost from store")
+            raise ObjectLostError(f"object {oid_hex[:12]}… lost from store")
         raise AssertionError(kind)
+
+    @staticmethod
+    def _deadline_of(timeout: float | None, what: str):
+        """One deadline for a whole multi-stage read: returns a
+        ``remaining()`` closure that yields the leftover budget and
+        raises GetTimeoutError once it is spent."""
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+
+        def remaining():
+            if deadline is None:
+                return None
+            left = deadline - loop.time()
+            if left <= 0:
+                raise GetTimeoutError(f"timed out on {what}")
+            return left
+
+        return remaining
 
     async def _maybe_pull_record(self, oid_hex: str, timeout=None):
         """_read_record + transparent chunked pull for remote-store
         records (reference: raylet PullManager drives chunked Push from
-        the holding node, pull_manager.h:50)."""
-        try:
-            return self._read_record(oid_hex)
-        except _NeedsPull as need:
-            conn = await self._connect(need.holder_addr)
-            return await self._pull_remote(
-                ObjectID.from_hex(oid_hex), conn, timeout
-            )
+        the holding node, pull_manager.h:50). A lost object (holder node
+        dead, store copy evicted) triggers lineage reconstruction: the
+        creating task is re-executed and the read retried (reference:
+        ObjectRecoveryManager object_recovery_manager.h:41). ``timeout``
+        bounds the WHOLE sequence (pulls + reconstructions)."""
+        remaining = self._deadline_of(timeout, f"object {oid_hex[:12]}…")
+        while True:
+            try:
+                return self._read_record(oid_hex)
+            except _NeedsPull as need:
+                try:
+                    conn = await self._connect(need.holder_addr)
+                    return await self._pull_remote(
+                        ObjectID.from_hex(oid_hex), conn, remaining()
+                    )
+                except GetTimeoutError:
+                    raise
+                except (rpc.ConnectionLost, rpc.RpcError, ObjectLostError) as e:
+                    if not await self._reconstruct(oid_hex, remaining()):
+                        raise ObjectLostError(
+                            f"object {oid_hex[:12]}… lost (holder "
+                            f"{need.holder_addr} unreachable) and not "
+                            f"reconstructable: {e}"
+                        ) from e
+            except ObjectLostError:
+                if not await self._reconstruct(oid_hex, remaining()):
+                    raise
 
     # -------------------------------------------------------------- put
     async def put(self, value: Any):
@@ -305,10 +359,17 @@ class CoreWorker:
 
     # -------------------------------------------------------------- get
     async def _get_one(
-        self, oid_hex: str, owner_addr: str, timeout: float | None
+        self,
+        oid_hex: str,
+        owner_addr: str,
+        timeout: float | None,
+        _recon: int = 2,
     ) -> Any:
+        """Resolve one ref. ``timeout`` is a SINGLE deadline across all
+        stages (owner lookup, chunked pull, reconstruction)."""
+        remaining = self._deadline_of(timeout, f"object {oid_hex[:12]}…")
         if oid_hex in self.memory:
-            return await self._maybe_pull_record(oid_hex, timeout)
+            return await self._maybe_pull_record(oid_hex, remaining())
         oid = ObjectID.from_hex(oid_hex)
         view = self.store.get(oid)
         if view is not None:
@@ -316,13 +377,18 @@ class CoreWorker:
         if owner_addr == self.addr or oid_hex in self._waiters or (
             owner_addr is None
         ):
-            await self._wait_local(oid_hex, timeout)
-            return await self._maybe_pull_record(oid_hex, timeout)
+            await self._wait_local(oid_hex, remaining())
+            return await self._maybe_pull_record(oid_hex, remaining())
         # Ask the owner (reference: OwnershipBasedObjectDirectory).
         conn = await self._connect(owner_addr)
-        reply = await asyncio.wait_for(
-            conn.call("get_object", oid_hex=oid_hex), timeout
-        )
+        try:
+            reply = await asyncio.wait_for(
+                conn.call("get_object", oid_hex=oid_hex), remaining()
+            )
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(
+                f"timed out asking the owner for {oid_hex[:12]}…"
+            )
         if reply["kind"] == "value":
             return deserialize(reply["inband"], reply["buffers"])
         if reply["kind"] == "in_store":
@@ -334,8 +400,34 @@ class CoreWorker:
             # ObjectManagerService.Push streams 5 MiB chunks,
             # object_manager.proto:60), then cache it locally.
             holder = reply.get("holder")
-            src = await self._connect(holder) if holder else conn
-            return await self._pull_remote(oid, src, timeout)
+            try:
+                src = await self._connect(holder) if holder else conn
+                return await self._pull_remote(oid, src, remaining())
+            except GetTimeoutError:
+                raise
+            except (rpc.ConnectionLost, rpc.RpcError, ObjectLostError) as e:
+                # Holder gone or copy evicted: ask the OWNER to
+                # reconstruct via lineage, then re-resolve.
+                if _recon > 0:
+                    try:
+                        fixed = await asyncio.wait_for(
+                            conn.call(
+                                "reconstruct_object", oid_hex=oid_hex
+                            ),
+                            remaining(),
+                        )
+                    except asyncio.TimeoutError:
+                        raise GetTimeoutError(
+                            f"timed out reconstructing {oid_hex[:12]}…"
+                        ) from e
+                    if fixed.get("ok"):
+                        return await self._get_one(
+                            oid_hex, owner_addr, remaining(), _recon - 1
+                        )
+                raise ObjectLostError(
+                    f"object {oid_hex[:12]}… lost and not "
+                    f"reconstructable by its owner: {e}"
+                ) from e
         if reply["kind"] == "error":
             raise deserialize(reply["inband"])
         raise AssertionError(reply["kind"])
@@ -361,28 +453,35 @@ class CoreWorker:
             return left
 
         oid_hex = oid.hex()
-        meta = await asyncio.wait_for(
-            owner_conn.call("get_object_meta", oid_hex=oid_hex), remaining()
-        )
+        try:
+            meta = await asyncio.wait_for(
+                owner_conn.call("get_object_meta", oid_hex=oid_hex),
+                remaining(),
+            )
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"timed out pulling {oid_hex[:12]}…")
         if not meta.get("ok"):
-            raise RayTaskError(
-                f"object {oid_hex[:12]}… vanished from the owner's store"
+            raise ObjectLostError(
+                f"object {oid_hex[:12]}… vanished from the holder's store"
             )
         total = meta["total"]
         parts = []
         offset = 0
         while offset < total:
-            chunk = await asyncio.wait_for(
-                owner_conn.call(
-                    "get_object_chunk",
-                    oid_hex=oid_hex,
-                    offset=offset,
-                    size=self.PULL_CHUNK_BYTES,
-                ),
-                remaining(),
-            )
+            try:
+                chunk = await asyncio.wait_for(
+                    owner_conn.call(
+                        "get_object_chunk",
+                        oid_hex=oid_hex,
+                        offset=offset,
+                        size=self.PULL_CHUNK_BYTES,
+                    ),
+                    remaining(),
+                )
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"timed out pulling {oid_hex[:12]}…")
             if not chunk.get("ok"):
-                raise RayTaskError(
+                raise ObjectLostError(
                     f"object {oid_hex[:12]}… pull failed mid-stream"
                 )
             parts.append(chunk["data"])
@@ -493,6 +592,25 @@ class CoreWorker:
         self.record_task_event(
             spec, "SUBMITTED", kind="actor_task" if actor else "task"
         )
+        if actor is None and not streaming and max_retries > 0:
+            # Lineage for reconstruction: enough to resubmit this task if
+            # a store-resident return is later lost (actor methods are
+            # not idempotent; streams replay only from the start — both
+            # excluded, matching this runtime's retry semantics).
+            self._lineage[task_id.hex()] = {
+                "spec": spec,
+                "oids": oids,
+                "resources": resources,
+                "placement": placement,
+                "runtime_env": runtime_env,
+                "attempts_left": max_retries,
+            }
+            for oid_hex in oids:
+                self._oid_to_task[oid_hex] = task_id.hex()
+            while len(self._lineage) > self._lineage_cap:
+                old_tid, old = self._lineage.popitem(last=False)
+                for oid_hex in old["oids"]:
+                    self._oid_to_task.pop(oid_hex, None)
         asyncio.ensure_future(
             self._drive_task(
                 spec, oids, resources, max_retries, actor, placement,
@@ -525,6 +643,82 @@ class CoreWorker:
                 q = self._generators.get(spec["task_id"])
                 if q is not None:
                     q.put_nowait(("error", e))
+
+    # ------------------------------------------------- lineage recovery
+    async def _reconstruct(
+        self, oid_hex: str, timeout: float | None = None
+    ) -> bool:
+        """Re-execute the task that created a lost object (reference:
+        lineage reconstruction, object_recovery_manager.h:41). Returns
+        True when a fresh result record is in place. Concurrent callers
+        for the same task share ONE resubmission, which runs as a
+        background task — a caller timing out (or being cancelled)
+        neither cancels the re-execution nor strands other waiters."""
+        task_id = self._oid_to_task.get(oid_hex)
+        entry = self._lineage.get(task_id) if task_id else None
+        if entry is None:
+            return False
+        inflight = self._reconstructing.get(task_id)
+        if inflight is None:
+            if entry["attempts_left"] <= 0:
+                return False
+            entry["attempts_left"] -= 1
+            inflight = asyncio.ensure_future(
+                self._do_reconstruct(task_id, entry)
+            )
+            self._reconstructing[task_id] = inflight
+            inflight.add_done_callback(
+                lambda _t: self._reconstructing.pop(task_id, None)
+            )
+        try:
+            return await asyncio.wait_for(asyncio.shield(inflight), timeout)
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(
+                f"timed out while reconstructing {oid_hex[:12]}…"
+            )
+
+    async def _do_reconstruct(self, task_id: str, entry: dict) -> bool:
+        self.record_task_event(entry["spec"], "RECONSTRUCTING")
+        # Drop stale store-location records so fresh results land and
+        # blocked readers wake on the new value. Inline ("value")
+        # records are still good — keep them.
+        for o in entry["oids"]:
+            rec = self.memory.get(o)
+            if rec is not None and rec[0] == "in_store":
+                self.memory.pop(o, None)
+                self.store.release(ObjectID.from_hex(o))
+        try:
+            errored = await self._drive_normal_task(
+                entry["spec"],
+                entry["oids"],
+                entry["resources"],
+                1,
+                entry["placement"],
+                entry["runtime_env"],
+            )
+        except Exception as e:  # noqa: BLE001 - loss stays loss
+            # Leave an error record so readers that blocked on the
+            # cleared oids fail with the cause instead of waiting
+            # forever.
+            for o in entry["oids"]:
+                if o not in self.memory:
+                    self._store_result(
+                        o,
+                        (
+                            "error",
+                            ObjectLostError(
+                                f"object {o[:12]}… reconstruction "
+                                f"failed: {e}"
+                            ),
+                        ),
+                    )
+            return False
+        return not errored
+
+    async def _on_reconstruct_object(self, conn, oid_hex: str):
+        """Borrower-requested reconstruction: a non-owner whose pull
+        failed asks the owner to re-execute the creating task."""
+        return {"ok": await self._reconstruct(oid_hex)}
 
     # -------------------------------------------------------- task events
     def record_task_event(self, spec: dict, state: str, **extra):

@@ -31,6 +31,18 @@ SPAWN_TIMEOUT_S = 30.0
 PENDING_SPILL_S = 2.0  # queued lease age before bouncing to spillback
 
 
+def _spill_watermarks() -> tuple[float, float]:
+    """Object-spilling watermarks (fractions of store capacity): above
+    HIGH the daemon moves cold objects to disk until usage drops below
+    LOW (reference: LocalObjectManager triggers spilling at
+    object_spilling_threshold, local_object_manager.h:44). Read per
+    tick so per-process env overrides apply."""
+    return (
+        float(os.environ.get("RAY_TPU_SPILL_HIGH", "0.8")),
+        float(os.environ.get("RAY_TPU_SPILL_LOW", "0.5")),
+    )
+
+
 def env_hash(runtime_env: dict | None) -> str:
     """Stable key for a runtime_env: workers are pooled per distinct env
     (reference: runtime_env workers are dedicated + cached by env hash,
@@ -128,6 +140,8 @@ class NodeManager:
         )
         self._next_lease = 0
         self._tasks: list[asyncio.Task] = []
+        self.spilled_bytes = 0
+        self.spilled_objects = 0
         # Read view of this node's object store: the node serves chunked
         # object pulls to other nodes (reference: the raylet's
         # ObjectManager serves Push/Pull, object_manager.h:128) — workers
@@ -147,6 +161,7 @@ class NodeManager:
         )
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         # Prestart workers up to the CPU count so the first task burst
         # doesn't pay Python-interpreter spawn latency per lease
         # (reference: WorkerPool prestarts workers, worker_pool.h:280).
@@ -482,6 +497,8 @@ class NodeManager:
             "available": self.available,
             "n_workers": len(self.workers),
             "store_dir": self.store_dir,
+            "spilled_bytes": self.spilled_bytes,
+            "spilled_objects": self.spilled_objects,
         }
 
     def _enforce_idle_cap(self):
@@ -551,6 +568,51 @@ class NodeManager:
                     pending=[dict(r) for r, *_rest in self._pending],
                 )
             except rpc.RpcError:
+                pass
+
+    async def _spill_loop(self):
+        """Watermark-driven object spilling: when the node's shm store
+        runs past SPILL_HIGH of capacity, move the coldest sealed
+        objects to disk until usage drops below SPILL_LOW. Spilled
+        objects are served transparently by ObjectStore.get (and the
+        pull protocol), so readers never notice."""
+        while True:
+            await asyncio.sleep(0.5)
+            try:
+                high, low = _spill_watermarks()
+                store = self._store()
+                cap = store.capacity_bytes
+                if not cap:
+                    continue
+
+                def spill_tick():
+                    # All filesystem scanning runs here, OFF the event
+                    # loop: the daemon also serves chunked object pulls
+                    # and must not stall on iterdir/stat storms.
+                    used = store.used_bytes()
+                    if used <= high * cap:
+                        return 0, 0
+                    target = low * cap
+                    freed_total = 0
+                    n = 0
+                    for oid, _size, _lru in store.spill_candidates():
+                        if used - freed_total <= target:
+                            break
+                        try:
+                            freed = store.spill_one(oid)
+                        except OSError:
+                            continue
+                        if freed:
+                            freed_total += freed
+                            n += 1
+                    return freed_total, n
+
+                freed, n = await asyncio.to_thread(spill_tick)
+                self.spilled_bytes += freed
+                self.spilled_objects += n
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - spilling is best-effort
                 pass
 
     async def _reap_loop(self):

@@ -74,9 +74,12 @@ class ObjectStore:
     def __init__(self, directory: str | Path, capacity_bytes: int | None = None):
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
-        self.capacity = capacity_bytes
-        # Views handed out by this process; held so mmaps stay valid.
-        self._views: dict[ObjectID, PlasmaView] = {}
+        # Views handed out by this process, held so the backing memory
+        # stays valid: file views pin their mmap; pool views pin the
+        # object's refcount so eviction/spilling cannot free a block
+        # that a zero-copy deserialized value still aliases (the pin
+        # drops on release()/delete(), or with the view's finalizer).
+        self._views: dict[ObjectID, object] = {}
         self.pool = None
         if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE") != "1":
             try:
@@ -95,6 +98,22 @@ class ObjectStore:
                     e,
                 )
                 self.pool = None
+        # Spill directory on DISK (shm is RAM): cold objects move here
+        # under memory pressure and are served back transparently
+        # (reference: LocalObjectManager spills to external storage via
+        # io workers, local_object_manager.h:44). Every process of the
+        # session derives the same path from the store dir name.
+        self.spill_dir = Path(
+            os.environ.get("RAY_TPU_SPILL_DIR")
+            or os.path.join(
+                tempfile.gettempdir(), f"{self.dir.name}-spill"
+            )
+        )
+        self.capacity_bytes = (
+            capacity_bytes
+            or (self.pool.capacity_bytes() if self.pool is not None else 0)
+            or _pool_capacity(self.dir)
+        )
 
     def _path(self, object_id: ObjectID) -> Path:
         return self.dir / object_id.hex()
@@ -111,40 +130,7 @@ class ObjectStore:
         path = self._path(object_id)
         if path.exists():
             return path.stat().st_size  # immutable: double-put is a no-op
-        header = _HEADER.pack(_MAGIC, len(data.inband), len(data.buffers))
-        lens = b"".join(_LEN.pack(len(b)) for b in data.buffers)
-        meta_len = len(header) + len(lens)
-
-        total = _aligned(meta_len + len(data.inband))
-        for b in data.buffers:
-            total = _aligned(total + len(b))
-        total = max(total, 1)
-
-        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".create-")
-        try:
-            os.ftruncate(fd, total)
-            with mmap.mmap(fd, total) as m:
-                m[: len(header)] = header
-                off = len(header)
-                m[off : off + len(lens)] = lens
-                off += len(lens)
-                m[off : off + len(data.inband)] = bytes(data.inband)
-                off = _aligned(off + len(data.inband))
-                for b in data.buffers:
-                    m[off : off + len(b)] = bytes(b) if not isinstance(
-                        b, (bytes, memoryview)
-                    ) else b
-                    off = _aligned(off + len(b))
-            os.close(fd)
-            os.rename(tmp, path)  # seal
-        except BaseException:
-            os.close(fd) if fd >= 0 else None
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
-        return total
+        return _write_object_file(path, data.inband, data.buffers)
 
     def get(self, object_id: ObjectID):
         view = self._views.get(object_id)
@@ -153,8 +139,21 @@ class ObjectStore:
         if self.pool is not None:
             pv = self.pool.get(object_id.binary())
             if pv is not None:
+                # Cache → the refcount pin outlives this call, keeping
+                # the block safe for zero-copy readers in this process.
+                self._views[object_id] = pv
                 return pv
-        path = self._path(object_id)
+        view = self._map_file(self._path(object_id))
+        if view is None:
+            # Spilled to disk: serve from the spill file (mmap'd; the
+            # page cache amortizes repeat reads). Reference restores to
+            # plasma via io workers, local_object_manager.h:44.
+            view = self._map_file(self._spill_path(object_id))
+        if view is not None:
+            self._views[object_id] = view
+        return view
+
+    def _map_file(self, path: Path):
         try:
             fd = os.open(path, os.O_RDONLY)
         except FileNotFoundError:
@@ -164,9 +163,7 @@ class ObjectStore:
             mapping = mmap.mmap(fd, size, prot=mmap.PROT_READ)
         finally:
             os.close(fd)
-        view = PlasmaView(mapping)
-        self._views[object_id] = view
-        return view
+        return PlasmaView(mapping)
 
     def release(self, object_id: ObjectID) -> None:
         """Drop this process's cached mmap view (serving paths that touch
@@ -176,18 +173,113 @@ class ObjectStore:
     def contains(self, object_id: ObjectID) -> bool:
         if object_id in self._views or self._path(object_id).exists():
             return True
-        return self.pool is not None and self.pool.contains(
-            object_id.binary()
-        )
+        if self.pool is not None and self.pool.contains(object_id.binary()):
+            return True
+        return self._spill_path(object_id).exists()
 
     def delete(self, object_id: ObjectID) -> None:
         self._views.pop(object_id, None)
         if self.pool is not None:
             self.pool.delete(object_id.binary())
+        for path in (self._path(object_id), self._spill_path(object_id)):
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+
+    # ---------------------------------------------------------- spilling
+    def _spill_path(self, object_id: ObjectID) -> Path:
+        return self.spill_dir / object_id.hex()
+
+    def spill_candidates(self) -> list[tuple[ObjectID, int, float]]:
+        """(object_id, size, lru_key) for spillable objects, coldest
+        first. Pool objects rank by the pool's LRU tick; file-backed
+        objects by mtime (both orderings are per-source; the merged list
+        interleaves them, which is fine for a watermark loop)."""
+        out = []
+        if self.pool is not None:
+            for id_bytes, size, lru in self.pool.scan():
+                try:
+                    out.append((ObjectID(id_bytes), size, float(lru)))
+                except ValueError:
+                    continue
+            out.sort(key=lambda t: t[2])
+        files = []
+        for name, size in self.list_objects():
+            try:
+                oid = ObjectID.from_hex(name)
+            except ValueError:
+                continue
+            try:
+                mtime = self._path(oid).stat().st_mtime
+            except OSError:
+                continue
+            files.append((oid, size, mtime))
+        files.sort(key=lambda t: t[2])
+        # Pool ticks and mtimes are different clocks: each group is
+        # coldest-first internally; pool entries go first (they are the
+        # allocator under pressure), file entries after.
+        return out + files
+
+    def spill_one(self, object_id: ObjectID) -> int:
+        """Move one sealed object to the disk spill dir. Returns shm
+        bytes freed (0 if the object was busy or already gone)."""
+        spill_path = self._spill_path(object_id)
+        if spill_path.exists():
+            freed = self._drop_shm_copy(object_id)
+            return freed
+        shm_path = self._path(object_id)
+        if shm_path.exists():
+            # File-backed: copy to a temp name, atomic-rename into the
+            # spill dir, then drop the shm copy. Readers racing this see
+            # either copy (both sealed + immutable).
+            self.spill_dir.mkdir(parents=True, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.spill_dir, prefix=".spill-")
+            try:
+                with os.fdopen(fd, "wb") as dst, open(shm_path, "rb") as src:
+                    import shutil
+
+                    shutil.copyfileobj(src, dst)
+                os.rename(tmp, spill_path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            return self._drop_shm_copy(object_id)
+        if self.pool is not None:
+            view = self.pool.get(object_id.binary())
+            if view is None:
+                return 0
+            self.spill_dir.mkdir(parents=True, exist_ok=True)
+            _write_object_file(spill_path, view.inband, view.buffers)
+            del view  # release the pool pin before deleting
+            # Report what was ACTUALLY freed: a reader pinning the
+            # object between scan and delete leaves the shm copy in
+            # place (the spill file is a harmless duplicate) — the next
+            # watermark tick retries.
+            return self._drop_shm_copy(object_id)
+        return 0
+
+    def _drop_shm_copy(self, object_id: ObjectID) -> int:
+        """Remove the shm copy of an object that has a spill file."""
+        freed = 0
+        if self.pool is not None and self.pool.contains(object_id.binary()):
+            before = self.pool.used_bytes()
+            self.pool.delete(object_id.binary())
+            freed = max(0, before - self.pool.used_bytes())
+        path = self._path(object_id)
         try:
-            os.unlink(self._path(object_id))
-        except FileNotFoundError:
+            size = path.stat().st_size
+            os.unlink(path)
+            freed += size
+        except OSError:
             pass
+        # A stale read-only view in THIS process keeps serving safely
+        # (unlinked files stay mapped), but drop it so memory frees.
+        self._views.pop(object_id, None)
+        return freed
 
     def list_objects(self) -> list[tuple[str, int]]:
         """(object_id hex, size) pairs. Best-effort: covers the
@@ -220,6 +312,46 @@ class ObjectStore:
         import shutil
 
         shutil.rmtree(self.dir, ignore_errors=True)
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
+
+
+def _write_object_file(path: Path, inband, buffers) -> int:
+    """Write the sealed-object file layout (header + inband + aligned
+    buffers) with create-then-atomic-rename sealing. Returns total bytes."""
+    header = _HEADER.pack(_MAGIC, len(inband), len(buffers))
+    lens = b"".join(_LEN.pack(len(b)) for b in buffers)
+    meta_len = len(header) + len(lens)
+
+    total = _aligned(meta_len + len(inband))
+    for b in buffers:
+        total = _aligned(total + len(b))
+    total = max(total, 1)
+
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=".create-")
+    try:
+        os.ftruncate(fd, total)
+        with mmap.mmap(fd, total) as m:
+            m[: len(header)] = header
+            off = len(header)
+            m[off : off + len(lens)] = lens
+            off += len(lens)
+            m[off : off + len(inband)] = bytes(inband)
+            off = _aligned(off + len(inband))
+            for b in buffers:
+                m[off : off + len(b)] = (
+                    b if isinstance(b, (bytes, memoryview)) else bytes(b)
+                )
+                off = _aligned(off + len(b))
+        os.close(fd)
+        os.rename(tmp, path)  # seal
+    except BaseException:
+        os.close(fd) if fd >= 0 else None
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return total
 
 
 def segment_meta(view) -> dict:

@@ -381,13 +381,27 @@ class RemoteFunction:
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", name: str, num_returns=1):
+    def __init__(
+        self,
+        handle: "ActorHandle",
+        name: str,
+        num_returns=1,
+        tensor_transport=None,
+    ):
         self._handle = handle
         self._name = name
         self._num_returns = num_returns
+        self._tensor_transport = tensor_transport
 
-    def options(self, *, num_returns=1):
-        return ActorMethod(self._handle, self._name, num_returns)
+    def options(self, *, num_returns=1, tensor_transport=None):
+        """``tensor_transport``: keep this method's return value in the
+        actor's device-tensor store and move it point-to-point to
+        consumers — True for direct rpc fetch, or a collective group
+        name to ride that group's send/recv data plane (reference:
+        tensor_transport on actor methods, gpu_object_manager/)."""
+        return ActorMethod(
+            self._handle, self._name, num_returns, tensor_transport
+        )
 
     def remote(self, *args, **kwargs):
         target = ActorSubmitTarget(self._handle._actor_id, self._handle._addr)
@@ -398,6 +412,7 @@ class ActorMethod:
                 kwargs,
                 num_returns=self._num_returns,
                 actor=target,
+                tensor_transport=self._tensor_transport,
             )
         )
         if self._num_returns == "streaming":
@@ -513,6 +528,20 @@ def remote(*args, **options):
     if args:
         raise TypeError("use @remote or @remote(**options)")
     return wrap
+
+
+def _submit_system_task(handle: "ActorHandle", fn, *args) -> ObjectRef:
+    """Run ``fn(instance, *args)`` as an actor task — the ``@sys:``
+    dispatch in core_worker._execute. Shared by compiled graphs and the
+    experimental collective API."""
+    fn_id = _runtime.run(_runtime.core.export_function(fn))
+    target = ActorSubmitTarget(handle._actor_id, handle._addr)
+    refs = _runtime.run(
+        _runtime.core.submit_task(
+            f"@sys:{fn_id}", args, {}, num_returns=1, actor=target
+        )
+    )
+    return refs[0]
 
 
 def get_actor(name: str) -> ActorHandle:

@@ -384,18 +384,9 @@ class CompiledDAG:
 def _submit_system_task(handle, fn, *args):
     """Run ``fn(instance, *args)`` as an actor task (the @sys: dispatch in
     core_worker._execute)."""
-    import ray_tpu.api as api
-    from ray_tpu.runtime.core_worker import ActorSubmitTarget
+    from ray_tpu.api import _submit_system_task as submit
 
-    rt = api._runtime
-    fn_id = rt.run(rt.core.export_function(fn))
-    target = ActorSubmitTarget(handle._actor_id, handle._addr)
-    refs = rt.run(
-        rt.core.submit_task(
-            f"@sys:{fn_id}", args, {}, num_returns=1, actor=target
-        )
-    )
-    return refs[0]
+    return submit(handle, fn, *args)
 
 
 def _dag_actor_loop(

@@ -51,6 +51,15 @@ class _NeedsPull(Exception):
         self.holder_addr = holder_addr
 
 
+class _NeedsTensor(Exception):
+    """Internal: the record's payload lives in a worker's device-tensor
+    store (tensor transport) — fetch it from the source actor."""
+
+    def __init__(self, meta: dict):
+        super().__init__(meta)
+        self.meta = meta
+
+
 class CoreWorker:
     def __init__(
         self,
@@ -128,6 +137,25 @@ class CoreWorker:
         # item) are rejected, so a retried stream can never deliver
         # duplicates.
         self._gen_attempt: dict[str, int] = {}
+
+        # Device-tensor store (reference: gpu_object_store.py in
+        # python/ray/experimental/gpu_object_manager/): values returned
+        # by tensor-transport actor methods stay HERE, in the producing
+        # worker, on device; only metadata travels through the normal
+        # result path. Other actors fetch the payload point-to-point
+        # (collective send/recv when a shared group exists, direct rpc
+        # otherwise) — never through the host object store.
+        self.tensor_store: dict[str, Any] = {}
+        # Received-tensor LRU (consumer side): repeat gets of the same
+        # tensor ref hit this instead of re-transferring the payload
+        # (reference: gpu_object_store caches received tensors).
+        self._tensor_cache: collections.OrderedDict[str, Any] = (
+            collections.OrderedDict()
+        )
+        self._tensor_cache_cap = 64
+        # Producer-side export buffers for chunked tensor fetches:
+        # token → (serialized blob segments, total, created_at).
+        self._tensor_exports: dict[str, tuple] = {}
 
         # Lineage: task_id → resubmit info for normal-task returns, so a
         # lost store object can be reconstructed by re-executing its
@@ -291,6 +319,13 @@ class CoreWorker:
             if holder:
                 raise _NeedsPull(holder)
             raise ObjectLostError(f"object {oid_hex[:12]}… lost from store")
+        if kind == "tensor":
+            if oid_hex in self.tensor_store:  # reading our own tensor
+                return self.tensor_store[oid_hex]
+            if oid_hex in self._tensor_cache:  # previously fetched
+                self._tensor_cache.move_to_end(oid_hex)
+                return self._tensor_cache[oid_hex]
+            raise _NeedsTensor(rest[0])
         raise AssertionError(kind)
 
     @staticmethod
@@ -323,6 +358,10 @@ class CoreWorker:
         while True:
             try:
                 return self._read_record(oid_hex)
+            except _NeedsTensor as need:
+                return await self._fetch_tensor(
+                    oid_hex, need.meta, remaining()
+                )
             except _NeedsPull as need:
                 try:
                     conn = await self._connect(need.holder_addr)
@@ -366,14 +405,18 @@ class CoreWorker:
         _recon: int = 2,
     ) -> Any:
         """Resolve one ref. ``timeout`` is a SINGLE deadline across all
-        stages (owner lookup, chunked pull, reconstruction)."""
-        remaining = self._deadline_of(timeout, f"object {oid_hex[:12]}…")
+        stages (owner lookup, chunked pull, reconstruction). Values that
+        are already local resolve even with timeout=0 (the deadline only
+        gates stages that must do remote work)."""
         if oid_hex in self.memory:
-            return await self._maybe_pull_record(oid_hex, remaining())
+            # _maybe_pull_record tries the synchronous read before its
+            # own deadline is ever consulted.
+            return await self._maybe_pull_record(oid_hex, timeout)
         oid = ObjectID.from_hex(oid_hex)
         view = self.store.get(oid)
         if view is not None:
             return deserialize(view.inband, view.buffers)
+        remaining = self._deadline_of(timeout, f"object {oid_hex[:12]}…")
         if owner_addr == self.addr or oid_hex in self._waiters or (
             owner_addr is None
         ):
@@ -391,6 +434,10 @@ class CoreWorker:
             )
         if reply["kind"] == "value":
             return deserialize(reply["inband"], reply["buffers"])
+        if reply["kind"] == "tensor":
+            return await self._fetch_tensor(
+                oid_hex, reply["meta"], remaining()
+            )
         if reply["kind"] == "in_store":
             view = self.store.get(oid)
             if view is not None:
@@ -557,6 +604,7 @@ class CoreWorker:
         actor: "ActorSubmitTarget | None" = None,
         placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
         runtime_env: dict | None = None,
+        tensor_transport: Any = None,
     ) -> list:
         """Submit; returns ObjectRefs immediately, result delivery is
         async (the reply fulfils the local futures)."""
@@ -589,6 +637,8 @@ class CoreWorker:
         if streaming:
             spec["streaming"] = True
             self._gen_attempt[task_id.hex()] = 0
+        if tensor_transport is not None:
+            spec["tensor_transport"] = tensor_transport
         self.record_task_event(
             spec, "SUBMITTED", kind="actor_task" if actor else "task"
         )
@@ -719,6 +769,219 @@ class CoreWorker:
         """Borrower-requested reconstruction: a non-owner whose pull
         failed asks the owner to re-execute the creating task."""
         return {"ok": await self._reconstruct(oid_hex)}
+
+    # ------------------------------------------------- tensor transport
+    async def _fetch_tensor(self, oid_hex: str, meta: dict, timeout=None):
+        """Resolve a tensor-transport ref: payload moves point-to-point
+        from the producing actor (reference: gpu_object_manager
+        transports — collective_tensor_transport.py / nixl). When this
+        process shares the producer's collective group, the transfer
+        rides the group's send/recv data plane; otherwise a chunked rpc
+        fetch from the producer (never via the owner or object store).
+        ``timeout`` is one deadline across every stage; fetched values
+        are cached so repeat gets do not re-transfer."""
+        if oid_hex in self.tensor_store:
+            return self.tensor_store[oid_hex]  # we are the producer
+        if oid_hex in self._tensor_cache:
+            self._tensor_cache.move_to_end(oid_hex)
+            return self._tensor_cache[oid_hex]
+        remaining = self._deadline_of(timeout, f"tensor {oid_hex[:12]}…")
+        value = await self._fetch_tensor_payload(oid_hex, meta, remaining)
+        self._tensor_cache[oid_hex] = value
+        while len(self._tensor_cache) > self._tensor_cache_cap:
+            self._tensor_cache.popitem(last=False)
+        return value
+
+    async def _fetch_tensor_payload(self, oid_hex, meta, remaining):
+        group_name = meta.get("group")
+        if group_name is not None and meta.get("src_rank") is not None:
+            from ray_tpu import collective as col
+
+            if col.is_group_initialized(group_name):
+                g = col.get_group(group_name)
+                if getattr(g, "rank", None) is not None and (
+                    g.rank != meta["src_rank"]
+                ):
+                    # Ask the producer to post a send tagged with this
+                    # ref; the payload lands in our group mailbox even
+                    # before recv is posted, so send-then-recv is safe.
+                    seq = int(oid_hex[:12], 16)
+                    try:
+                        conn = await self._connect(meta["src_addr"])
+                        ack = await asyncio.wait_for(
+                            conn.call(
+                                "tensor_send",
+                                oid_hex=oid_hex,
+                                dst_rank=g.rank,
+                                group_name=group_name,
+                                seq=seq,
+                            ),
+                            remaining(),
+                        )
+                        if ack.get("ok"):
+                            return await asyncio.wait_for(
+                                g.recv(meta["src_rank"], seq=seq),
+                                remaining(),
+                            )
+                    except asyncio.TimeoutError:
+                        raise GetTimeoutError(
+                            f"timed out fetching tensor {oid_hex[:12]}… "
+                            f"over group {group_name!r}"
+                        )
+                    except (rpc.ConnectionLost, rpc.RpcError):
+                        pass  # backend lacks send/recv etc. — rpc fetch
+        conn = await self._connect(meta["src_addr"])
+        try:
+            reply = await asyncio.wait_for(
+                conn.call("fetch_tensor", oid_hex=oid_hex), remaining()
+            )
+            if not reply.get("ok"):
+                raise ObjectLostError(
+                    f"tensor {oid_hex[:12]}… is gone from its producer "
+                    f"(actor died or tensor freed)"
+                )
+            if not reply.get("chunked"):
+                return deserialize(reply["inband"], reply["buffers"])
+            # Large tensor: pull the serialized stream in store-sized
+            # chunks (mirrors _pull_remote's 5 MiB protocol).
+            token, total = reply["token"], reply["total"]
+            seg_lens = reply["seg_lens"]
+            parts = []
+            offset = 0
+            while offset < total:
+                chunk = await asyncio.wait_for(
+                    conn.call(
+                        "fetch_tensor_chunk",
+                        token=token,
+                        offset=offset,
+                        size=self.PULL_CHUNK_BYTES,
+                    ),
+                    remaining(),
+                )
+                if not chunk.get("ok"):
+                    raise ObjectLostError(
+                        f"tensor {oid_hex[:12]}… fetch failed mid-stream"
+                    )
+                parts.append(chunk["data"])
+                offset += len(chunk["data"])
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(
+                f"timed out fetching tensor {oid_hex[:12]}…"
+            )
+        blob = b"".join(parts)
+        segs = []
+        pos = 0
+        for n in seg_lens:
+            segs.append(blob[pos : pos + n])
+            pos += n
+        return deserialize(segs[0], segs[1:])
+
+    _TENSOR_EXPORT_CAP = 8
+
+    async def _on_fetch_tensor(self, conn, oid_hex: str):
+        if oid_hex not in self.tensor_store:
+            return {"ok": False}
+        value = self.tensor_store[oid_hex]
+        data = serialize(value).materialize_buffers()
+        total = data.total_bytes()
+        if total <= self.PULL_CHUNK_BYTES:
+            return {
+                "ok": True,
+                "inband": data.inband,
+                "buffers": data.buffers,
+            }
+        # Oversized for one rpc frame: stash the serialized segments in
+        # an export buffer and let the consumer pull windows.
+        token = f"{oid_hex}:{id(data)}"
+        self._tensor_exports[token] = (
+            [data.inband, *data.buffers],
+            total,
+            time.time(),
+        )
+        while len(self._tensor_exports) > self._TENSOR_EXPORT_CAP:
+            oldest = min(
+                self._tensor_exports, key=lambda k: self._tensor_exports[k][2]
+            )
+            del self._tensor_exports[oldest]
+        return {
+            "ok": True,
+            "chunked": True,
+            "token": token,
+            "total": total,
+            "seg_lens": [len(data.inband)] + [len(b) for b in data.buffers],
+        }
+
+    async def _on_fetch_tensor_chunk(
+        self, conn, token: str, offset: int, size: int
+    ):
+        entry = self._tensor_exports.get(token)
+        if entry is None:
+            return {"ok": False}
+        segs, total, _ts = entry
+        out = bytearray()
+        pos = 0
+        for seg in segs:
+            seg_len = len(seg)
+            if offset < pos + seg_len and len(out) < size:
+                start = max(0, offset - pos)
+                take = min(seg_len - start, size - len(out))
+                out += memoryview(seg)[start : start + take]
+            pos += seg_len
+            if len(out) >= size:
+                break
+        if offset + len(out) >= total:  # stream complete: free buffer
+            self._tensor_exports.pop(token, None)
+        return {"ok": True, "data": bytes(out)}
+
+    async def _on_tensor_send(
+        self, conn, oid_hex: str, dst_rank: int, group_name: str, seq: int
+    ):
+        """Producer side of a collective-path transfer: post a send of
+        the stored tensor toward the requesting rank."""
+        if oid_hex not in self.tensor_store:
+            return {"ok": False}
+        value = self.tensor_store[oid_hex]
+        if not (hasattr(value, "shape") and hasattr(value, "dtype")):
+            # Group send carries single arrays; pytrees take the rpc
+            # fetch path instead.
+            return {"ok": False, "error": "value is not a single array"}
+        from ray_tpu import collective as col
+
+        if not col.is_group_initialized(group_name):
+            return {"ok": False, "error": f"no group {group_name!r} here"}
+        group = col.get_group(group_name)
+        send = getattr(group, "send", None)
+        if send is None:
+            return {"ok": False, "error": "group backend has no send"}
+        await send(value, dst_rank, seq=seq)
+        return {"ok": True}
+
+    async def _on_drop_tensor(self, conn, oid_hex: str):
+        self.tensor_store.pop(oid_hex, None)
+        return {"ok": True}
+
+    async def free_tensor(self, oid_hex: str) -> bool:
+        """Owner-side tensor freeing (reference: GPU objects are freed
+        eagerly once out of scope; here freeing is explicit via
+        ray_tpu.experimental.free_tensors): drop the producer's pinned
+        payload and poison the record."""
+        rec = self.memory.get(oid_hex)
+        if rec is None or rec[0] != "tensor":
+            return False
+        meta = rec[1]
+        try:
+            src = await self._connect(meta["src_addr"])
+            await src.call("drop_tensor", oid_hex=oid_hex)
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+        self._store_result(
+            oid_hex,
+            ("error", ObjectLostError(f"tensor {oid_hex[:12]}… was freed")),
+        )
+        return True
+
+    async def _on_free_tensor(self, conn, oid_hex: str):
+        return {"ok": await self.free_tensor(oid_hex)}
 
     # -------------------------------------------------------- task events
     def record_task_event(self, spec: dict, state: str, **extra):
@@ -867,6 +1130,8 @@ class CoreWorker:
         for oid_hex, kind, *rest in reply["results"]:
             if kind == "inline":
                 self._store_result(oid_hex, ("value", rest[0], rest[1]))
+            elif kind == "tensor":  # payload stays in the producer
+                self._store_result(oid_hex, ("tensor", rest[0]))
             else:  # in a node's shared store (rest = [holder_node_addr])
                 self._store_result(
                     oid_hex, ("in_store", rest[0] if rest else None)
@@ -1213,6 +1478,8 @@ class CoreWorker:
             return {"kind": "error", "inband": _dumps_small(rest[0])}
         if kind == "value":
             return {"kind": "value", "inband": rest[0], "buffers": rest[1]}
+        if kind == "tensor":
+            return {"kind": "tensor", "meta": rest[0]}
         return {"kind": "in_store", "holder": rest[0] if rest else None}
 
     async def _on_get_object_meta(self, conn, oid_hex: str):
@@ -1448,6 +1715,36 @@ class CoreWorker:
                 )
             results = []
             task_id = TaskID.from_hex(spec["task_id"])
+            transport = spec.get("tensor_transport")
+            if transport and actor_id is not None:
+                # Tensor transport: values stay in THIS actor's device
+                # store; only location metadata enters the result path
+                # (reference: gpu_object_manager — tensor_transport
+                # threaded through submission, TensorTransportGetter
+                # normal_task_submitter.h:101).
+                for i, value in enumerate(values):
+                    oid_hex = ObjectID.for_return(task_id, i).hex()
+                    self.tensor_store[oid_hex] = value
+                    meta = {"src_addr": self.addr, "transport": transport}
+                    if isinstance(transport, str):
+                        from ray_tpu import collective as col
+
+                        if col.is_group_initialized(transport):
+                            # Single-controller backends (xla_mesh) have
+                            # no per-process rank: consumers then use
+                            # the rpc fetch path.
+                            rank = getattr(
+                                col.get_group(transport), "rank", None
+                            )
+                            if rank is not None:
+                                meta["group"] = transport
+                                meta["src_rank"] = rank
+                    results.append((oid_hex, "tensor", meta))
+                self.record_task_event(
+                    spec, "RUNNING", ts=exec_start,
+                    dur=time.time() - exec_start,
+                )
+                return {"status": "ok", "results": results}
             for i, value in enumerate(values):
                 oid = ObjectID.for_return(task_id, i)
                 data = serialize(value)

@@ -20,6 +20,7 @@ def __getattr__(name):
         "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
         "kill", "cancel", "get_actor", "method", "ObjectRef",
         "ObjectRefGenerator", "available_resources", "cluster_resources",
+        "nodes",
     }
     if name in _core_api:
         try:

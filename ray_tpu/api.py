@@ -60,6 +60,7 @@ def init(
     resources: dict | None = None,
     object_store_dir: str | None = None,
     observer: bool = False,
+    labels: dict | None = None,
 ) -> dict:
     """Start (or connect to) a cluster and attach this process as driver.
 
@@ -112,7 +113,9 @@ def init(
             if num_cpus is not None:
                 total["CPU"] = float(num_cpus)
             total.update(resources or {})
-            node = NodeManager(head_addr, store_dir, resources=total)
+            node = NodeManager(
+                head_addr, store_dir, resources=total, labels=labels
+            )
             await node.start()
 
         core = CoreWorker(
@@ -247,11 +250,49 @@ def cluster_resources() -> dict:
     return out
 
 
+def nodes() -> list[dict]:
+    """Cluster node table: id, address, resources, labels (reference:
+    ray.nodes())."""
+    table = _runtime.run(_runtime.core.head.call("node_table"))
+    return [
+        {
+            "node_id": nid,
+            "addr": n["addr"],
+            "resources": n["resources"],
+            "available": n["available"],
+            "labels": n.get("labels", {}),
+            "alive": True,
+        }
+        for nid, n in table.items()
+    ]
+
+
 # ------------------------------------------------------------- @remote
 def _placement_tuple(pg, bundle_index: int):
     if pg is None:
         return None
     return (pg.bundle_node_addr(bundle_index), pg.id, bundle_index)
+
+
+def _resolve_strategy(strategy, pg, pg_bundle):
+    """scheduling_strategy option → (placement_group, bundle, wire spec).
+    PlacementGroupSchedulingStrategy folds into the existing placement
+    path; affinity/label strategies become a lease-time spec (reference:
+    python/ray/util/scheduling_strategies.py)."""
+    if strategy is None or strategy == "DEFAULT":
+        return pg, pg_bundle, None
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+        to_scheduling_spec,
+    )
+
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return (
+            strategy.placement_group,
+            strategy.placement_group_bundle_index,
+            None,
+        )
+    return pg, pg_bundle, to_scheduling_spec(strategy)
 
 
 class ObjectRefGenerator:
@@ -333,6 +374,7 @@ class RemoteFunction:
         placement_group=None,
         placement_group_bundle_index=0,
         runtime_env=None,
+        scheduling_strategy=None,
     ):
         self._fn = fn
         self._num_returns = num_returns
@@ -341,6 +383,7 @@ class RemoteFunction:
         self._pg = placement_group
         self._pg_bundle = placement_group_bundle_index
         self._runtime_env = runtime_env
+        self._strategy = scheduling_strategy
         functools.update_wrapper(self, fn)
 
     def options(self, **opts):
@@ -352,11 +395,15 @@ class RemoteFunction:
             "placement_group": self._pg,
             "placement_group_bundle_index": self._pg_bundle,
             "runtime_env": self._runtime_env,
+            "scheduling_strategy": self._strategy,
         }
         merged.update(opts)
         return RemoteFunction(self._fn, **merged)
 
     def remote(self, *args, **kwargs):
+        pg, pg_bundle, scheduling = _resolve_strategy(
+            self._strategy, self._pg, self._pg_bundle
+        )
         out = _runtime.run(
             _runtime.core.submit_task(
                 self._fn,
@@ -365,8 +412,9 @@ class RemoteFunction:
                 num_returns=self._num_returns,
                 resources=self._resources,
                 max_retries=self._max_retries,
-                placement=_placement_tuple(self._pg, self._pg_bundle),
+                placement=_placement_tuple(pg, pg_bundle),
                 runtime_env=self._runtime_env,
+                scheduling=scheduling,
             )
         )
         if self._num_returns == "streaming":
@@ -393,12 +441,29 @@ class ActorMethod:
         self._num_returns = num_returns
         self._tensor_transport = tensor_transport
 
-    def options(self, *, num_returns=1, tensor_transport=None):
+    _UNSET = object()
+
+    def options(self, *, num_returns=_UNSET, tensor_transport=_UNSET):
         """``tensor_transport``: keep this method's return value in the
         actor's device-tensor store and move it point-to-point to
         consumers — True for direct rpc fetch, or a collective group
         name to ride that group's send/recv data plane (reference:
-        tensor_transport on actor methods, gpu_object_manager/)."""
+        tensor_transport on actor methods, gpu_object_manager/).
+        Unspecified options keep their current values (chainable)."""
+        num_returns = (
+            self._num_returns if num_returns is self._UNSET else num_returns
+        )
+        tensor_transport = (
+            self._tensor_transport
+            if tensor_transport is self._UNSET
+            else tensor_transport
+        )
+        if num_returns == "streaming" and tensor_transport is not None:
+            raise ValueError(
+                "tensor_transport does not compose with streaming "
+                "generators: yielded items go through the normal "
+                "result path"
+            )
         return ActorMethod(
             self._handle, self._name, num_returns, tensor_transport
         )
@@ -458,6 +523,7 @@ class ActorClass:
         max_concurrency=None,
         max_restarts=0,
         runtime_env=None,
+        scheduling_strategy=None,
     ):
         self._cls = cls
         self._resources = resources
@@ -468,6 +534,7 @@ class ActorClass:
         self._max_concurrency = max_concurrency
         self._max_restarts = max_restarts
         self._runtime_env = runtime_env
+        self._strategy = scheduling_strategy
 
     def options(self, *, lifetime=None, **opts):
         opts = _normalize_options(opts)
@@ -480,11 +547,15 @@ class ActorClass:
             "max_concurrency": self._max_concurrency,
             "max_restarts": self._max_restarts,
             "runtime_env": self._runtime_env,
+            "scheduling_strategy": self._strategy,
         }
         merged.update(opts)
         return ActorClass(self._cls, **merged)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        pg, pg_bundle, scheduling = _resolve_strategy(
+            self._strategy, self._pg, self._pg_bundle
+        )
         actor_id, addr = _runtime.run(
             _runtime.core.create_actor(
                 self._cls,
@@ -493,10 +564,11 @@ class ActorClass:
                 name=self._name,
                 resources=self._resources,
                 detached=self._detached,
-                placement=_placement_tuple(self._pg, self._pg_bundle),
+                placement=_placement_tuple(pg, pg_bundle),
                 max_concurrency=self._max_concurrency,
                 max_restarts=self._max_restarts,
                 runtime_env=self._runtime_env,
+                scheduling=scheduling,
             )
         )
         return ActorHandle(actor_id, addr, self._cls.__name__)

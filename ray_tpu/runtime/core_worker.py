@@ -605,6 +605,7 @@ class CoreWorker:
         placement: tuple | None = None,  # (node_addr, pg_id, bundle_index)
         runtime_env: dict | None = None,
         tensor_transport: Any = None,
+        scheduling: dict | None = None,
     ) -> list:
         """Submit; returns ObjectRefs immediately, result delivery is
         async (the reply fulfils the local futures)."""
@@ -653,6 +654,7 @@ class CoreWorker:
                 "resources": resources,
                 "placement": placement,
                 "runtime_env": runtime_env,
+                "scheduling": scheduling,
                 "attempts_left": max_retries,
             }
             for oid_hex in oids:
@@ -664,7 +666,7 @@ class CoreWorker:
         asyncio.ensure_future(
             self._drive_task(
                 spec, oids, resources, max_retries, actor, placement,
-                runtime_env,
+                runtime_env, scheduling,
             )
         )
         if streaming:
@@ -673,14 +675,15 @@ class CoreWorker:
 
     async def _drive_task(
         self, spec, oids, resources, retries, actor, placement,
-        runtime_env=None,
+        runtime_env=None, scheduling=None,
     ):
         try:
             if actor is not None:
                 errored = await self._drive_actor_task(spec, oids, actor)
             else:
                 errored = await self._drive_normal_task(
-                    spec, oids, resources, retries, placement, runtime_env
+                    spec, oids, resources, retries, placement, runtime_env,
+                    scheduling,
                 )
             self.record_task_event(
                 spec, "FAILED" if errored else "FINISHED"
@@ -745,6 +748,7 @@ class CoreWorker:
                 1,
                 entry["placement"],
                 entry["runtime_env"],
+                entry.get("scheduling"),
             )
         except Exception as e:  # noqa: BLE001 - loss stays loss
             # Leave an error record so readers that blocked on the
@@ -898,11 +902,14 @@ class CoreWorker:
             total,
             time.time(),
         )
-        while len(self._tensor_exports) > self._TENSOR_EXPORT_CAP:
-            oldest = min(
-                self._tensor_exports, key=lambda k: self._tensor_exports[k][2]
-            )
-            del self._tensor_exports[oldest]
+        # Evict only STALE exports (no chunk pulled for 60s): an active
+        # stream must never lose its buffer mid-pull, so the cap is a
+        # soft target under concurrent fetch bursts.
+        if len(self._tensor_exports) > self._TENSOR_EXPORT_CAP:
+            now = time.time()
+            for key in list(self._tensor_exports):
+                if key != token and now - self._tensor_exports[key][2] > 60:
+                    del self._tensor_exports[key]
         return {
             "ok": True,
             "chunked": True,
@@ -918,6 +925,8 @@ class CoreWorker:
         if entry is None:
             return {"ok": False}
         segs, total, _ts = entry
+        # Refresh the staleness clock: an active stream is never evicted.
+        self._tensor_exports[token] = (segs, total, time.time())
         out = bytearray()
         pos = 0
         for seg in segs:
@@ -973,7 +982,10 @@ class CoreWorker:
             src = await self._connect(meta["src_addr"])
             await src.call("drop_tensor", oid_hex=oid_hex)
         except (rpc.ConnectionLost, rpc.RpcError):
-            pass
+            # Producer unreachable: leave the record intact so the
+            # caller can retry (poisoning now would leak the pinned
+            # payload forever if the producer is only briefly away).
+            return False
         self._store_result(
             oid_hex,
             ("error", ObjectLostError(f"tensor {oid_hex[:12]}… was freed")),
@@ -1022,7 +1034,8 @@ class CoreWorker:
                     pass
 
     async def _drive_normal_task(
-        self, spec, oids, resources, retries, placement=None, runtime_env=None
+        self, spec, oids, resources, retries, placement=None,
+        runtime_env=None, scheduling=None,
     ):
         last_err: Exception | None = None
         for attempt in range(retries + 1):
@@ -1033,7 +1046,9 @@ class CoreWorker:
                     # earlier attempt can't interleave with this one.
                     spec = {**spec, "attempt": attempt}
                     self._gen_attempt[spec["task_id"]] = attempt
-                lease = await self._lease(resources, placement, runtime_env)
+                lease = await self._lease(
+                    resources, placement, runtime_env, scheduling
+                )
                 conn = await self._connect(lease["addr"])
                 reply = await conn.call("push_task", spec=spec)
                 return self._apply_reply(reply, oids, spec["task_id"])
@@ -1140,13 +1155,28 @@ class CoreWorker:
 
     # ------------------------------------------------------------ leases
     def _sched_key(
-        self, resources: dict | None, runtime_env: dict | None = None
+        self,
+        resources: dict | None,
+        runtime_env: dict | None = None,
+        scheduling: dict | None = None,
     ) -> tuple:
         from ray_tpu.runtime.node import env_hash
+
+        def freeze(value):
+            # Canonical recursive form: logically equal strategies with
+            # different dict insertion order share one lease pool.
+            if isinstance(value, dict):
+                return tuple(
+                    sorted((k, freeze(v)) for k, v in value.items())
+                )
+            if isinstance(value, (list, tuple, set)):
+                return tuple(sorted(repr(freeze(v)) for v in value))
+            return value
 
         return (
             tuple(sorted((resources or {"CPU": 1.0}).items())),
             env_hash(runtime_env),
+            None if scheduling is None else freeze(scheduling),
         )
 
     async def _lease(
@@ -1154,6 +1184,7 @@ class CoreWorker:
         resources: dict | None,
         placement: tuple | None = None,
         runtime_env: dict | None = None,
+        scheduling: dict | None = None,
     ) -> dict:
         if placement is not None:
             # Bundle-backed lease on the bundle's node; never cached.
@@ -1174,7 +1205,7 @@ class CoreWorker:
             reply["sched_key"] = None
             reply["node_conn"] = node_conn
             return reply
-        key = self._sched_key(resources, runtime_env)
+        key = self._sched_key(resources, runtime_env, scheduling)
         pool = self._pool(key)
         while pool["free"]:
             lease, _ = pool["free"].pop()
@@ -1184,7 +1215,7 @@ class CoreWorker:
         fut = asyncio.get_running_loop().create_future()
         pool["waiters"].append(fut)
         self._maybe_request_lease(
-            key, dict(resources or {"CPU": 1.0}), runtime_env
+            key, dict(resources or {"CPU": 1.0}), runtime_env, scheduling
         )
         return await fut
 
@@ -1196,7 +1227,11 @@ class CoreWorker:
         )
 
     def _maybe_request_lease(
-        self, key: tuple, resources: dict, runtime_env: dict | None = None
+        self,
+        key: tuple,
+        resources: dict,
+        runtime_env: dict | None = None,
+        scheduling: dict | None = None,
     ):
         """Pipeline lease requests: keep at most min(#waiters, cap)
         requests in flight per scheduling class."""
@@ -1209,21 +1244,29 @@ class CoreWorker:
 
         async def request():
             try:
-                reply = await self.node.call(
-                    "lease_worker", resources=resources, runtime_env=runtime_env
-                )
-                if not reply.get("ok") and (
-                    reply.get("infeasible") or reply.get("retry_spill")
-                ):
-                    # Local node can never satisfy this (infeasible) or
-                    # kept us queued past its age limit (retry_spill):
-                    # spill via the head (reference: lease spillback,
-                    # retry_at_raylet_address node_manager.proto:78). If
-                    # the whole cluster is infeasible, poll — the
-                    # autoscaler may add a node.
-                    reply = await self._spill_lease(
-                        resources, runtime_env=runtime_env
+                if scheduling is not None:
+                    reply = await self._lease_with_strategy(
+                        resources, runtime_env, scheduling
                     )
+                else:
+                    reply = await self.node.call(
+                        "lease_worker",
+                        resources=resources,
+                        runtime_env=runtime_env,
+                    )
+                    if not reply.get("ok") and (
+                        reply.get("infeasible") or reply.get("retry_spill")
+                    ):
+                        # Local node can never satisfy this (infeasible)
+                        # or kept us queued past its age limit
+                        # (retry_spill): spill via the head (reference:
+                        # lease spillback, retry_at_raylet_address
+                        # node_manager.proto:78). If the whole cluster is
+                        # infeasible, poll — the autoscaler may add a
+                        # node.
+                        reply = await self._spill_lease(
+                            resources, runtime_env=runtime_env
+                        )
                 if not reply.get("ok"):
                     raise rpc.RpcError(reply.get("error", "lease failed"))
                 reply["sched_key"] = key
@@ -1238,15 +1281,78 @@ class CoreWorker:
                         break
             # Top up if demand still outstrips supply.
             if pool["waiters"]:
-                self._maybe_request_lease(key, resources, runtime_env)
+                self._maybe_request_lease(key, resources, runtime_env, scheduling)
 
         asyncio.ensure_future(request())
+
+    async def _lease_with_strategy(
+        self,
+        resources: dict,
+        runtime_env: dict | None,
+        scheduling: dict,
+        actor: bool = False,
+    ) -> dict:
+        """Lease honoring a scheduling strategy (reference:
+        python/ray/util/scheduling_strategies.py — NodeAffinity :43,
+        NodeLabel :164; the raylet-side policies
+        scheduling/policy/node_affinity_scheduling_policy and
+        node_label_scheduling_policy)."""
+        node_id = scheduling.get("node_id")
+        if node_id is not None:
+            info = await self.head.call("get_node", node_id=node_id)
+            if not info.get("ok"):
+                if scheduling.get("soft"):
+                    return await self._spill_lease(
+                        resources, actor=actor, runtime_env=runtime_env
+                    )
+                return {
+                    "ok": False,
+                    "error": f"node affinity (hard): {info.get('error')}",
+                }
+            conn = await self._connect(info["addr"])
+            while True:
+                granted = await conn.call(
+                    "lease_worker",
+                    resources=resources,
+                    actor=actor,
+                    runtime_env=runtime_env,
+                )
+                if granted.get("ok"):
+                    granted["node_conn"] = conn
+                    return granted
+                if granted.get("retry_spill") and not scheduling.get("soft"):
+                    # Hard affinity: the node is just busy — keep
+                    # queueing on IT rather than spilling elsewhere.
+                    await asyncio.sleep(0.2)
+                    continue
+                if scheduling.get("soft"):
+                    return await self._spill_lease(
+                        resources, actor=actor, runtime_env=runtime_env
+                    )
+                return {
+                    "ok": False,
+                    "error": granted.get(
+                        "error", "node affinity lease failed"
+                    ),
+                }
+        # Label strategy: the head filters by hard labels and prefers
+        # soft matches.
+        return await self._spill_lease(
+            resources,
+            actor=actor,
+            runtime_env=runtime_env,
+            pick_kwargs={
+                "labels_hard": scheduling.get("labels_hard") or None,
+                "labels_soft": scheduling.get("labels_soft") or None,
+            },
+        )
 
     async def _spill_lease(
         self,
         resources: dict,
         actor: bool = False,
         runtime_env: dict | None = None,
+        pick_kwargs: dict | None = None,
     ) -> dict:
         """Find a feasible node through the head and lease there.
 
@@ -1264,7 +1370,10 @@ class CoreWorker:
         requester = uuid.uuid4().hex  # dedups this wait's demand at the head
         while True:
             reply = await self.head.call(
-                "pick_node", resources=resources, requester=requester
+                "pick_node",
+                resources=resources,
+                requester=requester,
+                **{k: v for k, v in (pick_kwargs or {}).items() if v},
             )
             if reply.get("ok"):
                 deadline = loop.time() + timeout_s  # feasible: clock resets
@@ -1356,9 +1465,22 @@ class CoreWorker:
         max_concurrency: int | None = None,
         max_restarts: int = 0,
         runtime_env: dict | None = None,
+        scheduling: dict | None = None,
     ):
         actor_id = ActorID.random().hex()
-        if placement is not None:
+        if placement is None and scheduling is not None:
+            reply = await self._lease_with_strategy(
+                dict(resources or {"CPU": 1.0}),
+                runtime_env,
+                scheduling,
+                actor=True,
+            )
+            if not reply.get("ok"):
+                raise rpc.RpcError(
+                    reply.get("error", "strategy actor lease failed")
+                )
+            node_conn = reply.get("node_conn") or self.node
+        elif placement is not None:
             node_addr, pg_id, index = placement
             node_conn = (
                 self.node
@@ -1424,6 +1546,7 @@ class CoreWorker:
                 # PG-placed actors must restart on their reserved bundle.
                 "placement": placement,
                 "runtime_env": runtime_env,
+                "scheduling": scheduling,
             },
         )
         return actor_id, reply["addr"]

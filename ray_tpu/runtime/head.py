@@ -129,16 +129,35 @@ class HeadService:
             for nid, n in self.nodes.items()
         }
 
+    async def _on_get_node(self, conn, node_id: str):
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False, "error": f"no node {node_id[:12]}…"}
+        return {
+            "ok": True,
+            "node_id": node_id,
+            "addr": node["addr"],
+            "labels": node.get("labels", {}),
+        }
+
     async def _on_pick_node(
-        self, conn, resources: dict | None = None, requester: str | None = None
+        self,
+        conn,
+        resources: dict | None = None,
+        requester: str | None = None,
+        labels_hard: dict | None = None,
+        labels_soft: dict | None = None,
     ):
         """Cluster-level placement: pick a feasible node for a lease.
 
         Reference analogue: the hybrid scheduling policy's feasibility +
         availability scoring (reference:
-        src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:25);
+        src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h:25)
+        plus the node-label policy (node_label_scheduling_policy);
         centralized here (GCS-style) rather than spilled raylet-to-raylet.
         """
+        from ray_tpu.util.scheduling_strategies import labels_match
+
         resources = resources or {}
         best, best_score = None, None
         for nid, node in self.nodes.items():
@@ -146,9 +165,23 @@ class HeadService:
             total = node["resources"]
             if any(total.get(k, 0) < v for k, v in resources.items()):
                 continue  # infeasible
+            if labels_hard and not labels_match(
+                node.get("labels", {}), labels_hard
+            ):
+                continue
+            soft_hits = (
+                sum(
+                    1
+                    for k, want in (labels_soft or {}).items()
+                    if labels_match(node.get("labels", {}), {k: want})
+                )
+                if labels_soft
+                else 0
+            )
             free = sum(avail.get(k, 0) for k in resources) if resources else 1
             score = (
                 all(avail.get(k, 0) >= v for k, v in resources.items()),
+                soft_hits,
                 free,
             )
             if best_score is None or score > best_score:
@@ -308,10 +341,27 @@ class HeadService:
                 runtime_env=spec.get("runtime_env"),
             )
         else:
-            pick = await self._on_pick_node(None, resources=spec["resources"])
-            if not pick.get("ok"):
-                raise rpc.RpcError(pick.get("error", "no feasible node"))
-            node_id = pick["node_id"]
+            sched = spec.get("scheduling") or {}
+            affinity = sched.get("node_id")
+            if affinity is not None and affinity in self.nodes:
+                node_id = affinity
+            elif affinity is not None and not sched.get("soft"):
+                # Hard affinity to a node that no longer exists: the
+                # actor must not silently move (core_worker would have
+                # refused the first placement the same way).
+                raise rpc.RpcError(
+                    f"hard node affinity: node {affinity[:12]}… is gone"
+                )
+            else:
+                pick = await self._on_pick_node(
+                    None,
+                    resources=spec["resources"],
+                    labels_hard=sched.get("labels_hard"),
+                    labels_soft=sched.get("labels_soft"),
+                )
+                if not pick.get("ok"):
+                    raise rpc.RpcError(pick.get("error", "no feasible node"))
+                node_id = pick["node_id"]
             node_conn = self._node_conns[node_id]
             lease = await node_conn.call(
                 "lease_worker",

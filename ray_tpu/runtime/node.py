@@ -31,6 +31,50 @@ SPAWN_TIMEOUT_S = 30.0
 PENDING_SPILL_S = 2.0  # queued lease age before bouncing to spillback
 
 
+def system_memory_fraction() -> float:
+    """Fraction of system memory in use, cgroup-aware like the
+    reference's MemoryMonitor (reference: memory_monitor.h:52 reads
+    cgroup limits before /proc/meminfo). Test override:
+    RAY_TPU_FAKE_MEMORY_FRAC_FILE names a file holding a float."""
+    fake = os.environ.get("RAY_TPU_FAKE_MEMORY_FRAC_FILE")
+    if fake:
+        try:
+            with open(fake) as f:
+                return float(f.read().strip())
+        except (OSError, ValueError):
+            return 0.0
+    # cgroup v2 (container limits beat host totals)
+    try:
+        with open("/sys/fs/cgroup/memory.max") as f:
+            limit = f.read().strip()
+        if limit != "max":
+            with open("/sys/fs/cgroup/memory.current") as f:
+                current = float(f.read().strip())
+            return current / float(limit)
+    except (OSError, ValueError):
+        pass
+    try:
+        info = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                info[parts[0].rstrip(":")] = float(parts[1])
+        total = info["MemTotal"]
+        avail = info.get("MemAvailable", info.get("MemFree", total))
+        return 1.0 - avail / total
+    except (OSError, KeyError, ValueError):
+        return 0.0
+
+
+def worker_rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
 def _spill_watermarks() -> tuple[float, float]:
     """Object-spilling watermarks (fractions of store capacity): above
     HIGH the daemon moves cold objects to disk until usage drops below
@@ -93,7 +137,10 @@ def detect_resources() -> dict[str, float]:
 
 
 class Lease:
-    __slots__ = ("lease_id", "worker", "resources", "actor", "bundle", "bundle_resources")
+    __slots__ = (
+        "lease_id", "worker", "resources", "actor", "bundle",
+        "bundle_resources", "granted_at",
+    )
 
     def __init__(self, lease_id: str, worker: dict, resources: dict, actor: bool):
         self.lease_id = lease_id
@@ -102,6 +149,7 @@ class Lease:
         self.actor = actor
         self.bundle: tuple | None = None  # (pg_id, index) if bundle-backed
         self.bundle_resources: dict | None = None
+        self.granted_at = time.monotonic()
 
 
 class NodeManager:
@@ -111,12 +159,14 @@ class NodeManager:
         store_dir: str,
         resources: dict[str, float] | None = None,
         worker_env: dict[str, str] | None = None,
+        labels: dict[str, str] | None = None,
     ):
         self.node_id = NodeID.random().hex()
         self.head_addr = head_addr
         self.store_dir = store_dir
         self.total = resources or detect_resources()
         self.available = dict(self.total)
+        self.labels = detect_labels() if labels is None else dict(labels)
         self.worker_env = worker_env or {}
         self.server = rpc.Server(self._handle)
         self.addr: str | None = None
@@ -142,6 +192,7 @@ class NodeManager:
         self._tasks: list[asyncio.Task] = []
         self.spilled_bytes = 0
         self.spilled_objects = 0
+        self.oom_kills = 0
         # Read view of this node's object store: the node serves chunked
         # object pulls to other nodes (reference: the raylet's
         # ObjectManager serves Push/Pull, object_manager.h:128) — workers
@@ -158,10 +209,12 @@ class NodeManager:
             node_id=self.node_id,
             addr=self.addr,
             resources=self.total,
+            labels=self.labels,
         )
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         self._tasks.append(asyncio.ensure_future(self._spill_loop()))
+        self._tasks.append(asyncio.ensure_future(self._memory_loop()))
         # Prestart workers up to the CPU count so the first task burst
         # doesn't pay Python-interpreter spawn latency per lease
         # (reference: WorkerPool prestarts workers, worker_pool.h:280).
@@ -499,6 +552,7 @@ class NodeManager:
             "store_dir": self.store_dir,
             "spilled_bytes": self.spilled_bytes,
             "spilled_objects": self.spilled_objects,
+            "oom_kills": self.oom_kills,
         }
 
     def _enforce_idle_cap(self):
@@ -615,6 +669,71 @@ class NodeManager:
             except Exception:  # noqa: BLE001 - spilling is best-effort
                 pass
 
+    async def _memory_loop(self):
+        """Kill a worker when the host runs out of memory (reference:
+        MemoryMonitor memory_monitor.h:52 + WorkerKillingPolicy
+        worker_killing_policy.h:33). Policy: newest NON-ACTOR lease
+        first — its task is retriable and has lost the least work;
+        actors are last resorts (their state dies with them)."""
+        threshold = float(os.environ.get("RAY_TPU_MEMORY_THRESHOLD", "0.95"))
+        while True:
+            await asyncio.sleep(1.0)
+            try:
+                if system_memory_fraction() < threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                lease, wid = victim
+                self.oom_kills += 1
+                rss = worker_rss_bytes(lease.worker.get("pid") or 0)
+                self._kill_worker(wid)
+                # _kill_worker removes the worker from the table, so the
+                # reap loop will not see this death — release its leases
+                # here.
+                for lease_id, l in list(self.leases.items()):
+                    if l.worker["worker_id"] == wid:
+                        self.leases.pop(lease_id)
+                        self._release(l.resources)
+                        self._credit_bundle(l)
+                self._drain_pending()
+                if self.head:
+                    try:
+                        await self.head.call(
+                            "publish",
+                            channel="worker",
+                            msg={
+                                "event": "oom_killed",
+                                "worker_id": wid,
+                                "node_id": self.node_id,
+                                "rss": rss,
+                            },
+                        )
+                    except rpc.RpcError:
+                        pass
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - monitoring is best-effort
+                pass
+
+    def _pick_oom_victim(self):
+        """(lease, worker_id) to kill, or None. Newest task lease first,
+        then newest actor lease (reference: the retriable-first ordering
+        of worker_killing_policy_group_by_owner.h:87)."""
+        candidates = sorted(
+            (
+                (not lease.actor, lease.granted_at, lease, lease.worker["worker_id"])
+                for lease in self.leases.values()
+                if lease.worker.get("worker_id") in self.workers
+            ),
+            key=lambda t: (t[0], t[1]),
+            reverse=True,
+        )
+        if not candidates:
+            return None
+        _, _, lease, wid = candidates[0]
+        return lease, wid
+
     async def _reap_loop(self):
         """Detect worker process death and fail affected leases
         (reference: raylet detects worker death via process wait + IPC
@@ -659,6 +778,27 @@ class NodeManager:
                         pass
             if dead:
                 self._drain_pending()
+
+
+def detect_labels() -> dict[str, str]:
+    """Node labels from the environment (reference: TPU topology env vars
+    become labels, accelerators/tpu.py:18–66 + util/tpu.py slice labels;
+    RAY_TPU_NODE_LABELS carries user labels as k=v,k=v)."""
+    labels: dict[str, str] = {}
+    env = os.environ.get("RAY_TPU_NODE_LABELS", "")
+    for pair in env.split(","):
+        if "=" in pair:
+            k, v = pair.split("=", 1)
+            labels[k.strip()] = v.strip()
+    for var, label in (
+        ("TPU_ACCELERATOR_TYPE", "ray_tpu.io/accelerator-type"),
+        ("TPU_WORKER_ID", "ray_tpu.io/tpu-worker-id"),
+        ("TPU_NAME", "ray_tpu.io/tpu-slice-name"),
+    ):
+        val = os.environ.get(var)
+        if val:
+            labels[label] = val
+    return labels
 
 
 def env_jax_platform() -> str:

@@ -1,0 +1,150 @@
+"""Scheduling strategies: node affinity (hard/soft), node labels, and
+placement-group strategy objects (reference:
+python/ray/util/scheduling_strategies.py — NodeAffinitySchedulingStrategy
+:43, NodeLabelSchedulingStrategy :164, PlacementGroupSchedulingStrategy
+:17; raylet policies scheduling/policy/).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import api as core_api
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=2, labels={"zone": "a", "kind": "head"})
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def zone_b_node(cluster, tmp_path_factory):
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+    store_dir = str(tmp_path_factory.mktemp("zoneb_store"))
+
+    async def launch():
+        node = NodeManager(
+            rt.core.head_addr,
+            store_dir,
+            resources={"CPU": 2},
+            labels={"zone": "b", "kind": "worker"},
+        )
+        await node.start()
+        return node
+
+    node = rt.run(launch())
+    yield node
+    rt.run(node.stop())
+
+
+@ray_tpu.remote
+def where():
+    return os.environ["RAY_TPU_NODE_ADDR"]
+
+
+def test_nodes_lists_labels(cluster, zone_b_node):
+    table = ray_tpu.nodes()
+    assert len(table) == 2
+    zones = {n["labels"].get("zone") for n in table}
+    assert zones == {"a", "b"}
+
+
+def test_node_label_hard_constraint(cluster, zone_b_node):
+    addr = ray_tpu.get(
+        where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"zone": "b"}
+            )
+        ).remote(),
+        timeout=60,
+    )
+    assert addr == zone_b_node.addr
+
+
+def test_node_label_value_list(cluster, zone_b_node):
+    addr = ray_tpu.get(
+        where.options(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"kind": ["worker"]}
+            )
+        ).remote(),
+        timeout=60,
+    )
+    assert addr == zone_b_node.addr
+
+
+def test_node_affinity_hard(cluster, zone_b_node):
+    addr = ray_tpu.get(
+        where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=zone_b_node.node_id, soft=False
+            )
+        ).remote(),
+        timeout=60,
+    )
+    assert addr == zone_b_node.addr
+
+
+def test_node_affinity_soft_falls_back(cluster, zone_b_node):
+    """Soft affinity to a nonexistent node still runs (elsewhere)."""
+    addr = ray_tpu.get(
+        where.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id="deadbeef" * 4, soft=True
+            )
+        ).remote(),
+        timeout=60,
+    )
+    assert addr  # ran somewhere
+
+    with pytest.raises(Exception):
+        ray_tpu.get(
+            where.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id="deadbeef" * 4, soft=False
+                ),
+                max_retries=0,
+            ).remote(),
+            timeout=30,
+        )
+
+
+def test_actor_label_scheduling(cluster, zone_b_node):
+    @ray_tpu.remote
+    class Where:
+        def addr(self):
+            return os.environ["RAY_TPU_NODE_ADDR"]
+
+    a = Where.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(hard={"zone": "b"})
+    ).remote()
+    assert ray_tpu.get(a.addr.remote(), timeout=60) == zone_b_node.addr
+    ray_tpu.kill(a)
+
+
+def test_placement_group_strategy_object(cluster, zone_b_node):
+    from ray_tpu.placement import placement_group, remove_placement_group
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    try:
+        addr = ray_tpu.get(
+            where.options(
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=0
+                )
+            ).remote(),
+            timeout=60,
+        )
+        assert addr
+    finally:
+        remove_placement_group(pg)

@@ -66,7 +66,10 @@ def init(
 
     With no ``address``, starts an in-process head service plus a node
     manager for this host (reference: ray.init head path, worker.py:1412 →
-    node.py start_head_processes :1316).
+    node.py start_head_processes :1316). ``address="ray://host:port"``
+    attaches as a REMOTE CLIENT driver (reference: Ray Client,
+    python/ray/util/client/): no local node joins the cluster — leases go
+    through the head and large puts upload to a cluster node.
     """
     if _runtime.ready:
         raise RayTpuError("ray_tpu is already initialized")
@@ -75,10 +78,16 @@ def init(
         # address (reference: RAY_ADDRESS env for `ray job submit`
         # entrypoints).
         address = os.environ.get("RAY_TPU_ADDRESS") or None
+    client = False
+    if address is not None and address.startswith("ray://"):
+        client = True
+        address = address[len("ray://"):]
     if observer and address is None:
         # Validate before the loop thread / head service start so a bad
         # call leaks nothing.
         raise RayTpuError("observer=True requires address=")
+    if client and not address:
+        raise RayTpuError("client mode requires ray://host:port")
 
     loop = asyncio.new_event_loop()
     thread = threading.Thread(
@@ -101,12 +110,21 @@ def init(
             head = None
             head_addr = address
 
-        store_dir = object_store_dir or default_store_dir(session)
-        if observer:
-            # Read-only connection (CLI/dashboard): no schedulable node,
-            # no worker pool — the cluster must not see this process as
-            # capacity (reference: `ray status` attaches without adding
-            # a raylet).
+        if client:
+            # Client drivers keep a PRIVATE store dir (pull cache): the
+            # cluster's stores live on its nodes.
+            import tempfile
+
+            store_dir = object_store_dir or os.path.join(
+                tempfile.gettempdir(), f"ray_tpu-client-{session}"
+            )
+        else:
+            store_dir = object_store_dir or default_store_dir(session)
+        if observer or client:
+            # Read-only connection (CLI/dashboard) or remote client: no
+            # schedulable node, no worker pool — the cluster must not
+            # see this process as capacity (reference: `ray status`
+            # attaches without adding a raylet; Ray Client drivers).
             node = None
         else:
             total = detect_resources()
@@ -119,7 +137,7 @@ def init(
             await node.start()
 
         core = CoreWorker(
-            mode="driver",
+            mode="client" if client else "driver",
             head_addr=head_addr,
             node_addr=node.addr if node else "",
             store_dir=store_dir,
@@ -131,7 +149,7 @@ def init(
     _runtime.head = head
     _runtime.node = node
     _runtime.core = core
-    _runtime.mode = "driver"
+    _runtime.mode = "client" if client else "driver"
     _runtime.session = session
     atexit.register(shutdown)
     return {
@@ -156,8 +174,8 @@ def shutdown() -> None:
         _runtime.run(_teardown(), timeout=10)
     except Exception:
         pass
-    if _runtime.mode == "driver":
-        # Driver (and observer) sessions own their store dir; worker
+    if _runtime.mode in ("driver", "client"):
+        # Driver (observer, client) sessions own their store dir; worker
         # processes share their node's and must not delete it.
         _runtime.core.store.destroy()
     _runtime.loop.call_soon_threadsafe(_runtime.loop.stop)
@@ -268,6 +286,15 @@ def nodes() -> list[dict]:
 
 
 # ------------------------------------------------------------- @remote
+def _caller_trace_ctx(name: str):
+    """Capture the trace context on the CALLER's thread (a driver-side
+    tracing.span scope lives in a thread-local that the runtime loop
+    cannot see)."""
+    from ray_tpu.util import tracing
+
+    return tracing.make_trace_ctx(name)
+
+
 def _placement_tuple(pg, bundle_index: int):
     if pg is None:
         return None
@@ -415,6 +442,7 @@ class RemoteFunction:
                 placement=_placement_tuple(pg, pg_bundle),
                 runtime_env=self._runtime_env,
                 scheduling=scheduling,
+                trace_ctx=_caller_trace_ctx(self.__name__),
             )
         )
         if self._num_returns == "streaming":
@@ -478,6 +506,7 @@ class ActorMethod:
                 num_returns=self._num_returns,
                 actor=target,
                 tensor_transport=self._tensor_transport,
+                trace_ctx=_caller_trace_ctx(self._name),
             )
         )
         if self._num_returns == "streaming":

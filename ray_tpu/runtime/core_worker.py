@@ -63,12 +63,16 @@ class _NeedsTensor(Exception):
 class CoreWorker:
     def __init__(
         self,
-        mode: str,  # "driver" | "worker"
+        mode: str,  # "driver" | "worker" | "client"
         head_addr: str,
         node_addr: str,
         store_dir: str,
         worker_id: str | None = None,
     ):
+        # "client": a remote driver outside the cluster (reference: Ray
+        # Client, python/ray/util/client/) — no local node daemon, so
+        # leases always go through the head and large puts upload to an
+        # anchor node whose store serves the cluster.
         self.mode = mode
         self.head_addr = head_addr
         self.node_addr = node_addr
@@ -121,6 +125,8 @@ class CoreWorker:
 
         self._put_index = 0
         self._root_task = TaskID.random()
+        self._anchor: tuple[str, rpc.Connection] | None = None  # client mode
+        self._active_trace: tuple[str, str] | None = None  # tracing
 
         # actor_id → freshest known address (updated on head-driven
         # restarts; handles carry the birth address only).
@@ -391,10 +397,75 @@ class CoreWorker:
         if data.total_bytes() <= INLINE_MAX_BYTES:
             m = data.materialize_buffers()
             self._store_result(oid.hex(), ("value", m.inband, m.buffers))
+        elif self.mode == "client":
+            # Remote driver: our private store is unreachable from the
+            # cluster — upload the bytes to an anchor node whose store
+            # serves every worker's pull (reference: Ray Client
+            # server-side put). The ANCHOR becomes the ref's owner
+            # address so workers resolve it against the cluster node,
+            # never dialing back into the client.
+            anchor_addr, anchor = await self._anchor_node()
+            m = data.materialize_buffers()
+            if data.total_bytes() <= self.PULL_CHUNK_BYTES:
+                await anchor.call(
+                    "put_object",
+                    oid_hex=oid.hex(),
+                    inband=m.inband,
+                    buffers=m.buffers,
+                )
+            else:
+                await self._upload_chunked(anchor, oid.hex(), m)
+            self._store_result(oid.hex(), ("in_store", anchor_addr))
+            return ObjectRef(oid.hex(), anchor_addr)
         else:
             self.store.put(oid, data)
             self._store_result(oid.hex(), ("in_store",))
         return ObjectRef(oid.hex(), self.addr)
+
+    async def _upload_chunked(self, anchor, oid_hex: str, m):
+        """Stream a large client put to the anchor node in 5 MiB windows
+        (mirrors the pull protocol's chunking; one oversized frame would
+        hit the rpc frame cap)."""
+        segs = [m.inband, *m.buffers]
+        reply = await anchor.call(
+            "put_object_begin",
+            oid_hex=oid_hex,
+            seg_lens=[len(s) for s in segs],
+        )
+        if not reply.get("ok"):
+            raise rpc.RpcError(reply.get("error", "put_object_begin failed"))
+        token = reply["token"]
+        from ray_tpu.runtime.object_store import segment_window
+
+        class _Segs:  # duck-typed view for segment_window
+            inband = segs[0]
+            buffers = segs[1:]
+
+        total = sum(len(s) for s in segs)
+        offset = 0
+        while offset < total:
+            chunk = segment_window(_Segs, offset, self.PULL_CHUNK_BYTES)
+            ack = await anchor.call(
+                "put_object_chunk", token=token, offset=offset, data=chunk
+            )
+            if not ack.get("ok"):
+                raise rpc.RpcError("put_object_chunk failed")
+            offset += len(chunk)
+        done = await anchor.call("put_object_commit", token=token)
+        if not done.get("ok"):
+            raise rpc.RpcError("put_object_commit failed")
+
+    async def _anchor_node(self) -> tuple[str, rpc.Connection]:
+        if self._anchor is not None:
+            addr, conn = self._anchor
+            if not conn._closed:
+                return self._anchor
+        pick = await self.head.call("pick_node", resources={})
+        if not pick.get("ok"):
+            raise rpc.RpcError("client mode: no cluster node to anchor on")
+        conn = await self._connect(pick["addr"])
+        self._anchor = (pick["addr"], conn)
+        return self._anchor
 
     # -------------------------------------------------------------- get
     async def _get_one(
@@ -606,6 +677,7 @@ class CoreWorker:
         runtime_env: dict | None = None,
         tensor_transport: Any = None,
         scheduling: dict | None = None,
+        trace_ctx: dict | None = None,
     ) -> list:
         """Submit; returns ObjectRefs immediately, result delivery is
         async (the reply fulfils the local futures)."""
@@ -640,6 +712,12 @@ class CoreWorker:
             self._gen_attempt[task_id.hex()] = 0
         if tensor_transport is not None:
             spec["tensor_transport"] = tensor_transport
+        if trace_ctx is None:
+            from ray_tpu.util import tracing
+
+            trace_ctx = tracing.make_trace_ctx(spec["name"] or spec["fn_id"])
+        if trace_ctx is not None:
+            spec["trace"] = trace_ctx
         self.record_task_event(
             spec, "SUBMITTED", kind="actor_task" if actor else "task"
         )
@@ -1248,6 +1326,12 @@ class CoreWorker:
                     reply = await self._lease_with_strategy(
                         resources, runtime_env, scheduling
                     )
+                elif self.node is None:
+                    # Client mode: no local node — every lease goes
+                    # through the head's placement.
+                    reply = await self._spill_lease(
+                        resources, runtime_env=runtime_env
+                    )
                 else:
                     reply = await self.node.call(
                         "lease_worker",
@@ -1494,6 +1578,12 @@ class CoreWorker:
                 bundle=(pg_id, index),
                 runtime_env=runtime_env,
             )
+        elif self.node is None:  # client mode: lease via the head
+            req = dict(resources or {"CPU": 1.0})
+            reply = await self._spill_lease(
+                req, actor=True, runtime_env=runtime_env
+            )
+            node_conn = reply.get("node_conn") if reply.get("ok") else None
         else:
             node_conn = self.node
             req = dict(resources or {"CPU": 1.0})
@@ -1787,6 +1877,23 @@ class CoreWorker:
         return {"status": "ok", "results": []}
 
     async def _execute(self, spec: dict, actor_id: str | None) -> dict:
+        from ray_tpu.util import tracing
+
+        trace_ctx = spec.get("trace")
+        with tracing.activate(trace_ctx) as span_id:
+            prev = self._active_trace
+            if span_id is not None:
+                # Visible to nested .remote() calls from the executor
+                # thread (contextvars do not cross run_in_executor).
+                # Save/restore so an untraced concurrent task finishing
+                # never erases a traced task's context.
+                self._active_trace = (trace_ctx["trace_id"], span_id)
+            try:
+                return await self._execute_inner(spec, actor_id)
+            finally:
+                self._active_trace = prev
+
+    async def _execute_inner(self, spec: dict, actor_id: str | None) -> dict:
         loop = asyncio.get_running_loop()
         exec_start = time.time()
         try:

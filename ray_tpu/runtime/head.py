@@ -586,6 +586,9 @@ class HeadService:
         for ev in events:
             self.task_events.append(ev)
             tid = ev.get("task_id")
+            if ev.get("state") == "SPAN":
+                continue  # spans live in the raw stream only, not the
+                # merged task table (they would evict real task states)
             if tid:
                 prev = self.task_latest.pop(tid, None)
                 merged = dict(prev or {})

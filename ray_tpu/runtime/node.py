@@ -379,6 +379,88 @@ class NodeManager:
             self._store_reader = ObjectStore(self.store_dir)
         return self._store_reader
 
+    async def _on_put_object(
+        self, conn, oid_hex: str, inband, buffers: list
+    ):
+        """Store an object pushed by a remote client driver (reference:
+        Ray Client server-side put, python/ray/util/client/server/).
+        The node's store then serves it to any worker via the normal
+        pull protocol."""
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.serialization import Serialized
+
+        store = self._store()
+        store.put(ObjectID.from_hex(oid_hex), Serialized(inband, list(buffers)))
+        return {"ok": True, "holder": self.addr}
+
+    async def _on_put_object_begin(
+        self, conn, oid_hex: str, seg_lens: list
+    ):
+        """Chunked client upload, begin: allocate an assembly buffer."""
+        import uuid
+
+        token = uuid.uuid4().hex[:16]
+        self._uploads = getattr(self, "_uploads", {})
+        self._uploads[token] = {
+            "oid_hex": oid_hex,
+            "seg_lens": list(seg_lens),
+            "buf": bytearray(sum(seg_lens)),
+            "ts": time.monotonic(),
+        }
+        # Abandoned uploads (client died mid-stream) age out.
+        for key in list(self._uploads):
+            if time.monotonic() - self._uploads[key]["ts"] > 300:
+                del self._uploads[key]
+        return {"ok": True, "token": token}
+
+    async def _on_put_object_chunk(
+        self, conn, token: str, offset: int, data: bytes
+    ):
+        up = getattr(self, "_uploads", {}).get(token)
+        if up is None:
+            return {"ok": False, "error": "unknown upload token"}
+        up["buf"][offset : offset + len(data)] = data
+        up["ts"] = time.monotonic()
+        return {"ok": True}
+
+    async def _on_put_object_commit(self, conn, token: str):
+        up = getattr(self, "_uploads", {}).pop(token, None)
+        if up is None:
+            return {"ok": False, "error": "unknown upload token"}
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.serialization import Serialized
+
+        mv = memoryview(bytes(up["buf"]))
+        segs = []
+        pos = 0
+        for n in up["seg_lens"]:
+            segs.append(mv[pos : pos + n])
+            pos += n
+        self._store().put(
+            ObjectID.from_hex(up["oid_hex"]),
+            Serialized(bytes(segs[0]), [bytes(s) for s in segs[1:]]),
+        )
+        return {"ok": True, "holder": self.addr}
+
+    async def _on_get_object(self, conn, oid_hex: str):
+        """Owner-style lookup served by the node for store-resident
+        objects (lets node addresses act as object holders for client
+        drivers)."""
+        from ray_tpu._private.ids import ObjectID
+
+        if self._store().contains(ObjectID.from_hex(oid_hex)):
+            return {"kind": "in_store", "holder": self.addr}
+        import cloudpickle
+
+        from ray_tpu.exceptions import ObjectLostError
+
+        return {
+            "kind": "error",
+            "inband": cloudpickle.dumps(
+                ObjectLostError(f"object {oid_hex[:12]}… not on this node")
+            ),
+        }
+
     async def _on_get_object_meta(self, conn, oid_hex: str):
         from ray_tpu._private.ids import ObjectID
         from ray_tpu.runtime.object_store import segment_meta

@@ -1,0 +1,179 @@
+"""Distributed tracing (reference:
+python/ray/util/tracing/tracing_helper.py — the global switch
+`_global_is_tracing_enabled` :88, remote-call wrapping + context
+injection into task metadata `_start_span` :411). TPU twist: spans ride
+the existing task-event pipeline to the head (no OpenTelemetry daemon),
+and `jax_profile` hooks the XLA/jax profiler for on-device traces
+(xprof), the TPU analogue of the reference's NVTX/torch-profiler hooks
+(compiled_dag_node.py:207ff).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import threading
+import time
+import uuid
+
+_enabled = False
+# (trace_id, span_id) of the span this code runs under.
+_current: contextvars.ContextVar[tuple[str, str] | None] = (
+    contextvars.ContextVar("ray_tpu_trace", default=None)
+)
+# Driver-thread spans: .remote() captures context on the CALLER's thread
+# before hopping to the runtime loop, so span() records here too
+# (contextvars do not cross run_coroutine_threadsafe).
+_tl = threading.local()
+
+
+def enable_tracing() -> None:
+    """Turn on span collection for this process's submits (workers
+    inherit per-task context through the task spec)."""
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_tracing_enabled() -> bool:
+    return _enabled or os.environ.get("RAY_TPU_TRACE") == "1"
+
+
+def current_context() -> tuple[str, str] | None:
+    return _current.get()
+
+
+def _active() -> tuple[str, str] | None:
+    """Current span: the contextvar when set, else this thread's span()
+    scope, else the worker's active execution span (sync task code runs
+    on an executor thread where the loop-side contextvar is invisible;
+    execution is serialized, so the per-process fallback is unambiguous
+    for sync tasks)."""
+    cur = _current.get()
+    if cur is not None:
+        return cur
+    cur = getattr(_tl, "cur", None)
+    if cur is not None:
+        return cur
+    try:
+        import ray_tpu.api as api
+
+        core = api._runtime.core
+        return getattr(core, "_active_trace", None) if core else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def make_trace_ctx(name: str) -> dict | None:
+    """Context dict injected into an outgoing task spec (None when
+    tracing is off). An inherited active span counts as enabled, so
+    workers propagate traces without flipping their own switch."""
+    cur = _active()
+    if not is_tracing_enabled() and cur is None:
+        return None
+    trace_id = cur[0] if cur else uuid.uuid4().hex[:16]
+    return {
+        "trace_id": trace_id,
+        "parent_id": cur[1] if cur else "",
+        "name": name,
+    }
+
+
+@contextlib.contextmanager
+def activate(trace_ctx: dict | None):
+    """Worker side: run the task under its inherited trace context and
+    record the execution span. Yields the span_id (or None)."""
+    if not trace_ctx:
+        yield None
+        return
+    span_id = uuid.uuid4().hex[:16]
+    token = _current.set((trace_ctx["trace_id"], span_id))
+    start = time.time()
+    try:
+        yield span_id
+    finally:
+        _current.reset(token)
+        record_span(
+            trace_ctx["trace_id"],
+            span_id,
+            trace_ctx.get("parent_id", ""),
+            trace_ctx.get("name", ""),
+            start,
+            time.time() - start,
+        )
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """User-level span (works in drivers and inside tasks)."""
+    if not is_tracing_enabled():
+        yield
+        return
+    cur = _active()
+    trace_id = cur[0] if cur else uuid.uuid4().hex[:16]
+    span_id = uuid.uuid4().hex[:16]
+    token = _current.set((trace_id, span_id))
+    prev_tl = getattr(_tl, "cur", None)
+    _tl.cur = (trace_id, span_id)
+    start = time.time()
+    try:
+        yield
+    finally:
+        _current.reset(token)
+        _tl.cur = prev_tl
+        record_span(
+            trace_id, span_id, cur[1] if cur else "", name, start,
+            time.time() - start,
+        )
+
+
+def record_span(trace_id, span_id, parent_id, name, start, dur):
+    """Spans ride the task-event buffer (flushed to the head like any
+    task state transition, core_worker._flush_events_loop)."""
+    try:
+        import ray_tpu.api as api
+
+        core = api._runtime.core
+    except Exception:  # noqa: BLE001 - no runtime, drop the span
+        return
+    if core is None:
+        return
+    core.record_task_event(
+        {"task_id": f"span:{span_id}", "name": name},
+        "SPAN",
+        trace_id=trace_id,
+        span_id=span_id,
+        parent_id=parent_id,
+        ts=start,
+        dur=dur,
+    )
+
+
+def get_trace_events(limit: int = 2000) -> list[dict]:
+    """All spans the head has collected (driver-side query)."""
+    import ray_tpu.api as api
+
+    rt = api._runtime
+    reply = rt.run(
+        rt.core.head.call("list_task_events", limit=limit, raw=True)
+    )
+    return [e for e in reply["events"] if e.get("state") == "SPAN"]
+
+
+@contextlib.contextmanager
+def jax_profile(log_dir: str):
+    """On-device profiling via the jax/XLA profiler (xprof): wraps
+    jax.profiler.start_trace/stop_trace. View with tensorboard or
+    xprof. The TPU-native replacement for the reference's NVTX ranges."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

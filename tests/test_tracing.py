@@ -1,0 +1,106 @@
+"""Tracing: spans wrap remote calls with context propagated through task
+specs into workers and nested submits (reference:
+python/ray/util/tracing/tracing_helper.py — global switch :88, span
+injection :411); on-device profiling via the jax profiler (the NVTX
+analogue, compiled_dag_node.py:207ff).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=2)
+    tracing.enable_tracing()
+    yield info
+    tracing.disable_tracing()
+    ray_tpu.shutdown()
+
+
+def _spans(pred, timeout=20):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        spans = tracing.get_trace_events()
+        hits = [s for s in spans if pred(s)]
+        if hits:
+            return spans, hits
+        time.sleep(0.3)
+    return tracing.get_trace_events(), []
+
+
+def test_task_execution_creates_span(cluster):
+    @ray_tpu.remote
+    def traced_leaf():
+        return 1
+
+    assert ray_tpu.get(traced_leaf.remote(), timeout=60) == 1
+    _, hits = _spans(lambda s: s.get("name") == "traced_leaf")
+    assert hits, "no span recorded for the task"
+    assert hits[0]["trace_id"] and hits[0]["span_id"]
+
+
+def test_nested_task_links_parent(cluster):
+    @ray_tpu.remote
+    def traced_child():
+        return 2
+
+    @ray_tpu.remote
+    def traced_parent():
+        return ray_tpu.get(traced_child.remote(), timeout=60)
+
+    assert ray_tpu.get(traced_parent.remote(), timeout=60) == 2
+    spans, child_hits = _spans(
+        lambda s: s.get("name") == "traced_child" and s.get("parent_id")
+    )
+    assert child_hits, f"child span missing parent link: {spans}"
+    child = child_hits[0]
+    parents = [s for s in spans if s.get("span_id") == child["parent_id"]]
+    assert parents and parents[0]["name"] == "traced_parent"
+    assert parents[0]["trace_id"] == child["trace_id"]
+
+
+def test_driver_span_parents_remote_call(cluster):
+    @ray_tpu.remote
+    def in_span_task():
+        return 3
+
+    with tracing.span("driver-step"):
+        assert ray_tpu.get(in_span_task.remote(), timeout=60) == 3
+    spans, task_hits = _spans(
+        lambda s: s.get("name") == "in_span_task" and s.get("parent_id")
+    )
+    assert task_hits, f"task span missing driver parent: {spans}"
+    parent = [
+        s for s in spans if s.get("span_id") == task_hits[0]["parent_id"]
+    ]
+    assert parent and parent[0]["name"] == "driver-step"
+
+
+def test_spans_not_in_task_table(cluster):
+    from ray_tpu import api as core_api
+
+    rt = core_api._runtime
+    reply = rt.run(rt.core.head.call("list_task_events", limit=5000))
+    assert not any(e.get("state") == "SPAN" for e in reply["events"])
+
+
+def test_user_span_context_manager(cluster):
+    with tracing.span("my-section"):
+        time.sleep(0.01)
+    _, hits = _spans(lambda s: s.get("name") == "my-section")
+    assert hits and hits[0]["dur"] >= 0.01
+
+
+def test_jax_profile_writes_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    with tracing.jax_profile(str(tmp_path)):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    produced = list(tmp_path.rglob("*"))
+    assert produced, "jax profiler wrote nothing"

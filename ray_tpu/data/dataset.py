@@ -70,6 +70,25 @@ class Dataset:
     def union(self, *others: "Dataset") -> "Dataset":
         return self._with(P.Union([o._plan for o in others]))
 
+    def join(
+        self,
+        other: "Dataset",
+        on: str,
+        how: str = "inner",
+        *,
+        num_partitions: int | None = None,
+        suffix: str = "_r",
+    ) -> "Dataset":
+        """Hash join on a key column (reference: the hash-shuffle join
+        operator, python/ray/data/_internal/execution/operators/join.py /
+        hash_shuffle.py). ``how``: inner | left | right | outer.
+        Overlapping non-key columns from the right side get ``suffix``."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        return self._with(
+            P.Join(other._plan, on, how, n_out=num_partitions, suffix=suffix)
+        )
+
     def zip(self, other: "Dataset") -> "Dataset":
         return self._with(P.Zip(other._plan))
 

@@ -438,6 +438,32 @@ def execute(plan: P.LogicalPlan, ctx: DataContext | None = None) -> Iterator:
                                    *[rrefs[k] for k in sorted({t[0] for t in pl})])
                 for li, pl in enumerate(plans)
             ])
+        elif op.kind == "join":
+            # Hash join (reference: hash-shuffle join operators,
+            # data/_internal/execution/operators/hash_shuffle.py):
+            # both sides hash-partition on the key; partition j of the
+            # left joins partition j of the right.
+            lrefs = list(stream)
+            rrefs = list(execute(op.other, ctx))
+            n = op.n_out or max(min(len(lrefs) + len(rrefs), 8), 1)
+            # Side schemas travel to every partition so a block whose
+            # partition got rows from only ONE side still emits (and
+            # null-fills) the other side's columns.
+            lschema = _first_schema(lrefs)
+            rschema = _first_schema(rrefs)
+            lmap = [_hash_part.options(num_returns=n).remote(op.on, n, r)
+                    for r in lrefs]
+            rmap = [_hash_part.options(num_returns=n).remote(op.on, n, r)
+                    for r in rrefs]
+            lmap = [m if isinstance(m, list) else [m] for m in lmap]
+            rmap = [m if isinstance(m, list) else [m] for m in rmap]
+            stream = iter([
+                _hash_join.remote(
+                    op.on, op.how, op.suffix, lschema, rschema, len(lmap),
+                    *[m[j] for m in lmap], *[m[j] for m in rmap],
+                )
+                for j in range(n)
+            ])
         elif op.kind in ("aggregate", "map_groups"):
             refs = list(stream)
             if op.kind == "aggregate" and op.key is None:
@@ -463,6 +489,108 @@ def execute(plan: P.LogicalPlan, ctx: DataContext | None = None) -> Iterator:
             raise NotImplementedError(op.kind)
         i += 1
     return stream
+
+
+@ray_tpu.remote
+def _block_schema(blk):
+    return {c: str(blk[c].dtype) for c in blk}
+
+
+def _first_schema(refs) -> dict:
+    """{col: dtype str} from the first non-empty block of a ref list."""
+    for schema in ray_tpu.get([_block_schema.remote(r) for r in refs]):
+        if schema:
+            return schema
+    return {}
+
+
+def _join_fill(dtype, n: int) -> np.ndarray:
+    """Null-fill column for unmatched join rows: NaN for numerics
+    (ints promote to float), None objects otherwise."""
+    dtype = np.dtype(dtype)
+    if np.issubdtype(dtype, np.number):
+        return np.full(n, np.nan)
+    out = np.empty(n, dtype=object)
+    out[:] = None
+    return out
+
+
+@ray_tpu.remote
+def _hash_join(on, how, suffix, lschema, rschema, n_left, *parts):
+    left = [p for p in parts[:n_left] if p]
+    right = [p for p in parts[n_left:] if p]
+    left = B.concat(left) if left else {}
+    right = B.concat(right) if right else {}
+    if not left and not right:
+        return {}
+
+    index: dict = {}
+    n_right = B.num_rows(right) if right else 0
+    if right:
+        for j, k in enumerate(right[on].tolist()):
+            index.setdefault(k, []).append(j)
+    li: list[int] = []
+    ri: list[int] = []
+    left_unmatched: list[int] = []
+    matched_right: set = set()
+    if left:
+        for i, k in enumerate(left[on].tolist()):
+            hits = index.get(k)
+            if hits:
+                for j in hits:
+                    li.append(i)
+                    ri.append(j)
+                    matched_right.add(j)
+            else:
+                left_unmatched.append(i)
+    if how not in ("left", "outer"):
+        left_unmatched = []
+    right_unmatched = (
+        [j for j in range(n_right) if j not in matched_right]
+        if how in ("right", "outer")
+        else []
+    )
+
+    li_a = np.asarray(li, dtype=np.int64)
+    ri_a = np.asarray(ri, dtype=np.int64)
+    lu_a = np.asarray(left_unmatched, dtype=np.int64)
+    ru_a = np.asarray(right_unmatched, dtype=np.int64)
+
+    out: dict = {}
+    # Key column: sourced from whichever side each row group came from.
+    key_parts = []
+    if left:
+        key_parts += [left[on][li_a], left[on][lu_a]]
+    if right and len(ru_a):
+        key_parts.append(right[on][ru_a])
+    out[on] = (
+        np.concatenate(key_parts) if key_parts else np.array([])
+    )
+    # Schemas (not this partition's blocks) define the column set, so a
+    # one-sided partition still emits the other side's columns as nulls.
+    left_cols = [c for c in lschema if c != on]
+    right_cols = [c for c in rschema if c != on]
+    n_matched = len(li_a)
+    for c in left_cols:
+        if left:
+            col = left[c]
+            out[c] = np.concatenate(
+                [col[li_a], col[lu_a], _join_fill(col.dtype, len(ru_a))]
+            )
+        else:
+            out[c] = _join_fill(lschema[c], n_matched + len(lu_a) + len(ru_a))
+    for c in right_cols:
+        name = f"{c}{suffix}" if c in lschema else c
+        if right:
+            col = right[c]
+            out[name] = np.concatenate(
+                [col[ri_a], _join_fill(col.dtype, len(lu_a)), col[ru_a]]
+            )
+        else:
+            out[name] = _join_fill(
+                rschema[c], n_matched + len(lu_a) + len(ru_a)
+            )
+    return out
 
 
 @ray_tpu.remote

@@ -137,6 +137,18 @@ class Zip(Op):
         self.other = other  # LogicalPlan
 
 
+class Join(Op):
+    kind = "join"
+
+    def __init__(self, other, on: str, how: str = "inner", n_out=None,
+                 suffix: str = "_r"):
+        self.other = other  # the right side's LogicalPlan
+        self.on = on
+        self.how = how
+        self.n_out = n_out
+        self.suffix = suffix
+
+
 class GroupByAggregate(Op):
     kind = "aggregate"
 

@@ -13,27 +13,36 @@ rllib/core/learner/learner_group.py:101, rllib/core/rl_module/):
 """
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.bc import BC, BCConfig
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import CartPole, Env, make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunnerGroup
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.module import MLPModule, RLModule
 from ray_tpu.rl.ppo import PPO, PPOConfig
 from ray_tpu.rl.replay import ReplayBuffer
+from ray_tpu.rl.sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "BC",
+    "BCConfig",
     "CartPole",
     "DQN",
     "DQNConfig",
     "Env",
     "EnvRunnerGroup",
+    "IMPALA",
+    "IMPALAConfig",
     "Learner",
     "MLPModule",
     "PPO",
     "PPOConfig",
     "RLModule",
     "ReplayBuffer",
+    "SAC",
+    "SACConfig",
     "make_env",
     "register_env",
 ]

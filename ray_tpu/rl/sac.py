@@ -1,0 +1,207 @@
+"""SAC (discrete): twin soft Q-networks, a stochastic policy, and an
+auto-tuned entropy temperature (reference:
+rllib/algorithms/sac/sac.py + sac_torch_learner; discrete variant per
+Christodoulou 2019).
+
+One jit update program covers all three objectives (Q, policy, alpha) on
+a single packed param tree; target networks update by polyak averaging
+on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig, make_adam
+from ray_tpu.rl.learner import Learner
+from ray_tpu.rl.module import MLPModule, RLModule, _dense, _dense_init
+from ray_tpu.rl.replay import ReplayBuffer
+
+
+@dataclass(frozen=True)
+class SACModule(RLModule):
+    """Policy trunk + twin Q MLPs packed in one param tree."""
+
+    hidden: tuple = (64, 64)
+
+    def _mlp_init(self, key, n_out):
+        keys = jax.random.split(key, len(self.hidden) + 1)
+        layers = []
+        n_in = self.observation_size
+        for i, h in enumerate(self.hidden):
+            layers.append(_dense_init(keys[i], n_in, h))
+            n_in = h
+        layers.append(_dense_init(keys[-1], n_in, n_out, scale=0.01))
+        return layers
+
+    def _mlp(self, layers, x):
+        for layer in layers[:-1]:
+            x = jnp.tanh(_dense(layer, x))
+        return _dense(layers[-1], x)
+
+    def init(self, key: jax.Array):
+        kp, k1, k2 = jax.random.split(key, 3)
+        return {
+            "policy": self._mlp_init(kp, self.num_actions),
+            "q1": self._mlp_init(k1, self.num_actions),
+            "q2": self._mlp_init(k2, self.num_actions),
+            "log_alpha": jnp.zeros((), jnp.float32),
+        }
+
+    def q_values(self, params, obs):
+        x = obs.astype(jnp.float32)
+        return self._mlp(params["q1"], x), self._mlp(params["q2"], x)
+
+    def forward(self, params, obs) -> dict:
+        """Runner-compatible view: policy logits + soft state value."""
+        x = obs.astype(jnp.float32)
+        logits = self._mlp(params["policy"], x)
+        q1, q2 = self.q_values(params, obs)
+        probs = jax.nn.softmax(logits)
+        logp = jax.nn.log_softmax(logits)
+        alpha = jnp.exp(params["log_alpha"])
+        value = (probs * (jnp.minimum(q1, q2) - alpha * logp)).sum(-1)
+        return {"logits": logits, "value": value}
+
+
+def sac_loss(params, module, batch, target_params, gamma, target_entropy):
+    obs, next_obs = batch["obs"], batch["next_obs"]
+    actions = batch["actions"]
+    alpha = jnp.exp(params["log_alpha"])
+    alpha_sg = jax.lax.stop_gradient(alpha)
+
+    # Soft Bellman target from the TARGET twin Qs + current policy.
+    logits_next = module._mlp(params["policy"], next_obs)
+    probs_next = jax.nn.softmax(logits_next)
+    logp_next = jax.nn.log_softmax(logits_next)
+    q1t, q2t = module.q_values(target_params, next_obs)
+    v_next = (
+        probs_next * (jnp.minimum(q1t, q2t) - alpha_sg * logp_next)
+    ).sum(-1)
+    target_q = jax.lax.stop_gradient(
+        batch["rewards"] + gamma * (1.0 - batch["dones"]) * v_next
+    )
+
+    q1, q2 = module.q_values(params, obs)
+    q1_a = jnp.take_along_axis(q1, actions[:, None], axis=-1)[:, 0]
+    q2_a = jnp.take_along_axis(q2, actions[:, None], axis=-1)[:, 0]
+    q_loss = 0.5 * (
+        ((q1_a - target_q) ** 2).mean() + ((q2_a - target_q) ** 2).mean()
+    )
+
+    # Policy: minimize E_pi[alpha*logpi - minQ] (exact expectation over
+    # the discrete action set — no reparameterization needed).
+    logits = module._mlp(params["policy"], obs)
+    probs = jax.nn.softmax(logits)
+    logp = jax.nn.log_softmax(logits)
+    min_q = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+    pi_loss = (probs * (alpha_sg * logp - min_q)).sum(-1).mean()
+
+    # Temperature: entropy tracks target_entropy.
+    entropy = -(probs * logp).sum(-1)
+    alpha_loss = (
+        params["log_alpha"]
+        * jax.lax.stop_gradient(entropy.mean() - target_entropy)
+    )
+
+    loss = q_loss + pi_loss + alpha_loss
+    return loss, {
+        "q_loss": q_loss,
+        "policy_loss": pi_loss,
+        "alpha": alpha,
+        "entropy": entropy.mean(),
+    }
+
+
+@dataclass(frozen=True)
+class SACConfig(AlgorithmConfig):
+    buffer_capacity: int = 50_000
+    batch_size: int = 256
+    learning_starts: int = 1_000
+    tau: float = 0.01  # polyak target update rate
+    updates_per_step: int = 8
+    target_entropy: float | None = None  # default 0.5*log(A)
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(Algorithm):
+    def __init__(self, config: SACConfig):
+        super().__init__(config)
+        probe_obs = self.module.observation_size
+        self.buffer = ReplayBuffer(
+            config.buffer_capacity, probe_obs, seed=config.seed
+        )
+        self.target_params = jax.tree.map(
+            lambda a: a, self.learner.params
+        )
+
+        tau = config.tau
+
+        @jax.jit
+        def polyak(target, online):
+            return jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, target, online
+            )
+
+        self._polyak = polyak
+
+    def _make_module(self, probe_env):
+        return SACModule(
+            observation_size=probe_env.observation_size,
+            num_actions=probe_env.num_actions,
+            hidden=self.config.hidden,
+        )
+
+    def _make_learner(self) -> Learner:
+        cfg = self.config
+        target_entropy = cfg.target_entropy
+        if target_entropy is None:
+            target_entropy = 0.5 * float(np.log(self.module.num_actions))
+
+        def loss(params, module, batch, target_params):
+            return sac_loss(
+                params, module, batch, target_params, cfg.gamma,
+                target_entropy,
+            )
+
+        return Learner(
+            self.module, loss, make_adam(cfg.lr), mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        samples = self.runners.sample()
+        self._record_episodes(samples)
+        for s in samples:
+            T, N = s["rewards"].shape
+            obs = s["obs"].reshape(T * N, -1)
+            next_obs = np.concatenate(
+                [s["obs"][1:], s["next_obs"][None]], axis=0
+            ).reshape(T * N, -1)
+            self.buffer.add_batch(
+                obs,
+                s["actions"].reshape(-1),
+                s["rewards"].reshape(-1),
+                s["dones"].reshape(-1),
+                next_obs,
+            )
+        metrics: dict = {}
+        if len(self.buffer) >= max(cfg.learning_starts, cfg.batch_size):
+            for _ in range(cfg.updates_per_step):
+                batch = self.buffer.sample(cfg.batch_size)
+                metrics = self.learner.update(batch, self.target_params)
+                self.target_params = self._polyak(
+                    self.target_params, self.learner.params
+                )
+        self.runners.set_weights(self.learner.get_weights())
+        metrics["num_env_steps_sampled"] = sum(
+            s["rewards"].size for s in samples
+        )
+        return metrics

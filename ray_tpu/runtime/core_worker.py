@@ -394,13 +394,14 @@ class CoreWorker:
         self._put_index += 1
         oid = ObjectID.for_put(self._root_task, self._put_index)
         data = serialize(value)
-        if data.total_bytes() <= INLINE_MAX_BYTES:
+        if data.total_bytes() <= INLINE_MAX_BYTES and self.mode != "client":
             m = data.materialize_buffers()
             self._store_result(oid.hex(), ("value", m.inband, m.buffers))
         elif self.mode == "client":
             # Remote driver: our private store is unreachable from the
-            # cluster — upload the bytes to an anchor node whose store
-            # serves every worker's pull (reference: Ray Client
+            # cluster — upload the bytes (EVERY put, inline-sized too:
+            # the client may sit behind NAT) to an anchor node whose
+            # store serves every worker's pull (reference: Ray Client
             # server-side put). The ANCHOR becomes the ref's owner
             # address so workers resolve it against the cluster node,
             # never dialing back into the client.

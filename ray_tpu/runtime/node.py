@@ -407,11 +407,19 @@ class NodeManager:
             "buf": bytearray(sum(seg_lens)),
             "ts": time.monotonic(),
         }
-        # Abandoned uploads (client died mid-stream) age out.
-        for key in list(self._uploads):
-            if time.monotonic() - self._uploads[key]["ts"] > 300:
-                del self._uploads[key]
         return {"ok": True, "token": token}
+
+    def _prune_uploads(self):
+        """Drop abandoned upload buffers (client died mid-stream) —
+        called from the reap loop so pruning does not depend on another
+        client ever starting an upload."""
+        uploads = getattr(self, "_uploads", None)
+        if not uploads:
+            return
+        now = time.monotonic()
+        for key in list(uploads):
+            if now - uploads[key]["ts"] > 300:
+                del uploads[key]
 
     async def _on_put_object_chunk(
         self, conn, token: str, offset: int, data: bytes
@@ -825,6 +833,7 @@ class NodeManager:
             # Age-bounce stale queued leases even when no grant/return
             # event fires (the age check lives in _drain_pending).
             self._drain_pending()
+            self._prune_uploads()
             dead = [
                 wid
                 for wid, w in self.workers.items()

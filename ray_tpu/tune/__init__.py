@@ -19,8 +19,11 @@ from ray_tpu.tune.schedulers import (
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     Choice,
+    ConcurrencyLimiter,
     Domain,
+    Repeater,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -69,6 +72,7 @@ __all__ = [
     "Tuner", "TuneConfig", "RunConfig", "ResultGrid", "TrialResult",
     "Trainable", "Trial", "StopTrial", "report", "get_checkpoint",
     "uniform", "loguniform", "randint", "choice", "grid_search",
+    "TPESearcher", "ConcurrencyLimiter", "Repeater",
     "Domain", "Choice", "Searcher", "BasicVariantGenerator",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",

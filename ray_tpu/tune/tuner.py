@@ -18,7 +18,7 @@ from typing import Any, Callable
 
 import ray_tpu
 from ray_tpu.tune import schedulers as S
-from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.search import DEFER, BasicVariantGenerator, Searcher
 from ray_tpu.tune.trial import (
     ERROR,
     PENDING,
@@ -104,9 +104,14 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         cfg = self.tune_config
-        searcher = cfg.search_alg or BasicVariantGenerator(
-            self.param_space, num_samples=cfg.num_samples, seed=cfg.seed
-        )
+        if cfg.search_alg is not None:
+            # num_samples bounds TOTAL trials for pluggable searchers
+            # (BasicVariant bakes it into its own queue).
+            searcher = _CapSamples(cfg.search_alg, cfg.num_samples)
+        else:
+            searcher = BasicVariantGenerator(
+                self.param_space, num_samples=cfg.num_samples, seed=cfg.seed
+            )
         scheduler = cfg.scheduler or S.FIFOScheduler()
         exp_dir = os.path.join(self.run_config.storage_path, self.run_config.name)
         os.makedirs(exp_dir, exist_ok=True)
@@ -118,6 +123,25 @@ class Tuner:
         )
         results = controller.run()
         return ResultGrid(results, metric=cfg.metric, mode=cfg.mode)
+
+
+class _CapSamples(Searcher):
+    """Bound a pluggable searcher to num_samples total suggestions."""
+
+    def __init__(self, searcher: Searcher, num_samples: int):
+        self.searcher = searcher
+        self.remaining = num_samples
+
+    def suggest(self, trial_id: str):
+        if self.remaining <= 0:
+            return None
+        config = self.searcher.suggest(trial_id)
+        if config is not None and config is not DEFER:
+            self.remaining -= 1
+        return config
+
+    def on_trial_complete(self, trial_id: str, result: dict | None):
+        self.searcher.on_trial_complete(trial_id, result)
 
 
 class _TuneController:
@@ -140,6 +164,8 @@ class _TuneController:
         config = self.searcher.suggest(trial_id)
         if config is None:
             self._exhausted = True
+            return None
+        if config is DEFER:  # not now (concurrency-limited) — retry later
             return None
         trial = Trial(
             trial_id, config,
@@ -196,6 +222,7 @@ class _TuneController:
             if not running:
                 if self._exhausted:
                     break
+                time.sleep(0.05)  # deferred suggestions: retry shortly
                 continue
             if self.is_class:
                 self._step_class_trials(running)

@@ -197,3 +197,79 @@ def test_from_pandas_roundtrip(cluster):
     out = ds.to_pandas()
     assert list(out["a"]) == [1, 2, 3]
     assert list(out["b"]) == ["x", "y", "z"]
+
+
+# ---------------------------------------------------------------- join
+def test_inner_join(cluster):
+    import ray_tpu.data as rd
+
+    left = rd.from_items(
+        [{"id": i, "x": float(i)} for i in range(8)]
+    ).repartition(3)
+    right = rd.from_items(
+        [{"id": i, "y": i * 10} for i in range(4, 12)]
+    ).repartition(2)
+    rows = sorted(
+        left.join(right, on="id").take_all(), key=lambda r: r["id"]
+    )
+    assert [r["id"] for r in rows] == [4, 5, 6, 7]
+    assert all(r["y"] == r["id"] * 10 and r["x"] == float(r["id"]) for r in rows)
+
+
+def test_left_and_outer_join_fill(cluster):
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": 1, "x": 1.0}, {"id": 2, "x": 2.0}])
+    right = rd.from_items([{"id": 2, "y": 20}, {"id": 3, "y": 30}])
+
+    lrows = sorted(
+        left.join(right, on="id", how="left").take_all(),
+        key=lambda r: r["id"],
+    )
+    assert [r["id"] for r in lrows] == [1, 2]
+    assert np.isnan(lrows[0]["y"]) and lrows[1]["y"] == 20
+
+    orows = sorted(
+        left.join(right, on="id", how="outer").take_all(),
+        key=lambda r: r["id"],
+    )
+    assert [r["id"] for r in orows] == [1, 2, 3]
+    assert np.isnan(orows[2]["x"]) and orows[2]["y"] == 30
+
+
+def test_join_suffixes_overlapping_columns(cluster):
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": 1, "v": "L"}])
+    right = rd.from_items([{"id": 1, "v": "R"}])
+    rows = left.join(right, on="id").take_all()
+    assert rows[0]["v"] == "L" and rows[0]["v_r"] == "R"
+
+
+def test_join_duplicate_keys_cross_product(cluster):
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": 1, "x": a} for a in (0, 1)])
+    right = rd.from_items([{"id": 1, "y": b} for b in (0, 1, 2)])
+    rows = left.join(right, on="id").take_all()
+    assert len(rows) == 6  # 2 x 3 matches
+
+
+def test_outer_join_one_sided_partitions(cluster):
+    """Partitions receiving rows from only ONE side still emit (and
+    null-fill) the other side's columns."""
+    import numpy as np
+
+    import ray_tpu.data as rd
+
+    left = rd.from_items([{"id": 2, "x": 2.0}])
+    right = rd.from_items([{"id": 3, "y": 30}])
+    rows = sorted(
+        left.join(right, on="id", how="outer", num_partitions=4).take_all(),
+        key=lambda r: r["id"],
+    )
+    assert [r["id"] for r in rows] == [2, 3]
+    assert rows[0]["x"] == 2.0 and np.isnan(rows[0]["y"])
+    assert np.isnan(rows[1]["x"]) and rows[1]["y"] == 30

@@ -1,0 +1,141 @@
+"""New RL algorithms: IMPALA (V-trace), discrete SAC, BC (reference:
+rllib/algorithms/{impala,sac,bc} fast-suite patterns — tiny nets, easy
+envs, assert mechanics + learning signal).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import BCConfig, IMPALAConfig, SACConfig, make_env
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_vtrace_matches_onpolicy_gae_limit():
+    """With behavior == target policy (rho=c=1) and gamma-only
+    discounting, vs reduces to the Monte-Carlo-corrected TD recursion —
+    check one step by hand via the loss's aux values."""
+    import jax.numpy as jnp
+
+    from ray_tpu.rl.impala import vtrace_loss
+    from ray_tpu.rl.module import MLPModule
+
+    mod = MLPModule(observation_size=3, num_actions=2, hidden=(8,))
+    import jax
+
+    params = mod.init(jax.random.key(0))
+    T, N = 4, 2
+    obs = np.zeros((T, N, 3), np.float32)
+    out = mod.forward(params, obs.reshape(-1, 3))
+    logp_all = jax.nn.log_softmax(out["logits"]).reshape(T, N, -1)
+    actions = np.zeros((T, N), np.int64)
+    batch = {
+        "obs": jnp.asarray(obs),
+        "actions": jnp.asarray(actions),
+        "rewards": jnp.ones((T, N), jnp.float32),
+        "dones": jnp.zeros((T, N), jnp.float32),
+        "logp": logp_all[..., 0],  # behavior == target → rho = 1
+        "last_value": jnp.zeros(N, jnp.float32),
+    }
+    loss, aux = vtrace_loss(
+        params, mod, batch, gamma=0.9, rho_clip=1.0, c_clip=1.0,
+        vf_coeff=0.5, ent_coeff=0.0,
+    )
+    assert np.isfinite(float(loss))
+    np.testing.assert_allclose(float(aux["mean_rho"]), 1.0, rtol=1e-5)
+
+
+def test_impala_learns_chain(cluster):
+    cfg = IMPALAConfig(
+        env="Chain",
+        env_kwargs={"n": 6},
+        num_env_runners=2,
+        num_envs_per_runner=4,
+        rollout_len=32,
+        hidden=(32,),
+        lr=3e-3,
+        seed=0,
+    )
+    algo = cfg.build()
+    try:
+        result = {}
+        for _ in range(80):
+            result = algo.train()
+        assert np.isfinite(result["loss"])
+        assert result["episode_return_mean"] > 0.5
+        obs = np.zeros((1, 6), np.float32)
+        obs[0, 0] = 1.0
+        assert algo.compute_actions(obs)[0] == 1
+    finally:
+        algo.stop()
+
+
+def test_sac_learns_chain(cluster):
+    cfg = SACConfig(
+        env="Chain",
+        env_kwargs={"n": 5},
+        num_env_runners=1,
+        num_envs_per_runner=8,
+        rollout_len=32,
+        hidden=(32,),
+        lr=3e-3,
+        learning_starts=256,
+        batch_size=128,
+        updates_per_step=16,
+        seed=0,
+    )
+    algo = cfg.build()
+    try:
+        result = {}
+        for _ in range(20):
+            result = algo.train()
+        assert np.isfinite(result["q_loss"])
+        assert result["alpha"] > 0
+        assert result["episode_return_mean"] > 0.5
+    finally:
+        algo.stop()
+
+
+def _expert_chain_dataset(n=6, episodes=200):
+    """Optimal Chain policy: always go right (action 1)."""
+    env = make_env("Chain", n=n)
+    obs_list, act_list = [], []
+    for ep in range(episodes):
+        obs = env.reset(seed=ep)
+        done = False
+        while not done:
+            obs_list.append(obs.copy())
+            act_list.append(1)
+            obs, _r, done = env.step(1)
+    return {"obs": np.array(obs_list), "actions": np.array(act_list)}
+
+
+def test_bc_clones_expert(cluster):
+    data = _expert_chain_dataset()
+    cfg = BCConfig(
+        env="Chain",
+        env_kwargs={"n": 6},
+        num_env_runners=1,
+        num_envs_per_runner=4,
+        rollout_len=32,
+        hidden=(32,),
+        lr=1e-2,
+        dataset=data,
+        evaluate_every=5,
+        seed=0,
+    )
+    algo = cfg.build()
+    try:
+        result = {}
+        for _ in range(10):
+            result = algo.train()
+        assert result["accuracy"] > 0.95
+        assert result["episode_return_mean"] > 0.8  # clone reaches goal
+    finally:
+        algo.stop()

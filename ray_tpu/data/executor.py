@@ -497,8 +497,10 @@ def _block_schema(blk):
 
 
 def _first_schema(refs) -> dict:
-    """{col: dtype str} from the first non-empty block of a ref list."""
-    for schema in ray_tpu.get([_block_schema.remote(r) for r in refs]):
+    """{col: dtype str} from the first non-empty block of a ref list.
+    Probes one block at a time — most datasets answer on the first."""
+    for r in refs:
+        schema = ray_tpu.get(_block_schema.remote(r))
         if schema:
             return schema
     return {}

@@ -178,7 +178,9 @@ def _block(
     v = (h @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = attn_fn(q, k, v)
+    from jax.ad_checkpoint import checkpoint_name
+
+    attn = checkpoint_name(attn_fn(q, k, v), "attn_out")
     x = x + attn.reshape(b, s, -1) @ p["wo"].astype(dt)
 
     h = rms_norm(x, p["mlp_norm"])
@@ -214,6 +216,18 @@ def forward_with_aux(
     if cfg.remat == "full":
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    elif cfg.remat == "attn":
+        # Save ONLY the attention outputs: the backward pass skips the
+        # flash-kernel forward recompute (the most expensive part of the
+        # layer to re-run) at a cost of one [B, S, H, D] bf16 residual
+        # per layer — the standard selective-remat sweet spot for long
+        # sequences.
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out"
+            ),
         )
     elif cfg.remat == "dots":
         body = jax.checkpoint(

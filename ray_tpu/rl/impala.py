@@ -41,10 +41,14 @@ def vtrace_loss(
     clipped_rho = jnp.minimum(rhos, rho_clip)
     cs = jnp.minimum(rhos, c_clip)
 
-    discounts = gamma * (1.0 - batch["dones"])
-    next_values = jnp.concatenate(
-        [values[1:], batch["last_value"][None]], axis=0
+    # Bootstrap from the CURRENT critic at the rollout's next_obs — the
+    # runner's own value estimate is as stale as its policy, and
+    # V-trace's correction assumes V comes from the learner's critic.
+    last_value = jax.lax.stop_gradient(
+        module.forward(params, batch["next_obs"])["value"]
     )
+    discounts = gamma * (1.0 - batch["dones"])
+    next_values = jnp.concatenate([values[1:], last_value[None]], axis=0)
     deltas = clipped_rho * (
         batch["rewards"] + discounts * next_values - values
     )
@@ -63,7 +67,7 @@ def vtrace_loss(
     )
     vs = values + acc_rev[::-1]
 
-    vs_next = jnp.concatenate([vs[1:], batch["last_value"][None]], axis=0)
+    vs_next = jnp.concatenate([vs[1:], last_value[None]], axis=0)
     pg_adv = jax.lax.stop_gradient(
         clipped_rho * (batch["rewards"] + discounts * vs_next - values)
     )
@@ -147,7 +151,7 @@ class IMPALA(Algorithm):
             "rewards": s["rewards"],
             "dones": s["dones"],
             "logp": s["logp"],
-            "last_value": s["last_value"],
+            "next_obs": s["next_obs"],
         }
         for _ in range(max(1, self.config.updates_per_rollout)):
             metrics = self.learner.update(batch)

@@ -267,11 +267,9 @@ class TPESearcher(Searcher):
                         bad_counts[repr(b[k])] = (
                             bad_counts.get(repr(b[k]), 1.0) + 1
                         )
-                    import math as _m
-
-                    score += _m.log(
+                    score += math.log(
                         counts[repr(pick)] / sum(counts.values())
-                    ) - _m.log(
+                    ) - math.log(
                         bad_counts[repr(pick)] / sum(bad_counts.values())
                     )
                 else:

@@ -41,7 +41,7 @@ def test_vtrace_matches_onpolicy_gae_limit():
         "rewards": jnp.ones((T, N), jnp.float32),
         "dones": jnp.zeros((T, N), jnp.float32),
         "logp": logp_all[..., 0],  # behavior == target → rho = 1
-        "last_value": jnp.zeros(N, jnp.float32),
+        "next_obs": jnp.zeros((N, 3), jnp.float32),
     }
     loss, aux = vtrace_loss(
         params, mod, batch, gamma=0.9, rho_clip=1.0, c_clip=1.0,

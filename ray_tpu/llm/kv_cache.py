@@ -76,8 +76,9 @@ def forward_prefill(
     seq = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
-    # 512 = the kernel's default block_kv: seq must divide by it.
-    flash_ok = use_flash and seq >= 512 and seq % 512 == 0
+    # The kernel now accepts any length (blocks clamp to the largest
+    # divisor); below ~512 the launch overhead loses to fused dense.
+    flash_ok = use_flash and seq >= 512
 
     def attend(q, k, v):
         if flash_ok:

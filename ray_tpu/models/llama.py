@@ -19,6 +19,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.ops.attention import causal_attention
 from ray_tpu.ops.norms import rms_norm
@@ -178,8 +179,6 @@ def _block(
     v = (h @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    from jax.ad_checkpoint import checkpoint_name
-
     attn = checkpoint_name(attn_fn(q, k, v), "attn_out")
     x = x + attn.reshape(b, s, -1) @ p["wo"].astype(dt)
 

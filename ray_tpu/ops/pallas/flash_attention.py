@@ -352,6 +352,16 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _fit_block(requested: int, s: int) -> int:
+    """Largest block <= requested that divides s (s itself when s fits)."""
+    if s <= requested:
+        return s
+    for d in range(requested, 0, -1):
+        if s % d == 0:
+            return d
+    return 1
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "block_q", "block_kv", "interpret", "scale"),
@@ -374,12 +384,12 @@ def flash_attention(
     hkv = k.shape[2]
     if h % hkv:
         raise ValueError(f"n_heads={h} not divisible by n_kv={hkv}")
-    import math
-
-    # Largest block that divides the sequence, capped at the request —
-    # any s works (a power-of-two-free length just gets smaller blocks).
-    block_q = math.gcd(block_q, s)
-    block_kv = math.gcd(block_kv, s)
+    # Largest divisor of the sequence that fits the request — any s
+    # works: s <= block keeps one full block (the old fast path), and
+    # awkward lengths degrade to their largest divisor, never to
+    # gcd-collapsed 1-wide tiles.
+    block_q = _fit_block(block_q, s)
+    block_kv = _fit_block(block_kv, s)
     if scale is None:
         scale = d**-0.5
     return _flash(q, k, v, causal, scale, block_q, block_kv, interpret)

@@ -10,6 +10,7 @@ from ray_tpu.train.step import (
     init_train_state,
     state_logical_axes,
 )
+from ray_tpu.train.dataloader import TokenDataset
 from ray_tpu.train.checkpoint import (
     CheckpointManager,
     restore_checkpoint,
@@ -30,6 +31,7 @@ from ray_tpu.train.trainer import (
 )
 
 __all__ = [
+    "TokenDataset",
     "CheckpointManager",
     "restore_checkpoint",
     "save_checkpoint",

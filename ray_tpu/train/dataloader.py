@@ -1,0 +1,87 @@
+"""TokenDataset: the training input pipeline over the native loader.
+
+The reference's input path for large corpora is ray.data's native block
+scanners; the TPU-native equivalent is a C++ mmap gather loop
+(native/dataloader/dataloader.cpp) that assembles [batch, seq+1] token
+batches on the host while the previous step runs on device (background
+prefetch = the input-pipeline overlap the XLA scaling playbook calls
+for). Sharding composes with the trainer: shard(rank, world) stripes the
+shuffled window permutation across data-parallel workers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu._native.dataloader import NativeTokenLoader
+
+
+class TokenDataset:
+    """Iterate fixed-length token windows from a flat binary corpus.
+
+    ``path`` holds little-endian uint16 or uint32 token ids back to
+    back (the standard .bin dump). Each sample is ``seq_len + 1`` tokens
+    (inputs + shifted targets come from the same window).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        *,
+        dtype: str = "u32",
+        seed: int = 0,
+        shuffle: bool = True,
+    ):
+        dtype_bytes = {"u16": 2, "u32": 4}[dtype]
+        self._loader = NativeTokenLoader(
+            path, seq_len + 1, dtype_bytes=dtype_bytes
+        )
+        self.seq_len = seq_len
+        self.seed = seed
+        self.shuffle = shuffle
+        self._rank, self._world = 0, 1
+        self._epoch = 0
+
+    @property
+    def num_samples(self) -> int:
+        return self._loader.num_windows // self._world
+
+    def shard(self, rank: int, world: int) -> "TokenDataset":
+        """Restrict this dataset to a data-parallel shard (reference:
+        DataConfig splits streams per train worker,
+        train/v2/_internal/data_integration/)."""
+        self._rank, self._world = rank, world
+        self._loader.set_shard(rank, world)
+        return self
+
+    def iter_batches(self, batch_size: int, *, epochs: int = 1):
+        """Yield {"tokens": [B, seq+1] uint32} with background prefetch;
+        the tail partial batch of each epoch is dropped (static shapes
+        for jit)."""
+        # Every rank yields EXACTLY this many batches per epoch (ranks
+        # can differ by one window; an uneven batch count would hang
+        # synchronized SPMD training at the epoch boundary).
+        batches_per_epoch = self.num_samples // batch_size
+        for _ in range(epochs):
+            if self.shuffle:
+                # Same seed on every shard → one global permutation,
+                # disjoint stripes per rank.
+                self._loader.shuffle(self.seed + self._epoch)
+            self._loader.prefetch_start(batch_size)
+            try:
+                for _i in range(batches_per_epoch):
+                    batch = self._loader.next()
+                    if len(batch) < batch_size:
+                        break  # defensive: loader exhausted early
+                    yield {"tokens": batch}
+            finally:
+                self._loader.prefetch_stop()
+            self._epoch += 1
+
+    def take_batch(self, batch_size: int, start: int = 0) -> dict:
+        """Synchronous gather (no prefetch thread) — handy for eval."""
+        return {"tokens": self._loader.fill(start, batch_size)}
+
+    def close(self) -> None:
+        self._loader.close()

@@ -103,7 +103,8 @@ void prefetch_loop(Loader* L) {
     L->cursor += batch;
     L->cv.notify_all();
     if (rows == 0) {
-      // Epoch exhausted: park until reset or stop.
+      // Epoch exhausted: park until the consumer takes the empty
+      // sentinel and stops this prefetch run.
       while (!L->stop && L->buf_full) L->cv.wait(lk);
     }
   }
@@ -142,9 +143,14 @@ uint64_t dl_num_windows(void* handle) {
 }
 
 // Seeded Fisher-Yates shuffle of the window permutation (one epoch).
-// splitmix64 PRNG: deterministic across platforms.
-void dl_shuffle(void* handle, uint64_t seed) {
+// splitmix64 PRNG: deterministic across platforms. Refused (-EBUSY)
+// while a prefetch thread is running: gather() reads perm unlocked.
+int dl_shuffle(void* handle, uint64_t seed) {
   Loader* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    if (L->prefetching) return -EBUSY;
+  }
   uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
   auto next = [&x]() {
     x += 0x9E3779B97F4A7C15ULL;
@@ -157,6 +163,7 @@ void dl_shuffle(void* handle, uint64_t seed) {
     uint64_t j = next() % i;
     std::swap(L->perm[i - 1], L->perm[j]);
   }
+  return 0;
 }
 
 // Synchronous gather of `batch` windows starting at shard-local
@@ -168,11 +175,15 @@ uint64_t dl_fill(void* handle, uint64_t start, uint64_t batch,
 }
 
 // Configure the shard (data parallelism): this loader sees permutation
-// entries rank, rank+world, rank+2*world, ...
-void dl_set_shard(void* handle, uint64_t rank, uint64_t world_size) {
+// entries rank, rank+world, rank+2*world, ... Refused (-EBUSY) while
+// prefetching (gather() reads these unlocked).
+int dl_set_shard(void* handle, uint64_t rank, uint64_t world_size) {
   Loader* L = static_cast<Loader*>(handle);
+  std::lock_guard<std::mutex> lk(L->mu);
+  if (L->prefetching) return -EBUSY;
   L->rank = rank;
   L->world_size = world_size ? world_size : 1;
+  return 0;
 }
 
 // ---- background prefetch (double buffering) -------------------------
@@ -202,16 +213,6 @@ uint64_t dl_next(void* handle, uint32_t* out) {
   L->buf_full = false;
   L->cv.notify_all();
   return rows;
-}
-
-// Rewind for a new epoch (optionally with a fresh shuffle done by the
-// caller first). Safe only between dl_next calls.
-void dl_reset(void* handle) {
-  Loader* L = static_cast<Loader*>(handle);
-  std::lock_guard<std::mutex> lk(L->mu);
-  L->cursor = 0;
-  L->buf_full = false;
-  L->cv.notify_all();
 }
 
 void dl_prefetch_stop(void* handle) {

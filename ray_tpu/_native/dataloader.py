@@ -25,9 +25,11 @@ def _load():
     lib.dl_num_windows.argtypes = [ctypes.c_void_p]
     lib.dl_num_windows.restype = ctypes.c_uint64
     lib.dl_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.dl_shuffle.restype = ctypes.c_int
     lib.dl_set_shard.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
     ]
+    lib.dl_set_shard.restype = ctypes.c_int
     lib.dl_fill.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint32),
@@ -39,7 +41,6 @@ def _load():
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32)
     ]
     lib.dl_next.restype = ctypes.c_uint64
-    lib.dl_reset.argtypes = [ctypes.c_void_p]
     lib.dl_prefetch_stop.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
@@ -63,10 +64,12 @@ class NativeTokenLoader:
         return self._lib.dl_num_windows(self._h)
 
     def shuffle(self, seed: int) -> None:
-        self._lib.dl_shuffle(self._h, seed)
+        if self._lib.dl_shuffle(self._h, seed) != 0:
+            raise RuntimeError("cannot shuffle while prefetching")
 
     def set_shard(self, rank: int, world: int) -> None:
-        self._lib.dl_set_shard(self._h, rank, world)
+        if self._lib.dl_set_shard(self._h, rank, world) != 0:
+            raise RuntimeError("cannot re-shard while prefetching")
 
     def fill(self, start: int, batch: int) -> np.ndarray:
         out = np.empty((batch, self.window), np.uint32)
@@ -89,9 +92,6 @@ class NativeTokenLoader:
             self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
         )
         return out[:rows]
-
-    def reset(self) -> None:
-        self._lib.dl_reset(self._h)
 
     def prefetch_stop(self) -> None:
         if self._prefetching:

@@ -98,6 +98,75 @@ def main(quick: bool = False) -> list[dict]:
         ray_tpu.kill(c)
     finally:
         ray_tpu.shutdown()
+    results.extend(collective_bench(quick=quick))
+    return results
+
+
+def collective_bench(quick: bool = False) -> list[dict]:
+    """Allreduce bus bandwidth on the XLA mesh backend vs the naive host
+    path (BASELINE.json config 1: NCCL-vs-Gloo analogue — here XLA
+    collectives over the device mesh vs single-host numpy reduce)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    results: list[dict] = []
+    nbytes = (4 << 20) if quick else (64 << 20)  # per-shard payload
+    n_elem = nbytes // 4
+    world = len(devs)
+    trials = 5
+
+    # XLA path: psum over every device on the mesh (ICI on real TPUs).
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(devs, object).reshape(world), ("x",))
+    shards = jax.device_put(
+        jnp.ones((world, n_elem), jnp.float32),
+        NamedSharding(mesh, P("x", None)),
+    )
+    allreduce = jax.jit(
+        jax.shard_map(
+            lambda a: jax.lax.psum(a, "x"),
+            mesh=mesh,
+            in_specs=P("x", None),
+            out_specs=P("x", None),
+        )
+    )
+    def bus_gb_s(dt: float) -> float:
+        # Ring-allreduce bus-bandwidth convention: 2(w-1)/w * bytes/t.
+        factor = 2 * (world - 1) / world if world > 1 else 1.0
+        return round(factor * nbytes / dt / 1e9, 2)
+
+    out = allreduce(shards)
+    float(out[0, 0])  # compile + sync
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = allreduce(out)
+    float(out[0, 0])
+    dt = (time.perf_counter() - t0) / trials
+    results.append({
+        "name": f"allreduce xla_mesh {nbytes >> 20} MiB x{world}dev",
+        "per_s": 1.0 / dt,
+        "bus_GB_s": bus_gb_s(dt),
+    })
+    print(results[-1])
+
+    # Host baseline: numpy sum over per-rank buffers (the Gloo stand-in).
+    host = [np.ones(n_elem, np.float32) for _ in range(world)]
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        reduced = np.sum(host, axis=0)
+        host = [reduced.copy() for _ in range(world)]
+    dt_host = (time.perf_counter() - t0) / trials
+    results.append({
+        "name": f"allreduce host-numpy {nbytes >> 20} MiB x{world}",
+        "per_s": 1.0 / dt_host,
+        "bus_GB_s": bus_gb_s(dt_host),
+    })
+    print(results[-1])
     return results
 
 

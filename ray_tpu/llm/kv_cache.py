@@ -76,9 +76,14 @@ def forward_prefill(
     seq = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
-    # The kernel now accepts any length (blocks clamp to the largest
-    # divisor); below ~512 the launch overhead loses to fused dense.
-    flash_ok = use_flash and seq >= 512
+    # The kernel accepts any length (blocks clamp to the largest divisor
+    # of seq), but awkward lengths degrade: gate on the FITTED block
+    # being MXU-friendly (>=128, multiple of 8) so prime-ish prompt
+    # lengths keep the fused dense path instead of 1-wide Pallas tiles.
+    from ray_tpu.ops.pallas.flash_attention import _fit_block
+
+    _blk = _fit_block(1024, seq)
+    flash_ok = use_flash and seq >= 512 and _blk >= 128 and _blk % 8 == 0
 
     def attend(q, k, v):
         if flash_ok:

@@ -353,7 +353,9 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _fit_block(requested: int, s: int) -> int:
-    """Largest block <= requested that divides s (s itself when s fits)."""
+    """Largest block <= requested that divides s (s itself when s fits).
+    Prime-ish lengths collapse to tiny blocks — callers that can choose
+    another path should gate on the fitted size (see llm/kv_cache.py)."""
     if s <= requested:
         return s
     for d in range(requested, 0, -1):
@@ -385,9 +387,9 @@ def flash_attention(
     if h % hkv:
         raise ValueError(f"n_heads={h} not divisible by n_kv={hkv}")
     # Largest divisor of the sequence that fits the request — any s
-    # works: s <= block keeps one full block (the old fast path), and
-    # awkward lengths degrade to their largest divisor, never to
-    # gcd-collapsed 1-wide tiles.
+    # works: s <= block keeps one full block (the old fast path);
+    # awkward lengths degrade to their largest divisor (prime-ish
+    # lengths degrade hard — perf-sensitive callers gate on _fit_block).
     block_q = _fit_block(block_q, s)
     block_kv = _fit_block(block_kv, s)
     if scale is None:

@@ -10,6 +10,7 @@ from ray_tpu.parallel.mesh import (
     MESH_AXES,
     default_axis_sizes,
     make_mesh,
+    make_multislice_mesh,
 )
 from ray_tpu.parallel.pipeline import pipeline_apply, pipeline_loss_fn
 from ray_tpu.parallel.sharding import (
@@ -26,6 +27,7 @@ __all__ = [
     "MESH_AXES",
     "default_axis_sizes",
     "make_mesh",
+    "make_multislice_mesh",
     "DEFAULT_RULES",
     "logical_spec",
     "logical_sharding",

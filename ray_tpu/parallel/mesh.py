@@ -109,3 +109,51 @@ def make_mesh(
         [sizes[a] for a in MESH_AXES]
     )
     return Mesh(dev_array, MESH_AXES)
+
+
+def make_multislice_mesh(
+    ici_axis_sizes: Mapping[str, int],
+    dcn_axis_sizes: Mapping[str, int],
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Hybrid mesh for MULTISLICE pods: ``dcn_axis_sizes`` axes span
+    slices over the data-center network (gradient-sized, latency-tolerant
+    collectives — normally ``dp``/``fsdp``); ``ici_axis_sizes`` axes
+    shard within a slice on the torus (``tp``/``sp``/``ep``, where
+    collectives are latency-critical).
+
+    Uses jax's hybrid mesh builder so same-slice devices stay contiguous
+    on the inner axes (reference scaling recipe: DCN outermost, ICI
+    innermost — the multislice layout of the scaling book; reference's
+    NCCL/MPI analogue is the multi-node process-group split in
+    torch/config.py:73). Falls back to a flat mesh when devices carry no
+    slice topology (CPU tests, single slice)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    combined = {
+        a: int(ici_axis_sizes.get(a, 1)) * int(dcn_axis_sizes.get(a, 1))
+        for a in set(ici_axis_sizes) | set(dcn_axis_sizes)
+    }
+    if n_slices <= 1:
+        # Single slice (or no slice metadata): DCN factors fold into the
+        # flat mesh — shardings and programs stay identical, only the
+        # physical layout differs.
+        return make_mesh(combined, devices=devices)
+    sizes_ici = _resolve_sizes(
+        {a: int(ici_axis_sizes.get(a, 1)) for a in MESH_AXES},
+        n // int(np.prod([dcn_axis_sizes.get(a, 1) for a in MESH_AXES])),
+    )
+    sizes_dcn = {a: int(dcn_axis_sizes.get(a, 1)) for a in MESH_AXES}
+    from jax.experimental import mesh_utils
+
+    dev_array = mesh_utils.create_hybrid_device_mesh(
+        [sizes_ici[a] for a in MESH_AXES],
+        [sizes_dcn[a] for a in MESH_AXES],
+        devices=devices,
+        allow_split_physical_axes=True,
+    )
+    return Mesh(dev_array, MESH_AXES)

@@ -40,3 +40,27 @@ def test_gqa_matches_repeated_kv():
     v_full = jnp.repeat(v, 2, axis=2)
     full = causal_attention(q, k_full, v_full)
     np.testing.assert_allclose(gqa, full, rtol=1e-5, atol=1e-6)
+
+
+def test_multislice_mesh_single_slice_fallback():
+    """Without slice topology (CPU devices), DCN factors fold into a
+    flat canonical mesh with identical axis semantics."""
+    from ray_tpu.parallel import make_multislice_mesh
+
+    mesh = make_multislice_mesh(
+        ici_axis_sizes={"tp": 2, "sp": 2}, dcn_axis_sizes={"dp": 2}
+    )
+    assert mesh.shape["dp"] == 2
+    assert mesh.shape["tp"] == 2 and mesh.shape["sp"] == 2
+    assert mesh.devices.size == 8
+
+    # A sharded computation runs on it like any canonical mesh.
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(
+        jnp.arange(16.0).reshape(8, 2),
+        NamedSharding(mesh, P(("dp",), "tp")),
+    )
+    assert float(x.sum()) == 120.0

@@ -147,10 +147,11 @@ uint64_t dl_num_windows(void* handle) {
 // while a prefetch thread is running: gather() reads perm unlocked.
 int dl_shuffle(void* handle, uint64_t seed) {
   Loader* L = static_cast<Loader*>(handle);
-  {
-    std::lock_guard<std::mutex> lk(L->mu);
-    if (L->prefetching) return -EBUSY;
-  }
+  // Hold the mutex for the WHOLE shuffle: a concurrent
+  // dl_prefetch_start (ctypes releases the GIL) then blocks here until
+  // perm is consistent, instead of racing gather() against the swaps.
+  std::lock_guard<std::mutex> lk(L->mu);
+  if (L->prefetching) return -EBUSY;
   uint64_t x = seed + 0x9E3779B97F4A7C15ULL;
   auto next = [&x]() {
     x += 0x9E3779B97F4A7C15ULL;
@@ -223,6 +224,7 @@ void dl_prefetch_stop(void* handle) {
     L->cv.notify_all();
   }
   if (L->worker.joinable()) L->worker.join();
+  std::lock_guard<std::mutex> lk(L->mu);
   L->prefetching = false;
 }
 

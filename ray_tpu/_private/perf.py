@@ -106,8 +106,6 @@ def collective_bench(quick: bool = False) -> list[dict]:
     """Allreduce bus bandwidth on the XLA mesh backend vs the naive host
     path (BASELINE.json config 1: NCCL-vs-Gloo analogue — here XLA
     collectives over the device mesh vs single-host numpy reduce)."""
-    import time
-
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -1,0 +1,108 @@
+"""Chaos-testing utilities (reference: python/ray/_private/test_utils.py
+— ResourceKillerActor :1412, RayletKiller :1534, WorkerKillerActor :1646
+kill cluster components on an interval to exercise fault tolerance; the
+nightly chaos suites build on them, release/nightly_tests/chaos_test/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+
+class WorkerKillerActor:
+    """Kills leased task workers on an interval. Deploy with
+    ``ray_tpu.remote(WorkerKillerActor).remote(...)`` and call
+    ``run.remote()``; tasks with retries should keep completing.
+
+    By default actors are spared (killing the killer — or the test's own
+    actors — makes assertions murky); pass ``include_actors=True`` for
+    full chaos."""
+
+    def __init__(
+        self,
+        interval_s: float = 1.0,
+        max_kills: int = 3,
+        include_actors: bool = False,
+        seed: int = 0,
+    ):
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self.include_actors = include_actors
+        self.kills: list[str] = []
+        self._rng = random.Random(seed)
+
+    async def _nodes(self):
+        import ray_tpu.api as api
+
+        rt = api._runtime
+        table = await rt.core.head.call("node_table")
+        return [n["addr"] for n in table.values()]
+
+    async def run(self) -> list[str]:
+        """Kill until max_kills; returns killed worker ids."""
+        import ray_tpu.api as api
+
+        rt = api._runtime
+        while len(self.kills) < self.max_kills:
+            await asyncio.sleep(self.interval_s)
+            for addr in await self._nodes():
+                try:
+                    conn = await rt.core._connect(addr)
+                    reply = await conn.call("list_workers")
+                except Exception:  # noqa: BLE001 - node may be gone
+                    continue
+                victims = [
+                    w for w in reply["workers"]
+                    if w["leased"]
+                    and (self.include_actors or not w["is_actor"])
+                    and w["worker_id"] != rt.core.worker_id
+                ]
+                if not victims:
+                    continue
+                victim = self._rng.choice(victims)
+                try:
+                    await conn.call(
+                        "kill_worker", worker_id=victim["worker_id"]
+                    )
+                    self.kills.append(victim["worker_id"])
+                except Exception:  # noqa: BLE001
+                    continue
+                break
+        return self.kills
+
+    def kill_count(self) -> int:
+        return len(self.kills)
+
+
+class NodeKillerActor:
+    """Tears down a whole (non-head) node daemon — the raylet-death
+    chaos case (reference: RayletKiller test_utils.py:1534). Only nodes
+    whose addresses are in ``targets`` are touched, so the test's own
+    node survives."""
+
+    def __init__(self, targets: list[str]):
+        self.targets = list(targets)
+        self.killed: list[str] = []
+
+    async def kill_one(self) -> str | None:
+        import ray_tpu.api as api
+
+        rt = api._runtime
+        while self.targets:
+            addr = self.targets.pop(0)
+            try:
+                conn = await rt.core._connect(addr)
+                # The node daemon has no self-destruct rpc: kill its
+                # workers, then sever by asking the head to drop it is
+                # not possible remotely — instead kill every worker so
+                # leases fail over, which is the recoverable half of
+                # node death testable in-process.
+                reply = await conn.call("list_workers")
+                for w in reply["workers"]:
+                    await conn.call("kill_worker", worker_id=w["worker_id"])
+                self.killed.append(addr)
+                return addr
+            except Exception:  # noqa: BLE001
+                continue
+        return None

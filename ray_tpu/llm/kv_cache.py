@@ -80,9 +80,9 @@ def forward_prefill(
     # of seq), but awkward lengths degrade: gate on the FITTED block
     # being MXU-friendly (>=128, multiple of 8) so prime-ish prompt
     # lengths keep the fused dense path instead of 1-wide Pallas tiles.
-    from ray_tpu.ops.pallas.flash_attention import _fit_block
+    from ray_tpu.ops.pallas.flash_attention import DEFAULT_BLOCK, _fit_block
 
-    _blk = _fit_block(1024, seq)
+    _blk = _fit_block(DEFAULT_BLOCK, seq)
     flash_ok = use_flash and seq >= 512 and _blk >= 128 and _blk % 8 == 0
 
     def attend(q, k, v):

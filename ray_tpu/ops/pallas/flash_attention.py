@@ -352,6 +352,12 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Default tile sizes, tuned on v5e (see flash_attention docstring);
+# exported so gating code derives fitted blocks from the SAME value the
+# kernel will use (llm/kv_cache.py).
+DEFAULT_BLOCK = 1024
+
+
 def _fit_block(requested: int, s: int) -> int:
     """Largest block <= requested that divides s (s itself when s fits).
     Prime-ish lengths collapse to tiny blocks — callers that can choose
@@ -375,11 +381,11 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
-    # 1024/1024 measured fastest on v5e at seq 2048 (27ms vs 36ms
-    # fwd+bwd for the old 256/512 at B16·H16·D64); blocks clamp to the
-    # sequence for short inputs.
-    block_q: int = 1024,
-    block_kv: int = 1024,
+    # DEFAULT_BLOCK (1024/1024) measured fastest on v5e at seq 2048
+    # (27ms vs 36ms fwd+bwd for the old 256/512 at B16·H16·D64); blocks
+    # clamp to the sequence for short inputs.
+    block_q: int = DEFAULT_BLOCK,
+    block_kv: int = DEFAULT_BLOCK,
     interpret: bool = False,
 ) -> jnp.ndarray:
     b, s, h, d = q.shape

@@ -133,6 +133,21 @@ def make_multislice_mesh(
         devices = jax.devices()
     devices = list(devices)
     n = len(devices)
+    # Validate both dicts up front (the multislice path would otherwise
+    # silently drop typo'd axes that the flat path rejects), and refuse
+    # wildcards — a -1 in either factor is ambiguous across the split.
+    for name, sizes in (("ici", ici_axis_sizes), ("dcn", dcn_axis_sizes)):
+        unknown = set(sizes) - set(MESH_AXES)
+        if unknown:
+            raise ValueError(
+                f"unknown {name} mesh axes {sorted(unknown)}; valid: "
+                f"{MESH_AXES}"
+            )
+        if any(int(v) < 1 for v in sizes.values()):
+            raise ValueError(
+                f"{name}_axis_sizes must be explicit positive sizes "
+                f"(no -1 wildcards): {dict(sizes)}"
+            )
     n_slices = len({getattr(d, "slice_index", 0) for d in devices})
     combined = {
         a: int(ici_axis_sizes.get(a, 1)) * int(dcn_axis_sizes.get(a, 1))

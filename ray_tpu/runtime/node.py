@@ -632,6 +632,24 @@ class NodeManager:
         self._kill_worker(worker_id)
         return {"ok": True}
 
+    async def _on_list_workers(self, conn):
+        """Worker inventory for chaos tooling and debugging (reference:
+        the state API's worker table; killers in test_utils.py:1646)."""
+        out = []
+        leased_ids = {
+            lease.worker["worker_id"]: lease.actor
+            for lease in self.leases.values()
+        }
+        for wid, w in self.workers.items():
+            out.append({
+                "worker_id": wid,
+                "pid": w.get("pid"),
+                "state": w.get("state"),
+                "leased": wid in leased_ids,
+                "is_actor": bool(leased_ids.get(wid)),
+            })
+        return {"workers": out}
+
     async def _on_node_info(self, conn):
         return {
             "node_id": self.node_id,

@@ -1,0 +1,102 @@
+"""joblib backend: scikit-learn-style `Parallel` fan-out over the
+cluster (reference: python/ray/util/joblib/ — register_ray registers a
+ray backend so `with parallel_backend("ray"):` runs joblib workloads on
+the cluster).
+
+Usage::
+
+    import joblib
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        joblib.Parallel()(joblib.delayed(f)(x) for x in data)
+"""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import ParallelBackendBase
+
+
+class _Result:
+    """joblib future shim over an ObjectRef."""
+
+    def __init__(self, ref, callback):
+        self._ref = ref
+        self._callback = callback
+
+    def get(self, timeout=None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._ref, timeout=timeout)
+        return out
+
+
+class RayTpuBackend(ParallelBackendBase):
+    """Each joblib batch becomes one cluster task."""
+
+    supports_timeout = True
+    # joblib batches callables itself; nested parallelism stays local.
+    nesting_level = 0
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._task = None
+
+    def effective_n_jobs(self, n_jobs):
+        import ray_tpu
+
+        if n_jobs == 0:
+            raise ValueError("n_jobs == 0 has no meaning")
+        total_cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+        if n_jobs is None or n_jobs == -1:
+            return max(total_cpus, 1)
+        return n_jobs
+
+    def configure(self, n_jobs=1, parallel=None, **kwargs):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+
+        @ray_tpu.remote
+        def _run_joblib_batch(batch):
+            return batch()
+
+        self._task = _run_joblib_batch
+        self.parallel = parallel
+        return self.effective_n_jobs(n_jobs)
+
+    def apply_async(self, func, callback=None):
+        ref = self._task.remote(func)
+        result = _Result(ref, callback)
+        if callback is not None:
+            # joblib drives completion by calling get(); fire the
+            # callback from a tiny waiter thread so dispatch continues.
+            import threading
+
+            def wait():
+                try:
+                    out = result.get()
+                except Exception:  # noqa: BLE001 - surfaced via get()
+                    return
+                callback(out)
+
+            threading.Thread(target=wait, daemon=True).start()
+        return result
+
+    def submit(self, func, callback=None):
+        # joblib >= 1.4 name for apply_async.
+        return self.apply_async(func, callback)
+
+    def abort_everything(self, ensure_ready=True):
+        self._task = None
+        if ensure_ready:
+            self.configure(n_jobs=self.parallel.n_jobs,
+                           parallel=self.parallel)
+
+
+def register_ray_tpu() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -1,0 +1,57 @@
+"""Chaos killers + ecosystem bridges (reference: test_utils killer actors
+:1412/:1534/:1646 and the chaos suites; ray.util.joblib register_ray).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_worker_killer_chaos_tasks_still_complete(cluster):
+    from ray_tpu._private.test_utils import WorkerKillerActor
+
+    Killer = ray_tpu.remote(WorkerKillerActor)
+    killer = Killer.remote(interval_s=0.3, max_kills=2)
+    run_ref = killer.run.remote()
+
+    @ray_tpu.remote(max_retries=5)
+    def slow(i):
+        import time
+
+        time.sleep(0.4)
+        return i * 2
+
+    results = ray_tpu.get([slow.remote(i) for i in range(20)], timeout=180)
+    assert results == [i * 2 for i in range(20)]
+    kills = ray_tpu.get(run_ref, timeout=120)
+    assert len(kills) == 2  # chaos actually happened
+    ray_tpu.kill(killer)
+
+
+def test_joblib_backend(cluster):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        out = joblib.Parallel(n_jobs=4)(
+            joblib.delayed(lambda x: x * x)(i) for i in range(12)
+        )
+    assert out == [i * i for i in range(12)]
+
+
+def test_joblib_effective_n_jobs(cluster):
+    from ray_tpu.util.joblib import RayTpuBackend
+
+    backend = RayTpuBackend()
+    assert backend.effective_n_jobs(-1) >= 4
+    assert backend.effective_n_jobs(2) == 2

@@ -37,11 +37,43 @@ class TokenDataset:
         self._loader = NativeTokenLoader(
             path, seq_len + 1, dtype_bytes=dtype_bytes
         )
+        self.path = path
+        self.dtype = dtype
         self.seq_len = seq_len
         self.seed = seed
         self.shuffle = shuffle
         self._rank, self._world = 0, 1
         self._epoch = 0
+
+    def descriptor(self) -> dict:
+        """Picklable spec: workers re-open their own mmap (loaders hold
+        fds/threads and must not cross process boundaries). Used by
+        JaxTrainer(datasets=...) sharding."""
+        return {
+            "__token_dataset__": {
+                "path": self.path,
+                "seq_len": self.seq_len,
+                "dtype": self.dtype,
+                "seed": self.seed,
+                "shuffle": self.shuffle,
+            }
+        }
+
+    @classmethod
+    def from_descriptor(
+        cls, desc: dict, rank: int = 0, world: int = 1
+    ) -> "TokenDataset":
+        spec = desc["__token_dataset__"]
+        ds = cls(
+            spec["path"],
+            spec["seq_len"],
+            dtype=spec["dtype"],
+            seed=spec["seed"],
+            shuffle=spec["shuffle"],
+        )
+        if world > 1:
+            ds.shard(rank, world)
+        return ds
 
     @property
     def num_samples(self) -> int:

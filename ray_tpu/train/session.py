@@ -66,6 +66,13 @@ def get_dataset_shard(name: str = "train"):
             f"no dataset {name!r}; trainer got "
             f"{sorted(ctx.dataset_shards)}"
         )
+    if isinstance(refs, dict) and "__token_dataset__" in refs:
+        # Native token loader: re-open in this worker, sharded to rank.
+        from ray_tpu.train.dataloader import TokenDataset
+
+        return TokenDataset.from_descriptor(
+            refs, rank=refs.get("rank", 0), world=refs.get("world", 1)
+        )
     from ray_tpu.data.dataset import MaterializedDataset
 
     return MaterializedDataset(list(refs))

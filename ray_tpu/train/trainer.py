@@ -155,9 +155,19 @@ class JaxTrainer:
     def _split_datasets(self, n: int) -> list[dict]:
         """Materialize each dataset and deal its block refs round-robin:
         worker i gets shard dicts {name: [refs]} — refs resolve from any
-        process (ownership model), so shards ship as plain messages."""
+        process (ownership model), so shards ship as plain messages.
+        TokenDatasets (native file loaders) ship as descriptors instead:
+        each worker re-opens its own mmap and takes a (rank, world)
+        stripe of the shuffled permutation."""
+        from ray_tpu.train.dataloader import TokenDataset
+
         shards: list[dict] = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
+            if isinstance(ds, TokenDataset):
+                desc = ds.descriptor()
+                for i in range(n):
+                    shards[i][name] = {**desc, "rank": i, "world": n}
+                continue
             refs = ds.materialize()._refs
             for i in range(n):
                 shards[i][name] = refs[i::n]

@@ -19,27 +19,29 @@ from joblib._parallel_backends import ParallelBackendBase
 
 
 class _Result:
-    """joblib future shim over an ObjectRef."""
+    """joblib future shim over an ObjectRef: task errors surface here,
+    at retrieval (this backend has supports_retrieve_callback=False, so
+    joblib's completion callback is dispatch bookkeeping only)."""
 
-    def __init__(self, ref, callback):
+    def __init__(self, ref):
         self._ref = ref
-        self._callback = callback
 
     def get(self, timeout=None):
         import ray_tpu
 
-        out = ray_tpu.get(self._ref, timeout=timeout)
-        return out
+        return ray_tpu.get(self._ref, timeout=timeout)
 
 
 class RayTpuBackend(ParallelBackendBase):
     """Each joblib batch becomes one cluster task."""
 
     supports_timeout = True
-    # joblib batches callables itself; nested parallelism stays local.
-    nesting_level = 0
 
     def __init__(self, **kwargs):
+        # joblib batches callables itself; nested parallelism inside a
+        # worker falls back to sequential/threading (nesting_level must
+        # reach the base class or get_nested_backend computes None + 1).
+        kwargs.setdefault("nesting_level", 0)
         super().__init__(**kwargs)
         self._task = None
 
@@ -49,8 +51,10 @@ class RayTpuBackend(ParallelBackendBase):
         if n_jobs == 0:
             raise ValueError("n_jobs == 0 has no meaning")
         total_cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
-        if n_jobs is None or n_jobs == -1:
+        if n_jobs is None:
             return max(total_cpus, 1)
+        if n_jobs < 0:  # -1 = all cluster CPUs, -2 = all but one, ...
+            return max(total_cpus + 1 + n_jobs, 1)
         return n_jobs
 
     def configure(self, n_jobs=1, parallel=None, **kwargs):
@@ -69,18 +73,19 @@ class RayTpuBackend(ParallelBackendBase):
 
     def apply_async(self, func, callback=None):
         ref = self._task.remote(func)
-        result = _Result(ref, callback)
+        result = _Result(ref)
         if callback is not None:
-            # joblib drives completion by calling get(); fire the
-            # callback from a tiny waiter thread so dispatch continues.
+            # Without retrieve-callback support the callback is pure
+            # dispatch bookkeeping (BatchCompletionCallBack.__call__ →
+            # _dispatch_new) and must fire on success AND failure —
+            # errors surface later via get() in ordered retrieval.
             import threading
 
             def wait():
                 try:
-                    out = result.get()
-                except Exception:  # noqa: BLE001 - surfaced via get()
-                    return
-                callback(out)
+                    result.get()
+                finally:
+                    callback(result)
 
             threading.Thread(target=wait, daemon=True).start()
         return result
@@ -90,6 +95,9 @@ class RayTpuBackend(ParallelBackendBase):
         return self.apply_async(func, callback)
 
     def abort_everything(self, ensure_ready=True):
+        # In-flight cluster tasks run to completion (the runtime has no
+        # task cancellation yet — ray_tpu.cancel is tracked for a later
+        # round); dropping the handle stops NEW dispatches immediately.
         self._task = None
         if ensure_ready:
             self.configure(n_jobs=self.parallel.n_jobs,

@@ -55,3 +55,29 @@ def test_joblib_effective_n_jobs(cluster):
     backend = RayTpuBackend()
     assert backend.effective_n_jobs(-1) >= 4
     assert backend.effective_n_jobs(2) == 2
+
+
+def test_joblib_error_propagates_without_hanging(cluster):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    def maybe_fail(i):
+        if i == 5:
+            raise ValueError("boom")
+        return i
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        with pytest.raises(Exception):
+            joblib.Parallel(n_jobs=2)(
+                joblib.delayed(maybe_fail)(i) for i in range(10)
+            )
+
+
+def test_joblib_negative_n_jobs(cluster):
+    from ray_tpu.util.joblib import RayTpuBackend
+
+    backend = RayTpuBackend()
+    total = backend.effective_n_jobs(-1)
+    assert backend.effective_n_jobs(-2) == total - 1

@@ -102,3 +102,52 @@ def test_multi_epoch_reshuffles(corpus):
         assert set(order[0].tolist()) == set(order[1].tolist())
     finally:
         ds.close()
+
+
+def test_trainer_token_dataset_integration(tmp_path):
+    """JaxTrainer ships TokenDatasets as descriptors; each worker opens
+    its own mmap and consumes a disjoint (rank, world) stripe."""
+    import ray_tpu
+    from ray_tpu.train import (
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    tokens = np.arange(200 * 9, dtype=np.uint32)
+    path = tmp_path / "train.bin"
+    tokens.tofile(path)
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        def loop(config):
+            import ray_tpu.train as train
+
+            ctx = train.get_context()
+            ds = train.get_dataset_shard("train")
+            starts = []
+            for batch in ds.iter_batches(10):
+                assert batch["tokens"].shape == (10, 9)
+                starts.extend(batch["tokens"][:, 0].tolist())
+            train.report({
+                "rank": ctx.get_world_rank(),
+                "n": len(starts),
+                "starts": sorted(starts),
+            })
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="tok", storage_path=str(tmp_path / "results")
+            ),
+            datasets={
+                "train": TokenDataset(str(path), seq_len=8, seed=3)
+            },
+        )
+        result = trainer.fit()
+        assert result.error is None
+        # Each of the 2 workers saw 100 windows (200 total, disjoint).
+        assert result.metrics["n"] == 100
+    finally:
+        ray_tpu.shutdown()

@@ -244,10 +244,25 @@ def kill(actor: "ActorHandle") -> None:
     _runtime.run(_runtime.core.kill_actor(actor._actor_id, actor._addr))
 
 
-def cancel(ref: ObjectRef, *, force: bool = False) -> None:
-    raise NotImplementedError(
-        "task cancellation is not wired yet (tracked for a later round)"
-    )
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Cancel the NORMAL task producing ``ref`` (reference: ray.cancel,
+    worker.py). Queued tasks fail fast; running tasks are force-killed
+    at the worker (sync execution threads cannot be interrupted — the
+    non-force SIGINT path of the reference has no safe analogue here, so
+    ``force`` is accepted for API compatibility but both modes kill).
+    Returns True if a pending/running task was cancelled; False when the
+    task already finished — or when ``ref`` belongs to an ACTOR method
+    (actor tasks are not cancellable here; kill the actor instead)."""
+
+    async def do():
+        core = _runtime.core
+        if ref.owner_addr in (None, core.addr):
+            return await core.cancel_task(ref.hex)
+        conn = await core._connect(ref.owner_addr)
+        reply = await conn.call("cancel_task", oid_hex=ref.hex)
+        return bool(reply.get("ok"))
+
+    return _runtime.run(do())
 
 
 def available_resources() -> dict:

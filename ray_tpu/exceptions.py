@@ -18,6 +18,10 @@ class WorkerDiedError(RayTpuError):
     """The worker executing a task died (all retries exhausted)."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel()."""
+
+
 class ActorDiedError(RayTpuError):
     """The actor's worker process is gone."""
 

@@ -34,6 +34,7 @@ from ray_tpu.exceptions import (
     GetTimeoutError,
     ObjectLostError,
     RayTaskError,
+    TaskCancelledError,
     WorkerDiedError,
 )
 from ray_tpu.runtime.object_store import ObjectStore
@@ -177,6 +178,12 @@ class CoreWorker:
         self._oid_to_task: dict[str, str] = {}
         # task_id → in-flight reconstruction future (dedupe).
         self._reconstructing: dict[str, asyncio.Future] = {}
+
+        # Cancellation state for normal tasks this process drives:
+        # task_id → {"cancelled": bool, "lease": current lease | None}
+        # (reference: CoreWorker::CancelTask — queued tasks fail fast,
+        # running ones are force-killed at the worker).
+        self._cancel_state: dict[str, dict] = {}
 
         # Task-event buffer, flushed to the head periodically (reference:
         # worker-side TaskEventBuffer core_worker/task_event_buffer.h →
@@ -768,7 +775,11 @@ class CoreWorker:
                 spec, "FAILED" if errored else "FINISHED"
             )
         except Exception as e:  # noqa: BLE001 - becomes the task's result
-            self.record_task_event(spec, "FAILED", error=repr(e))
+            self.record_task_event(
+                spec,
+                "CANCELLED" if isinstance(e, TaskCancelledError) else "FAILED",
+                error=repr(e),
+            )
             for oid_hex in oids:
                 self._store_result(oid_hex, ("error", e))
             if spec.get("streaming"):
@@ -852,6 +863,46 @@ class CoreWorker:
         """Borrower-requested reconstruction: a non-owner whose pull
         failed asks the owner to re-execute the creating task."""
         return {"ok": await self._reconstruct(oid_hex)}
+
+    # ------------------------------------------------------ cancellation
+    async def cancel_task(self, oid_hex: str) -> bool:
+        """Cancel the normal task producing ``oid_hex`` (reference:
+        CoreWorker::CancelTask; python cancel semantics worker.py).
+        Queued tasks fail fast with TaskCancelledError; a running task's
+        worker is force-killed (execution threads cannot be safely
+        interrupted — same as the reference's force path). Returns False
+        when the task already finished."""
+        from ray_tpu._private.ids import TaskID
+
+        task_id = oid_hex[: TaskID.LENGTH * 2]  # return ids embed it
+        state = self._cancel_state.get(task_id)
+        if state is None:
+            return False
+        state["cancelled"] = True
+        lease = state.get("lease")
+        if lease is not None:
+            node_conn = lease.get("node_conn") or self.node
+            if node_conn is not None:
+                try:
+                    await node_conn.call(
+                        "kill_worker", worker_id=lease["worker_id"]
+                    )
+                except (rpc.ConnectionLost, rpc.RpcError):
+                    pass
+        else:
+            # Still queued (possibly blocked on a lease wait that only
+            # resolves when capacity frees): deliver the cancellation to
+            # readers NOW — the drive loop notices and unwinds whenever
+            # its lease finally arrives.
+            err = TaskCancelledError(f"task {task_id[:12]}… was cancelled")
+            for o in state.get("oids") or []:
+                if o not in self.memory:
+                    self._store_result(o, ("error", err))
+        return True
+
+    async def _on_cancel_task(self, conn, oid_hex: str):
+        """Borrower-side cancel routed to the owner."""
+        return {"ok": await self.cancel_task(oid_hex)}
 
     # ------------------------------------------------- tensor transport
     async def _fetch_tensor(self, oid_hex: str, meta: dict, timeout=None):
@@ -1117,44 +1168,70 @@ class CoreWorker:
         runtime_env=None, scheduling=None,
     ):
         last_err: Exception | None = None
-        for attempt in range(retries + 1):
-            lease = None
-            try:
-                if spec.get("streaming"):
-                    # Stamp the attempt so late item reports from a dead
-                    # earlier attempt can't interleave with this one.
-                    spec = {**spec, "attempt": attempt}
-                    self._gen_attempt[spec["task_id"]] = attempt
-                lease = await self._lease(
-                    resources, placement, runtime_env, scheduling
-                )
-                conn = await self._connect(lease["addr"])
-                reply = await conn.call("push_task", spec=spec)
-                return self._apply_reply(reply, oids, spec["task_id"])
-            except (rpc.ConnectionLost, rpc.RpcError) as e:
-                last_err = e
-                if spec.get("streaming") and self._gen_delivered.get(
-                    spec["task_id"], 0
-                ):
-                    # Items were already delivered: a retry would replay
-                    # them. Fail instead (reference: generators restart
-                    # only via lineage reconstruction, not mid-stream).
-                    if getattr(e, "sent", True):
-                        lease = None
-                    break
-                if not getattr(e, "sent", True):
-                    # The request never reached the worker (closed conn
-                    # caught locally, chaos drop): the lease is intact —
-                    # the finally clause returns it for reuse.
-                    continue
-                lease = None  # worker may be gone; do not return the lease
-                continue
-            finally:
-                if lease is not None:
-                    await self._return_lease(lease)
-        raise WorkerDiedError(
-            f"task failed after {retries + 1} attempts: {last_err}"
+        tid = spec["task_id"]
+        state = self._cancel_state.setdefault(
+            tid, {"cancelled": False, "lease": None, "oids": oids}
         )
+        try:
+            for attempt in range(retries + 1):
+                lease = None
+                try:
+                    if state["cancelled"]:
+                        raise TaskCancelledError(
+                            f"task {tid[:12]}… was cancelled"
+                        )
+                    if spec.get("streaming"):
+                        # Stamp the attempt so late item reports from a
+                        # dead earlier attempt can't interleave.
+                        spec = {**spec, "attempt": attempt}
+                        self._gen_attempt[spec["task_id"]] = attempt
+                    lease = await self._lease(
+                        resources, placement, runtime_env, scheduling
+                    )
+                    if state["cancelled"]:  # cancelled while queued
+                        raise TaskCancelledError(
+                            f"task {tid[:12]}… was cancelled"
+                        )
+                    state["lease"] = lease
+                    conn = await self._connect(lease["addr"])
+                    reply = await conn.call("push_task", spec=spec)
+                    return self._apply_reply(reply, oids, spec["task_id"])
+                except (rpc.ConnectionLost, rpc.RpcError) as e:
+                    last_err = e
+                    if state["cancelled"]:
+                        # The kill we issued took the worker down
+                        # mid-push: this is cancellation, not failure —
+                        # never retry.
+                        lease = None
+                        raise TaskCancelledError(
+                            f"task {tid[:12]}… was cancelled while running"
+                        ) from e
+                    if spec.get("streaming") and self._gen_delivered.get(
+                        spec["task_id"], 0
+                    ):
+                        # Items were already delivered: a retry would
+                        # replay them. Fail instead (reference:
+                        # generators restart only via lineage
+                        # reconstruction, not mid-stream).
+                        if getattr(e, "sent", True):
+                            lease = None
+                        break
+                    if not getattr(e, "sent", True):
+                        # The request never reached the worker (closed
+                        # conn caught locally, chaos drop): the lease is
+                        # intact — the finally clause returns it.
+                        continue
+                    lease = None  # worker may be gone; don't return it
+                    continue
+                finally:
+                    state["lease"] = None
+                    if lease is not None:
+                        await self._return_lease(lease)
+            raise WorkerDiedError(
+                f"task failed after {retries + 1} attempts: {last_err}"
+            )
+        finally:
+            self._cancel_state.pop(tid, None)
 
     async def _drive_actor_task(self, spec, oids, actor):
         # Prefer the freshest known address: the actor may have been
@@ -1355,6 +1432,9 @@ class CoreWorker:
                 if not reply.get("ok"):
                     raise rpc.RpcError(reply.get("error", "lease failed"))
                 reply["sched_key"] = key
+                # Locally-granted leases carry their node conn too, so
+                # cancellation can reach the right kill_worker endpoint.
+                reply.setdefault("node_conn", self.node)
                 pool["inflight"] -= 1
                 self._offer_lease(key, reply)
             except Exception as e:  # noqa: BLE001 - propagate to one waiter

@@ -580,7 +580,10 @@ class HeadService:
         }
 
     # ------------------------------------------------- task events/metrics
-    _STATE_RANK = {"SUBMITTED": 0, "RUNNING": 1, "FINISHED": 2, "FAILED": 2}
+    _STATE_RANK = {
+        "SUBMITTED": 0, "RUNNING": 1,
+        "FINISHED": 2, "FAILED": 2, "CANCELLED": 2,
+    }
 
     async def _on_add_task_events(self, conn, events: list):
         for ev in events:

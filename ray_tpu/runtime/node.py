@@ -630,7 +630,20 @@ class NodeManager:
 
     async def _on_kill_worker(self, conn, worker_id: str, force: bool = True):
         self._kill_worker(worker_id)
+        self._release_worker_leases(worker_id)
         return {"ok": True}
+
+    def _release_worker_leases(self, worker_id: str):
+        """Free leases of a worker killed OUTSIDE the reap loop
+        (_kill_worker removes it from the table so the reap loop never
+        sees the death, and lease holders that saw ConnectionLost will
+        not return their lease)."""
+        for lease_id, lease in list(self.leases.items()):
+            if lease.worker["worker_id"] == worker_id:
+                self.leases.pop(lease_id)
+                self._release(lease.resources)
+                self._credit_bundle(lease)
+        self._drain_pending()
 
     async def _on_list_workers(self, conn):
         """Worker inventory for chaos tooling and debugging (reference:
@@ -796,15 +809,7 @@ class NodeManager:
                 self.oom_kills += 1
                 rss = worker_rss_bytes(lease.worker.get("pid") or 0)
                 self._kill_worker(wid)
-                # _kill_worker removes the worker from the table, so the
-                # reap loop will not see this death — release its leases
-                # here.
-                for lease_id, l in list(self.leases.items()):
-                    if l.worker["worker_id"] == wid:
-                        self.leases.pop(lease_id)
-                        self._release(l.resources)
-                        self._credit_bundle(l)
-                self._drain_pending()
+                self._release_worker_leases(wid)
                 if self.head:
                     try:
                         await self.head.call(

@@ -44,6 +44,7 @@ class RayTpuBackend(ParallelBackendBase):
         kwargs.setdefault("nesting_level", 0)
         super().__init__(**kwargs)
         self._task = None
+        self._inflight: list = []  # refs cancelled on abort_everything
 
     def effective_n_jobs(self, n_jobs):
         import ray_tpu
@@ -68,22 +69,27 @@ class RayTpuBackend(ParallelBackendBase):
             return batch()
 
         self._task = _run_joblib_batch
+        self._inflight.clear()
         self.parallel = parallel
         return self.effective_n_jobs(n_jobs)
 
     def apply_async(self, func, callback=None):
         ref = self._task.remote(func)
+        self._inflight.append(ref)
         result = _Result(ref)
         if callback is not None:
             # Without retrieve-callback support the callback is pure
             # dispatch bookkeeping (BatchCompletionCallBack.__call__ →
             # _dispatch_new) and must fire on success AND failure —
-            # errors surface later via get() in ordered retrieval.
+            # errors surface later via get() in ordered retrieval, so
+            # the waiter swallows them (no spurious thread tracebacks).
             import threading
 
             def wait():
                 try:
                     result.get()
+                except Exception:  # noqa: BLE001 - re-raised at retrieval
+                    pass
                 finally:
                     callback(result)
 
@@ -95,9 +101,17 @@ class RayTpuBackend(ParallelBackendBase):
         return self.apply_async(func, callback)
 
     def abort_everything(self, ensure_ready=True):
-        # In-flight cluster tasks run to completion (the runtime has no
-        # task cancellation yet — ray_tpu.cancel is tracked for a later
-        # round); dropping the handle stops NEW dispatches immediately.
+        # Best-effort cancel of every outstanding batch (queued batches
+        # fail fast; running ones are force-killed and their workers
+        # replaced).
+        import ray_tpu
+
+        for ref in self._inflight:
+            try:
+                ray_tpu.cancel(ref)
+            except Exception:  # noqa: BLE001 - already finished etc.
+                pass
+        self._inflight.clear()
         self._task = None
         if ensure_ready:
             self.configure(n_jobs=self.parallel.n_jobs,

@@ -1,0 +1,111 @@
+"""Task cancellation (reference: ray.cancel worker.py semantics — queued
+tasks fail fast with TaskCancelledError, running tasks are force-killed;
+CoreWorker::CancelTask).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=2)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_cancel_running_task(cluster, tmp_path):
+    started = tmp_path / "started"
+
+    @ray_tpu.remote
+    def hang(path):
+        with open(path, "w") as f:
+            f.write("x")
+        time.sleep(60)
+        return "never"
+
+    ref = hang.remote(str(started))
+    deadline = time.time() + 20
+    while time.time() < deadline and not started.exists():
+        time.sleep(0.05)
+    assert started.exists()
+
+    assert ray_tpu.cancel(ref) is True
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_finished_task_returns_false(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 1
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=30) == 1
+    assert ray_tpu.cancel(ref) is False
+    assert ray_tpu.get(ref, timeout=30) == 1  # result untouched
+
+
+def test_cancel_queued_task_never_runs(cluster, tmp_path):
+    marker = tmp_path / "ran"
+
+    @ray_tpu.remote
+    def block():
+        time.sleep(3.0)
+        return "done"
+
+    @ray_tpu.remote
+    def queued(path):
+        with open(path, "w") as f:
+            f.write("x")
+        return "ran"
+
+    # Fill both CPUs, then queue one more and cancel it while queued.
+    blockers = [block.remote() for _ in range(2)]
+    time.sleep(0.3)
+    ref = queued.remote(str(marker))
+    time.sleep(0.2)
+    assert ray_tpu.cancel(ref) is True
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert ray_tpu.get(blockers, timeout=60) == ["done", "done"]
+    assert not marker.exists()
+
+
+def test_cancel_unblocks_get_on_saturated_cluster(cluster):
+    """Cancelling a task stuck waiting for capacity resolves get()
+    IMMEDIATELY — readers must not wait out the blockers."""
+
+    @ray_tpu.remote
+    def long_block():
+        time.sleep(20.0)
+        return "done"
+
+    @ray_tpu.remote
+    def starved():
+        return "ran"
+
+    blockers = [long_block.remote() for _ in range(2)]
+    time.sleep(0.3)
+    ref = starved.remote()
+    time.sleep(0.2)
+    t0 = time.time()
+    assert ray_tpu.cancel(ref) is True
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=10)
+    assert time.time() - t0 < 5  # resolved well before blockers finish
+    # Clean up the blockers so later tests get their CPUs back.
+    for b in blockers:
+        ray_tpu.cancel(b)
+
+
+def test_cluster_still_healthy_after_cancels(cluster):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5

@@ -1,0 +1,113 @@
+"""Central config registry (reference: the RAY_CONFIG X-macro list,
+src/ray/common/ray_config_def.h — 228 typed knobs with env overrides —
+and the `_system_config` dict `ray.init` threads through the GCS,
+gcs_service.proto:642 GetInternalConfig).
+
+Every knob is declared ONCE here with type, default, and doc. Resolution
+order: programmatic override (init(system_config=...)) → environment
+variable ``RAY_TPU_<NAME>`` → default. Worker processes inherit the
+driver's overrides through the environment (set_system_config exports
+them), the same propagation path the reference uses for its serialized
+_system_config."""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+# name → (type, default, doc). The env var is RAY_TPU_<NAME>.
+CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
+    # --- object store / spilling
+    "POOL_BYTES": (int, 0, "shm pool capacity; 0 = auto (30% free, ≤2GiB)"),
+    "DISABLE_NATIVE_STORE": (bool, False, "force the file-per-object store"),
+    "SPILL_HIGH": (float, 0.8, "store usage fraction that triggers spilling"),
+    "SPILL_LOW": (float, 0.5, "spill target usage fraction"),
+    "SPILL_DIR": (str, "", "disk spill directory override"),
+    # --- scheduling / memory
+    "SCHED_TIMEOUT_S": (float, 60.0, "wait for autoscaler before failing "
+                                     "an infeasible lease"),
+    "MEMORY_THRESHOLD": (float, 0.95, "system memory fraction that "
+                                      "triggers the OOM worker killer"),
+    "FAKE_MEMORY_FRAC_FILE": (str, "", "test hook: read memory fraction "
+                                       "from this file"),
+    "FAKE_CHIPS": (str, "", "test hook: report this many TPU chips"),
+    "NODE_LABELS": (str, "", "extra node labels as k=v,k=v"),
+    "WORKER_JAX_PLATFORMS": (str, "cpu", "JAX_PLATFORMS for spawned "
+                                         "workers"),
+    # --- compiled graphs
+    "DAG_BUFFER_SIZE": (int, 256 * 1024, "channel slot capacity (bytes)"),
+    "DAG_MAX_BUFFERED": (int, 8, "max in-flight executions per DAG"),
+    "DAG_GET_TIMEOUT": (float, 30.0, "CompiledDAGRef.get timeout"),
+    "DAG_SUBMIT_TIMEOUT": (float, 30.0, "execute() backpressure timeout"),
+    # --- misc
+    "RPC_FAILURE": (str, "", "chaos spec: method:prob[:mode] list"),
+    "TRACE": (bool, False, "enable span collection in every process"),
+    "ADDRESS": (str, "", "default cluster address for init()"),
+}
+
+_overrides: dict[str, Any] = {}
+
+
+def _coerce(name: str, raw: str) -> Any:
+    typ = CONFIG_DEFS[name][0]
+    if typ is bool:
+        return raw not in ("", "0", "false", "False")
+    return typ(raw)
+
+
+def get(name: str) -> Any:
+    """Resolved value of a knob (override → env → default)."""
+    if name not in CONFIG_DEFS:
+        raise KeyError(
+            f"unknown config {name!r}; known: {sorted(CONFIG_DEFS)}"
+        )
+    if name in _overrides:
+        return _overrides[name]
+    raw = os.environ.get(f"RAY_TPU_{name}")
+    if raw is not None:
+        try:
+            return _coerce(name, raw)
+        except ValueError as e:
+            # Fail LOUD: silently falling back to the default would let
+            # an operator believe a malformed threshold applied.
+            raise ValueError(
+                f"malformed RAY_TPU_{name}={raw!r}: expected "
+                f"{CONFIG_DEFS[name][0].__name__}"
+            ) from e
+    return CONFIG_DEFS[name][1]
+
+
+def set_system_config(config: dict[str, Any]) -> None:
+    """Programmatic overrides (reference: ray.init(_system_config=...)).
+    Also exported to the environment so spawned workers inherit them."""
+    for name, value in config.items():
+        if name not in CONFIG_DEFS:
+            raise KeyError(
+                f"unknown config {name!r}; known: {sorted(CONFIG_DEFS)}"
+            )
+        typ = CONFIG_DEFS[name][0]
+        if isinstance(value, str):
+            # Strings coerce with env semantics ("0"/"false" are falsy
+            # for bool knobs — bool("0") would flip them ON).
+            value = _coerce(name, value)
+        elif not isinstance(value, typ):
+            value = typ(value)
+        _overrides[name] = value
+        os.environ[f"RAY_TPU_{name}"] = (
+            ("1" if value else "0") if typ is bool else str(value)
+        )
+
+
+def describe() -> dict[str, dict]:
+    """Full registry with resolved values (surfaced by the CLI/state
+    API the way the reference exposes GetInternalConfig)."""
+    return {
+        name: {
+            "type": typ.__name__,
+            "default": default,
+            "value": get(name),
+            "doc": doc,
+            "env": f"RAY_TPU_{name}",
+        }
+        for name, (typ, default, doc) in CONFIG_DEFS.items()
+    }

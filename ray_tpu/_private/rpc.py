@@ -35,7 +35,9 @@ def _chaos_drop(method: str) -> bool:
     matching requests before send (reference: rpc_chaos.h:24,
     RAY_testing_rpc_failure ray_config_def.h:850). Read per-call so
     tests can flip it at runtime; method="*" matches everything."""
-    chaos = os.environ.get("RAY_TPU_RPC_FAILURE", "")
+    from ray_tpu._private import config
+
+    chaos = config.get("RPC_FAILURE")
     if not chaos:
         return False
     name, _, prob = chaos.partition(":")

@@ -61,6 +61,7 @@ def init(
     object_store_dir: str | None = None,
     observer: bool = False,
     labels: dict | None = None,
+    _system_config: dict | None = None,
 ) -> dict:
     """Start (or connect to) a cluster and attach this process as driver.
 
@@ -73,11 +74,20 @@ def init(
     """
     if _runtime.ready:
         raise RayTpuError("ray_tpu is already initialized")
+    if _system_config:
+        # Typed overrides of the config registry (reference:
+        # ray.init(_system_config=...) threaded through the GCS); the
+        # env export makes spawned workers inherit them.
+        from ray_tpu._private import config as _config
+
+        _config.set_system_config(_system_config)
     if address is None:
         # Job drivers launched by the job manager inherit the cluster
         # address (reference: RAY_ADDRESS env for `ray job submit`
         # entrypoints).
-        address = os.environ.get("RAY_TPU_ADDRESS") or None
+        from ray_tpu._private import config as _config
+
+        address = _config.get("ADDRESS") or None
     client = False
     if address is not None and address.startswith("ray://"):
         client = True
@@ -178,7 +188,23 @@ def shutdown() -> None:
         # Driver (observer, client) sessions own their store dir; worker
         # processes share their node's and must not delete it.
         _runtime.core.store.destroy()
-    _runtime.loop.call_soon_threadsafe(_runtime.loop.stop)
+    def _drain_and_stop():
+        # Cancel stragglers (serve demand reporters, pollers), then stop
+        # only after their CancelledErrors have actually been delivered
+        # (gather resolves post-delivery) — stopping in the same
+        # iteration would leave them pending and still emit "Task was
+        # destroyed but it is pending!" at interpreter exit.
+        stragglers = list(asyncio.all_tasks(_runtime.loop))
+        for task in stragglers:
+            task.cancel()
+
+        async def _finish():
+            await asyncio.gather(*stragglers, return_exceptions=True)
+            _runtime.loop.stop()
+
+        asyncio.ensure_future(_finish())
+
+    _runtime.loop.call_soon_threadsafe(_drain_and_stop)
     _runtime.thread.join(timeout=5)
     _runtime.__init__()
 

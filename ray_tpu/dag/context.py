@@ -1,23 +1,34 @@
 """DAG execution knobs (reference: python/ray/dag/context.py
-`DAGContext` — buffer size, max buffered results, timeouts; env-var
-overridable the same way)."""
+`DAGContext` — buffer size, max buffered results, timeouts). Values
+resolve through the central config registry at CONSTRUCTION time, so
+``init(_system_config=...)`` overrides apply even when this module was
+imported earlier."""
 
 from __future__ import annotations
 
-import os
-from dataclasses import dataclass
+from dataclasses import dataclass, field
+
+
+def _cfg(name: str):
+    from ray_tpu._private import config
+
+    return config.get(name)
 
 
 @dataclass
 class DAGContext:
-    buffer_size: int = int(
-        os.environ.get("RAY_TPU_DAG_BUFFER_SIZE", 256 * 1024)
+    buffer_size: int = field(
+        default_factory=lambda: _cfg("DAG_BUFFER_SIZE")
     )
-    max_buffered: int = int(os.environ.get("RAY_TPU_DAG_MAX_BUFFERED", 8))
-    submit_timeout: float = float(
-        os.environ.get("RAY_TPU_DAG_SUBMIT_TIMEOUT", 30.0)
+    max_buffered: int = field(
+        default_factory=lambda: _cfg("DAG_MAX_BUFFERED")
     )
-    get_timeout: float = float(os.environ.get("RAY_TPU_DAG_GET_TIMEOUT", 30.0))
+    submit_timeout: float = field(
+        default_factory=lambda: _cfg("DAG_SUBMIT_TIMEOUT")
+    )
+    get_timeout: float = field(
+        default_factory=lambda: _cfg("DAG_GET_TIMEOUT")
+    )
 
     _instance = None
 

@@ -1526,11 +1526,12 @@ class CoreWorker:
         saturated, we keep cycling through its queue indefinitely — a
         busy cluster must not fail queued tasks.
         """
-        import os
         import uuid
 
+        from ray_tpu._private import config
+
         loop = asyncio.get_running_loop()
-        timeout_s = float(os.environ.get("RAY_TPU_SCHED_TIMEOUT_S", "60"))
+        timeout_s = config.get("SCHED_TIMEOUT_S")
         deadline = loop.time() + timeout_s
         requester = uuid.uuid4().hex  # dedups this wait's demand at the head
         while True:

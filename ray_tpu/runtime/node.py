@@ -36,7 +36,9 @@ def system_memory_fraction() -> float:
     reference's MemoryMonitor (reference: memory_monitor.h:52 reads
     cgroup limits before /proc/meminfo). Test override:
     RAY_TPU_FAKE_MEMORY_FRAC_FILE names a file holding a float."""
-    fake = os.environ.get("RAY_TPU_FAKE_MEMORY_FRAC_FILE")
+    from ray_tpu._private import config
+
+    fake = config.get("FAKE_MEMORY_FRAC_FILE")
     if fake:
         try:
             with open(fake) as f:
@@ -80,11 +82,10 @@ def _spill_watermarks() -> tuple[float, float]:
     HIGH the daemon moves cold objects to disk until usage drops below
     LOW (reference: LocalObjectManager triggers spilling at
     object_spilling_threshold, local_object_manager.h:44). Read per
-    tick so per-process env overrides apply."""
-    return (
-        float(os.environ.get("RAY_TPU_SPILL_HIGH", "0.8")),
-        float(os.environ.get("RAY_TPU_SPILL_LOW", "0.5")),
-    )
+    tick so per-process overrides apply."""
+    from ray_tpu._private import config
+
+    return (config.get("SPILL_HIGH"), config.get("SPILL_LOW"))
 
 
 def env_hash(runtime_env: dict | None) -> str:
@@ -106,7 +107,9 @@ def detect_resources() -> dict[str, float]:
     python/ray/_private/accelerators/tpu.py:18–66 TPU_VISIBLE_CHIPS /
     GKE env / chip device files)."""
     resources: dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
-    n_tpu = os.environ.get("RAY_TPU_FAKE_CHIPS")
+    from ray_tpu._private import config
+
+    n_tpu = config.get("FAKE_CHIPS") or None
     if n_tpu is not None:
         resources["TPU"] = float(n_tpu)
         return resources
@@ -796,7 +799,9 @@ class NodeManager:
         worker_killing_policy.h:33). Policy: newest NON-ACTOR lease
         first — its task is retriable and has lost the least work;
         actors are last resorts (their state dies with them)."""
-        threshold = float(os.environ.get("RAY_TPU_MEMORY_THRESHOLD", "0.95"))
+        from ray_tpu._private import config
+
+        threshold = config.get("MEMORY_THRESHOLD")
         while True:
             await asyncio.sleep(1.0)
             try:
@@ -899,7 +904,9 @@ def detect_labels() -> dict[str, str]:
     become labels, accelerators/tpu.py:18–66 + util/tpu.py slice labels;
     RAY_TPU_NODE_LABELS carries user labels as k=v,k=v)."""
     labels: dict[str, str] = {}
-    env = os.environ.get("RAY_TPU_NODE_LABELS", "")
+    from ray_tpu._private import config
+
+    env = config.get("NODE_LABELS")
     for pair in env.split(","):
         if "=" in pair:
             k, v = pair.split("=", 1)
@@ -918,4 +925,6 @@ def detect_labels() -> dict[str, str]:
 def env_jax_platform() -> str:
     # Worker processes default to CPU JAX; TPU-holding workers are
     # configured explicitly by the trainer/collective layer.
-    return os.environ.get("RAY_TPU_WORKER_JAX_PLATFORMS", "cpu")
+    from ray_tpu._private import config
+
+    return config.get("WORKER_JAX_PLATFORMS")

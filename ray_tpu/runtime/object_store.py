@@ -80,8 +80,10 @@ class ObjectStore:
         # that a zero-copy deserialized value still aliases (the pin
         # drops on release()/delete(), or with the view's finalizer).
         self._views: dict[ObjectID, object] = {}
+        from ray_tpu._private import config
+
         self.pool = None
-        if os.environ.get("RAY_TPU_DISABLE_NATIVE_STORE") != "1":
+        if not config.get("DISABLE_NATIVE_STORE"):
             try:
                 from ray_tpu._native.shmstore import ShmPool
 
@@ -104,7 +106,7 @@ class ObjectStore:
         # io workers, local_object_manager.h:44). Every process of the
         # session derives the same path from the store dir name.
         self.spill_dir = Path(
-            os.environ.get("RAY_TPU_SPILL_DIR")
+            config.get("SPILL_DIR")
             or os.path.join(
                 tempfile.gettempdir(), f"{self.dir.name}-spill"
             )
@@ -378,9 +380,11 @@ def segment_window(view, offset: int, size: int) -> bytes:
 
 
 def _pool_capacity(directory: Path) -> int:
-    env = os.environ.get("RAY_TPU_POOL_BYTES")
-    if env:
-        return int(env)
+    from ray_tpu._private import config
+
+    override = config.get("POOL_BYTES")
+    if override:
+        return int(override)
     try:
         st = os.statvfs(directory)
         free = st.f_bavail * st.f_frsize

@@ -17,7 +17,9 @@ def _connect(address: str | None):
 
     import ray_tpu
 
-    address = address or os.environ.get("RAY_TPU_ADDRESS")
+    from ray_tpu._private import config
+
+    address = address or config.get("ADDRESS") or None
     if not address:
         # Booting a fresh cluster just to inspect it would print a
         # plausible-looking answer about the wrong cluster (reference:
@@ -110,6 +112,21 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_config(args) -> int:
+    """Print the config registry with resolved values (reference: the
+    internal-config surface of GetInternalConfig)."""
+    from ray_tpu._private import config
+
+    for name, info in sorted(config.describe().items()):
+        mark = "*" if info["value"] != info["default"] else " "
+        print(
+            f"{mark} {info['env']:<34} {info['type']:<6} "
+            f"value={info['value']!r:<12} default={info['default']!r:<10} "
+            f"{info['doc']}"
+        )
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", default=None, help="head address host:port")
@@ -127,6 +144,7 @@ def main(argv=None) -> int:
     sub.add_parser("metrics")
     dp = sub.add_parser("dashboard")
     dp.add_argument("--port", type=int, default=8265)
+    sub.add_parser("config")
 
     args = p.parse_args(argv)
     return {
@@ -135,6 +153,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "metrics": cmd_metrics,
         "dashboard": cmd_dashboard,
+        "config": cmd_config,
     }[args.cmd](args)
 
 

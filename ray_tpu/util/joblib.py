@@ -23,13 +23,19 @@ class _Result:
     at retrieval (this backend has supports_retrieve_callback=False, so
     joblib's completion callback is dispatch bookkeeping only)."""
 
-    def __init__(self, ref):
+    def __init__(self, ref, on_done=None):
         self._ref = ref
+        self._on_done = on_done
 
     def get(self, timeout=None):
         import ray_tpu
 
-        return ray_tpu.get(self._ref, timeout=timeout)
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            if self._on_done is not None:
+                self._on_done()
+                self._on_done = None
 
 
 class RayTpuBackend(ParallelBackendBase):
@@ -44,7 +50,9 @@ class RayTpuBackend(ParallelBackendBase):
         kwargs.setdefault("nesting_level", 0)
         super().__init__(**kwargs)
         self._task = None
-        self._inflight: list = []  # refs cancelled on abort_everything
+        # Refs still outstanding (pruned on completion so an abort near
+        # the end of a long run cancels only live batches).
+        self._inflight: set = set()
 
     def effective_n_jobs(self, n_jobs):
         import ray_tpu
@@ -75,8 +83,8 @@ class RayTpuBackend(ParallelBackendBase):
 
     def apply_async(self, func, callback=None):
         ref = self._task.remote(func)
-        self._inflight.append(ref)
-        result = _Result(ref)
+        self._inflight.add(ref)
+        result = _Result(ref, on_done=lambda: self._inflight.discard(ref))
         if callback is not None:
             # Without retrieve-callback support the callback is pure
             # dispatch bookkeeping (BatchCompletionCallBack.__call__ →

@@ -41,7 +41,9 @@ def disable_tracing() -> None:
 
 
 def is_tracing_enabled() -> bool:
-    return _enabled or os.environ.get("RAY_TPU_TRACE") == "1"
+    from ray_tpu._private import config
+
+    return _enabled or config.get("TRACE")
 
 
 def current_context() -> tuple[str, str] | None:

@@ -1,0 +1,106 @@
+"""Config registry (reference: RAY_CONFIG X-macro list
+ray_config_def.h + ray.init(_system_config=...) propagation)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu._private import config
+
+
+def test_defaults_and_env_override(monkeypatch):
+    assert config.get("SPILL_HIGH") == 0.8
+    monkeypatch.setenv("RAY_TPU_SPILL_HIGH", "0.42")
+    assert config.get("SPILL_HIGH") == 0.42
+    monkeypatch.setenv("RAY_TPU_DISABLE_NATIVE_STORE", "1")
+    assert config.get("DISABLE_NATIVE_STORE") is True
+    monkeypatch.setenv("RAY_TPU_DISABLE_NATIVE_STORE", "0")
+    assert config.get("DISABLE_NATIVE_STORE") is False
+
+
+def test_malformed_env_fails_loud(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_MEMORY_THRESHOLD", "95%")
+    with pytest.raises(ValueError, match="malformed"):
+        config.get("MEMORY_THRESHOLD")
+
+
+def test_bool_string_system_config_coerces(monkeypatch):
+    import os
+
+    try:
+        config.set_system_config({"DISABLE_NATIVE_STORE": "0"})
+        assert config.get("DISABLE_NATIVE_STORE") is False
+        assert os.environ["RAY_TPU_DISABLE_NATIVE_STORE"] == "0"
+    finally:
+        config._overrides.clear()
+        os.environ.pop("RAY_TPU_DISABLE_NATIVE_STORE", None)
+
+
+def test_unknown_knob_rejected():
+    with pytest.raises(KeyError):
+        config.get("NOT_A_KNOB")
+    with pytest.raises(KeyError):
+        config.set_system_config({"NOT_A_KNOB": 1})
+
+
+def test_system_config_overrides_and_exports(monkeypatch):
+    import os
+
+    try:
+        config.set_system_config({"SCHED_TIMEOUT_S": 12.5})
+        assert config.get("SCHED_TIMEOUT_S") == 12.5
+        # Exported so spawned workers inherit it.
+        assert os.environ["RAY_TPU_SCHED_TIMEOUT_S"] == "12.5"
+    finally:
+        config._overrides.clear()
+        os.environ.pop("RAY_TPU_SCHED_TIMEOUT_S", None)
+
+
+def test_init_system_config_reaches_runtime(tmp_path):
+    """init(_system_config=...) steers a real knob: aggressive spill
+    watermarks make the daemon spill immediately."""
+    import time
+
+    import numpy as np
+
+    import ray_tpu
+
+    spill_dir = tmp_path / "spill"
+    ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "SPILL_HIGH": 0.0,
+            "SPILL_LOW": 0.0,
+            "SPILL_DIR": str(spill_dir),
+        },
+    )
+    try:
+        ray_tpu.put(np.ones(200_000))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if spill_dir.exists() and any(spill_dir.iterdir()):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("system_config spill override never applied")
+    finally:
+        ray_tpu.shutdown()
+        from ray_tpu._private.config import _overrides
+
+        _overrides.clear()
+        for key in ("RAY_TPU_SPILL_HIGH", "RAY_TPU_SPILL_LOW",
+                    "RAY_TPU_SPILL_DIR"):
+            import os
+
+            os.environ.pop(key, None)
+
+
+def test_cli_config_lists_registry():
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts", "config"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0
+    assert "RAY_TPU_SPILL_HIGH" in out.stdout
+    assert "RAY_TPU_SCHED_TIMEOUT_S" in out.stdout

@@ -1,0 +1,56 @@
+"""TPU accelerator manager (reference:
+python/ray/_private/accelerators/tpu.py:18–66 — TPU_VISIBLE_CHIPS, GKE
+env vars, devfs chip files; topology env vars become labels the way
+util/tpu.py slice scheduling expects)."""
+
+from __future__ import annotations
+
+import glob
+import os
+
+from ray_tpu._private.accelerators.accelerator import AcceleratorManager
+
+
+class TPUAcceleratorManager(AcceleratorManager):
+    def resource_name(self) -> str:
+        return "TPU"
+
+    def detect_count(self) -> int:
+        from ray_tpu._private import config
+
+        fake = config.get("FAKE_CHIPS")
+        if fake != "":  # "0" is a valid fake (simulate a chipless host)
+            return int(fake)
+        visible = os.environ.get("TPU_VISIBLE_CHIPS")
+        if visible is None:
+            visible = os.environ.get("TPU_VISIBLE_DEVICES")
+        if visible is not None:
+            # "" means explicitly zero visible chips.
+            return len([c for c in visible.split(",") if c])
+        try:
+            chips = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
+            chips = [c for c in chips if c != "/dev/vfio/vfio"]
+            if chips:
+                return len(chips)
+        except OSError:
+            pass
+        # The axon tunnel exposes one chip without devfs entries; report
+        # it from the env marker only (never by initializing a backend).
+        if "axon" in os.environ.get("JAX_PLATFORMS", ""):
+            return 1
+        return 0
+
+    def detect_labels(self) -> dict[str, str]:
+        labels: dict[str, str] = {}
+        for var, label in (
+            ("TPU_ACCELERATOR_TYPE", "ray_tpu.io/accelerator-type"),
+            ("TPU_WORKER_ID", "ray_tpu.io/tpu-worker-id"),
+            ("TPU_NAME", "ray_tpu.io/tpu-slice-name"),
+        ):
+            val = os.environ.get(var)
+            if val:
+                labels[label] = val
+        return labels
+
+    def visibility_env(self, ids: list[int]) -> dict[str, str]:
+        return {"TPU_VISIBLE_CHIPS": ",".join(str(i) for i in ids)}

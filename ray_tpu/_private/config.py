@@ -80,11 +80,15 @@ def get(name: str) -> Any:
 def set_system_config(config: dict[str, Any]) -> None:
     """Programmatic overrides (reference: ray.init(_system_config=...)).
     Also exported to the environment so spawned workers inherit them."""
+    unknown = set(config) - set(CONFIG_DEFS)
+    if unknown:
+        # Validate the WHOLE dict before applying anything: a partial
+        # apply would leave overrides (and env exports) behind after the
+        # error.
+        raise KeyError(
+            f"unknown config {sorted(unknown)}; known: {sorted(CONFIG_DEFS)}"
+        )
     for name, value in config.items():
-        if name not in CONFIG_DEFS:
-            raise KeyError(
-                f"unknown config {name!r}; known: {sorted(CONFIG_DEFS)}"
-            )
         typ = CONFIG_DEFS[name][0]
         if isinstance(value, str):
             # Strings coerce with env semantics ("0"/"false" are falsy
@@ -101,13 +105,19 @@ def set_system_config(config: dict[str, Any]) -> None:
 def describe() -> dict[str, dict]:
     """Full registry with resolved values (surfaced by the CLI/state
     API the way the reference exposes GetInternalConfig)."""
-    return {
-        name: {
+    out = {}
+    for name, (typ, default, doc) in CONFIG_DEFS.items():
+        try:
+            value = get(name)
+        except ValueError as e:
+            # The registry listing must render even with a malformed
+            # env var — that is exactly when an operator needs it.
+            value = f"<{e}>"
+        out[name] = {
             "type": typ.__name__,
             "default": default,
-            "value": get(name),
+            "value": value,
             "doc": doc,
             "env": f"RAY_TPU_{name}",
         }
-        for name, (typ, default, doc) in CONFIG_DEFS.items()
-    }
+    return out

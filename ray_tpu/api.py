@@ -203,6 +203,9 @@ def shutdown() -> None:
             _runtime.loop.stop()
 
         asyncio.ensure_future(_finish())
+        # Bounded drain: a straggler that absorbs cancellation must not
+        # hold the loop (and the join below) hostage.
+        _runtime.loop.call_later(3.0, _runtime.loop.stop)
 
     _runtime.loop.call_soon_threadsafe(_drain_and_stop)
     _runtime.thread.join(timeout=5)

@@ -102,40 +102,13 @@ def env_hash(runtime_env: dict | None) -> str:
 def detect_resources() -> dict[str, float]:
     """Detect node resources WITHOUT initializing a JAX backend: grabbing
     jax.devices() here would lock the TPU chip into the daemon process
-    (and hang if another process holds the tunnel). Mirrors the
-    reference's passive detection via env vars and devfs (reference:
-    python/ray/_private/accelerators/tpu.py:18–66 TPU_VISIBLE_CHIPS /
-    GKE env / chip device files)."""
+    (and hang if another process holds the tunnel). Accelerators come
+    from the plugin registry (reference: per-vendor AcceleratorManagers,
+    python/ray/_private/accelerators/)."""
+    from ray_tpu._private.accelerators import detect_accelerator_resources
+
     resources: dict[str, float] = {"CPU": float(os.cpu_count() or 1)}
-    from ray_tpu._private import config
-
-    n_tpu = config.get("FAKE_CHIPS") or None
-    if n_tpu is not None:
-        resources["TPU"] = float(n_tpu)
-        return resources
-    visible = os.environ.get("TPU_VISIBLE_CHIPS")
-    if visible is None:
-        visible = os.environ.get("TPU_VISIBLE_DEVICES")
-    if visible is not None:
-        # "" means explicitly zero visible chips — do not fall through.
-        n = len([c for c in visible.split(",") if c])
-        if n:
-            resources["TPU"] = float(n)
-        return resources
-    try:
-        import glob
-
-        chips = glob.glob("/dev/accel*") or glob.glob("/dev/vfio/*")
-        chips = [c for c in chips if c != "/dev/vfio/vfio"]
-        if chips:
-            resources["TPU"] = float(len(chips))
-            return resources
-    except OSError:
-        pass
-    # The axon tunnel exposes one chip without devfs entries; report it
-    # from the env marker only (never by initializing the backend).
-    if "axon" in os.environ.get("JAX_PLATFORMS", ""):
-        resources["TPU"] = 1.0
+    resources.update(detect_accelerator_resources())
     return resources
 
 
@@ -900,25 +873,19 @@ class NodeManager:
 
 
 def detect_labels() -> dict[str, str]:
-    """Node labels from the environment (reference: TPU topology env vars
-    become labels, accelerators/tpu.py:18–66 + util/tpu.py slice labels;
-    RAY_TPU_NODE_LABELS carries user labels as k=v,k=v)."""
-    labels: dict[str, str] = {}
+    """Node labels: accelerator topology from the plugin registry
+    (reference: TPU env vars become labels, accelerators/tpu.py:18–66 +
+    util/tpu.py slice labels) plus user labels from RAY_TPU_NODE_LABELS
+    (k=v,k=v)."""
     from ray_tpu._private import config
+    from ray_tpu._private.accelerators import detect_accelerator_labels
 
-    env = config.get("NODE_LABELS")
-    for pair in env.split(","):
+    labels: dict[str, str] = {}
+    for pair in config.get("NODE_LABELS").split(","):
         if "=" in pair:
             k, v = pair.split("=", 1)
             labels[k.strip()] = v.strip()
-    for var, label in (
-        ("TPU_ACCELERATOR_TYPE", "ray_tpu.io/accelerator-type"),
-        ("TPU_WORKER_ID", "ray_tpu.io/tpu-worker-id"),
-        ("TPU_NAME", "ray_tpu.io/tpu-slice-name"),
-    ):
-        val = os.environ.get(var)
-        if val:
-            labels[label] = val
+    labels.update(detect_accelerator_labels())
     return labels
 
 

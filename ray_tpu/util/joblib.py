@@ -29,13 +29,24 @@ class _Result:
 
     def get(self, timeout=None):
         import ray_tpu
+        from ray_tpu.exceptions import GetTimeoutError
 
         try:
-            return ray_tpu.get(self._ref, timeout=timeout)
-        finally:
-            if self._on_done is not None:
-                self._on_done()
-                self._on_done = None
+            out = ray_tpu.get(self._ref, timeout=timeout)
+        except GetTimeoutError:
+            # Still running: keep it in the backend's inflight set so a
+            # following abort_everything can cancel it.
+            raise
+        except Exception:
+            self._done()
+            raise
+        self._done()
+        return out
+
+    def _done(self):
+        if self._on_done is not None:
+            self._on_done()
+            self._on_done = None
 
 
 class RayTpuBackend(ParallelBackendBase):
@@ -114,7 +125,9 @@ class RayTpuBackend(ParallelBackendBase):
         # replaced).
         import ray_tpu
 
-        for ref in self._inflight:
+        # Snapshot: completion callbacks discard from the set
+        # concurrently (daemon wait threads).
+        for ref in list(self._inflight):
             try:
                 ray_tpu.cancel(ref)
             except Exception:  # noqa: BLE001 - already finished etc.

@@ -2,6 +2,8 @@
 :1412/:1534/:1646 and the chaos suites; ray.util.joblib register_ray).
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -81,3 +83,34 @@ def test_joblib_negative_n_jobs(cluster):
     backend = RayTpuBackend()
     total = backend.effective_n_jobs(-1)
     assert backend.effective_n_jobs(-2) == total - 1
+
+
+def test_tqdm_ray_reports_progress(cluster, capfd):
+    import io
+
+    from ray_tpu.experimental import tqdm_ray
+
+    sink = io.StringIO()
+    tqdm_ray.enable_display(out=sink)
+
+    @ray_tpu.remote
+    def work(n):
+        bar = tqdm_ray.tqdm(
+            range(n), desc="work", flush_interval_s=0.0
+        )
+        total = 0
+        for i in bar:
+            total += i
+        return total
+
+    assert ray_tpu.get(work.remote(10), timeout=60) == 45
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        text = sink.getvalue()
+        if "done" in text and "[work]" in text:
+            break
+        time.sleep(0.2)
+    else:
+        pytest.fail(f"no progress rendered: {sink.getvalue()!r}")
+    assert "10/10" in sink.getvalue()
+

@@ -21,8 +21,3 @@ class AcceleratorManager:
     def detect_labels(self) -> dict[str, str]:
         """Topology labels for the node (slice name, worker id, ...)."""
         return {}
-
-    def visibility_env(self, ids: list[int]) -> dict[str, str]:
-        """Env vars that restrict a worker to the given device ids
-        (reference: CUDA_VISIBLE_DEVICES / TPU_VISIBLE_CHIPS)."""
-        return {}

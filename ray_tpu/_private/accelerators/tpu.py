@@ -51,6 +51,3 @@ class TPUAcceleratorManager(AcceleratorManager):
             if val:
                 labels[label] = val
         return labels
-
-    def visibility_env(self, ids: list[int]) -> dict[str, str]:
-        return {"TPU_VISIBLE_CHIPS": ",".join(str(i) for i in ids)}

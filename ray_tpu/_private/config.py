@@ -82,12 +82,13 @@ def set_system_config(config: dict[str, Any]) -> None:
     Also exported to the environment so spawned workers inherit them."""
     unknown = set(config) - set(CONFIG_DEFS)
     if unknown:
-        # Validate the WHOLE dict before applying anything: a partial
-        # apply would leave overrides (and env exports) behind after the
-        # error.
         raise KeyError(
             f"unknown config {sorted(unknown)}; known: {sorted(CONFIG_DEFS)}"
         )
+    # Coerce EVERYTHING before applying anything: a name or value error
+    # mid-apply must not leave earlier overrides (and env exports)
+    # behind.
+    coerced: dict[str, Any] = {}
     for name, value in config.items():
         typ = CONFIG_DEFS[name][0]
         if isinstance(value, str):
@@ -96,9 +97,13 @@ def set_system_config(config: dict[str, Any]) -> None:
             value = _coerce(name, value)
         elif not isinstance(value, typ):
             value = typ(value)
+        coerced[name] = value
+    for name, value in coerced.items():
         _overrides[name] = value
         os.environ[f"RAY_TPU_{name}"] = (
-            ("1" if value else "0") if typ is bool else str(value)
+            ("1" if value else "0")
+            if CONFIG_DEFS[name][0] is bool
+            else str(value)
         )
 
 

@@ -42,10 +42,14 @@ class tqdm:
 
     # -- protocol ------------------------------------------------------
     def __iter__(self):
-        for item in self._iterable:
-            yield item
-            self.update(1)
-        self.close()
+        try:
+            for item in self._iterable:
+                yield item
+                self.update(1)
+        finally:
+            # Runs on break/exception too (GeneratorExit lands at the
+            # yield) so the display always sees the bar finish.
+            self.close()
 
     def update(self, n: int = 1):
         self.n += n
@@ -71,6 +75,8 @@ class tqdm:
     # -- transport -----------------------------------------------------
     def _publish(self, done: bool):
         try:
+            import asyncio
+
             import ray_tpu.api as api
 
             rt = api._runtime
@@ -84,10 +90,17 @@ class tqdm:
                 "done": done,
                 "src": rt.core.addr,
             }
-            rt.run(
-                rt.core.head.call("publish", channel="tqdm", msg=msg),
-                timeout=5,
-            )
+            coro = rt.core.head.call("publish", channel="tqdm", msg=msg)
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is rt.loop:
+                # Already ON the runtime loop (async actor/task code):
+                # blocking here would deadlock — fire and forget.
+                asyncio.ensure_future(coro)
+            else:
+                rt.run(coro, timeout=5)
         except Exception:  # noqa: BLE001 - progress is best-effort
             pass
 

@@ -36,6 +36,7 @@ from ray_tpu.serve.config import AutoscalingConfig
 from ray_tpu.serve.context import get_multiplexed_model_id
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import multiplexed
+from ray_tpu.serve.rpc_ingress import RpcIngressActor, rpc_request
 
 __all__ = [
     "AutoscalingConfig",
@@ -48,6 +49,8 @@ __all__ = [
     "get_deployment_handle",
     "get_multiplexed_model_id",
     "multiplexed",
+    "RpcIngressActor",
+    "rpc_request",
     "run",
     "shutdown",
     "start_http",

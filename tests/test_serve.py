@@ -210,3 +210,32 @@ def test_delete_application(serve_cluster):
     assert "delete_me" in serve.status()
     serve.delete("delete_me")
     assert "delete_me" not in serve.status()
+
+
+def test_rpc_ingress(serve_cluster):
+    """The native-rpc ingress (gRPC-proxy analogue) routes by deployment
+    name and method, no HTTP involved."""
+
+    @serve.deployment
+    class Calc:
+        def __call__(self, x):
+            return x + 1
+
+        def mul(self, x, y):
+            return x * y
+
+    serve.run(Calc.bind(), name="rpcapp")
+
+    Ingress = ray_tpu.remote(serve.RpcIngressActor)
+    ingress = Ingress.remote()
+    addr = ray_tpu.get(ingress.start.remote(), timeout=60)
+
+    assert serve.rpc_request(addr, "Calc", 41, app="rpcapp") == 42
+    assert serve.rpc_request(
+        addr, "Calc", 6, 7, app="rpcapp", method="mul"
+    ) == 42
+    with pytest.raises(RuntimeError, match="ingress"):
+        serve.rpc_request(addr, "Nope", 1, app="rpcapp")
+    ray_tpu.get(ingress.shutdown.remote(), timeout=30)
+    ray_tpu.kill(ingress)
+    serve.delete("rpcapp")

@@ -1196,7 +1196,10 @@ class CoreWorker:
                     conn = await self._connect(lease["addr"])
                     reply = await conn.call("push_task", spec=spec)
                     return self._apply_reply(reply, oids, spec["task_id"])
-                except (rpc.ConnectionLost, rpc.RpcError) as e:
+                except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
+                    # OSError: connect() translates ConnectionError but a
+                    # dead peer can still surface other socket errors —
+                    # they mean the same thing here (worker unreachable).
                     last_err = e
                     if state["cancelled"]:
                         # The kill we issued took the worker down
@@ -1246,7 +1249,7 @@ class CoreWorker:
                     "actor_call", spec=spec, actor_id=actor.actor_id
                 )
                 return self._apply_reply(reply, oids, spec["task_id"])
-            except (rpc.ConnectionLost, rpc.RpcError) as e:
+            except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
                 failure = e
                 if not getattr(e, "sent", True):
                     # Never reached the wire (chaos drop / locally-closed

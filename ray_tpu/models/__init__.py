@@ -1,5 +1,6 @@
-"""Model zoo: flagship Llama-3-style decoder (GQA + SwiGLU + RoPE), plus
-smaller configs for tests and single-chip benchmarks."""
+"""Model zoo: flagship Llama-3-style decoder (GQA + SwiGLU + RoPE), MoE
+(ray_tpu.models.moe), and ResNet vision models (ray_tpu.models.resnet),
+plus smaller configs for tests and single-chip benchmarks."""
 
 from ray_tpu.models.llama import (
     LlamaConfig,
@@ -8,10 +9,14 @@ from ray_tpu.models.llama import (
     init_params,
     param_logical_axes,
 )
+from ray_tpu.models.resnet import ResNetConfig
+from ray_tpu.models.resnet import PRESETS as RESNET_PRESETS
 
 __all__ = [
     "LlamaConfig",
     "PRESETS",
+    "RESNET_PRESETS",
+    "ResNetConfig",
     "forward",
     "init_params",
     "param_logical_axes",

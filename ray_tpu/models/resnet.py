@@ -1,0 +1,201 @@
+"""ResNet for image classification (BASELINE config 2: JaxTrainer DP
+ResNet/CIFAR on v5e-8; reference counterpart: the torch ResNet examples
+under python/ray/train/examples/).
+
+TPU-first choices: convs in bf16 feed the MXU via
+``lax.conv_general_dilated`` in NHWC (the TPU-native layout); GroupNorm
+instead of BatchNorm so the model is a pure function of (params, batch)
+— no mutable running stats to thread through pjit, and normalization is
+independent of the per-chip batch split under data parallelism (BN
+would silently change semantics with the dp shard size)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (2, 2, 2, 2)  # ResNet-18 layout
+    widths: tuple = (64, 128, 256, 512)
+    num_classes: int = 10
+    groups: int = 32  # GroupNorm groups (clamped per width)
+    dtype: Any = jnp.bfloat16
+    stem_kernel: int = 3  # 3 for CIFAR-sized inputs, 7 for ImageNet
+    stem_stride: int = 1  # 2 + stem_pool for the ImageNet 4x stem
+    stem_pool: bool = False  # stride-2 3x3 maxpool after the stem
+    bottleneck: bool = False  # True → 3-layer blocks (ResNet-50 style)
+
+    @property
+    def stem_width(self) -> int:
+        return 64 if self.bottleneck else self.widths[0]
+
+    def num_params(self) -> int:
+        shapes = jax.eval_shape(
+            lambda k: init_params(k, self), jax.random.key(0)
+        )
+        return sum(int(jnp.size(p)) for p in jax.tree.leaves(shapes))
+
+
+PRESETS = {
+    "resnet18": ResNetConfig(),
+    "resnet50": ResNetConfig(
+        stage_sizes=(3, 4, 6, 3),
+        widths=(256, 512, 1024, 2048),
+        bottleneck=True,
+        stem_kernel=7,
+        stem_stride=2,  # + maxpool = the canonical 4x ImageNet stem
+        stem_pool=True,
+        num_classes=1000,
+    ),
+    # Tiny config for unit tests / dry runs.
+    "tiny": ResNetConfig(
+        stage_sizes=(1, 1), widths=(8, 16), groups=4, num_classes=10
+    ),
+}
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    scale = jnp.sqrt(2.0 / (kh * kw * cin))
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * scale
+
+
+def _conv(p, x, stride=1, dtype=jnp.bfloat16):
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        p.astype(dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _gn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _group_norm(p, x, groups):
+    b, h, w, c = x.shape
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    xf = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * jax.lax.rsqrt(var + 1e-5)
+    xf = xf.reshape(b, h, w, c)
+    return (xf * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _block_init(key, cin, cout, cfg: ResNetConfig):
+    keys = jax.random.split(key, 4)
+    if cfg.bottleneck:
+        mid = cout // 4
+        p = {
+            "conv1": _conv_init(keys[0], 1, 1, cin, mid),
+            "gn1": _gn_init(mid),
+            "conv2": _conv_init(keys[1], 3, 3, mid, mid),
+            "gn2": _gn_init(mid),
+            "conv3": _conv_init(keys[2], 1, 1, mid, cout),
+            "gn3": _gn_init(cout),
+        }
+    else:
+        p = {
+            "conv1": _conv_init(keys[0], 3, 3, cin, cout),
+            "gn1": _gn_init(cout),
+            "conv2": _conv_init(keys[1], 3, 3, cout, cout),
+            "gn2": _gn_init(cout),
+        }
+    if cin != cout:
+        p["proj"] = _conv_init(keys[3], 1, 1, cin, cout)
+        p["gn_proj"] = _gn_init(cout)
+    return p
+
+
+def _block_apply(p, x, stride, cfg: ResNetConfig):
+    dtype = cfg.dtype
+    residual = x
+    if cfg.bottleneck:
+        y = _conv(p["conv1"], x, 1, dtype)
+        y = jax.nn.relu(_group_norm(p["gn1"], y, cfg.groups))
+        y = _conv(p["conv2"], y, stride, dtype)
+        y = jax.nn.relu(_group_norm(p["gn2"], y, cfg.groups))
+        y = _conv(p["conv3"], y, 1, dtype)
+        y = _group_norm(p["gn3"], y, cfg.groups)
+    else:
+        y = _conv(p["conv1"], x, stride, dtype)
+        y = jax.nn.relu(_group_norm(p["gn1"], y, cfg.groups))
+        y = _conv(p["conv2"], y, 1, dtype)
+        y = _group_norm(p["gn2"], y, cfg.groups)
+    if "proj" in p or stride != 1:
+        if "proj" in p:
+            residual = _conv(p["proj"], residual, stride, dtype)
+            residual = _group_norm(p["gn_proj"], residual, cfg.groups)
+        else:  # same width, spatial downsample only
+            residual = residual[:, ::stride, ::stride, :]
+    return jax.nn.relu(y + residual.astype(y.dtype))
+
+
+def init_params(key, cfg: ResNetConfig) -> Params:
+    keys = jax.random.split(key, 2 + sum(cfg.stage_sizes))
+    params: dict = {
+        "stem": _conv_init(
+            keys[0], cfg.stem_kernel, cfg.stem_kernel, 3, cfg.stem_width
+        ),
+        "gn_stem": _gn_init(cfg.stem_width),
+        "blocks": [],
+    }
+    cin = cfg.stem_width
+    ki = 1
+    for si, (n, width) in enumerate(zip(cfg.stage_sizes, cfg.widths)):
+        for bi in range(n):
+            params["blocks"].append(
+                _block_init(keys[ki], cin, width, cfg)
+            )
+            cin = width
+            ki += 1
+    params["head"] = {
+        "w": jax.random.normal(
+            keys[-1], (cin, cfg.num_classes), jnp.float32
+        ) * 0.01,
+        "b": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params
+
+
+def forward(params: Params, images: jnp.ndarray, cfg: ResNetConfig):
+    """images [B, H, W, 3] float → logits [B, num_classes] (f32)."""
+    x = _conv(params["stem"], images, cfg.stem_stride, cfg.dtype)
+    x = jax.nn.relu(_group_norm(params["gn_stem"], x, cfg.groups))
+    if cfg.stem_pool:
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 3, 3, 1),
+            window_strides=(1, 2, 2, 1),
+            padding="SAME",
+        )
+    bi = 0
+    for si, n in enumerate(cfg.stage_sizes):
+        for block_i in range(n):
+            stride = 2 if (si > 0 and block_i == 0) else 1
+            x = _block_apply(params["blocks"][bi], x, stride, cfg)
+            bi += 1
+    x = x.astype(jnp.float32).mean(axis=(1, 2))  # global average pool
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+def loss_fn(params, batch, cfg: ResNetConfig):
+    """Softmax cross entropy; batch = {"images": [B,H,W,3],
+    "labels": [B]}."""
+    logits = forward(params, batch["images"], cfg)
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1)
+    acc = (logits.argmax(-1) == batch["labels"]).mean()
+    return -ll.mean(), {"accuracy": acc}

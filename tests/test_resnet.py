@@ -1,0 +1,116 @@
+"""ResNet vision model: forward shapes, jit training convergence, and
+data-parallel training over the 8-device mesh (BASELINE config 2's
+JaxTrainer-DP-ResNet shape in miniature; reference counterpart: torch
+ResNet train examples)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models.resnet import (
+    PRESETS,
+    ResNetConfig,
+    forward,
+    init_params,
+    loss_fn,
+)
+
+
+def _synthetic(n, hw=16, classes=10, seed=0):
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, n)
+    images = rng.normal(0, 0.1, (n, hw, hw, 3)).astype(np.float32)
+    # SPATIAL class signal (a bright row at a label-dependent position):
+    # a constant per-image shift would be erased by GroupNorm.
+    images[np.arange(n), labels % hw, :, :] += 2.0
+    return {"images": jnp.asarray(images), "labels": jnp.asarray(labels)}
+
+
+def test_forward_shapes():
+    cfg = PRESETS["tiny"]
+    params = init_params(jax.random.key(0), cfg)
+    batch = _synthetic(4)
+    logits = forward(params, batch["images"], cfg)
+    assert logits.shape == (4, 10) and logits.dtype == jnp.float32
+
+
+def test_resnet50_preset_builds():
+    cfg = PRESETS["resnet50"]
+    params = init_params(jax.random.key(0), cfg)
+    logits = forward(
+        params, jnp.zeros((1, 32, 32, 3), jnp.float32), cfg
+    )
+    assert logits.shape == (1, 1000)
+    assert cfg.num_params() > 2e7  # ~23M+ (GroupNorm variant)
+
+
+def test_training_learns_synthetic(mesh8):
+    cfg = PRESETS["tiny"]
+    params = init_params(jax.random.key(1), cfg)
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+    batch = _synthetic(64)
+
+    @jax.jit
+    def step(params, state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, batch, cfg)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, loss, aux
+
+    first = None
+    for i in range(100):
+        params, state, loss, aux = step(params, state, batch)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.5
+    assert float(aux["accuracy"]) > 0.7
+
+
+def test_data_parallel_training_on_mesh():
+    """DP over a canonical device mesh: batch sharded on dp, grads
+    psummed by XLA — the JaxTrainer-DP execution shape. dp=4 (not the
+    full 8): this host exposes ONE core, and XLA-CPU's in-process
+    allreduce deadlocks (AwaitAndLogIfStuck abort) when conv workloads
+    starve the thread pool across too many virtual devices."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import make_mesh
+
+    cfg = PRESETS["tiny"]
+    mesh = make_mesh({"dp": 4}, devices=jax.devices()[:4])
+    params = init_params(jax.random.key(1), cfg)
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+
+    data_spec = P("dp")
+    batch = _synthetic(64)
+    batch = {
+        "images": jax.device_put(
+            batch["images"], NamedSharding(mesh, data_spec)
+        ),
+        "labels": jax.device_put(
+            batch["labels"], NamedSharding(mesh, data_spec)
+        ),
+    }
+    replicated = NamedSharding(mesh, P())
+    params = jax.device_put(params, replicated)
+    state = jax.device_put(state, replicated)
+
+    @jax.jit
+    def step(params, state, batch):
+        (loss, _aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, batch, cfg)
+        updates, state = opt.update(grads, state)
+        return optax.apply_updates(params, updates), state, loss
+
+    first = None
+    for _ in range(40):
+        params, state, loss = step(params, state, batch)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.8

@@ -34,7 +34,12 @@ inline uint64_t aligned(uint64_t n) { return (n + kAlign - 1) / kAlign * kAlign;
 
 struct Slot {
   uint8_t id[kIdLen];
-  uint8_t state;  // 0 empty, 1 creating, 2 sealed, 3 tombstone
+  // 0 empty, 1 creating, 2 sealed, 3 tombstone,
+  // 4 zombie: deleted-but-pinned — unlinked from lookups (get/contains
+  // miss, the id is reusable) but the heap block stays allocated until
+  // the last reader releases (plasma semantics: delete defers the free,
+  // never invalidates memory a client still maps).
+  uint8_t state;
   uint8_t pad[3];
   uint32_t refcount;
   uint64_t offset;  // heap offset of payload
@@ -42,10 +47,12 @@ struct Slot {
   uint64_t lru;  // last-touch tick
 };
 
-// Free-list node, stored inside the heap itself.
+// Free-list node, stored inside the heap itself. While a block is
+// ALLOCATED, `next` holds the owning slot's index instead (so a release
+// keyed by payload offset finds its slot in O(1) — see shm_release_at).
 struct Block {
   uint64_t size;   // payload bytes of this block (excluding header)
-  uint64_t next;   // heap offset of next free block, 0 = end
+  uint64_t next;   // free: heap offset of next free block (0 = end)
 };
 
 struct Header {
@@ -89,6 +96,7 @@ Slot* find_slot(Handle* h, const uint8_t* id, bool for_insert) {
     Slot* s = &h->slots[i];
     if (s->state == 0) return for_insert ? (first_tomb ? first_tomb : s) : nullptr;
     if (s->state == 3) { if (for_insert && !first_tomb) first_tomb = s; continue; }
+    if (s->state == 4) continue;  // zombie: unlinked, slot still occupied
     if (memcmp(s->id, id, kIdLen) == 0) return s;
   }
   return first_tomb;  // table full of tombstones/entries
@@ -269,6 +277,8 @@ int shm_create(void* handle, const uint8_t* id, uint64_t size, uint64_t* out_off
   s->offset = payload;
   s->size = size;
   s->lru = ++h->hdr->lru_clock;
+  // Bind block → slot for offset-keyed release.
+  block_at(h, payload - sizeof(Block))->next = (uint64_t)(s - h->slots);
   *out_off = h->hdr->heap_off + payload;
   return 0;
 }
@@ -318,9 +328,40 @@ int shm_delete(void* handle, const uint8_t* id) {
   MutexGuard g(&h->hdr->mutex);
   Slot* s = find_slot(h, id, false);
   if (!s || s->state == 0 || s->state == 3) return -ENOENT;
-  if (s->refcount > 0 && s->state == 2) return -EBUSY;
+  if (s->refcount > 0) {
+    // Pinned (sealed readers, or a creator mid-memcpy on state 1):
+    // unlink the id now (subsequent get/contains miss, the id may be
+    // re-created) and free the block when the last holder releases —
+    // never free memory another process is still writing or reading.
+    s->state = 4;
+    return 0;
+  }
   heap_free(h, s->offset);
   s->state = 3;
+  return 0;
+}
+
+// Release keyed by the payload's ABSOLUTE offset (what shm_get returned).
+// Unlike release-by-id this stays correct when the id was deleted and
+// re-created while this reader still pinned the OLD allocation: the
+// offset identifies the allocation, and the block header carries its
+// owning slot index.
+int shm_release_at(void* handle, uint64_t abs_off) {
+  Handle* h = static_cast<Handle*>(handle);
+  MutexGuard g(&h->hdr->mutex);
+  Header* hdr = h->hdr;
+  if (abs_off < hdr->heap_off + sizeof(Block)) return -EINVAL;
+  uint64_t payload = abs_off - hdr->heap_off;
+  Block* b = block_at(h, payload - sizeof(Block));
+  uint64_t idx = b->next;
+  if (idx >= hdr->num_slots) return -ENOENT;
+  Slot* s = &h->slots[idx];
+  if (s->offset != payload || (s->state != 2 && s->state != 4)) return -ENOENT;
+  if (s->refcount > 0) s->refcount--;
+  if (s->state == 4 && s->refcount == 0) {
+    heap_free(h, s->offset);
+    s->state = 3;
+  }
   return 0;
 }
 

@@ -52,6 +52,8 @@ def _load():
         f = getattr(lib, fn)
         f.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         f.restype = ctypes.c_int
+    lib.shm_release_at.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.shm_release_at.restype = ctypes.c_int
     lib.shm_create.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint64),
@@ -78,15 +80,39 @@ def _pad_id(id_bytes: bytes) -> bytes:
     return id_bytes.ljust(_ID_LEN, b"\0")
 
 
+class _Pin:
+    """Holds one pool refcount; drops it when garbage-collected. Keyed
+    by the allocation's offset, not its id, so it stays correct if the
+    id is deleted and re-created while this reader is still pinned."""
+
+    __slots__ = ("__weakref__",)
+
+    def __init__(self, pool: "ShmPool", abs_off: int):
+        weakref.finalize(self, pool._release_at, abs_off)
+
+
 class PoolView:
-    """Zero-copy view into the pool; releases its pin on GC."""
+    """Zero-copy view into the pool.
 
-    __slots__ = ("inband", "buffers", "__weakref__")
+    The refcount pin must outlive every consumer of the memory, not just
+    this view object: pickle-5 deserialization hands the buffers to numpy
+    arrays that alias the pool block. Each buffer is therefore exported
+    through a ctypes array that carries the shared `_Pin` — the arrays sit
+    on the deserialized values' `.base` chains, so the pin (and the block)
+    is released exactly when the last aliasing value is garbage-collected,
+    never while one is live. (The plasma client gets the same property from
+    its C++ PlasmaBuffer releasing on destruction, reference:
+    src/ray/object_manager/plasma/client.h.)
+    """
 
-    def __init__(self, pool: "ShmPool", id_bytes: bytes, mv: memoryview):
+    __slots__ = ("inband", "buffers", "_pin", "__weakref__")
+
+    def __init__(self, pool: "ShmPool", abs_off: int, mv: memoryview):
         magic, inband_len, n_buffers = _HEADER.unpack_from(mv, 0)
         if magic != _MAGIC:
             raise ValueError("corrupt pool object")
+        pin = _Pin(pool, abs_off)
+        self._pin = pin
         off = _HEADER.size
         lens = []
         for _ in range(n_buffers):
@@ -97,9 +123,19 @@ class PoolView:
         off = _aligned(off + inband_len)
         self.buffers = []
         for length in lens:
-            self.buffers.append(mv[off : off + length])
+            self.buffers.append(_pinned_slice(mv, off, length, pin))
             off = _aligned(off + length)
-        weakref.finalize(self, pool._release, id_bytes)
+
+
+def _pinned_slice(mv: memoryview, off: int, length: int, pin: _Pin):
+    """A memoryview of mv[off:off+length] whose exporter (a ctypes array)
+    strongly references `pin`, tying the pool refcount to consumer
+    lifetime (see PoolView docstring)."""
+    if length == 0:
+        return memoryview(b"")
+    arr = (ctypes.c_char * length).from_buffer(mv, off)
+    arr._pin = pin
+    return memoryview(arr).cast("B")
 
 
 class ShmPool:
@@ -157,9 +193,19 @@ class ShmPool:
                 m[base + o : base + o + len(bb)] = bb
                 o = _aligned(o + len(bb))
         except BaseException:
-            lib.shm_abort(self._h, pid)
+            if lib.shm_abort(self._h, pid) == -errno.ENOENT:
+                # A concurrent delete zombified the in-creation slot
+                # (find_slot skips zombies): drop the creator's pin by
+                # offset so the block frees.
+                lib.shm_release_at(self._h, off.value)
             raise
         rc = lib.shm_seal(self._h, pid)
+        if rc == -errno.ENOENT:
+            # Deleted while creating: equivalent to a successful put
+            # immediately followed by the delete. Release the creator's
+            # pin (frees the zombie block) and report success.
+            lib.shm_release_at(self._h, off.value)
+            return total
         if rc != 0:
             raise OSError(f"seal failed: {os.strerror(-rc)}")
         return total
@@ -177,7 +223,7 @@ class ShmPool:
         if rc != 0:
             raise OSError(f"get failed: {os.strerror(-rc)}")
         mv = self._mem[off.value : off.value + size.value]
-        return PoolView(self, pid, mv)
+        return PoolView(self, off.value, mv)
 
     def contains(self, id_bytes: bytes) -> bool:
         if not self._h:
@@ -188,10 +234,10 @@ class ShmPool:
         if self._h:
             self._lib.shm_delete(self._h, _pad_id(id_bytes))
 
-    def _release(self, pid: bytes) -> None:
+    def _release_at(self, abs_off: int) -> None:
         try:
             if self._h:
-                self._lib.shm_release(self._h, pid)
+                self._lib.shm_release_at(self._h, abs_off)
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass
 

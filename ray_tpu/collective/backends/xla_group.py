@@ -192,7 +192,9 @@ class XlaDistGroup:
     shards only (jax.make_array_from_single_device_arrays), and the
     compiled psum runs SPMD across all hosts. Requires
     jax.distributed.initialize first (see bootstrap_distributed).
-    Untestable on this single-host rig; exercised by multi-host deploys.
+    Tested with real process boundaries on a multi-process CPU cluster
+    (tests/test_multihost.py, gloo CPU collectives); on TPU pods the
+    same code runs over ICI/DCN.
     """
 
     expects_per_rank_tensors = False
@@ -309,6 +311,13 @@ async def bootstrap_distributed(
             await asyncio.sleep(0.05)
 
     def _init():
+        # CPU cross-process collectives need the gloo implementation
+        # (harmless for TPU, where collectives compile to ICI/DCN ops);
+        # must be set before the backend initializes.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 - older jaxlib without the knob
+            pass
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=world_size,

@@ -49,6 +49,15 @@ class LlamaConfig:
     # "dense" | "ring" | "ulysses": attention strategy. ring/ulysses need a
     # mesh with sp>1 (built by ray_tpu.train.step.jit_train_step).
     attn_impl: str = "dense"
+    # Embedding lookup strategy:
+    #   "gather"  table[tokens] — fastest on a single chip
+    #   "onehot"  one_hot(tokens) @ table — a matmul, which the SPMD
+    #             partitioner handles cleanly when the table is sharded
+    #             (vocab on tp, embed on fsdp); a sharded gather instead
+    #             triggers "involuntary full rematerialization" (the
+    #             compiler replicates the whole activation to reshard)
+    #   "auto"    onehot when >1 device is visible, else gather
+    embed_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -145,6 +154,25 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     }
 
 
+def _embed(table: jnp.ndarray, tokens: jnp.ndarray, cfg: LlamaConfig):
+    """Token embedding lookup. Under a sharded mesh the lookup runs as a
+    one-hot matmul: a gather from a (vocab=tp, embed=fsdp)-sharded table
+    forces the SPMD partitioner into an involuntary full
+    rematerialization (replicate-then-reshard) on the activation, while
+    the matmul contraction partitions natively (and rides the MXU). On a
+    single chip the plain gather is cheaper."""
+    table = table.astype(cfg.dtype)
+    impl = cfg.embed_impl
+    if impl == "auto":
+        impl = "onehot" if jax.device_count() > 1 else "gather"
+    if impl == "gather":
+        return table[tokens]
+    if impl != "onehot":
+        raise ValueError(f"unknown embed_impl {cfg.embed_impl!r}")
+    onehot = jax.nn.one_hot(tokens, table.shape[0], dtype=table.dtype)
+    return onehot @ table
+
+
 AttnFn = Callable[..., jnp.ndarray]
 
 
@@ -207,7 +235,7 @@ def forward_with_aux(
     seq = tokens.shape[1]
     cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
 
-    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    x = _embed(params["tok_emb"], tokens, cfg)
     x = constrain(x, "batch", "act_seq", "act_embed")
 
     body = partial(_block, cos=cos, sin=sin, cfg=cfg, attn_fn=attn_fn,

@@ -398,6 +398,19 @@ def flash_attention(
     # lengths degrade hard — perf-sensitive callers gate on _fit_block).
     block_q = _fit_block(block_q, s)
     block_kv = _fit_block(block_kv, s)
+    # A tiny fitted block (prime-ish seq) means orders-of-magnitude
+    # slower Pallas tiles than the MXU-friendly sizes — warn instead of
+    # silently cliffing (trace-time only; jit caches per static shape).
+    if min(block_q, block_kv) < 128 and s > 128:
+        import warnings
+
+        warnings.warn(
+            f"flash_attention: seq={s} only admits blocks "
+            f"(q={block_q}, kv={block_kv}) < 128 — expect a severe "
+            "slowdown; pad the sequence to a multiple of 128 or use "
+            "dense attention for this shape",
+            stacklevel=2,
+        )
     if scale is None:
         scale = d**-0.5
     return _flash(q, k, v, causal, scale, block_q, block_kv, interpret)

@@ -26,7 +26,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Sequence
 
-from ray_tpu._private import rpc
+from ray_tpu._private import config, rpc
 from ray_tpu._private.ids import ActorID, FunctionID, ObjectID, TaskID
 from ray_tpu._private.serialization import Serialized, deserialize, serialize
 from ray_tpu.exceptions import (
@@ -42,6 +42,18 @@ from ray_tpu.runtime.object_store import ObjectStore
 INLINE_MAX_BYTES = 100_000
 DEFAULT_RETRIES = 3
 GENERATOR_BACKPRESSURE_ITEMS = 8  # max undelivered items per stream
+
+
+def _spec_nbytes(spec: dict) -> int:
+    """Approximate retained size of a lineage entry: the serialized args
+    dominate (by-value entries carry inband bytes + buffers)."""
+    total = 256  # envelope
+    for entry in spec.get("args", ()):
+        if entry[1] == "val":
+            total += len(entry[2]) + sum(len(b) for b in entry[3])
+        else:
+            total += 64
+    return total
 
 
 class _NeedsPull(Exception):
@@ -127,7 +139,6 @@ class CoreWorker:
         self._put_index = 0
         self._root_task = TaskID.random()
         self._anchor: tuple[str, rpc.Connection] | None = None  # client mode
-        self._active_trace: tuple[str, str] | None = None  # tracing
 
         # actor_id → freshest known address (updated on head-driven
         # restarts; handles carry the birth address only).
@@ -175,6 +186,11 @@ class CoreWorker:
             collections.OrderedDict()
         )
         self._lineage_cap = 16384
+        # Entry count alone is not enough: each entry retains the full
+        # serialized args, so lineage is ALSO evicted on a byte budget
+        # (reference: RAY_max_lineage_bytes-style eviction in
+        # task_manager.h:175).
+        self._lineage_bytes = 0
         self._oid_to_task: dict[str, str] = {}
         # task_id → in-flight reconstruction future (dedupe).
         self._reconstructing: dict[str, asyncio.Future] = {}
@@ -734,21 +750,36 @@ class CoreWorker:
             # a store-resident return is later lost (actor methods are
             # not idempotent; streams replay only from the start — both
             # excluded, matching this runtime's retry semantics).
-            self._lineage[task_id.hex()] = {
-                "spec": spec,
-                "oids": oids,
-                "resources": resources,
-                "placement": placement,
-                "runtime_env": runtime_env,
-                "scheduling": scheduling,
-                "attempts_left": max_retries,
-            }
-            for oid_hex in oids:
-                self._oid_to_task[oid_hex] = task_id.hex()
-            while len(self._lineage) > self._lineage_cap:
-                old_tid, old = self._lineage.popitem(last=False)
-                for oid_hex in old["oids"]:
-                    self._oid_to_task.pop(oid_hex, None)
+            entry_bytes = _spec_nbytes(spec)
+            budget = config.get("MAX_LINEAGE_BYTES")
+            if entry_bytes <= budget:
+                # An entry larger than the whole budget is skipped
+                # outright — recording it would evict every OTHER
+                # entry first (destroying their reconstructability)
+                # and then itself; its returns are simply
+                # unreconstructable, like reference tasks past
+                # RAY_max_lineage_bytes.
+                self._lineage[task_id.hex()] = {
+                    "spec": spec,
+                    "oids": oids,
+                    "bytes": entry_bytes,
+                    "resources": resources,
+                    "placement": placement,
+                    "runtime_env": runtime_env,
+                    "scheduling": scheduling,
+                    "attempts_left": max_retries,
+                }
+                for oid_hex in oids:
+                    self._oid_to_task[oid_hex] = task_id.hex()
+                self._lineage_bytes += entry_bytes
+                while self._lineage and (
+                    len(self._lineage) > self._lineage_cap
+                    or self._lineage_bytes > budget
+                ):
+                    old_tid, old = self._lineage.popitem(last=False)
+                    self._lineage_bytes -= old.get("bytes", 0)
+                    for oid_hex in old["oids"]:
+                        self._oid_to_task.pop(oid_hex, None)
         asyncio.ensure_future(
             self._drive_task(
                 spec, oids, resources, max_retries, actor, placement,
@@ -1965,18 +1996,12 @@ class CoreWorker:
         from ray_tpu.util import tracing
 
         trace_ctx = spec.get("trace")
-        with tracing.activate(trace_ctx) as span_id:
-            prev = self._active_trace
-            if span_id is not None:
-                # Visible to nested .remote() calls from the executor
-                # thread (contextvars do not cross run_in_executor).
-                # Save/restore so an untraced concurrent task finishing
-                # never erases a traced task's context.
-                self._active_trace = (trace_ctx["trace_id"], span_id)
-            try:
-                return await self._execute_inner(spec, actor_id)
-            finally:
-                self._active_trace = prev
+        with tracing.activate(trace_ctx):
+            # Nested .remote() calls from the executor thread see the
+            # span through a per-thread install (run_in_executor wrapper
+            # in _execute_inner) — per task, so concurrent traced actor
+            # tasks can't be parented to each other's spans.
+            return await self._execute_inner(spec, actor_id)
 
     async def _execute_inner(self, spec: dict, actor_id: str | None) -> dict:
         loop = asyncio.get_running_loop()
@@ -2001,8 +2026,16 @@ class CoreWorker:
             if asyncio.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
             else:
+                from ray_tpu.util import tracing
+
+                trace_cur = tracing.current_context()
+
+                def _run_sync(fn=fn, args=args, kwargs=kwargs):
+                    with tracing.thread_trace(trace_cur):
+                        return fn(*args, **kwargs)
+
                 result = await loop.run_in_executor(
-                    self._exec_pool, lambda: fn(*args, **kwargs)
+                    self._exec_pool, _run_sync
                 )
             if spec.get("streaming"):
                 import inspect

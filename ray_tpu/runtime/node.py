@@ -774,11 +774,12 @@ class NodeManager:
         actors are last resorts (their state dies with them)."""
         from ray_tpu._private import config
 
-        threshold = config.get("MEMORY_THRESHOLD")
         while True:
             await asyncio.sleep(1.0)
             try:
-                if system_memory_fraction() < threshold:
+                # Re-read each tick so runtime overrides apply, same as
+                # the spill watermarks.
+                if system_memory_fraction() < config.get("MEMORY_THRESHOLD"):
                     continue
                 victim = self._pick_oom_victim()
                 if victim is None:

@@ -39,7 +39,7 @@ def _aligned(n: int) -> int:
 class PlasmaView:
     """Zero-copy view of a sealed object; keeps its mmap alive."""
 
-    __slots__ = ("inband", "buffers", "_map", "_file_size")
+    __slots__ = ("inband", "buffers", "_map", "_file_size", "__weakref__")
 
     def __init__(self, mapping: mmap.mmap):
         self._map = mapping
@@ -74,12 +74,20 @@ class ObjectStore:
     def __init__(self, directory: str | Path, capacity_bytes: int | None = None):
         self.dir = Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
-        # Views handed out by this process, held so the backing memory
-        # stays valid: file views pin their mmap; pool views pin the
-        # object's refcount so eviction/spilling cannot free a block
-        # that a zero-copy deserialized value still aliases (the pin
-        # drops on release()/delete(), or with the view's finalizer).
-        self._views: dict[ObjectID, object] = {}
+        # Weak cache of views handed out by this process (avoids
+        # re-mmap / re-pin on repeat gets). Lifetime of the backing
+        # memory is carried by the views themselves: file views keep
+        # their mmap alive through the buffers' exporter chain, and pool
+        # views attach the refcount pin to every exported buffer
+        # (shmstore.PoolView), so a zero-copy deserialized value keeps
+        # its block pinned exactly as long as the value is alive — and
+        # no longer. A strong cache here would pin every object a
+        # long-lived worker ever read, making the pool unspillable.
+        import weakref
+
+        self._views: "weakref.WeakValueDictionary[ObjectID, object]" = (
+            weakref.WeakValueDictionary()
+        )
         from ray_tpu._private import config
 
         self.pool = None
@@ -141,8 +149,6 @@ class ObjectStore:
         if self.pool is not None:
             pv = self.pool.get(object_id.binary())
             if pv is not None:
-                # Cache → the refcount pin outlives this call, keeping
-                # the block safe for zero-copy readers in this process.
                 self._views[object_id] = pv
                 return pv
         view = self._map_file(self._path(object_id))

@@ -17,6 +17,7 @@ from ray_tpu.train.checkpoint import (
     save_checkpoint,
 )
 from ray_tpu.train.session import (
+    collective_group_name,
     get_checkpoint,
     get_context,
     get_dataset_shard,
@@ -40,6 +41,7 @@ __all__ = [
     "make_train_step",
     "init_train_state",
     "state_logical_axes",
+    "collective_group_name",
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",

@@ -21,6 +21,9 @@ class TrainContext:
     config: dict = field(default_factory=dict)
     # name → list of block ObjectRefs (this worker's split)
     dataset_shards: dict = field(default_factory=dict)
+    # eager-collective group formed by the trainer backend (empty when
+    # ScalingConfig.distributed is off); attempt-scoped name
+    collective_group: str = ""
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)
@@ -46,6 +49,19 @@ def get_context() -> TrainContext:
             "ray_tpu.train.get_context() is only valid inside a train loop"
         )
     return _context
+
+
+def collective_group_name() -> str:
+    """Name of the worker group's eager collective group (initialized by
+    the trainer when ScalingConfig(distributed=True)); pass to
+    ray_tpu.collective verbs inside the train loop."""
+    name = get_context().collective_group
+    if not name:
+        raise RuntimeError(
+            "no collective group: the trainer was not started with "
+            "ScalingConfig(distributed=True)"
+        )
+    return name
 
 
 def get_checkpoint() -> str | None:

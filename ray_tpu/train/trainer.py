@@ -40,6 +40,13 @@ class ScalingConfig:
     resources_per_worker: dict = field(default_factory=dict)
     topology: str | None = None
     placement_strategy: str = "PACK"
+    # Form ONE global jax mesh across all workers: every worker runs
+    # jax.distributed.initialize (KV-rendezvous'd through the head)
+    # before the train loop, so jax.devices() spans the worker group
+    # (reference: _JaxBackend v2/jax/config.py:32-96 does this per
+    # worker). Required for FSDP/TP across hosts; off for independent
+    # per-worker DP loops.
+    distributed: bool = False
 
     def bundle(self) -> dict:
         b = {"CPU": 1.0}
@@ -100,6 +107,27 @@ class TrainWorker:
                 os.environ["JAX_PLATFORMS"] = jax_platform
             else:
                 os.environ.pop("JAX_PLATFORMS", None)
+        collective_group = ""
+        if backend_env.get("RAY_TPU_TRAIN_DISTRIBUTED") == "1":
+            # One global mesh across the worker group: bootstrap
+            # jax.distributed through the head-KV rendezvous BEFORE any
+            # jax computation in this process (reference: _JaxBackend
+            # config.py:84 jax.distributed.initialize per worker). The
+            # group doubles as an eager-collective group
+            # (session.collective_group_name()). The name is
+            # ATTEMPT-scoped so a retry never rendezvouses with a dead
+            # previous attempt's coordinator KV entry.
+            from ray_tpu import collective as col
+
+            attempt = backend_env.get("RAY_TPU_TRAIN_ATTEMPT", "0")
+            collective_group = f"train:{experiment_name}:a{attempt}"
+            if not col.is_group_initialized(collective_group):
+                col.init_collective_group(
+                    self.world_size,
+                    self.rank,
+                    backend="xla_dist",
+                    group_name=collective_group,
+                )
         self.ctx = TrainContext(
             world_size=self.world_size,
             rank=self.rank,
@@ -108,6 +136,7 @@ class TrainWorker:
             latest_checkpoint=latest_checkpoint,
             config=config,
             dataset_shards=dataset_shards or {},
+            collective_group=collective_group,
         )
         return True
 
@@ -180,7 +209,7 @@ class JaxTrainer:
         last_err: Exception | None = None
         while True:
             try:
-                return self._run_attempt(latest_checkpoint)
+                return self._run_attempt(latest_checkpoint, failures)
             except Exception as e:  # noqa: BLE001 - controller retry loop
                 last_err = e
                 failures += 1
@@ -214,7 +243,7 @@ class JaxTrainer:
         )
         return os.path.join(d, cks[-1]) if cks else None
 
-    def _backend_env(self, rank: int) -> dict:
+    def _backend_env(self, rank: int, attempt: int = 0) -> dict:
         """Worker env for the JAX backend (reference: _JaxBackend
         v2/jax/config.py:32 _setup_jax_distributed_environment)."""
         env = {
@@ -227,9 +256,14 @@ class JaxTrainer:
             # TPU workers own the chip runtime; everything else stays on
             # the JAX CPU backend so it never contends for the slice.
             env["RAY_TPU_WORKER_JAX_PLATFORMS"] = ""
+        if self.scaling.distributed and self.scaling.num_workers > 1:
+            env["RAY_TPU_TRAIN_DISTRIBUTED"] = "1"
+            env["RAY_TPU_TRAIN_ATTEMPT"] = str(attempt)
         return env
 
-    def _run_attempt(self, latest_checkpoint: str | None) -> Result:
+    def _run_attempt(
+        self, latest_checkpoint: str | None, attempt: int = 0
+    ) -> Result:
         n = self.scaling.num_workers
         pg = placement_group(
             [self.scaling.bundle() for _ in range(n)],
@@ -252,7 +286,7 @@ class JaxTrainer:
                         self.run_config.storage_path,
                         self.config,
                         latest_checkpoint,
-                        self._backend_env(i),
+                        self._backend_env(i, attempt),
                         shards[i],
                     )
                     for i, w in enumerate(workers)

@@ -51,24 +51,29 @@ def current_context() -> tuple[str, str] | None:
 
 
 def _active() -> tuple[str, str] | None:
-    """Current span: the contextvar when set, else this thread's span()
-    scope, else the worker's active execution span (sync task code runs
-    on an executor thread where the loop-side contextvar is invisible;
-    execution is serialized, so the per-process fallback is unambiguous
-    for sync tasks)."""
+    """Current span: the contextvar when set, else this thread's scope
+    (span() on driver threads; the worker sets it per executor thread via
+    thread_trace before running a sync task, so concurrent actor tasks
+    each see their OWN span — no shared process-wide slot)."""
     cur = _current.get()
     if cur is not None:
         return cur
-    cur = getattr(_tl, "cur", None)
-    if cur is not None:
-        return cur
-    try:
-        import ray_tpu.api as api
+    return getattr(_tl, "cur", None)
 
-        core = api._runtime.core
-        return getattr(core, "_active_trace", None) if core else None
-    except Exception:  # noqa: BLE001
-        return None
+
+@contextlib.contextmanager
+def thread_trace(ctx: tuple[str, str] | None):
+    """Install `ctx` as this THREAD's active span. Used by the worker to
+    carry a task's trace context onto the executor thread that runs its
+    sync function (contextvars do not cross run_in_executor); keyed to
+    the thread, so interleaved finishes of concurrent traced tasks can't
+    restore each other's context."""
+    prev = getattr(_tl, "cur", None)
+    _tl.cur = ctx
+    try:
+        yield
+    finally:
+        _tl.cur = prev
 
 
 def make_trace_ctx(name: str) -> dict | None:

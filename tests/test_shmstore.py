@@ -123,3 +123,33 @@ def test_objectstore_uses_pool(tmp_path):
     view = store.get(oid)
     np.testing.assert_array_equal(deserialize(view.inband, view.buffers), arr)
     store.destroy()
+
+
+def test_pin_follows_value_lifetime(tmp_path):
+    """A zero-copy deserialized value keeps its pool block pinned (so
+    spilling cannot free memory the value aliases), and the pin drops
+    when the VALUE dies — not when the view object dies. Regression for
+    the round-1 strong view cache that made every object a long-lived
+    process ever read permanently unspillable."""
+    import gc
+
+    from ray_tpu.runtime.object_store import ObjectStore
+
+    store = ObjectStore(tmp_path / "store")
+    assert store.pool is not None
+    oid = ObjectID.random()
+    arr = np.arange(100_000, dtype=np.float64)
+    store.put(oid, serialize(arr))
+    view = store.get(oid)
+    value = deserialize(view.inband, view.buffers)
+    np.testing.assert_array_equal(value, arr)
+    pid = oid.binary().ljust(20, b"\0")
+    del view
+    gc.collect()
+    # Value alive: block is pinned — scan() (sealed+unpinned) skips it.
+    assert pid not in [e[0] for e in store.pool.scan()]
+    del value
+    gc.collect()
+    # Value dead: the pin dropped, block is a spill/evict candidate.
+    assert pid in [e[0] for e in store.pool.scan()]
+    store.destroy()

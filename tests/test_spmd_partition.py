@@ -1,0 +1,76 @@
+"""SPMD partitioner hygiene: the 8-way train step must compile without
+"Involuntary full rematerialization" warnings (VERDICT r1 item 2 — the
+round-1 embedding gather forced the partitioner to replicate a sharded
+activation to reshard it, wasted HBM + ICI on every step on a real pod).
+
+The warning is emitted by XLA's C++ to stderr at compile time, so the
+check runs the compile in a subprocess and scans its output.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+
+COMPILE_SCRIPT = textwrap.dedent(
+    """
+    import os
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import dataclasses
+    import jax.numpy as jnp
+    from ray_tpu.models import PRESETS
+    from ray_tpu.parallel import default_axis_sizes, make_mesh
+    from ray_tpu.parallel.sharding import tree_shardings
+    from ray_tpu.train.step import (
+        init_train_state,
+        jit_train_step,
+        make_optimizer,
+        state_logical_axes,
+    )
+
+    axes = default_axis_sizes(8)
+    mesh = make_mesh(axes)  # dp1 fsdp2 tp2 sp2 — the dryrun mesh
+    cfg = dataclasses.replace(PRESETS["tiny"], attn_impl="ring")
+    opt = make_optimizer(total_steps=10)
+    step = jit_train_step(cfg, opt, mesh)
+    state = init_train_state(jax.random.key(0), cfg, opt)
+    state = jax.device_put(
+        state, tree_shardings(mesh, state_logical_axes(cfg, opt))
+    )
+    tokens = jnp.zeros((4, 65), jnp.int32)
+    batch = {
+        "tokens": jax.device_put(
+            tokens, tree_shardings(mesh, ("batch", None))
+        )
+    }
+    _, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    print("STEP_OK")
+    """
+)
+
+
+def test_8way_step_compiles_without_full_remat(tmp_path):
+    script = tmp_path / "compile8.py"
+    script.write_text(COMPILE_SCRIPT)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH", "")) if p
+    )
+    out = subprocess.run(
+        [sys.executable, str(script)],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    combined = out.stdout + out.stderr
+    assert out.returncode == 0, combined
+    assert "STEP_OK" in out.stdout
+    assert "Involuntary full rematerialization" not in combined, combined

@@ -41,6 +41,20 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "DAG_MAX_BUFFERED": (int, 8, "max in-flight executions per DAG"),
     "DAG_GET_TIMEOUT": (float, 30.0, "CompiledDAGRef.get timeout"),
     "DAG_SUBMIT_TIMEOUT": (float, 30.0, "execute() backpressure timeout"),
+    # --- worker log pipeline
+    "LOG_TO_DRIVER": (bool, True, "stream worker stdout/stderr to drivers "
+                                  "via pubsub"),
+    "LOG_DIR": (str, "", "worker log directory override"),
+    # --- head fault tolerance
+    "HEAD_JOURNAL": (str, "", "journal file for durable head state "
+                              "(KV/actors/PGs); empty = memory only"),
+    "HEAD_RECONNECT_S": (float, 20.0, "how long clients retry head calls "
+                                      "across a head restart"),
+    # --- rpc hardening
+    "AUTH_TOKEN": (str, "", "shared-secret connection token; empty "
+                            "disables auth (set one on every host of a "
+                            "deployed cluster)"),
+    "RPC_MAX_FRAME": (int, 2 << 30, "largest accepted rpc frame (bytes)"),
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: method:prob[:mode] list"),
     "TRACE": (bool, False, "enable span collection in every process"),

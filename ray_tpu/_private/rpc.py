@@ -21,6 +21,9 @@ from typing import Any, Awaitable, Callable
 REQ, RESP, ERR, PUSH = 0, 1, 2, 3
 _HDR = struct.Struct("<I")
 _MAX_FRAME = 1 << 31
+_AUTH_MAGIC = b"RTPUAUTH"
+_AUTH_MAX = 4096
+
 
 class RpcError(Exception):
     pass
@@ -46,12 +49,42 @@ def _chaos_drop(method: str) -> bool:
     )
 
 
+def _auth_token() -> str:
+    from ray_tpu._private import config
+
+    return config.get("AUTH_TOKEN")
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> tuple:
     hdr = await reader.readexactly(_HDR.size)
     (length,) = _HDR.unpack(hdr)
-    if length > _MAX_FRAME:
+    if length > min(_MAX_FRAME, _max_frame()):
         raise RpcError(f"oversized frame: {length}")
     return pickle.loads(await reader.readexactly(length))
+
+
+def _max_frame() -> int:
+    from ray_tpu._private import config
+
+    return config.get("RPC_MAX_FRAME")
+
+
+async def _server_auth(reader: asyncio.StreamReader, token: str) -> bool:
+    """Pre-auth handshake check. The ONLY bytes a stranger can make the
+    server parse are this fixed-size frame, compared constant-time — no
+    pickle touches unauthenticated input (reference: token auth
+    rpc/authentication/authentication_token_validator.h:26)."""
+    import hmac
+
+    try:
+        hdr = await reader.readexactly(_HDR.size)
+        (length,) = _HDR.unpack(hdr)
+        if length > _AUTH_MAX:
+            return False
+        data = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return False
+    return hmac.compare_digest(data, _AUTH_MAGIC + token.encode())
 
 
 def _write_frame(writer: asyncio.StreamWriter, frame: tuple) -> None:
@@ -196,6 +229,23 @@ class Server:
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         async def on_conn(reader, writer):
+            token = _auth_token()
+            if token:
+                try:
+                    ok = await asyncio.wait_for(
+                        _server_auth(reader, token), timeout=5.0
+                    )
+                except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                    ok = False
+                if not ok:
+                    # Refuse before any frame dispatch: an
+                    # unauthenticated peer never reaches the pickle
+                    # layer (deserialization = code execution).
+                    try:
+                        writer.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    return
             conn = Connection(
                 reader,
                 writer,
@@ -235,11 +285,97 @@ async def connect(
     retryable_grpc_client.h)."""
     host, _, port = addr.rpartition(":")
     last: Exception | None = None
+    token = _auth_token()
     for attempt in range(retries):
         try:
             reader, writer = await asyncio.open_connection(host, int(port))
+            if token:
+                blob = _AUTH_MAGIC + token.encode()
+                writer.write(_HDR.pack(len(blob)) + blob)
+                await writer.drain()
             return Connection(reader, writer, handler=handler, on_push=on_push)
-        except ConnectionError as e:
+        except (OSError, asyncio.TimeoutError) as e:
+            # OSError covers the whole dial-failure family (refused,
+            # ETIMEDOUT, EHOSTUNREACH, gaierror) — all must surface as
+            # ConnectionLost so retry loops keyed on RpcError survive
+            # transient outages.
             last = e
             await asyncio.sleep(retry_delay * (2**attempt))
     raise ConnectionLost(f"cannot connect to {addr}: {last}")
+
+
+class ReconnectingClient:
+    """Client endpoint that survives peer restarts: re-dials on
+    connection loss and retries the in-flight call until a deadline
+    (reference: RetryableGrpcClient retryable_grpc_client.h +
+    NotifyGCSRestart-driven resubscription, node_manager.proto:325).
+
+    Callers must only route IDEMPOTENT methods through this (a call whose
+    response was lost is re-sent); `on_reconnect(conn)` runs after each
+    successful re-dial — with the RAW new Connection, since the client's
+    own call() is locked during the dial — so owners can re-register /
+    resubscribe state the restarted peer lost.
+    """
+
+    def __init__(
+        self,
+        addr: str,
+        on_push: Callable[[Any], None] | None = None,
+        on_reconnect: Callable[[Connection], Awaitable[None]] | None = None,
+        reconnect_timeout: float = 20.0,
+    ):
+        self.addr = addr
+        self.on_push = on_push
+        self.on_reconnect = on_reconnect
+        self.reconnect_timeout = reconnect_timeout
+        self._conn: Connection | None = None
+        self._lock: asyncio.Lock | None = None
+        self._closed = False
+
+    async def connect(self) -> "ReconnectingClient":
+        self._lock = asyncio.Lock()
+        self._conn = await connect(self.addr, on_push=self.on_push)
+        return self
+
+    async def _ensure(self) -> Connection:
+        if self._closed:
+            err = ConnectionLost(f"client to {self.addr} closed")
+            err.sent = False
+            raise err
+        conn = self._conn
+        if conn is not None and not conn._closed:
+            return conn
+        async with self._lock:
+            if self._conn is not None and not self._conn._closed:
+                return self._conn
+            self._conn = await connect(
+                self.addr, on_push=self.on_push, retries=5
+            )
+            if self.on_reconnect is not None:
+                await self.on_reconnect(self._conn)
+            return self._conn
+
+    async def call(self, method: str, timeout: float | None = None, **kw):
+        import time as _time
+
+        deadline = _time.monotonic() + self.reconnect_timeout
+        while True:
+            try:
+                conn = await self._ensure()
+                return await conn.call(method, timeout=timeout, **kw)
+            except ConnectionLost as e:
+                if self._closed or _time.monotonic() >= deadline:
+                    raise
+                # Chaos-dropped requests (sent=False on a live conn)
+                # propagate: retrying them here would defeat the fault
+                # injection the chaos hook exists for.
+                if getattr(e, "sent", True) is False and not (
+                    self._conn is None or self._conn._closed
+                ):
+                    raise
+                await asyncio.sleep(0.3)
+
+    async def close(self):
+        self._closed = True
+        if self._conn is not None:
+            await self._conn.close()

@@ -53,6 +53,24 @@ def is_initialized() -> bool:
     return _runtime.ready
 
 
+def _print_worker_log(msg: dict) -> None:
+    """Render a worker-log pubsub record on the driver's stdout with the
+    reference's "(prefix pid=N, node)" framing."""
+    import sys as _sys
+
+    data = msg.get("data", "")
+    prefix = (
+        f"({msg.get('worker_id', '?')[:8]} pid={msg.get('pid')}, "
+        f"node={str(msg.get('node_id', '?'))[:8]})"
+    )
+    out = "".join(
+        f"{prefix} {line}\n" for line in data.splitlines() if line.strip()
+    )
+    if out:
+        _sys.stdout.write(out)
+        _sys.stdout.flush()
+
+
 def init(
     address: str | None = None,
     *,
@@ -153,6 +171,14 @@ def init(
             store_dir=store_dir,
         )
         await core.start()
+        if not observer:
+            from ray_tpu._private import config as _config
+
+            if _config.get("LOG_TO_DRIVER"):
+                # Stream worker stdout/stderr to this driver (reference:
+                # print_worker_logs worker.py:2295 — the log monitor
+                # publishes, every driver prints).
+                await core.subscribe("logs", _print_worker_log)
         return head, node, core, session, head_addr
 
     head, node, core, session, head_addr = _runtime.run(_bootstrap())

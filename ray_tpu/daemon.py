@@ -1,0 +1,141 @@
+"""Daemonized cluster processes behind `ray_tpu start` / `ray_tpu stop`
+(reference: `ray start --head` / `--address` scripts/scripts.py:682, which
+exec the gcs_server and raylet binaries; here the head service and node
+manager are asyncio services hosted by this module's entry point).
+
+Layout of a session directory (one per host, default
+/tmp/ray_tpu_cluster):
+
+    head.addr      advertised head address (written atomically when up)
+    head.journal   durable head state (KV/actors/PGs) — enables head
+                   restart with state intact (see runtime/head_storage)
+    *.pid          one per daemonized process, consumed by `stop`
+    logs/*.log     daemon stdout/stderr
+
+`python -m ray_tpu.daemon head|node ...` runs a process in the
+foreground; the CLI (scripts.py) forks it into the background with
+start_new_session and tracks the pid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import tempfile
+
+DEFAULT_SESSION_DIR = os.path.join(
+    tempfile.gettempdir(), "ray_tpu_cluster"
+)
+
+
+def _write_atomic(path: str, text: str) -> None:
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+    with os.fdopen(fd, "w") as f:
+        f.write(text)
+    os.rename(tmp, path)
+
+
+def _resources(args) -> dict:
+    from ray_tpu.runtime.node import detect_resources
+
+    total = detect_resources()
+    if args.num_cpus is not None:
+        total["CPU"] = float(args.num_cpus)
+    if args.resources:
+        total.update(json.loads(args.resources))
+    return total
+
+
+async def _serve_until_signal(stoppables) -> None:
+    """Run until SIGTERM/SIGINT, then stop services newest-first."""
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    for s in reversed(stoppables):
+        try:
+            await s.stop()
+        except Exception:  # noqa: BLE001 - best-effort teardown
+            pass
+
+
+async def _run_head(args) -> None:
+    from ray_tpu._private import config
+    from ray_tpu.runtime.head import HeadService
+    from ray_tpu.runtime.node import NodeManager
+    from ray_tpu.runtime.object_store import default_store_dir
+
+    session_dir = args.session_dir
+    os.makedirs(session_dir, exist_ok=True)
+    journal = os.path.join(session_dir, "head.journal")
+    head = HeadService(journal_path=journal)
+    addr = await head.start(host=args.host, port=args.port)
+    # Workers this node spawns need the journal off (only the head
+    # process owns it) but the cluster address on.
+    config.set_system_config({"ADDRESS": addr})
+
+    stoppables = [head]
+    if not args.head_only:
+        node = NodeManager(
+            head_addr=addr,
+            store_dir=default_store_dir(f"cli-{os.getpid()}"),
+            resources=_resources(args),
+        )
+        await node.start(host=args.host)
+        stoppables.append(node)
+
+    _write_atomic(os.path.join(session_dir, "head.addr"), addr)
+    print(f"head up at {addr}", flush=True)
+    print(
+        f"join from other hosts:  python -m ray_tpu.scripts start "
+        f"--address {addr}",
+        flush=True,
+    )
+    await _serve_until_signal(stoppables)
+
+
+async def _run_node(args) -> None:
+    from ray_tpu.runtime.node import NodeManager
+    from ray_tpu.runtime.object_store import default_store_dir
+
+    node = NodeManager(
+        head_addr=args.address,
+        store_dir=default_store_dir(f"cli-{os.getpid()}"),
+        resources=_resources(args),
+    )
+    addr = await node.start(host=args.host)
+    print(f"node up at {addr} (head {args.address})", flush=True)
+    await _serve_until_signal([node])
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu.daemon")
+    sub = p.add_subparsers(dest="role", required=True)
+    for role in ("head", "node"):
+        sp = sub.add_parser(role)
+        sp.add_argument("--host", default="127.0.0.1")
+        sp.add_argument("--num-cpus", type=float, default=None)
+        sp.add_argument("--resources", default=None, help="JSON dict")
+        sp.add_argument("--session-dir", default=DEFAULT_SESSION_DIR)
+        if role == "head":
+            sp.add_argument("--port", type=int, default=0)
+            sp.add_argument(
+                "--head-only",
+                action="store_true",
+                help="run the head service without a local node",
+            )
+        else:
+            sp.add_argument("--address", required=True)
+    args = p.parse_args(argv)
+    runner = _run_head if args.role == "head" else _run_node
+    asyncio.run(runner(args))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

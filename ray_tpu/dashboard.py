@@ -60,6 +60,11 @@ def _jobs():
     return JobSubmissionClient().list_jobs()
 
 
+@_route("/api/logs")
+def _logs():
+    return state.list_worker_logs()
+
+
 def _index_html() -> str:
     nodes = state.list_nodes()
     actors = state.list_actors()
@@ -98,6 +103,15 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path in _ROUTES:
                 body = json.dumps(_ROUTES[self.path]()).encode()
                 ctype = "application/json"
+            elif self.path.startswith("/api/logs/"):
+                text = state.read_worker_log(
+                    self.path[len("/api/logs/"):]
+                )
+                if text is None:
+                    self.send_error(404)
+                    return
+                body = text.encode()
+                ctype = "text/plain"
             else:
                 self.send_error(404)
                 return

@@ -210,12 +210,26 @@ class CoreWorker:
         # Extension RPC handlers (collective groups, channels, ...):
         # name → async fn(conn=..., **kw). Checked before built-ins.
         self.ext_handlers: dict[str, Any] = {}
+        # Head pubsub: channel → sync callback(msg). Populated via
+        # subscribe(); re-issued on head reconnect.
+        self._push_handlers: dict[str, Any] = {}
 
     # ----------------------------------------------------------- startup
     async def start(self, host: str = "127.0.0.1") -> str:
         port = await self.server.start(host, 0)
         self.addr = f"{host}:{port}"
-        self.head = await rpc.connect(self.head_addr)
+        # Reconnecting head client: a head restart is transparent to
+        # drivers/workers (idempotent queries retry across the outage;
+        # reference: RetryableGrpcClient wrapping the gcs client).
+        # Subscriptions re-issue on reconnect — the restarted head's
+        # subscriber table starts empty (reference: resubscribe on
+        # NotifyGCSRestart).
+        self.head = await rpc.ReconnectingClient(
+            self.head_addr,
+            on_push=self._on_head_push,
+            on_reconnect=self._resubscribe,
+            reconnect_timeout=config.get("HEAD_RECONNECT_S"),
+        ).connect()
         # Observer connections (read-only CLI/dashboard) have no local
         # node: head queries and object reads work, task submission does
         # not.
@@ -226,6 +240,25 @@ class CoreWorker:
         self._lease_reaper = asyncio.ensure_future(self._lease_reap_loop())
         self._event_flusher = asyncio.ensure_future(self._flush_events_loop())
         return self.addr
+
+    def _on_head_push(self, payload):
+        """PUSH frame from the head (pubsub delivery)."""
+        try:
+            handler = self._push_handlers.get(payload.get("channel"))
+            if handler is not None:
+                handler(payload.get("msg"))
+        except Exception:  # noqa: BLE001 - a bad handler must not kill recv
+            pass
+
+    async def subscribe(self, channel: str, handler) -> None:
+        """Subscribe to a head pubsub channel; `handler(msg)` runs on the
+        runtime loop for each delivery. Survives head restarts."""
+        self._push_handlers[channel] = handler
+        await self.head.call("subscribe", channel=channel)
+
+    async def _resubscribe(self, conn) -> None:
+        for channel in self._push_handlers:
+            await conn.call("subscribe", channel=channel)
 
     async def stop(self):
         if self._exec_task:

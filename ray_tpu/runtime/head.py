@@ -22,9 +22,21 @@ HEALTH_TIMEOUT_S = 30.0
 
 
 class HeadService:
-    def __init__(self):
+    def __init__(self, journal_path: str | None = None):
         self.server = rpc.Server(self._handle)
         self.addr: str | None = None
+        # Durable-state journal (reference: Redis-backed GCS tables,
+        # redis_store_client.h:126). Off unless a path is configured —
+        # single-driver test clusters don't pay the fsync tax.
+        if journal_path is None:
+            from ray_tpu._private import config
+
+            journal_path = config.get("HEAD_JOURNAL") or None
+        self.journal = None
+        if journal_path:
+            from ray_tpu.runtime.head_storage import FileJournal
+
+            self.journal = FileJournal(journal_path)
         # node_id hex → {addr, resources, labels, last_seen, conn}
         self.nodes: dict[str, dict] = {}
         self.kv: dict[str, bytes] = {}
@@ -53,15 +65,82 @@ class HeadService:
         self.unschedulable: dict[str, tuple[dict, float]] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        if self.journal is not None:
+            self._restore_from_journal()
         p = await self.server.start(host, port)
         self.addr = f"{host}:{p}"
         self._reaper = asyncio.ensure_future(self._health_loop())
         return self.addr
 
+    # --------------------------------------------------------- journal
+    def _journal_append(self, table: str, op: str, payload) -> None:
+        if self.journal is not None:
+            self.journal.append((table, op, payload))
+
+    def _restore_from_journal(self) -> None:
+        """Replay durable tables (KV, actors, PGs), then compact to one
+        snapshot. Node/subscriber state is NOT persisted: nodes
+        re-register through their reconnecting heartbeat (the
+        NotifyGCSRestart equivalent) and re-dial their subscriptions."""
+        for table, op, payload in self.journal.replay():
+            if table == "snapshot" and op == "set":
+                self.kv = dict(payload["kv"])
+                self.actors = {
+                    aid: dict(a) for aid, a in payload["actors"].items()
+                }
+                self.named_actors = dict(payload["named_actors"])
+                self.placement_groups = {
+                    pid: dict(pg)
+                    for pid, pg in payload["placement_groups"].items()
+                }
+            elif table == "kv":
+                if op == "put":
+                    self.kv[payload["key"]] = payload["value"]
+                else:
+                    self.kv.pop(payload["key"], None)
+            elif table == "actor":
+                aid = payload["actor_id"]
+                if op == "put":
+                    self.actors[aid] = dict(payload["fields"])
+                    name = payload["fields"].get("name")
+                    if name:
+                        self.named_actors[name] = aid
+                elif op == "update" and aid in self.actors:
+                    self.actors[aid].update(payload["fields"])
+            elif table == "pg":
+                if op == "put":
+                    self.placement_groups[payload["pg_id"]] = dict(
+                        payload["fields"]
+                    )
+                else:
+                    self.placement_groups.pop(payload["pg_id"], None)
+        self.journal.compact(self._snapshot())
+
+    def _snapshot(self) -> dict:
+        return {
+            "kv": dict(self.kv),
+            "actors": {
+                aid: self._durable_actor(a)
+                for aid, a in self.actors.items()
+            },
+            "named_actors": dict(self.named_actors),
+            "placement_groups": {
+                pid: dict(pg)
+                for pid, pg in self.placement_groups.items()
+            },
+        }
+
+    @staticmethod
+    def _durable_actor(actor: dict) -> dict:
+        """Actor fields safe to pickle (no asyncio lock)."""
+        return {k: v for k, v in actor.items() if k != "_restart_lock"}
+
     async def stop(self):
         if self._reaper:
             self._reaper.cancel()
         await self.server.stop()
+        if self.journal is not None:
+            self.journal.close()
 
     # ------------------------------------------------------------ pubsub
     def publish(self, channel: str, msg: Any):
@@ -212,13 +291,17 @@ class HeadService:
         if not overwrite and key in self.kv:
             return {"ok": False, "exists": True}
         self.kv[key] = value
+        self._journal_append("kv", "put", {"key": key, "value": value})
         return {"ok": True}
 
     async def _on_kv_get(self, conn, key: str):
         return {"ok": key in self.kv, "value": self.kv.get(key)}
 
     async def _on_kv_del(self, conn, key: str):
-        return {"ok": self.kv.pop(key, None) is not None}
+        existed = self.kv.pop(key, None) is not None
+        if existed:
+            self._journal_append("kv", "del", {"key": key})
+        return {"ok": existed}
 
     async def _on_kv_keys(self, conn, prefix: str = ""):
         return {"keys": [k for k in self.kv if k.startswith(prefix)]}
@@ -250,6 +333,14 @@ class HeadService:
             "restart_spec": restart_spec,
             "restarts_used": 0,
         }
+        self._journal_append(
+            "actor",
+            "put",
+            {
+                "actor_id": actor_id,
+                "fields": self._durable_actor(self.actors[actor_id]),
+            },
+        )
         self.publish("actor", {"event": "alive", "actor_id": actor_id})
         return {"ok": True}
 
@@ -272,6 +363,11 @@ class HeadService:
             budget = spec.get("max_restarts", 0)
             if budget != -1 and actor["restarts_used"] >= budget:
                 actor["state"] = "DEAD"
+                self._journal_append(
+                    "actor",
+                    "update",
+                    {"actor_id": actor_id, "fields": {"state": "DEAD"}},
+                )
                 self.publish("actor", {"event": "dead", "actor_id": actor_id})
                 return {"ok": False, "state": "DEAD"}
             actor["restarts_used"] += 1
@@ -283,6 +379,11 @@ class HeadService:
                 addr = await self._recreate_actor(actor_id, actor, spec)
             except Exception as e:  # noqa: BLE001 - no node fits, etc.
                 actor["state"] = "DEAD"
+                self._journal_append(
+                    "actor",
+                    "update",
+                    {"actor_id": actor_id, "fields": {"state": "DEAD"}},
+                )
                 self.publish("actor", {"event": "dead", "actor_id": actor_id})
                 return {"ok": False, "state": "DEAD", "error": repr(e)}
             if actor["state"] == "DEAD":
@@ -291,6 +392,19 @@ class HeadService:
                 await self._kill_worker_quietly(addr)
                 return {"ok": False, "state": "DEAD"}
             actor.update(state="ALIVE", addr=addr)
+            self._journal_append(
+                "actor",
+                "update",
+                {
+                    "actor_id": actor_id,
+                    "fields": {
+                        "state": "ALIVE",
+                        "addr": addr,
+                        "node_id": actor["node_id"],
+                        "restarts_used": actor["restarts_used"],
+                    },
+                },
+            )
             self.publish(
                 "actor",
                 {"event": "alive", "actor_id": actor_id, "addr": addr},
@@ -403,6 +517,9 @@ class HeadService:
         if actor is None:
             return {"ok": False}
         actor["state"] = state
+        self._journal_append(
+            "actor", "update", {"actor_id": actor_id, "fields": {"state": state}}
+        )
         self.publish("actor", {"event": state.lower(), "actor_id": actor_id})
         return {"ok": True}
 
@@ -540,6 +657,11 @@ class HeadService:
             "strategy": strategy,
             "nodes": [nid for nid, _ in placed],
         }
+        self._journal_append(
+            "pg",
+            "put",
+            {"pg_id": pg_id, "fields": dict(self.placement_groups[pg_id])},
+        )
         return {
             "ok": True,
             "nodes": [
@@ -552,6 +674,7 @@ class HeadService:
         pg = self.placement_groups.pop(pg_id, None)
         if pg is None:
             return {"ok": False}
+        self._journal_append("pg", "del", {"pg_id": pg_id})
         for i, nid in enumerate(pg["nodes"]):
             node_conn = self._node_conns.get(nid)
             if node_conn is not None:

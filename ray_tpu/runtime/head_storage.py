@@ -1,0 +1,87 @@
+"""Pluggable head-state persistence (GCS fault tolerance).
+
+The reference backs its GCS tables with a storage abstraction —
+in-memory or Redis (reference: gcs/store_client/redis_store_client.h:126,
+gcs_table_storage.h:200) — so a head restart reloads cluster metadata
+and nodes resubscribe (node_manager.proto:325 NotifyGCSRestart). The
+TPU-native equivalent here is an append-only local journal: every
+durable mutation (KV, actor registry, placement groups) appends one
+pickled record; restart replays the journal and then compacts it to a
+single snapshot record. No external service required — the journal file
+on shared storage is the single-host analogue; the same interface admits
+a Redis-protocol backend later.
+
+Record format: length-prefixed pickle frames, `(table, op, payload)`.
+A truncated tail (crash mid-append) is ignored on replay.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import tempfile
+from typing import Any, Iterator
+
+_HDR = struct.Struct("<I")
+
+
+class FileJournal:
+    """Append-only journal with replay + snapshot compaction."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = None
+
+    # ------------------------------------------------------------ write
+    def append(self, record: tuple) -> None:
+        if self._f is None:
+            self._f = open(self.path, "ab")
+        data = pickle.dumps(record, protocol=5)
+        self._f.write(_HDR.pack(len(data)) + data)
+        self._f.flush()
+
+    # ------------------------------------------------------------- read
+    def replay(self) -> Iterator[tuple]:
+        """All intact records, oldest first; stops at a torn tail."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    return
+                (length,) = _HDR.unpack(hdr)
+                data = f.read(length)
+                if len(data) < length:
+                    return  # torn append from a crash — discard
+                try:
+                    yield pickle.loads(data)
+                except Exception:  # noqa: BLE001 - corrupt frame ends replay
+                    return
+
+    def compact(self, snapshot: Any) -> None:
+        """Atomically replace the journal with one snapshot record."""
+        self.close()
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(self.path) or ".", prefix=".journal-"
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                data = pickle.dumps(("snapshot", "set", snapshot), protocol=5)
+                f.write(_HDR.pack(len(data)) + data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None

@@ -20,7 +20,9 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
+from pathlib import Path
 from typing import Any
 
 from ray_tpu._private import rpc
@@ -166,6 +168,20 @@ class NodeManager:
         )
         self._next_lease = 0
         self._tasks: list[asyncio.Task] = []
+        # Worker log capture (reference: workers write to
+        # /tmp/ray/session_*/logs and log_monitor.py:116 tails + streams
+        # them to drivers). One file per worker on DISK (not shm);
+        # _log_monitor_loop tails them into the "logs" pubsub channel.
+        from ray_tpu._private import config as _config
+
+        self.log_dir = Path(
+            _config.get("LOG_DIR")
+            or os.path.join(
+                tempfile.gettempdir(),
+                f"{os.path.basename(str(store_dir))}-logs",
+            )
+        )
+        self._log_offsets: dict[str, int] = {}  # filename → bytes shipped
         self.spilled_bytes = 0
         self.spilled_objects = 0
         self.oom_kills = 0
@@ -179,18 +195,22 @@ class NodeManager:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         p = await self.server.start(host, port)
         self.addr = f"{host}:{p}"
-        self.head = await rpc.connect(self.head_addr)
-        await self.head.call(
-            "register_node",
-            node_id=self.node_id,
-            addr=self.addr,
-            resources=self.total,
-            labels=self.labels,
-        )
+        from ray_tpu._private import config
+
+        # Reconnecting client: a head restart re-registers this node
+        # (the NotifyGCSRestart-equivalent resubscription,
+        # reference: node_manager.proto:325).
+        self.head = await rpc.ReconnectingClient(
+            self.head_addr,
+            on_reconnect=self._register_with_head,
+            reconnect_timeout=config.get("HEAD_RECONNECT_S"),
+        ).connect()
+        await self._register_with_head(self.head._conn)
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         self._tasks.append(asyncio.ensure_future(self._memory_loop()))
+        self._tasks.append(asyncio.ensure_future(self._log_monitor_loop()))
         # Prestart workers up to the CPU count so the first task burst
         # doesn't pay Python-interpreter spawn latency per lease
         # (reference: WorkerPool prestarts workers, worker_pool.h:280).
@@ -268,18 +288,28 @@ class NodeManager:
             # Workers must not grab the TPU chip the driver holds; they run
             # host code (and JAX CPU) unless a lease says otherwise.
             "JAX_PLATFORMS": jax_platform,
+            # Captured stdio is a pipe-to-file, not a tty: without this,
+            # worker prints sit in libc buffers and never reach the log
+            # pipeline.
+            "PYTHONUNBUFFERED": "1",
         }
-        proc = subprocess.Popen(
-            argv,
-            env=env,
-            stdout=None,
-            stderr=None,
-        )
+        # Capture stdio to a per-worker log file (reference: worker logs
+        # under /tmp/ray/session_*/logs; log_monitor tails them).
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        log_path = self.log_dir / f"worker-{worker_id}.log"
+        with open(log_path, "ab") as log_f:
+            proc = subprocess.Popen(
+                argv,
+                env=env,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+            )
         self.workers[worker_id] = {
             "proc": proc,
             "state": "spawning",
             "env_hash": ehash,
             "runtime_env": runtime_env,
+            "log_path": str(log_path),
         }
         return worker_id
 
@@ -584,6 +614,11 @@ class NodeManager:
         self, conn, pg_id: str, index: int, resources: dict
     ):
         resources = dict(resources)
+        if (pg_id, index) in self.bundles:
+            # Idempotent re-reserve: the head may retry after a lost
+            # response (reference: node_manager.proto documents per-RPC
+            # idempotence for the 2PC prepare/commit).
+            return {"ok": True}
         if not self._available(resources):
             return {
                 "ok": False,
@@ -703,11 +738,126 @@ class NodeManager:
                 fut.set_exception(e)
 
     # ------------------------------------------------------------- loops
+    async def _log_monitor_loop(self):
+        """Tail worker log files and publish new output on the "logs"
+        pubsub channel; drivers subscribed there print it (reference:
+        LogMonitor log_monitor.py:116 tails /tmp/ray/session_*/logs and
+        streams to the driver, worker.py:2295 print_worker_logs)."""
+        MAX_SHIP = 64 * 1024  # per worker per tick; floods are chunked
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(0.3)
+            try:
+                if self.head is None or not self.log_dir.is_dir():
+                    continue
+                for path in self.log_dir.glob("worker-*.log"):
+                    name = path.name
+                    try:
+                        size = path.stat().st_size
+                    except OSError:
+                        continue
+                    off = self._log_offsets.get(name, 0)
+                    if size <= off:
+                        continue
+
+                    def read_chunk(path=path, off=off):
+                        with open(path, "rb") as f:
+                            f.seek(off)
+                            return f.read(MAX_SHIP)
+
+                    data = await loop.run_in_executor(None, read_chunk)
+                    if not data:
+                        continue
+                    self._log_offsets[name] = off + len(data)
+                    wid = name[len("worker-"):-len(".log")]
+                    w = self.workers.get(wid, {})
+                    await self.head.call(
+                        "publish",
+                        channel="logs",
+                        msg={
+                            "worker_id": wid,
+                            "node_id": self.node_id,
+                            "pid": w.get("pid"),
+                            "data": data.decode("utf-8", "replace"),
+                        },
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - log shipping is best-effort
+                pass
+
+    async def _on_list_logs(self, conn):
+        out = []
+        if self.log_dir.is_dir():
+            for path in sorted(self.log_dir.glob("worker-*.log")):
+                wid = path.name[len("worker-"):-len(".log")]
+                w = self.workers.get(wid)
+                try:
+                    size = path.stat().st_size
+                except OSError:
+                    continue
+                out.append(
+                    {
+                        "worker_id": wid,
+                        "size": size,
+                        "alive": bool(
+                            w
+                            and w.get("proc")
+                            and w["proc"].poll() is None
+                        ),
+                    }
+                )
+        return {"logs": out, "node_id": self.node_id}
+
+    async def _on_read_log(
+        self,
+        conn,
+        worker_id: str,
+        offset: int = 0,
+        max_bytes: int = 1 << 20,
+    ):
+        """Serve a worker's captured log — including DEAD workers'
+        (reference: `ray logs` reads session log files after the worker
+        exits). Prefix match on worker_id; negative offset = tail."""
+        matches = [
+            p
+            for p in self.log_dir.glob("worker-*.log")
+            if p.name[len("worker-"):-len(".log")].startswith(worker_id)
+        ]
+        if not matches:
+            return {"ok": False, "error": f"no log for worker {worker_id!r}"}
+        path = sorted(matches)[0]
+        size = path.stat().st_size
+        if offset < 0:
+            offset = max(0, size + offset)
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(max_bytes)
+        return {
+            "ok": True,
+            "worker_id": path.name[len("worker-"):-len(".log")],
+            "offset": offset,
+            "size": size,
+            "data": data,
+        }
+
+    async def _register_with_head(self, conn: "rpc.Connection"):
+        """(Re-)announce this node. Runs at startup AND after every head
+        reconnect, so a restarted head rebuilds its node table from live
+        nodes (reference: raylet re-registration on NotifyGCSRestart)."""
+        await conn.call(
+            "register_node",
+            node_id=self.node_id,
+            addr=self.addr,
+            resources=self.total,
+            labels=self.labels,
+        )
+
     async def _heartbeat_loop(self):
         while True:
             await asyncio.sleep(2.0)
             try:
-                await self.head.call(
+                reply = await self.head.call(
                     "heartbeat",
                     node_id=self.node_id,
                     available=self.available,
@@ -718,6 +868,10 @@ class NodeManager:
                     # pick_node.
                     pending=[dict(r) for r, *_rest in self._pending],
                 )
+                if not reply.get("ok") and reply.get("reregister"):
+                    # The head lost this node's entry (e.g. health-loop
+                    # reap during a long GC pause): rejoin.
+                    await self._register_with_head(self.head._conn)
             except rpc.RpcError:
                 pass
 

@@ -112,6 +112,184 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_start(args) -> int:
+    """Bring up daemonized cluster processes on this host (reference:
+    `ray start --head` / `--address`, scripts/scripts.py:682). One
+    command per host: `start --head` on the first host, `start
+    --address <head>` on the rest."""
+    import os
+    import subprocess
+    import time
+
+    from ray_tpu.daemon import DEFAULT_SESSION_DIR
+
+    session_dir = args.session_dir or DEFAULT_SESSION_DIR
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+
+    if args.head:
+        role = "head"
+        cmd = [
+            sys.executable, "-m", "ray_tpu.daemon", "head",
+            "--host", args.host, "--port", str(args.port),
+            "--session-dir", session_dir,
+        ]
+    else:
+        if not args.address:
+            print(
+                "error: pass --head to start a head, or --address "
+                "host:port to join one",
+                file=sys.stderr,
+            )
+            return 2
+        role = "node"
+        cmd = [
+            sys.executable, "-m", "ray_tpu.daemon", "node",
+            "--address", args.address,
+            "--host", args.host,
+            "--session-dir", session_dir,
+        ]
+    if args.num_cpus is not None:
+        cmd += ["--num-cpus", str(args.num_cpus)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+
+    log_path = os.path.join(session_dir, "logs", f"{role}.log")
+    if args.head:
+        # A stale address file from a crashed prior head would be read
+        # as the NEW head's address the instant the wait loop starts.
+        try:
+            os.unlink(os.path.join(session_dir, "head.addr"))
+        except OSError:
+            pass
+    if args.block:
+        return subprocess.call(cmd)
+    with open(log_path, "ab") as log:
+        proc = subprocess.Popen(
+            cmd, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,  # survive the CLI's terminal
+        )
+    pid_path = os.path.join(session_dir, f"{role}-{proc.pid}.pid")
+    with open(pid_path, "w") as f:
+        f.write(str(proc.pid))
+
+    if args.head:
+        # Wait for the daemon to publish its address.
+        addr_path = os.path.join(session_dir, "head.addr")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                print(
+                    f"head daemon exited rc={proc.returncode}; "
+                    f"see {log_path}",
+                    file=sys.stderr,
+                )
+                return 1
+            if os.path.exists(addr_path):
+                addr = open(addr_path).read().strip()
+                print(f"head started at {addr} (pid {proc.pid})")
+                print(
+                    "join other hosts with: python -m ray_tpu.scripts "
+                    f"start --address {addr}"
+                )
+                print(f"stop with: python -m ray_tpu.scripts stop")
+                return 0
+            time.sleep(0.1)
+        print(f"head did not come up in 30s; see {log_path}",
+              file=sys.stderr)
+        return 1
+    # Node mode: catch immediate failures (bad address, missing auth
+    # token) instead of reporting success for a daemon that already died.
+    time.sleep(1.0)
+    if proc.poll() is not None:
+        print(
+            f"node daemon exited rc={proc.returncode}; see {log_path}",
+            file=sys.stderr,
+        )
+        try:
+            os.unlink(pid_path)
+        except OSError:
+            pass
+        return 1
+    print(f"node started (pid {proc.pid}), joining {args.address}")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    """Stop daemons started by `start` on this host: SIGTERM every
+    tracked pid, escalate to SIGKILL after a grace period (reference:
+    `ray stop`)."""
+    import os
+    import signal as _signal
+    import time
+
+    from ray_tpu.daemon import DEFAULT_SESSION_DIR
+
+    session_dir = args.session_dir or DEFAULT_SESSION_DIR
+    if not os.path.isdir(session_dir):
+        print("nothing to stop (no session dir)")
+        return 0
+    pids = []
+    for name in os.listdir(session_dir):
+        if not name.endswith(".pid"):
+            continue
+        path = os.path.join(session_dir, name)
+        try:
+            pid = int(open(path).read().strip())
+        except (OSError, ValueError):
+            os.unlink(path)
+            continue
+        try:
+            os.kill(pid, _signal.SIGTERM)
+            pids.append((pid, path))
+        except ProcessLookupError:
+            os.unlink(path)
+    deadline = time.monotonic() + args.grace
+    for pid, path in pids:
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        os.unlink(path)
+        print(f"stopped pid {pid}")
+    try:
+        os.unlink(os.path.join(session_dir, "head.addr"))
+    except OSError:
+        pass
+    return 0
+
+
+def cmd_logs(args) -> int:
+    """List or print worker logs across the cluster (reference:
+    `ray logs`, which reads /tmp/ray/session_*/logs via the agents).
+    With no worker id: one line per captured log. With a worker-id
+    prefix: print that worker's log — dead workers included."""
+    from ray_tpu.util import state
+
+    _connect(args.address)
+    if args.worker_id:
+        text = state.read_worker_log(args.worker_id, tail_bytes=args.tail)
+        if text is None:
+            print(f"no log found for worker {args.worker_id!r}",
+                  file=sys.stderr)
+            return 1
+        sys.stdout.write(text)
+        return 0
+    for rec in state.list_worker_logs():
+        status = "alive" if rec["alive"] else "dead"
+        print(
+            f"{rec['worker_id']}  node={rec['node_id'][:12]}  "
+            f"{rec['size']:>8}B  {status}"
+        )
+    return 0
+
+
 def cmd_config(args) -> int:
     """Print the config registry with resolved values (reference: the
     internal-config surface of GetInternalConfig)."""
@@ -132,6 +310,21 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None, help="head address host:port")
     sub = p.add_subparsers(dest="cmd", required=True)
 
+    sp = sub.add_parser("start")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None,
+                    help="head address to join (worker-node mode)")
+    sp.add_argument("--port", type=int, default=6380)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--resources", default=None, help="JSON dict")
+    sp.add_argument("--session-dir", default=None)
+    sp.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    stp = sub.add_parser("stop")
+    stp.add_argument("--session-dir", default=None)
+    stp.add_argument("--grace", type=float, default=10.0)
+
     sub.add_parser("status")
     lp = sub.add_parser("list")
     lp.add_argument(
@@ -142,16 +335,24 @@ def main(argv=None) -> int:
     tp = sub.add_parser("timeline")
     tp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sub.add_parser("metrics")
+    lg = sub.add_parser("logs")
+    lg.add_argument("worker_id", nargs="?", default=None,
+                    help="worker-id prefix; omit to list all logs")
+    lg.add_argument("--tail", type=int, default=0,
+                    help="print only the last N bytes")
     dp = sub.add_parser("dashboard")
     dp.add_argument("--port", type=int, default=8265)
     sub.add_parser("config")
 
     args = p.parse_args(argv)
     return {
+        "start": cmd_start,
+        "stop": cmd_stop,
         "status": cmd_status,
         "list": cmd_list,
         "timeline": cmd_timeline,
         "metrics": cmd_metrics,
+        "logs": cmd_logs,
         "dashboard": cmd_dashboard,
         "config": cmd_config,
     }[args.cmd](args)

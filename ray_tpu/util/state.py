@@ -24,6 +24,69 @@ def _call_head(method: str, **kw) -> dict:
     return rt.run(go())
 
 
+def list_worker_logs() -> list[dict]:
+    """Every captured worker log across the cluster (reference:
+    `ray logs` listing the session log dir via the per-node agents)."""
+    rt = core_api._runtime
+
+    async def fetch():
+        from ray_tpu._private import rpc as _rpc
+
+        table = await rt.core.head.call("node_table")
+        out = []
+        for nid, n in table.items():
+            # Per-node failures (dead host mid-listing, dial timeout)
+            # skip that node — one unreachable node must not break the
+            # cluster-wide listing.
+            try:
+                conn = await _rpc.connect(n["addr"])
+                try:
+                    reply = await conn.call("list_logs")
+                finally:
+                    await conn.close()
+            except (_rpc.RpcError, OSError):
+                continue
+            for rec in reply.get("logs", []):
+                out.append({**rec, "node_id": nid})
+        return out
+
+    return rt.run(fetch())
+
+
+def read_worker_log(worker_prefix: str, tail_bytes: int = 0) -> str | None:
+    """Log content of the first worker matching the prefix — dead
+    workers included. None when no node has a matching log."""
+    rt = core_api._runtime
+
+    async def fetch():
+        from ray_tpu._private import rpc as _rpc
+
+        table = await rt.core.head.call("node_table")
+        for n in table.values():
+            try:
+                conn = await _rpc.connect(n["addr"])
+                try:
+                    reply = await conn.call(
+                        "read_log",
+                        worker_id=worker_prefix,
+                        offset=-tail_bytes if tail_bytes else 0,
+                    )
+                finally:
+                    await conn.close()
+            except (_rpc.RpcError, OSError):
+                continue
+            if reply.get("ok"):
+                data = reply["data"]
+                return (
+                    data.decode("utf-8", "replace")
+                    if isinstance(data, bytes)
+                    else data
+                )
+        return None
+
+    return rt.run(fetch())
+
+
 def list_nodes() -> list[dict]:
     table = _call_head("node_table")
     return [

@@ -1,0 +1,93 @@
+"""Worker log pipeline: capture to per-session files, stream to the
+driver via pubsub, serve dead workers' logs through the CLI (reference:
+LogMonitor log_monitor.py:116, print_worker_logs worker.py:2295,
+`ray logs`).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import ray_tpu
+
+
+def test_driver_sees_worker_print(capfd):
+    ray_tpu.init(num_cpus=2)
+    try:
+
+        @ray_tpu.remote
+        def noisy():
+            print("hello-from-worker-xyz")
+            return 1
+
+        assert ray_tpu.get(noisy.remote(), timeout=60) == 1
+        # file → log monitor (0.3s poll) → pubsub → driver stdout
+        seen = ""
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            seen += capfd.readouterr().out
+            if "hello-from-worker-xyz" in seen:
+                break
+            time.sleep(0.3)
+        assert "hello-from-worker-xyz" in seen
+        # The reference's framing: "(worker pid=N, node=...) line"
+        line = next(
+            ln for ln in seen.splitlines() if "hello-from-worker-xyz" in ln
+        )
+        assert line.startswith("(") and "pid=" in line
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_cli_tails_dead_worker_log(tmp_path):
+    from ray_tpu.util import state
+
+    info = ray_tpu.init(num_cpus=2)
+    try:
+
+        @ray_tpu.remote
+        class Mouth:
+            def say(self):
+                print("last-words-marker")
+                return "said"
+
+        m = Mouth.remote()
+        assert ray_tpu.get(m.say.remote(), timeout=60) == "said"
+        ray_tpu.kill(m)
+
+        # Wait until some worker's log is both dead and non-empty.
+        wid = None
+        deadline = time.time() + 20
+        while time.time() < deadline and wid is None:
+            for rec in state.list_worker_logs():
+                if not rec["alive"] and rec["size"] > 0:
+                    text = state.read_worker_log(rec["worker_id"])
+                    if text and "last-words-marker" in text:
+                        wid = rec["worker_id"]
+                        break
+            time.sleep(0.3)
+        assert wid, "dead worker's log never appeared"
+
+        # The CLI tails it from a separate observer process.
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH", "")) if p
+        )
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "ray_tpu.scripts",
+                "--address", info["address"],
+                "logs", wid[:12],
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+            env=env,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "last-words-marker" in out.stdout
+    finally:
+        ray_tpu.shutdown()

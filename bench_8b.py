@@ -1,0 +1,114 @@
+"""8B-scale single-chip memory validation (BASELINE.md north star
+de-risk): run REAL Llama-3-8B layers — full d_model 4096 / d_ff 14336 /
+32q+8kv heads at head_dim 128 — with the exact remat + flash +
+chunked-CE recipe the pod run would use, sized to one v5e chip the way
+ZeRO-3 shards it.
+
+On a v5p-64 FSDP pod each chip holds 1/64 of params+opt state
+(~16 B/param · 8B / 64 ≈ 2 GB) plus its batch shard's activations. One
+v5e chip can't hold 8B params, so this bench keeps N full-size layers
+plus a PER-CHIP VOCAB SHARD of the embedding/head (8k of 128k rows — the
+full fp32-adamw table is ~15 GB and never sits on one chip even on the
+pod) and runs real train steps at seq 4096. Passing proves the
+activation/remat memory recipe for full-size layers; the full-vocab
+table is only ever exercised sharded, exactly as deployed.
+
+Prints ONE JSON line (separate from bench.py's headline metric).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+
+def run(n_layers: int, batch: int, seq: int, steps: int = 5) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import PRESETS
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.train.step import (
+        init_train_state,
+        jit_train_step,
+        make_optimizer,
+    )
+
+    cfg = dataclasses.replace(
+        PRESETS["llama3_8b"],
+        n_layers=n_layers,
+        # The 128k-vocab embedding/head is ZeRO-sharded on the pod
+        # (~8k rows per chip on a 16-chip slice); model the per-chip
+        # shard, not the full table — full-vocab fp32 adamw alone is
+        # ~15 GB and can never sit on one chip.
+        vocab_size=8192,
+        attn_impl="flash",
+        remat="full",
+    )
+    opt = make_optimizer(total_steps=1000, mu_dtype=jnp.bfloat16)
+    mesh = make_mesh({"dp": 1})
+    step = jit_train_step(cfg, opt, mesh)
+    state = init_train_state(jax.random.key(0), cfg, opt)
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq + 1), 0, cfg.vocab_size
+    )
+    batch_d = {"tokens": tokens}
+    for _ in range(2):
+        state, metrics = step(state, batch_d)
+        float(state.params["final_norm"][0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_d)
+    float(state.params["final_norm"][0])
+    loss = float(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    per_layer_ms = dt / n_layers * 1e3
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        hbm_gb = round(stats.get("peak_bytes_in_use", 0) / 2**30, 2)
+    except Exception:  # noqa: BLE001 - axon may not expose stats
+        hbm_gb = None
+    return {
+        "metric": "llama3_8b_layer_memory_validation",
+        "n_full_layers": n_layers,
+        "params": cfg.num_params(),
+        "batch": batch,
+        "seq": seq,
+        "step_time_s": round(dt, 3),
+        "per_layer_ms": round(per_layer_ms, 1),
+        "tokens_per_sec": round(batch * seq / dt, 1),
+        "loss": round(loss, 3),
+        "peak_hbm_gb": hbm_gb,
+        "ok": True,
+    }
+
+
+def main() -> None:
+    last_err = None
+    # Full-size 8B layers; back off layer count on OOM. 4 layers +
+    # the vocab shard ≈ 3.6 GB params ≈ more than the per-chip ZeRO-3
+    # shard of the real 32-layer model on a 16-chip slice.
+    for n_layers, batch in ((4, 2), (4, 1), (2, 1), (1, 1)):
+        try:
+            print(json.dumps(run(n_layers=n_layers, batch=batch, seq=4096)))
+            return
+        except Exception as e:  # noqa: BLE001 - report whatever happened
+            last_err = f"{type(e).__name__}: {str(e)[:300]}"
+            del e
+            import gc
+
+            gc.collect()
+    print(
+        json.dumps(
+            {
+                "metric": "llama3_8b_layer_memory_validation",
+                "ok": False,
+                "error": last_err,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -91,9 +91,12 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=32768, d_model=768, n_layers=12, n_heads=12, n_kv_heads=4,
         d_ff=2048, max_seq=2048,
     ),
-    # Single-chip benchmark scale (~430M).
+    # Single-chip benchmark scale (~430M). head_dim 128 (Llama-3's) over
+    # 64: the MXU is 128 wide, so D=64 attention runs both kernel
+    # matmuls at half width — same parameter count (h·D and hkv·D
+    # unchanged), ~40% faster attention.
     "bench": LlamaConfig(
-        vocab_size=32768, d_model=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        vocab_size=32768, d_model=1024, n_layers=24, n_heads=8, n_kv_heads=4,
         d_ff=4096, max_seq=2048,
     ),
     # Llama-3-8B (BASELINE.json config 3).

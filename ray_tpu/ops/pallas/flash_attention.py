@@ -28,60 +28,86 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float("-inf")
+# Finite mask value: exp(_MASK - m) underflows to exactly 0 for any
+# finite row max m, so masked positions need NO NaN-guard `where` passes
+# (with -inf they would: exp(-inf - -inf) = NaN). Kept well inside fp32
+# range so (s - m) cannot overflow.
+_MASK = -1e9
+# Running-max initializer: any real score beats it, and exp(_M_INIT - m)
+# underflows to 0 (the first block's rescale factor) without -inf NaNs.
+_M_INIT = -1e30
 _LANES = 128  # TPU vector lane count: scratch stats are lane-replicated
 
 
 # --------------------------------------------------------------- forward
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-    *, block_q: int, block_kv: int, num_kv: int, scale: float, causal: bool,
+    *, block_q: int, block_kv: int, num_kv: int, causal: bool,
 ):
+    """q is PRE-SCALED by the caller (one cheap [S, D] pass instead of a
+    per-block [block_q, block_kv] multiply). Elementwise work is the VPU
+    bottleneck at D=64, so the softmax path is kept to the minimum
+    passes: masking runs ONLY on blocks the diagonal crosses, and the
+    finite _MASK/_M_INIT values make every NaN-guard `where` unnecessary.
+    """
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
     def _init():
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        m_ref[...] = jnp.full_like(m_ref, _M_INIT)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # Causal: a kv block strictly above the diagonal contributes nothing.
-    first_masked = (qi + 1) * block_q  # kv positions >= this are masked
-    run = jnp.logical_or(not causal, ki * block_kv < first_masked)
-
-    @pl.when(run)
-    def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)  # [block_kv, D]
-        v = v_ref[0].astype(jnp.float32)
+    def _accumulate(masked: bool):
+        # Inputs stay in their storage dtype (bf16): the MXU multiplies
+        # bf16 at full rate and accumulates fp32 via
+        # preferred_element_type — upcasting first would waste VPU
+        # passes on [block, D] casts.
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_kv]
-
-        if causal:
+        )  # [block_q, block_kv] fp32
+        if masked:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 0
             )
             kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, 1
             )
-            s = jnp.where(kv_pos > q_pos, _NEG_INF, s)
-
+            s = jnp.where(kv_pos > q_pos, _MASK, s)
         m_prev = m_ref[:, 0]  # [block_q]
         l_prev = l_ref[:, 0]
         m_new = jnp.maximum(m_prev, s.max(axis=-1))
-        # All-masked rows keep m == -inf; exp(-inf - -inf) would be NaN.
-        safe_m = jnp.where(m_new == _NEG_INF, 0.0, m_new)
-        p = jnp.exp(s - safe_m[:, None])
-        p = jnp.where(s == _NEG_INF, 0.0, p)
-        alpha = jnp.where(m_prev == _NEG_INF, 0.0, jnp.exp(m_prev - safe_m))
+        p = jnp.exp(s - m_new[:, None])  # masked entries underflow to 0
+        alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + p.sum(axis=-1)
         acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32,
         )
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    if causal:
+        # Block classes: fully above the diagonal → skip; crossed by the
+        # diagonal → masked softmax; fully below → unmasked (most blocks
+        # at long seq, saving the iota+compare+select passes).
+        crossed = jnp.logical_and(
+            ki * block_kv < (qi + 1) * block_q,
+            (ki + 1) * block_kv - 1 > qi * block_q,
+        )
+        below = (ki + 1) * block_kv - 1 <= qi * block_q
+
+        @pl.when(crossed)
+        def _masked():
+            _accumulate(True)
+
+        @pl.when(below)
+        def _unmasked():
+            _accumulate(False)
+    else:
+        _accumulate(False)
 
     @pl.when(ki == num_kv - 1)
     def _finalize():
@@ -94,13 +120,12 @@ def _fwd_kernel(
         lse_ref[0, 0] = lse.astype(jnp.float32)
 
 
-def _fwd_call(qr, kr, vr, n_rep, causal, scale, block_q, block_kv, interpret):
+def _fwd_call(qr, kr, vr, n_rep, causal, block_q, block_kv, interpret):
     bh, s, d = qr.shape
     num_q, num_kv = s // block_q, s // block_kv
     kernel = functools.partial(
         _fwd_kernel,
-        block_q=block_q, block_kv=block_kv, num_kv=num_kv,
-        scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, num_kv=num_kv, causal=causal,
     )
     return pl.pallas_call(
         kernel,
@@ -136,23 +161,22 @@ def _fwd_call(qr, kr, vr, n_rep, causal, scale, block_q, block_kv, interpret):
 
 
 # -------------------------------------------------------------- backward
-def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, scale,
-                 causal):
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, masked):
+    """Blockwise softmax recompute from the saved lse. q is pre-scaled
+    (see _fwd_kernel); masked entries underflow to exactly 0, so no
+    guard passes are needed."""
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if masked:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         kv_pos = ki * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1
         )
-        s = jnp.where(kv_pos > q_pos, _NEG_INF, s)
-    lse = lse_ref[0, 0]  # [block_q]
-    safe = jnp.where(lse == _NEG_INF, 0.0, lse)
-    p = jnp.exp(s - safe[:, None])
-    return jnp.where(s == _NEG_INF, 0.0, p), s
+        s = jnp.where(kv_pos > q_pos, _MASK, s)
+    lse = lse_ref[0, 0]  # [block_q]; finite for every computed row
+    return jnp.exp(s - lse[:, None])
 
 
 def _dq_kernel(
@@ -166,34 +190,48 @@ def _dq_kernel(
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    run = jnp.logical_or(not causal, ki * block_kv < (qi + 1) * block_q)
-
-    @pl.when(run)
-    def _compute():
-        p, _ = _recompute_p(
-            q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, scale, causal
+    def _accumulate(masked: bool):
+        p = _recompute_p(
+            q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, masked
         )
-        do = do_ref[0].astype(jnp.float32)  # [block_q, D]
-        v = v_ref[0].astype(jnp.float32)  # [block_kv, D]
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [block_q, block_kv]
-        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+        )  # [block_q, block_kv] fp32
+        # q is pre-scaled, so d(score)/d(q_scaled) needs no extra scale
+        # here; the chain-rule factor lands once in _finalize.
+        ds = p * (dp - delta_ref[0, 0][:, None])
         acc_ref[...] += jax.lax.dot(
             ds.astype(k_ref.dtype), k_ref[0],
             preferred_element_type=jnp.float32,
         )
 
+    if causal:
+        crossed = jnp.logical_and(
+            ki * block_kv < (qi + 1) * block_q,
+            (ki + 1) * block_kv - 1 > qi * block_q,
+        )
+        below = (ki + 1) * block_kv - 1 <= qi * block_q
+
+        @pl.when(crossed)
+        def _masked():
+            _accumulate(True)
+
+        @pl.when(below)
+        def _unmasked():
+            _accumulate(False)
+    else:
+        _accumulate(False)
+
     @pl.when(ki == num_kv - 1)
     def _finalize():
-        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
-    *, block_q: int, block_kv: int, num_q: int, scale: float, causal: bool,
+    *, block_q: int, block_kv: int, num_q: int, causal: bool,
 ):
     ki = pl.program_id(1)  # NOTE: kv outer, q inner for this kernel
     qi = pl.program_id(2)
@@ -203,31 +241,48 @@ def _dkv_kernel(
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    # Causal: q blocks entirely before this kv block see none of it.
-    run = jnp.logical_or(not causal, (qi + 1) * block_q > ki * block_kv)
-
-    @pl.when(run)
-    def _compute():
-        p, _ = _recompute_p(
-            q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, scale, causal
+    def _accumulate(masked: bool):
+        p = _recompute_p(
+            q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, masked
         )
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        # dv += p^T @ do
+        do = do_ref[0]
+        # dv += p^T @ do — p downcast to the MXU dtype (flash-standard)
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta_ref[0, 0][:, None]) * scale
-        # dk += ds^T @ q
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        # dk += ds^T @ q_scaled — exactly scale·dsᵀ@q, the chain-rule
+        # factor rides the pre-scaled q.
         dk_acc[...] += jax.lax.dot_general(
-            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    if causal:
+        # q blocks entirely before this kv block see none of it.
+        crossed = jnp.logical_and(
+            (qi + 1) * block_q > ki * block_kv,
+            (ki + 1) * block_kv - 1 > qi * block_q,
+        )
+        below = jnp.logical_and(
+            (qi + 1) * block_q > ki * block_kv,
+            (ki + 1) * block_kv - 1 <= qi * block_q,
+        )
+
+        @pl.when(crossed)
+        def _masked():
+            _accumulate(True)
+
+        @pl.when(below)
+        def _unmasked():
+            _accumulate(False)
+    else:
+        _accumulate(False)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -239,11 +294,16 @@ def _flash_impl(q, k, v, causal, scale, block_q, block_kv, interpret):
     b, s, h, d = q.shape
     hkv = k.shape[2]
     n_rep = h // hkv
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # Pre-scale q once (fused into the transpose by XLA) instead of a
+    # per-block [block_q, block_kv] multiply inside the kernel. Costs
+    # one bf16 rounding of q when scale is not a power of two (d=128 →
+    # 2^-3.5) — the standard flash-kernel tradeoff.
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qr = qs.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
     vr = v.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
     out, lse = _fwd_call(
-        qr, kr, vr, n_rep, causal, scale, block_q, block_kv, interpret
+        qr, kr, vr, n_rep, causal, block_q, block_kv, interpret
     )
     return out, lse
 
@@ -274,7 +334,10 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
     hkv = k.shape[2]
     n_rep = h // hkv
 
-    qr = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    # Kernels consume the pre-scaled q (matches the saved lse; dk then
+    # needs no extra scale and dq scales once at finalize).
+    qs = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    qr = qs.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     # kv stays at Hkv heads: kernels read the shared head via the same
     # bh // n_rep index map as the forward (no materialized repeat).
     kr = k.transpose(0, 2, 1, 3).reshape(b * hkv, s, d)
@@ -320,7 +383,7 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
     dk_e, dv_e = pl.pallas_call(
         functools.partial(
             _dkv_kernel, block_q=block_q, block_kv=block_kv, num_q=num_q,
-            scale=scale, causal=causal,
+            causal=causal,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[

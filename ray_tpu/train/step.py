@@ -51,13 +51,20 @@ def make_optimizer(
     total_steps: int = 10000,
     weight_decay: float = 0.1,
     grad_clip: float = 1.0,
+    mu_dtype=None,
 ) -> optax.GradientTransformation:
+    """``mu_dtype=jnp.bfloat16`` halves the first-moment memory (the
+    8-bit-optimizer-style tradeoff; the variance stays fp32) — measured
+    loss-neutral on the bench model and frees HBM for batch at 8B."""
     sched = optax.warmup_cosine_decay_schedule(
         0.0, lr, warmup, max(total_steps, warmup + 1), lr * 0.1
     )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        optax.adamw(
+            sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+            mu_dtype=mu_dtype,
+        ),
     )
 
 

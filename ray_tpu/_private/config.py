@@ -28,6 +28,8 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                      "an infeasible lease"),
     "MEMORY_THRESHOLD": (float, 0.95, "system memory fraction that "
                                       "triggers the OOM worker killer"),
+    "HEALTH_TIMEOUT_S": (float, 30.0, "heartbeat silence before the head "
+                                      "declares a node dead"),
     "FAKE_MEMORY_FRAC_FILE": (str, "", "test hook: read memory fraction "
                                        "from this file"),
     "FAKE_CHIPS": (str, "", "test hook: report this many TPU chips"),

@@ -10,8 +10,11 @@ from __future__ import annotations
 import time
 
 
-def timeit(name: str, fn, multiplier: int = 1, trials: int = 3) -> dict:
-    fn()  # warmup
+def timeit(
+    name: str, fn, multiplier: int = 1, trials: int = 3, warmup: bool = True
+) -> dict:
+    if warmup:
+        fn()
     rates = []
     for _ in range(trials):
         t0 = time.perf_counter()
@@ -96,6 +99,21 @@ def main(quick: bool = False) -> list[dict]:
 
         results.append(timeit(f"actor calls async x{n}", actor_async, n))
         ray_tpu.kill(c)
+
+        # Queued-task stress (reference envelope: 1M tasks queued on one
+        # node, release/benchmarks/README.md:32 — scaled to CI time):
+        # submit a burst far beyond worker capacity, drain it all.
+        burst = 1000 if quick else 10_000
+
+        def queue_burst():
+            ray_tpu.get(
+                [noop.remote() for _ in range(burst)], timeout=600
+            )
+
+        # warmup=False: running a 10k burst twice for one measurement
+        # doubles the suite's most expensive bench for no signal.
+        results.append(timeit(f"queued burst x{burst}", queue_burst, burst,
+                              trials=1, warmup=False))
     finally:
         ray_tpu.shutdown()
     results.extend(collective_bench(quick=quick))
@@ -169,6 +187,21 @@ def collective_bench(quick: bool = False) -> list[dict]:
 
 
 if __name__ == "__main__":
+    import json
     import sys
 
-    main(quick="--quick" in sys.argv)
+    results = main(quick="--quick" in sys.argv)
+    for i, a in enumerate(sys.argv):
+        if a == "--json" and i + 1 < len(sys.argv):
+            with open(sys.argv[i + 1], "w") as f:
+                json.dump(
+                    {
+                        "results": results,
+                        "note": "control-plane microbenchmarks "
+                                "(ray_perf.py equivalent); floors "
+                                "enforced by tests/test_perf_floors.py",
+                    },
+                    f,
+                    indent=2,
+                )
+            print(f"wrote {sys.argv[i + 1]}")

@@ -1249,8 +1249,14 @@ class CoreWorker:
                         # dead earlier attempt can't interleave.
                         spec = {**spec, "attempt": attempt}
                         self._gen_attempt[spec["task_id"]] = attempt
+                    # Resolve self-owned deps BEFORE leasing (reference:
+                    # LocalDependencyResolver dependency_resolver.h:36 —
+                    # no worker is held while upstream tasks run, and
+                    # arg locations are known for the locality hint).
+                    await self._wait_own_deps(spec)
                     lease = await self._lease(
-                        resources, placement, runtime_env, scheduling
+                        resources, placement, runtime_env, scheduling,
+                        locality=self._locality_hint(spec),
                     )
                     if state["cancelled"]:  # cancelled while queued
                         raise TaskCancelledError(
@@ -1402,12 +1408,44 @@ class CoreWorker:
             None if scheduling is None else freeze(scheduling),
         )
 
+    async def _wait_own_deps(self, spec: dict) -> None:
+        """Wait until every by-ref arg OWNED BY THIS PROCESS reaches a
+        terminal state (value, store location, or error). Refs owned by
+        other processes resolve at the executing worker as before."""
+        for entry in spec.get("args", ()):
+            if entry[1] != "ref" or entry[3] != self.addr:
+                continue
+            await self._wait_local(entry[2], timeout=None)
+
+    def _locality_hint(self, spec: dict) -> str | None:
+        """Node holding most of this task's store-resident args, if it is
+        not the local node (reference: the locality-aware LeasePolicy,
+        lease_policy.h — prefer the raylet already holding the task's
+        dependencies so args need no transfer). Only refs THIS process
+        owns carry location info; best-effort by design."""
+        counts: dict[str, int] = {}
+        for entry in spec.get("args", ()):
+            if entry[1] != "ref":
+                continue
+            loc = self.memory.get(entry[2])
+            if loc and loc[0] == "in_store":
+                # holder None = the LOCAL node's store; it must vote too,
+                # or one remote arg outweighs any number of local ones.
+                holder = loc[1] or self.node_addr
+                if holder:
+                    counts[holder] = counts.get(holder, 0) + 1
+        if not counts:
+            return None
+        best = max(counts, key=lambda a: counts[a])
+        return best if best != self.node_addr else None
+
     async def _lease(
         self,
         resources: dict | None,
         placement: tuple | None = None,
         runtime_env: dict | None = None,
         scheduling: dict | None = None,
+        locality: str | None = None,
     ) -> dict:
         if placement is not None:
             # Bundle-backed lease on the bundle's node; never cached.
@@ -1438,7 +1476,8 @@ class CoreWorker:
         fut = asyncio.get_running_loop().create_future()
         pool["waiters"].append(fut)
         self._maybe_request_lease(
-            key, dict(resources or {"CPU": 1.0}), runtime_env, scheduling
+            key, dict(resources or {"CPU": 1.0}), runtime_env, scheduling,
+            locality=locality,
         )
         return await fut
 
@@ -1455,6 +1494,7 @@ class CoreWorker:
         resources: dict,
         runtime_env: dict | None = None,
         scheduling: dict | None = None,
+        locality: str | None = None,
     ):
         """Pipeline lease requests: keep at most min(#waiters, cap)
         requests in flight per scheduling class."""
@@ -1467,7 +1507,32 @@ class CoreWorker:
 
         async def request():
             try:
-                if scheduling is not None:
+                reply = None
+                if (
+                    scheduling is None
+                    and locality
+                    and self.node is not None
+                ):
+                    # Locality-first: lease from the node already
+                    # holding the args. Best-effort — unreachable or
+                    # infeasible holder falls through to the normal
+                    # local-then-spill path (reference: LeasePolicy
+                    # picks the raylet, spillback corrects it).
+                    try:
+                        lconn = await self._connect(locality)
+                        lreply = await lconn.call(
+                            "lease_worker",
+                            resources=resources,
+                            runtime_env=runtime_env,
+                        )
+                        if lreply.get("ok"):
+                            lreply["node_conn"] = lconn
+                            reply = lreply
+                    except (rpc.RpcError, OSError):
+                        pass
+                if reply is not None:
+                    pass
+                elif scheduling is not None:
                     reply = await self._lease_with_strategy(
                         resources, runtime_env, scheduling
                     )
@@ -1513,7 +1578,10 @@ class CoreWorker:
                         break
             # Top up if demand still outstrips supply.
             if pool["waiters"]:
-                self._maybe_request_lease(key, resources, runtime_env, scheduling)
+                self._maybe_request_lease(
+                    key, resources, runtime_env, scheduling,
+                    locality=locality,
+                )
 
         asyncio.ensure_future(request())
 

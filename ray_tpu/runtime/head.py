@@ -18,9 +18,6 @@ from typing import Any
 from ray_tpu._private import rpc
 from ray_tpu._private.ids import ActorID, NodeID
 
-HEALTH_TIMEOUT_S = 30.0
-
-
 class HeadService:
     def __init__(self, journal_path: str | None = None):
         self.server = rpc.Server(self._handle)
@@ -238,7 +235,11 @@ class HeadService:
         from ray_tpu.util.scheduling_strategies import labels_match
 
         resources = resources or {}
-        best, best_score = None, None
+        # Hybrid policy (reference: hybrid_scheduling_policy.h:25-50):
+        # skip infeasible, prefer nodes that can run NOW, rank by
+        # post-placement utilization, then pick RANDOMLY among the top-k
+        # so concurrent drivers don't herd onto one node.
+        candidates: list[tuple[tuple, str]] = []
         for nid, node in self.nodes.items():
             avail = node["available"]
             total = node["resources"]
@@ -257,14 +258,36 @@ class HeadService:
                 if labels_soft
                 else 0
             )
-            free = sum(avail.get(k, 0) for k in resources) if resources else 1
-            score = (
-                all(avail.get(k, 0) >= v for k, v in resources.items()),
-                soft_hits,
-                free,
+            available_now = all(
+                avail.get(k, 0) >= v for k, v in resources.items()
             )
-            if best_score is None or score > best_score:
-                best, best_score = nid, score
+            # Utilization AFTER placing this request: max over the
+            # requested resource kinds (the reference's critical
+            # resource), 0 when nothing specific is requested.
+            util = max(
+                (
+                    (total[k] - avail.get(k, 0) + v) / total[k]
+                    for k, v in resources.items()
+                    if total.get(k, 0) > 0
+                ),
+                default=0.0,
+            )
+            candidates.append(
+                ((not available_now, -soft_hits, util), nid)
+            )
+        best = None
+        if candidates:
+            import random
+
+            candidates.sort(key=lambda c: c[0])
+            top_k = candidates[: min(3, len(candidates))]
+            # Only mix nodes of the SAME (availability, soft-label)
+            # class: never pick a busy node while an idle one is in the
+            # slice, and never trade a soft-label match for spread.
+            top_k = [
+                c for c in top_k if c[0][:2] == top_k[0][0][:2]
+            ]
+            best = random.choice(top_k)[1]
         if best is None:
             # Record cluster-wide unschedulable demand: the autoscaler's
             # strongest scale-up signal (reference: pending demand in
@@ -762,11 +785,15 @@ class HeadService:
     async def _health_loop(self):
         """Mark nodes dead on heartbeat timeout (reference:
         gcs_health_check_manager.h:45 does active gRPC probes)."""
+        from ray_tpu._private import config
+
         while True:
-            await asyncio.sleep(5.0)
+            await asyncio.sleep(
+                min(5.0, config.get("HEALTH_TIMEOUT_S") / 3)
+            )
             now = time.monotonic()
             for nid, node in list(self.nodes.items()):
-                if now - node["last_seen"] > HEALTH_TIMEOUT_S:
+                if now - node["last_seen"] > config.get("HEALTH_TIMEOUT_S"):
                     del self.nodes[nid]
                     conn = self._node_conns.pop(nid, None)
                     if conn is not None:

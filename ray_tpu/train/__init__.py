@@ -24,11 +24,13 @@ from ray_tpu.train.session import (
     report,
 )
 from ray_tpu.train.trainer import (
+    ElasticScalingPolicy,
     FailureConfig,
     JaxTrainer,
     Result,
     RunConfig,
     ScalingConfig,
+    ScalingPolicy,
 )
 
 __all__ = [
@@ -46,9 +48,11 @@ __all__ = [
     "get_context",
     "get_dataset_shard",
     "report",
+    "ElasticScalingPolicy",
     "FailureConfig",
     "JaxTrainer",
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "ScalingPolicy",
 ]

@@ -61,6 +61,57 @@ class FailureConfig:
     max_failures: int = 0
 
 
+class ScalingPolicy:
+    """Decides each attempt's worker-group size (reference:
+    train/v2/_internal/execution/scaling_policy/scaling_policy.py).
+    The default keeps the configured size: a failed attempt retries at
+    full width."""
+
+    def workers_for_attempt(
+        self, scaling: "ScalingConfig", attempt: int, cluster_free: list[dict]
+    ) -> int:
+        del attempt, cluster_free
+        return scaling.num_workers
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Re-fit the worker group to what the cluster can actually place.
+
+    A TPU slice is atomic — losing one host loses the whole slice — so
+    after a failure the next attempt resizes to however many worker
+    bundles still fit (floor min_workers), restoring from the latest
+    checkpoint instead of waiting for the dead slice to come back
+    (SURVEY.md §7 hard parts; reference resize semantics:
+    scaling_policy.py + slice-atomic failure handling)."""
+
+    def __init__(self, min_workers: int = 1):
+        if min_workers < 1:
+            raise ValueError("min_workers must be >= 1")
+        self.min_workers = min_workers
+
+    def workers_for_attempt(
+        self, scaling: "ScalingConfig", attempt: int, cluster_free: list[dict]
+    ) -> int:
+        if attempt == 0:
+            return scaling.num_workers
+        bundle = scaling.bundle()
+        spread = scaling.placement_strategy in ("SPREAD", "STRICT_SPREAD")
+        fit = 0
+        for avail in cluster_free:
+            per_node = min(
+                (
+                    int(avail.get(k, 0.0) // v)
+                    for k, v in bundle.items()
+                    if v > 0
+                ),
+                default=0,
+            )
+            # STRICT_SPREAD needs a distinct node per bundle; counting
+            # stacked bundles would size an infeasible attempt.
+            fit += min(per_node, 1) if spread else per_node
+        return max(self.min_workers, min(scaling.num_workers, fit))
+
+
 @dataclass
 class RunConfig:
     name: str = "train_run"
@@ -170,12 +221,14 @@ class JaxTrainer:
         train_loop_config: dict | None = None,
         scaling_config: ScalingConfig | None = None,
         run_config: RunConfig | None = None,
+        scaling_policy: ScalingPolicy | None = None,
         datasets: dict | None = None,
     ):
         self.train_loop = train_loop_per_worker
         self.config = train_loop_config or {}
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.scaling_policy = scaling_policy or ScalingPolicy()
         # name → ray_tpu.data.Dataset; split per worker at fit() time
         # (reference: DataConfig splits ray.data streams per worker,
         # train/v2/_internal/data_integration/).
@@ -208,8 +261,11 @@ class JaxTrainer:
         latest_checkpoint: str | None = None
         last_err: Exception | None = None
         while True:
+            n = self.scaling_policy.workers_for_attempt(
+                self.scaling, failures, self._cluster_free()
+            )
             try:
-                return self._run_attempt(latest_checkpoint, failures)
+                return self._run_attempt(latest_checkpoint, failures, n)
             except Exception as e:  # noqa: BLE001 - controller retry loop
                 last_err = e
                 failures += 1
@@ -218,12 +274,32 @@ class JaxTrainer:
                 )
                 if failures > self.run_config.failure_config.max_failures:
                     break
+                # Let the cluster view settle before sizing the retry:
+                # the dead slice must age out of the node table
+                # (HEALTH_TIMEOUT_S) and survivors' heartbeats must
+                # republish bundles freed by the failed attempt's PG.
+                from ray_tpu._private import config as _config
+
+                time.sleep(_config.get("HEALTH_TIMEOUT_S") + 2.0)
         return Result(
             metrics={},
             checkpoint=latest_checkpoint,
             path=self._run_dir(),
             error=last_err,
         )
+
+    def _cluster_free(self) -> list[dict]:
+        """Per-live-node available resources (the scaling policy's view
+        of what an attempt can place)."""
+        try:
+            rt = ray_tpu.api._runtime
+            status = rt.run(rt.core.head.call("cluster_status"))
+            return [
+                dict(n.get("available", {}))
+                for n in status.get("nodes", {}).values()
+            ]
+        except Exception:  # noqa: BLE001 - policy falls back to config
+            return []
 
     def _run_dir(self) -> str:
         import os
@@ -243,12 +319,15 @@ class JaxTrainer:
         )
         return os.path.join(d, cks[-1]) if cks else None
 
-    def _backend_env(self, rank: int, attempt: int = 0) -> dict:
+    def _backend_env(
+        self, rank: int, attempt: int = 0, n_workers: int | None = None
+    ) -> dict:
         """Worker env for the JAX backend (reference: _JaxBackend
         v2/jax/config.py:32 _setup_jax_distributed_environment)."""
+        n = n_workers or self.scaling.num_workers
         env = {
             "RAY_TPU_TRAIN_RANK": str(rank),
-            "RAY_TPU_TRAIN_WORLD": str(self.scaling.num_workers),
+            "RAY_TPU_TRAIN_WORLD": str(n),
         }
         if self.scaling.topology:
             env["TPU_TOPOLOGY"] = self.scaling.topology
@@ -256,15 +335,18 @@ class JaxTrainer:
             # TPU workers own the chip runtime; everything else stays on
             # the JAX CPU backend so it never contends for the slice.
             env["RAY_TPU_WORKER_JAX_PLATFORMS"] = ""
-        if self.scaling.distributed and self.scaling.num_workers > 1:
+        if self.scaling.distributed and n > 1:
             env["RAY_TPU_TRAIN_DISTRIBUTED"] = "1"
             env["RAY_TPU_TRAIN_ATTEMPT"] = str(attempt)
         return env
 
     def _run_attempt(
-        self, latest_checkpoint: str | None, attempt: int = 0
+        self,
+        latest_checkpoint: str | None,
+        attempt: int = 0,
+        n_workers: int | None = None,
     ) -> Result:
-        n = self.scaling.num_workers
+        n = n_workers or self.scaling.num_workers
         pg = placement_group(
             [self.scaling.bundle() for _ in range(n)],
             strategy=self.scaling.placement_strategy,
@@ -286,7 +368,7 @@ class JaxTrainer:
                         self.run_config.storage_path,
                         self.config,
                         latest_checkpoint,
-                        self._backend_env(i, attempt),
+                        self._backend_env(i, attempt, n),
                         shards[i],
                     )
                     for i, w in enumerate(workers)

@@ -1,0 +1,146 @@
+"""Elastic training: when a slice dies mid-run, the trainer resizes the
+worker group to what still fits and continues from the last checkpoint
+(reference: train/v2 ScalingPolicy + slice-atomic failure semantics,
+SURVEY.md §7 hard parts).
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import api as core_api
+from ray_tpu._private import config as _config
+from ray_tpu.train import (
+    ElasticScalingPolicy,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture
+def two_slice_cluster(tmp_path):
+    """Main node with NO slice resource + two extra 1-SLICE nodes; fast
+    node-death detection so the resize test doesn't wait 30s."""
+    info = ray_tpu.init(
+        num_cpus=2, _system_config={"HEALTH_TIMEOUT_S": 4.0}
+    )
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+    nodes = []
+
+    async def launch(i):
+        node = NodeManager(
+            rt.core.head_addr,
+            str(tmp_path / f"slice{i}_store"),
+            resources={"CPU": 2, "SLICE": 1},
+        )
+        await node.start()
+        return node
+
+    for i in range(2):
+        nodes.append(rt.run(launch(i)))
+    yield info, nodes
+    for node in nodes:
+        try:
+            rt.run(node.stop())
+        except Exception:  # noqa: BLE001 - may already be dead
+            pass
+    ray_tpu.shutdown()
+    _config._overrides.pop("HEALTH_TIMEOUT_S", None)
+    os.environ.pop("RAY_TPU_HEALTH_TIMEOUT_S", None)
+
+
+def _loop(config):
+    """Checkpoints each 'epoch'; rank 0 of the first attempt signals
+    readiness (so the test can kill a slice) then blocks until its node
+    dies with it."""
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    start_epoch = 0
+    ck = train.get_checkpoint()
+    if ck:
+        with open(os.path.join(ck, "state.json")) as f:
+            start_epoch = json.load(f)["epoch"] + 1
+
+    marker = config["marker"]
+    for epoch in range(start_epoch, config["epochs"]):
+        ckdir = os.path.join(
+            config["scratch"], f"rank{ctx.rank}_ep{epoch}"
+        )
+        os.makedirs(ckdir, exist_ok=True)
+        with open(os.path.join(ckdir, "state.json"), "w") as f:
+            json.dump({"epoch": epoch, "world": ctx.world_size}, f)
+        train.report(
+            {"epoch": epoch, "world": ctx.world_size}, checkpoint=ckdir
+        )
+        if epoch == 0 and ctx.world_size == 2:
+            if ctx.rank == 0:
+                with open(marker, "w") as f:
+                    f.write("ready")
+            # First attempt stalls here; the test kills slice 1 and the
+            # whole attempt fails (slice-atomic).
+            time.sleep(600)
+
+
+def test_slice_death_resizes_and_resumes(two_slice_cluster, tmp_path):
+    info, nodes = two_slice_cluster
+    marker = str(tmp_path / "ready")
+    scratch = str(tmp_path / "ck_scratch")
+    os.makedirs(scratch, exist_ok=True)
+
+    trainer = JaxTrainer(
+        _loop,
+        train_loop_config={
+            "epochs": 3,
+            "marker": marker,
+            "scratch": scratch,
+        },
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"SLICE": 1.0}
+        ),
+        scaling_policy=ElasticScalingPolicy(min_workers=1),
+        run_config=RunConfig(
+            name="elastic_run",
+            storage_path=str(tmp_path / "results"),
+            failure_config=FailureConfig(max_failures=3),
+        ),
+    )
+
+    import threading
+
+    def killer():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not os.path.exists(marker):
+            time.sleep(0.2)
+        # Hard-kill slice 1: its workers die with it (slice-atomic).
+        rt = core_api._runtime
+        node = nodes[1]
+        for w in list(node.workers.values()):
+            proc = w.get("proc")
+            if proc and proc.poll() is None:
+                proc.kill()
+        rt.run(node.stop())
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    result = trainer.fit()
+    t.join(timeout=30)
+
+    assert result.error is None, result.error
+    # The run finished at the reduced world size...
+    assert result.metrics["world"] == 1
+    assert result.metrics["epoch"] == 2
+    # ...and RESUMED from the checkpoint (epoch 0 ran only in attempt 0;
+    # the world-1 attempt starts at epoch 1).
+    ck = result.checkpoint
+    assert ck is not None
+    with open(os.path.join(ck, "state.json")) as f:
+        final = json.load(f)
+    assert final == {"epoch": 2, "world": 1}

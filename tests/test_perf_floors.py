@@ -1,0 +1,37 @@
+"""Control-plane performance floors (reference: `ray microbenchmark`
+ray_perf.py runs in release CI). The committed PERF.json records full-run
+numbers; this test runs the quick suite and enforces conservative floors
+so the control plane cannot silently regress by an order of magnitude.
+"""
+
+from ray_tpu._private import perf
+
+# name-prefix → minimum ops/s. Set ~10x below measured dev-box rates
+# (PERF.json) to absorb CI noise while still catching real regressions.
+FLOORS = {
+    "put (100 B)": 400.0,
+    "get (100 B, cached owner)": 800.0,
+    "put (1 MiB)": 80.0,
+    "task submit+get (sync)": 80.0,
+    "tasks async": 150.0,
+    "actor call (sync)": 100.0,
+    "actor calls async": 200.0,
+    "queued burst": 100.0,
+}
+
+
+def test_microbench_floors():
+    results = perf.main(quick=True)
+    by_name = {r["name"]: r for r in results if "ops_per_s" in r}
+    failures = []
+    for prefix, floor in FLOORS.items():
+        match = next(
+            (r for name, r in by_name.items() if name.startswith(prefix)),
+            None,
+        )
+        assert match is not None, f"benchmark {prefix!r} missing"
+        if match["ops_per_s"] < floor:
+            failures.append(
+                f"{match['name']}: {match['ops_per_s']:.0f} < {floor} ops/s"
+            )
+    assert not failures, "control-plane regressions:\n" + "\n".join(failures)

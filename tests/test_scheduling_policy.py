@@ -1,0 +1,85 @@
+"""Scheduler policy depth: hybrid top-k placement at the head and
+locality-aware leasing at the submitter (reference:
+hybrid_scheduling_policy.h:25-50, lease_policy.h).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import api as core_api
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=2)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def remote_node(cluster, tmp_path_factory):
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+    store_dir = str(tmp_path_factory.mktemp("loc_store"))
+
+    async def launch():
+        node = NodeManager(
+            rt.core.head_addr,
+            store_dir,
+            resources={"CPU": 2, "REMOTE": 2},
+        )
+        await node.start()
+        return node
+
+    node = rt.run(launch())
+    yield node
+    rt.run(node.stop())
+
+
+def test_lease_follows_arg_locality(cluster, remote_node):
+    """A task whose store-resident arg lives on another node leases THERE
+    (no arg transfer) even without resource pins."""
+
+    @ray_tpu.remote(resources={"REMOTE": 1.0})
+    def produce():
+        return np.arange(1_000_000, dtype=np.float64)  # 8 MB, store-resident
+
+    ref = produce.remote()
+
+    @ray_tpu.remote
+    def consume(x):
+        import os
+
+        return os.environ["RAY_TPU_NODE_ADDR"], float(x[10])
+
+    where, v = ray_tpu.get(consume.remote(ref), timeout=120)
+    assert v == 10.0
+    assert where == remote_node.addr, (
+        f"consumer ran on {where}, arg lives on {remote_node.addr}"
+    )
+
+
+def test_pick_node_prefers_available_and_spreads(cluster, remote_node):
+    """pick_node never chooses a saturated node over an idle one, and
+    spreads across equally-idle nodes (random top-k, anti-herding)."""
+    rt = core_api._runtime
+
+    async def pick(resources):
+        return await rt.core.head.call("pick_node", resources=resources)
+
+    # Both nodes expose CPU; request a resource only one node has spare
+    # capacity for after loading the other: simulate load by asking for
+    # REMOTE (only remote_node has it).
+    reply = rt.run(pick({"REMOTE": 1.0}))
+    assert reply["ok"] and reply["addr"] == remote_node.addr
+
+    # CPU exists on both idle nodes: over many picks both must appear
+    # (random among top-k instead of deterministic herding).
+    seen = set()
+    for _ in range(40):
+        reply = rt.run(pick({"CPU": 1.0}))
+        assert reply["ok"]
+        seen.add(reply["addr"])
+    assert len(seen) >= 2, f"herded onto {seen}"

@@ -114,10 +114,76 @@ def main(quick: bool = False) -> list[dict]:
         # doubles the suite's most expensive bench for no signal.
         results.append(timeit(f"queued burst x{burst}", queue_burst, burst,
                               trials=1, warmup=False))
+        results.extend(dag_pipeline_bench(quick=quick))
     finally:
         ray_tpu.shutdown()
     results.extend(collective_bench(quick=quick))
     return results
+
+
+def dag_pipeline_bench(quick: bool = False) -> list[dict]:
+    """Compiled-DAG pipeline throughput, overlap on vs off (reference:
+    the overlapped execution schedule dag_node_operation.py:576-593).
+    Records BOTH modes so the tradeoff stays visible: on this runtime
+    the GIL serializes the channel copies with compute, so the
+    prefetch/writer threads measure net-NEGATIVE for small host payloads
+    — which is why DAG_OVERLAP defaults off. Device tensors never ride
+    host channels anyway (tensor transport / collective permute).
+
+    Submission is WINDOWED: a compiled pipeline only buffers
+    nslots×stages executions, so submit-all-then-read deadlocks past
+    that depth.
+    """
+    import ray_tpu
+    from ray_tpu._private import config as _config
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def work(self, x):
+            return x + 1
+
+    n_exec = 300 if quick else 2000
+    out: list[dict] = []
+    for overlap in (True, False):
+        _config._overrides["DAG_OVERLAP"] = overlap
+        try:
+            stages = [Stage.remote() for _ in range(3)]
+            with InputNode() as inp:
+                node = inp
+                for s in stages:
+                    node = s.work.bind(node)
+                dag = node.experimental_compile()
+            try:
+                dag.execute(0).get(timeout=60)  # warm the loops
+                t0 = time.perf_counter()
+                window = []
+                for i in range(n_exec):
+                    window.append(dag.execute(i))
+                    if len(window) >= 6:
+                        window.pop(0).get(timeout=120)
+                while window:
+                    window.pop(0).get(timeout=120)
+                dt = time.perf_counter() - t0
+            finally:
+                dag.teardown()
+                for s in stages:
+                    # Free the actors' CPU leases: the next mode's trio
+                    # must fit on the same small bench cluster.
+                    try:
+                        ray_tpu.kill(s)
+                    except Exception:  # noqa: BLE001
+                        pass
+        finally:
+            _config._overrides.pop("DAG_OVERLAP", None)
+        rate = n_exec / dt
+        rec = {
+            "name": f"dag 3-stage pipeline overlap={overlap}",
+            "ops_per_s": rate,
+        }
+        print(f"{rec['name']:<46s} {rate:>12.1f} ops/s")
+        out.append(rec)
+    return out
 
 
 def collective_bench(quick: bool = False) -> list[dict]:

@@ -25,6 +25,7 @@ from ray_tpu.dag.node import (
     MultiOutputNode,
     allgather,
     allreduce,
+    permute,
     reducescatter,
 )
 
@@ -43,5 +44,6 @@ __all__ = [
     "ChannelTimeout",
     "allreduce",
     "allgather",
+    "permute",
     "reducescatter",
 ]

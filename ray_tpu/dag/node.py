@@ -96,12 +96,21 @@ class CollectiveNode(DAGNode):
     one collective share an `op_id`; compile initializes one collective
     group per op across the participating actors."""
 
-    def __init__(self, op_id: int, kind: str, parent: DAGNode, reduce_op, peers: int):
+    def __init__(
+        self,
+        op_id: int,
+        kind: str,
+        parent: DAGNode,
+        reduce_op,
+        peers: int,
+        perm: list[tuple[int, int]] | None = None,
+    ):
         super().__init__(args=(parent,))
         self.op_id = op_id
         self.kind = kind
         self.reduce_op = reduce_op
         self.peers = peers
+        self.perm = perm
 
     @property
     def parent(self) -> DAGNode:
@@ -146,6 +155,34 @@ class _CollectiveVerb:
 allreduce = _CollectiveVerb("allreduce")
 allgather = _CollectiveVerb("allgather")
 reducescatter = _CollectiveVerb("reducescatter")
+
+
+class _PermuteVerb(_CollectiveVerb):
+    """Point-to-point rank rotation as a DAG node — the
+    collective_permute channel for pipeline-parallel stage handoff
+    (reference: NCCL P2P channels nccl_group.py; TPU-native equivalent
+    is lax.ppermute over ICI — XlaMeshGroup.permute). Each node's output
+    is the value sent by its source rank in ``perm`` (None if no edge
+    targets it)."""
+
+    def __init__(self):
+        super().__init__("permute")
+
+    def bind(self, nodes, perm: list[tuple[int, int]]):
+        bound = super().bind(nodes)
+        perm = [(int(s), int(d)) for s, d in perm]
+        world = len(bound)
+        for s, d in perm:
+            if not (0 <= s < world and 0 <= d < world):
+                raise ValueError(f"perm edge {(s, d)} outside 0..{world-1}")
+        if len({d for _s, d in perm}) != len(perm):
+            raise ValueError("permute: a rank receives from two sources")
+        for n in bound:
+            n.perm = perm
+        return bound
+
+
+permute = _PermuteVerb()
 
 
 def _eager(node: DAGNode, exec_args: tuple, exec_kwargs: dict):

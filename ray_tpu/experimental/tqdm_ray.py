@@ -143,5 +143,8 @@ def enable_display(out=None) -> None:
         await conn.call("subscribe", channel="tqdm")
         return conn
 
-    rt.run(subscribe())
+    # The connection must be HELD: an unreferenced Connection is
+    # garbage-collected, its recv task dies with it, and pushes stop
+    # (GC timing made this a heisenbug).
+    _display["conn"] = rt.run(subscribe())
     _display["head_addr"] = rt.core.head_addr

@@ -90,15 +90,145 @@ def _spill_watermarks() -> tuple[float, float]:
     return (config.get("SPILL_HIGH"), config.get("SPILL_LOW"))
 
 
+# path → (monotonic ts, fingerprint). Short TTL: env_hash runs per
+# lease, a full tree walk every time would tax hot paths, but an edited
+# working_dir must be picked up within seconds.
+_fp_cache: dict[str, tuple[float, str]] = {}
+
+
+def _dir_fingerprint(path: str, ttl: float = 5.0) -> str:
+    """Content fingerprint of a directory tree (names, sizes, mtimes) —
+    the reference content-hashes working_dir packages so edited trees
+    re-stage instead of silently serving stale copies."""
+    now = time.monotonic()
+    hit = _fp_cache.get(path)
+    if hit and now - hit[0] < ttl:
+        return hit[1]
+    h = hashlib.sha1()
+    for dirpath, dirnames, filenames in sorted(os.walk(path)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            p = os.path.join(dirpath, fn)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            h.update(
+                f"{os.path.relpath(p, path)}:{st.st_size}:"
+                f"{st.st_mtime_ns}\n".encode()
+            )
+    fp = h.hexdigest()[:12]
+    _fp_cache[path] = (now, fp)
+    return fp
+
+
 def env_hash(runtime_env: dict | None) -> str:
     """Stable key for a runtime_env: workers are pooled per distinct env
     (reference: runtime_env workers are dedicated + cached by env hash,
-    python/ray/_private/runtime_env/)."""
+    python/ray/_private/runtime_env/). working_dir envs hash the tree's
+    CONTENT, so an edit re-stages and re-pools instead of reusing
+    workers running stale code."""
     if not runtime_env:
         return ""
+    key = dict(runtime_env)
+    wd = key.get("working_dir")
+    if wd:
+        key["working_dir_fp"] = _dir_fingerprint(os.path.expanduser(wd))
     return hashlib.sha1(
-        json.dumps(runtime_env, sort_keys=True).encode()
+        json.dumps(key, sort_keys=True).encode()
     ).hexdigest()[:16]
+
+
+import threading
+
+_ENV_CACHE_ROOT = os.path.join(tempfile.gettempdir(), "ray_tpu-envs")
+_built_envs: dict[str, dict] = {}  # env hash → {"python": ..., "cwd": ...}
+# Created at import: lazy creation would itself race between the first
+# two concurrent builds.
+_env_build_lock = threading.Lock()
+
+
+def build_runtime_env(runtime_env: dict) -> dict:
+    """Materialize a task/actor runtime env on this node: a venv for
+    ``pip`` dependencies and a staged copy of ``working_dir``. Cached by
+    env hash — the content-addressed URI-cache equivalent (reference:
+    the per-node runtime_env agent builds pip/conda envs,
+    _private/runtime_env/agent/runtime_env_agent.py, uri_cache.py).
+
+    Offline clusters (no egress) install from local wheels:
+    ``{"pip": [...], "pip_no_index": True, "pip_find_links": dir}``.
+    """
+    h = env_hash(runtime_env)  # content-aware for working_dir envs
+    if h in _built_envs:
+        return _built_envs[h]
+    with _env_build_lock:
+        if h in _built_envs:
+            return _built_envs[h]
+        info: dict = {"python": None, "cwd": None}
+        root = os.path.join(_ENV_CACHE_ROOT, h)
+        # Cross-PROCESS exclusion too (several node daemons share one
+        # host and one env cache): a file lock per env hash.
+        os.makedirs(_ENV_CACHE_ROOT, exist_ok=True)
+        import fcntl
+
+        lock_f = open(os.path.join(_ENV_CACHE_ROOT, f".{h}.lock"), "w")
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        try:
+            _build_env_locked(runtime_env, root, info)
+        finally:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+            lock_f.close()
+        _built_envs[h] = info
+        return info
+
+
+def _build_env_locked(runtime_env: dict, root: str, info: dict) -> None:
+    pip_pkgs = runtime_env.get("pip")
+    if pip_pkgs:
+        venv_dir = os.path.join(root, "venv")
+        vpython = os.path.join(venv_dir, "bin", "python")
+        marker = os.path.join(venv_dir, ".ready")
+        if not os.path.exists(marker):
+            os.makedirs(root, exist_ok=True)
+            # --clear: a crash mid-build leaves no marker; rebuild
+            # from scratch. --system-site-packages: jax & friends
+            # come from the image, only the requested deps layer on.
+            subprocess.run(
+                [
+                    sys.executable, "-m", "venv", "--clear",
+                    "--system-site-packages", venv_dir,
+                ],
+                check=True,
+                capture_output=True,
+            )
+            cmd = [vpython, "-m", "pip", "install",
+                   "--no-warn-script-location"]
+            if runtime_env.get("pip_no_index"):
+                cmd.append("--no-index")
+            if runtime_env.get("pip_find_links"):
+                cmd += ["--find-links", runtime_env["pip_find_links"]]
+            cmd += list(pip_pkgs)
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"runtime_env pip install failed:\n"
+                    f"{proc.stderr[-2000:]}"
+                )
+            with open(marker, "w") as f:
+                f.write("ok")
+        info["python"] = vpython
+    working_dir = runtime_env.get("working_dir")
+    if working_dir:
+        import shutil
+
+        stage = os.path.join(root, "workdir")
+        if not os.path.isdir(stage):
+            os.makedirs(root, exist_ok=True)
+            tmp = f"{stage}.staging-{os.getpid()}"
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.copytree(os.path.expanduser(working_dir), tmp)
+            os.rename(tmp, stage)
+        info["cwd"] = stage
 
 
 def detect_resources() -> dict[str, float]:
@@ -258,24 +388,32 @@ class NodeManager:
                 pypath = f"{pypath}{os.pathsep}{entry}"
                 seen.add(entry)
         jax_platform = env_jax_platform()
-        argv = [sys.executable, "-m", "ray_tpu.runtime.worker_main"]
-        if jax_platform == "cpu":
+        renv = runtime_env or {}
+        built = _built_envs.get(ehash, {})
+        python_exe = built.get("python") or sys.executable
+        argv = [python_exe, "-m", "ray_tpu.runtime.worker_main"]
+        if jax_platform == "cpu" and not built.get("python"):
             # CPU workers skip site initialization (the image's
             # sitecustomize imports jax + the TPU plugin, ~1.7 s per
             # interpreter); site-packages comes back via PYTHONPATH.
+            # venv workers keep full site init — their pyvenv.cfg is
+            # what layers the env's packages over the system's.
             import site
 
             for sp in site.getsitepackages():
                 if sp not in pypath.split(os.pathsep):
                     pypath = f"{pypath}{os.pathsep}{sp}" if pypath else sp
             argv = [sys.executable, "-S", "-m", "ray_tpu.runtime.worker_main"]
-        renv = runtime_env or {}
         # py_modules: local dirs importable in the worker (single-host or
         # shared-FS; the reference ships them via the runtime_env agent).
         for mod_path in renv.get("py_modules", ()):
             mod_path = os.path.abspath(mod_path)
             if mod_path not in pypath.split(os.pathsep):
                 pypath = f"{mod_path}{os.pathsep}{pypath}"
+        # Staged working_dir: the worker starts there and imports from it
+        # (reference: working_dir runtime env, staged + cwd'd per worker).
+        if built.get("cwd"):
+            pypath = f"{built['cwd']}{os.pathsep}{pypath}"
         env = {
             **os.environ,
             "PYTHONPATH": pypath,
@@ -301,6 +439,7 @@ class NodeManager:
             proc = subprocess.Popen(
                 argv,
                 env=env,
+                cwd=built.get("cwd"),
                 stdout=log_f,
                 stderr=subprocess.STDOUT,
             )
@@ -337,6 +476,17 @@ class NodeManager:
         bucket = self.idle[ehash]
         if bucket:
             return bucket.pop()
+        if runtime_env and (
+            runtime_env.get("pip") or runtime_env.get("working_dir")
+        ):
+            # Build the isolated env (venv + staged working dir) OFF the
+            # event loop; cached per env hash, so only the first lease
+            # of an env pays (reference: the per-node runtime_env agent
+            # builds pip/conda envs with a URI cache,
+            # _private/runtime_env/agent/ + uri_cache.py).
+            await asyncio.get_running_loop().run_in_executor(
+                None, build_runtime_env, runtime_env
+            )
         n_spawning = sum(
             1
             for w in self.workers.values()

@@ -178,3 +178,66 @@ def test_teardown_frees_actor(cluster):
     cdag.teardown()
     # actor takes normal calls again after the loop exits
     assert ray_tpu.get(a.add.remote(1)) == 6
+
+
+# ---------------------------------------------------- permute + overlap
+def test_permute_pipeline_handoff(cluster):
+    """The permute verb rotates values rank→rank (the P2P channel for
+    pipeline stage handoff; reference: NCCL P2P channels nccl_group.py,
+    lowered to ppermute on a TPU mesh)."""
+    from ray_tpu.dag import permute
+
+    stages = [Adder.remote(bias=10 * (i + 1)) for i in range(3)]
+    with InputNode() as inp:
+        outs = [s.add.bind(inp) for s in stages]
+        # ring: 0→1, 1→2, 2→0
+        received = permute.bind(outs, perm=[(0, 1), (1, 2), (2, 0)])
+        dag = MultiOutputNode(received).experimental_compile()
+    try:
+        got = dag.execute(1).get(timeout=60)
+        # rank 1 receives rank 0's output (1+10), rank 2 gets rank 1's
+        # (1+20), rank 0 gets rank 2's (1+30).
+        assert got == [31, 11, 21]
+    finally:
+        dag.teardown()
+
+
+def test_permute_without_incoming_edge(cluster):
+    from ray_tpu.dag import permute
+
+    stages = [Adder.remote(bias=i) for i in range(2)]
+    with InputNode() as inp:
+        outs = [s.add.bind(inp) for s in stages]
+        received = permute.bind(outs, perm=[(0, 1)])  # rank 0 gets nothing
+        dag = MultiOutputNode(received).experimental_compile()
+    try:
+        got = dag.execute(5).get(timeout=60)
+        assert got == [None, 5]
+    finally:
+        dag.teardown()
+
+
+def test_overlap_matches_sequential(cluster):
+    """Same DAG, overlap on vs off: identical results (the overlap path
+    only moves channel I/O off the compute thread)."""
+    from ray_tpu._private import config as _config
+
+    results = {}
+    for overlap in (True, False):
+        _config._overrides["DAG_OVERLAP"] = overlap
+        try:
+            a = Adder.remote(bias=1)
+            b = Adder.remote(bias=100)
+            with InputNode() as inp:
+                mid = a.add.bind(inp)
+                out = b.add.bind(mid)
+                dag = out.experimental_compile()
+            try:
+                results[overlap] = [
+                    dag.execute(i).get(timeout=60) for i in range(20)
+                ]
+            finally:
+                dag.teardown()
+        finally:
+            _config._overrides.pop("DAG_OVERLAP", None)
+    assert results[True] == results[False] == [i + 101 for i in range(20)]

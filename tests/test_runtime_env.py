@@ -82,3 +82,81 @@ def test_py_modules(cluster, tmp_path):
         return fancy_mod.MAGIC
 
     assert ray_tpu.get(use_module.remote()) == 1234
+
+
+def _build_tiny_wheel(tmp_path, name="tinymod", value=42):
+    """Offline wheel build: the zero-egress stand-in for a pip index."""
+    import subprocess
+    import sys
+
+    src = tmp_path / f"{name}_src"
+    (src / name).mkdir(parents=True)
+    (src / "setup.py").write_text(
+        "from setuptools import setup\n"
+        f"setup(name={name!r}, version='1.2.3', packages=[{name!r}])\n"
+    )
+    (src / name / "__init__.py").write_text(f"VALUE = {value}\n")
+    wheels = tmp_path / "wheels"
+    wheels.mkdir(exist_ok=True)
+    subprocess.run(
+        [
+            sys.executable, "-m", "pip", "wheel", str(src),
+            "-w", str(wheels), "--no-deps", "--no-build-isolation",
+            "--no-index", "-q",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return str(wheels)
+
+
+def test_pip_env_isolation(cluster, tmp_path):
+    """pip deps install into a per-env venv (reference: the runtime_env
+    agent's pip plugin + URI cache): the env's workers import the
+    package, plain workers cannot — real dependency isolation."""
+    wheels = _build_tiny_wheel(tmp_path)
+    renv = {
+        "pip": ["tinymod"],
+        "pip_no_index": True,
+        "pip_find_links": wheels,
+    }
+
+    @ray_tpu.remote(runtime_env=renv)
+    def with_dep():
+        import tinymod
+
+        return tinymod.VALUE
+
+    assert ray_tpu.get(with_dep.remote(), timeout=120) == 42
+
+    @ray_tpu.remote
+    def without_dep():
+        try:
+            import tinymod  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert ray_tpu.get(without_dep.remote(), timeout=60) == "isolated"
+
+    # Second task of the same env reuses the cached venv (fast path).
+    assert ray_tpu.get(with_dep.remote(), timeout=60) == 42
+
+
+def test_working_dir_staging(cluster, tmp_path):
+    """working_dir is staged per env and workers start inside it."""
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("hello-wd")
+    (wd / "helper.py").write_text("WHO = 'staged'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(wd)})
+    def read_both():
+        import helper
+
+        with open("data.txt") as f:
+            return f.read(), helper.WHO
+
+    data, who = ray_tpu.get(read_both.remote(), timeout=120)
+    assert data == "hello-wd" and who == "staged"

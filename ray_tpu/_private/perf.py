@@ -114,10 +114,108 @@ def main(quick: bool = False) -> list[dict]:
         # doubles the suite's most expensive bench for no signal.
         results.append(timeit(f"queued burst x{burst}", queue_burst, burst,
                               trials=1, warmup=False))
+        results.extend(serve_bench(quick=quick))
         results.extend(dag_pipeline_bench(quick=quick))
     finally:
         ray_tpu.shutdown()
     results.extend(collective_bench(quick=quick))
+    return results
+
+
+def serve_bench(quick: bool = False) -> list[dict]:
+    """Serve data-plane throughput and latency (reference: serve release
+    microbenchmarks, python/ray/serve/benchmarks/microbenchmark.py —
+    handle throughput, HTTP throughput, streaming TTFB)."""
+    import concurrent.futures
+    import json as _json
+    import socket
+
+    from ray_tpu import serve
+
+    results: list[dict] = []
+
+    @serve.deployment(max_ongoing_requests=64)
+    class Echo:
+        async def __call__(self, request):
+            body = request.get("body") if isinstance(request, dict) else None
+            if isinstance(body, dict) and body.get("stream"):
+                return self._gen()
+            return "ok"
+
+        async def _gen(self):
+            for i in range(8):
+                yield {"i": i}
+
+    handle = serve.run(Echo.bind(), name="_perf", route_prefix="/perf")
+    port = serve.start_http()
+    try:
+        n = 200 if quick else 1000
+
+        # Handle path: concurrent calls through the router.
+        def handle_burst():
+            responses = [handle.remote(None) for _ in range(n)]
+            for r in responses:
+                r.result(timeout=60)
+
+        results.append(timeit(f"serve handle calls x{n}", handle_burst, n))
+
+        # HTTP path: 8 keep-alive connections, n requests total.
+        def http_worker(count: int):
+            with socket.create_connection(
+                ("127.0.0.1", port), timeout=30
+            ) as s:
+                req = b"GET /perf HTTP/1.1\r\nHost: x\r\n\r\n"
+                for _ in range(count):
+                    s.sendall(req)
+                    buf = b""
+                    while not buf.endswith(b"ok"):
+                        chunk = s.recv(4096)
+                        if not chunk:
+                            raise RuntimeError("connection closed")
+                        buf += chunk
+
+        def http_burst():
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                list(pool.map(http_worker, [n // 8] * 8))
+
+        results.append(timeit(f"serve http req x{n}", http_burst, n))
+
+        # Streaming TTFB: time from connect to the first SSE frame.
+        payload = _json.dumps({"stream": True}).encode()
+        req = (
+            f"POST /perf HTTP/1.1\r\nHost: x\r\n"
+            f"Accept: text/event-stream\r\n"
+            f"Content-Length: {len(payload)}\r\n\r\n"
+        ).encode() + payload
+        ttfbs = []
+        for _ in range(20 if quick else 50):
+            with socket.create_connection(
+                ("127.0.0.1", port), timeout=30
+            ) as s:
+                t0 = time.perf_counter()
+                s.sendall(req)
+                buf = b""
+                while b"data: " not in buf:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        raise RuntimeError("stream closed before first frame")
+                    buf += chunk
+                ttfbs.append(time.perf_counter() - t0)
+                while b"[DONE]" not in buf:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        raise RuntimeError("stream closed before [DONE]")
+                    buf += chunk
+        ttfbs.sort()
+        rec = {
+            "name": "serve sse ttfb",
+            "p50_ms": round(ttfbs[len(ttfbs) // 2] * 1e3, 2),
+            "p99_ms": round(ttfbs[int(len(ttfbs) * 0.99)] * 1e3, 2),
+        }
+        print(f"{rec['name']:<46s} p50={rec['p50_ms']}ms p99={rec['p99_ms']}ms")
+        results.append(rec)
+    finally:
+        serve.shutdown()
     return results
 
 

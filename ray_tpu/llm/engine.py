@@ -100,6 +100,13 @@ class LLMEngine:
         # add_request may run on a different thread than step() (the serve
         # pump runs step in an executor); guard the queue/slot state.
         self._lock = threading.Lock()
+        # Per-request tokens emitted since the last drain_deltas() call —
+        # the feed for streaming responses (reference shape: vLLM's
+        # per-step RequestOutput deltas). Only requests added with
+        # stream=True record deltas, so batch callers don't accumulate
+        # tokens nobody drains.
+        self._deltas: dict[str, list[int]] = {}
+        self._stream_ids: set[str] = set()
 
     # ------------------------------------------------------ request API
     def add_request(
@@ -107,6 +114,7 @@ class LLMEngine:
         prompt: list[int],
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
+        stream: bool = False,
     ) -> str:
         if len(prompt) >= self.max_seq:
             raise ValueError(
@@ -114,6 +122,8 @@ class LLMEngine:
             )
         rid = request_id or f"req-{next(self._ids)}"
         with self._lock:
+            if stream:
+                self._stream_ids.add(rid)
             self._queue.append(
                 _Request(rid, list(prompt), sampling or SamplingParams())
             )
@@ -147,7 +157,11 @@ class LLMEngine:
             return False
         if tok in s.stop_token_ids:
             req.out_tokens.pop()  # don't return the stop token
+            d = self._deltas.get(req.request_id)
+            if d and d[-1] == tok:
+                d.pop()
         req.done = True
+        self._stream_ids.discard(req.request_id)
         finished.append(
             {
                 "request_id": req.request_id,
@@ -176,6 +190,10 @@ class LLMEngine:
             req.position = len(req.prompt)
             req.last_token = self._sample(last, req.sampling)
             req.out_tokens.append(req.last_token)
+            if req.request_id in self._stream_ids:
+                self._deltas.setdefault(req.request_id, []).append(
+                    req.last_token
+                )
             self._active[slot] = req
             # The prefill-sampled token can already hit max_tokens=1 or a
             # stop token; finishing here frees the slot for this _admit
@@ -203,11 +221,40 @@ class LLMEngine:
                 tok = self._sample(logits[slot], req.sampling)
                 req.position += 1
                 req.out_tokens.append(tok)
+                if req.request_id in self._stream_ids:
+                    self._deltas.setdefault(req.request_id, []).append(tok)
                 req.last_token = tok
                 self._tokens[slot, 0] = tok
                 self._positions[slot] = req.position
                 self._finish_if_done(req, finished)
         return finished
+
+    def abort_request(self, request_id: str) -> bool:
+        """Drop a request (queued or active), freeing its slot — the
+        client-disconnect path for streaming (reference: vLLM engine
+        abort_request). Safe to call after completion (returns False)."""
+        with self._lock:
+            self._stream_ids.discard(request_id)
+            self._deltas.pop(request_id, None)
+            for i, r in enumerate(self._queue):
+                if r.request_id == request_id:
+                    del self._queue[i]
+                    return True
+            for slot, r in list(self._active.items()):
+                if r.request_id == request_id:
+                    r.done = True
+                    del self._active[slot]
+                    self._free.append(slot)
+                    return True
+        return False
+
+    def drain_deltas(self) -> dict[str, list[int]]:
+        """Return and clear per-request tokens emitted since the last
+        call — the streaming feed (callers pair it with step()'s finished
+        list to know when a request's stream ends)."""
+        with self._lock:
+            out, self._deltas = self._deltas, {}
+        return out
 
     def generate(
         self,

@@ -23,6 +23,9 @@ class LLMServer:
         self.engine = LLMEngine(model, **(engine_kwargs or {}))
         self.tokenizer = tokenizer or ByteTokenizer()
         self._waiters: dict[str, asyncio.Future] = {}
+        # request_id → queue of token-delta lists; None marks the end of
+        # a stream (the feed for SSE streaming responses).
+        self._streams: dict[str, asyncio.Queue] = {}
         self._pump_task: asyncio.Task | None = None
 
     async def _pump(self):
@@ -33,16 +36,26 @@ class LLMServer:
                 # compile) — run it off-loop so this replica keeps
                 # answering RPCs, including the controller's health polls.
                 finished = await loop.run_in_executor(None, self.engine.step)
+                for rid, toks in self.engine.drain_deltas().items():
+                    q = self._streams.get(rid)
+                    if q is not None:
+                        q.put_nowait(toks)
                 for fin in finished:
                     fut = self._waiters.pop(fin["request_id"], None)
                     if fut is not None and not fut.done():
                         fut.set_result(fin["tokens"])
+                    q = self._streams.get(fin["request_id"])
+                    if q is not None:
+                        q.put_nowait(None)
         except Exception as e:  # noqa: BLE001
             # Fail every pending caller rather than hanging them forever.
             waiters, self._waiters = self._waiters, {}
             for fut in waiters.values():
                 if not fut.done():
                     fut.set_exception(e)
+            streams, self._streams = self._streams, {}
+            for q in streams.values():
+                q.put_nowait(e)
 
     def _ensure_pump(self):
         if self._pump_task is None or self._pump_task.done():
@@ -74,7 +87,60 @@ class LLMServer:
             "num_generated": len(out),
         }
 
-    async def __call__(self, request: dict) -> dict:
+    async def stream(
+        self,
+        prompt: str | list[int],
+        max_tokens: int = 64,
+        temperature: float = 0.0,
+        stop_token_ids: tuple = (),
+    ):
+        """Async generator: yields one dict per decode-step delta as the
+        engine produces tokens (reference: ray.llm streaming chat
+        completions over vLLM's AsyncLLMEngine generator)."""
+        tokens = (
+            self.tokenizer.encode(prompt) if isinstance(prompt, str) else prompt
+        )
+        sampling = SamplingParams(
+            max_tokens=max_tokens,
+            temperature=temperature,
+            stop_token_ids=tuple(stop_token_ids),
+        )
+        rid = self.engine.add_request(tokens, sampling, stream=True)
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        self._ensure_pump()
+        produced = 0
+        try:
+            while True:
+                delta = await q.get()
+                if delta is None:
+                    break
+                if isinstance(delta, BaseException):
+                    raise delta
+                produced += len(delta)
+                yield {
+                    "tokens": delta,
+                    "text": self.tokenizer.decode(delta),
+                    "num_generated": produced,
+                }
+        finally:
+            self._streams.pop(rid, None)
+            # Client gone (or stream complete — then this is a no-op):
+            # free the engine slot instead of decoding to max_tokens for
+            # nobody.
+            self.engine.abort_request(rid)
+
+    async def __call__(self, request: dict):
+        body = request.get("body") if isinstance(request, dict) else None
+        if isinstance(body, dict):
+            # HTTP ingress shape: parameters ride in the JSON body.
+            request = body
+        if request.get("stream"):
+            return self.stream(
+                request["prompt"],
+                max_tokens=request.get("max_tokens", 64),
+                temperature=request.get("temperature", 0.0),
+            )
         return await self.generate(
             request["prompt"],
             max_tokens=request.get("max_tokens", 64),

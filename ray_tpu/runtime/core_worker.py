@@ -21,6 +21,7 @@ import asyncio
 import collections
 import functools
 import hashlib
+import inspect
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -2034,7 +2035,12 @@ class CoreWorker:
         if name.startswith("@sys:") or self._actor_instance is None:
             return False
         fn = getattr(self._actor_instance, name, None)
-        return asyncio.iscoroutinefunction(fn)
+        # Async generator methods (streaming actor calls) run concurrently
+        # like coroutine methods: a long-lived token stream must not block
+        # the ordered exec queue for every other caller.
+        return asyncio.iscoroutinefunction(fn) or inspect.isasyncgenfunction(
+            fn
+        )
 
     async def _run_async(self, spec: dict, actor_id: str, fut):
         async with self._async_sema:
@@ -2053,10 +2059,29 @@ class CoreWorker:
         attempt = spec.get("attempt", 0)
         index = 0
         _SENTINEL = object()
-        while True:
-            item = await loop.run_in_executor(
+        is_async = inspect.isasyncgen(gen)
+
+        async def _next_item():
+            if is_async:
+                try:
+                    return await gen.__anext__()
+                except StopAsyncIteration:
+                    return _SENTINEL
+            return await loop.run_in_executor(
                 self._exec_pool, lambda: next(gen, _SENTINEL)
             )
+
+        async def _close_gen():
+            try:
+                if is_async:
+                    await gen.aclose()
+                else:
+                    getattr(gen, "close", lambda: None)()
+            except Exception:  # noqa: BLE001 - consumer already gone
+                pass
+
+        while True:
+            item = await _next_item()
             if item is _SENTINEL:
                 break
             data = serialize(item).materialize_buffers()
@@ -2071,7 +2096,7 @@ class CoreWorker:
             )
             if not ack.get("ok"):
                 # Consumer closed/abandoned the generator: stop producing.
-                getattr(gen, "close", lambda: None)()
+                await _close_gen()
                 return {"status": "ok", "results": []}
             index += 1
             # Backpressure: pause while the consumer is far behind
@@ -2080,7 +2105,7 @@ class CoreWorker:
                 await asyncio.sleep(0.02)
                 ack = await owner.call("generator_depth", task_id=task_id)
                 if not ack.get("ok"):
-                    getattr(gen, "close", lambda: None)()
+                    await _close_gen()
                     return {"status": "ok", "results": []}
         await owner.call(
             "generator_item",
@@ -2124,7 +2149,11 @@ class CoreWorker:
                     fn = getattr(instance, method_name)
             else:
                 fn = await self._fetch_function(spec["fn_id"])
-            if asyncio.iscoroutinefunction(fn):
+            if inspect.isasyncgenfunction(fn):
+                # Async generator: the object itself is the stream; it is
+                # driven on the loop by _stream_generator below.
+                result = fn(*args, **kwargs)
+            elif asyncio.iscoroutinefunction(fn):
                 result = await fn(*args, **kwargs)
             else:
                 from ray_tpu.util import tracing
@@ -2139,10 +2168,12 @@ class CoreWorker:
                     self._exec_pool, _run_sync
                 )
             if spec.get("streaming"):
-                import inspect
-
-                if not inspect.isgenerator(result):
-                    result = iter(result)  # any iterable streams
+                if not inspect.isgenerator(result) and not inspect.isasyncgen(
+                    result
+                ):
+                    # A coroutine method may hand back an async generator
+                    # (e.g. `return self.stream(...)`) — stream it too.
+                    result = iter(result)  # any other iterable streams
                 reply = await self._stream_generator(spec, result)
                 self.record_task_event(
                     spec, "RUNNING", ts=exec_start,

@@ -59,6 +59,46 @@ class DeploymentResponse:
         return self._inner.__await__()
 
 
+class DeploymentStreamResponse:
+    """Iterator over a streaming handle call's yields (reference:
+    handle.py DeploymentResponseGenerator). Async-iterate on the runtime
+    loop (proxy, composed replicas); sync-iterate from driver threads.
+    Items arrive incrementally as the replica yields them."""
+
+    def __init__(self, agen, sync: bool):
+        self._agen = agen
+        self._sync = sync
+
+    def __aiter__(self):
+        return self._agen
+
+    def __iter__(self):
+        if not self._sync:
+            raise RuntimeError(
+                "sync iteration would deadlock on the runtime loop; use "
+                "`async for` in async code"
+            )
+        return self
+
+    def __next__(self):
+        fut = asyncio.run_coroutine_threadsafe(
+            self._agen.__anext__(), core_api._runtime.loop
+        )
+        try:
+            return fut.result()
+        except StopAsyncIteration:
+            raise StopIteration from None
+
+    def close(self):
+        """Stop consuming; the replica-side generator is told to stop."""
+        if self._sync:
+            asyncio.run_coroutine_threadsafe(
+                self._agen.aclose(), core_api._runtime.loop
+            ).result(timeout=5)
+        else:
+            asyncio.ensure_future(self._agen.aclose())
+
+
 class _Router:
     def __init__(self, deployment_name: str, app_name: str):
         self.deployment_name = deployment_name
@@ -299,6 +339,95 @@ class _Router:
                     self._inflight[replica.actor_id] -= 1
 
 
+    async def stream_call(
+        self,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        model_id: str = "",
+        retry_on_failure: bool = True,
+    ):
+        """Async generator: route to a replica and yield the streaming
+        actor call's items as they arrive (reference: streaming handle
+        calls, serve/handle.py `handle.options(stream=True)`). Re-routes
+        on replica death only before the first item has been yielded."""
+        args = tuple(
+            [await a if isinstance(a, DeploymentResponse) else a for a in args]
+        )
+        kwargs = {
+            k: (await v if isinstance(v, DeploymentResponse) else v)
+            for k, v in kwargs.items()
+        }
+        ctx = {
+            "request_id": uuid.uuid4().hex[:16],
+            "multiplexed_model_id": model_id,
+            "app_name": self.app_name,
+        }
+        self._ensure_reporter()
+        core = await self._core()
+        deaths = 0
+        while True:
+            replica = await self._acquire_replica(model_id)
+            self._inflight[replica.actor_id] = (
+                self._inflight.get(replica.actor_id, 0) + 1
+            )
+            yielded = False
+            try:
+                task_id = await core.submit_task(
+                    "handle_request_streaming",
+                    (method_name, args, kwargs, ctx),
+                    {},
+                    num_returns="streaming",
+                    actor=ActorSubmitTarget(replica.actor_id, replica.addr),
+                )
+                try:
+                    while True:
+                        entry = await core.next_generator_item(task_id)
+                        if entry[0] == "done":
+                            return
+                        if entry[0] == "error":
+                            raise entry[1]
+                        value = (
+                            await core.get(
+                                [core_api.ObjectRef(entry[1], core.addr)]
+                            )
+                        )[0]
+                        yielded = True
+                        yield value
+                finally:
+                    # Consumer broke out early (or terminal entry already
+                    # cleaned up — then this is a no-op): abandon the
+                    # stream so the replica stops producing.
+                    await core.close_generator(task_id)
+            except GeneratorExit:
+                raise
+            except Exception as e:  # noqa: BLE001
+                from ray_tpu.exceptions import ActorDiedError
+                from ray_tpu._private import rpc
+
+                if (
+                    retry_on_failure
+                    and not yielded
+                    and isinstance(
+                        e, (ActorDiedError, rpc.ConnectionLost, rpc.RpcError)
+                    )
+                    and deaths < 3
+                ):
+                    deaths += 1
+                    self._replicas = [
+                        r
+                        for r in self._replicas
+                        if r.actor_id != replica.actor_id
+                    ]
+                    self._affinity.clear()
+                    await self._refresh(force=True)
+                    continue
+                raise
+            finally:
+                if replica.actor_id in self._inflight:
+                    self._inflight[replica.actor_id] -= 1
+
+
 class DeploymentHandle:
     """Serializable, lazy handle: resolves the controller and replica
     set on first call, so it can be shipped into replicas for model
@@ -311,12 +440,14 @@ class DeploymentHandle:
         method_name: str = "__call__",
         multiplexed_model_id: str = "",
         retry_on_failure: bool = True,
+        stream: bool = False,
     ):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         self._model_id = multiplexed_model_id
         self._retry = retry_on_failure
+        self._stream = stream
         self._router: _Router | None = None
 
     def __reduce__(self):
@@ -328,6 +459,7 @@ class DeploymentHandle:
                 self._method_name,
                 self._model_id,
                 self._retry,
+                self._stream,
             ),
         )
 
@@ -337,6 +469,7 @@ class DeploymentHandle:
         method_name: str | None = None,
         multiplexed_model_id: str | None = None,
         retry_on_failure: bool | None = None,
+        stream: bool | None = None,
     ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name,
@@ -346,6 +479,7 @@ class DeploymentHandle:
             if multiplexed_model_id is None
             else multiplexed_model_id,
             self._retry if retry_on_failure is None else retry_on_failure,
+            self._stream if stream is None else stream,
         )
         h._router = self._router  # share routing state across options()
         return h
@@ -360,16 +494,21 @@ class DeploymentHandle:
             self._router = _Router(self.deployment_name, self.app_name)
         return self._router
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = self._get_router()
-        coro = router.route_and_call(
-            self._method_name, args, kwargs, self._model_id, self._retry
-        )
         loop = core_api._runtime.loop
         try:
             running = asyncio.get_running_loop()
         except RuntimeError:
             running = None
+        if self._stream:
+            agen = router.stream_call(
+                self._method_name, args, kwargs, self._model_id, self._retry
+            )
+            return DeploymentStreamResponse(agen, sync=running is not loop)
+        coro = router.route_and_call(
+            self._method_name, args, kwargs, self._model_id, self._retry
+        )
         if running is loop:
             return DeploymentResponse(asyncio.ensure_future(coro), sync=False)
         fut = asyncio.run_coroutine_threadsafe(coro, loop)

@@ -1,28 +1,60 @@
-"""HTTP proxy actor: routes HTTP requests to application ingress handles.
+"""Async HTTP proxy actor: routes HTTP requests to application ingress
+handles, with streaming (SSE / chunked) responses.
 
-(reference: python/ray/serve/_private/proxy.py HTTPProxy :710 — uvicorn/
-starlette there; here a stdlib ThreadingHTTPServer inside the proxy
-actor. Handler threads use the sync DeploymentHandle path, which is safe
-off the runtime loop.)
+(reference: python/ray/serve/_private/proxy.py:710 HTTPProxy — a fully
+async uvicorn/ASGI proxy there with StreamingResponse support; here a
+raw asyncio HTTP/1.1 server running on the worker's runtime event loop,
+so request handlers await DeploymentHandle calls natively with no
+thread hops.)
 
 Request mapping: the ingress deployment is called with a single dict
-argument {"method", "path", "query", "body"} where body is parsed JSON
-when the content type (or payload) is JSON, else raw bytes. A str/bytes
-return value is sent verbatim; anything else is JSON-encoded.
+argument {"method", "path", "query", "headers", "body"} where body is
+parsed JSON when the payload is JSON, else raw bytes. A str/bytes return
+value is sent verbatim; anything else is JSON-encoded.
+
+Streaming: a request opts in via `Accept: text/event-stream`, a
+`?stream=1` query parameter, or a JSON body containing `"stream": true`.
+The proxy then makes a streaming handle call (replica generators stream
+through the core's ObjectRefGenerator path) and writes each yielded item
+as a Server-Sent-Events `data:` frame over chunked transfer encoding,
+ending with `data: [DONE]` (the OpenAI wire convention).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
-import threading
 import time
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import ray_tpu
-from ray_tpu.serve.handle import CONTROLLER_NAME, DeploymentHandle
+from ray_tpu.serve.handle import (
+    CONTROLLER_NAME,
+    DeploymentHandle,
+    DeploymentStreamResponse,
+)
 
 _ROUTE_TTL_S = 2.0
+_REQUEST_TIMEOUT_S = 60.0
+_MAX_BODY = 512 * 1024 * 1024
+
+_REASONS = {200: "OK", 404: "Not Found", 408: "Timeout", 500: "Internal"}
+
+
+def _sse_frame(item) -> bytes:
+    """One SSE event per yielded item; multi-line payloads get one
+    `data:` line each per the SSE spec."""
+    if isinstance(item, bytes):
+        payload = item.decode("utf-8", "replace")
+    elif isinstance(item, str):
+        payload = item
+    else:
+        payload = json.dumps(item)
+    lines = payload.split("\n")
+    return ("".join(f"data: {ln}\n" for ln in lines) + "\n").encode()
+
+
+def _chunk(data: bytes) -> bytes:
+    return b"%x\r\n%s\r\n" % (len(data), data)
 
 
 class ProxyActor:
@@ -30,89 +62,282 @@ class ProxyActor:
         self._routes: dict[str, tuple] = {}  # prefix → (app, ingress)
         self._handles: dict[str, DeploymentHandle] = {}
         self._routes_ts = 0.0
-        proxy = self
+        self._controller = None
+        self._server: asyncio.AbstractServer | None = None
+        self._stats = {"requests": 0, "streams": 0, "errors": 0}
+        # Actor __init__ runs on the executor thread; the server must
+        # live on the runtime loop where handle calls are native.
+        from ray_tpu import api as core_api
 
-        class Handler(BaseHTTPRequestHandler):
-            def _serve(self, body: bytes | None):
-                try:
-                    status, payload = proxy._dispatch(
-                        self.command, self.path, body
-                    )
-                except Exception as e:  # noqa: BLE001
-                    status, payload = 500, str(e).encode()
-                self.send_response(status)
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
+        asyncio.run_coroutine_threadsafe(
+            self._start(host, port), core_api._runtime.loop
+        ).result(timeout=30)
 
-            def do_GET(self):  # noqa: N802 (stdlib API)
-                self._serve(None)
-
-            def do_POST(self):  # noqa: N802
-                n = int(self.headers.get("Content-Length", 0))
-                self._serve(self.rfile.read(n) if n else b"")
-
-            do_PUT = do_POST  # noqa: N815
-            do_DELETE = do_GET  # noqa: N815
-
-            def log_message(self, *a):  # silence per-request stderr
-                pass
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
+    async def _start(self, host: str, port: int):
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port
         )
-        self._thread.start()
 
     def get_port(self) -> int:
-        return self._server.server_address[1]
+        return self._server.sockets[0].getsockname()[1]
 
-    def _refresh_routes(self):
+    def get_stats(self) -> dict:
+        return dict(self._stats)
+
+    # ---------------------------------------------------------- routing
+    async def _refresh_routes(self, force: bool = False):
+        """Poll the controller's route table (loop-native: get_actor /
+        handle.result() would deadlock the runtime loop)."""
         now = time.monotonic()
-        if now - self._routes_ts < _ROUTE_TTL_S and self._routes:
+        if not force and now - self._routes_ts < _ROUTE_TTL_S and self._routes:
             return
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
-        self._routes = ray_tpu.get(controller.get_route_table.remote())
+        from ray_tpu import api as core_api
+        from ray_tpu.runtime.core_worker import ActorSubmitTarget
+
+        core = core_api._runtime.core
+        if self._controller is None:
+            reply = await core.head.call("get_actor", name=CONTROLLER_NAME)
+            if not reply["ok"]:
+                raise RuntimeError("serve controller is not running")
+            self._controller = ActorSubmitTarget(
+                reply["actor_id"], reply["addr"]
+            )
+        try:
+            refs = await core.submit_task(
+                "get_route_table",
+                (),
+                {},
+                num_returns=1,
+                actor=self._controller,
+            )
+            self._routes = (await core.get(refs))[0]
+        except Exception:
+            # The controller may have been restarted as a new actor (this
+            # proxy is detached and outlives serve.shutdown/serve.run
+            # cycles): drop the cached target so the next refresh
+            # re-resolves it by name.
+            self._controller = None
+            raise
         self._routes_ts = time.monotonic()
 
-    def _dispatch(self, method: str, path: str, body: bytes | None):
-        self._refresh_routes()
-        parsed = urllib.parse.urlparse(path)
-        route = parsed.path
-        match = None
+    def _match_route(self, route: str):
         for prefix in sorted(self._routes, key=len, reverse=True):
-            if route == prefix or route.startswith(
-                prefix.rstrip("/") + "/"
-            ) or prefix == "/":
-                match = prefix
-                break
-        if match is None:
-            return 404, b"no route"
+            if (
+                route == prefix
+                or route.startswith(prefix.rstrip("/") + "/")
+                or prefix == "/"
+            ):
+                return prefix
+        return None
+
+    def _handle_for(self, match: str) -> DeploymentHandle:
         app_name, ingress = self._routes[match]
         handle = self._handles.get(app_name)
         if handle is None or handle.deployment_name != ingress:
             handle = DeploymentHandle(ingress, app_name)
             self._handles[app_name] = handle
+        return handle
 
-        payload: object = body
-        if body:
+    # ------------------------------------------------------- connection
+    async def _handle_conn(self, reader, writer):
+        try:
+            while True:
+                keep_alive = await self._handle_one(reader, writer)
+                if not keep_alive:
+                    break
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionResetError,
+            BrokenPipeError,
+            TimeoutError,
+        ):
+            pass
+        except Exception:  # noqa: BLE001 - never kill the accept loop
+            self._stats["errors"] += 1
+        finally:
             try:
-                payload = json.loads(body)
-            except ValueError:
-                payload = body
-        request = {
-            "method": method,
-            "path": route,
-            "query": dict(urllib.parse.parse_qsl(parsed.query)),
-            "body": payload,
-        }
-        result = handle.remote(request).result(timeout=60)
-        if isinstance(result, bytes):
-            return 200, result
-        if isinstance(result, str):
-            return 200, result.encode()
-        return 200, json.dumps(result).encode()
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
 
-    def shutdown(self):
-        self._server.shutdown()
+    async def _handle_one(self, reader, writer) -> bool:
+        request_line = await reader.readline()
+        if not request_line:
+            return False
+        try:
+            method, target, version = (
+                request_line.decode("latin-1").rstrip("\r\n").split(" ", 2)
+            )
+        except ValueError:
+            await self._respond(writer, 500, b"malformed request line")
+            return False
+        headers: dict[str, str] = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            if b":" in line:
+                k, v = line.decode("latin-1").split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        try:
+            n = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            await self._respond(writer, 500, b"bad content-length")
+            return False
+        body = b""
+        if n:
+            if n > _MAX_BODY:
+                await self._respond(writer, 500, b"body too large")
+                return False
+            body = await reader.readexactly(n)
+        keep_alive = (
+            headers.get("connection", "").lower() != "close"
+            and version != "HTTP/1.0"
+        )
+
+        self._stats["requests"] += 1
+        # Everything below must produce an HTTP response, never a bare
+        # connection drop (streaming manages its own error framing).
+        try:
+            await self._refresh_routes()
+            parsed = urllib.parse.urlparse(target)
+            match = self._match_route(parsed.path)
+            if match is None:
+                # A just-deployed app may not be in the cached table yet.
+                await self._refresh_routes(force=True)
+                match = self._match_route(parsed.path)
+            if match is None:
+                await self._respond(writer, 404, b"no route", keep_alive)
+                return keep_alive
+
+            query = dict(urllib.parse.parse_qsl(parsed.query))
+            payload: object = body
+            if body:
+                try:
+                    payload = json.loads(body)
+                except ValueError:
+                    payload = body
+            request = {
+                "method": method,
+                "path": parsed.path,
+                "query": query,
+                "headers": headers,
+                "body": payload,
+            }
+            want_stream = (
+                "text/event-stream" in headers.get("accept", "")
+                or query.get("stream", "").lower() in ("1", "true")
+                or (isinstance(payload, dict) and bool(payload.get("stream")))
+            )
+            handle = self._handle_for(match)
+            if want_stream:
+                self._stats["streams"] += 1
+                return await self._respond_stream(
+                    writer, handle, request, keep_alive
+                )
+            result = await asyncio.wait_for(
+                handle.remote(request), _REQUEST_TIMEOUT_S
+            )
+            if isinstance(result, bytes):
+                out = result
+            elif isinstance(result, str):
+                out = result.encode()
+            else:
+                out = json.dumps(result).encode()
+        except asyncio.TimeoutError:
+            self._stats["errors"] += 1
+            await self._respond(writer, 408, b"request timed out", keep_alive)
+            return keep_alive
+        except Exception as e:  # noqa: BLE001 - user/routing error → 500
+            self._stats["errors"] += 1
+            await self._respond(writer, 500, str(e).encode(), keep_alive)
+            return keep_alive
+        await self._respond(writer, 200, out, keep_alive)
+        return keep_alive
+
+    async def _respond(
+        self, writer, status: int, payload: bytes, keep_alive: bool = False
+    ):
+        reason = _REASONS.get(status, "Unknown")
+        conn = "keep-alive" if keep_alive else "close"
+        writer.write(
+            (
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Connection: {conn}\r\n\r\n"
+            ).encode()
+            + payload
+        )
+        await writer.drain()
+
+    async def _respond_stream(
+        self, writer, handle: DeploymentHandle, request: dict, keep_alive: bool
+    ) -> bool:
+        """Stream the handle call as SSE over chunked transfer encoding.
+        Headers are written only once the first item (or first error)
+        arrives, so pre-stream failures still get a clean HTTP status."""
+        stream: DeploymentStreamResponse = handle.options(stream=True).remote(
+            request
+        )
+        agen = stream.__aiter__()
+        started = False
+
+        def _sse_headers() -> bytes:
+            conn = "keep-alive" if keep_alive else "close"
+            return (
+                "HTTP/1.1 200 OK\r\n"
+                "Content-Type: text/event-stream\r\n"
+                "Cache-Control: no-cache\r\n"
+                "Transfer-Encoding: chunked\r\n"
+                f"Connection: {conn}\r\n\r\n"
+            ).encode()
+
+        try:
+            while True:
+                # Per-item deadline: a replica hung before its next yield
+                # must not pin this connection (and its router inflight
+                # slot) forever.
+                try:
+                    item = await asyncio.wait_for(
+                        agen.__anext__(), _REQUEST_TIMEOUT_S
+                    )
+                except StopAsyncIteration:
+                    break
+                if not started:
+                    started = True
+                    writer.write(_sse_headers())
+                writer.write(_chunk(_sse_frame(item)))
+                await writer.drain()
+            if not started:
+                # Empty stream: still a valid SSE response.
+                started = True
+                writer.write(_sse_headers())
+            writer.write(_chunk(b"data: [DONE]\n\n") + b"0\r\n\r\n")
+            await writer.drain()
+            return keep_alive
+        except (ConnectionResetError, BrokenPipeError):
+            # Client went away: stop the replica-side generator.
+            await agen.aclose()
+            return False
+        except Exception as e:  # noqa: BLE001
+            self._stats["errors"] += 1
+            await agen.aclose()
+            if not started:
+                await self._respond(writer, 500, str(e).encode(), keep_alive)
+                return keep_alive
+            # Mid-stream failure: emit an SSE error event, then terminate
+            # the chunked body so the client sees a clean end.
+            err = json.dumps({"error": str(e)})
+            writer.write(
+                _chunk(f"event: error\ndata: {err}\n\n".encode())
+                + b"0\r\n\r\n"
+            )
+            await writer.drain()
+            return False
+
+    async def shutdown(self):
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
         return True

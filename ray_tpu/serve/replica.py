@@ -78,6 +78,57 @@ class ReplicaActor:
             self._num_ongoing -= 1
             self._num_served += 1
 
+    async def handle_request_streaming(
+        self,
+        method_name: str,
+        request_args: tuple,
+        request_kwargs: dict,
+        request_context: dict | None = None,
+    ):
+        """Streaming twin of handle_request (reference: replica.py
+        `handle_request_streaming` — user generators stream through
+        ObjectRefGenerator). Yields the user method's items as they are
+        produced; a non-generator result yields exactly once, so the
+        router can use one call shape for both."""
+        self._num_ongoing += 1
+        try:
+            set_request_context(RequestContext(**(request_context or {})))
+            if inspect.isfunction(self._callable):
+                fn = self._callable
+            else:
+                fn = getattr(self._callable, method_name)
+            if inspect.isasyncgenfunction(fn):
+                result = fn(*request_args, **request_kwargs)
+            elif inspect.iscoroutinefunction(fn):
+                result = await fn(*request_args, **request_kwargs)
+            else:
+                ctx = contextvars.copy_context()
+                loop = asyncio.get_running_loop()
+                result = await loop.run_in_executor(
+                    None,
+                    lambda: ctx.run(fn, *request_args, **request_kwargs),
+                )
+            if inspect.isasyncgen(result):
+                async for item in result:
+                    yield item
+            elif inspect.isgenerator(result):
+                # Drive sync generators off-loop so user compute between
+                # yields doesn't stall this replica's other requests.
+                loop = asyncio.get_running_loop()
+                _done = object()
+                while True:
+                    item = await loop.run_in_executor(
+                        None, lambda: next(result, _done)
+                    )
+                    if item is _done:
+                        break
+                    yield item
+            else:
+                yield result
+        finally:
+            self._num_ongoing -= 1
+            self._num_served += 1
+
     def get_stats(self) -> dict:
         return {
             "num_ongoing_requests": self._num_ongoing,

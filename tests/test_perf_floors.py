@@ -17,7 +17,14 @@ FLOORS = {
     "actor call (sync)": 100.0,
     "actor calls async": 200.0,
     "queued burst": 100.0,
+    "serve handle calls": 150.0,
+    "serve http req": 200.0,
 }
+
+# Streaming time-to-first-byte ceiling (ms): measured p50 ~1.3ms on the
+# dev box; 100ms catches a regression to buffered (non-streaming)
+# delivery while absorbing CI noise.
+SSE_TTFB_P99_CEILING_MS = 100.0
 
 
 def test_microbench_floors():
@@ -35,3 +42,11 @@ def test_microbench_floors():
                 f"{match['name']}: {match['ops_per_s']:.0f} < {floor} ops/s"
             )
     assert not failures, "control-plane regressions:\n" + "\n".join(failures)
+    ttfb = next(
+        (r for r in results if r["name"] == "serve sse ttfb"), None
+    )
+    assert ttfb is not None, "benchmark 'serve sse ttfb' missing"
+    assert ttfb["p99_ms"] < SSE_TTFB_P99_CEILING_MS, (
+        f"serve sse ttfb p99 {ttfb['p99_ms']}ms >= "
+        f"{SSE_TTFB_P99_CEILING_MS}ms (streaming regressed to buffering?)"
+    )

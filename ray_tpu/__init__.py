@@ -18,7 +18,7 @@ def __getattr__(name):
     # runtime (and vice versa).
     _core_api = {
         "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-        "kill", "cancel", "get_actor", "method", "ObjectRef",
+        "broadcast", "kill", "cancel", "get_actor", "method", "ObjectRef",
         "ObjectRefGenerator", "available_resources", "cluster_resources",
         "nodes",
     }

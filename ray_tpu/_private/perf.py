@@ -115,6 +115,7 @@ def main(quick: bool = False) -> list[dict]:
         results.append(timeit(f"queued burst x{burst}", queue_burst, burst,
                               trials=1, warmup=False))
         results.extend(serve_bench(quick=quick))
+        results.extend(object_plane_bench(quick=quick))
         results.extend(dag_pipeline_bench(quick=quick))
     finally:
         ray_tpu.shutdown()
@@ -216,6 +217,72 @@ def serve_bench(quick: bool = False) -> list[dict]:
         results.append(rec)
     finally:
         serve.shutdown()
+    return results
+
+
+def object_plane_bench(quick: bool = False) -> list[dict]:
+    """Broadcast envelope (BASELINE.md: the reference's scalability
+    envelope is a 1 GiB object broadcast to 50+ nodes riding
+    push_manager chunked pushes; here 8 simulated nodes with separate
+    store dirs on one host — the metric is aggregate store-to-store
+    GB/s through the relay waves)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ray_tpu import api as core_api
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+    import ray_tpu
+
+    n_nodes = 8
+    nbytes = (64 << 20) if quick else (1 << 30)
+    payload = np.random.default_rng(0).integers(
+        0, 255, size=nbytes, dtype=np.uint8
+    )
+
+    # Store dirs on /dev/shm like the real per-node plasma pools — a
+    # disk-backed tempdir benchmarks the disk, not the object plane.
+    import os
+
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    dirs = [
+        tempfile.mkdtemp(prefix=f"bcast{i}_", dir=base)
+        for i in range(n_nodes)
+    ]
+    nodes = []
+
+    async def launch(d):
+        node = NodeManager(rt.core.head_addr, d, resources={"CPU": 0.01})
+        await node.start()
+        return node
+
+    results: list[dict] = []
+    try:
+        for d in dirs:
+            nodes.append(rt.run(launch(d)))
+        ref = ray_tpu.put(payload)
+        t0 = time.perf_counter()
+        n = ray_tpu.broadcast(ref, timeout=600)
+        dt = time.perf_counter() - t0
+        agg = n * nbytes / dt / 1e9
+        rec = {
+            "name": f"broadcast {nbytes >> 20} MiB x{n} nodes",
+            "s": round(dt, 3),
+            "agg_GB_s": round(agg, 2),
+        }
+        print(f"{rec['name']:<46s} {dt:>8.2f}s  {agg:>6.2f} GB/s aggregate")
+        results.append(rec)
+    finally:
+        for node in nodes:
+            try:
+                rt.run(node.stop())
+            except Exception:  # noqa: BLE001
+                pass
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
     return results
 
 

@@ -87,9 +87,32 @@ async def _server_auth(reader: asyncio.StreamReader, token: str) -> bool:
     return hmac.compare_digest(data, _AUTH_MAGIC + token.encode())
 
 
+# Large-transfer tuning: the asyncio stream default (64 KiB reader
+# limit, ~208 KiB kernel socket buffers) makes a 5 MiB object chunk
+# cost dozens of event-loop wakeups and transport-buffer memmoves
+# (~14 ms/chunk measured). A multi-MiB reader limit + socket buffers
+# let one chunk move in a few syscalls (reference: plasma/object
+# manager move chunks over dedicated high-watermark gRPC streams).
+_STREAM_LIMIT = 16 * 1024 * 1024
+_SOCK_BUF = 8 * 1024 * 1024
+
+
+def _tune_socket(sock) -> None:
+    import socket as _socket
+
+    try:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, _SOCK_BUF)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, _SOCK_BUF)
+    except OSError:
+        pass
+
+
 def _write_frame(writer: asyncio.StreamWriter, frame: tuple) -> None:
     data = pickle.dumps(frame, protocol=5)
-    writer.write(_HDR.pack(len(data)) + data)
+    writer.write(_HDR.pack(len(data)))
+    # Separate write: concatenating header+payload would copy the whole
+    # multi-MiB payload just to prepend 4 bytes.
+    writer.write(data)
 
 
 Handler = Callable[[str, dict, "Connection"], Awaitable[Any]]
@@ -254,7 +277,11 @@ class Server:
             )
             self.connections.add(conn)
 
-        self._server = await asyncio.start_server(on_conn, host, port)
+        self._server = await asyncio.start_server(
+            on_conn, host, port, limit=_STREAM_LIMIT
+        )
+        for sock in self._server.sockets:
+            _tune_socket(sock)
         self.port = self._server.sockets[0].getsockname()[1]
         return self.port
 
@@ -288,7 +315,12 @@ async def connect(
     token = _auth_token()
     for attempt in range(retries):
         try:
-            reader, writer = await asyncio.open_connection(host, int(port))
+            reader, writer = await asyncio.open_connection(
+                host, int(port), limit=_STREAM_LIMIT
+            )
+            sock = writer.get_extra_info("socket")
+            if sock is not None:
+                _tune_socket(sock)
             if token:
                 blob = _AUTH_MAGIC + token.encode()
                 writer.write(_HDR.pack(len(blob)) + blob)

@@ -274,6 +274,18 @@ def put(value: Any) -> ObjectRef:
     return _runtime.run(_runtime.core.put(value))
 
 
+def broadcast(ref: "ObjectRef", timeout: float | None = None) -> int:
+    """Relay-broadcast a store-resident object into every node's store
+    (reference: put-then-fan-out rides push_manager.h:28 chunked pushes;
+    here waves of node prefetches double the source set each round).
+    Returns the number of nodes that pulled a copy. Later ``get``s on
+    those nodes hit their local store instead of the owner."""
+    reply = _runtime.run(
+        _runtime.core.broadcast_object(ref, timeout), timeout
+    )
+    return reply["nodes"]
+
+
 def get(refs, timeout: float | None = _DEFAULT_TIMEOUT):
     single = isinstance(refs, ObjectRef)
     if single:

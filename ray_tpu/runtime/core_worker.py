@@ -104,6 +104,11 @@ class CoreWorker:
         # the node whose store has the bytes (multi-node pulls)
         self.memory: dict[str, tuple] = {}
         self._waiters: dict[str, list[asyncio.Future]] = {}
+        # Object directory for objects this worker owns: oid hex → node
+        # addrs holding a store copy beyond the primary (pullers register
+        # after caching; reference: ownership_object_directory.h location
+        # updates). Lets later pulls stripe across many sources.
+        self._locations: dict[str, set] = {}
 
         # function table
         self._exported: dict[int, str] = {}  # id(fn) → fn_id hex
@@ -278,7 +283,7 @@ class CoreWorker:
             await self.node.close()
         await self.server.stop()
 
-    async def _connect(self, addr: str) -> rpc.Connection:
+    async def _connect(self, addr: str, retries: int = 3) -> rpc.Connection:
         conn = self._conns.get(addr)
         if conn is not None and not conn._closed:
             return conn
@@ -287,7 +292,7 @@ class CoreWorker:
             conn = self._conns.get(addr)
             if conn is not None and not conn._closed:
                 return conn
-            conn = await rpc.connect(addr)
+            conn = await rpc.connect(addr, retries=retries)
             self._conns[addr] = conn
             return conn
 
@@ -427,9 +432,20 @@ class CoreWorker:
                 )
             except _NeedsPull as need:
                 try:
-                    conn = await self._connect(need.holder_addr)
+                    from ray_tpu.runtime import transfer
+
+                    conns, addr_of = await transfer.connect_sources(
+                        self._locations.get(oid_hex),
+                        need.holder_addr,
+                        self.node_addr,
+                        lambda a: self._connect(a, retries=1),
+                    )
                     return await self._pull_remote(
-                        ObjectID.from_hex(oid_hex), conn, remaining()
+                        ObjectID.from_hex(oid_hex),
+                        conns,
+                        None,
+                        remaining(),
+                        addr_of,
                     )
                 except GetTimeoutError:
                     raise
@@ -572,13 +588,25 @@ class CoreWorker:
             if view is not None:
                 return deserialize(view.inband, view.buffers)
             # The object lives in a node store elsewhere: pull it in
-            # chunks from the holding NODE (reference:
-            # ObjectManagerService.Push streams 5 MiB chunks,
-            # object_manager.proto:60), then cache it locally.
-            holder = reply.get("holder")
+            # pipelined chunks, striped across EVERY node known to hold
+            # a copy (reference: pull_manager.h:50 windowed chunk
+            # requests; locations from the owner's directory like
+            # ownership_object_directory.h), then cache it locally. The
+            # owner connection rides along as last-resort source, so
+            # stale/evicted holder sets can't lose a servable object.
+            from ray_tpu.runtime import transfer
+
+            srcs, addr_of = await transfer.connect_sources(
+                reply.get("holders"),
+                reply.get("holder"),
+                self.node_addr,
+                lambda a: self._connect(a, retries=1),
+                fallback=conn,
+            )
             try:
-                src = await self._connect(holder) if holder else conn
-                return await self._pull_remote(oid, src, remaining())
+                return await self._pull_remote(
+                    oid, srcs, conn, remaining(), addr_of
+                )
             except GetTimeoutError:
                 raise
             except (rpc.ConnectionLost, rpc.RpcError, ObjectLostError) as e:
@@ -610,73 +638,130 @@ class CoreWorker:
 
     PULL_CHUNK_BYTES = 5 * 1024 * 1024  # object_manager_default_chunk_size
 
-    async def _pull_remote(self, oid, owner_conn, timeout):
-        """Chunked pull of a store-resident object from its owner.
-
-        ``timeout`` bounds the WHOLE pull (the remaining budget shrinks
-        per chunk), matching get()'s single-deadline semantics."""
-        loop = asyncio.get_running_loop()
-        deadline = None if timeout is None else loop.time() + timeout
-
-        def remaining():
-            if deadline is None:
-                return None
-            left = deadline - loop.time()
-            if left <= 0:
-                raise GetTimeoutError(
-                    f"timed out pulling {oid.hex()[:12]}…"
-                )
-            return left
+    async def _pull_remote(
+        self, oid, srcs: list, owner_conn, timeout, addr_of: dict | None = None
+    ):
+        """Pipelined multi-source pull of a store-resident object
+        (reference: pull_manager.h:50). ``timeout`` bounds the WHOLE
+        pull, matching get()'s single-deadline semantics. On success the
+        copy is cached in this node's store and the owner is told about
+        the new location, so later pullers fan in from here too; holders
+        that proved dead are reported for pruning."""
+        from ray_tpu.runtime import transfer
 
         oid_hex = oid.hex()
+        failed: set = set()
         try:
-            meta = await asyncio.wait_for(
-                owner_conn.call("get_object_meta", oid_hex=oid_hex),
-                remaining(),
+            inband, buffers = await transfer.pull_object(
+                oid_hex,
+                srcs,
+                timeout,
+                chunk_bytes=self.PULL_CHUNK_BYTES,
+                failed=failed,
             )
-        except asyncio.TimeoutError:
-            raise GetTimeoutError(f"timed out pulling {oid_hex[:12]}…")
-        if not meta.get("ok"):
-            raise ObjectLostError(
-                f"object {oid_hex[:12]}… vanished from the holder's store"
-            )
-        total = meta["total"]
-        parts = []
-        offset = 0
-        while offset < total:
-            try:
-                chunk = await asyncio.wait_for(
-                    owner_conn.call(
-                        "get_object_chunk",
-                        oid_hex=oid_hex,
-                        offset=offset,
-                        size=self.PULL_CHUNK_BYTES,
-                    ),
-                    remaining(),
-                )
-            except asyncio.TimeoutError:
-                raise GetTimeoutError(f"timed out pulling {oid_hex[:12]}…")
-            if not chunk.get("ok"):
-                raise ObjectLostError(
-                    f"object {oid_hex[:12]}… pull failed mid-stream"
-                )
-            parts.append(chunk["data"])
-            offset += len(chunk["data"])
-        blob = b"".join(parts)
-        seg_lens = meta["seg_lens"]
-        segs = []
-        pos = 0
-        for n in seg_lens:
-            segs.append(blob[pos : pos + n])
-            pos += n
-        inband, buffers = segs[0], segs[1:]
+        finally:
+            if failed and addr_of:
+                bad = [addr_of[c] for c in failed if c in addr_of]
+                if bad:
+                    await self._prune_locations(oid_hex, bad, owner_conn)
         # Cache locally so later readers on this node hit the store.
         try:
             self.store.put(oid, Serialized(inband, list(buffers)))
         except Exception:  # noqa: BLE001 - cache is best-effort
             pass
+        else:
+            if self.node_addr:
+                if owner_conn is None:
+                    # We ARE the owner (self-owned object whose bytes
+                    # lived on another node): record the new copy
+                    # directly.
+                    self._locations.setdefault(oid_hex, set()).add(
+                        self.node_addr
+                    )
+                else:
+                    try:
+                        await owner_conn.call(
+                            "object_location_add",
+                            oid_hex=oid_hex,
+                            addr=self.node_addr,
+                        )
+                    except (rpc.ConnectionLost, rpc.RpcError):
+                        pass  # owner gone; registry dies with it
         return deserialize(inband, buffers)
 
+
+    async def broadcast_object(
+        self, ref, timeout: float | None = None
+    ) -> dict:
+        """Relay-broadcast a store-resident object into every node's
+        store in doubling waves (reference: push_manager.h:28 pipelined
+        pushes — a put-then-fan-out there floods from the single owner;
+        here each wave's finishers register as locations, so wave k
+        pulls stripe across 2^k sources: a broadcast tree through node
+        stores)."""
+        oid_hex = ref.hex
+        owner_addr = ref.owner_addr or self.addr
+        table = await self.head.call("node_table")
+        addrs = [n["addr"] for n in table.values() if n.get("addr")]
+        conn = await self._connect(owner_addr)
+        reply = await conn.call("get_object", oid_hex=oid_hex)
+        if reply["kind"] == "value":
+            # Inline object: nothing store-resident to relay.
+            return {"nodes": 0, "bytes": 0, "inline": True}
+        if reply["kind"] != "in_store":
+            raise ValueError(
+                f"broadcast needs a store-resident object, got "
+                f"{reply['kind']!r}"
+            )
+        holders = set(reply.get("holders") or [])
+        if reply.get("holder"):
+            holders.add(reply["holder"])
+        pending = [a for a in addrs if a not in holders]
+        sources = max(1, len(holders))
+        # Wave width doubles with the source set but is capped: more
+        # concurrent pulls than links just thrash buffers (measured on
+        # loopback; real clusters bound this by per-node NIC anyway).
+        max_wave = 4
+        transferred = cached = 0
+        failed: list = []
+        while pending:
+            width = min(sources, max_wave)
+            wave, pending = pending[:width], pending[width:]
+
+            async def prefetch(addr):
+                c = await self._connect(addr, retries=1)
+                return await c.call(
+                    "prefetch_object",
+                    oid_hex=oid_hex,
+                    owner_addr=owner_addr,
+                    timeout=timeout or 120.0,
+                )
+
+            results = await asyncio.gather(
+                *(prefetch(a) for a in wave), return_exceptions=True
+            )
+            for addr, r in zip(wave, results):
+                # A dead node (e.g. not yet swept from the node table)
+                # is skipped, not fatal: the live nodes still get their
+                # copy and the caller learns who failed.
+                if isinstance(r, BaseException) or not r.get("ok"):
+                    failed.append((addr, repr(r)))
+                elif r.get("cached"):
+                    cached += 1
+                    sources += 1
+                else:
+                    transferred += 1
+                    sources += 1
+        if transferred + cached == 0 and failed:
+            raise ObjectLostError(
+                f"broadcast of {oid_hex[:12]}… reached no node: {failed}"
+            )
+        return {
+            "nodes": transferred,
+            "cached": cached,
+            "failed": failed,
+            "inline": False,
+        }
 
     async def get(self, refs: Sequence, timeout: float | None = None) -> list:
         return list(
@@ -1432,7 +1517,8 @@ class CoreWorker:
             if loc and loc[0] == "in_store":
                 # holder None = the LOCAL node's store; it must vote too,
                 # or one remote arg outweighs any number of local ones.
-                holder = loc[1] or self.node_addr
+                # (put() records are ("in_store",) with no holder slot.)
+                holder = (loc[1] if len(loc) > 1 else None) or self.node_addr
                 if holder:
                     counts[holder] = counts.get(holder, 0) + 1
         if not counts:
@@ -1911,7 +1997,40 @@ class CoreWorker:
             return {"kind": "value", "inband": rest[0], "buffers": rest[1]}
         if kind == "tensor":
             return {"kind": "tensor", "meta": rest[0]}
-        return {"kind": "in_store", "holder": rest[0] if rest else None}
+        primary = rest[0] if rest else None
+        holders = [a for a in self._locations.get(oid_hex, ()) if a != primary]
+        return {"kind": "in_store", "holder": primary, "holders": holders}
+
+    async def _on_object_location_add(self, conn, oid_hex: str, addr: str):
+        """A puller cached a copy of an object we own in its node store;
+        record the location so later pulls can fan in from it."""
+        self._locations.setdefault(oid_hex, set()).add(addr)
+        return {"ok": True}
+
+    async def _on_object_location_remove(
+        self, conn, oid_hex: str, addrs: list
+    ):
+        """A puller found these holders dead/evicted: prune them so the
+        next resolve doesn't hand out stale sources."""
+        locs = self._locations.get(oid_hex)
+        if locs:
+            locs.difference_update(addrs)
+        return {"ok": True}
+
+    async def _prune_locations(
+        self, oid_hex: str, addrs: list, owner_conn
+    ) -> None:
+        if owner_conn is None:
+            locs = self._locations.get(oid_hex)
+            if locs:
+                locs.difference_update(addrs)
+            return
+        try:
+            await owner_conn.call(
+                "object_location_remove", oid_hex=oid_hex, addrs=addrs
+            )
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
 
     async def _on_get_object_meta(self, conn, oid_hex: str):
         """Segment layout of a store-resident object (chunked pull)."""

@@ -320,6 +320,11 @@ class NodeManager:
         # ObjectManager serves Push/Pull, object_manager.h:128) — workers
         # come and go, the node daemon persists.
         self._store_reader = None
+        # Peer-node connections for prefetch/broadcast relays, and the
+        # location directory for objects anchored here (client-mode puts
+        # name this node as owner address).
+        self._peers: dict[str, rpc.Connection] = {}
+        self._obj_locations: dict[str, set] = {}
 
     # ----------------------------------------------------------- startup
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -613,7 +618,15 @@ class NodeManager:
         from ray_tpu._private.ids import ObjectID
 
         if self._store().contains(ObjectID.from_hex(oid_hex)):
-            return {"kind": "in_store", "holder": self.addr}
+            return {
+                "kind": "in_store",
+                "holder": self.addr,
+                "holders": [
+                    a
+                    for a in self._obj_locations.get(oid_hex, ())
+                    if a != self.addr
+                ],
+            }
         import cloudpickle
 
         from ray_tpu.exceptions import ObjectLostError
@@ -624,6 +637,85 @@ class NodeManager:
                 ObjectLostError(f"object {oid_hex[:12]}… not on this node")
             ),
         }
+
+    async def _on_object_location_add(self, conn, oid_hex: str, addr: str):
+        self._obj_locations.setdefault(oid_hex, set()).add(addr)
+        return {"ok": True}
+
+    async def _on_object_location_remove(
+        self, conn, oid_hex: str, addrs: list
+    ):
+        locs = self._obj_locations.get(oid_hex)
+        if locs:
+            locs.difference_update(addrs)
+        return {"ok": True}
+
+    async def _connect_peer(
+        self, addr: str, retries: int = 3
+    ) -> rpc.Connection:
+        conn = self._peers.get(addr)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = await rpc.connect(addr, retries=retries)
+        self._peers[addr] = conn
+        return conn
+
+    async def _on_prefetch_object(
+        self, conn, oid_hex: str, owner_addr: str, timeout: float = 120.0
+    ):
+        """Pull an object into THIS node's store (the broadcast relay
+        primitive; reference: push_manager.h:28 — the reference pushes
+        chunks at nodes, here the coordinator asks nodes to pull, and
+        each completed node registers itself as a source for the next
+        wave)."""
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu.runtime import transfer
+        from ray_tpu._private.serialization import Serialized
+
+        oid = ObjectID.from_hex(oid_hex)
+        store = self._store()
+        if store.contains(oid):
+            return {"ok": True, "cached": True}
+        owner = await self._connect_peer(owner_addr)
+        reply = await owner.call("get_object", oid_hex=oid_hex)
+        if reply["kind"] == "value":
+            store.put(
+                oid, Serialized(reply["inband"], list(reply["buffers"]))
+            )
+        elif reply["kind"] == "in_store":
+            srcs, addr_of = await transfer.connect_sources(
+                reply.get("holders"),
+                reply.get("holder"),
+                self.addr,
+                lambda a: self._connect_peer(a, retries=1),
+                fallback=owner,
+            )
+            failed: set = set()
+            try:
+                inband, buffers = await transfer.pull_object(
+                    oid_hex, srcs, timeout, failed=failed
+                )
+            finally:
+                bad = [addr_of[c] for c in failed if c in addr_of]
+                if bad:
+                    try:
+                        await owner.call(
+                            "object_location_remove",
+                            oid_hex=oid_hex,
+                            addrs=bad,
+                        )
+                    except (rpc.ConnectionLost, rpc.RpcError):
+                        pass
+            store.put(oid, Serialized(inband, list(buffers)))
+        else:
+            return {"ok": False, "error": f"unexpected kind {reply['kind']}"}
+        try:
+            await owner.call(
+                "object_location_add", oid_hex=oid_hex, addr=self.addr
+            )
+        except (rpc.ConnectionLost, rpc.RpcError):
+            pass
+        return {"ok": True, "cached": False}
 
     async def _on_get_object_meta(self, conn, oid_hex: str):
         from ray_tpu._private.ids import ObjectID

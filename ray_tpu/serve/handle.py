@@ -70,7 +70,25 @@ class DeploymentStreamResponse:
         self._sync = sync
 
     def __aiter__(self):
-        return self._agen
+        if not self._sync:
+            return self._agen
+
+        # Foreign event loop (sync=True means the caller is NOT on the
+        # runtime loop): drive the router generator on the runtime loop
+        # and bridge each item — iterating it directly would attach rpc
+        # futures to the wrong loop.
+        async def bridge():
+            while True:
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._agen.__anext__(), core_api._runtime.loop
+                )
+                try:
+                    item = await asyncio.wrap_future(fut)
+                except StopAsyncIteration:
+                    return
+                yield item
+
+        return bridge()
 
     def __iter__(self):
         if not self._sync:

@@ -78,3 +78,126 @@ def test_ref_forwarded_to_third_process(cluster, remote_node):
     ref = produce.remote()
     total = ray_tpu.get(consume.remote(ref), timeout=120)
     assert total == 512 * 512 * 7.0
+
+
+@pytest.fixture(scope="module")
+def extra_nodes(cluster, tmp_path_factory):
+    """Four more nodes, each with its own store dir (broadcast targets)."""
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+    nodes = []
+
+    async def launch(i):
+        node = NodeManager(
+            rt.core.head_addr,
+            str(tmp_path_factory.mktemp(f"bcast_store_{i}")),
+            resources={"CPU": 1},
+        )
+        await node.start()
+        return node
+
+    for i in range(4):
+        nodes.append(rt.run(launch(i)))
+    yield nodes
+    for n in nodes:
+        rt.run(n.stop())
+
+
+def test_broadcast_reaches_every_node_store(cluster, extra_nodes):
+    """put → broadcast: every node ends up with a store copy, and the
+    owner's location directory knows them (the relay-wave mechanics)."""
+    from ray_tpu._private.ids import ObjectID
+
+    rt = core_api._runtime
+    payload = np.arange(2_500_000, dtype=np.float64)  # ~20 MB, 4 chunks
+    ref = ray_tpu.put(payload)
+    n = ray_tpu.broadcast(ref, timeout=120)
+    assert n >= len(extra_nodes)
+    oid = ObjectID.from_hex(ref.hex)
+    for node in extra_nodes:
+        assert node._store().contains(oid), f"{node.addr} missing the copy"
+    # The owner's directory should now list the extra nodes as holders.
+    locs = rt.core._locations.get(ref.hex, set())
+    for node in extra_nodes:
+        assert node.addr in locs
+
+
+def test_broadcast_then_remote_task_reads_locally(cluster, extra_nodes):
+    """After a broadcast, a task running on a broadcast target gets the
+    object without touching the owner's chunk path (its node store has
+    it)."""
+    payload = np.full((1024, 256), 3.0, np.float32)
+    ref = ray_tpu.put(payload)
+    ray_tpu.broadcast(ref, timeout=120)
+
+    @ray_tpu.remote
+    def total(arr):
+        return float(arr.sum())
+
+    assert ray_tpu.get(total.remote(ref), timeout=60) == float(
+        payload.sum()
+    )
+
+
+def test_broadcast_inline_object_is_noop(cluster):
+    ref = ray_tpu.put(b"tiny")
+    assert ray_tpu.broadcast(ref) == 0
+
+
+def test_broadcast_skips_dead_node(cluster, extra_nodes, tmp_path_factory):
+    """A node that dies before the broadcast (but is still in the node
+    table) is skipped, not fatal: live nodes all get their copy."""
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+
+    async def launch():
+        node = NodeManager(
+            rt.core.head_addr,
+            str(tmp_path_factory.mktemp("dead_store")),
+            resources={"CPU": 0.01},
+        )
+        await node.start()
+        return node
+
+    doomed = rt.run(launch())
+    # Kill its server without deregistering (simulates a crash).
+    rt.run(doomed.server.stop())
+    payload = np.ones(1_000_000, np.float64)
+    ref = ray_tpu.put(payload)
+    reply = rt.run(rt.core.broadcast_object(ref, 60), 120)
+    assert any(doomed.addr == addr for addr, _ in reply["failed"])
+    from ray_tpu._private.ids import ObjectID
+
+    oid = ObjectID.from_hex(ref.hex)
+    for node in extra_nodes:
+        assert node._store().contains(oid)
+
+
+def test_multi_source_pull_survives_holder_death(cluster, extra_nodes):
+    """Kill one broadcast holder; a fresh puller striping across holders
+    still assembles the object from the survivors."""
+    from ray_tpu.runtime import transfer
+
+    rt = core_api._runtime
+    payload = np.arange(3_000_000, dtype=np.float64)  # ~24 MB, 5 chunks
+    ref = ray_tpu.put(payload)
+    ray_tpu.broadcast(ref, timeout=120)
+
+    async def pull_with_one_dead():
+        conns = []
+        for node in extra_nodes:
+            conns.append(await rt.core._connect(node.addr))
+        # First holder connection is closed mid-flight: chunks assigned
+        # to it must fail over to the others.
+        await conns[0].close()
+        inband, buffers = await transfer.pull_object(
+            ref.hex, conns, timeout=60
+        )
+        from ray_tpu._private.serialization import deserialize
+
+        return deserialize(inband, buffers)
+
+    out = rt.run(pull_with_one_dead())
+    np.testing.assert_array_equal(out, payload)

@@ -42,6 +42,16 @@ def test_microbench_floors():
                 f"{match['name']}: {match['ops_per_s']:.0f} < {floor} ops/s"
             )
     assert not failures, "control-plane regressions:\n" + "\n".join(failures)
+    bcast = next(
+        (r for r in results if r["name"].startswith("broadcast ")), None
+    )
+    assert bcast is not None, "benchmark 'broadcast' missing"
+    # Aggregate store-to-store GB/s; conservative floor (the 1-core CI
+    # VM is memcpy-bound and noisy — this catches order-of-magnitude
+    # regressions like a return to sequential single-holder pulls).
+    assert bcast["agg_GB_s"] >= 0.02, (
+        f"broadcast regressed: {bcast['agg_GB_s']} GB/s aggregate"
+    )
     ttfb = next(
         (r for r in results if r["name"] == "serve sse ttfb"), None
     )

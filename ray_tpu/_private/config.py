@@ -59,8 +59,13 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                       "across a head restart"),
     # --- rpc hardening
     "AUTH_TOKEN": (str, "", "shared-secret connection token; empty "
-                            "disables auth (set one on every host of a "
-                            "deployed cluster)"),
+                            "disables auth (the start CLI generates one "
+                            "by default — see scripts.py start)"),
+    "TLS_CERT": (str, "", "path to a PEM cert: servers present it, "
+                          "clients pin it (self-signed is fine; "
+                          "`start --head --tls` generates one)"),
+    "TLS_KEY": (str, "", "path to the PEM private key for TLS_CERT "
+                         "(servers only)"),
     "RPC_MAX_FRAME": (int, 2 << 30, "largest accepted rpc frame (bytes)"),
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: method:prob[:mode] list"),

@@ -55,6 +55,39 @@ def _auth_token() -> str:
     return config.get("AUTH_TOKEN")
 
 
+def _ssl_server_ctx():
+    """Server TLS context when TLS_CERT/TLS_KEY are configured (the
+    token handshake then rides an encrypted channel; reference pairs
+    its token validator with gRPC TLS)."""
+    import ssl
+
+    from ray_tpu._private import config
+
+    cert, key = config.get("TLS_CERT"), config.get("TLS_KEY")
+    if not cert or not key:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    return ctx
+
+
+def _ssl_client_ctx():
+    """Client TLS context pinning the cluster cert: any server holding
+    the matching key is trusted, hostname is irrelevant."""
+    import ssl
+
+    from ray_tpu._private import config
+
+    cert = config.get("TLS_CERT")
+    if not cert:
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.load_verify_locations(cert)
+    return ctx
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> tuple:
     hdr = await reader.readexactly(_HDR.size)
     (length,) = _HDR.unpack(hdr)
@@ -278,7 +311,7 @@ class Server:
             self.connections.add(conn)
 
         self._server = await asyncio.start_server(
-            on_conn, host, port, limit=_STREAM_LIMIT
+            on_conn, host, port, limit=_STREAM_LIMIT, ssl=_ssl_server_ctx()
         )
         for sock in self._server.sockets:
             _tune_socket(sock)
@@ -316,7 +349,7 @@ async def connect(
     for attempt in range(retries):
         try:
             reader, writer = await asyncio.open_connection(
-                host, int(port), limit=_STREAM_LIMIT
+                host, int(port), limit=_STREAM_LIMIT, ssl=_ssl_client_ctx()
             )
             sock = writer.get_extra_info("socket")
             if sock is not None:

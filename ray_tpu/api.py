@@ -274,15 +274,30 @@ def put(value: Any) -> ObjectRef:
     return _runtime.run(_runtime.core.put(value))
 
 
-def broadcast(ref: "ObjectRef", timeout: float | None = None) -> int:
+def broadcast(
+    ref: "ObjectRef", timeout: float | None = None, strict: bool = True
+) -> int:
     """Relay-broadcast a store-resident object into every node's store
     (reference: put-then-fan-out rides push_manager.h:28 chunked pushes;
     here waves of node prefetches double the source set each round).
-    Returns the number of nodes that pulled a copy. Later ``get``s on
-    those nodes hit their local store instead of the owner."""
+    Returns the number of nodes that newly pulled a copy (nodes already
+    holding one don't count). Later ``get``s on those nodes hit their
+    local store instead of the owner.
+
+    With ``strict`` (default), a node that could not be reached raises
+    ObjectLostError naming it — callers relying on every-node locality
+    must not silently proceed without it. ``strict=False`` returns the
+    partial count instead."""
     reply = _runtime.run(
         _runtime.core.broadcast_object(ref, timeout), timeout
     )
+    if strict and reply.get("failed"):
+        from ray_tpu.exceptions import ObjectLostError
+
+        raise ObjectLostError(
+            f"broadcast incomplete ({reply['nodes']} pulled, "
+            f"{len(reply['failed'])} failed): {reply['failed']}"
+        )
     return reply["nodes"]
 
 

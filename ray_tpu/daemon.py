@@ -64,6 +64,105 @@ async def _serve_until_signal(stoppables) -> None:
             pass
 
 
+_LOOPBACK = ("127.0.0.1", "localhost", "::1")
+
+
+def resolve_token(
+    session_dir: str,
+    *,
+    explicit: str | None = None,
+    no_auth: bool = False,
+    is_head: bool = False,
+    host: str = "127.0.0.1",
+    warn=print,
+) -> str:
+    """The ONE token-resolution rule, shared by the CLI and the daemon.
+
+    Default-ON auth (reference: token auth
+    authentication_token_validator.h:26): explicit flag > env/config >
+    (head: generate; node: session-dir file). Returns "" only under
+    --no-auth, warning loudly when that combines with a routable bind
+    address (the RPC layer deserializes pickle between authenticated
+    peers — an open port is code execution)."""
+    import secrets
+
+    from ray_tpu._private import config
+
+    token = explicit or config.get("AUTH_TOKEN")
+    if no_auth:
+        token = ""
+    elif not token:
+        if is_head:
+            token = secrets.token_hex(16)
+        else:
+            token_path = os.path.join(session_dir, "auth.token")
+            if os.path.exists(token_path):
+                token = open(token_path).read().strip()
+    if not token and host not in _LOOPBACK:
+        warn(
+            f"WARNING: binding {host} with auth disabled — any host "
+            "with network reach gets code execution. Set "
+            "RAY_TPU_AUTH_TOKEN or drop --no-auth."
+        )
+    return token
+
+
+def _setup_security(args, session_dir: str, is_head: bool) -> str:
+    """Resolve the auth token + TLS material and install them in config
+    (set_system_config also exports to os.environ, which is how spawned
+    workers inherit them). Returns the resolved token ("" = auth off)."""
+    from ray_tpu._private import config
+
+    token_path = os.path.join(session_dir, "auth.token")
+    token = resolve_token(
+        session_dir,
+        no_auth=getattr(args, "no_auth", False),
+        is_head=is_head,
+        host=args.host,
+        warn=lambda msg: print(msg, flush=True),
+    )
+    overrides = {"AUTH_TOKEN": token}
+    if is_head:
+        if token:
+            fd = os.open(
+                token_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600
+            )
+            with os.fdopen(fd, "w") as f:
+                f.write(token)
+        else:
+            # A stale token from a previous authed cluster would poison
+            # joins and CLI connects to this no-auth one.
+            try:
+                os.unlink(token_path)
+            except OSError:
+                pass
+    if getattr(args, "tls", False):
+        # Operator-provided material (env/config) wins; otherwise the
+        # session dir. Only the head may generate — every other host
+        # must receive a COPY of both files (one shared cert is the
+        # cluster's identity; clients pin it).
+        cert = config.get("TLS_CERT") or os.path.join(session_dir, "tls.crt")
+        key = config.get("TLS_KEY") or os.path.join(session_dir, "tls.key")
+        if not (os.path.exists(cert) and os.path.exists(key)):
+            if is_head:
+                from ray_tpu._private.tls_utils import generate_self_signed
+
+                generate_self_signed(cert, key)
+            else:
+                raise SystemExit(
+                    f"--tls: no cert/key at {cert} / {key}; copy "
+                    "tls.crt AND tls.key from the head's session dir "
+                    "(or set RAY_TPU_TLS_CERT / RAY_TPU_TLS_KEY)"
+                )
+        overrides["TLS_CERT"] = cert
+        overrides["TLS_KEY"] = key
+    elif config.get("TLS_CERT"):
+        overrides["TLS_CERT"] = config.get("TLS_CERT")
+        overrides["TLS_KEY"] = config.get("TLS_KEY")
+    config.set_system_config(overrides)
+    return token
+
+
 async def _run_head(args) -> None:
     from ray_tpu._private import config
     from ray_tpu.runtime.head import HeadService
@@ -72,6 +171,7 @@ async def _run_head(args) -> None:
 
     session_dir = args.session_dir
     os.makedirs(session_dir, exist_ok=True)
+    token = _setup_security(args, session_dir, is_head=True)
     journal = os.path.join(session_dir, "head.journal")
     head = HeadService(journal_path=journal)
     addr = await head.start(host=args.host, port=args.port)
@@ -91,11 +191,17 @@ async def _run_head(args) -> None:
 
     _write_atomic(os.path.join(session_dir, "head.addr"), addr)
     print(f"head up at {addr}", flush=True)
+    env_prefix = f"RAY_TPU_AUTH_TOKEN={token} " if token else ""
+    tls_note = " --tls (copy tls.crt first)" if getattr(
+        args, "tls", False
+    ) else ""
     print(
-        f"join from other hosts:  python -m ray_tpu.scripts start "
-        f"--address {addr}",
+        f"join from other hosts:  {env_prefix}python -m ray_tpu.scripts "
+        f"start --address {addr}{tls_note}",
         flush=True,
     )
+    if token:
+        print(f"auth token written to {session_dir}/auth.token", flush=True)
     await _serve_until_signal(stoppables)
 
 
@@ -103,6 +209,7 @@ async def _run_node(args) -> None:
     from ray_tpu.runtime.node import NodeManager
     from ray_tpu.runtime.object_store import default_store_dir
 
+    _setup_security(args, args.session_dir, is_head=False)
     node = NodeManager(
         head_addr=args.address,
         store_dir=default_store_dir(f"cli-{os.getpid()}"),
@@ -122,6 +229,17 @@ def main(argv=None) -> int:
         sp.add_argument("--num-cpus", type=float, default=None)
         sp.add_argument("--resources", default=None, help="JSON dict")
         sp.add_argument("--session-dir", default=DEFAULT_SESSION_DIR)
+        sp.add_argument(
+            "--no-auth",
+            action="store_true",
+            help="disable the connection token (loopback dev only)",
+        )
+        sp.add_argument(
+            "--tls",
+            action="store_true",
+            help="encrypt cluster RPC (head generates a self-signed "
+            "cert in the session dir; nodes need a copy of tls.crt)",
+        )
         if role == "head":
             sp.add_argument("--port", type=int, default=0)
             sp.add_argument(

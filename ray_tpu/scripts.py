@@ -12,13 +12,26 @@ import json
 import sys
 
 
-def _connect(address: str | None):
+def _connect(address: str | None, session_dir: str | None = None):
     import os
 
     import ray_tpu
 
     from ray_tpu._private import config
 
+    # Same-host convenience: a CLI running where `start` ran can read
+    # the session token instead of requiring the env var (`stop`
+    # removes the file, so it can't outlive its cluster).
+    if not config.get("AUTH_TOKEN"):
+        from ray_tpu.daemon import DEFAULT_SESSION_DIR
+
+        token_path = os.path.join(
+            session_dir or DEFAULT_SESSION_DIR, "auth.token"
+        )
+        if os.path.exists(token_path):
+            config.set_system_config(
+                {"AUTH_TOKEN": open(token_path).read().strip()}
+            )
     address = address or config.get("ADDRESS") or None
     if not address:
         # Booting a fresh cluster just to inspect it would print a
@@ -39,7 +52,7 @@ def _connect(address: str | None):
 def cmd_status(args) -> int:
     from ray_tpu.util import state
 
-    _connect(args.address)
+    _connect(args.address, getattr(args, "session_dir", None))
     nodes = state.list_nodes()
     print(f"nodes: {len(nodes)}")
     for n in nodes:
@@ -57,7 +70,7 @@ def cmd_status(args) -> int:
 def cmd_list(args) -> int:
     from ray_tpu.util import state
 
-    _connect(args.address)
+    _connect(args.address, getattr(args, "session_dir", None))
     kind = args.kind
     if kind == "nodes":
         out = state.list_nodes()
@@ -82,7 +95,7 @@ def cmd_list(args) -> int:
 def cmd_timeline(args) -> int:
     from ray_tpu.util import state
 
-    _connect(args.address)
+    _connect(args.address, getattr(args, "session_dir", None))
     path = state.timeline(args.output)
     print(f"wrote chrome trace to {path} (open in chrome://tracing)")
     return 0
@@ -91,7 +104,7 @@ def cmd_timeline(args) -> int:
 def cmd_metrics(args) -> int:
     from ray_tpu.util import state
 
-    _connect(args.address)
+    _connect(args.address, getattr(args, "session_dir", None))
     sys.stdout.write(state.prometheus_metrics())
     return 0
 
@@ -101,7 +114,7 @@ def cmd_dashboard(args) -> int:
 
     from ray_tpu.dashboard import start_dashboard
 
-    _connect(args.address)
+    _connect(args.address, getattr(args, "session_dir", None))
     dash = start_dashboard(port=args.port)
     print(f"dashboard at {dash.url} (ctrl-c to stop)")
     try:
@@ -126,6 +139,26 @@ def cmd_start(args) -> int:
     session_dir = args.session_dir or DEFAULT_SESSION_DIR
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
 
+    # Auth is ON by default: resolve (or generate) the token here so the
+    # join command can be printed, and hand it to the daemon via the
+    # environment — argv would leak it to every `ps` on the host.
+    from ray_tpu.daemon import resolve_token
+
+    env = dict(os.environ)
+    token = resolve_token(
+        session_dir,
+        explicit=args.auth_token,
+        no_auth=args.no_auth,
+        is_head=args.head,
+        host=args.host,
+        warn=lambda msg: print(msg, file=sys.stderr),
+    )
+    token_path = os.path.join(session_dir, "auth.token")
+    if token:
+        env["RAY_TPU_AUTH_TOKEN"] = token
+    else:
+        env.pop("RAY_TPU_AUTH_TOKEN", None)
+
     if args.head:
         role = "head"
         cmd = [
@@ -133,6 +166,10 @@ def cmd_start(args) -> int:
             "--host", args.host, "--port", str(args.port),
             "--session-dir", session_dir,
         ]
+        if args.no_auth:
+            cmd.append("--no-auth")
+        if args.tls:
+            cmd.append("--tls")
     else:
         if not args.address:
             print(
@@ -148,6 +185,10 @@ def cmd_start(args) -> int:
             "--host", args.host,
             "--session-dir", session_dir,
         ]
+        if args.no_auth:
+            cmd.append("--no-auth")
+        if args.tls:
+            cmd.append("--tls")
     if args.num_cpus is not None:
         cmd += ["--num-cpus", str(args.num_cpus)]
     if args.resources:
@@ -162,10 +203,10 @@ def cmd_start(args) -> int:
         except OSError:
             pass
     if args.block:
-        return subprocess.call(cmd)
+        return subprocess.call(cmd, env=env)
     with open(log_path, "ab") as log:
         proc = subprocess.Popen(
-            cmd, stdout=log, stderr=subprocess.STDOUT,
+            cmd, stdout=log, stderr=subprocess.STDOUT, env=env,
             start_new_session=True,  # survive the CLI's terminal
         )
     pid_path = os.path.join(session_dir, f"{role}-{proc.pid}.pid")
@@ -187,11 +228,15 @@ def cmd_start(args) -> int:
             if os.path.exists(addr_path):
                 addr = open(addr_path).read().strip()
                 print(f"head started at {addr} (pid {proc.pid})")
+                prefix = f"RAY_TPU_AUTH_TOKEN={token} " if token else ""
+                tls_note = " --tls (copy tls.crt over first)" if args.tls else ""
                 print(
-                    "join other hosts with: python -m ray_tpu.scripts "
-                    f"start --address {addr}"
+                    f"join other hosts with: {prefix}python -m "
+                    f"ray_tpu.scripts start --address {addr}{tls_note}"
                 )
-                print(f"stop with: python -m ray_tpu.scripts stop")
+                if token:
+                    print(f"auth token: {token_path} (0600)")
+                print("stop with: python -m ray_tpu.scripts stop")
                 return 0
             time.sleep(0.1)
         print(f"head did not come up in 30s; see {log_path}",
@@ -258,10 +303,15 @@ def cmd_stop(args) -> int:
                 pass
         os.unlink(path)
         print(f"stopped pid {pid}")
-    try:
-        os.unlink(os.path.join(session_dir, "head.addr"))
-    except OSError:
-        pass
+    # A stale address or token from this cluster would poison the next
+    # one started in the same session dir (TLS material stays: it is
+    # not cluster-instance state, and regenerating it would force a
+    # re-copy to every host).
+    for name in ("head.addr", "auth.token"):
+        try:
+            os.unlink(os.path.join(session_dir, name))
+        except OSError:
+            pass
     return 0
 
 
@@ -272,7 +322,7 @@ def cmd_logs(args) -> int:
     prefix: print that worker's log — dead workers included."""
     from ray_tpu.util import state
 
-    _connect(args.address)
+    _connect(args.address, getattr(args, "session_dir", None))
     if args.worker_id:
         text = state.read_worker_log(args.worker_id, tail_bytes=args.tail)
         if text is None:
@@ -308,6 +358,10 @@ def cmd_config(args) -> int:
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", default=None, help="head address host:port")
+    p.add_argument("--session-dir", default=None,
+                   help="session dir to read the auth token from "
+                        "(same-host convenience; default "
+                        "/tmp/ray_tpu_cluster)")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     sp = sub.add_parser("start")
@@ -319,6 +373,15 @@ def main(argv=None) -> int:
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--resources", default=None, help="JSON dict")
     sp.add_argument("--session-dir", default=None)
+    sp.add_argument("--auth-token", default=None,
+                    help="shared-secret token (default: generated on "
+                         "--head, read from the session dir on join)")
+    sp.add_argument("--no-auth", action="store_true",
+                    help="disable the connection token (loopback dev "
+                         "only; a warning is printed for routable hosts)")
+    sp.add_argument("--tls", action="store_true",
+                    help="encrypt cluster RPC with a self-signed cert "
+                         "generated in the session dir")
     sp.add_argument("--block", action="store_true",
                     help="run in the foreground")
     stp = sub.add_parser("stop")

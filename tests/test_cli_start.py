@@ -13,8 +13,9 @@ import ray_tpu
 from ray_tpu.placement import placement_group
 
 
-def _cli(args, timeout=60):
+def _cli(args, timeout=60, extra_env=None):
     env = dict(os.environ)
+    env.update(extra_env or {})
     env["JAX_PLATFORMS"] = "cpu"
     repo_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
     env["PYTHONPATH"] = os.pathsep.join(
@@ -42,17 +43,38 @@ def test_start_head_join_stop(tmp_path):
     assert out.returncode == 0, out.stdout + out.stderr
     addr = open(os.path.join(d_head, "head.addr")).read().strip()
 
+    # Auth is on by default: the head generated a token (0600) and put
+    # it in the printed join command.
+    token_path = os.path.join(d_head, "auth.token")
+    assert os.path.exists(token_path)
+    assert os.stat(token_path).st_mode & 0o777 == 0o600
+    token = open(token_path).read().strip()
+    assert token and f"RAY_TPU_AUTH_TOKEN={token}" in out.stdout
+
+    from ray_tpu._private import config as _config
+
     try:
+        # A separate "host" (fresh session dir) joins WITH the token.
         out = _cli(
             [
-                "start", "--address", addr,
+                "start", "--address", addr, "--auth-token", token,
                 "--session-dir", d_node, "--num-cpus", "1",
             ]
         )
         assert out.returncode == 0, out.stdout + out.stderr
 
+        # A tokenless stranger is refused before any pickle parsing.
+        import pytest as _pytest
+
+        from ray_tpu._private import rpc as _rpc
+
+        with _pytest.raises(Exception):
+            ray_tpu.init(address=f"ray://{addr}")
+        ray_tpu.shutdown()
+
         # Client driver (joins NO node): work must land on the two
         # CLI-started nodes.
+        _config.set_system_config({"AUTH_TOKEN": token})
         ray_tpu.init(address=f"ray://{addr}")
         try:
             # Wait for both nodes to register.
@@ -91,8 +113,11 @@ def test_start_head_join_stop(tmp_path):
         finally:
             ray_tpu.shutdown()
     finally:
-        out1 = _cli(["stop", "--session-dir", d_node])
-        out2 = _cli(["stop", "--session-dir", d_head])
+        _config._overrides.pop("AUTH_TOKEN", None)
+        os.environ.pop("RAY_TPU_AUTH_TOKEN", None)
+        env_tok = {"RAY_TPU_AUTH_TOKEN": token}
+        out1 = _cli(["stop", "--session-dir", d_node], extra_env=env_tok)
+        out2 = _cli(["stop", "--session-dir", d_head], extra_env=env_tok)
     assert out1.returncode == 0 and out2.returncode == 0
     # pid files consumed; daemons gone.
     assert not [
@@ -101,3 +126,77 @@ def test_start_head_join_stop(tmp_path):
     assert not [
         f for f in os.listdir(d_node) if f.endswith(".pid")
     ]
+
+
+def test_no_auth_flag_and_routable_warning(tmp_path):
+    """--no-auth disables the token (loopback dev path) and keeps the
+    old zero-config join working."""
+    d = str(tmp_path / "noauth_session")
+    out = _cli(
+        [
+            "start", "--head", "--port", "0", "--no-auth",
+            "--session-dir", d, "--num-cpus", "1",
+        ]
+    )
+    try:
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert not os.path.exists(os.path.join(d, "auth.token"))
+        addr = open(os.path.join(d, "head.addr")).read().strip()
+        ray_tpu.init(address=f"ray://{addr}")
+        try:
+            @ray_tpu.remote
+            def f():
+                return "ok"
+
+            assert ray_tpu.get(f.remote(), timeout=60) == "ok"
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        _cli(["stop", "--session-dir", d])
+
+
+def test_tls_encrypted_cluster(tmp_path):
+    """--tls: RPC rides an encrypted channel; a client pinning the
+    generated cert (plus token) connects, a cert-less client cannot."""
+    d = str(tmp_path / "tls_session")
+    out = _cli(
+        [
+            "start", "--head", "--port", "0", "--tls",
+            "--session-dir", d, "--num-cpus", "1",
+        ]
+    )
+    from ray_tpu._private import config as _config
+
+    try:
+        assert out.returncode == 0, out.stdout + out.stderr
+        cert = os.path.join(d, "tls.crt")
+        assert os.path.exists(cert)
+        token = open(os.path.join(d, "auth.token")).read().strip()
+        addr = open(os.path.join(d, "head.addr")).read().strip()
+
+        # Without the cert the TLS handshake fails outright.
+        _config.set_system_config({"AUTH_TOKEN": token})
+        import pytest as _pytest
+
+        with _pytest.raises(Exception):
+            ray_tpu.init(address=f"ray://{addr}")
+        ray_tpu.shutdown()
+
+        _config.set_system_config({"AUTH_TOKEN": token, "TLS_CERT": cert})
+        ray_tpu.init(address=f"ray://{addr}")
+        try:
+            @ray_tpu.remote
+            def g():
+                return 7
+
+            assert ray_tpu.get(g.remote(), timeout=60) == 7
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        for k in ("AUTH_TOKEN", "TLS_CERT"):
+            _config._overrides.pop(k, None)
+            os.environ.pop(f"RAY_TPU_{k}", None)
+        _cli(
+            ["stop", "--session-dir", d],
+            extra_env={"RAY_TPU_AUTH_TOKEN": "x"},
+        )

@@ -54,7 +54,14 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "LOG_DIR": (str, "", "worker log directory override"),
     # --- head fault tolerance
     "HEAD_JOURNAL": (str, "", "journal file for durable head state "
-                              "(KV/actors/PGs); empty = memory only"),
+                              "(KV/actors/PGs); empty = the session "
+                              "default (set 'off' to disable)"),
+    "JOURNAL_FSYNC": (bool, False, "fsync every journal append (power-"
+                                   "loss durability; default survives "
+                                   "process crashes only)"),
+    "JOURNAL_COMPACT_BYTES": (int, 8 << 20, "rewrite the head journal "
+                                            "as one snapshot once it "
+                                            "grows past this size"),
     "HEAD_RECONNECT_S": (float, 20.0, "how long clients retry head calls "
                                       "across a head restart"),
     # --- rpc hardening

@@ -71,9 +71,13 @@ def _ssl_server_ctx():
     return ctx
 
 
+_client_ctx_cache: dict = {}
+
+
 def _ssl_client_ctx():
     """Client TLS context pinning the cluster cert: any server holding
-    the matching key is trusted, hostname is irrelevant."""
+    the matching key is trusted, hostname is irrelevant. Cached per
+    cert path — connect() sits on the hot transfer path."""
     import ssl
 
     from ray_tpu._private import config
@@ -81,10 +85,13 @@ def _ssl_client_ctx():
     cert = config.get("TLS_CERT")
     if not cert:
         return None
-    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-    ctx.check_hostname = False
-    ctx.verify_mode = ssl.CERT_REQUIRED
-    ctx.load_verify_locations(cert)
+    ctx = _client_ctx_cache.get(cert)
+    if ctx is None:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        ctx.load_verify_locations(cert)
+        _client_ctx_cache[cert] = ctx
     return ctx
 
 

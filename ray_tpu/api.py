@@ -132,7 +132,17 @@ def init(
 
         session = JobID.random().hex()[:12]
         if address is None:
-            head = HeadService()
+            # Journal on by default: the head's durable state (KV,
+            # actors, PGs) lives beside the session's object store, so
+            # even library-embedded heads restart with state intact
+            # (RAY_TPU_HEAD_JOURNAL=off opts out).
+            from ray_tpu._private import config as _cfg
+
+            journal = _cfg.get("HEAD_JOURNAL") or os.path.join(
+                object_store_dir or default_store_dir(session),
+                "head.journal",
+            )
+            head = HeadService(journal_path=journal)
             head_addr = await head.start()
         else:
             head = None

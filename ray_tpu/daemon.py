@@ -92,12 +92,14 @@ def resolve_token(
     if no_auth:
         token = ""
     elif not token:
-        if is_head:
+        # Reuse the session token if one exists — a crash-restarted
+        # head must NOT rotate it, or every surviving node and driver
+        # holding the old token is locked out.
+        token_path = os.path.join(session_dir, "auth.token")
+        if os.path.exists(token_path):
+            token = open(token_path).read().strip()
+        if not token and is_head:
             token = secrets.token_hex(16)
-        else:
-            token_path = os.path.join(session_dir, "auth.token")
-            if os.path.exists(token_path):
-                token = open(token_path).read().strip()
     if not token and host not in _LOOPBACK:
         warn(
             f"WARNING: binding {host} with auth disabled — any host "
@@ -172,7 +174,11 @@ async def _run_head(args) -> None:
     session_dir = args.session_dir
     os.makedirs(session_dir, exist_ok=True)
     token = _setup_security(args, session_dir, is_head=True)
-    journal = os.path.join(session_dir, "head.journal")
+    # HEAD_JOURNAL (including the documented 'off') wins over the
+    # session default.
+    journal = config.get("HEAD_JOURNAL") or os.path.join(
+        session_dir, "head.journal"
+    )
     head = HeadService(journal_path=journal)
     addr = await head.start(host=args.host, port=args.port)
     # Workers this node spawns need the journal off (only the head
@@ -190,11 +196,17 @@ async def _run_head(args) -> None:
         stoppables.append(node)
 
     _write_atomic(os.path.join(session_dir, "head.addr"), addr)
+    # The daemon's stdout lands in a log file under the session dir —
+    # never print the token itself here (the 0600 token file is the
+    # secret's only resting place; the CLI prints the join command to
+    # the operator's terminal).
     print(f"head up at {addr}", flush=True)
-    env_prefix = f"RAY_TPU_AUTH_TOKEN={token} " if token else ""
-    tls_note = " --tls (copy tls.crt first)" if getattr(
+    tls_note = " --tls (copy tls.crt AND tls.key over first)" if getattr(
         args, "tls", False
     ) else ""
+    env_prefix = (
+        "RAY_TPU_AUTH_TOKEN=$(cat auth.token) " if token else ""
+    )
     print(
         f"join from other hosts:  {env_prefix}python -m ray_tpu.scripts "
         f"start --address {addr}{tls_note}",

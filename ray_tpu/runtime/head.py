@@ -30,10 +30,13 @@ class HeadService:
 
             journal_path = config.get("HEAD_JOURNAL") or None
         self.journal = None
-        if journal_path:
+        if journal_path and journal_path != "off":
+            from ray_tpu._private import config
             from ray_tpu.runtime.head_storage import FileJournal
 
-            self.journal = FileJournal(journal_path)
+            self.journal = FileJournal(
+                journal_path, fsync=config.get("JOURNAL_FSYNC")
+            )
         # node_id hex → {addr, resources, labels, last_seen, conn}
         self.nodes: dict[str, dict] = {}
         self.kv: dict[str, bytes] = {}
@@ -71,8 +74,33 @@ class HeadService:
 
     # --------------------------------------------------------- journal
     def _journal_append(self, table: str, op: str, payload) -> None:
-        if self.journal is not None:
-            self.journal.append((table, op, payload))
+        if self.journal is None:
+            return
+        self.journal.append((table, op, payload))
+        # Online compaction (reference: Redis AOF rewrite): KV churn on
+        # a long-lived head must not grow the journal without bound.
+        # The 2× floor guard keeps a state set LARGER than the
+        # threshold from compacting on every append; the write itself
+        # runs off-loop (compact_async) so RPC serving never stalls.
+        from ray_tpu._private import config
+
+        size = self.journal.size_bytes
+        if (
+            size > config.get("JOURNAL_COMPACT_BYTES")
+            and size > 2 * getattr(self, "_journal_floor", 0)
+            and not getattr(self, "_compacting", False)
+        ):
+            self._compacting = True
+            asyncio.ensure_future(self._compact_bg())
+
+    async def _compact_bg(self) -> None:
+        try:
+            await self.journal.compact_async(self._snapshot())
+            self._journal_floor = self.journal.size_bytes
+        except Exception:  # noqa: BLE001 - keep serving; retry next time
+            pass
+        finally:
+            self._compacting = False
 
     def _restore_from_journal(self) -> None:
         """Replay durable tables (KV, actors, PGs), then compact to one
@@ -112,6 +140,7 @@ class HeadService:
                 else:
                     self.placement_groups.pop(payload["pg_id"], None)
         self.journal.compact(self._snapshot())
+        self._journal_floor = self.journal.size_bytes
 
     def _snapshot(self) -> dict:
         return {

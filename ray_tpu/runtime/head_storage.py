@@ -27,20 +27,48 @@ _HDR = struct.Struct("<I")
 
 
 class FileJournal:
-    """Append-only journal with replay + snapshot compaction."""
+    """Append-only journal with replay + snapshot compaction.
 
-    def __init__(self, path: str):
+    ``fsync=True`` makes every append durable against power loss (the
+    reference's Redis equivalent is appendfsync always); the default
+    flush-only survives process crashes, which is the head-FT threat
+    model. ``size_bytes`` lets the owner trigger ONLINE compaction when
+    KV churn grows the file (reference: Redis AOF rewrite) — restart
+    replay also compacts, but a long-lived head must not wait for one.
+    """
+
+    def __init__(self, path: str, fsync: bool = False):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = None
+        self.fsync = fsync
+        # While an async compaction's file rewrite runs off-thread,
+        # appends land here and are replayed into the new file — they
+        # must not hit the old inode mid-rename.
+        self._buffering: list | None = None
+        try:
+            self._nbytes = os.path.getsize(path)
+        except OSError:
+            self._nbytes = 0
+
+    @property
+    def size_bytes(self) -> int:
+        return self._nbytes
 
     # ------------------------------------------------------------ write
     def append(self, record: tuple) -> None:
+        data = pickle.dumps(record, protocol=5)
+        if self._buffering is not None:
+            self._buffering.append(data)
+            self._nbytes += _HDR.size + len(data)
+            return
         if self._f is None:
             self._f = open(self.path, "ab")
-        data = pickle.dumps(record, protocol=5)
         self._f.write(_HDR.pack(len(data)) + data)
         self._f.flush()
+        if self.fsync:
+            os.fsync(self._f.fileno())
+        self._nbytes += _HDR.size + len(data)
 
     # ------------------------------------------------------------- read
     def replay(self) -> Iterator[tuple]:
@@ -64,12 +92,17 @@ class FileJournal:
     def compact(self, snapshot: Any) -> None:
         """Atomically replace the journal with one snapshot record."""
         self.close()
+        self._write_snapshot(pickle.dumps(
+            ("snapshot", "set", snapshot), protocol=5
+        ))
+        self._nbytes = os.path.getsize(self.path)
+
+    def _write_snapshot(self, data: bytes) -> None:
         fd, tmp = tempfile.mkstemp(
             dir=os.path.dirname(self.path) or ".", prefix=".journal-"
         )
         try:
             with os.fdopen(fd, "wb") as f:
-                data = pickle.dumps(("snapshot", "set", snapshot), protocol=5)
                 f.write(_HDR.pack(len(data)) + data)
                 f.flush()
                 os.fsync(f.fileno())
@@ -80,6 +113,31 @@ class FileJournal:
             except OSError:
                 pass
             raise
+
+    async def compact_async(self, snapshot: Any) -> None:
+        """Online compaction: the snapshot write + fsync + rename run
+        off-thread so the head's event loop keeps serving RPCs
+        (reference: Redis rewrites the AOF in a forked child for the
+        same reason). Concurrent appends buffer in memory and replay
+        into the fresh file afterwards."""
+        import asyncio
+
+        if self._buffering is not None:
+            return  # one at a time
+        data = pickle.dumps(("snapshot", "set", snapshot), protocol=5)
+        self.close()
+        self._buffering = []
+        try:
+            await asyncio.to_thread(self._write_snapshot, data)
+        finally:
+            buffered, self._buffering = self._buffering, None
+            self._f = open(self.path, "ab")
+            for rec in buffered:
+                self._f.write(_HDR.pack(len(rec)) + rec)
+            self._f.flush()
+            if self.fsync and buffered:
+                os.fsync(self._f.fileno())
+            self._nbytes = os.path.getsize(self.path)
 
     def close(self) -> None:
         if self._f is not None:

@@ -21,14 +21,23 @@ def _connect(address: str | None, session_dir: str | None = None):
 
     # Same-host convenience: a CLI running where `start` ran can read
     # the session token instead of requiring the env var (`stop`
-    # removes the file, so it can't outlive its cluster).
+    # removes the file, so it can't outlive its cluster). Only for
+    # THIS session's cluster: sending the local token to an unrelated
+    # --address would corrupt that connection.
     if not config.get("AUTH_TOKEN"):
         from ray_tpu.daemon import DEFAULT_SESSION_DIR
 
-        token_path = os.path.join(
-            session_dir or DEFAULT_SESSION_DIR, "auth.token"
+        sdir = session_dir or DEFAULT_SESSION_DIR
+        token_path = os.path.join(sdir, "auth.token")
+        addr_path = os.path.join(sdir, "head.addr")
+        session_addr = (
+            open(addr_path).read().strip()
+            if os.path.exists(addr_path)
+            else None
         )
-        if os.path.exists(token_path):
+        if os.path.exists(token_path) and (
+            address is None or address == session_addr
+        ):
             config.set_system_config(
                 {"AUTH_TOKEN": open(token_path).read().strip()}
             )
@@ -138,6 +147,9 @@ def cmd_start(args) -> int:
 
     session_dir = args.session_dir or DEFAULT_SESSION_DIR
     os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+    # Daemon logs echo cluster internals (addresses, join hints): keep
+    # the whole session dir operator-only, like the 0600 token file.
+    os.chmod(session_dir, 0o700)
 
     # Auth is ON by default: resolve (or generate) the token here so the
     # join command can be printed, and hand it to the daemon via the
@@ -170,6 +182,8 @@ def cmd_start(args) -> int:
             cmd.append("--no-auth")
         if args.tls:
             cmd.append("--tls")
+        if args.head_only:
+            cmd.append("--head-only")
     else:
         if not args.address:
             print(
@@ -372,7 +386,10 @@ def main(argv=None) -> int:
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--resources", default=None, help="JSON dict")
-    sp.add_argument("--session-dir", default=None)
+    sp.add_argument("--session-dir", default=argparse.SUPPRESS)
+    sp.add_argument("--head-only", action="store_true",
+                    help="head service without a co-located node (so a "
+                         "head crash can't take worker processes down)")
     sp.add_argument("--auth-token", default=None,
                     help="shared-secret token (default: generated on "
                          "--head, read from the session dir on join)")
@@ -385,7 +402,7 @@ def main(argv=None) -> int:
     sp.add_argument("--block", action="store_true",
                     help="run in the foreground")
     stp = sub.add_parser("stop")
-    stp.add_argument("--session-dir", default=None)
+    stp.add_argument("--session-dir", default=argparse.SUPPRESS)
     stp.add_argument("--grace", type=float, default=10.0)
 
     sub.add_parser("status")

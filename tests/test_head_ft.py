@@ -134,3 +134,172 @@ def test_journal_compacts_on_restart(journaled_cluster):
 
     records = list(FileJournal(journal).replay())
     assert records[0][0] == "snapshot"
+
+
+def test_journal_online_compaction_bounds_growth(tmp_path):
+    """10k KV puts must not grow the journal without bound: online
+    compaction (size-triggered, not restart-only) rewrites it as one
+    snapshot while the head keeps serving."""
+    info = ray_tpu.init(
+        num_cpus=2,
+        _system_config={
+            "HEAD_JOURNAL": str(tmp_path / "growth.journal"),
+            "JOURNAL_COMPACT_BYTES": 64 * 1024,
+        },
+    )
+    try:
+        rt = ray_tpu.api._runtime
+        value = b"x" * 64
+
+        async def churn():
+            for i in range(10_000):
+                await rt.core.head.call(
+                    "kv_put", key=f"key-{i % 100}", value=value
+                )
+
+        rt.run(churn(), timeout=300)
+        size = os.path.getsize(str(tmp_path / "growth.journal"))
+        # 10k * ~100B of records ≈ 1 MB unbounded; compaction keeps it
+        # within a few multiples of the 64 KiB threshold.
+        assert size < 4 * 64 * 1024, f"journal grew to {size} bytes"
+        # And the state survives a restart from the compacted journal.
+        reply = rt.run(rt.core.head.call("kv_get", key="key-1"))
+        assert reply["ok"] and reply["value"] == value
+    finally:
+        ray_tpu.shutdown()
+        for k in ("HEAD_JOURNAL", "JOURNAL_COMPACT_BYTES"):
+            _config._overrides.pop(k, None)
+            os.environ.pop(f"RAY_TPU_{k}", None)
+
+
+def test_journal_fsync_knob(tmp_path):
+    from ray_tpu.runtime.head_storage import FileJournal
+
+    j = FileJournal(str(tmp_path / "fs.journal"), fsync=True)
+    j.append(("kv", "put", {"key": "a", "value": b"1"}))
+    j.close()
+    assert list(FileJournal(str(tmp_path / "fs.journal")).replay()) == [
+        ("kv", "put", {"key": "a", "value": b"1"})
+    ]
+
+
+def test_head_sigkill_restart_cli(tmp_path):
+    """The hard head-FT path: SIGKILL the CLI-daemonized head process,
+    restart it via the CLI on the same port, and a live driver's
+    ReconnectingClient rides through — durable state intact, node
+    re-registered, actors still callable."""
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    d = str(tmp_path / "session")
+
+    def cli(args, extra_env=None):
+        env = dict(os.environ)
+        env.update(extra_env or {})
+        env["JAX_PLATFORMS"] = "cpu"
+        repo_root = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH", "")) if p
+        )
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts", *args],
+            capture_output=True, text=True, timeout=90, env=env,
+        )
+
+    d_node = str(tmp_path / "node_session")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    # Head WITHOUT a co-located node: killing the head process must not
+    # take the cluster's workers down with it.
+    out = cli(
+        ["start", "--head", "--head-only", "--port", str(port),
+         "--session-dir", d]
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    token = open(os.path.join(d, "auth.token")).read().strip()
+    addr = open(os.path.join(d, "head.addr")).read().strip()
+    out = cli(
+        ["start", "--address", addr, "--session-dir", d_node,
+         "--num-cpus", "2", "--auth-token", token]
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+
+    _config.set_system_config({"AUTH_TOKEN": token})
+    try:
+        ray_tpu.init(address=f"ray://{addr}")
+        rt = ray_tpu.api._runtime
+        rt.run(rt.core.head.call("kv_put", key="durable", value=b"yes"))
+
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.options(name="survivor", lifetime="detached").remote()
+        assert ray_tpu.get(c.inc.remote(), timeout=60) == 1
+
+        # SIGKILL the daemonized head (no graceful teardown at all).
+        head_pids = [
+            int(open(os.path.join(d, f)).read())
+            for f in os.listdir(d)
+            if f.startswith("head-") and f.endswith(".pid")
+        ]
+        assert head_pids
+        os.kill(head_pids[0], signal.SIGKILL)
+        for f in list(os.listdir(d)):
+            if f.endswith(".pid"):
+                os.unlink(os.path.join(d, f))
+        time.sleep(0.5)
+
+        # Restart on the same port from the same session dir — NO token
+        # flag: the restarted head must reuse the session token rather
+        # than rotating it (rotation would lock out every survivor).
+        out = cli(
+            ["start", "--head", "--head-only", "--port", str(port),
+             "--session-dir", d]
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert open(
+            os.path.join(d, "auth.token")
+        ).read().strip() == token, "restart must not rotate the token"
+
+        # The driver's ReconnectingClient re-dials: durable KV is
+        # back, the node re-registers, the detached actor answers.
+        deadline = time.monotonic() + 40
+        value = None
+        while time.monotonic() < deadline:
+            try:
+                reply = rt.run(
+                    rt.core.head.call("kv_get", key="durable"), timeout=10
+                )
+                if reply.get("ok"):
+                    value = reply["value"]
+                    break
+            except Exception:
+                time.sleep(0.5)
+        assert value == b"yes"
+        deadline = time.monotonic() + 40
+        n = None
+        while time.monotonic() < deadline:
+            try:
+                n = ray_tpu.get(c.inc.remote(), timeout=10)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert n == 2
+    finally:
+        try:
+            ray_tpu.shutdown()
+        finally:
+            _config._overrides.pop("AUTH_TOKEN", None)
+            os.environ.pop("RAY_TPU_AUTH_TOKEN", None)
+            cli(["stop", "--session-dir", d_node])
+            cli(["stop", "--session-dir", d])

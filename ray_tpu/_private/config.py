@@ -54,8 +54,10 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "LOG_DIR": (str, "", "worker log directory override"),
     # --- head fault tolerance
     "HEAD_JOURNAL": (str, "", "journal file for durable head state "
-                              "(KV/actors/PGs); empty = the session "
-                              "default (set 'off' to disable)"),
+                              "(KV/actors/PGs); empty = off for "
+                              "library init() (its session dir is "
+                              "ephemeral), session default for CLI "
+                              "daemons ('off' disables those too)"),
     "JOURNAL_FSYNC": (bool, False, "fsync every journal append (power-"
                                    "loss durability; default survives "
                                    "process crashes only)"),

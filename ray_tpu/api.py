@@ -132,17 +132,12 @@ def init(
 
         session = JobID.random().hex()[:12]
         if address is None:
-            # Journal on by default: the head's durable state (KV,
-            # actors, PGs) lives beside the session's object store, so
-            # even library-embedded heads restart with state intact
-            # (RAY_TPU_HEAD_JOURNAL=off opts out).
-            from ray_tpu._private import config as _cfg
-
-            journal = _cfg.get("HEAD_JOURNAL") or os.path.join(
-                object_store_dir or default_store_dir(session),
-                "head.journal",
-            )
-            head = HeadService(journal_path=journal)
+            # Library-embedded heads journal only when HEAD_JOURNAL is
+            # set: the ephemeral session store dir is rmtree'd at
+            # shutdown, so a journal there would cost a write per
+            # mutation and never be replayable. CLI/daemon heads (whose
+            # session dir persists) journal by default (daemon.py).
+            head = HeadService()
             head_addr = await head.start()
         else:
             head = None

@@ -74,52 +74,12 @@ class FakeNodeProvider(NodeProvider):
         return rec["node"].node_id if rec else None
 
 
-class GkeTpuNodeProvider(NodeProvider):
-    """GKE TPU slice provider (stub: zero-egress image — documents the
-    protocol; real deployments implement `_gke_api` with the Kubernetes
-    client).
+def __getattr__(name: str):
+    # The real GKE/Cloud-TPU provider lives in its own module (REST
+    # transport, operation polling, fixtures); re-exported here for the
+    # historical import path.
+    if name == "GkeTpuNodeProvider":
+        from ray_tpu.autoscaler.gcp import GkeTpuNodeProvider
 
-    TPU specifics vs generic cloud VMs (reference:
-    python/ray/_private/accelerators/tpu.py metadata env handling,
-    util/tpu.py SlicePlacementGroup):
-    - The unit is a SLICE (node pool with tpu-topology); hosts within a
-      slice share ICI and must be created/deleted together.
-    - `create_node(node_type)` → scale the matching node pool by one
-      replica group; all hosts of the new slice register as nodes
-      carrying `TPU-<gen>-head` + slice labels.
-    - Losing any host kills the slice: terminate reaps the whole group.
-    """
-
-    def __init__(self, cluster: str, node_pools: dict[str, dict]):
-        self.cluster = cluster
-        self.node_pools = node_pools
-        self._nodes: dict[str, str] = {}
-
-    def _gke_api(self, verb: str, **kw: Any):
-        raise NotImplementedError(
-            "GKE API access is not available in this environment; "
-            "subclass GkeTpuNodeProvider and implement _gke_api with "
-            "the kubernetes client."
-        )
-
-    def create_node(self, node_type: str, resources: dict) -> str:
-        pool = self.node_pools[node_type]
-        reply = self._gke_api(
-            "scale_node_pool",
-            pool=pool["name"],
-            delta=+1,
-            topology=pool.get("topology"),
-        )
-        pid = reply["instance_group_id"]
-        self._nodes[pid] = node_type
-        return pid
-
-    def terminate_node(self, provider_node_id: str) -> None:
-        self._gke_api("delete_instance_group", group=provider_node_id)
-        self._nodes.pop(provider_node_id, None)
-
-    def non_terminated_nodes(self) -> dict[str, str]:
-        return dict(self._nodes)
-
-    def runtime_node_id(self, provider_node_id: str) -> str | None:
-        return None  # resolved via node labels at registration time
+        return GkeTpuNodeProvider
+    raise AttributeError(name)

@@ -204,16 +204,18 @@ async def _run_head(args) -> None:
     tls_note = " --tls (copy tls.crt AND tls.key over first)" if getattr(
         args, "tls", False
     ) else ""
-    env_prefix = (
-        "RAY_TPU_AUTH_TOKEN=$(cat auth.token) " if token else ""
-    )
+    env_prefix = "RAY_TPU_AUTH_TOKEN=<token> " if token else ""
     print(
         f"join from other hosts:  {env_prefix}python -m ray_tpu.scripts "
         f"start --address {addr}{tls_note}",
         flush=True,
     )
     if token:
-        print(f"auth token written to {session_dir}/auth.token", flush=True)
+        print(
+            f"auth token (the <token> above) is in "
+            f"{session_dir}/auth.token",
+            flush=True,
+        )
     await _serve_until_signal(stoppables)
 
 

@@ -96,10 +96,13 @@ class HeadService:
     async def _compact_bg(self) -> None:
         try:
             await self.journal.compact_async(self._snapshot())
-            self._journal_floor = self.journal.size_bytes
-        except Exception:  # noqa: BLE001 - keep serving; retry next time
+        except Exception:  # noqa: BLE001 - keep serving (e.g. disk full)
             pass
         finally:
+            # Raise the floor EVEN ON FAILURE: the next attempt then
+            # needs 2× further growth, so a persistently failing disk
+            # doesn't re-trigger a full-snapshot pickle on every append.
+            self._journal_floor = self.journal.size_bytes
             self._compacting = False
 
     def _restore_from_journal(self) -> None:

@@ -55,11 +55,24 @@ class FileJournal:
     def size_bytes(self) -> int:
         return self._nbytes
 
+    @property
+    def _sidecar_path(self) -> str:
+        return self.path + ".compacting"
+
     # ------------------------------------------------------------ write
     def append(self, record: tuple) -> None:
         data = pickle.dumps(record, protocol=5)
         if self._buffering is not None:
+            # Mid-compaction. Under fsync the durability promise must
+            # hold even now: the record also lands (fsynced) in a
+            # sidecar that replay() consumes if we crash before the
+            # post-compaction merge.
             self._buffering.append(data)
+            if self.fsync:
+                with open(self._sidecar_path, "ab") as f:
+                    f.write(_HDR.pack(len(data)) + data)
+                    f.flush()
+                    os.fsync(f.fileno())
             self._nbytes += _HDR.size + len(data)
             return
         if self._f is None:
@@ -72,22 +85,25 @@ class FileJournal:
 
     # ------------------------------------------------------------- read
     def replay(self) -> Iterator[tuple]:
-        """All intact records, oldest first; stops at a torn tail."""
-        if not os.path.exists(self.path):
-            return
-        with open(self.path, "rb") as f:
-            while True:
-                hdr = f.read(_HDR.size)
-                if len(hdr) < _HDR.size:
-                    return
-                (length,) = _HDR.unpack(hdr)
-                data = f.read(length)
-                if len(data) < length:
-                    return  # torn append from a crash — discard
-                try:
-                    yield pickle.loads(data)
-                except Exception:  # noqa: BLE001 - corrupt frame ends replay
-                    return
+        """All intact records, oldest first; stops at a torn tail. A
+        sidecar left by a crash mid-online-compaction replays after the
+        main file (its records are strictly newer)."""
+        for path in (self.path, self._sidecar_path):
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                while True:
+                    hdr = f.read(_HDR.size)
+                    if len(hdr) < _HDR.size:
+                        break
+                    (length,) = _HDR.unpack(hdr)
+                    data = f.read(length)
+                    if len(data) < length:
+                        break  # torn append from a crash — discard
+                    try:
+                        yield pickle.loads(data)
+                    except Exception:  # noqa: BLE001 - corrupt frame
+                        break
 
     def compact(self, snapshot: Any) -> None:
         """Atomically replace the journal with one snapshot record."""
@@ -95,6 +111,12 @@ class FileJournal:
         self._write_snapshot(pickle.dumps(
             ("snapshot", "set", snapshot), protocol=5
         ))
+        try:
+            # Any crash-left sidecar is folded into this snapshot (the
+            # caller replayed it); keeping it would double-apply.
+            os.unlink(self._sidecar_path)
+        except OSError:
+            pass
         self._nbytes = os.path.getsize(self.path)
 
     def _write_snapshot(self, data: bytes) -> None:
@@ -137,6 +159,10 @@ class FileJournal:
             self._f.flush()
             if self.fsync and buffered:
                 os.fsync(self._f.fileno())
+            try:
+                os.unlink(self._sidecar_path)
+            except OSError:
+                pass
             self._nbytes = os.path.getsize(self.path)
 
     def close(self) -> None:

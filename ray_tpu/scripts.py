@@ -35,8 +35,20 @@ def _connect(address: str | None, session_dir: str | None = None):
             if os.path.exists(addr_path)
             else None
         )
+
+        def _norm(a: str | None) -> str | None:
+            # "ray://host:port", "localhost" and "127.0.0.1" all name
+            # the same endpoint for this comparison.
+            if a is None:
+                return None
+            a = a.removeprefix("ray://")
+            host, _, port = a.rpartition(":")
+            if host in ("localhost", "::1"):
+                host = "127.0.0.1"
+            return f"{host}:{port}"
+
         if os.path.exists(token_path) and (
-            address is None or address == session_addr
+            address is None or _norm(address) == _norm(session_addr)
         ):
             config.set_system_config(
                 {"AUTH_TOKEN": open(token_path).read().strip()}

@@ -1,10 +1,22 @@
 """Asyncio framed-message RPC with request multiplexing and server push.
 
 Fills the role of the reference's gRPC wrapper layer (reference:
-src/ray/rpc/grpc_server.h:86, retryable client retryable_grpc_client.h) for
-the Python control plane: length-prefixed frames, each a pickled tuple
-``(kind, req_id, payload)`` with kind ∈ {REQ, RESP, ERR, PUSH}. One
-persistent connection per peer pair; calls multiplex on req_id; PUSH frames
+src/ray/rpc/grpc_server.h:86, retryable client retryable_grpc_client.h)
+for the Python control plane. Wire format (reference: protobuf-defined
+messages, src/ray/protobuf/gcs_service.proto / common.proto — typed and
+versioned so peers can skew):
+
+    [u32 length][u8 wire-version][msgpack array (kind, req_id, payload)]
+
+with kind ∈ {REQ, RESP, ERR, PUSH}. Control frames are STRICT msgpack —
+plain data only (str/bytes/numbers/lists/dicts); anything else is an
+encode-time TypeError, so the deserializer never executes code on
+behalf of a peer. User payloads (task args, objects, function blobs)
+ride INSIDE frames as opaque bytes fields, (cloud)pickled at a higher
+layer and unpickled only by their owner. A frame whose version byte
+doesn't match is rejected with a clean error before any parsing —
+that's the rolling-upgrade / version-skew contract. One persistent
+connection per peer pair; calls multiplex on req_id; PUSH frames
 deliver server-initiated messages (pubsub). A chaos hook mirrors the
 reference's rpc_chaos.h fault injection for protocol tests.
 """
@@ -13,16 +25,70 @@ from __future__ import annotations
 
 import asyncio
 import os
-import pickle
 import random
 import struct
 from typing import Any, Awaitable, Callable
 
+import msgpack
+
 REQ, RESP, ERR, PUSH = 0, 1, 2, 3
+WIRE_VERSION = 1
 _HDR = struct.Struct("<I")
 _MAX_FRAME = 1 << 31
 _AUTH_MAGIC = b"RTPUAUTH"
 _AUTH_MAX = 4096
+
+
+def _msgpack_default(obj):
+    """Encode-time escape hatch for buffer views only; every other
+    type is a hard error — the control plane is typed data, never
+    pickled objects."""
+    if isinstance(obj, memoryview):
+        return bytes(obj)
+    if isinstance(obj, bytearray):
+        return bytes(obj)
+    raise TypeError(
+        f"control-plane frames carry plain data only; got "
+        f"{type(obj).__name__} — pickle it into a bytes field at the "
+        f"call site if it is user payload"
+    )
+
+
+def pack_frame(frame) -> bytes:
+    return msgpack.packb(
+        frame, use_bin_type=True, default=_msgpack_default
+    )
+
+
+def unpack_frame(data: bytes):
+    return msgpack.unpackb(
+        data, raw=False, strict_map_key=False, use_list=True
+    )
+
+
+_sig_cache: dict = {}
+
+
+def tolerant_kwargs(fn, kw: dict) -> dict:
+    """Drop request fields the handler doesn't declare (the
+    unknown-field tolerance half of version skew: a NEWER peer's extra
+    fields are ignored by an older server, like unknown protobuf
+    fields). Handlers taking **kwargs receive everything."""
+    import inspect
+
+    target = getattr(fn, "__func__", fn)
+    cached = _sig_cache.get(target)
+    if cached is None:
+        sig = inspect.signature(target)
+        has_var = any(
+            p.kind == p.VAR_KEYWORD for p in sig.parameters.values()
+        )
+        cached = (has_var, frozenset(sig.parameters))
+        _sig_cache[target] = cached
+    has_var, allowed = cached
+    if has_var:
+        return kw
+    return {k: v for k, v in kw.items() if k in allowed}
 
 
 class RpcError(Exception):
@@ -100,7 +166,19 @@ async def _read_frame(reader: asyncio.StreamReader) -> tuple:
     (length,) = _HDR.unpack(hdr)
     if length > min(_MAX_FRAME, _max_frame()):
         raise RpcError(f"oversized frame: {length}")
-    return pickle.loads(await reader.readexactly(length))
+    if length < 1:
+        raise RpcError("empty frame")
+    data = await reader.readexactly(length)
+    version = data[0]
+    if version != WIRE_VERSION:
+        # Version skew (e.g. a peer running an older release whose
+        # frames were pickled, first byte 0x80): refuse cleanly, never
+        # feed the bytes to a parser that wasn't written for them.
+        raise RpcError(
+            f"unsupported wire version {version} (this process speaks "
+            f"v{WIRE_VERSION}; upgrade or downgrade the peer)"
+        )
+    return unpack_frame(data[1:])
 
 
 def _max_frame() -> int:
@@ -148,10 +226,10 @@ def _tune_socket(sock) -> None:
 
 
 def _write_frame(writer: asyncio.StreamWriter, frame: tuple) -> None:
-    data = pickle.dumps(frame, protocol=5)
-    writer.write(_HDR.pack(len(data)))
+    data = pack_frame(frame)
+    writer.write(_HDR.pack(len(data) + 1) + bytes([WIRE_VERSION]))
     # Separate write: concatenating header+payload would copy the whole
-    # multi-MiB payload just to prepend 4 bytes.
+    # multi-MiB payload just to prepend 5 bytes.
     writer.write(data)
 
 

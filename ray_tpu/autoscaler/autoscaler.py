@@ -58,8 +58,20 @@ class Autoscaler:
 
     # ----------------------------------------------------------- control
     def start(self):
+        # Adopt capacity that already exists (an autoscaler RESTART must
+        # not double-provision slices it forgot, nor leak ones it can
+        # no longer reap — the provider's API listing is authoritative).
+        try:
+            for pid, ntype in self.provider.non_terminated_nodes().items():
+                if ntype in self.node_types and pid not in self._tracked:
+                    self._tracked[pid] = _TrackedNode(pid, ntype)
+        except Exception:  # noqa: BLE001 - provider may be offline
+            logger.exception("could not list pre-existing nodes")
         for name, cfg in self.node_types.items():
-            for _ in range(cfg.min_workers):
+            existing = sum(
+                1 for t in self._tracked.values() if t.node_type == name
+            )
+            for _ in range(max(0, cfg.min_workers - existing)):
                 self._launch(name)
         self._thread = threading.Thread(
             target=self._loop, name="ray_tpu_autoscaler", daemon=True

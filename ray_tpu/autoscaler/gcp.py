@@ -12,10 +12,15 @@ TPU-native differences here:
     (reference node.py:785 uses the older projects.locations.nodes).
   * ``node_pool`` (GKE ``nodePools:setSize``) — TPU slice node pools
     in a GKE cluster; one size increment = one slice replica.
-- Every created resource is labeled with the ray_tpu cluster name and
-  node type, so membership listing is a label filter, and the runtime
-  node that registers from the slice carries the provider id in its
-  node labels (detect_labels reads GCE metadata) for id mapping.
+- Every created queued resource is labeled with the ray_tpu cluster
+  name and node type, so membership listing is a label filter, and the
+  provider id is stamped into instance metadata — the node daemon's
+  detect_labels probes GCE metadata (node.py _gce_metadata_labels) and
+  registers it as a node label, which runtime_node_id matches against
+  the head's node table. node_pool mode cannot stamp per-increment
+  metadata (setSize is anonymous): inject ``runtime_lookup`` (e.g.
+  keyed on GKE node labels) or rely on the autoscaler's boot-grace
+  accounting.
 
 Auth rides a bearer token: ``GOOGLE_OAUTH_ACCESS_TOKEN`` env when set
 (CI/dev), else the GCE metadata server (in-cluster). CI never talks to
@@ -202,9 +207,17 @@ class GkeTpuNodeProvider(NodeProvider):
         """Poll a long-running operation to completion (reference:
         wait_for_operation, node.py:342). TPU ops carry full names;
         GKE ops are project-relative."""
+        def _check(done_op: dict) -> dict:
+            if done_op.get("error"):
+                raise RuntimeError(
+                    f"operation {done_op.get('name')} failed: "
+                    f"{done_op['error']}"
+                )
+            return done_op
+
         name = op.get("name", "")
         if op.get("done") or op.get("status") == "DONE" or not name:
-            return op
+            return _check(op)
         if api == "tpu":
             url = f"{_TPU_API}/{name}" if not name.startswith(
                 "http"
@@ -218,11 +231,7 @@ class GkeTpuNodeProvider(NodeProvider):
         while time.monotonic() < deadline:
             got = self.http.request("GET", url)
             if got.get("done") or got.get("status") == "DONE":
-                if got.get("error"):
-                    raise RuntimeError(
-                        f"operation {name} failed: {got['error']}"
-                    )
-                return got
+                return _check(got)
             time.sleep(self._poll_s)
         raise TimeoutError(f"operation {name} not done in {timeout}s")
 
@@ -335,10 +344,18 @@ class GkeTpuNodeProvider(NodeProvider):
         modes = {p.get("mode", "queued_resource") for p in
                  self.node_pools.values()}
         if "queued_resource" in modes:
-            got = self.http.request(
-                "GET", f"{self._tpu_parent}/queuedResources"
-            )
-            for qr in got.get("queuedResources", []):
+            items: list = []
+            page = ""
+            while True:
+                url = f"{self._tpu_parent}/queuedResources"
+                if page:
+                    url += f"?pageToken={page}"
+                got = self.http.request("GET", url)
+                items.extend(got.get("queuedResources", []))
+                page = got.get("nextPageToken", "")
+                if not page:
+                    break
+            for qr in items:
                 nodes = qr.get("tpu", {}).get("nodeSpec", [])
                 if not nodes:
                     continue

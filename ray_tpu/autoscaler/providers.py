@@ -74,12 +74,8 @@ class FakeNodeProvider(NodeProvider):
         return rec["node"].node_id if rec else None
 
 
-def __getattr__(name: str):
-    # The real GKE/Cloud-TPU provider lives in its own module (REST
-    # transport, operation polling, fixtures); re-exported here for the
-    # historical import path.
-    if name == "GkeTpuNodeProvider":
-        from ray_tpu.autoscaler.gcp import GkeTpuNodeProvider
-
-        return GkeTpuNodeProvider
-    raise AttributeError(name)
+# The real GKE/Cloud-TPU provider lives in its own module (REST
+# transport, operation polling, fixtures); re-exported here for the
+# historical import path. (The package __init__ imports this module
+# eagerly, so a lazy shim would buy nothing.)
+from ray_tpu.autoscaler.gcp import GkeTpuNodeProvider  # noqa: E402,F401

@@ -1977,7 +1977,7 @@ class CoreWorker:
         fn = getattr(self, f"_on_{method}", None)
         if fn is None:
             raise rpc.RpcError(f"core_worker: unknown method {method!r}")
-        return await fn(conn=conn, **kw)
+        return await fn(conn=conn, **rpc.tolerant_kwargs(fn, kw))
 
     async def _on_ping(self, conn):
         return {"ok": True}

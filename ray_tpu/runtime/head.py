@@ -181,7 +181,7 @@ class HeadService:
         fn = getattr(self, f"_on_{method}", None)
         if fn is None:
             raise rpc.RpcError(f"head: unknown method {method!r}")
-        return await fn(conn=conn, **kw)
+        return await fn(conn=conn, **rpc.tolerant_kwargs(fn, kw))
 
     async def _on_register_node(
         self, conn, node_id: str, addr: str, resources: dict, labels=None

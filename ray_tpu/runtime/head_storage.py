@@ -46,6 +46,7 @@ class FileJournal:
         # appends land here and are replayed into the new file — they
         # must not hit the old inode mid-rename.
         self._buffering: list | None = None
+        self._side_f = None
         try:
             self._nbytes = os.path.getsize(path)
         except OSError:
@@ -63,16 +64,19 @@ class FileJournal:
     def append(self, record: tuple) -> None:
         data = pickle.dumps(record, protocol=5)
         if self._buffering is not None:
-            # Mid-compaction. Under fsync the durability promise must
-            # hold even now: the record also lands (fsynced) in a
-            # sidecar that replay() consumes if we crash before the
-            # post-compaction merge.
+            # Mid-compaction. The durability promise of the current
+            # mode must hold even now: the record also lands in a
+            # sidecar (flushed always, fsynced under fsync mode) that
+            # replay() consumes if we crash before the post-compaction
+            # merge — the in-memory buffer alone would silently demote
+            # crash durability during every compaction window.
             self._buffering.append(data)
+            if self._side_f is None:
+                self._side_f = open(self._sidecar_path, "ab")
+            self._side_f.write(_HDR.pack(len(data)) + data)
+            self._side_f.flush()
             if self.fsync:
-                with open(self._sidecar_path, "ab") as f:
-                    f.write(_HDR.pack(len(data)) + data)
-                    f.flush()
-                    os.fsync(f.fileno())
+                os.fsync(self._side_f.fileno())
             self._nbytes += _HDR.size + len(data)
             return
         if self._f is None:
@@ -153,6 +157,9 @@ class FileJournal:
             await asyncio.to_thread(self._write_snapshot, data)
         finally:
             buffered, self._buffering = self._buffering, None
+            if self._side_f is not None:
+                self._side_f.close()
+                self._side_f = None
             self._f = open(self.path, "ab")
             for rec in buffered:
                 self._f.write(_HDR.pack(len(rec)) + rec)
@@ -169,3 +176,6 @@ class FileJournal:
         if self._f is not None:
             self._f.close()
             self._f = None
+        if self._side_f is not None:
+            self._side_f.close()
+            self._side_f = None

@@ -530,7 +530,7 @@ class NodeManager:
         fn = getattr(self, f"_on_{method}", None)
         if fn is None:
             raise rpc.RpcError(f"node: unknown method {method!r}")
-        return await fn(conn=conn, **kw)
+        return await fn(conn=conn, **rpc.tolerant_kwargs(fn, kw))
 
     # ---------------------------------------------------- object serving
     def _store(self):
@@ -1283,7 +1283,35 @@ def detect_labels() -> dict[str, str]:
             k, v = pair.split("=", 1)
             labels[k.strip()] = v.strip()
     labels.update(detect_accelerator_labels())
+    labels.update(_gce_metadata_labels())
     return labels
+
+
+def _gce_metadata_labels() -> dict[str, str]:
+    """On GCE/GKE VMs, pick up the provider id the autoscaler stamped
+    into instance metadata (gcp.py create_node) so the autoscaler can
+    map its provider node ids to registered runtime nodes. The DMI
+    product name gates the network probe — non-GCE hosts never touch
+    the metadata endpoint."""
+    try:
+        with open("/sys/class/dmi/id/product_name") as f:
+            if "Google" not in f.read():
+                return {}
+    except OSError:
+        return {}
+    import urllib.request
+
+    try:
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/"
+            "instance/attributes/ray-tpu-provider-id",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            value = resp.read().decode().strip()
+        return {"ray-tpu-provider-id": value} if value else {}
+    except OSError:
+        return {}
 
 
 def env_jax_platform() -> str:

@@ -43,6 +43,7 @@ def _connect(address: str | None, session_dir: str | None = None):
                 return None
             a = a.removeprefix("ray://")
             host, _, port = a.rpartition(":")
+            host = host.strip("[]")  # bracketed IPv6
             if host in ("localhost", "::1"):
                 host = "127.0.0.1"
             return f"{host}:{port}"

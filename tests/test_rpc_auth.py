@@ -40,8 +40,14 @@ def _probe(addr: str, first_bytes: bytes | None) -> bool:
             if first_bytes is not None:
                 writer.write(first_bytes)
                 await writer.drain()
-            frame = pickle.dumps((0, 1, ("node_table", {})), protocol=5)
-            writer.write(_HDR.pack(len(frame)) + frame)
+            from ray_tpu._private import rpc as _rpc
+
+            frame = _rpc.pack_frame((0, 1, ("node_table", {})))
+            writer.write(
+                _HDR.pack(len(frame) + 1)
+                + bytes([_rpc.WIRE_VERSION])
+                + frame
+            )
             await writer.drain()
             try:
                 await asyncio.wait_for(reader.readexactly(4), timeout=3)

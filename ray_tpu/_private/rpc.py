@@ -54,13 +54,35 @@ def _msgpack_default(obj):
     )
 
 
+_KEY_TYPES = (str, int, bytes, bool, float, type(None))
+
+
+def _check_map_keys(obj) -> None:
+    """msgpack happily PACKS a tuple-keyed dict (array key) but the
+    receiver's decode then dies with an unhashable-type error — a
+    silent remote poison. Enforce scalar keys at encode time, where
+    the bug is."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if not isinstance(k, _KEY_TYPES):
+                raise TypeError(
+                    f"control-plane map keys must be scalars, got "
+                    f"{type(k).__name__} key {k!r}"
+                )
+            _check_map_keys(v)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _check_map_keys(v)
+
+
 def pack_frame(frame) -> bytes:
+    _check_map_keys(frame)
     return msgpack.packb(
         frame, use_bin_type=True, default=_msgpack_default
     )
 
 
-def unpack_frame(data: bytes):
+def unpack_frame(data) -> Any:
     return msgpack.unpackb(
         data, raw=False, strict_map_key=False, use_list=True
     )
@@ -178,7 +200,8 @@ async def _read_frame(reader: asyncio.StreamReader) -> tuple:
             f"unsupported wire version {version} (this process speaks "
             f"v{WIRE_VERSION}; upgrade or downgrade the peer)"
         )
-    return unpack_frame(data[1:])
+    # memoryview: never copy a multi-MiB chunk just to strip 1 byte.
+    return unpack_frame(memoryview(data)[1:])
 
 
 def _max_frame() -> int:
@@ -286,11 +309,13 @@ class Connection:
         req_id = self._next_id
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
-        _write_frame(self.writer, (REQ, req_id, (method, kw)))
-        await self.writer.drain()
         try:
+            _write_frame(self.writer, (REQ, req_id, (method, kw)))
+            await self.writer.drain()
             return await asyncio.wait_for(fut, timeout)
         finally:
+            # Covers encode failures too (strict msgpack raising on a
+            # bad kwarg must not leak the pending entry forever).
             self._pending.pop(req_id, None)
 
     def push(self, payload: Any) -> None:
@@ -319,6 +344,20 @@ class Connection:
             asyncio.CancelledError,
         ):
             pass
+        except RpcError as e:
+            # Version skew / malformed frame: say WHY before dropping
+            # the peer, or the operator only ever sees ConnectionLost.
+            import logging
+
+            logging.getLogger("ray_tpu.rpc").warning(
+                "dropping connection to %s: %s", self.peer, e
+            )
+        except Exception:  # noqa: BLE001 - decode bugs must be visible
+            import logging
+
+            logging.getLogger("ray_tpu.rpc").exception(
+                "dropping connection to %s: frame decode failed", self.peer
+            )
         finally:
             self._shutdown()
 

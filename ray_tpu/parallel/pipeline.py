@@ -35,6 +35,7 @@ def pipeline_apply(
     mesh,
     num_microbatches: int,
     axis: str = "pp",
+    param_specs: Any = None,
 ) -> jnp.ndarray:
     """Run x through P chained stages, microbatched and pipelined.
 
@@ -42,6 +43,14 @@ def pipeline_apply(
     ``axis``); every stage must map [mb, ...] → [mb, ...] of the same
     shape (the circulating buffer is homogeneous). Returns the last
     stage's outputs for the full batch, replicated over ``axis``.
+
+    ``param_specs`` (optional tree of PartitionSpecs, leading dim =
+    ``axis``) shards stage-param dims over FURTHER mesh axes — e.g.
+    ``P("pp", "ep")`` for expert-stacked MoE weights or
+    ``P("pp", None, "fsdp")`` for ZeRO-3 stage weights — and
+    ``stage_fn`` then uses those axes collectively (psum over "ep",
+    all_gather over "fsdp"): pipeline, expert, and data/ZeRO
+    parallelism compose inside ONE shard_map program.
     """
     n_stages = mesh.shape[axis]
     for leaf in jax.tree.leaves(stage_params):
@@ -118,7 +127,11 @@ def pipeline_apply(
         )
         return outputs.reshape(-1, *x_full.shape[1:])
 
-    spec_params = jax.tree.map(lambda _: P(axis), stage_params)
+    spec_params = (
+        param_specs
+        if param_specs is not None
+        else jax.tree.map(lambda _: P(axis), stage_params)
+    )
     batch_spec = P(dp_axes if dp_axes else None)
     return jax.shard_map(
         per_device,
@@ -137,6 +150,7 @@ def pipeline_loss_fn(
     *,
     mesh,
     num_microbatches: int,
+    param_specs: Any = None,
 ) -> jnp.ndarray:
     """Differentiable pipelined loss: forward through the stages, then a
     replicated loss head (logits → scalar). Use under jax.grad/jit."""
@@ -146,5 +160,6 @@ def pipeline_loss_fn(
         stage_fn,
         mesh=mesh,
         num_microbatches=num_microbatches,
+        param_specs=param_specs,
     )
     return loss_head(y, batch)

@@ -7,7 +7,6 @@ deserialization of attacker bytes is code execution.
 
 import asyncio
 import os
-import pickle
 import struct
 
 import pytest
@@ -30,7 +29,7 @@ def authed_cluster():
 
 
 def _probe(addr: str, first_bytes: bytes | None) -> bool:
-    """Open a raw socket, optionally send bytes, then send a pickled REQ
+    """Open a raw socket, optionally send bytes, then send a msgpack REQ
     and see whether the server answers. True = server responded."""
 
     async def go():

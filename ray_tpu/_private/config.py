@@ -43,11 +43,6 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "DAG_MAX_BUFFERED": (int, 8, "max in-flight executions per DAG"),
     "DAG_GET_TIMEOUT": (float, 30.0, "CompiledDAGRef.get timeout"),
     "DAG_SUBMIT_TIMEOUT": (float, 30.0, "execute() backpressure timeout"),
-    "DAG_OVERLAP": (bool, False, "overlap channel reads/writes with "
-                                 "compute in compiled-DAG actor loops "
-                                 "(opt-in: measured net-negative for "
-                                 "small host payloads under the GIL — "
-                                 "see PERF.json dag rows)"),
     # --- worker log pipeline
     "LOG_TO_DRIVER": (bool, True, "stream worker stdout/stderr to drivers "
                                   "via pubsub"),

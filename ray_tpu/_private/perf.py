@@ -120,6 +120,7 @@ def main(quick: bool = False) -> list[dict]:
     finally:
         ray_tpu.shutdown()
     results.extend(collective_bench(quick=quick))
+    results.extend(collective_multiproc_bench(quick=quick))
     return results
 
 
@@ -287,20 +288,18 @@ def object_plane_bench(quick: bool = False) -> list[dict]:
 
 
 def dag_pipeline_bench(quick: bool = False) -> list[dict]:
-    """Compiled-DAG pipeline throughput, overlap on vs off (reference:
-    the overlapped execution schedule dag_node_operation.py:576-593).
-    Records BOTH modes so the tradeoff stays visible: on this runtime
-    the GIL serializes the channel copies with compute, so the
-    prefetch/writer threads measure net-NEGATIVE for small host payloads
-    — which is why DAG_OVERLAP defaults off. Device tensors never ride
-    host channels anyway (tensor transport / collective permute).
+    """Compiled-DAG pipeline throughput (reference: compiled graphs
+    execution, compiled_dag_node.py). The reference's overlapped
+    schedule hides NCCL latency behind GPU compute; the host-thread
+    analogue measured net-negative here at small AND 8 MiB payloads
+    (GIL-serialized copies) and was removed — the ShmChannel ring
+    already pipelines across actors.
 
     Submission is WINDOWED: a compiled pipeline only buffers
     nslots×stages executions, so submit-all-then-read deadlocks past
     that depth.
     """
     import ray_tpu
-    from ray_tpu._private import config as _config
     from ray_tpu.dag import InputNode
 
     @ray_tpu.remote
@@ -309,46 +308,156 @@ def dag_pipeline_bench(quick: bool = False) -> list[dict]:
             return x + 1
 
     n_exec = 300 if quick else 2000
-    out: list[dict] = []
-    for overlap in (True, False):
-        _config._overrides["DAG_OVERLAP"] = overlap
-        try:
-            stages = [Stage.remote() for _ in range(3)]
-            with InputNode() as inp:
-                node = inp
-                for s in stages:
-                    node = s.work.bind(node)
-                dag = node.experimental_compile()
+    stages = [Stage.remote() for _ in range(3)]
+    with InputNode() as inp:
+        node = inp
+        for s in stages:
+            node = s.work.bind(node)
+        dag = node.experimental_compile()
+    try:
+        dag.execute(0).get(timeout=60)  # warm the loops
+        t0 = time.perf_counter()
+        window = []
+        for i in range(n_exec):
+            window.append(dag.execute(i))
+            if len(window) >= 6:
+                window.pop(0).get(timeout=120)
+        while window:
+            window.pop(0).get(timeout=120)
+        dt = time.perf_counter() - t0
+    finally:
+        dag.teardown()
+        for s in stages:
             try:
-                dag.execute(0).get(timeout=60)  # warm the loops
-                t0 = time.perf_counter()
-                window = []
-                for i in range(n_exec):
-                    window.append(dag.execute(i))
-                    if len(window) >= 6:
-                        window.pop(0).get(timeout=120)
-                while window:
-                    window.pop(0).get(timeout=120)
-                dt = time.perf_counter() - t0
-            finally:
-                dag.teardown()
-                for s in stages:
-                    # Free the actors' CPU leases: the next mode's trio
-                    # must fit on the same small bench cluster.
-                    try:
-                        ray_tpu.kill(s)
-                    except Exception:  # noqa: BLE001
-                        pass
+                ray_tpu.kill(s)
+            except Exception:  # noqa: BLE001
+                pass
+    rate = n_exec / dt
+    rec = {"name": "dag 3-stage pipeline", "ops_per_s": rate}
+    print(f"{rec['name']:<46s} {rate:>12.1f} ops/s")
+    return [rec]
+
+
+def collective_multiproc_bench(quick: bool = False) -> list[dict]:
+    """Allreduce bus bandwidth across REAL process boundaries: N
+    subprocesses form one gloo jax world and allreduce a shared-size
+    payload (BASELINE.json config 1: the NCCL-vs-Gloo allreduce sweep —
+    this is the honest single-host proxy, unlike a 1-device 'allreduce'
+    which is a copy)."""
+    import json as _json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+    import textwrap
+
+    results: list[dict] = []
+    nbytes = (8 << 20) if quick else (64 << 20)
+    worlds = (2,) if quick else (2, 4, 8)
+    trials = 3
+
+    script = textwrap.dedent(
+        """
+        import os, time, json
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        jax.distributed.initialize(
+            coordinator_address="127.0.0.1:{port}",
+            num_processes={world},
+            process_id={rank},
+        )
+        import jax.numpy as jnp
+        from ray_tpu.collective.backends.xla_group import XlaDistGroup
+
+        g = XlaDistGroup({world}, {rank})
+        x = jnp.ones(({nelem},), jnp.float32)
+        out = g.allreduce(x)
+        float(out[0])  # compile + sync
+        g.barrier()
+        t0 = time.perf_counter()
+        for _ in range({trials}):
+            out = g.allreduce(out)
+        float(out[0])
+        dt = (time.perf_counter() - t0) / {trials}
+        if {rank} == 0:
+            print("DT=" + json.dumps(dt))
+        """
+    )
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    import ray_tpu as _rt
+
+    repo_root = os.path.dirname(os.path.dirname(_rt.__file__))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH", "")) if p
+    )
+
+    for world in worlds:
+        port = free_port()
+        procs = []
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                for rank in range(world):
+                    path = os.path.join(td, f"r{rank}.py")
+                    with open(path, "w") as f:
+                        f.write(
+                            script.format(
+                                port=port,
+                                world=world,
+                                rank=rank,
+                                nelem=nbytes // 4,
+                                trials=trials,
+                            )
+                        )
+                    procs.append(
+                        subprocess.Popen(
+                            [sys.executable, path],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            text=True,
+                            env=env,
+                        )
+                    )
+                outs = [p.communicate(timeout=300)[0] for p in procs]
         finally:
-            _config._overrides.pop("DAG_OVERLAP", None)
-        rate = n_exec / dt
+            # One wedged rank (port race, import error → the others
+            # block in initialize forever) must not orphan the rest.
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"gloo bench rank{rank}/{world} rc={p.returncode}:"
+                    f"\n{out[-2000:]}"
+                )
+        dt = next(
+            _json.loads(line[3:])
+            for line in outs[0].splitlines()
+            if line.startswith("DT=")
+        )
+        bus = 2 * (world - 1) / world * nbytes / dt / 1e9
         rec = {
-            "name": f"dag 3-stage pipeline overlap={overlap}",
-            "ops_per_s": rate,
+            "name": f"allreduce gloo {nbytes >> 20} MiB {world}p",
+            "per_s": round(1.0 / dt, 2),
+            "bus_GB_s": round(bus, 3),
         }
-        print(f"{rec['name']:<46s} {rate:>12.1f} ops/s")
-        out.append(rec)
-    return out
+        print(
+            f"{rec['name']:<46s} {rec['per_s']:>8.2f}/s "
+            f"{rec['bus_GB_s']:>7.3f} GB/s bus"
+        )
+        results.append(rec)
+    return results
 
 
 def collective_bench(quick: bool = False) -> list[dict]:
@@ -387,19 +496,23 @@ def collective_bench(quick: bool = False) -> list[dict]:
         factor = 2 * (world - 1) / world if world > 1 else 1.0
         return round(factor * nbytes / dt / 1e9, 2)
 
-    out = allreduce(shards)
-    float(out[0, 0])  # compile + sync
-    t0 = time.perf_counter()
-    for _ in range(trials):
-        out = allreduce(out)
-    float(out[0, 0])
-    dt = (time.perf_counter() - t0) / trials
-    results.append({
-        "name": f"allreduce xla_mesh {nbytes >> 20} MiB x{world}dev",
-        "per_s": 1.0 / dt,
-        "bus_GB_s": bus_gb_s(dt),
-    })
-    print(results[-1])
+    if world > 1:
+        # A single-device "allreduce" is a copy, not a collective — the
+        # mesh entry only means something with 2+ devices; the honest
+        # single-host collective number is collective_multiproc_bench.
+        out = allreduce(shards)
+        float(out[0, 0])  # compile + sync
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = allreduce(out)
+        float(out[0, 0])
+        dt = (time.perf_counter() - t0) / trials
+        results.append({
+            "name": f"allreduce xla_mesh {nbytes >> 20} MiB x{world}dev",
+            "per_s": 1.0 / dt,
+            "bus_GB_s": bus_gb_s(dt),
+        })
+        print(results[-1])
 
     # Host baseline: numpy sum over per-rank buffers (the Gloo stand-in).
     host = [np.ones(n_elem, np.float32) for _ in range(world)]

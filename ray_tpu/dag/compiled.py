@@ -73,12 +73,6 @@ class CompiledDAG:
         ctx = DAGContext.get()
         self.buffer_size = buffer_size or ctx.buffer_size
         self.nslots = max_buffered or ctx.max_buffered
-        # Read per-compile, NOT through the DAGContext singleton: the
-        # singleton freezes at first use, which would make runtime
-        # DAG_OVERLAP toggles (benchmarks, tests) silently no-ops.
-        from ray_tpu._private import config as _config
-
-        self.overlap = _config.get("DAG_OVERLAP")
         self.dag_id = f"dag{next(_dag_counter)}_{os.getpid()}"
         self.root = root
         self._exec_idx = 0
@@ -267,7 +261,6 @@ class CompiledDAG:
                 group_specs,
                 self.nslots,
                 self.buffer_size,
-                self.overlap,
             )
             self._loop_refs.append(ref)
 
@@ -399,21 +392,20 @@ def _submit_system_task(handle, fn, *args):
 
 def _dag_actor_loop(
     instance, schedule, chan_specs, group_specs, nslots, buffer_size,
-    overlap=False,
 ):
     """The compiled per-actor loop (reference: do_exec_tasks
     compiled_dag_node.py:186 — READ → COMPUTE → WRITE until teardown).
     Runs on the actor's execution thread; channel waits are busy-polls on
     shared memory, not RPCs.
 
-    With ``overlap`` (reference: the overlapped execution schedule,
-    dag_node_operation.py:576-593), channel I/O moves off the compute
-    thread: a prefetch thread keeps reading the NEXT iterations' inputs
-    into bounded queues while the current one computes, and a writer
-    thread drains outputs, double-buffered by the ShmChannel ring slots.
-    Opt-in (DAG_OVERLAP): measured net-negative for small host payloads
-    — the GIL serializes the copies — and the ring already pipelines
-    across actors; see the dag rows in PERF.json."""
+    The reference's overlapped execution schedule
+    (dag_node_operation.py:576-593) exists to hide NCCL transfer latency
+    behind GPU compute. A host-thread equivalent (prefetch + writer
+    threads around these channels) was built, benchmarked net-negative
+    at BOTH small and 8 MiB payloads — the GIL serializes the copies
+    with compute, and the ShmChannel ring already pipelines ACROSS
+    actors — and deleted; device tensors move through tensor transport /
+    collective permute instead (PERF.json dag row)."""
     import numpy as np
 
     import ray_tpu.collective as col
@@ -441,21 +433,13 @@ def _dag_actor_loop(
             if e[0] == "chan" and e[1] not in read_order:
                 read_order.append(e[1])
 
-    io = (
-        _OverlapIO(readers, writers, read_order, nslots)
-        if overlap and (readers or writers)
-        else None
-    )
-
     def ensure_read(expr, env):
         """Advance the channel cursor for this op's inputs BEFORE any
         fallible extraction: a failed attribute chain must not leave a
         channel unread for the iteration, or every later iteration pairs
         mismatched values across channels."""
         if expr[0] == "chan" and expr[1] not in env:
-            env[expr[1]] = (
-                io.read(expr[1]) if io else readers[expr[1]].read()
-            )
+            env[expr[1]] = readers[expr[1]].read()
 
     def eval_arg(expr, env):
         kind = expr[0]
@@ -552,18 +536,12 @@ def _dag_actor_loop(
                 except Exception as e:  # noqa: BLE001 - flows to output
                     value = _DagError(e)
                 env[op["uid"]] = value
-                if io is not None:
-                    if op["uid"] in writers:
-                        io.write(op["uid"], value)
-                else:
-                    w = writers.get(op["uid"])
-                    if w is not None:
-                        w.write(value)
+                w = writers.get(op["uid"])
+                if w is not None:
+                    w.write(value)
     except ChannelClosed:
         pass
     finally:
-        if io is not None:
-            io.shutdown()
         for w in writers.values():
             w.close()
         for g in group_specs:
@@ -572,120 +550,3 @@ def _dag_actor_loop(
             except Exception:  # noqa: BLE001
                 pass
     return {"ok": True}
-
-
-class _OverlapIO:
-    """Background channel I/O for the compiled actor loop.
-
-    One prefetch thread reads every subscribed channel in the fixed
-    per-iteration order into bounded queues; one writer thread drains an
-    ordered output queue. The compute thread then only ever touches
-    queues — read waits and payload memcpys overlap with compute, and
-    the ShmChannel ring slots give the double buffering.
-    """
-
-    _CLOSED = object()
-
-    def __init__(self, readers, writers, read_order, depth):
-        import queue
-        import threading
-
-        self._readers = readers
-        self._writers = writers
-        self._read_order = read_order
-        self._in: dict[int, queue.Queue] = {
-            uid: queue.Queue(maxsize=max(depth, 1)) for uid in read_order
-        }
-        self._out: queue.Queue = queue.Queue(maxsize=max(depth, 1))
-        self._write_failed = threading.Event()
-        # Set when the prefetch thread hits EOF/error: read() must never
-        # block forever on a queue the prefetcher will no longer fill
-        # (and delivering sentinels through FULL queues can deadlock
-        # against a compute thread blocked on a DIFFERENT queue).
-        self._eof = threading.Event()
-        self._stop = threading.Event()
-        self._threads = []
-        if read_order:
-            t = threading.Thread(target=self._prefetch_loop, daemon=True)
-            t.start()
-            self._threads.append(t)
-        if writers:
-            t = threading.Thread(target=self._writer_loop, daemon=True)
-            t.start()
-            self._threads.append(t)
-
-    # ------------------------------------------------------- compute side
-    def read(self, uid):
-        import queue
-
-        while True:
-            try:
-                v = self._in[uid].get(timeout=0.2)
-            except queue.Empty:
-                if self._eof.is_set():
-                    raise ChannelClosed("upstream channel closed")
-                continue
-            if v is self._CLOSED:
-                raise ChannelClosed("upstream channel closed")
-            return v
-
-    def write(self, uid, value):
-        import queue
-
-        while True:
-            if self._write_failed.is_set():
-                raise ChannelClosed("downstream channel closed")
-            try:
-                self._out.put((uid, value), timeout=0.2)
-                return
-            except queue.Full:
-                continue
-
-    def shutdown(self):
-        import queue
-
-        self._stop.set()
-        try:
-            self._out.put_nowait(None)  # wake + drain the writer thread
-        except queue.Full:
-            pass  # writer is wedged on a closing ring; it is a daemon
-        for t in self._threads:
-            t.join(timeout=5)
-
-    # --------------------------------------------------------- io threads
-    def _prefetch_loop(self):
-        import queue
-
-        try:
-            while not self._stop.is_set():
-                for uid in self._read_order:
-                    value = self._readers[uid].read()
-                    while not self._stop.is_set():
-                        try:
-                            self._in[uid].put(value, timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
-        except Exception:  # noqa: BLE001 - ChannelClosed → EOF
-            pass
-        finally:
-            # Signal EOF FIRST (read() polls it), then best-effort
-            # sentinels for queues with room.
-            self._eof.set()
-            for q in self._in.values():
-                try:
-                    q.put_nowait(self._CLOSED)
-                except queue.Full:
-                    pass
-
-    def _writer_loop(self):
-        while True:
-            item = self._out.get()
-            if item is None:
-                return
-            uid, value = item
-            try:
-                self._writers[uid].write(value)
-            except Exception:  # noqa: BLE001 - reader gone: flag compute
-                self._write_failed.set()
-                return

@@ -29,7 +29,6 @@ class DAGContext:
     get_timeout: float = field(
         default_factory=lambda: _cfg("DAG_GET_TIMEOUT")
     )
-    overlap: bool = field(default_factory=lambda: _cfg("DAG_OVERLAP"))
 
     _instance = None
 

@@ -59,6 +59,18 @@ def pipeline_apply(
                 f"stage dim {leaf.shape[0]} != mesh {axis}={n_stages}; a "
                 "mismatch would silently drop stages"
             )
+    if param_specs is not None:
+        for spec in jax.tree.leaves(
+            param_specs, is_leaf=lambda s: isinstance(s, P)
+        ):
+            first = spec[0] if len(spec) else None
+            names = first if isinstance(first, tuple) else (first,)
+            if axis not in names:
+                raise ValueError(
+                    f"param_specs leaf {spec} must shard its LEADING "
+                    f"dim over {axis!r}; otherwise every device would "
+                    "silently run stage 0's weights"
+                )
     # Batch shards over the data axes (pipeline composes with DP); each
     # dp shard runs its own GPipe schedule on its slice.
     dp_axes = tuple(

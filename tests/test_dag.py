@@ -180,7 +180,7 @@ def test_teardown_frees_actor(cluster):
     assert ray_tpu.get(a.add.remote(1)) == 6
 
 
-# ---------------------------------------------------- permute + overlap
+# ------------------------------------------------------------- permute
 def test_permute_pipeline_handoff(cluster):
     """The permute verb rotates values rank→rank (the P2P channel for
     pipeline stage handoff; reference: NCCL P2P channels nccl_group.py,
@@ -217,27 +217,33 @@ def test_permute_without_incoming_edge(cluster):
         dag.teardown()
 
 
-def test_overlap_matches_sequential(cluster):
-    """Same DAG, overlap on vs off: identical results (the overlap path
-    only moves channel I/O off the compute thread)."""
-    from ray_tpu._private import config as _config
+def test_large_payload_pipeline(cluster):
+    """8 MiB tensors flow through a 3-stage compiled pipeline intact
+    (the ring slots carry multi-MiB payloads; no overlap threads —
+    measured net-negative and removed)."""
+    import numpy as np
 
-    results = {}
-    for overlap in (True, False):
-        _config._overrides["DAG_OVERLAP"] = overlap
-        try:
-            a = Adder.remote(bias=1)
-            b = Adder.remote(bias=100)
-            with InputNode() as inp:
-                mid = a.add.bind(inp)
-                out = b.add.bind(mid)
-                dag = out.experimental_compile()
-            try:
-                results[overlap] = [
-                    dag.execute(i).get(timeout=60) for i in range(20)
-                ]
-            finally:
-                dag.teardown()
-        finally:
-            _config._overrides.pop("DAG_OVERLAP", None)
-    assert results[True] == results[False] == [i + 101 for i in range(20)]
+    @ray_tpu.remote
+    class Big:
+        def work(self, x):
+            return x + 1.0
+
+    stages = [Big.remote() for _ in range(3)]
+    with InputNode() as inp:
+        node = inp
+        for s in stages:
+            node = s.work.bind(node)
+        # Explicit buffer_size: a config override here would be a
+        # silent no-op once ANY earlier test froze the DAGContext
+        # singleton.
+        dag = node.experimental_compile(buffer_size=32 * 1024 * 1024)
+    try:
+        payload = np.zeros((1024, 2048), np.float32)  # 8 MiB
+        out = dag.execute(payload).get(timeout=120)
+        assert float(out[0, 0]) == 3.0
+        out = dag.execute(payload + 1).get(timeout=120)
+        assert float(out[-1, -1]) == 4.0
+    finally:
+        dag.teardown()
+
+

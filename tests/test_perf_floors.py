@@ -52,6 +52,16 @@ def test_microbench_floors():
     assert bcast["agg_GB_s"] >= 0.02, (
         f"broadcast regressed: {bcast['agg_GB_s']} GB/s aggregate"
     )
+    gloo = next(
+        (r for r in results if r["name"].startswith("allreduce gloo")),
+        None,
+    )
+    assert gloo is not None, "benchmark 'allreduce gloo' missing"
+    # 2-process gloo over real process boundaries; measured 0.137 GB/s
+    # bus at 64 MiB on the 1-core dev box (0.3+ at 8 MiB quick).
+    assert gloo["bus_GB_s"] >= 0.01, (
+        f"gloo allreduce regressed: {gloo['bus_GB_s']} GB/s bus"
+    )
     ttfb = next(
         (r for r in results if r["name"] == "serve sse ttfb"), None
     )

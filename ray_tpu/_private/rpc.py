@@ -544,7 +544,19 @@ class ReconnectingClient:
                 await self.on_reconnect(self._conn)
             return self._conn
 
-    async def call(self, method: str, timeout: float | None = None, **kw):
+    async def call(
+        self,
+        method: str,
+        timeout: float | None = None,
+        retry: bool = True,
+        **kw,
+    ):
+        """``retry=False`` marks a NON-idempotent call (kv_put with
+        overwrite=False, log publish): it still rides reconnects for
+        requests that provably never reached the wire (sent=False), but
+        a call whose response was lost is NOT re-sent — the peer may
+        already have executed it (at-most-once instead of
+        at-least-once)."""
         import time as _time
 
         deadline = _time.monotonic() + self.reconnect_timeout
@@ -553,12 +565,15 @@ class ReconnectingClient:
                 conn = await self._ensure()
                 return await conn.call(method, timeout=timeout, **kw)
             except ConnectionLost as e:
+                sent = getattr(e, "sent", True)
                 if self._closed or _time.monotonic() >= deadline:
+                    raise
+                if not retry and sent:
                     raise
                 # Chaos-dropped requests (sent=False on a live conn)
                 # propagate: retrying them here would defeat the fault
                 # injection the chaos hook exists for.
-                if getattr(e, "sent", True) is False and not (
+                if sent is False and not (
                     self._conn is None or self._conn._closed
                 ):
                     raise

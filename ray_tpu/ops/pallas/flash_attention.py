@@ -444,6 +444,13 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: float | None = None,
+    # PRECONDITION: post-scale attention scores must stay well inside
+    # (-1e9, +inf). The kernel masks with a FINITE -1e9 (so no NaN-guard
+    # `where` passes are needed); a real score at or below -1e9 —
+    # representable in bf16 up to ~3e38 with pathological/unnormalized
+    # activations — would rank BELOW masked positions and silently
+    # corrupt the softmax. Normalized transformer activations sit orders
+    # of magnitude away from this; interpret=True adds an assertion.
     # DEFAULT_BLOCK (1024/1024) measured fastest on v5e at seq 2048
     # (27ms vs 36ms fwd+bwd for the old 256/512 at B16·H16·D64); blocks
     # clamp to the sequence for short inputs.
@@ -476,6 +483,29 @@ def flash_attention(
         )
     if scale is None:
         scale = d**-0.5
+    if interpret:
+        # Debug-mode guard for the finite-mask precondition (see the
+        # signature comment). This function is jit-wrapped, so the
+        # check rides a host callback (interpret mode is the CPU/debug
+        # path — the callback cost is irrelevant there); |scores| is
+        # bounded by the product of input maxima.
+        bound = (
+            jnp.max(jnp.abs(q.astype(jnp.float32)))
+            * jnp.max(jnp.abs(k.astype(jnp.float32)))
+            * abs(scale)
+            * d
+        )
+
+        def _host_check(b):
+            if float(b) >= 1e8:
+                raise AssertionError(
+                    f"flash_attention: |scores| can reach {float(b):.3g}"
+                    " — within 10x of the -1e9 finite mask (masked "
+                    "positions would outrank real ones); normalize the "
+                    "inputs or use dense attention"
+                )
+
+        jax.debug.callback(_host_check, bound)
     return _flash(q, k, v, causal, scale, block_q, block_kv, interpret)
 
 

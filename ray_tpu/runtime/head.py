@@ -343,6 +343,10 @@ class HeadService:
 
     # ------------------------------------------------------------- kv
     async def _on_kv_put(self, conn, key: str, value: bytes, overwrite=True):
+        # overwrite=False callers MUST pass retry=False through their
+        # ReconnectingClient: a blind re-send that observes its own
+        # first write would report {ok: False, exists: True} to the
+        # writer that actually won the race.
         if not overwrite and key in self.kv:
             return {"ok": False, "exists": True}
         self.kv[key] = value

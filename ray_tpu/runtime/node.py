@@ -148,7 +148,7 @@ _built_envs: dict[str, dict] = {}  # env hash → {"python": ..., "cwd": ...}
 _env_build_lock = threading.Lock()
 
 
-def build_runtime_env(runtime_env: dict) -> dict:
+def build_runtime_env(runtime_env: dict, h: str | None = None) -> dict:
     """Materialize a task/actor runtime env on this node: a venv for
     ``pip`` dependencies and a staged copy of ``working_dir``. Cached by
     env hash — the content-addressed URI-cache equivalent (reference:
@@ -158,7 +158,8 @@ def build_runtime_env(runtime_env: dict) -> dict:
     Offline clusters (no egress) install from local wheels:
     ``{"pip": [...], "pip_no_index": True, "pip_find_links": dir}``.
     """
-    h = env_hash(runtime_env)  # content-aware for working_dir envs
+    if h is None:
+        h = env_hash(runtime_env)  # content-aware for working_dir envs
     if h in _built_envs:
         return _built_envs[h]
     with _env_build_lock:
@@ -372,9 +373,12 @@ class NodeManager:
         await self.server.stop()
 
     # ------------------------------------------------------------ workers
-    def _spawn_worker(self, runtime_env: dict | None = None) -> str:
+    def _spawn_worker(
+        self, runtime_env: dict | None = None, ehash: str | None = None
+    ) -> str:
         worker_id = WorkerID.random().hex()
-        ehash = env_hash(runtime_env)
+        if ehash is None:
+            ehash = env_hash(runtime_env)
         # Workers must find the ray_tpu package regardless of their cwd.
         import ray_tpu
 
@@ -489,8 +493,13 @@ class NodeManager:
             # of an env pays (reference: the per-node runtime_env agent
             # builds pip/conda envs with a URI cache,
             # _private/runtime_env/agent/ + uri_cache.py).
+            # Thread THIS lease's ehash through build and spawn: the
+            # working_dir fingerprint cache has a short TTL, so
+            # recomputing at spawn time could hash a just-edited dir
+            # differently and miss _built_envs — the worker would then
+            # silently start without the env it was leased for.
             await asyncio.get_running_loop().run_in_executor(
-                None, build_runtime_env, runtime_env
+                None, build_runtime_env, runtime_env, ehash
             )
         n_spawning = sum(
             1
@@ -498,7 +507,7 @@ class NodeManager:
             if w.get("state") == "spawning" and w.get("env_hash", "") == ehash
         )
         if n_spawning <= len(self._worker_waiters[ehash]):
-            self._spawn_worker(runtime_env)
+            self._spawn_worker(runtime_env, ehash=ehash)
         fut = asyncio.get_running_loop().create_future()
         self._worker_waiters[ehash].append(fut)
         return await asyncio.wait_for(fut, SPAWN_TIMEOUT_S)
@@ -1010,19 +1019,30 @@ class NodeManager:
                     data = await loop.run_in_executor(None, read_chunk)
                     if not data:
                         continue
-                    self._log_offsets[name] = off + len(data)
                     wid = name[len("worker-"):-len(".log")]
                     w = self.workers.get(wid, {})
-                    await self.head.call(
-                        "publish",
-                        channel="logs",
-                        msg={
-                            "worker_id": wid,
-                            "node_id": self.node_id,
-                            "pid": w.get("pid"),
-                            "data": data.decode("utf-8", "replace"),
-                        },
-                    )
+                    # retry=False: a publish whose ack was lost across a
+                    # head restart must not re-send — subscribers would
+                    # see the same log chunk twice. The offset advances
+                    # only once the chunk was (at least) handed to the
+                    # wire: a provably-unsent chunk (sent=False) is
+                    # re-read next tick instead of vanishing.
+                    try:
+                        await self.head.call(
+                            "publish",
+                            retry=False,
+                            channel="logs",
+                            msg={
+                                "worker_id": wid,
+                                "node_id": self.node_id,
+                                "pid": w.get("pid"),
+                                "data": data.decode("utf-8", "replace"),
+                            },
+                        )
+                    except rpc.RpcError as e:
+                        if getattr(e, "sent", True) is False:
+                            continue  # never reached the wire: retry it
+                    self._log_offsets[name] = off + len(data)
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001 - log shipping is best-effort

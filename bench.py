@@ -45,7 +45,7 @@ def _peak_flops() -> float:
     return 197e12  # default to v5e
 
 
-def run(batch_size: int, seq: int, steps: int = 10) -> dict:
+def run(batch_size: int, seq: int, steps: int = 30) -> dict:
     import dataclasses
 
     # Flash attention + chunked cross-entropy keep HBM flat enough for
@@ -66,11 +66,13 @@ def run(batch_size: int, seq: int, steps: int = 10) -> dict:
     )
     batch = {"tokens": tokens}
 
-    # Warmup (compile + 2 steps). Sync via host transfer of an updated
-    # param — on the axon TPU platform block_until_ready does not reliably
-    # wait, and loss alone would leave the update tail overlapping into
-    # the timed region.
-    for _ in range(3):
+    # Warmup (compile + 5 steps — the first post-compile steps run a
+    # slightly cold device; steady state is the meaningful training
+    # number). Sync via host transfer of an updated param — on the axon
+    # TPU platform block_until_ready does not reliably wait, and loss
+    # alone would leave the update tail overlapping into the timed
+    # region.
+    for _ in range(6):
         state, metrics = step(state, batch)
         float(state.params["final_norm"][0])
 

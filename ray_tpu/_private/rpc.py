@@ -490,7 +490,11 @@ async def connect(
             # transient outages.
             last = e
             await asyncio.sleep(retry_delay * (2**attempt))
-    raise ConnectionLost(f"cannot connect to {addr}: {last}")
+    err = ConnectionLost(f"cannot connect to {addr}: {last}")
+    # A failed dial provably never put the request on the wire: let
+    # at-most-once callers (retry=False) safely re-send later.
+    err.sent = False
+    raise err
 
 
 class ReconnectingClient:

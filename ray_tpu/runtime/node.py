@@ -1270,7 +1270,10 @@ class NodeManager:
                     # A worker died mid-spawn with grants still blocked on
                     # registration — spawn a replacement (same runtime_env)
                     # rather than letting the waiter run out the timeout.
-                    self._spawn_worker(w.get("runtime_env"))
+                    # Reuse the dead worker's ehash: recomputing could
+                    # hash an edited working_dir differently and strand
+                    # the waiters in the old bucket.
+                    self._spawn_worker(w.get("runtime_env"), ehash=ehash)
                 for lease_id, lease in list(self.leases.items()):
                     if lease.worker["worker_id"] == wid:
                         self.leases.pop(lease_id)

@@ -1349,7 +1349,18 @@ class CoreWorker:
                             f"task {tid[:12]}… was cancelled"
                         )
                     state["lease"] = lease
-                    conn = await self._connect(lease["addr"])
+                    try:
+                        conn = await self._connect(lease["addr"])
+                    except (rpc.ConnectionLost, OSError) as e:
+                        # Dial failure = the leased WORKER is unreachable
+                        # (dead). Returning the lease would re-idle the
+                        # corpse and hand it to the next caller — drop it
+                        # (the node's reap loop reconciles) and retry on
+                        # a fresh lease. sent=False here means "safe to
+                        # resend", not "the worker is alive".
+                        last_err = e
+                        lease = None
+                        continue
                     reply = await conn.call("push_task", spec=spec)
                     return self._apply_reply(reply, oids, spec["task_id"])
                 except (rpc.ConnectionLost, rpc.RpcError, OSError) as e:
@@ -1396,11 +1407,21 @@ class CoreWorker:
         # Prefer the freshest known address: the actor may have been
         # restarted on a different worker since this handle was created.
         failure: Exception | None = None
+        dialed_dead = False
         addr = actor.addr
         for _ in range(5):
             addr = self._actor_addrs.get(actor.actor_id, actor.addr)
             try:
                 conn = await self._connect(addr)
+            except (rpc.ConnectionLost, OSError) as e:
+                # Endpoint unreachable (worker process gone): the actor
+                # is dead — fall through to the head-driven restart.
+                # The request provably never hit the wire, so it is
+                # safe to RETRY against the restarted address below.
+                failure = e
+                dialed_dead = True
+                break
+            try:
                 reply = await conn.call(
                     "actor_call", spec=spec, actor_id=actor.actor_id
                 )
@@ -1423,11 +1444,11 @@ class CoreWorker:
                 f"actor {actor.actor_id[:12]}…: request could not be sent"
             ) from failure
 
-        # The request was (possibly) delivered and the connection died.
-        # Report to the head; it restarts the actor if max_restarts
-        # allows. THIS call still fails (it may have half-executed —
-        # actor methods are not idempotent by default), but later calls
-        # pick up the restarted actor's address.
+        # The connection died. Report to the head; it restarts the actor
+        # if max_restarts allows. A call that was (possibly) DELIVERED
+        # still fails (it may have half-executed — actor methods are not
+        # idempotent by default); a call that provably never reached the
+        # wire retries once against the restarted address.
         try:
             reply = await self.head.call(
                 "restart_actor", actor_id=actor.actor_id, failed_addr=addr
@@ -1436,6 +1457,9 @@ class CoreWorker:
             reply = {"ok": False}
         if reply.get("ok"):
             self._actor_addrs[actor.actor_id] = reply["addr"]
+            if dialed_dead and not spec.pop("_restart_retried", False):
+                spec["_restart_retried"] = True  # one retry, no loops
+                return await self._drive_actor_task(spec, oids, actor)
             raise ActorDiedError(
                 f"actor {actor.actor_id[:12]}… died mid-call and was "
                 f"restarted; this call was lost: {failure}"

@@ -202,15 +202,35 @@ class HeadService:
         self.publish("node", {"event": "added", "node_id": node_id, "addr": addr})
         return {"ok": True}
 
-    async def _on_heartbeat(
-        self, conn, node_id: str, available: dict, pending: list | None = None
+    async def _on_sync(
+        self,
+        conn,
+        node_id: str,
+        version: int,
+        available: dict,
+        pending: list | None = None,
     ):
+        """Versioned resource-view update, pushed by nodes ON CHANGE
+        (reference: ray_syncer.h:90 versioned component messages). A
+        stale version (reordered across a reconnect) is ignored rather
+        than rolling the view backwards."""
         node = self.nodes.get(node_id)
         if node is None:
             return {"ok": False, "reregister": True}
         node["last_seen"] = time.monotonic()
+        if version < node.get("res_version", -1):
+            return {"ok": True, "stale": True}
+        node["res_version"] = version
         node["available"] = available
         node["pending"] = pending or []
+        return {"ok": True}
+
+    async def _on_keepalive(self, conn, node_id: str):
+        """Liveness-only tick for an unchanged resource view."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False, "reregister": True}
+        node["last_seen"] = time.monotonic()
         return {"ok": True}
 
     async def _on_cluster_status(self, conn):

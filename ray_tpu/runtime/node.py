@@ -326,6 +326,10 @@ class NodeManager:
         # name this node as owner address).
         self._peers: dict[str, rpc.Connection] = {}
         self._obj_locations: dict[str, set] = {}
+        # Resource-view sync state (reference: ray_syncer.h:90 —
+        # versioned per-node updates pushed on CHANGE, not polled).
+        self._res_version = 0
+        self._sync_event: asyncio.Event | None = None
 
     # ----------------------------------------------------------- startup
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -342,6 +346,8 @@ class NodeManager:
             reconnect_timeout=config.get("HEAD_RECONNECT_S"),
         ).connect()
         await self._register_with_head(self.head._conn)
+        self._sync_event = asyncio.Event()
+        self._sync_event.set()  # first wake sends the initial view
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         self._tasks.append(asyncio.ensure_future(self._spill_loop()))
@@ -468,13 +474,24 @@ class NodeManager:
     def _available(self, resources: dict) -> bool:
         return all(self.available.get(k, 0) >= v for k, v in resources.items())
 
+    def _bump_resources(self):
+        """Mark the resource view dirty: the sync loop pushes a
+        versioned update to the head as soon as it wakes (reference:
+        ray_syncer's per-component version counters — only CHANGED
+        state crosses the wire, ray_syncer.h:90)."""
+        self._res_version += 1
+        if self._sync_event is not None:
+            self._sync_event.set()
+
     def _acquire(self, resources: dict):
         for k, v in resources.items():
             self.available[k] = self.available.get(k, 0) - v
+        self._bump_resources()
 
     def _release(self, resources: dict):
         for k, v in resources.items():
             self.available[k] = self.available.get(k, 0) + v
+        self._bump_resources()
 
     async def _get_worker(self, runtime_env: dict | None = None) -> str:
         """Pop an idle worker of the matching runtime_env, else wait for
@@ -829,6 +846,7 @@ class NodeManager:
             (resources, actor, fut, asyncio.get_running_loop().time(),
              runtime_env)
         )
+        self._bump_resources()  # queued demand is a scale-up signal
         return await fut
 
     def _credit_bundle(self, lease: "Lease"):
@@ -977,6 +995,8 @@ class NodeManager:
                 )
             else:
                 still.append((resources, actor, fut, ts, runtime_env))
+        if len(still) != len(self._pending):
+            self._bump_resources()
         self._pending = still
 
     async def _fulfil(self, resources, actor, fut, runtime_env=None):
@@ -1115,25 +1135,57 @@ class NodeManager:
             labels=self.labels,
         )
 
+    _SYNC_KEEPALIVE_S = 5.0
+    _SYNC_DEBOUNCE_S = 0.02
+
     async def _heartbeat_loop(self):
+        """Resource-view sync (reference: ray_syncer.h:90 — streaming
+        versioned updates, not polling). A resource CHANGE (lease
+        grant/release, queued demand, bundle ops) wakes this loop
+        immediately and pushes one versioned update — sub-50ms
+        propagation instead of a 2s poll; an unchanged view sends only
+        a tiny keepalive every _SYNC_KEEPALIVE_S so the head's health
+        loop still sees liveness. At 2,000 idle nodes this is ~400
+        payload-free messages/s cluster-wide instead of 1,000 full
+        snapshots/s."""
+        sent_version = -1
         while True:
-            await asyncio.sleep(2.0)
             try:
-                reply = await self.head.call(
-                    "heartbeat",
-                    node_id=self.node_id,
-                    available=self.available,
-                    # Feasible-but-queued lease demand: a scale-up signal
-                    # (reference: raylets report resource_load_by_shape
-                    # to GCS for GcsAutoscalerStateManager). Cluster-wide
-                    # infeasible demand is recorded by the head itself in
-                    # pick_node.
-                    pending=[dict(r) for r, *_rest in self._pending],
+                await asyncio.wait_for(
+                    self._sync_event.wait(), timeout=self._SYNC_KEEPALIVE_S
                 )
+                # Coalesce bursts (a lease storm is one update).
+                await asyncio.sleep(self._SYNC_DEBOUNCE_S)
+            except asyncio.TimeoutError:
+                pass
+            self._sync_event.clear()
+            version = self._res_version
+            try:
+                if version != sent_version:
+                    reply = await self.head.call(
+                        "sync",
+                        node_id=self.node_id,
+                        version=version,
+                        available=self.available,
+                        # Feasible-but-queued lease demand: a scale-up
+                        # signal (reference: raylets report
+                        # resource_load_by_shape to GCS for
+                        # GcsAutoscalerStateManager).
+                        pending=[dict(r) for r, *_rest in self._pending],
+                    )
+                    if reply.get("ok"):
+                        sent_version = version
+                else:
+                    reply = await self.head.call(
+                        "keepalive", node_id=self.node_id
+                    )
                 if not reply.get("ok") and reply.get("reregister"):
-                    # The head lost this node's entry (e.g. health-loop
-                    # reap during a long GC pause): rejoin.
+                    # The head lost this node's entry (restart, or a
+                    # health-loop reap during a long GC pause): rejoin
+                    # and force a full re-send.
                     await self._register_with_head(self.head._conn)
+                    sent_version = -1
+                    self._sync_event.set()
             except rpc.RpcError:
                 pass
 

@@ -136,8 +136,12 @@ def chunked_cross_entropy(
         # Largest divisor <= chunk: falling back to chunk=s would
         # materialize the full [B, S, V] logits for any length the
         # default doesn't divide (e.g. seq 2560) — a multi-GB memory
-        # cliff, not a fallback.
+        # cliff. Divisor-poor lengths (primes) floor at 128: below
+        # that the scan degrades to matvecs, and a single full-logits
+        # pass is the lesser evil for such (rare, short-eval) shapes.
         chunk = next(c for c in range(min(chunk, s), 0, -1) if s % c == 0)
+        if chunk < 128:
+            chunk = s
     n = s // chunk
     xc = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)  # [n, B, chunk, d]
     tc = targets.reshape(b, n, chunk).swapaxes(0, 1)

@@ -70,11 +70,12 @@ def test_actor_restart(cluster):
 
     os.kill(pid, signal.SIGKILL)
     time.sleep(0.3)
-    # The first call after death fails (methods are not idempotent),
-    # but reporting it triggers the restart.
-    with pytest.raises(ActorDiedError):
-        ray_tpu.get(a.bump.remote(), timeout=30)
+    # The first call after death dials a dead endpoint — the request
+    # provably never reached the wire, so after the head-driven restart
+    # it retries transparently against the new address (at-most-once is
+    # preserved; a HALF-SENT call would still raise ActorDiedError).
     assert ray_tpu.get(a.bump.remote(), timeout=30) == 1  # state reset
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 2
     new_pid = ray_tpu.get(a.pid.remote())
     assert new_pid != pid
 
@@ -106,9 +107,14 @@ def test_actor_restart_budget_exhausts(cluster):
     a = OneLife.remote()
     pid1 = ray_tpu.get(a.pid.remote())
     os.kill(pid1, signal.SIGKILL)
-    with pytest.raises(ActorDiedError):
-        ray_tpu.get(a.pid.remote(), timeout=30)
-    pid2 = ray_tpu.get(a.pid.remote(), timeout=30)  # restarted once
+    # Depending on when the dead connection is detected, the first call
+    # either raises (frame reached a locally-live socket: half-sent,
+    # not retried) or retries transparently (dial failure: provably
+    # unsent). Both must land on the restarted instance.
+    try:
+        pid2 = ray_tpu.get(a.pid.remote(), timeout=30)
+    except ActorDiedError:
+        pid2 = ray_tpu.get(a.pid.remote(), timeout=30)
     assert pid2 != pid1
     os.kill(pid2, signal.SIGKILL)
     with pytest.raises(ActorDiedError):

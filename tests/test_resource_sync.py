@@ -1,0 +1,104 @@
+"""Streaming resource-view sync (reference: ray_syncer.h:90 — versioned
+per-node updates pushed on change; liveness via payload-free keepalives;
+stale versions never roll the view backwards).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import api as core_api
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _available_cpu(rt):
+    table = rt.run(rt.core.head.call("node_table"))
+    return sum(n["available"].get("CPU", 0) for n in table.values())
+
+
+def test_resource_change_propagates_fast(cluster):
+    """A lease grant reaches the head's view in well under the old 2s
+    polling period — the sync is event-driven."""
+    rt = core_api._runtime
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return "ok"
+
+    base = _available_cpu(rt)
+    a = Holder.options(num_cpus=2).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "ok"
+    deadline = time.monotonic() + 1.0
+    seen = base
+    while time.monotonic() < deadline:
+        seen = _available_cpu(rt)
+        if seen <= base - 2:
+            break
+        time.sleep(0.05)
+    assert seen <= base - 2, (
+        f"lease not visible at head within 1s (avail {base} -> {seen})"
+    )
+    ray_tpu.kill(a)
+
+
+def test_sync_versions_monotonic_and_stale_rejected(cluster):
+    rt = core_api._runtime
+    table = rt.run(rt.core.head.call("node_table"))
+    nid, node = next(iter(table.items()))
+    v = node.get("res_version", 0)
+    assert v >= 0
+
+    # A stale (older-version) sync must not roll the view backwards.
+    reply = rt.run(
+        rt.core.head.call(
+            "sync",
+            node_id=nid,
+            version=max(0, v - 1),
+            available={"CPU": 999.0},
+            pending=[],
+        )
+    )
+    assert reply["ok"] and reply.get("stale")
+    table = rt.run(rt.core.head.call("node_table"))
+    assert table[nid]["available"].get("CPU") != 999.0
+
+
+def test_keepalive_refreshes_liveness_only(cluster):
+    rt = core_api._runtime
+    table = rt.run(rt.core.head.call("node_table"))
+    nid = next(iter(table))
+    reply = rt.run(rt.core.head.call("keepalive", node_id=nid))
+    assert reply["ok"]
+    # Unknown node is told to re-register (head restart recovery).
+    reply = rt.run(rt.core.head.call("keepalive", node_id="f" * 32))
+    assert not reply["ok"] and reply["reregister"]
+
+
+def test_idle_node_sends_no_payload_updates(cluster):
+    """With no resource churn, the node's synced version stays put
+    (only keepalives flow)."""
+    rt = core_api._runtime
+
+    # Let cached-lease idle returns from earlier tests settle (the
+    # driver's lease pool parks free leases ~1s before returning them,
+    # each return being a legitimate resource change).
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        table = rt.run(rt.core.head.call("node_table"))
+        nid, node = next(iter(table.items()))
+        v1 = node.get("res_version", 0)
+        time.sleep(1.5)
+        table = rt.run(rt.core.head.call("node_table"))
+        v2 = table[nid].get("res_version", 0)
+        if v2 == v1:
+            return  # a quiet window with zero payload updates: proven
+    raise AssertionError(f"no quiet window found; version at {v2}")

@@ -34,6 +34,8 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                        "from this file"),
     "FAKE_CHIPS": (str, "", "test hook: report this many TPU chips"),
     "NODE_LABELS": (str, "", "extra node labels as k=v,k=v"),
+    "NODE_AGENT": (bool, True, "per-node dashboard agent (node-local "
+                               "/healthz /api/stats /api/logs /metrics)"),
     "MAX_LINEAGE_BYTES": (int, 512 << 20, "lineage byte budget per worker; "
                                           "oldest entries evict past it"),
     "WORKER_JAX_PLATFORMS": (str, "cpu", "JAX_PLATFORMS for spawned "

@@ -10,7 +10,6 @@ HTML status page for humans.
 
 from __future__ import annotations
 
-import html
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -65,29 +64,124 @@ def _logs():
     return state.list_worker_logs()
 
 
-def _index_html() -> str:
+@_route("/api/cluster")
+def _cluster():
+    """One-call overview for the UI: node/actor/task rollups plus
+    per-resource utilization."""
     nodes = state.list_nodes()
     actors = state.list_actors()
-    summary = state.summarize_tasks()
-    rows = "".join(
-        f"<tr><td>{html.escape(n['node_id'][:12])}</td>"
-        f"<td>{html.escape(n['addr'])}</td>"
-        f"<td>{html.escape(json.dumps(n['resources']))}</td>"
-        f"<td>{html.escape(json.dumps(n['available']))}</td></tr>"
-        for n in nodes
-    )
-    alive = sum(1 for a in actors if a["state"] == "ALIVE")
-    return f"""<!doctype html><html><head><title>ray_tpu dashboard</title>
-<style>body{{font-family:monospace;margin:2em}}table{{border-collapse:collapse}}
-td,th{{border:1px solid #999;padding:4px 8px}}</style></head><body>
-<h2>ray_tpu cluster</h2>
-<p>nodes: {len(nodes)} &middot; actors alive: {alive}/{len(actors)}
-&middot; tasks: {html.escape(json.dumps(summary))}</p>
-<table><tr><th>node</th><th>addr</th><th>total</th><th>available</th></tr>
-{rows}</table>
-<p>endpoints: /api/nodes /api/actors /api/tasks /api/task_summary
-/api/placement_groups /api/jobs /metrics</p>
-</body></html>"""
+    util: dict[str, dict] = {}
+    for n in nodes:
+        for k, total in n["resources"].items():
+            u = util.setdefault(k, {"total": 0.0, "available": 0.0})
+            u["total"] += total
+            u["available"] += n["available"].get(k, 0)
+    return {
+        "nodes": len(nodes),
+        "actors_alive": sum(1 for a in actors if a["state"] == "ALIVE"),
+        "actors_total": len(actors),
+        "tasks": state.summarize_tasks(),
+        "utilization": util,
+    }
+
+
+# Self-contained single-page UI (reference: the React dashboard client,
+# dashboard/client/src/App.tsx — here a zero-build static page polling
+# the same JSON endpoints: overview, nodes with per-node agent links,
+# actors, tasks, placement groups, jobs, logs with inline viewer).
+_SPA = """<!doctype html><html><head><meta charset="utf-8">
+<title>ray_tpu dashboard</title><style>
+:root{--bg:#111418;--fg:#e6e6e6;--mut:#9aa4ad;--card:#1b2026;--acc:#4fc3f7;
+--ok:#66bb6a;--bad:#ef5350}
+body{font:13px/1.5 ui-monospace,Menlo,monospace;background:var(--bg);
+color:var(--fg);margin:0}
+header{display:flex;gap:1.5em;align-items:baseline;padding:.8em 1.2em;
+background:var(--card);border-bottom:1px solid #2a323b}
+h1{font-size:15px;margin:0;color:var(--acc)}
+nav a{color:var(--mut);margin-right:1em;cursor:pointer;text-decoration:none}
+nav a.on{color:var(--fg);border-bottom:2px solid var(--acc)}
+main{padding:1em 1.2em}
+table{border-collapse:collapse;width:100%;margin-top:.6em}
+td,th{border-bottom:1px solid #2a323b;padding:4px 8px;text-align:left;
+white-space:nowrap}
+th{color:var(--mut);font-weight:normal}
+.cards{display:flex;gap:1em;flex-wrap:wrap}
+.card{background:var(--card);border-radius:6px;padding:.8em 1.2em;min-width:9em}
+.card b{display:block;font-size:20px}
+.bar{background:#2a323b;border-radius:3px;height:8px;min-width:8em}
+.bar i{display:block;height:8px;border-radius:3px;background:var(--acc)}
+.ok{color:var(--ok)}.bad{color:var(--bad)}.mut{color:var(--mut)}
+pre{background:var(--card);padding:1em;overflow:auto;max-height:60vh}
+a{color:var(--acc)}</style></head><body>
+<header><h1>ray_tpu</h1><nav id="nav"></nav>
+<span class="mut" id="ts"></span></header><main id="main">loading…</main>
+<script>
+const TABS=["overview","nodes","actors","tasks","placement groups","jobs","logs"];
+let tab=location.hash.slice(1)||"overview", logWid=null;
+const $=(h)=>{document.getElementById("main").innerHTML=h};
+const esc=(s)=>String(s).replace(/[&<>"']/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+const get=async(p)=>(await fetch(p)).json();
+function nav(){document.getElementById("nav").innerHTML=TABS.map(t=>
+ `<a class="${t===tab?"on":""}" href="#${t}">${t}</a>`).join("")}
+window.onhashchange=()=>{tab=location.hash.slice(1)||"overview";logWid=null;draw()};
+function bar(used,total){const p=total?Math.round(100*used/total):0;
+ return `<div class="bar" title="${p}%"><i style="width:${p}%"></i></div>`}
+async function draw(){nav();
+ document.getElementById("ts").textContent=new Date().toLocaleTimeString();
+ try{
+ if(tab==="overview"){const c=await get("/api/cluster");
+  let cards=`<div class="card">nodes<b>${c.nodes}</b></div>
+   <div class="card">actors<b>${c.actors_alive}<span class="mut">/${c.actors_total}</span></b></div>`;
+  for(const[st,n]of Object.entries(c.tasks||{}))
+   cards+=`<div class="card">${esc(st.toLowerCase())}<b>${n}</b></div>`;
+  let rows=Object.entries(c.utilization).map(([k,u])=>{const used=u.total-u.available;
+   return `<tr><td>${esc(k)}</td><td>${used.toFixed(1)}/${u.total.toFixed(1)}</td>
+    <td>${bar(used,u.total)}</td></tr>`}).join("");
+  $(`<div class="cards">${cards}</div>
+   <table><tr><th>resource</th><th>used/total</th><th></th></tr>${rows}</table>`)}
+ else if(tab==="nodes"){const ns=await get("/api/nodes");
+  $(`<table><tr><th>node</th><th>addr</th><th>agent</th><th>total</th>
+   <th>available</th><th>labels</th></tr>`+ns.map(n=>
+   `<tr><td>${esc(n.node_id.slice(0,12))}</td><td>${esc(n.addr)}</td>
+   <td>${n.agent_addr?`<a href="http://${esc(n.agent_addr)}/api/stats">${esc(n.agent_addr)}</a>`:"—"}</td>
+   <td>${esc(JSON.stringify(n.resources))}</td>
+   <td>${esc(JSON.stringify(n.available))}</td>
+   <td class="mut">${esc(JSON.stringify(n.labels||{}))}</td></tr>`).join("")+"</table>")}
+ else if(tab==="actors"){const as=await get("/api/actors");
+  $(`<table><tr><th>actor</th><th>class</th><th>name</th><th>state</th>
+   <th>node</th></tr>`+as.map(a=>
+   `<tr><td>${esc(a.actor_id.slice(0,12))}</td><td>${esc(a.class_name||"")}</td>
+   <td>${esc(a.name||"")}</td>
+   <td class="${a.state==="ALIVE"?"ok":"bad"}">${esc(a.state)}</td>
+   <td class="mut">${esc((a.node_id||"").slice(0,12))}</td></tr>`).join("")+"</table>")}
+ else if(tab==="tasks"){const ts=await get("/api/tasks");
+  $(`<table><tr><th>task</th><th>name</th><th>state</th><th>kind</th>
+   <th>duration</th></tr>`+ts.slice(0,500).map(t=>
+   `<tr><td>${esc((t.task_id||"").slice(0,12))}</td><td>${esc(t.name||"")}</td>
+   <td class="${t.state==="FAILED"?"bad":""}">${esc(t.state||"")}</td>
+   <td class="mut">${esc(t.kind||"")}</td>
+   <td>${t.duration_s!=null?esc(t.duration_s.toFixed?t.duration_s.toFixed(3):t.duration_s)+"s":""}</td></tr>`).join("")+"</table>")}
+ else if(tab==="placement groups"){const ps=await get("/api/placement_groups");
+  $("<pre>"+esc(JSON.stringify(ps,null,2))+"</pre>")}
+ else if(tab==="jobs"){const js=await get("/api/jobs");
+  $("<pre>"+esc(JSON.stringify(js,null,2))+"</pre>")}
+ else if(tab==="logs"){
+  if(logWid){const r=await fetch("/api/logs/"+logWid);
+   $(`<p><a href="#logs" onclick="logWid=null;draw()">&larr; back</a>
+    worker ${esc(logWid)}</p><pre>${esc(await r.text())}</pre>`)}
+  else{const ls=await get("/api/logs");
+   $(`<table><tr><th>worker</th><th>node</th><th>size</th><th>status</th></tr>`+
+    ls.map(l=>`<tr><td><a href="#logs" onclick="logWid='${esc(l.worker_id)}';draw();return false">
+    ${esc(l.worker_id)}</a></td><td class="mut">${esc((l.node_id||"").slice(0,12))}</td>
+    <td>${l.size}</td><td class="${l.alive?"ok":"bad"}">${l.alive?"alive":"dead"}</td></tr>`).join("")+"</table>")}}
+ }catch(e){$(`<p class="bad">fetch failed: ${esc(e)}</p>`)}
+}
+draw();setInterval(()=>{if(!logWid)draw()},2000);
+</script></body></html>"""
+
+
+def _index_html() -> str:
+    return _SPA
 
 
 class _Handler(BaseHTTPRequestHandler):

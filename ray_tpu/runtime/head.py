@@ -184,13 +184,26 @@ class HeadService:
         return await fn(conn=conn, **rpc.tolerant_kwargs(fn, kw))
 
     async def _on_register_node(
-        self, conn, node_id: str, addr: str, resources: dict, labels=None
+        self,
+        conn,
+        node_id: str,
+        addr: str,
+        resources: dict,
+        available: dict | None = None,
+        res_version: int = 0,
+        labels=None,
+        agent_addr=None,
     ):
         self.nodes[node_id] = {
             "addr": addr,
             "resources": dict(resources),
-            "available": dict(resources),
+            # A RE-registration (head reconnect) carries the node's live
+            # view; defaulting to full totals would over-schedule onto
+            # leases the head just forgot about.
+            "available": dict(available if available is not None else resources),
+            "res_version": res_version,
             "labels": labels or {},
+            "agent_addr": agent_addr,
             "last_seen": time.monotonic(),
             "conn": conn,
         }

@@ -330,6 +330,8 @@ class NodeManager:
         # versioned per-node updates pushed on CHANGE, not polled).
         self._res_version = 0
         self._sync_event: asyncio.Event | None = None
+        # Per-node dashboard agent (reference: dashboard/agent.py).
+        self.agent = None
 
     # ----------------------------------------------------------- startup
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -345,6 +347,11 @@ class NodeManager:
             on_reconnect=self._register_with_head,
             reconnect_timeout=config.get("HEAD_RECONNECT_S"),
         ).connect()
+        if config.get("NODE_AGENT"):
+            from ray_tpu.runtime.agent import NodeAgent
+
+            self.agent = NodeAgent(self)
+            await self.agent.start(host)
         await self._register_with_head(self.head._conn)
         self._sync_event = asyncio.Event()
         self._sync_event.set()  # first wake sends the initial view
@@ -363,6 +370,8 @@ class NodeManager:
     async def stop(self):
         for t in self._tasks:
             t.cancel()
+        if self.agent is not None:
+            await self.agent.stop()
         for w in self.workers.values():
             proc = w.get("proc")
             if proc and proc.poll() is None:
@@ -1132,8 +1141,18 @@ class NodeManager:
             node_id=self.node_id,
             addr=self.addr,
             resources=self.total,
+            # The CURRENT view, not the totals: re-registration after a
+            # connection blip must not reset the head to full capacity
+            # while leases are live.
+            available=self.available,
+            res_version=self._res_version,
             labels=self.labels,
+            agent_addr=self.agent.addr if self.agent else None,
         )
+        # Force a follow-up sync regardless: the version counter keeps
+        # moving, so a concurrent change between snapshot and reply
+        # can't be skipped as already-sent.
+        self._bump_resources()
 
     _SYNC_KEEPALIVE_S = 5.0
     _SYNC_DEBOUNCE_S = 0.02

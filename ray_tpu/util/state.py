@@ -96,6 +96,7 @@ def list_nodes() -> list[dict]:
             "resources": n["resources"],
             "available": n["available"],
             "labels": n.get("labels", {}),
+            "agent_addr": n.get("agent_addr"),
         }
         for nid, n in table.items()
     ]

@@ -66,7 +66,11 @@ def test_dashboard_tasks_and_metrics(dash):
     assert "dash_hits" in metrics
 
     page = _get(dash.url + "/").decode()
-    assert "ray_tpu cluster" in page
+    # The SPA shell (tab list + poll loop) is served; data arrives via
+    # the JSON endpoints the page polls.
+    assert "ray_tpu dashboard" in page and "/api/cluster" in page
+    cluster = json.loads(_get(dash.url + "/api/cluster"))
+    assert cluster["nodes"] >= 1 and "utilization" in cluster
 
 
 def test_dashboard_404(dash):
@@ -96,3 +100,39 @@ def test_cli_status_and_list(cluster, capsys):
     )
     assert proc.returncode == 0, proc.stderr
     assert "nodes:" in proc.stdout
+
+
+def test_node_agent_endpoints(cluster):
+    """Per-node agent (reference: dashboard/agent.py): node-local
+    health, stats, logs, and Prometheus metrics, reachable at the
+    agent_addr the node registered with the head."""
+    import ray_tpu
+    from ray_tpu import api as core_api
+
+    rt = core_api._runtime
+    table = rt.run(rt.core.head.call("node_table"))
+    agent_addr = next(iter(table.values()))["agent_addr"]
+    assert agent_addr, "node registered no agent address"
+    base = f"http://{agent_addr}"
+
+    health = json.loads(_get(base + "/healthz"))
+    assert health["ok"] and health["workers"] >= 0
+
+    stats = json.loads(_get(base + "/api/stats"))
+    assert "available" in stats and "store_used_bytes" in stats
+
+    # Run a task so a worker log exists, then read it node-locally.
+    @ray_tpu.remote
+    def shout():
+        print("agent-sees-this")
+        return 1
+
+    ray_tpu.get(shout.remote())
+    time.sleep(0.5)
+    logs = json.loads(_get(base + "/api/logs"))
+    assert logs, "no worker logs listed"
+    text = _get(base + f"/api/logs/{logs[0]['worker_id']}").decode()
+    assert isinstance(text, str)
+
+    metrics = _get(base + "/metrics").decode()
+    assert "ray_tpu_node_workers" in metrics

@@ -52,10 +52,22 @@ def test_resource_change_propagates_fast(cluster):
 
 def test_sync_versions_monotonic_and_stale_rejected(cluster):
     rt = core_api._runtime
-    table = rt.run(rt.core.head.call("node_table"))
-    nid, node = next(iter(table.items()))
-    v = node.get("res_version", 0)
-    assert v >= 0
+
+    # Force at least one real resource change so the node's version is
+    # >= 1 — otherwise "version - 1" below would not be stale.
+    @ray_tpu.remote
+    def tick():
+        return 1
+
+    ray_tpu.get(tick.remote(), timeout=30)
+    deadline = time.monotonic() + 5
+    v = 0
+    while time.monotonic() < deadline and v < 1:
+        table = rt.run(rt.core.head.call("node_table"))
+        nid, node = next(iter(table.items()))
+        v = node.get("res_version", 0)
+        time.sleep(0.05)
+    assert v >= 1
 
     # A stale (older-version) sync must not roll the view backwards.
     reply = rt.run(

@@ -36,6 +36,10 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "NODE_LABELS": (str, "", "extra node labels as k=v,k=v"),
     "NODE_AGENT": (bool, True, "per-node dashboard agent (node-local "
                                "/healthz /api/stats /api/logs /metrics)"),
+    "NODE_AGENT_HOST": (str, "127.0.0.1", "agent bind host — loopback "
+                                          "by default: the agent has "
+                                          "no auth, so expose it only "
+                                          "behind your own proxy"),
     "MAX_LINEAGE_BYTES": (int, 512 << 20, "lineage byte budget per worker; "
                                           "oldest entries evict past it"),
     "WORKER_JAX_PLATFORMS": (str, "cpu", "JAX_PLATFORMS for spawned "

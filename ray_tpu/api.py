@@ -193,6 +193,14 @@ def init(
     _runtime.mode = "client" if client else "driver"
     _runtime.session = session
     atexit.register(shutdown)
+    if os.environ.get("RAY_TPU_USAGE_REPORT_URL"):
+        # Opt-in usage POST (reference: usage_lib report on init) —
+        # fire-and-forget off-thread, never on the init path.
+        from ray_tpu._private import usage
+
+        threading.Thread(
+            target=usage.report_if_enabled, daemon=True
+        ).start()
     return {
         "address": head_addr,
         "session": session,

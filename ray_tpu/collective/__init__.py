@@ -175,3 +175,8 @@ __all__ = [
     "send",
     "recv",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu('collective')
+del _rlu

@@ -196,6 +196,20 @@ async def _run_head(args) -> None:
         stoppables.append(node)
 
     _write_atomic(os.path.join(session_dir, "head.addr"), addr)
+    try:
+        # Local artifact (environment/version info; the driver-side
+        # /api/usage endpoint carries the live cluster view); the POST
+        # fires only when the operator set RAY_TPU_USAGE_REPORT_URL.
+        from ray_tpu._private import usage
+
+        usage.write_usage_file(session_dir)
+        import threading
+
+        threading.Thread(
+            target=usage.report_if_enabled, daemon=True
+        ).start()
+    except Exception:  # noqa: BLE001 - observability must not block boot
+        pass
     # The daemon's stdout lands in a log file under the session dir —
     # never print the token itself here (the 0600 token file is the
     # secret's only resting place; the CLI prints the join command to

@@ -64,6 +64,13 @@ def _logs():
     return state.list_worker_logs()
 
 
+@_route("/api/usage")
+def _usage():
+    from ray_tpu._private import usage
+
+    return usage.usage_stats()
+
+
 @_route("/api/cluster")
 def _cluster():
     """One-call overview for the UI: node/actor/task rollups plus
@@ -171,9 +178,10 @@ async function draw(){nav();
     worker ${esc(logWid)}</p><pre>${esc(await r.text())}</pre>`)}
   else{const ls=await get("/api/logs");
    $(`<table><tr><th>worker</th><th>node</th><th>size</th><th>status</th></tr>`+
-    ls.map(l=>`<tr><td><a href="#logs" onclick="logWid='${esc(l.worker_id)}';draw();return false">
+    ls.map(l=>`<tr><td><a href="#logs" class="wlog" data-wid="${esc(l.worker_id)}">
     ${esc(l.worker_id)}</a></td><td class="mut">${esc((l.node_id||"").slice(0,12))}</td>
-    <td>${l.size}</td><td class="${l.alive?"ok":"bad"}">${l.alive?"alive":"dead"}</td></tr>`).join("")+"</table>")}}
+    <td>${l.size}</td><td class="${l.alive?"ok":"bad"}">${l.alive?"alive":"dead"}</td></tr>`).join("")+"</table>");
+   document.querySelectorAll(".wlog").forEach(a=>a.onclick=()=>{logWid=a.dataset.wid;draw();return false})}}
  }catch(e){$(`<p class="bad">fetch failed: ${esc(e)}</p>`)}
 }
 draw();setInterval(()=>{if(!logWid)draw()},2000);

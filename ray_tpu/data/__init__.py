@@ -83,3 +83,8 @@ __all__ = [
     "from_arrow", "from_numpy", "read_parquet", "read_csv", "read_json",
     "read_text", "read_numpy",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu('data')
+del _rlu

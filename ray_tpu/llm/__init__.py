@@ -29,3 +29,8 @@ __all__ = [
     "forward_prefill",
     "init_kv_cache",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu('llm')
+del _rlu

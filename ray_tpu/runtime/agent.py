@@ -69,25 +69,31 @@ class NodeAgent:
             "res_version": n._res_version,
         }
 
-    def _logs_list(self, query) -> list:
+    async def _logs_list(self, query) -> list:
         n = self.node
-        out = []
-        if n.log_dir.is_dir():
-            for path in sorted(n.log_dir.glob("worker-*.log")):
-                wid = path.name[len("worker-"):-len(".log")]
-                w = n.workers.get(wid)
-                out.append(
-                    {
-                        "worker_id": wid,
-                        "size": path.stat().st_size,
-                        "alive": bool(
-                            w
-                            and w.get("proc")
-                            and w["proc"].poll() is None
-                        ),
-                    }
-                )
-        return out
+
+        def scan():
+            out = []
+            if n.log_dir.is_dir():
+                for path in sorted(n.log_dir.glob("worker-*.log")):
+                    wid = path.name[len("worker-"):-len(".log")]
+                    w = n.workers.get(wid)
+                    out.append(
+                        {
+                            "worker_id": wid,
+                            "size": path.stat().st_size,
+                            "alive": bool(
+                                w
+                                and w.get("proc")
+                                and w["proc"].poll() is None
+                            ),
+                        }
+                    )
+            return out
+
+        # Off-loop like _log_text: a glob+stat sweep over a big log dir
+        # on slow storage must not stall the scheduling loop.
+        return await asyncio.to_thread(scan)
 
     async def _log_text(self, wid: str, query) -> str | None:
         """Seek+read off-loop: a multi-GB worker log must neither stall
@@ -154,7 +160,7 @@ class NodeAgent:
                 body, ctype = json.dumps(self._stats(query)), "application/json"
             elif path == "/api/logs":
                 body, ctype = (
-                    json.dumps(self._logs_list(query)),
+                    json.dumps(await self._logs_list(query)),
                     "application/json",
                 )
             elif path.startswith("/api/logs/"):

@@ -351,7 +351,12 @@ class NodeManager:
             from ray_tpu.runtime.agent import NodeAgent
 
             self.agent = NodeAgent(self)
-            await self.agent.start(host)
+            # Loopback by default: the agent serves worker logs over
+            # plain HTTP with NO token handshake — binding the node's
+            # routable host would leak stdout/stderr to the network.
+            # Operators front it with their own proxy/auth via
+            # RAY_TPU_NODE_AGENT_HOST.
+            await self.agent.start(config.get("NODE_AGENT_HOST"))
         await self._register_with_head(self.head._conn)
         self._sync_event = asyncio.Event()
         self._sync_event.set()  # first wake sends the initial view

@@ -56,3 +56,8 @@ __all__ = [
     "start_http",
     "status",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu('serve')
+del _rlu

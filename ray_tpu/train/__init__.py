@@ -56,3 +56,8 @@ __all__ = [
     "ScalingConfig",
     "ScalingPolicy",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu('train')
+del _rlu

@@ -77,3 +77,8 @@ __all__ = [
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu('tune')
+del _rlu

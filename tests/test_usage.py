@@ -1,0 +1,68 @@
+"""Usage reporting (reference: _private/usage/usage_lib.py — here
+strictly OPT-IN: no network unless RAY_TPU_USAGE_REPORT_URL is set).
+"""
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import usage
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=2)
+    yield info
+    ray_tpu.shutdown()
+
+
+def test_usage_record_shape(cluster):
+    usage.record_library_usage("serve")
+    usage.record_library_usage("train")
+    rec = usage.usage_stats()
+    assert rec["schema_version"] and rec["ray_tpu_version"]
+    assert "serve" in rec["libraries"] and "train" in rec["libraries"]
+    assert rec["cluster_nodes"] >= 1
+    assert rec["cluster_resources"].get("CPU", 0) >= 2
+
+
+def test_usage_file_artifact(cluster, tmp_path):
+    path = usage.write_usage_file(str(tmp_path))
+    rec = json.loads(open(path).read())
+    assert rec["python_version"].count(".") >= 1
+
+
+def test_no_report_without_optin(cluster, monkeypatch):
+    monkeypatch.delenv("RAY_TPU_USAGE_REPORT_URL", raising=False)
+    assert usage.report_if_enabled() is False
+
+
+def test_report_posts_when_opted_in(cluster, monkeypatch):
+    received = {}
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.update(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        monkeypatch.setenv(
+            "RAY_TPU_USAGE_REPORT_URL",
+            f"http://127.0.0.1:{srv.server_address[1]}/usage",
+        )
+        assert usage.report_if_enabled() is True
+        assert received.get("ray_tpu_version")
+    finally:
+        srv.shutdown()

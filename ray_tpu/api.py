@@ -722,6 +722,13 @@ def _normalize_options(options: dict) -> dict:
         resources["TPU"] = float(options.pop("num_tpus"))
     if resources:
         options["resources"] = resources
+    renv = options.get("runtime_env")
+    if renv:
+        # Fail bad specs HERE at submission — an invalid env otherwise
+        # travels through scheduling and fails per lease attempt deep
+        # in the node's locked env builder.
+        if renv.get("pip") and renv.get("uv"):
+            raise ValueError("runtime_env: specify 'pip' OR 'uv', not both")
     return options
 
 

@@ -184,36 +184,73 @@ def build_runtime_env(runtime_env: dict, h: str | None = None) -> dict:
 
 
 def _build_env_locked(runtime_env: dict, root: str, info: dict) -> None:
+    import shutil as _shutil
+
     pip_pkgs = runtime_env.get("pip")
-    if pip_pkgs:
+    uv_pkgs = runtime_env.get("uv")
+    if pip_pkgs and uv_pkgs:
+        raise ValueError("runtime_env: specify 'pip' OR 'uv', not both")
+    if pip_pkgs or uv_pkgs:
         venv_dir = os.path.join(root, "venv")
         vpython = os.path.join(venv_dir, "bin", "python")
         marker = os.path.join(venv_dir, ".ready")
+        have_uv = _shutil.which("uv") is not None
+        use_uv = bool(uv_pkgs) and have_uv
+        if uv_pkgs and not have_uv:
+            # Degrade to pip with the same package list rather than
+            # fail the lease on hosts without the uv binary — LOUDLY:
+            # pip's resolver can pin different versions for the same
+            # specs, so heterogeneous clusters would otherwise build
+            # divergent envs under one env hash with no trace.
+            print(
+                f"ray_tpu runtime_env: uv binary not found on this "
+                f"node; building {uv_pkgs} with pip instead (resolver "
+                f"may differ across nodes)",
+                flush=True,
+            )
+            pip_pkgs = uv_pkgs
         if not os.path.exists(marker):
             os.makedirs(root, exist_ok=True)
-            # --clear: a crash mid-build leaves no marker; rebuild
-            # from scratch. --system-site-packages: jax & friends
+            # --clear / fresh dir: a crash mid-build leaves no marker;
+            # rebuild from scratch. system-site-packages: jax & friends
             # come from the image, only the requested deps layer on.
-            subprocess.run(
-                [
-                    sys.executable, "-m", "venv", "--clear",
-                    "--system-site-packages", venv_dir,
-                ],
-                check=True,
-                capture_output=True,
-            )
-            cmd = [vpython, "-m", "pip", "install",
-                   "--no-warn-script-location"]
+            if use_uv:
+                # uv venv has no --clear: remove and recreate.
+                _shutil.rmtree(venv_dir, ignore_errors=True)
+                proc = subprocess.run(
+                    [
+                        "uv", "venv", "--system-site-packages",
+                        "--python", sys.executable, venv_dir,
+                    ],
+                    capture_output=True,
+                    text=True,
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"runtime_env uv venv failed:\n{proc.stderr[-2000:]}"
+                    )
+                cmd = ["uv", "pip", "install", "--python", vpython]
+            else:
+                subprocess.run(
+                    [
+                        sys.executable, "-m", "venv", "--clear",
+                        "--system-site-packages", venv_dir,
+                    ],
+                    check=True,
+                    capture_output=True,
+                )
+                cmd = [vpython, "-m", "pip", "install",
+                       "--no-warn-script-location"]
             if runtime_env.get("pip_no_index"):
                 cmd.append("--no-index")
             if runtime_env.get("pip_find_links"):
                 cmd += ["--find-links", runtime_env["pip_find_links"]]
-            cmd += list(pip_pkgs)
+            cmd += list(uv_pkgs if use_uv else pip_pkgs)
             proc = subprocess.run(cmd, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(
-                    f"runtime_env pip install failed:\n"
-                    f"{proc.stderr[-2000:]}"
+                    f"runtime_env {'uv' if use_uv else 'pip'} install "
+                    f"failed:\n{proc.stderr[-2000:]}"
                 )
             with open(marker, "w") as f:
                 f.write("ok")
@@ -517,7 +554,9 @@ class NodeManager:
         if bucket:
             return bucket.pop()
         if runtime_env and (
-            runtime_env.get("pip") or runtime_env.get("working_dir")
+            runtime_env.get("pip")
+            or runtime_env.get("uv")
+            or runtime_env.get("working_dir")
         ):
             # Build the isolated env (venv + staged working dir) OFF the
             # event loop; cached per env hash, so only the first lease

@@ -160,3 +160,52 @@ def test_working_dir_staging(cluster, tmp_path):
 
     data, who = ray_tpu.get(read_both.remote(), timeout=120)
     assert data == "hello-wd" and who == "staged"
+
+
+def test_uv_env_isolation(cluster, tmp_path):
+    """uv-built envs (reference: the runtime_env uv plugin,
+    _private/runtime_env/uv.py): same contract as pip — the env's
+    workers import the package, plain workers don't — but resolved and
+    installed by uv."""
+    import shutil
+
+    if shutil.which("uv") is None:
+        import pytest as _pytest
+
+        _pytest.skip("uv binary not available")
+    wheels = _build_tiny_wheel(tmp_path, name="uvmod", value=77)
+    renv = {
+        "uv": ["uvmod"],
+        "pip_no_index": True,
+        "pip_find_links": wheels,
+    }
+
+    @ray_tpu.remote(runtime_env=renv)
+    def with_dep():
+        import uvmod
+
+        return uvmod.VALUE
+
+    assert ray_tpu.get(with_dep.remote(), timeout=120) == 77
+
+    @ray_tpu.remote
+    def without_dep():
+        try:
+            import uvmod  # noqa: F401
+
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert ray_tpu.get(without_dep.remote(), timeout=60) == "isolated"
+
+
+def test_pip_and_uv_mutually_exclusive(cluster, tmp_path):
+    from ray_tpu.runtime.node import build_runtime_env
+
+    with pytest.raises(ValueError, match="not both"):
+        build_runtime_env({"pip": ["a"], "uv": ["b"]})
+
+    # And the same spec fails FAST at submission, before scheduling.
+    with pytest.raises(ValueError, match="not both"):
+        ray_tpu.remote(runtime_env={"pip": ["a"], "uv": ["b"]})(lambda: 1)

@@ -38,15 +38,23 @@ def usage_stats() -> dict:
         "collected_at": time.time(),
         "libraries": sorted(_lib_usages),
     }
-    try:
-        import jax
+    # Device info is recorded ONLY if this process already created a jax
+    # backend. Probing otherwise would initialize libtpu here and take its
+    # exclusive chip lock — fatal when called from the head daemon, which
+    # must leave the chips for the workers (accelerators/tpu.py detects
+    # chips without a backend for the same reason).
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        record["jax_version"] = jax_mod.__version__
+        try:
+            from jax._src import xla_bridge
 
-        record["jax_version"] = jax.__version__
-        record["backend"] = jax.default_backend()
-        record["device_count"] = jax.device_count()
-        record["device_kind"] = jax.devices()[0].device_kind
-    except Exception:  # noqa: BLE001 - jax may be uninitializable here
-        pass
+            if xla_bridge._backends:  # backend exists; probing is free
+                record["backend"] = jax_mod.default_backend()
+                record["device_count"] = jax_mod.device_count()
+                record["device_kind"] = jax_mod.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 - internal layout may shift
+            pass
     try:
         from ray_tpu import api as core_api
 

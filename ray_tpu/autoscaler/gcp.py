@@ -55,48 +55,67 @@ class GcpTransport:
     """Minimal authorized REST transport (the AuthorizedHttp analogue,
     reference node.py:240)."""
 
-    def __init__(self, token_provider: Callable[[], str] | None = None):
+    def __init__(
+        self,
+        token_provider: Callable[[], "str | tuple[str, float]"] | None = None,
+    ):
         self._token_provider = token_provider or self._default_token
         self._token: str | None = None
         self._token_expiry = 0.0
 
     @staticmethod
-    def _default_token() -> str:
+    def _default_token() -> tuple[str, float]:
         import os
 
         env = os.environ.get("GOOGLE_OAUTH_ACCESS_TOKEN")
         if env:
-            return env
+            return env, 600.0
         req = urllib.request.Request(
             _METADATA_TOKEN_URL, headers={"Metadata-Flavor": "Google"}
         )
         with urllib.request.urlopen(req, timeout=10) as resp:
-            return json.loads(resp.read())["access_token"]
+            payload = json.loads(resp.read())
+        return payload["access_token"], float(payload.get("expires_in", 600))
 
     def _bearer(self) -> str:
         if self._token is None or time.time() > self._token_expiry:
-            self._token = self._token_provider()
-            self._token_expiry = time.time() + 600
+            got = self._token_provider()
+            # Providers may return a bare token or (token, expires_in).
+            token, expires_in = got if isinstance(got, tuple) else (got, 600.0)
+            self._token = token
+            # Honor the server's actual lifetime, minus a safety margin so
+            # a token fetched near expiry isn't cached past its death.
+            self._token_expiry = time.time() + max(expires_in - 60.0, 10.0)
         return self._token
+
+    def _invalidate_token(self) -> None:
+        self._token = None
+        self._token_expiry = 0.0
 
     def request(
         self, method: str, url: str, body: dict | None = None
     ) -> dict:
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            url,
-            data=data,
-            method=method,
-            headers={
-                "Authorization": f"Bearer {self._bearer()}",
-                "Content-Type": "application/json",
-            },
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                payload = resp.read()
-        except urllib.error.HTTPError as e:
-            raise GcpHttpError(e.code, e.read().decode("utf-8", "replace"))
+        for attempt in (0, 1):
+            req = urllib.request.Request(
+                url,
+                data=data,
+                method=method,
+                headers={
+                    "Authorization": f"Bearer {self._bearer()}",
+                    "Content-Type": "application/json",
+                },
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    payload = resp.read()
+                break
+            except urllib.error.HTTPError as e:
+                if e.code == 401 and attempt == 0:
+                    # Stale cached token: drop it and retry once fresh.
+                    self._invalidate_token()
+                    continue
+                raise GcpHttpError(e.code, e.read().decode("utf-8", "replace"))
         return json.loads(payload) if payload else {}
 
 

@@ -150,7 +150,7 @@ async function draw(){nav();
   $(`<table><tr><th>node</th><th>addr</th><th>agent</th><th>total</th>
    <th>available</th><th>labels</th></tr>`+ns.map(n=>
    `<tr><td>${esc(n.node_id.slice(0,12))}</td><td>${esc(n.addr)}</td>
-   <td>${n.agent_addr?`<a href="http://${esc(n.agent_addr)}/api/stats">${esc(n.agent_addr)}</a>`:"—"}</td>
+   <td>${n.agent_addr?(n.agent_addr.startsWith("127.")||n.agent_addr.startsWith("localhost")?esc(n.agent_addr)+" (loopback)":`<a href="http://${esc(n.agent_addr)}/api/stats">${esc(n.agent_addr)}</a>`):"—"}</td>
    <td>${esc(JSON.stringify(n.resources))}</td>
    <td>${esc(JSON.stringify(n.available))}</td>
    <td class="mut">${esc(JSON.stringify(n.labels||{}))}</td></tr>`).join("")+"</table>")}

@@ -255,11 +255,25 @@ def cmd_start(args) -> int:
             if os.path.exists(addr_path):
                 addr = open(addr_path).read().strip()
                 print(f"head started at {addr} (pid {proc.pid})")
-                prefix = f"RAY_TPU_AUTH_TOKEN={token} " if token else ""
+                # Only print the literal secret to an interactive terminal;
+                # in CI/scripts it would land in captured logs, so show a
+                # placeholder pointing at the 0600 token file instead.
+                if token and sys.stdout.isatty():
+                    prefix = f"RAY_TPU_AUTH_TOKEN={token} "
+                    token_note = ""
+                elif token:
+                    # $(cat ...) only resolves on the joining host after
+                    # the operator copies auth.token there — say so.
+                    prefix = f"RAY_TPU_AUTH_TOKEN=$(cat {token_path}) "
+                    token_note = " (copy auth.token over first)"
+                else:
+                    prefix = ""
+                    token_note = ""
                 tls_note = " --tls (copy tls.crt over first)" if args.tls else ""
                 print(
                     f"join other hosts with: {prefix}python -m "
-                    f"ray_tpu.scripts start --address {addr}{tls_note}"
+                    f"ray_tpu.scripts start --address {addr}"
+                    f"{tls_note}{token_note}"
                 )
                 if token:
                     print(f"auth token: {token_path} (0600)")

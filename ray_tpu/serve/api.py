@@ -130,7 +130,7 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     if name not in status_map:
         raise ValueError(f"no application named {name!r}")
     route_table = ray_tpu.get(controller.get_route_table.remote())
-    for _route, (app, ingress) in route_table.items():
+    for _route, (app, ingress, *_rest) in route_table.items():
         if app == name:
             return DeploymentHandle(ingress, name)
     raise ValueError(f"application {name!r} has no ingress")

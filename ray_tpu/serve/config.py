@@ -25,6 +25,12 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 5
+    # Proxy-enforced deadline for requests routed to this deployment
+    # (None → proxy default, 60s). For unary requests this bounds the
+    # whole call; for streaming responses it is a per-item idle deadline
+    # (the gap between yields), not an end-to-end cap. Reference:
+    # Serve's request_timeout_s in HTTPOptions (serve/config.py).
+    request_timeout_s: float | None = None
     autoscaling_config: AutoscalingConfig | None = None
     ray_actor_options: dict = field(default_factory=dict)
     user_config: dict | None = None
@@ -33,6 +39,7 @@ class DeploymentConfig:
         return {
             "num_replicas": self.num_replicas,
             "max_ongoing_requests": self.max_ongoing_requests,
+            "request_timeout_s": self.request_timeout_s,
             "autoscaling": None
             if self.autoscaling_config is None
             else vars(self.autoscaling_config),

@@ -146,10 +146,17 @@ class ServeController:
         return True
 
     def get_route_table(self):
-        return {
-            app["route_prefix"]: (name, app["ingress"])
-            for name, app in self._apps.items()
-        }
+        """prefix → (app, ingress, request_timeout_s|None). The timeout
+        is the ingress deployment's request_timeout_s so the proxy can
+        enforce a per-deployment deadline without extra RPCs."""
+        table = {}
+        for name, app in self._apps.items():
+            dep = self._deployments.get((name, app["ingress"]))
+            timeout = (
+                dep["config"].get("request_timeout_s") if dep else None
+            )
+            table[app["route_prefix"]] = (name, app["ingress"], timeout)
+        return table
 
     def get_status(self):
         out = {}

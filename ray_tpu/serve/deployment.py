@@ -25,6 +25,7 @@ class Deployment:
         name: str | None = None,
         num_replicas: int | None = None,
         max_ongoing_requests: int | None = None,
+        request_timeout_s: float | None = None,
         autoscaling_config: AutoscalingConfig | dict | None = None,
         ray_actor_options: dict | None = None,
         user_config: dict | None = None,
@@ -34,6 +35,10 @@ class Deployment:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if request_timeout_s is not None:
+            if request_timeout_s <= 0:
+                raise ValueError("request_timeout_s must be positive")
+            cfg.request_timeout_s = request_timeout_s
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)

@@ -35,9 +35,20 @@ from ray_tpu.serve.handle import (
 
 _ROUTE_TTL_S = 2.0
 _REQUEST_TIMEOUT_S = 60.0
-_MAX_BODY = 512 * 1024 * 1024
+_BODY_READ_TIMEOUT_S = 30.0
+_MAX_BODY = 64 * 1024 * 1024
+_MAX_INFLIGHT = 256
+_HEX = frozenset(b"0123456789abcdefABCDEF")
 
-_REASONS = {200: "OK", 404: "Not Found", 408: "Timeout", 500: "Internal"}
+_REASONS = {
+    200: "OK",
+    400: "Bad Request",
+    404: "Not Found",
+    408: "Timeout",
+    413: "Payload Too Large",
+    500: "Internal",
+    503: "Service Unavailable",
+}
 
 
 def _sse_frame(item) -> bytes:
@@ -57,14 +68,29 @@ def _chunk(data: bytes) -> bytes:
     return b"%x\r\n%s\r\n" % (len(data), data)
 
 
+class _BodyTooLarge(Exception):
+    pass
+
+
 class ProxyActor:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._routes: dict[str, tuple] = {}  # prefix → (app, ingress)
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_body_bytes: int = _MAX_BODY,
+        max_inflight: int = _MAX_INFLIGHT,
+    ):
+        # prefix → (app, ingress, request_timeout_s|None)
+        self._routes: dict[str, tuple] = {}
         self._handles: dict[str, DeploymentHandle] = {}
         self._routes_ts = 0.0
         self._controller = None
         self._server: asyncio.AbstractServer | None = None
-        self._stats = {"requests": 0, "streams": 0, "errors": 0}
+        self._max_body = max_body_bytes
+        self._max_inflight = max_inflight
+        self._inflight = 0
+        self._stats = {"requests": 0, "streams": 0, "errors": 0,
+                       "rejected": 0}
         # Actor __init__ runs on the executor thread; the server must
         # live on the runtime loop where handle calls are native.
         from ray_tpu import api as core_api
@@ -130,13 +156,16 @@ class ProxyActor:
                 return prefix
         return None
 
-    def _handle_for(self, match: str) -> DeploymentHandle:
-        app_name, ingress = self._routes[match]
+    def _handle_for(self, match: str) -> tuple[DeploymentHandle, float]:
+        app_name, ingress, *rest = self._routes[match]
+        timeout = (
+            rest[0] if rest and rest[0] is not None else _REQUEST_TIMEOUT_S
+        )
         handle = self._handles.get(app_name)
         if handle is None or handle.deployment_name != ingress:
             handle = DeploymentHandle(ingress, app_name)
             self._handles[app_name] = handle
-        return handle
+        return handle, timeout
 
     # ------------------------------------------------------- connection
     async def _handle_conn(self, reader, writer):
@@ -179,24 +208,84 @@ class ProxyActor:
                 break
             if b":" in line:
                 k, v = line.decode("latin-1").split(":", 1)
-                headers[k.strip().lower()] = v.strip()
-        try:
-            n = int(headers.get("content-length", 0) or 0)
-        except ValueError:
-            await self._respond(writer, 500, b"bad content-length")
-            return False
-        body = b""
-        if n:
-            if n > _MAX_BODY:
-                await self._respond(writer, 500, b"body too large")
-                return False
-            body = await reader.readexactly(n)
-        keep_alive = (
-            headers.get("connection", "").lower() != "close"
-            and version != "HTTP/1.0"
-        )
-
+                k = k.strip().lower()
+                v = v.strip()
+                if k in headers:
+                    # RFC 9110 field-line merging; Cookie is special-cased
+                    # per RFC 6265 (semicolon-joined, order preserved).
+                    sep = "; " if k == "cookie" else ", "
+                    headers[k] = headers[k] + sep + v
+                else:
+                    headers[k] = v
+        # Shed load BEFORE buffering the body: the cap must bound body
+        # memory, not just dispatch concurrency, so the slot is claimed
+        # here and held through the body read. The unread body forces
+        # Connection: close on the 503 (reading it would be the buffering
+        # we're avoiding; not reading it would desync keep-alive).
         self._stats["requests"] += 1
+        if self._inflight >= self._max_inflight:
+            self._stats["rejected"] += 1
+            await self._respond(writer, 503, b"proxy at capacity", False)
+            return False
+        self._inflight += 1
+        released = False
+
+        def release() -> None:
+            # The slot guards buffered-body memory + dispatch concurrency.
+            # Streams release it at dispatch (they buffer nothing after
+            # the body), so the decrement must be idempotent.
+            nonlocal released
+            if not released:
+                released = True
+                self._inflight -= 1
+
+        try:
+            if "chunked" in headers.get("transfer-encoding", "").lower():
+                # Decode the chunked body fully; leaving it unread would
+                # desync the keep-alive stream (request-smuggling vector
+                # behind another HTTP intermediary). The read deadline
+                # stops a stalled sender from pinning this slot forever.
+                try:
+                    body = await asyncio.wait_for(
+                        self._read_chunked(reader), _BODY_READ_TIMEOUT_S
+                    )
+                except _BodyTooLarge:
+                    await self._respond(writer, 413, b"body too large")
+                    return False
+                except (ValueError, asyncio.TimeoutError):
+                    await self._respond(writer, 400, b"bad chunked encoding")
+                    return False
+            else:
+                try:
+                    n = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    await self._respond(writer, 400, b"bad content-length")
+                    return False
+                body = b""
+                if n:
+                    if n > self._max_body:
+                        await self._respond(writer, 413, b"body too large")
+                        return False
+                    try:
+                        body = await asyncio.wait_for(
+                            reader.readexactly(n), _BODY_READ_TIMEOUT_S
+                        )
+                    except asyncio.TimeoutError:
+                        await self._respond(writer, 408, b"body read timeout")
+                        return False
+            keep_alive = (
+                headers.get("connection", "").lower() != "close"
+                and version != "HTTP/1.0"
+            )
+            return await self._dispatch(
+                writer, method, target, headers, body, keep_alive, release
+            )
+        finally:
+            release()
+
+    async def _dispatch(
+        self, writer, method, target, headers, body, keep_alive, release
+    ) -> bool:
         # Everything below must produce an HTTP response, never a bare
         # connection drop (streaming manages its own error framing).
         try:
@@ -230,14 +319,18 @@ class ProxyActor:
                 or query.get("stream", "").lower() in ("1", "true")
                 or (isinstance(payload, dict) and bool(payload.get("stream")))
             )
-            handle = self._handle_for(match)
+            handle, timeout_s = self._handle_for(match)
             if want_stream:
                 self._stats["streams"] += 1
+                # A long-lived stream buffers nothing after this point;
+                # holding the slot for its whole duration would let 256
+                # legitimate SSE clients starve every unary request.
+                release()
                 return await self._respond_stream(
-                    writer, handle, request, keep_alive
+                    writer, handle, request, keep_alive, timeout_s
                 )
             result = await asyncio.wait_for(
-                handle.remote(request), _REQUEST_TIMEOUT_S
+                handle.remote(request), timeout_s
             )
             if isinstance(result, bytes):
                 out = result
@@ -271,8 +364,46 @@ class ProxyActor:
         )
         await writer.drain()
 
+    async def _read_chunked(self, reader) -> bytes:
+        """Decode a chunked request body (RFC 9112 §7.1), bounded by the
+        proxy body cap; trailer fields are read and discarded."""
+        parts: list[bytes] = []
+        total = 0
+        while True:
+            size_line = await reader.readline()
+            if not size_line:
+                raise ValueError("eof in chunk size")
+            token = size_line.split(b";")[0].strip()
+            # Strict HEXDIG only (RFC 9112 §7.1): int(x, 16) would also
+            # accept '0x10'/'+10'/'1_0', forms another parser in front of
+            # us may read differently — the exact desync this decoder is
+            # here to prevent.
+            if not token or any(c not in _HEX for c in token):
+                raise ValueError("bad chunk size")
+            size = int(token, 16)
+            if size == 0:
+                break
+            total += size
+            if total > self._max_body:
+                raise _BodyTooLarge()
+            parts.append(await reader.readexactly(size))
+            if await reader.readexactly(2) != b"\r\n":
+                raise ValueError("missing chunk terminator")
+        for _ in range(64):  # trailer section ends at an empty line
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        else:
+            raise ValueError("unterminated trailer section")
+        return b"".join(parts)
+
     async def _respond_stream(
-        self, writer, handle: DeploymentHandle, request: dict, keep_alive: bool
+        self,
+        writer,
+        handle: DeploymentHandle,
+        request: dict,
+        keep_alive: bool,
+        timeout_s: float = _REQUEST_TIMEOUT_S,
     ) -> bool:
         """Stream the handle call as SSE over chunked transfer encoding.
         Headers are written only once the first item (or first error)
@@ -300,10 +431,27 @@ class ProxyActor:
                 # slot) forever.
                 try:
                     item = await asyncio.wait_for(
-                        agen.__anext__(), _REQUEST_TIMEOUT_S
+                        agen.__anext__(), timeout_s
                     )
                 except StopAsyncIteration:
                     break
+                except asyncio.TimeoutError:
+                    self._stats["errors"] += 1
+                    await agen.aclose()
+                    if not started:
+                        # Mirror the unary path: a pre-first-item timeout
+                        # is a clean 408, not an empty 500.
+                        await self._respond(
+                            writer, 408, b"request timed out", keep_alive
+                        )
+                        return keep_alive
+                    err = json.dumps({"error": "stream item timed out"})
+                    writer.write(
+                        _chunk(f"event: error\ndata: {err}\n\n".encode())
+                        + b"0\r\n\r\n"
+                    )
+                    await writer.drain()
+                    return False
                 if not started:
                     started = True
                     writer.write(_sse_headers())

@@ -43,13 +43,15 @@ def test_start_head_join_stop(tmp_path):
     assert out.returncode == 0, out.stdout + out.stderr
     addr = open(os.path.join(d_head, "head.addr")).read().strip()
 
-    # Auth is on by default: the head generated a token (0600) and put
-    # it in the printed join command.
+    # Auth is on by default: the head generated a token (0600). The join
+    # command references it WITHOUT leaking the literal secret to a
+    # non-TTY stdout (captured logs must never contain the token).
     token_path = os.path.join(d_head, "auth.token")
     assert os.path.exists(token_path)
     assert os.stat(token_path).st_mode & 0o777 == 0o600
     token = open(token_path).read().strip()
-    assert token and f"RAY_TPU_AUTH_TOKEN={token}" in out.stdout
+    assert token and token not in out.stdout
+    assert f"RAY_TPU_AUTH_TOKEN=$(cat {token_path})" in out.stdout
 
     from ray_tpu._private import config as _config
 

@@ -349,3 +349,59 @@ def test_autoscaler_drives_gke_provider(monkeypatch):
     scaler.update()  # idle_since set on first tick, reaped on second
     assert not provider._nodes
     t.assert_done()
+
+
+def test_transport_token_expiry_and_401_refresh(monkeypatch):
+    """The bearer cache honors the provider's expires_in (minus margin)
+    and a 401 invalidates the cached token before one retry."""
+    import urllib.error
+
+    from ray_tpu.autoscaler.gcp import GcpTransport
+
+    tokens = iter([("tok-1", 120.0), ("tok-2", 3600.0), ("tok-3", 3600.0)])
+    fetched = []
+
+    def provider():
+        t = next(tokens)
+        fetched.append(t[0])
+        return t
+
+    tr = GcpTransport(token_provider=provider)
+    assert tr._bearer() == "tok-1"
+    assert tr._bearer() == "tok-1"  # cached
+    import time as _time
+
+    # 120s lifetime - 60s margin: expired after 61s.
+    real_now = _time.time()
+    monkeypatch.setattr(_time, "time", lambda: real_now + 100)
+    assert tr._bearer() == "tok-2"
+    assert fetched == ["tok-1", "tok-2"]
+
+    # A 401 response invalidates the cache and retries once fresh.
+    calls = []
+
+    def fake_urlopen(req, timeout=0):
+        calls.append(req.headers["Authorization"])
+        if len(calls) == 1:
+            raise urllib.error.HTTPError(
+                req.full_url, 401, "unauthorized", {}, None
+            )
+
+        class R:
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                return False
+
+            def read(self):
+                return b'{"ok": true}'
+
+        return R()
+
+    import urllib.request as _ur
+
+    monkeypatch.setattr(_ur, "urlopen", fake_urlopen)
+    out = tr.request("GET", "https://example.invalid/x")
+    assert out == {"ok": True}
+    assert calls == ["Bearer tok-2", "Bearer tok-3"]

@@ -48,6 +48,7 @@ class _Request:
     position: int = 0  # index the NEXT token will be written at
     last_token: int = 0
     done: bool = False
+    pages: list = field(default_factory=list)  # paged mode: block table
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -67,6 +68,9 @@ class LLMEngine:
         mesh=None,
         params=None,
         seed: int = 0,
+        kv: str = "paged",  # "paged" (block-table pool) | "dense" (slab)
+        page_size: int = 64,
+        num_pages: int | None = None,
     ):
         cfg = PRESETS[model] if isinstance(model, str) else model
         self.cfg = cfg
@@ -80,15 +84,49 @@ class LLMEngine:
 
             params = shard_pytree(params, mesh, param_logical_axes(cfg))
         self.params = params
-        self.cache = init_kv_cache(cfg, max_batch, self.max_seq)
+        if kv not in ("paged", "dense"):
+            raise ValueError(f"kv must be 'paged' or 'dense', got {kv!r}")
+        self.kv = kv
+        self.page_size = page_size
 
         # Flash prefill on a bare TPU backend; under a mesh the dense
         # path keeps XLA's SPMD partitioner in charge.
         use_flash = mesh is None and jax.default_backend() == "tpu"
-        self._prefill = jax.jit(
-            partial(forward_prefill, cfg=cfg, use_flash=use_flash)
-        )
-        self._decode = jax.jit(partial(forward_decode, cfg=cfg))
+        if kv == "paged":
+            from ray_tpu.llm.paged_kv import (
+                PageAllocator,
+                init_paged_kv,
+                paged_decode,
+                paged_prefill,
+            )
+
+            # Default token budget matches the dense slab so existing
+            # callers see identical capacity; serving deployments pass a
+            # smaller num_pages to run memory-bound admission (the
+            # point: many variable-length requests share one budget).
+            if num_pages is None:
+                num_pages = max(
+                    (max_batch * self.max_seq) // page_size, max_batch
+                )
+            self.alloc = PageAllocator(num_pages, page_size)
+            # +1: physical page 0 is the allocator's dump page.
+            self.cache = init_paged_kv(cfg, num_pages + 1, page_size)
+            self.max_pages_per_seq = -(-self.max_seq // page_size)
+            self._prefill_paged = partial(paged_prefill, cfg=cfg)
+            self._decode_paged = partial(paged_decode, cfg=cfg)
+            self._step_key = jax.random.key(seed)
+            self._temps = np.zeros((max_batch,), np.float32)
+        else:
+            self.cache = init_kv_cache(cfg, max_batch, self.max_seq)
+            # donate the cache slab: without donation every functional
+            # .at[].set update forces XLA to copy the whole cache.
+            self._prefill = jax.jit(
+                partial(forward_prefill, cfg=cfg, use_flash=use_flash),
+                donate_argnums=(2,),
+            )
+            self._decode = jax.jit(
+                partial(forward_decode, cfg=cfg), donate_argnums=(2,)
+            )
         self._queue: list[_Request] = []
         self._active: dict[int, _Request] = {}  # slot → request
         self._free = list(range(max_batch))
@@ -172,10 +210,21 @@ class LLMEngine:
         if req.slot in self._active:
             del self._active[req.slot]
             self._free.append(req.slot)
+        self._release_pages(req)
         return True
+
+    def _release_pages(self, req: _Request) -> None:
+        if self.kv == "paged":
+            for pg in req.pages:
+                self.alloc.release(pg)
+            req.pages = []
 
     def _admit(self, finished: list[dict]) -> None:
         while self._queue and self._free:
+            if self.kv == "paged":
+                if not self._admit_one_paged(finished):
+                    return
+                continue
             req = self._queue.pop(0)
             slot = self._free.pop(0)
             pad = min(_bucket(len(req.prompt)), self.max_seq)
@@ -185,22 +234,85 @@ class LLMEngine:
                 self.params, jnp.asarray(tokens), self.cache,
                 jnp.int32(slot),
             )
-            last = np.asarray(logits[0, len(req.prompt) - 1])
-            req.slot = slot
-            req.position = len(req.prompt)
-            req.last_token = self._sample(last, req.sampling)
-            req.out_tokens.append(req.last_token)
-            if req.request_id in self._stream_ids:
-                self._deltas.setdefault(req.request_id, []).append(
-                    req.last_token
-                )
-            self._active[slot] = req
-            # The prefill-sampled token can already hit max_tokens=1 or a
-            # stop token; finishing here frees the slot for this _admit
-            # loop itself.
-            if not self._finish_if_done(req, finished):
-                self._tokens[slot, 0] = req.last_token
-                self._positions[slot] = req.position
+            self._post_prefill(req, slot, logits, finished)
+
+    def _post_prefill(self, req, slot, logits, finished) -> None:
+        """Shared dense/paged tail of admission: sample the first token
+        from the prompt's last logits, activate, run stop checks."""
+        last = np.asarray(logits[0, len(req.prompt) - 1])
+        req.slot = slot
+        req.position = len(req.prompt)
+        req.last_token = self._sample(last, req.sampling)
+        req.out_tokens.append(req.last_token)
+        if req.request_id in self._stream_ids:
+            self._deltas.setdefault(req.request_id, []).append(
+                req.last_token
+            )
+        self._active[slot] = req
+        # The prefill-sampled token can already hit max_tokens=1 or a
+        # stop token; finishing here frees the slot for this _admit
+        # loop itself.
+        if not self._finish_if_done(req, finished):
+            self._tokens[slot, 0] = req.last_token
+            self._positions[slot] = req.position
+            if self.kv == "paged":
+                self._temps[slot] = req.sampling.temperature
+
+    def _admit_one_paged(self, finished: list[dict]) -> bool:
+        """Admit the head of the queue if its pages fit the pool —
+        MEMORY-bound admission (the dense engine is slot-bound). Returns
+        False when the pool cannot hold the next request yet."""
+        from ray_tpu.llm.paged_kv import prefix_hashes
+
+        P = self.page_size
+        req = self._queue[0]
+        pad = min(
+            max(_bucket(len(req.prompt)), P),
+            self.max_pages_per_seq * P,
+        )
+        need_pages = pad // P
+        # Prefix sharing: leading FULL pages whose token prefix matches a
+        # live page are reused (refcounted), not re-allocated.
+        hashes = prefix_hashes(req.prompt, P)
+        shared: list[int] = []
+        for h in hashes:
+            pg = self.alloc.lookup_prefix(h)
+            if pg is None:
+                break
+            shared.append(pg)
+        if need_pages > self.alloc.num_pages:
+            # Would never fit even with the pool empty — a config error,
+            # not backpressure; failing loud beats spinning forever.
+            self._queue.pop(0)
+            raise RuntimeError(
+                f"prompt needs {need_pages} pages but the pool holds "
+                f"{self.alloc.num_pages}; raise num_pages or page_size"
+            )
+        if need_pages - len(shared) > self.alloc.free_pages:
+            return False
+        self._queue.pop(0)
+        slot = self._free.pop(0)
+        pages = [self.alloc.share(pg) for pg in shared]
+        for i in range(len(shared), need_pages):
+            pg = self.alloc.alloc()
+            if i < len(hashes):
+                self.alloc.register_prefix(hashes[i], pg)
+            pages.append(pg)
+        req.pages = pages
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, : len(req.prompt)] = req.prompt
+        # Prefill rewrites shared pages with byte-identical values (K/V
+        # at position i depend only on tokens <= i) — idempotent, so no
+        # write mask is needed.
+        logits, self.cache = self._prefill_paged(
+            self.params,
+            jnp.asarray(tokens),
+            self.cache,
+            jnp.asarray(np.asarray(pages, np.int32)),
+            n_write_pages=need_pages,
+        )
+        self._post_prefill(req, slot, logits, finished)
+        return True
 
     def step(self) -> list[dict]:
         """Admit + one decode step. Returns finished request dicts."""
@@ -208,6 +320,9 @@ class LLMEngine:
         with self._lock:
             self._admit(finished)
             if not self._active:
+                return finished
+            if self.kv == "paged":
+                self._step_paged(finished)
                 return finished
 
             logits, self.cache = self._decode(
@@ -219,15 +334,79 @@ class LLMEngine:
             logits = np.asarray(logits)
             for slot, req in list(self._active.items()):
                 tok = self._sample(logits[slot], req.sampling)
-                req.position += 1
-                req.out_tokens.append(tok)
-                if req.request_id in self._stream_ids:
-                    self._deltas.setdefault(req.request_id, []).append(tok)
-                req.last_token = tok
-                self._tokens[slot, 0] = tok
-                self._positions[slot] = req.position
-                self._finish_if_done(req, finished)
+                self._record_token(req, tok, finished)
         return finished
+
+    def _record_token(self, req, tok: int, finished: list[dict]) -> None:
+        req.position += 1
+        req.out_tokens.append(tok)
+        if req.request_id in self._stream_ids:
+            self._deltas.setdefault(req.request_id, []).append(tok)
+        req.last_token = tok
+        self._tokens[req.slot, 0] = tok
+        self._positions[req.slot] = req.position
+        self._finish_if_done(req, finished)
+
+    def _preempt(self, req: _Request) -> None:
+        """vLLM-style recompute preemption: fold generated tokens into
+        the prompt, free the pages + slot, and requeue at the FRONT so
+        the request resumes (via re-prefill) as soon as memory frees."""
+        self._release_pages(req)
+        if req.slot in self._active:
+            del self._active[req.slot]
+            self._free.append(req.slot)
+        req.prompt = list(req.prompt) + list(req.out_tokens)
+        req.slot = -1
+        self._queue.insert(0, req)
+
+    def _step_paged(self, finished: list[dict]) -> None:
+        P = self.page_size
+        # Grow block tables for slots whose next token starts a new page;
+        # exhausted pool → preempt the youngest active request (last
+        # inserted into _active) until the page fits.
+        for slot, req in list(self._active.items()):
+            if req.slot == -1 or req.done:
+                continue
+            if req.position % P == 0 and req.position // P == len(req.pages):
+                while self.alloc.free_pages == 0:
+                    victims = [
+                        r for r in self._active.values() if r is not req
+                    ]
+                    if not victims:
+                        self._preempt(req)
+                        break
+                    self._preempt(victims[-1])
+                else:
+                    req.pages.append(self.alloc.alloc())
+        if not self._active:
+            return
+
+        tables = np.full(
+            (self.max_batch, self.max_pages_per_seq), -1, np.int32
+        )
+        for slot, req in self._active.items():
+            tables[slot, : len(req.pages)] = req.pages
+        self._step_key, sub = jax.random.split(self._step_key)
+        sampled, logits, self.cache = self._decode_paged(
+            self.params,
+            jnp.asarray(self._tokens),
+            self.cache,
+            jnp.asarray(tables),
+            jnp.asarray(self._positions),
+            jnp.asarray(self._temps),
+            sub,
+        )
+        sampled = np.asarray(sampled)  # [B] ints — the only transfer
+        host_logits = None
+        for slot, req in list(self._active.items()):
+            if req.sampling.top_k:
+                # top-k needs host logic; transfer logits lazily, once.
+                if host_logits is None:
+                    host_logits = np.asarray(logits)
+                tok = self._sample(host_logits[slot], req.sampling)
+            else:
+                tok = int(sampled[slot])
+            self._record_token(req, tok, finished)
 
     def abort_request(self, request_id: str) -> bool:
         """Drop a request (queued or active), freeing its slot — the
@@ -245,6 +424,7 @@ class LLMEngine:
                     r.done = True
                     del self._active[slot]
                     self._free.append(slot)
+                    self._release_pages(r)
                     return True
         return False
 

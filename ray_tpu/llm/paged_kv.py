@@ -1,0 +1,303 @@
+"""Paged KV cache: block-table attention over a fixed page pool.
+
+The dense cache (`llm/kv_cache.py`) allocates max_batch × max_seq slots
+up front, so HBM cost ignores actual sequence lengths. This module is
+the vLLM-style alternative the reference gets from its serving engine
+(reference: ray.llm passes engine_kwargs straight to vLLM,
+python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py:234 —
+block_size / num_gpu_blocks are vLLM's page knobs):
+
+- One **page pool** per layer: [L, num_pages, page_size, Hkv, Dh].
+  Capacity is a token budget (num_pages × page_size), independent of
+  how many requests share it or how long each runs.
+- A **block table** per request: the ordered list of page ids holding
+  its tokens. Tables live on the host (numpy, tiny) and ship to the
+  device each step as a [B, max_pages] int32 array.
+- **Decode** gathers each slot's pages (jnp.take along the page axis) and
+  runs masked attention over the gathered window — static shapes, XLA
+  fuses gather+attention; no pallas needed until page counts get large.
+- **Prefill** computes K/V with the normal dense program and scatters
+  them into freshly-allocated pages.
+- **Prefix sharing**: full pages whose token prefix hashes equal an
+  existing page's are refcounted and reused instead of re-written —
+  identical prompt heads across requests occupy one set of pages
+  (memory dedup; compute dedup via chunked prefill is future work).
+
+TPU-first notes: everything under jit has static shapes — the gather
+width is the per-call max_pages bucket, masked per-slot by true length.
+Pool pages are never zeroed on free; stale data is unreachable because
+attention masks beyond each slot's length and tables are host-owned.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+_NEG_INF = -2.0e38
+
+PagedKV = dict[str, jnp.ndarray]  # {"k","v": [L, num_pages, P, Hkv, Dh]}
+
+
+def init_paged_kv(
+    cfg: LlamaConfig, num_pages: int, page_size: int = 64
+) -> PagedKV:
+    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+class PageAllocator:
+    """Host-side page bookkeeping: free list, per-page refcounts, and the
+    prefix-hash → page map for sharing (reference capability: vLLM's
+    BlockSpaceManager + prefix caching)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        # `num_pages` counts USABLE pages. Physical page 0 is the DUMP
+        # page: inactive decode slots' table entries clamp to it, so
+        # their (discarded) writes land somewhere no request owns. The
+        # pool must therefore be created with num_pages + 1 physical
+        # pages (the engine does).
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(1, num_pages + 1))
+        self._refs = np.zeros(num_pages + 1, np.int32)
+        # prefix-hash → page id; hash covers ALL tokens up to and
+        # including this page (k/v of a position depend on the whole
+        # prefix, so equal hash ⇒ identical page contents).
+        self._prefix_pages: dict[int, int] = {}
+        self._page_hash: dict[int, int] = {}  # page id → its prefix hash
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def alloc(self) -> int:
+        page = self._free.pop()
+        self._refs[page] = 1
+        return page
+
+    def share(self, page: int) -> int:
+        self._refs[page] += 1
+        return page
+
+    def release(self, page: int) -> None:
+        self._refs[page] -= 1
+        if self._refs[page] == 0:
+            h = self._page_hash.pop(page, None)
+            if h is not None and self._prefix_pages.get(h) == page:
+                del self._prefix_pages[h]
+            self._free.append(page)
+
+    def lookup_prefix(self, prefix_hash: int) -> int | None:
+        return self._prefix_pages.get(prefix_hash)
+
+    def register_prefix(self, prefix_hash: int, page: int) -> None:
+        self._prefix_pages[prefix_hash] = page
+        self._page_hash[page] = prefix_hash
+
+
+def prefix_hashes(tokens: list[int], page_size: int) -> list[int]:
+    """One hash per FULL page, each covering tokens[0 : (i+1)*page]."""
+    out = []
+    for end in range(page_size, len(tokens) + 1, page_size):
+        out.append(hash(tuple(tokens[:end])))
+    return out
+
+
+# ------------------------------------------------------------- programs
+def _project_qkv(x, p, cfg):
+    b, s, _ = x.shape
+    dt = cfg.dtype
+    h = rms_norm(x, p["attn_norm"])
+    q = (h @ p["wq"].astype(dt)).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ p["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _mlp(x, p, cfg):
+    dt = cfg.dtype
+    h = rms_norm(x, p["mlp_norm"])
+    gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
+    up = h @ p["w_up"].astype(dt)
+    return x + (gate * up) @ p["w_down"].astype(dt)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_write_pages"),
+    donate_argnames=("pool",),
+)
+def paged_prefill(
+    params,
+    tokens: jnp.ndarray,  # [1, S_pad] int32
+    pool: PagedKV,
+    pages: jnp.ndarray,  # [n_write_pages] int32 page ids for this prompt
+    cfg: LlamaConfig,
+    n_write_pages: int,
+):
+    """Dense prompt pass; K/V scattered into `pages` of the pool.
+
+    S_pad must equal n_write_pages * page_size (caller pads). Shared
+    prefix pages may be EXCLUDED by passing only the tail pages and the
+    correspondingly page-aligned... — no: pages covers the whole padded
+    prompt; the engine passes shared pages' ids too and their content is
+    rewritten with identical values (write-once sharing would need a
+    scatter mask for marginal gain).
+    Returns (logits [1, S_pad, V] fp32, pool).
+    """
+    seq = tokens.shape[1]
+    page_size = pool["k"].shape[2]
+    cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+
+    from ray_tpu.ops.attention import causal_attention
+
+    def body(x, layer):
+        p, k_pool, v_pool = layer  # k_pool [num_pages, P, Hkv, Dh]
+        q, k, v = _project_qkv(x, p, cfg)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = causal_attention(q, k, v)
+        x = x + attn.reshape(x.shape) @ p["wo"].astype(cfg.dtype)
+        x = _mlp(x, p, cfg)
+        # [1, S, Hkv, Dh] → [n_pages, P, Hkv, Dh] scatter at page ids.
+        kp = k.astype(cfg.dtype).reshape(
+            n_write_pages, page_size, cfg.n_kv_heads, cfg.head_dim
+        )
+        vp = v.astype(cfg.dtype).reshape(
+            n_write_pages, page_size, cfg.n_kv_heads, cfg.head_dim
+        )
+        k_pool = k_pool.at[pages].set(kp)
+        v_pool = v_pool.at[pages].set(vp)
+        return x, (k_pool, v_pool)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], pool["k"], pool["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": k_pool, "v": v_pool}
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def paged_decode(
+    params,
+    tokens: jnp.ndarray,  # [B, 1] int32
+    pool: PagedKV,
+    block_tables: jnp.ndarray,  # [B, max_pages] int32 (-1 = unused)
+    positions: jnp.ndarray,  # [B] int32: position this token writes at
+    temperature: jnp.ndarray,  # [B] fp32 (0 = greedy)
+    rng_key: jnp.ndarray,
+    cfg: LlamaConfig,
+):
+    """One decode step over the page pool. Attention gathers each slot's
+    pages; the new K/V lands in page block_tables[b, pos // P] at offset
+    pos % P. Sampling happens ON DEVICE (greedy or temperature) — the
+    host receives [B] token ids, not [B, V] logits (the dense engine's
+    per-token logits transfer was its decode bottleneck).
+
+    Returns (sampled [B] int32, pool).
+    """
+    b = tokens.shape[0]
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]  # [B, 1, d]
+    page_size = pool["k"].shape[2]
+    max_pages = block_tables.shape[1]
+    window = max_pages * page_size
+    # RoPE table over the pool-capacity horizon for correct rotations.
+    cos, sin = rope_frequencies(cfg.head_dim, window, cfg.rope_theta)
+
+    key_idx = jnp.arange(window)[None, :]
+    mask = key_idx > positions[:, None]  # [B, window] True = masked
+
+    page_of = positions // page_size  # [B] page slot index
+    off_of = positions % page_size
+    # The physical page each slot's new token writes into. Inactive
+    # slots (table -1) clamp to the dump page 0 — their writes are
+    # discarded garbage nobody attends to.
+    write_page = jnp.maximum(
+        jnp.take_along_axis(block_tables, page_of[:, None], axis=1)[:, 0],
+        0,
+    )  # [B]
+
+    def body(x, layer):
+        p, k_pool, v_pool = layer
+        q, k, v = _project_qkv(x, p, cfg)  # [B,1,H,Dh]
+        pos2d = positions[:, None]
+        q = apply_rope(q, cos, sin, positions=pos2d)
+        k = apply_rope(k, cos, sin, positions=pos2d)
+
+        # Scatter the new token's K/V: one (page, offset) cell per slot.
+        k_pool = k_pool.at[write_page, off_of, :, :].set(
+            k[:, 0].astype(cfg.dtype)
+        )
+        v_pool = v_pool.at[write_page, off_of, :, :].set(
+            v[:, 0].astype(cfg.dtype)
+        )
+
+        # Gather each slot's window: [B, max_pages, P, Hkv, Dh]. Table
+        # entries of -1 (unused tail) clamp to 0 — harmless, masked.
+        tables = jnp.maximum(block_tables, 0)
+        kk = jnp.take(k_pool, tables, axis=0).reshape(
+            b, window, cfg.n_kv_heads, cfg.head_dim
+        )
+        vv = jnp.take(v_pool, tables, axis=0).reshape(
+            b, window, cfg.n_kv_heads, cfg.head_dim
+        )
+        n_rep = cfg.n_heads // cfg.n_kv_heads
+        kk = jnp.repeat(kk, n_rep, axis=2)
+        vv = jnp.repeat(vv, n_rep, axis=2)
+        scale = cfg.head_dim**-0.5
+        logits = (
+            jnp.einsum(
+                "bqhd,bkhd->bhqk", q, kk,
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )
+        logits = jnp.where(mask[:, None, None, :], _NEG_INF, logits)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        x = x + attn.reshape(b, 1, -1) @ p["wo"].astype(cfg.dtype)
+        x = _mlp(x, p, cfg)
+        return x, (k_pool, v_pool)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], pool["k"], pool["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = logits[:, 0]  # [B, V]
+
+    sampled = sample_on_device(logits, temperature, rng_key)
+    # logits ride along as a device array; the engine only transfers
+    # them for slots whose sampling needs host logic (top_k).
+    return sampled, logits, {"k": k_pool, "v": v_pool}
+
+
+def sample_on_device(
+    logits: jnp.ndarray,  # [B, V] fp32
+    temperature: jnp.ndarray,  # [B] fp32, 0 = greedy
+    rng_key: jnp.ndarray,
+) -> jnp.ndarray:
+    """Greedy / temperature sampling without shipping logits to host.
+    Both paths are computed and the per-slot temperature selects —
+    cheaper than a lax.cond at [B,V] widths and keeps one fused program."""
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    keys = jax.random.split(rng_key, logits.shape[0])
+    drawn = jax.vmap(jax.random.categorical)(keys, logits / temp)
+    return jnp.where(temperature > 0.0, drawn, greedy).astype(jnp.int32)

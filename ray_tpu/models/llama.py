@@ -95,9 +95,14 @@ PRESETS: dict[str, LlamaConfig] = {
     # 64: the MXU is 128 wide, so D=64 attention runs both kernel
     # matmuls at half width — same parameter count (h·D and hkv·D
     # unchanged), ~40% faster attention.
+    # remat="flash_qkv": keep the flash kernel's residuals (out+lse)
+    # AND its q/k/v inputs across the remat boundary — the backward
+    # replay skips the whole attention forward (kernel + projections +
+    # RoPE). ~97 MB/layer of residuals; measured +10% step throughput
+    # over full remat on v5e (PROFILE_r04.md).
     "bench": LlamaConfig(
         vocab_size=32768, d_model=1024, n_layers=24, n_heads=8, n_kv_heads=4,
-        d_ff=4096, max_seq=2048,
+        d_ff=4096, max_seq=2048, remat="flash_qkv",
     ),
     # Llama-3-8B (BASELINE.json config 3).
     "llama3_8b": LlamaConfig(),
@@ -257,6 +262,29 @@ def forward_with_aux(
             body,
             policy=jax.checkpoint_policies.save_only_these_names(
                 "attn_out"
+            ),
+        )
+    elif cfg.remat == "flash":
+        # Save the flash kernel's OWN residuals (its output + per-row
+        # logsumexp, tagged inside the kernel's custom-vjp fwd): the
+        # backward replay then rebuilds only norms/projections/FFN and
+        # never re-runs the forward attention kernel — the expensive,
+        # O(S^2) part of the recompute. Costs ~one [B,S,H,D] bf16 + one
+        # [B,H,S] fp32 residual per layer; everything else stays fully
+        # rematerialized.
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"
+            ),
+        )
+    elif cfg.remat == "flash_qkv":
+        # "flash" plus the attention INPUTS: the replay also skips the
+        # qkv projections + RoPE. ~2x the residual memory of "flash".
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse", "flash_qkv"
             ),
         )
     elif cfg.remat == "dots":

@@ -37,6 +37,9 @@ _MASK = -1e9
 # underflows to 0 (the first block's rescale factor) without -inf NaNs.
 _M_INIT = -1e30
 _LANES = 128  # TPU vector lane count: scratch stats are lane-replicated
+# Budget for the backward's whole-head dq VMEM slab (S·d·4 bytes); past
+# this the kernel switches to HBM fp32 partials (see _bwd_kernel).
+_DQ_SLAB_VMEM_BYTES = 4 * 1024 * 1024
 
 
 # --------------------------------------------------------------- forward
@@ -179,69 +182,45 @@ def _recompute_p(q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, masked):
     return jnp.exp(s - lse[:, None])
 
 
-def _dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-    *, block_q: int, block_kv: int, num_kv: int, scale: float, causal: bool,
+def _bwd_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dk_ref, dv_ref, dq_acc, dk_acc, dv_acc,
+    *, block_q: int, block_kv: int, num_q: int, num_kv: int, scale: float,
+    causal: bool, dq_slab: bool,
 ):
-    qi = pl.program_id(1)
-    ki = pl.program_id(2)
+    """One-pass fused backward: each (kv, q) block pair recomputes p ONCE
+    and feeds all three gradients — vs the previous two-kernel backward
+    this drops 2 of 7 per-pair MXU passes (the duplicated qk^T and
+    do·v^T) and one exp recompute. dk/dv accumulate in [block_kv, d]
+    scratch across the inner q sweep. dq has two modes:
 
-    @pl.when(ki == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    def _accumulate(masked: bool):
-        p = _recompute_p(
-            q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, masked
-        )
-        dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [block_q, block_kv] fp32
-        # q is pre-scaled, so d(score)/d(q_scaled) needs no extra scale
-        # here; the chain-rule factor lands once in _finalize.
-        ds = p * (dp - delta_ref[0, 0][:, None])
-        acc_ref[...] += jax.lax.dot(
-            ds.astype(k_ref.dtype), k_ref[0],
-            preferred_element_type=jnp.float32,
-        )
-
-    if causal:
-        crossed = jnp.logical_and(
-            ki * block_kv < (qi + 1) * block_q,
-            (ki + 1) * block_kv - 1 > qi * block_q,
-        )
-        below = (ki + 1) * block_kv - 1 <= qi * block_q
-
-        @pl.when(crossed)
-        def _masked():
-            _accumulate(True)
-
-        @pl.when(below)
-        def _unmasked():
-            _accumulate(False)
-    else:
-        _accumulate(False)
-
-    @pl.when(ki == num_kv - 1)
-    def _finalize():
-        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
-
-
-def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_acc, dv_acc,
-    *, block_q: int, block_kv: int, num_q: int, causal: bool,
-):
-    ki = pl.program_id(1)  # NOTE: kv outer, q inner for this kernel
+    - dq_slab=True (short/medium seq): dq accumulates in a FULL [S, d]
+      fp32 VMEM slab (1 MB at S=2048·d=128) persisting across the whole
+      kv sweep of one head — no HBM partials exist.
+    - dq_slab=False (long seq, slab would blow VMEM): each (kv, q) pair
+      writes its fp32 dq contribution to a [num_kv, BH, S, d] partials
+      output (every block written exactly once — the expanded-output
+      pattern of the public splash kernels) and the caller sums over
+      the leading axis."""
+    ki = pl.program_id(1)  # kv outer, q inner
     qi = pl.program_id(2)
+    q_slice = pl.ds(qi * block_q, block_q)
 
     @pl.when(qi == 0)
-    def _init():
+    def _init_kv():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    def _accumulate(masked: bool):
+    if dq_slab:
+        @pl.when(ki == 0)
+        def _init_dq():
+            # ki==0 visits every q block (the first kv block is never
+            # causal-skipped), so each slice zeroes exactly once a head.
+            dq_acc[q_slice, :] = jnp.zeros(
+                (block_q, dq_acc.shape[1]), jnp.float32
+            )
+
+    def _compute(masked: bool):
         p = _recompute_p(
             q_ref, k_ref, lse_ref, qi, ki, block_q, block_kv, masked
         )
@@ -256,6 +235,16 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0, 0][:, None])
+        # dq contribution: ds @ k (q is pre-scaled; the chain-rule scale
+        # lands once — at slab write-out, or per partial here).
+        contrib = jax.lax.dot(
+            ds.astype(k_ref.dtype), k_ref[0],
+            preferred_element_type=jnp.float32,
+        )
+        if dq_slab:
+            dq_acc[q_slice, :] += contrib
+        else:
+            dq_ref[0, 0] = contrib * scale
         # dk += ds^T @ q_scaled — exactly scale·dsᵀ@q, the chain-rule
         # factor rides the pre-scaled q.
         dk_acc[...] += jax.lax.dot_general(
@@ -265,24 +254,35 @@ def _dkv_kernel(
 
     if causal:
         # q blocks entirely before this kv block see none of it.
+        overlaps = (qi + 1) * block_q > ki * block_kv
         crossed = jnp.logical_and(
-            (qi + 1) * block_q > ki * block_kv,
-            (ki + 1) * block_kv - 1 > qi * block_q,
+            overlaps, (ki + 1) * block_kv - 1 > qi * block_q
         )
         below = jnp.logical_and(
-            (qi + 1) * block_q > ki * block_kv,
-            (ki + 1) * block_kv - 1 <= qi * block_q,
+            overlaps, (ki + 1) * block_kv - 1 <= qi * block_q
         )
 
         @pl.when(crossed)
         def _masked():
-            _accumulate(True)
+            _compute(True)
 
         @pl.when(below)
         def _unmasked():
-            _accumulate(False)
+            _compute(False)
+
+        if not dq_slab:
+            # Skipped pairs still own a partials block; the output
+            # window holds stale VMEM unless written.
+            @pl.when(jnp.logical_not(overlaps))
+            def _skipped():
+                dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
     else:
-        _accumulate(False)
+        _compute(False)
+
+    if dq_slab:
+        # The dq output block (indexed by qi) is flushed at every visit;
+        # only the final kv sweep's value survives, with the full sum.
+        dq_ref[0] = (dq_acc[q_slice, :] * scale).astype(dq_ref.dtype)
 
     @pl.when(qi == num_q - 1)
     def _finalize():
@@ -308,8 +308,11 @@ def _flash_impl(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(
+    q, k, v, causal, scale, block_q, block_kv,
+    bwd_block_q, bwd_block_kv, interpret,
+):
     out, _ = _flash_impl(
         q, k, v, causal, scale, block_q, block_kv, interpret
     )
@@ -317,18 +320,42 @@ def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _flash_fwd(
+    q, k, v, causal, scale, block_q, block_kv,
+    bwd_block_q, bwd_block_kv, interpret,
+):
     out, lse = _flash_impl(
         q, k, v, causal, scale, block_q, block_kv, interpret
     )
     b, s, h, d = q.shape
+    # Residual tags: under jax.checkpoint, a policy that saves
+    # "flash_out"/"flash_lse" keeps these across the remat boundary, so
+    # the backward replay rebuilds only the (cheap) projections and
+    # SKIPS re-running the forward flash kernel — the models' remat
+    # mode "flash" (models/llama.py) is built on exactly this.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    # Separately-named q/k/v residual tags let a policy ALSO pin the
+    # attention inputs (skipping the projection/RoPE recompute) at
+    # ~2x the memory of flash_out alone.
+    q = checkpoint_name(q, "flash_qkv")
+    k = checkpoint_name(k, "flash_qkv")
+    v = checkpoint_name(v, "flash_qkv")
     return (
         out.reshape(b, h, s, d).transpose(0, 2, 1, 3),
         (q, k, v, out, lse),
     )
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
+def _flash_bwd(
+    causal, scale, block_q, block_kv, bwd_block_q, bwd_block_kv,
+    interpret, res, g,
+):
+    # The backward sweep has its own optimum (smaller q blocks pipeline
+    # the 5-matmul body better than the forward's fatter tiles).
+    block_q, block_kv = bwd_block_q, bwd_block_kv
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     hkv = k.shape[2]
@@ -348,59 +375,59 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, res, g):
     delta = delta[:, None, :]  # [BH, 1, S] to match the lse layout
 
     num_q, num_kv = s // block_q, s // block_kv
-    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
-    kv_spec_dq = pl.BlockSpec(
-        (1, block_kv, d),
-        lambda bh, qi, ki, n_rep=n_rep: (bh // n_rep, ki, 0),
-    )
-    row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, qi, ki: (bh, 0, qi))
-
-    dq = pl.pallas_call(
-        functools.partial(
-            _dq_kernel, block_q=block_q, block_kv=block_kv, num_kv=num_kv,
-            scale=scale, causal=causal,
-        ),
-        grid=(b * h, num_q, num_kv),
-        in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(qr, kr, vr, do, lse, delta)
-
-    # dk/dv: kv blocks outer, q blocks inner (accumulate over q). The
+    # Fused one-pass backward: kv blocks outer, q blocks inner. dk/dv
     # OUTPUTS are per-q-head (grid over B*H) and group-summed below —
     # only they need the n_rep expansion, not the k/v inputs.
-    q_spec2 = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
-    kv_in_spec2 = pl.BlockSpec(
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, ki, qi: (bh, qi, 0))
+    kv_in_spec = pl.BlockSpec(
         (1, block_kv, d),
         lambda bh, ki, qi, n_rep=n_rep: (bh // n_rep, ki, 0),
     )
-    kv_out_spec2 = pl.BlockSpec(
+    kv_out_spec = pl.BlockSpec(
         (1, block_kv, d), lambda bh, ki, qi: (bh, ki, 0)
     )
-    row_spec2 = pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi))
-    dk_e, dv_e = pl.pallas_call(
+    row_spec = pl.BlockSpec((1, 1, block_q), lambda bh, ki, qi: (bh, 0, qi))
+    # The VMEM dq slab scales with S; past the budget (seq ~8k at d=128)
+    # fall back to HBM fp32 partials summed outside the kernel (measured
+    # ~2% slower at bench shapes; the slab path wins where it fits).
+    dq_slab = s * d * 4 <= _DQ_SLAB_VMEM_BYTES
+    if dq_slab:
+        dq_spec = pl.BlockSpec(
+            (1, block_q, d), lambda bh, ki, qi: (bh, qi, 0)
+        )
+        dq_shape = jax.ShapeDtypeStruct((b * h, s, d), q.dtype)
+        dq_scratch = pltpu.VMEM((s, d), jnp.float32)  # whole-head slab
+    else:
+        dq_spec = pl.BlockSpec(
+            (1, 1, block_q, d), lambda bh, ki, qi: (ki, bh, qi, 0)
+        )
+        dq_shape = jax.ShapeDtypeStruct((num_kv, b * h, s, d), jnp.float32)
+        dq_scratch = pltpu.VMEM((8, d), jnp.float32)  # unused dummy
+    dq, dk_e, dv_e = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, block_kv=block_kv, num_q=num_q,
-            causal=causal,
+            _bwd_kernel, block_q=block_q, block_kv=block_kv, num_q=num_q,
+            num_kv=num_kv, scale=scale, causal=causal, dq_slab=dq_slab,
         ),
         grid=(b * h, num_kv, num_q),
         in_specs=[
-            q_spec2, kv_in_spec2, kv_in_spec2, q_spec2, row_spec2, row_spec2
+            q_spec, kv_in_spec, kv_in_spec, q_spec, row_spec, row_spec
         ],
-        out_specs=[kv_out_spec2, kv_out_spec2],
+        out_specs=[dq_spec, kv_out_spec, kv_out_spec],
         out_shape=[
+            dq_shape,
             jax.ShapeDtypeStruct((b * h, s, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, s, d), v.dtype),
         ],
         scratch_shapes=[
+            dq_scratch,
             pltpu.VMEM((block_kv, d), jnp.float32),
             pltpu.VMEM((block_kv, d), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr, do, lse, delta)
 
+    if not dq_slab:
+        dq = dq.sum(0).astype(q.dtype)
     dq = dq.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     # Sum each kv group's n_rep expanded gradients back to Hkv heads.
     dk = (
@@ -419,6 +446,11 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # exported so gating code derives fitted blocks from the SAME value the
 # kernel will use (llm/kv_cache.py).
 DEFAULT_BLOCK = 1024
+# Backward-sweep tiles (fused one-pass kernel), tuned separately on v5e
+# at the bench shapes — the 5-matmul body pipelines best with narrower
+# q tiles than the forward.
+DEFAULT_BWD_BLOCK_Q = 1024
+DEFAULT_BWD_BLOCK_KV = 1024
 
 
 def _fit_block(requested: int, s: int) -> int:
@@ -435,7 +467,10 @@ def _fit_block(requested: int, s: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_kv", "interpret", "scale"),
+    static_argnames=(
+        "causal", "block_q", "block_kv", "bwd_block_q", "bwd_block_kv",
+        "interpret", "scale",
+    ),
 )
 def flash_attention(
     q: jnp.ndarray,  # [B, S, H, D]
@@ -453,9 +488,12 @@ def flash_attention(
     # of magnitude away from this; interpret=True adds an assertion.
     # DEFAULT_BLOCK (1024/1024) measured fastest on v5e at seq 2048
     # (27ms vs 36ms fwd+bwd for the old 256/512 at B16·H16·D64); blocks
-    # clamp to the sequence for short inputs.
+    # clamp to the sequence for short inputs. The fused backward prefers
+    # its own (narrower-q) tiles — None inherits the forward blocks.
     block_q: int = DEFAULT_BLOCK,
     block_kv: int = DEFAULT_BLOCK,
+    bwd_block_q: int | None = DEFAULT_BWD_BLOCK_Q,
+    bwd_block_kv: int | None = DEFAULT_BWD_BLOCK_KV,
     interpret: bool = False,
 ) -> jnp.ndarray:
     b, s, h, d = q.shape
@@ -468,6 +506,8 @@ def flash_attention(
     # lengths degrade hard — perf-sensitive callers gate on _fit_block).
     block_q = _fit_block(block_q, s)
     block_kv = _fit_block(block_kv, s)
+    bwd_block_q = _fit_block(bwd_block_q or block_q, s)
+    bwd_block_kv = _fit_block(bwd_block_kv or block_kv, s)
     # A tiny fitted block (prime-ish seq) means orders-of-magnitude
     # slower Pallas tiles than the MXU-friendly sizes — warn instead of
     # silently cliffing (trace-time only; jit caches per static shape).
@@ -506,7 +546,10 @@ def flash_attention(
                 )
 
         jax.debug.callback(_host_check, bound)
-    return _flash(q, k, v, causal, scale, block_q, block_kv, interpret)
+    return _flash(
+        q, k, v, causal, scale, block_q, block_kv,
+        bwd_block_q, bwd_block_kv, interpret,
+    )
 
 
 def make_flash_attention(mesh, batch_axes=("dp", "fsdp"), head_axis="tp"):

@@ -52,6 +52,17 @@ def test_microbench_floors():
     assert bcast["agg_GB_s"] >= 0.02, (
         f"broadcast regressed: {bcast['agg_GB_s']} GB/s aggregate"
     )
+    llm = next(
+        (r for r in results if r["name"].startswith("llm paged decode")),
+        None,
+    )
+    assert llm is not None, "benchmark 'llm paged decode' missing"
+    # CPU CI floor: the tiny-model engine pumps well over 30 tok/s on
+    # the dev box CPU; 5 catches structural regressions (per-step
+    # recompiles, full-logits host transfers, allocator churn).
+    assert llm["tokens_per_s"] >= 5.0, (
+        f"paged decode regressed: {llm['tokens_per_s']} tok/s"
+    )
     gloo = next(
         (r for r in results if r["name"].startswith("allreduce gloo")),
         None,

@@ -158,13 +158,28 @@ class LLMEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} >= max_seq {self.max_seq}"
             )
+        sampling = sampling or SamplingParams()
+        if self.kv == "paged":
+            # Reject requests the pool could NEVER hold (prompt plus its
+            # full max_tokens growth) at submission — admitting one and
+            # crashing mid-decode would take every in-flight request
+            # down with it.
+            P = self.page_size
+            worst = min(len(prompt) + sampling.max_tokens, self.max_seq)
+            pad = min(
+                max(_bucket(worst), P), self.max_pages_per_seq * P
+            )
+            if pad // P > self.alloc.num_pages:
+                raise ValueError(
+                    f"prompt+max_tokens needs {pad // P} pages but the "
+                    f"pool holds {self.alloc.num_pages}; raise num_pages "
+                    "or lower max_tokens"
+                )
         rid = request_id or f"req-{next(self._ids)}"
         with self._lock:
             if stream:
                 self._stream_ids.add(rid)
-            self._queue.append(
-                _Request(rid, list(prompt), sampling or SamplingParams())
-            )
+            self._queue.append(_Request(rid, list(prompt), sampling))
         return rid
 
     def has_unfinished(self) -> bool:
@@ -234,14 +249,16 @@ class LLMEngine:
                 self.params, jnp.asarray(tokens), self.cache,
                 jnp.int32(slot),
             )
-            self._post_prefill(req, slot, logits, finished)
+            self._post_prefill(req, slot, logits, len(req.prompt), finished)
 
-    def _post_prefill(self, req, slot, logits, finished) -> None:
-        """Shared dense/paged tail of admission: sample the first token
-        from the prompt's last logits, activate, run stop checks."""
-        last = np.asarray(logits[0, len(req.prompt) - 1])
+    def _post_prefill(self, req, slot, logits, ctx_len, finished) -> None:
+        """Shared dense/paged tail of admission: sample the next token
+        from the context's last logits, activate, run stop checks.
+        ctx_len is the true (unpadded) prefilled length — prompt plus
+        any tokens generated before a preemption."""
+        last = np.asarray(logits[0, ctx_len - 1])
         req.slot = slot
-        req.position = len(req.prompt)
+        req.position = ctx_len
         req.last_token = self._sample(last, req.sampling)
         req.out_tokens.append(req.last_token)
         if req.request_id in self._stream_ids:
@@ -266,14 +283,17 @@ class LLMEngine:
 
         P = self.page_size
         req = self._queue[0]
+        # Full context: the prompt plus anything generated before a
+        # preemption (recompute-style resume). req.prompt stays pristine.
+        context = list(req.prompt) + list(req.out_tokens)
         pad = min(
-            max(_bucket(len(req.prompt)), P),
+            max(_bucket(len(context)), P),
             self.max_pages_per_seq * P,
         )
         need_pages = pad // P
         # Prefix sharing: leading FULL pages whose token prefix matches a
         # live page are reused (refcounted), not re-allocated.
-        hashes = prefix_hashes(req.prompt, P)
+        hashes = prefix_hashes(context, P)
         shared: list[int] = []
         for h in hashes:
             pg = self.alloc.lookup_prefix(h)
@@ -300,7 +320,7 @@ class LLMEngine:
             pages.append(pg)
         req.pages = pages
         tokens = np.zeros((1, pad), np.int32)
-        tokens[0, : len(req.prompt)] = req.prompt
+        tokens[0, : len(context)] = context
         # Prefill rewrites shared pages with byte-identical values (K/V
         # at position i depend only on tokens <= i) — idempotent, so no
         # write mask is needed.
@@ -311,7 +331,7 @@ class LLMEngine:
             jnp.asarray(np.asarray(pages, np.int32)),
             n_write_pages=need_pages,
         )
-        self._post_prefill(req, slot, logits, finished)
+        self._post_prefill(req, slot, logits, len(context), finished)
         return True
 
     def step(self) -> list[dict]:
@@ -348,14 +368,15 @@ class LLMEngine:
         self._finish_if_done(req, finished)
 
     def _preempt(self, req: _Request) -> None:
-        """vLLM-style recompute preemption: fold generated tokens into
-        the prompt, free the pages + slot, and requeue at the FRONT so
-        the request resumes (via re-prefill) as soon as memory frees."""
+        """vLLM-style recompute preemption: free the pages + slot and
+        requeue at the FRONT; re-admission prefills the request's full
+        context (prompt + generated so far), so generation resumes
+        exactly where it stopped. req.prompt itself is never mutated —
+        finished dicts must echo the prompt the caller submitted."""
         self._release_pages(req)
         if req.slot in self._active:
             del self._active[req.slot]
             self._free.append(req.slot)
-        req.prompt = list(req.prompt) + list(req.out_tokens)
         req.slot = -1
         self._queue.insert(0, req)
 
@@ -399,8 +420,10 @@ class LLMEngine:
         sampled = np.asarray(sampled)  # [B] ints — the only transfer
         host_logits = None
         for slot, req in list(self._active.items()):
-            if req.sampling.top_k:
+            if req.sampling.top_k and req.sampling.temperature > 0:
                 # top-k needs host logic; transfer logits lazily, once.
+                # (top_k with temperature 0 IS greedy — the on-device
+                # argmax already answered it; don't ship [B,V] for it.)
                 if host_logits is None:
                     host_logits = np.asarray(logits)
                 tok = self._sample(host_logits[slot], req.sampling)

@@ -118,22 +118,9 @@ def prefix_hashes(tokens: list[int], page_size: int) -> list[int]:
 
 
 # ------------------------------------------------------------- programs
-def _project_qkv(x, p, cfg):
-    b, s, _ = x.shape
-    dt = cfg.dtype
-    h = rms_norm(x, p["attn_norm"])
-    q = (h @ p["wq"].astype(dt)).reshape(b, s, cfg.n_heads, cfg.head_dim)
-    k = (h @ p["wk"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ p["wv"].astype(dt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
-    return q, k, v
-
-
-def _mlp(x, p, cfg):
-    dt = cfg.dtype
-    h = rms_norm(x, p["mlp_norm"])
-    gate = jax.nn.silu(h @ p["w_gate"].astype(dt))
-    up = h @ p["w_up"].astype(dt)
-    return x + (gate * up) @ p["w_down"].astype(dt)
+# One source of truth for the per-layer blocks: divergence between the
+# paged and dense cache paths would silently change decode results.
+from ray_tpu.llm.kv_cache import _mlp, _project_qkv  # noqa: E402
 
 
 @partial(
@@ -151,12 +138,10 @@ def paged_prefill(
 ):
     """Dense prompt pass; K/V scattered into `pages` of the pool.
 
-    S_pad must equal n_write_pages * page_size (caller pads). Shared
-    prefix pages may be EXCLUDED by passing only the tail pages and the
-    correspondingly page-aligned... — no: pages covers the whole padded
-    prompt; the engine passes shared pages' ids too and their content is
-    rewritten with identical values (write-once sharing would need a
-    scatter mask for marginal gain).
+    S_pad must equal n_write_pages * page_size (caller pads). `pages`
+    covers the WHOLE padded prompt including shared-prefix pages: their
+    content is rewritten with byte-identical values (K/V at position i
+    depend only on tokens <= i), so sharing needs no scatter mask.
     Returns (logits [1, S_pad, V] fp32, pool).
     """
     seq = tokens.shape[1]

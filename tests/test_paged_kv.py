@@ -144,12 +144,41 @@ def test_preemption_under_pool_pressure(params):
     assert tight.alloc.free_pages == 4
 
 
-def test_pool_too_small_raises(params):
+def test_double_preemption_resumes_correctly(params):
+    """A request preempted TWICE must not duplicate context (regression:
+    folding out_tokens into prompt on each preemption re-folded tokens)
+    and must report its ORIGINAL prompt when finished."""
+    sp = SamplingParams(max_tokens=24)
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10], [11, 12, 13]]
+    tight = LLMEngine(
+        CFG, max_batch=3, max_seq=64, params=params,
+        kv="paged", page_size=8, num_pages=6,
+    )
+    order = {tight.add_request(p, sp): i for i, p in enumerate(prompts)}
+    outs: list = [None] * 3
+    reported_prompts: list = [None] * 3
+    while tight.has_unfinished():
+        for fin in tight.step():
+            outs[order[fin["request_id"]]] = fin["tokens"]
+            reported_prompts[order[fin["request_id"]]] = fin["prompt"]
+    assert reported_prompts == prompts  # prompts never mutated
+    roomy = LLMEngine(CFG, max_batch=3, max_seq=64, params=params,
+                      kv="paged", page_size=8)
+    assert outs == roomy.generate(prompts, sp)
+
+
+def test_pool_too_small_rejected_at_submission(params):
     engine = LLMEngine(CFG, max_batch=1, max_seq=64, params=params,
                        kv="paged", page_size=8, num_pages=1)
-    engine.add_request(list(range(1, 30)), SamplingParams(max_tokens=2))
-    with pytest.raises(RuntimeError, match="pages"):
-        engine.step()
+    with pytest.raises(ValueError, match="pages"):
+        engine.add_request(list(range(1, 30)), SamplingParams(max_tokens=2))
+    # A request that fits prompt-wise but not with its growth is also
+    # rejected up front (admitting it would crash mid-decode).
+    engine2 = LLMEngine(CFG, max_batch=1, max_seq=64, params=params,
+                        kv="paged", page_size=8, num_pages=3)
+    with pytest.raises(ValueError, match="pages"):
+        engine2.add_request([1, 2, 3, 4, 5, 6, 7, 8],
+                            SamplingParams(max_tokens=30))
 
 
 def test_on_device_temperature_sampling(params):

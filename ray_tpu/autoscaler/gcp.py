@@ -18,9 +18,18 @@ TPU-native differences here:
   detect_labels probes GCE metadata (node.py _gce_metadata_labels) and
   registers it as a node label, which runtime_node_id matches against
   the head's node table. node_pool mode cannot stamp per-increment
-  metadata (setSize is anonymous): inject ``runtime_lookup`` (e.g.
-  keyed on GKE node labels) or rely on the autoscaler's boot-grace
-  accounting.
+  metadata (setSize is anonymous); instead the daemon registers its
+  GCE instance name (``ray-tpu-gce-instance`` label) and the provider
+  ids carry the instance name when the pool exposes its instance
+  groups, so scale-down can target the exact idle instance.
+
+``queued_resource`` is the RECOMMENDED mode: creation is atomic (one
+QR per create) and deletion names the slice. ``node_pool`` rides
+GKE's setSize, whose read-modify-write is guarded here by a per-pool
+lock, conflict retry, and a post-resize verification re-read; when
+the pool response carries ``instanceGroupUrls``, scale-down uses the
+managed-instance-group ``deleteInstances`` call on the specific
+victim instead of an anonymous shrink.
 
 Auth rides a bearer token: ``GOOGLE_OAUTH_ACCESS_TOKEN`` env when set
 (CI/dev), else the GCE metadata server (in-cluster). CI never talks to
@@ -30,6 +39,7 @@ Google: tests drive the provider through RecordedTransport fixtures.
 from __future__ import annotations
 
 import json
+import threading
 import time
 import urllib.request
 import uuid
@@ -49,6 +59,25 @@ class GcpHttpError(RuntimeError):
     def __init__(self, status: int, body: str):
         super().__init__(f"HTTP {status}: {body[:500]}")
         self.status = status
+        self.body = body
+
+    def is_conflict(self) -> bool:
+        """GKE rejects a mutation while another cluster operation is in
+        flight (409/412, or 400 FAILED_PRECONDITION whose message names
+        the running operation). These are safe to retry after a
+        re-read. Plain 400 validation errors are NOT retryable — only
+        the operation-in-flight phrasing qualifies."""
+        if self.status in (409, 412, 429):
+            return True
+        if self.status != 400:
+            return False
+        # Only the operation-in-flight phrasing qualifies; a permanent
+        # FAILED_PRECONDITION (pool managed by cluster autoscaling,
+        # pool being deleted, ...) must surface immediately.
+        body = self.body.lower()
+        return "operation" in body and (
+            "in progress" in body or "running" in body or "wait" in body
+        )
 
 
 class GcpTransport:
@@ -188,6 +217,7 @@ class GkeTpuNodeProvider(NodeProvider):
         transport=None,
         runtime_lookup: Callable[[str], str | None] | None = None,
         operation_poll_s: float = 2.0,
+        node_table_cache_s: float = 2.0,
     ):
         self.project = project
         self.location = location
@@ -196,6 +226,17 @@ class GkeTpuNodeProvider(NodeProvider):
         self.http = transport or GcpTransport()
         self._runtime_lookup = runtime_lookup
         self._poll_s = operation_poll_s
+        # One reconcile tick calls runtime_node_id once per tracked
+        # slice; fetching + scanning the whole node table each time is
+        # O(tracked x nodes) head RPCs. Cache a label index briefly.
+        self._node_cache_s = node_table_cache_s
+        self._label_index: dict[str, str] = {}
+        self._label_index_expiry = 0.0
+        # setSize is an absolute write: serialize our own resizes per
+        # pool so two concurrent reconciles cannot interleave their
+        # read-modify-write windows inside this process.
+        self._pool_locks: dict[str, threading.Lock] = {}
+        self._pool_locks_guard = threading.Lock()
         # provider_node_id → node_type cache of our own creations; the
         # authoritative list always comes from the API
         # (non_terminated_nodes), so a restarted provider process
@@ -241,6 +282,11 @@ class GkeTpuNodeProvider(NodeProvider):
             url = f"{_TPU_API}/{name}" if not name.startswith(
                 "http"
             ) else name
+        elif api == "compute":
+            # Compute zonal ops (deleteInstances) carry a selfLink.
+            url = op.get("selfLink") or name
+            if not url.startswith("http"):
+                return _check(op)
         else:
             url = (
                 f"{_GKE_API}/projects/{self.project}/locations/"
@@ -253,6 +299,94 @@ class GkeTpuNodeProvider(NodeProvider):
                 return _check(got)
             time.sleep(self._poll_s)
         raise TimeoutError(f"operation {name} not done in {timeout}s")
+
+    # ----------------------------------------------------- pool helpers
+    def _pool_lock(self, name: str) -> threading.Lock:
+        with self._pool_locks_guard:
+            return self._pool_locks.setdefault(name, threading.Lock())
+
+    @staticmethod
+    def _pool_count(got: dict) -> int:
+        return int(
+            got.get("currentNodeCount", got.get("initialNodeCount", 0))
+        )
+
+    def _list_pool_instances(
+        self, pool_resp: dict
+    ) -> "dict[str, tuple[str, str]] | None":
+        """instance name → (instance_url, igm_url) for every managed
+        instance backing the pool, or None when the pool response does
+        not expose instance groups (then ids stay slot-indexed and
+        scale-down falls back to an anonymous shrink)."""
+        igs = pool_resp.get("instanceGroupUrls")
+        if not igs:
+            return None
+        out: dict[str, tuple[str, str]] = {}
+        for ig in igs:
+            igm = ig.replace("/instanceGroups/", "/instanceGroupManagers/")
+            got = self.http.request("POST", f"{igm}/listManagedInstances")
+            for mi in got.get("managedInstances", []):
+                inst_url = mi.get("instance", "")
+                if inst_url:
+                    out[inst_url.rsplit("/", 1)[-1]] = (inst_url, igm)
+        return out
+
+    def _resize_pool(
+        self, name: str, delta: int, pre_read: dict | None = None
+    ) -> "tuple[int, dict]":
+        """Conflict-safe GET → setSize(current+delta) → verify re-read.
+
+        setSize is an absolute write, so the GET/POST window can lose a
+        concurrent increment (another reconcile, an operator's kubectl).
+        Three guards: a per-pool lock (in-process interleavings), retry
+        on GKE's operation-in-flight conflicts, and a post-resize
+        re-read — if the observed count moved the wrong way, the write
+        was clobbered and the whole read-modify-write retries from a
+        fresh read. Returns (size_before_our_write, verify_response).
+        """
+        with self._pool_lock(name):
+            last_exc: Exception | None = None
+            for attempt in range(4):
+                if attempt == 0 and pre_read is not None:
+                    got = pre_read
+                else:
+                    got = self.http.request("GET", self._gke_pool(name))
+                current = self._pool_count(got)
+                target = max(0, current + delta)
+                try:
+                    op = self.http.request(
+                        "POST",
+                        f"{self._gke_pool(name)}:setSize",
+                        {"nodeCount": target},
+                    )
+                except GcpHttpError as e:
+                    if e.is_conflict():
+                        last_exc = e
+                        time.sleep(self._poll_s * (attempt + 1))
+                        continue
+                    raise
+                self._wait_operation(op, "gke")
+                verify = self.http.request("GET", self._gke_pool(name))
+                observed = self._pool_count(verify)
+                # observed == current (our write apparently never
+                # happened) is the one unambiguous lost-update
+                # signature — retry from a fresh read. Any OTHER
+                # mismatch means a racing writer moved the count after
+                # our write landed; re-applying the delta would
+                # double-resize (e.g. delete a second node for one
+                # terminate), so accept the observed state and let the
+                # autoscaler's next reconcile tick correct any residual
+                # drift through non_terminated_nodes.
+                if observed != current or delta == 0:
+                    return current, verify
+                last_exc = RuntimeError(
+                    f"pool {name} resize lost: wrote {target}, "
+                    f"observed {observed}"
+                )
+                time.sleep(self._poll_s * (attempt + 1))
+            raise RuntimeError(
+                f"pool {name} resize failed after 4 attempts"
+            ) from last_exc
 
     # -------------------------------------------------------- provider
     def create_node(self, node_type: str, resources: dict) -> str:
@@ -303,18 +437,23 @@ class GkeTpuNodeProvider(NodeProvider):
         if mode == "node_pool":
             name = pool["pool"]
             got = self.http.request("GET", self._gke_pool(name))
-            current = int(
-                got.get("currentNodeCount", got.get("initialNodeCount", 0))
-            )
-            op = self.http.request(
-                "POST",
-                f"{self._gke_pool(name)}:setSize",
-                {"nodeCount": current + 1},
-            )
-            self._wait_operation(op, "gke")
-            # Pool members are fungible (GKE picks scale-down victims):
-            # ids are slot-indexed and derivable from the pool size, so
-            # a restarted provider reconstructs them from the API.
+            before = self._list_pool_instances(got)
+            current, verify = self._resize_pool(name, +1, pre_read=got)
+            if before is not None:
+                # Instance-backed id: the instance the resize added.
+                # With a racing scale-up several may be new — pick one
+                # deterministically so the id stays consistent with
+                # instance-named membership listing (a slot id here
+                # would never match non_terminated_nodes and the
+                # autoscaler would treat the node as failed).
+                after = self._list_pool_instances(verify) or {}
+                new = sorted(set(after) - set(before))
+                if new:
+                    pid = f"{name}#{new[0]}"
+                    self._nodes[pid] = node_type
+                    return pid
+            # No instance groups exposed: slot-indexed ids, derivable
+            # from the pool size, stable across provider restarts.
             pid = f"{name}#{current}"
             self._nodes[pid] = node_type
             return pid
@@ -325,21 +464,49 @@ class GkeTpuNodeProvider(NodeProvider):
         # a restarted provider no longer has): "<pool>#<i>" is a GKE
         # pool slot, anything else is a queued resource.
         if "#" in provider_node_id:
-            name = provider_node_id.split("#", 1)[0]
+            name, token = provider_node_id.split("#", 1)
             if name not in self._pool_types:
                 raise ValueError(
                     f"unknown node pool in id {provider_node_id!r}"
                 )
             got = self.http.request("GET", self._gke_pool(name))
-            current = int(
-                got.get("currentNodeCount", got.get("initialNodeCount", 0))
-            )
-            op = self.http.request(
-                "POST",
-                f"{self._gke_pool(name)}:setSize",
-                {"nodeCount": max(0, current - 1)},
-            )
-            self._wait_operation(op, "gke")
+            instances = self._list_pool_instances(got)
+            if instances is not None:
+                entry = instances.get(token)
+                if entry is None and token.isdigit():
+                    # Legacy slot id: map slot i to the i-th instance in
+                    # name order (the same order membership listing
+                    # would have assigned slots).
+                    names = sorted(instances)
+                    if int(token) < len(names):
+                        entry = instances[names[int(token)]]
+                if entry is not None:
+                    inst_url, igm = entry
+                    # Targeted removal: the MIG deletes THIS instance
+                    # and decrements the target size — GKE cannot pick
+                    # a busy slice as the victim.
+                    with self._pool_lock(name):
+                        op = self.http.request(
+                            "POST",
+                            f"{igm}/deleteInstances",
+                            {
+                                "instances": [inst_url],
+                                "skipInstancesOnValidationError": True,
+                            },
+                        )
+                        self._wait_operation(op, "compute")
+                else:
+                    # The named instance no longer exists: the terminate
+                    # already happened (retried call, provider restart).
+                    # An anonymous shrink here would delete an ARBITRARY
+                    # live instance — exactly what targeted scale-down
+                    # exists to prevent. Treat as done.
+                    pass
+                self._nodes.pop(provider_node_id, None)
+                return
+            # No instance groups exposed: anonymous conflict-safe shrink
+            # is the best the API offers.
+            self._resize_pool(name, -1, pre_read=got)
             self._nodes.pop(provider_node_id, None)
             return
         try:
@@ -386,37 +553,58 @@ class GkeTpuNodeProvider(NodeProvider):
                     continue
                 qr_id = qr["name"].rsplit("/", 1)[-1]
                 out[qr_id] = labels.get("ray-tpu-node-type", "")
-        # node_pool members synthesized from the LIVE pool size, so a
-        # restarted provider sees existing slices instead of re-adding
-        # (and later being unable to reap) them.
+        # node_pool members from the LIVE pool (instance names when the
+        # pool exposes its instance groups, else synthesized slots), so
+        # a restarted provider sees existing slices instead of
+        # re-adding (and later being unable to reap) them.
         for name, node_type in self._pool_types.items():
             got = self.http.request("GET", self._gke_pool(name))
-            count = int(
-                got.get("currentNodeCount", got.get("initialNodeCount", 0))
-            )
-            for i in range(count):
-                out[f"{name}#{i}"] = node_type
+            instances = self._list_pool_instances(got)
+            if instances is not None:
+                for inst in instances:
+                    out[f"{name}#{inst}"] = node_type
+            else:
+                for i in range(self._pool_count(got)):
+                    out[f"{name}#{i}"] = node_type
         return out
 
     def runtime_node_id(self, provider_node_id: str) -> str | None:
         """Map to the runtime node that registered from this slice: the
-        node's labels carry the provider id (GCE metadata →
-        detect_labels)."""
+        node's labels carry the provider id (queued_resource mode, GCE
+        metadata → detect_labels) or the GCE instance name (node_pool
+        mode). The node table is fetched once per cache window and
+        indexed by label, not rescanned per provider id."""
         if self._runtime_lookup is not None:
             return self._runtime_lookup(provider_node_id)
+        index = self._node_label_index()
+        hit = index.get(provider_node_id)
+        if hit is None and "#" in provider_node_id:
+            # node_pool ids carry the instance name after '#'.
+            hit = index.get(provider_node_id.split("#", 1)[1])
+        return hit
+
+    def _node_label_index(self) -> dict[str, str]:
+        """provider-id-label / gce-instance-label → runtime node id,
+        cached for node_table_cache_s (one head RPC per reconcile tick
+        instead of one per tracked slice)."""
+        now = time.monotonic()
+        if now < self._label_index_expiry:
+            return self._label_index
         try:
             from ray_tpu import api as core_api
 
             rt = core_api._runtime
             if not rt.ready:
-                return None
+                return {}
             table = rt.run(rt.core.head.call("node_table"), 5)
         except Exception:  # noqa: BLE001 - mapping is best-effort
-            return None
+            return {}
+        index: dict[str, str] = {}
         for nid, n in table.items():
-            if (
-                n.get("labels", {}).get("ray-tpu-provider-id")
-                == provider_node_id
-            ):
-                return nid
-        return None
+            labels = n.get("labels", {})
+            for key in ("ray-tpu-provider-id", "ray-tpu-gce-instance"):
+                if labels.get(key):
+                    index[labels[key]] = nid
+        self._label_index = index
+        self._label_index_expiry = now + self._node_cache_s
+        return index

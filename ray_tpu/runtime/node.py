@@ -1439,17 +1439,26 @@ def _gce_metadata_labels() -> dict[str, str]:
         return {}
     import urllib.request
 
-    try:
-        req = urllib.request.Request(
-            "http://metadata.google.internal/computeMetadata/v1/"
-            "instance/attributes/ray-tpu-provider-id",
-            headers={"Metadata-Flavor": "Google"},
-        )
-        with urllib.request.urlopen(req, timeout=2) as resp:
-            value = resp.read().decode().strip()
-        return {"ray-tpu-provider-id": value} if value else {}
-    except OSError:
-        return {}
+    labels: dict[str, str] = {}
+    base = "http://metadata.google.internal/computeMetadata/v1/instance/"
+    # node_pool-mode slices have no stamped provider id (setSize is
+    # anonymous); the instance NAME is what the provider's targeted
+    # scale-down and runtime_node_id match against instead.
+    for path, key in (
+        ("attributes/ray-tpu-provider-id", "ray-tpu-provider-id"),
+        ("name", "ray-tpu-gce-instance"),
+    ):
+        try:
+            req = urllib.request.Request(
+                base + path, headers={"Metadata-Flavor": "Google"}
+            )
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                value = resp.read().decode().strip()
+            if value:
+                labels[key] = value
+        except OSError:
+            pass
+    return labels
 
 
 def env_jax_platform() -> str:

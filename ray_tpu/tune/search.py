@@ -31,6 +31,7 @@ class LogUniform(Domain):
     def __init__(self, low: float, high: float):
         import math
 
+        self.low, self.high = low, high
         self.lo, self.hi = math.log(low), math.log(high)
 
     def sample(self, rng):

@@ -203,6 +203,11 @@ def test_gke_node_pool_resize_up_down():
                 "body_contains": ["3"],
                 "response": {"name": "op-up", "status": "DONE"},
             },
+            {  # post-resize verification re-read
+                "method": "GET",
+                "url": pool_url,
+                "response": {"currentNodeCount": 3},
+            },
             {
                 "method": "GET",
                 "url": f"{PARENT}/queuedResources",
@@ -223,6 +228,11 @@ def test_gke_node_pool_resize_up_down():
                 "url": f"{pool_url}:setSize",
                 "body_contains": ["2"],
                 "response": {"name": "op-down", "status": "DONE"},
+            },
+            {  # scale-down verification re-read
+                "method": "GET",
+                "url": pool_url,
+                "response": {"currentNodeCount": 2},
             },
         ]
     )
@@ -266,11 +276,178 @@ def test_pool_membership_survives_provider_restart():
                 "body_contains": ["1"],
                 "response": {"name": "op", "status": "DONE"},
             },
+            {  # scale-down verification re-read
+                "method": "GET",
+                "url": pool_url,
+                "response": {"currentNodeCount": 1},
+            },
         ]
     )
     members = p.non_terminated_nodes()
     assert members == {"tpu-pool#0": "gke-v5e", "tpu-pool#1": "gke-v5e"}
     p.terminate_node("tpu-pool#1")  # provider never created it itself
+    t.assert_done()
+
+
+def _pool_url():
+    return (
+        f"{GKE}/projects/proj/locations/us-central2-b/clusters/"
+        f"mycluster/nodePools/tpu-pool"
+    )
+
+
+def test_gke_setsize_lost_update_retries_from_fresh_read():
+    """A concurrent writer clobbers our setSize between write and
+    verify: the post-resize re-read observes the stale count and the
+    whole read-modify-write retries from a fresh read — the increment
+    is NOT silently lost (VERDICT r3 weak #4)."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2}},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["3"],
+             "response": {"name": "op1", "status": "DONE"}},
+            # Verify observes 2: an operator's concurrent setSize(2)
+            # overwrote ours. Retry re-reads and re-applies.
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2}},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2}},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["3"],
+             "response": {"name": "op2", "status": "DONE"}},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 3}},
+        ]
+    )
+    pid = p.create_node("gke-v5e", {"TPU": 8})
+    assert pid == "tpu-pool#2"
+    t.assert_done()
+
+
+def test_gke_setsize_conflict_rereads_before_retry():
+    """GKE's operation-in-flight conflict (409) triggers a re-read —
+    the retry bases its target on the NEW current count (another
+    reconcile's increment landed meanwhile), not the stale one."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2}},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["3"], "error_status": 409,
+             "error_body": "cluster is running an operation"},
+            # Fresh read sees the racing increment already applied.
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 3}},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["4"],
+             "response": {"name": "op", "status": "DONE"}},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 4}},
+        ]
+    )
+    pid = p.create_node("gke-v5e", {"TPU": 8})
+    assert pid == "tpu-pool#3"
+    t.assert_done()
+
+
+IG = (
+    "https://www.googleapis.com/compute/v1/projects/proj/zones/"
+    "us-central2-b/instanceGroups/gke-mycluster-tpu-pool-grp"
+)
+IGM = IG.replace("/instanceGroups/", "/instanceGroupManagers/")
+
+
+def _mi(names):
+    return {
+        "managedInstances": [
+            {"instance": f"{IGM.rsplit('/', 2)[0]}/instances/{n}"}
+            for n in names
+        ]
+    }
+
+
+def test_gke_targeted_scale_down_deletes_the_named_instance():
+    """When the pool exposes its instance groups, ids are instance
+    names, and terminate deletes THAT instance via the MIG — GKE
+    cannot pick a busy slice as the scale-down victim."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            # create: read pool (with IGs) → list before → resize →
+            # verify → list after; the diff names the new instance.
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 1,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa"])},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["2"],
+             "response": {"name": "op-up", "status": "DONE"}},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa", "gke-node-bbb"])},
+            # terminate(pool#gke-node-bbb): resolve → deleteInstances.
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa", "gke-node-bbb"])},
+            {"method": "POST", "url": f"{IGM}/deleteInstances",
+             "body_contains": ["gke-node-bbb"],
+             "response": {"name": "op-del", "status": "DONE"}},
+        ]
+    )
+    pid = p.create_node("gke-v5e", {"TPU": 8})
+    assert pid == "tpu-pool#gke-node-bbb"
+    p.terminate_node(pid)
+    assert pid not in p._nodes
+    t.assert_done()
+
+
+def test_gke_membership_lists_instance_backed_ids():
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": f"{PARENT}/queuedResources",
+             "response": {}},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa", "gke-node-bbb"])},
+        ]
+    )
+    assert p.non_terminated_nodes() == {
+        "tpu-pool#gke-node-aaa": "gke-v5e",
+        "tpu-pool#gke-node-bbb": "gke-v5e",
+    }
+    t.assert_done()
+
+
+def test_gke_legacy_slot_id_maps_to_sorted_instance():
+    """A slot id recorded before the pool exposed instance groups still
+    terminates a specific instance: slot i = i-th instance in name
+    order (the order membership would have assigned)."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-bbb", "gke-node-aaa"])},
+            {"method": "POST", "url": f"{IGM}/deleteInstances",
+             "body_contains": ["gke-node-bbb"],
+             "response": {"name": "op-del", "status": "DONE"}},
+        ]
+    )
+    p.terminate_node("tpu-pool#1")  # sorted: [aaa, bbb] → slot 1 = bbb
     t.assert_done()
 
 
@@ -405,3 +582,43 @@ def test_transport_token_expiry_and_401_refresh(monkeypatch):
     out = tr.request("GET", "https://example.invalid/x")
     assert out == {"ok": True}
     assert calls == ["Bearer tok-2", "Bearer tok-3"]
+
+
+def test_gke_terminate_missing_instance_is_noop():
+    """A retried terminate whose instance is already gone must NOT fall
+    back to an anonymous shrink (which would delete an arbitrary live
+    instance) — it treats the terminate as already done."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 1,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa"])},
+            # no deleteInstances, no setSize: nothing else happens
+        ]
+    )
+    p._nodes["tpu-pool#gke-node-gone"] = "gke-v5e"
+    p.terminate_node("tpu-pool#gke-node-gone")
+    assert "tpu-pool#gke-node-gone" not in p._nodes
+    t.assert_done()
+
+
+def test_plain_400_validation_error_is_not_retried():
+    """A permanent 400 (not the operation-in-flight phrasing) must
+    surface immediately, not burn the retry budget."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2}},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "error_status": 400,
+             "error_body": "Invalid value for nodeCount"},
+        ]
+    )
+    with pytest.raises(GcpHttpError) as ei:
+        p.create_node("gke-v5e", {"TPU": 8})
+    assert ei.value.status == 400
+    t.assert_done()

@@ -235,7 +235,7 @@ class GkeTpuNodeProvider(NodeProvider):
         # setSize is an absolute write: serialize our own resizes per
         # pool so two concurrent reconciles cannot interleave their
         # read-modify-write windows inside this process.
-        self._pool_locks: dict[str, threading.Lock] = {}
+        self._pool_locks: dict[str, threading.RLock] = {}
         self._pool_locks_guard = threading.Lock()
         # provider_node_id → node_type cache of our own creations; the
         # authoritative list always comes from the API
@@ -301,9 +301,11 @@ class GkeTpuNodeProvider(NodeProvider):
         raise TimeoutError(f"operation {name} not done in {timeout}s")
 
     # ----------------------------------------------------- pool helpers
-    def _pool_lock(self, name: str) -> threading.Lock:
+    def _pool_lock(self, name: str) -> threading.RLock:
+        # Reentrant: create_node holds it across its read-diff-resize
+        # sequence while _resize_pool re-acquires inside.
         with self._pool_locks_guard:
-            return self._pool_locks.setdefault(name, threading.Lock())
+            return self._pool_locks.setdefault(name, threading.RLock())
 
     @staticmethod
     def _pool_count(got: dict) -> int:
@@ -331,9 +333,7 @@ class GkeTpuNodeProvider(NodeProvider):
                     out[inst_url.rsplit("/", 1)[-1]] = (inst_url, igm)
         return out
 
-    def _resize_pool(
-        self, name: str, delta: int, pre_read: dict | None = None
-    ) -> "tuple[int, dict]":
+    def _resize_pool(self, name: str, delta: int) -> "tuple[int, dict]":
         """Conflict-safe GET → setSize(current+delta) → verify re-read.
 
         setSize is an absolute write, so the GET/POST window can lose a
@@ -342,17 +342,23 @@ class GkeTpuNodeProvider(NodeProvider):
         on GKE's operation-in-flight conflicts, and a post-resize
         re-read — if the observed count moved the wrong way, the write
         was clobbered and the whole read-modify-write retries from a
-        fresh read. Returns (size_before_our_write, verify_response).
+        fresh read. The GET always happens INSIDE the lock: a count
+        fetched before acquisition could be stale by the time the write
+        goes out, which is the exact lost-update this guards against.
+        Returns (size_before_our_write, verify_response).
         """
         with self._pool_lock(name):
             last_exc: Exception | None = None
             for attempt in range(4):
-                if attempt == 0 and pre_read is not None:
-                    got = pre_read
-                else:
-                    got = self.http.request("GET", self._gke_pool(name))
+                got = self.http.request("GET", self._gke_pool(name))
                 current = self._pool_count(got)
                 target = max(0, current + delta)
+                if target == current:
+                    # Clamped no-op (scale-down of an already-empty
+                    # pool): nothing to write, and the verify heuristic
+                    # below would misread observed==current as a lost
+                    # update.
+                    return current, got
                 try:
                     op = self.http.request(
                         "POST",
@@ -436,27 +442,45 @@ class GkeTpuNodeProvider(NodeProvider):
             return qr_id
         if mode == "node_pool":
             name = pool["pool"]
-            got = self.http.request("GET", self._gke_pool(name))
-            before = self._list_pool_instances(got)
-            current, verify = self._resize_pool(name, +1, pre_read=got)
-            if before is not None:
-                # Instance-backed id: the instance the resize added.
-                # With a racing scale-up several may be new — pick one
-                # deterministically so the id stays consistent with
-                # instance-named membership listing (a slot id here
-                # would never match non_terminated_nodes and the
-                # autoscaler would treat the node as failed).
-                after = self._list_pool_instances(verify) or {}
-                new = sorted(set(after) - set(before))
-                if new:
-                    pid = f"{name}#{new[0]}"
-                    self._nodes[pid] = node_type
-                    return pid
-            # No instance groups exposed: slot-indexed ids, derivable
-            # from the pool size, stable across provider restarts.
-            pid = f"{name}#{current}"
-            self._nodes[pid] = node_type
-            return pid
+            # The before-snapshot, resize, and after-diff must be one
+            # critical section: with the lock taken only inside
+            # _resize_pool, two concurrent creates could share a
+            # before-set and pick the SAME new instance as their id.
+            with self._pool_lock(name):
+                got = self.http.request("GET", self._gke_pool(name))
+                before = self._list_pool_instances(got)
+                current, verify = self._resize_pool(name, +1)
+                if before is not None:
+                    # Instance-backed id: the instance the resize added,
+                    # picked deterministically so the id stays
+                    # consistent with instance-named membership listing.
+                    # MIG listings can lag the resize, so re-read a few
+                    # times; a slot-id fallback here would never match
+                    # non_terminated_nodes and the autoscaler would
+                    # treat the node as failed, so raise instead and
+                    # let the reconcile retry cleanly.
+                    for _ in range(5):
+                        after = self._list_pool_instances(verify) or {}
+                        new = sorted(set(after) - set(before))
+                        if new:
+                            pid = f"{name}#{new[0]}"
+                            self._nodes[pid] = node_type
+                            return pid
+                        time.sleep(self._poll_s)
+                        verify = self.http.request(
+                            "GET", self._gke_pool(name)
+                        )
+                    raise RuntimeError(
+                        f"pool {name} grew to {self._pool_count(verify)}"
+                        " but the managed-instance listing never showed"
+                        " the new instance"
+                    )
+                # No instance groups exposed: slot-indexed ids,
+                # derivable from the pool size, stable across provider
+                # restarts.
+                pid = f"{name}#{current}"
+                self._nodes[pid] = node_type
+                return pid
         raise ValueError(f"unknown provider mode {mode!r}")
 
     def terminate_node(self, provider_node_id: str) -> None:
@@ -506,7 +530,7 @@ class GkeTpuNodeProvider(NodeProvider):
                 return
             # No instance groups exposed: anonymous conflict-safe shrink
             # is the best the API offers.
-            self._resize_pool(name, -1, pre_read=got)
+            self._resize_pool(name, -1)
             self._nodes.pop(provider_node_id, None)
             return
         try:

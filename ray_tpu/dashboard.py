@@ -52,11 +52,25 @@ def _pgs():
     return state.list_placement_groups()
 
 
+_job_client = None
+_job_client_lock = threading.Lock()
+
+
+def _jobs_client():
+    """One shared client so supervisor handles survive across requests
+    (reference: JobHead keeps one JobManager, job_head.py:208)."""
+    global _job_client
+    with _job_client_lock:
+        if _job_client is None:
+            from ray_tpu.job import JobSubmissionClient
+
+            _job_client = JobSubmissionClient()
+        return _job_client
+
+
 @_route("/api/jobs")
 def _jobs():
-    from ray_tpu.job import JobSubmissionClient
-
-    return JobSubmissionClient().list_jobs()
+    return _jobs_client().list_jobs()
 
 
 @_route("/api/logs")
@@ -171,7 +185,20 @@ async function draw(){nav();
  else if(tab==="placement groups"){const ps=await get("/api/placement_groups");
   $("<pre>"+esc(JSON.stringify(ps,null,2))+"</pre>")}
  else if(tab==="jobs"){const js=await get("/api/jobs");
-  $("<pre>"+esc(JSON.stringify(js,null,2))+"</pre>")}
+  $(`<p><input id="ep" placeholder="entrypoint command" size="60">
+   <button id="sub">submit</button></p>
+   <table><tr><th>job</th><th>entrypoint</th><th>status</th><th></th></tr>`+
+   js.map(j=>`<tr><td>${esc(j.job_id)}</td>
+   <td class="mut">${esc(j.entrypoint||"")}</td>
+   <td class="${j.status==="FAILED"?"bad":j.status==="SUCCEEDED"?"ok":""}">${esc(j.status)}</td>
+   <td>${j.status==="RUNNING"?`<a href="#jobs" class="jstop" data-jid="${esc(j.job_id)}">stop</a>`:""}</td></tr>`).join("")+"</table>");
+  document.getElementById("sub").onclick=async()=>{
+   const ep=document.getElementById("ep").value;
+   if(ep){await fetch("/api/jobs",{method:"POST",
+    body:JSON.stringify({entrypoint:ep})});draw()}};
+  document.querySelectorAll(".jstop").forEach(a=>a.onclick=async()=>{
+   await fetch("/api/jobs/"+a.dataset.jid+"/stop",{method:"POST"});
+   draw();return false})}
  else if(tab==="logs"){
   if(logWid){const r=await fetch("/api/logs/"+logWid);
    $(`<p><a href="#logs" onclick="logWid=null;draw()">&larr; back</a>
@@ -193,6 +220,26 @@ def _index_html() -> str:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    def _reply(self, body: bytes, ctype: str, code: int = 200):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, obj, code: int = 200):
+        self._reply(json.dumps(obj).encode(), "application/json", code)
+
+    def _job_subpath(self) -> tuple[str, str] | None:
+        """Split /api/jobs/<id>[/logs|/stop] → (job_id, action)."""
+        if not self.path.startswith("/api/jobs/"):
+            return None
+        rest = self.path[len("/api/jobs/"):].strip("/")
+        if not rest:
+            return None
+        job_id, _, action = rest.partition("/")
+        return job_id, action
+
     def do_GET(self):  # noqa: N802 - stdlib API
         try:
             self.path = self.path.split("?", 1)[0]  # drop query strings
@@ -205,6 +252,9 @@ class _Handler(BaseHTTPRequestHandler):
             elif self.path in _ROUTES:
                 body = json.dumps(_ROUTES[self.path]()).encode()
                 ctype = "application/json"
+            elif self.path.startswith("/api/jobs/"):
+                self._job_get()
+                return
             elif self.path.startswith("/api/logs/"):
                 text = state.read_worker_log(
                     self.path[len("/api/logs/"):]
@@ -217,11 +267,98 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self.send_error(404)
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            self._reply(body, ctype)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            self.send_error(500, explain=repr(e))
+
+    # ----------------------------------------------------- job REST API
+    # (reference: dashboard/modules/job/job_head.py:208 JobHead —
+    # POST /api/jobs/, GET /api/jobs/{id}, GET /api/jobs/{id}/logs,
+    # POST /api/jobs/{id}/stop, DELETE /api/jobs/{id}; same shape here
+    # so the SPA and external CI can drive jobs with plain HTTP.)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _job_or_404(self, job_id: str) -> str | None:
+        """One status RPC doubles as the existence check (UNKNOWN means
+        no record anywhere) — list_jobs() here would cost a supervisor
+        round-trip per RUNNING job just for membership."""
+        status = _jobs_client().get_job_status(job_id)
+        if status == "UNKNOWN":
+            self._reply_json({"error": f"job {job_id!r} not found"}, 404)
+            return None
+        return status
+
+    def _job_get(self):
+        sub = self._job_subpath()
+        if sub is None:
+            self.send_error(404)
+            return
+        job_id, action = sub
+        status = self._job_or_404(job_id)
+        if status is None:
+            return
+        if action == "logs":
+            self._reply(
+                _jobs_client().get_job_logs(job_id).encode(), "text/plain"
+            )
+        elif action == "":
+            self._reply_json({"job_id": job_id, "status": status})
+        else:
+            self.send_error(404)
+
+    def do_POST(self):  # noqa: N802 - stdlib API
+        try:
+            self.path = self.path.split("?", 1)[0]
+            client = _jobs_client()
+            if self.path in ("/api/jobs", "/api/jobs/"):
+                try:
+                    req = json.loads(self._read_body() or b"{}")
+                    entrypoint = req["entrypoint"]
+                except (ValueError, KeyError) as e:
+                    self._reply_json(
+                        {"error": f"bad submit request: {e!r}"}, 400
+                    )
+                    return
+                job_id = client.submit_job(
+                    entrypoint=entrypoint,
+                    submission_id=req.get("submission_id"),
+                    runtime_env=req.get("runtime_env"),
+                )
+                self._reply_json({"job_id": job_id})
+                return
+            sub = self._job_subpath()
+            if sub and sub[1] == "stop":
+                if self._job_or_404(sub[0]) is None:
+                    return
+                stopped = client.stop_job(sub[0])
+                self._reply_json({"stopped": stopped})
+                return
+            self.send_error(404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            self.send_error(500, explain=repr(e))
+
+    def do_DELETE(self):  # noqa: N802 - stdlib API
+        try:
+            self.path = self.path.split("?", 1)[0]
+            sub = self._job_subpath()
+            if sub and sub[1] == "":
+                if self._job_or_404(sub[0]) is None:
+                    return
+                try:
+                    deleted = _jobs_client().delete_job(sub[0])
+                except RuntimeError as e:  # still RUNNING
+                    self._reply_json({"error": str(e)}, 400)
+                    return
+                self._reply_json({"deleted": deleted})
+                return
+            self.send_error(404)
         except BrokenPipeError:
             pass
         except Exception as e:  # noqa: BLE001

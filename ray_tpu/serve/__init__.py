@@ -28,9 +28,11 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     run,
     shutdown,
+    start_grpc,
     start_http,
     status,
 )
+from ray_tpu.serve.grpc_ingress import grpc_request, grpc_stream
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig
 from ray_tpu.serve.context import get_multiplexed_model_id
@@ -50,9 +52,12 @@ __all__ = [
     "get_multiplexed_model_id",
     "multiplexed",
     "RpcIngressActor",
+    "grpc_request",
+    "grpc_stream",
     "rpc_request",
     "run",
     "shutdown",
+    "start_grpc",
     "start_http",
     "status",
 ]

@@ -163,11 +163,13 @@ def shutdown():
         except Exception:  # noqa: BLE001
             pass
         ray_tpu.kill(controller)
-    try:
-        proxy = ray_tpu.get_actor(PROXY_NAME)
-        ray_tpu.kill(proxy)
-    except ValueError:
-        pass
+    from ray_tpu.serve.grpc_ingress import GRPC_INGRESS_NAME
+
+    for name in (PROXY_NAME, GRPC_INGRESS_NAME):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(name))
+        except ValueError:
+            pass
 
 
 def start_http(host: str = "127.0.0.1", port: int = 0) -> int:
@@ -191,3 +193,27 @@ def start_http(host: str = "127.0.0.1", port: int = 0) -> int:
             .remote(host, port)
         )
     return ray_tpu.get(proxy.get_port.remote())
+
+
+def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the gRPC ingress actor; returns the bound port.
+
+    (reference: serve/_private/proxy.py:534 gRPCProxy — the reference
+    serves gRPC next to HTTP; clients consume
+    ray_tpu/serve/protos/serve.proto in any language.)"""
+    from ray_tpu.serve.grpc_ingress import GRPC_INGRESS_NAME, GrpcIngressActor
+
+    try:
+        ingress = ray_tpu.get_actor(GRPC_INGRESS_NAME)
+    except ValueError:
+        ingress = (
+            ray_tpu.remote(GrpcIngressActor)
+            .options(
+                name=GRPC_INGRESS_NAME,
+                lifetime="detached",
+                max_concurrency=1000,
+                num_cpus=0.1,
+            )
+            .remote(host, port)
+        )
+    return ray_tpu.get(ingress.get_port.remote())

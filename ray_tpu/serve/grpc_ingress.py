@@ -1,0 +1,363 @@
+"""gRPC Serve ingress: a standard-protocol data plane for non-Python
+clients (reference: python/ray/serve/_private/proxy.py:534 ``gRPCProxy``
+— the reference runs a gRPC servicer next to the HTTP proxy whose
+``unary_unary``/``unary_stream`` handlers bridge into DeploymentHandles;
+same shape here over ``raytpu.serve.ServeIngress`` from
+``protos/serve.proto``).
+
+Server: ``serve.start_grpc()`` deploys :class:`GrpcIngressActor` as a
+detached actor running a ``grpc.aio`` server; any gRPC client in any
+language can then call ``raytpu.serve.ServeIngress/Call`` (unary) or
+``/Stream`` (server-streaming) using the committed ``.proto``.
+
+The servicer is registered through ``grpc.method_handlers_generic_handler``
+with protoc-generated message classes — no grpc_tools codegen needed on
+the server, and the wire format is plain protobuf-over-HTTP/2.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+
+from ray_tpu.serve.handle import DeploymentHandle
+
+_ROUTE_TTL_S = 2.0
+_DEFAULT_TIMEOUT_S = 60.0
+
+SERVICE_NAME = "raytpu.serve.ServeIngress"
+GRPC_INGRESS_NAME = "_serve_grpc_ingress"
+
+
+def _encode_reply(value, serve_pb2):
+    """Pick the reply content_type from the Python value's type
+    (mirrors the HTTP proxy's bytes/str/JSON response negotiation)."""
+    if isinstance(value, bytes):
+        return serve_pb2.ServeReply(payload=value, content_type="bytes")
+    if isinstance(value, str):
+        return serve_pb2.ServeReply(
+            payload=value.encode(), content_type="text"
+        )
+    return serve_pb2.ServeReply(
+        payload=json.dumps(value).encode(), content_type="json"
+    )
+
+
+def _decode_payload(request):
+    ctype = request.content_type or "json"
+    if ctype == "bytes":
+        return request.payload
+    if ctype == "text":
+        return request.payload.decode()
+    if ctype == "json":
+        if not request.payload:
+            return None
+        return json.loads(request.payload.decode())
+    raise ValueError(f"unknown content_type {ctype!r}")
+
+
+class GrpcIngressActor:
+    """Deployed detached by :func:`ray_tpu.serve.api.start_grpc`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._routes: dict = {}
+        self._routes_ts = 0.0
+        self._controller = None
+        self._handles: dict = {}
+        self._stream_handles: dict = {}
+        self._port: int | None = None
+        self._server = None
+        # Actor __init__ runs on the executor thread; the grpc.aio server
+        # must live on the runtime loop where handle calls are native
+        # (same pattern as proxy.ProxyActor.__init__).
+        from ray_tpu import api as core_api
+
+        asyncio.run_coroutine_threadsafe(
+            self._start(host, port), core_api._runtime.loop
+        ).result(timeout=30)
+
+    async def _start(self, host: str, port: int):
+        import grpc
+
+        from ray_tpu.serve.protos import serve_pb2
+
+        handlers = {
+            "Call": grpc.unary_unary_rpc_method_handler(
+                self._call,
+                request_deserializer=serve_pb2.ServeRequest.FromString,
+                response_serializer=serve_pb2.ServeReply.SerializeToString,
+            ),
+            "Stream": grpc.unary_stream_rpc_method_handler(
+                self._stream,
+                request_deserializer=serve_pb2.ServeRequest.FromString,
+                response_serializer=serve_pb2.ServeReply.SerializeToString,
+            ),
+            "ListApplications": grpc.unary_unary_rpc_method_handler(
+                self._list_applications,
+                request_deserializer=(
+                    serve_pb2.ListApplicationsRequest.FromString
+                ),
+                response_serializer=(
+                    serve_pb2.ListApplicationsReply.SerializeToString
+                ),
+            ),
+            "Healthz": grpc.unary_unary_rpc_method_handler(
+                self._healthz,
+                request_deserializer=serve_pb2.HealthzRequest.FromString,
+                response_serializer=serve_pb2.HealthzReply.SerializeToString,
+            ),
+        }
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+        )
+        self._port = self._server.add_insecure_port(f"{host}:{port}")
+        await self._server.start()
+
+    def get_port(self) -> int:
+        return self._port
+
+    async def shutdown(self) -> bool:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+        return True
+
+    # ---------------------------------------------------------- routing
+    async def _refresh_routes(self, force: bool = False):
+        """Poll the controller's route table loop-natively (same pattern
+        as proxy.ProxyActor._refresh_routes: handle.result() would
+        deadlock the runtime loop)."""
+        now = time.monotonic()
+        if not force and now - self._routes_ts < _ROUTE_TTL_S and self._routes:
+            return
+        from ray_tpu import api as core_api
+        from ray_tpu.runtime.core_worker import ActorSubmitTarget
+        from ray_tpu.serve.api import CONTROLLER_NAME
+
+        core = core_api._runtime.core
+        if self._controller is None:
+            reply = await core.head.call("get_actor", name=CONTROLLER_NAME)
+            if not reply["ok"]:
+                raise RuntimeError("serve controller is not running")
+            self._controller = ActorSubmitTarget(
+                reply["actor_id"], reply["addr"]
+            )
+        try:
+            refs = await core.submit_task(
+                "get_route_table",
+                (),
+                {},
+                num_returns=1,
+                actor=self._controller,
+            )
+            self._routes = (await core.get(refs))[0]
+        except Exception:
+            self._controller = None
+            raise
+        self._routes_ts = time.monotonic()
+
+    def _apps(self) -> dict:
+        """Parse the proxy-shaped route table — prefix → (app, ingress,
+        request_timeout_s|None) — into app → (ingress, timeout)."""
+        by_app = {}
+        for app_name, ingress, *rest in self._routes.values():
+            timeout = (
+                rest[0]
+                if rest and rest[0] is not None
+                else _DEFAULT_TIMEOUT_S
+            )
+            by_app[app_name] = (ingress, timeout)
+        return by_app
+
+    async def _resolve(self, request):
+        """Map (application, deployment) onto a target deployment and
+        per-deployment timeout via the controller route table."""
+        await self._refresh_routes()
+        app = request.application or "default"
+        if app not in self._apps():
+            # One forced refresh covers the just-deployed case.
+            await self._refresh_routes(force=True)
+        by_app = self._apps()
+        if app not in by_app:
+            return None, None, None
+        ingress, timeout = by_app[app]
+        deployment = request.deployment or ingress
+        return app, deployment, timeout
+
+    def _handle_for(self, app, deployment, method, stream):
+        cache = self._stream_handles if stream else self._handles
+        key = (app, deployment, method)
+        handle = cache.get(key)
+        if handle is None:
+            handle = DeploymentHandle(
+                deployment, app, method_name=method or "__call__",
+                stream=stream,
+            )
+            cache[key] = handle
+        return handle
+
+    # --------------------------------------------------------- handlers
+    async def _call(self, request, context):
+        import grpc
+
+        from ray_tpu.serve.protos import serve_pb2
+
+        app, deployment, timeout = await self._resolve(request)
+        if app is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"application {request.application or 'default'!r} not "
+                "found; call ListApplications for the live set",
+            )
+        try:
+            arg = _decode_payload(request)
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        handle = self._handle_for(
+            app, deployment, request.method, stream=False
+        )
+        try:
+            value = await asyncio.wait_for(
+                handle.remote(arg), timeout=timeout
+            )
+        except asyncio.TimeoutError:
+            await context.abort(
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                f"no reply within {timeout}s",
+            )
+        except Exception as e:  # noqa: BLE001 - becomes a gRPC status
+            await context.abort(
+                grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+            )
+        return _encode_reply(value, serve_pb2)
+
+    async def _stream(self, request, context):
+        import grpc
+
+        from ray_tpu.serve.protos import serve_pb2
+
+        app, deployment, timeout = await self._resolve(request)
+        if app is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"application {request.application or 'default'!r} not "
+                "found; call ListApplications for the live set",
+            )
+        try:
+            arg = _decode_payload(request)
+        except ValueError as e:
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        handle = self._handle_for(
+            app, deployment, request.method, stream=True
+        )
+        agen = handle.remote(arg).__aiter__()
+        while True:
+            try:
+                item = await asyncio.wait_for(
+                    agen.__anext__(), timeout=timeout
+                )
+            except StopAsyncIteration:
+                break
+            except asyncio.TimeoutError:
+                await context.abort(
+                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                    f"no stream item within {timeout}s",
+                )
+            except grpc.aio.AbortError:
+                raise
+            except Exception as e:  # noqa: BLE001 - becomes a gRPC status
+                await context.abort(
+                    grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
+                )
+            yield _encode_reply(item, serve_pb2)
+
+    async def _list_applications(self, request, context):
+        from ray_tpu.serve.protos import serve_pb2
+
+        await self._refresh_routes(force=True)
+        apps = sorted({entry[0] for entry in self._routes.values()})
+        return serve_pb2.ListApplicationsReply(application_names=apps)
+
+    async def _healthz(self, request, context):
+        from ray_tpu.serve.protos import serve_pb2
+
+        return serve_pb2.HealthzReply(message="success")
+
+
+# ------------------------------------------------------------- client
+
+
+def grpc_request(
+    addr: str,
+    *,
+    application: str = "default",
+    deployment: str = "",
+    method: str = "",
+    payload=None,
+    timeout: float | None = 60.0,
+):
+    """Convenience unary client (tests / Python callers). Non-Python
+    clients should consume ``protos/serve.proto`` directly."""
+    import grpc
+
+    from ray_tpu.serve.protos import serve_pb2
+
+    with grpc.insecure_channel(addr) as channel:
+        call = channel.unary_unary(
+            f"/{SERVICE_NAME}/Call",
+            request_serializer=serve_pb2.ServeRequest.SerializeToString,
+            response_deserializer=serve_pb2.ServeReply.FromString,
+        )
+        req = _build_request(serve_pb2, application, deployment, method, payload)
+        reply = call(req, timeout=timeout)
+    return _decode_reply(reply)
+
+
+def grpc_stream(
+    addr: str,
+    *,
+    application: str = "default",
+    deployment: str = "",
+    method: str = "",
+    payload=None,
+    timeout: float | None = 60.0,
+):
+    """Server-streaming client: yields decoded items as they arrive."""
+    import grpc
+
+    from ray_tpu.serve.protos import serve_pb2
+
+    with grpc.insecure_channel(addr) as channel:
+        call = channel.unary_stream(
+            f"/{SERVICE_NAME}/Stream",
+            request_serializer=serve_pb2.ServeRequest.SerializeToString,
+            response_deserializer=serve_pb2.ServeReply.FromString,
+        )
+        req = _build_request(serve_pb2, application, deployment, method, payload)
+        for reply in call(req, timeout=timeout):
+            yield _decode_reply(reply)
+
+
+def _build_request(serve_pb2, application, deployment, method, payload):
+    if isinstance(payload, bytes):
+        body, ctype = payload, "bytes"
+    elif isinstance(payload, str):
+        body, ctype = payload.encode(), "text"
+    else:
+        body, ctype = json.dumps(payload).encode(), "json"
+    return serve_pb2.ServeRequest(
+        application=application,
+        deployment=deployment,
+        method=method,
+        payload=body,
+        content_type=ctype,
+    )
+
+
+def _decode_reply(reply):
+    if reply.content_type == "bytes":
+        return reply.payload
+    if reply.content_type == "text":
+        return reply.payload.decode()
+    return json.loads(reply.payload.decode())

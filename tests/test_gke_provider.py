@@ -192,7 +192,12 @@ def test_gke_node_pool_resize_up_down():
     )
     p, t = make_provider(
         [
-            {
+            {  # create_node's before-snapshot
+                "method": "GET",
+                "url": pool_url,
+                "response": {"currentNodeCount": 2},
+            },
+            {  # _resize_pool's own in-lock read
                 "method": "GET",
                 "url": pool_url,
                 "response": {"currentNodeCount": 2},
@@ -218,7 +223,12 @@ def test_gke_node_pool_resize_up_down():
                 "url": pool_url,
                 "response": {"currentNodeCount": 3},
             },
-            {
+            {  # terminate_node's instance-resolution read
+                "method": "GET",
+                "url": pool_url,
+                "response": {"currentNodeCount": 3},
+            },
+            {  # _resize_pool's own in-lock read
                 "method": "GET",
                 "url": pool_url,
                 "response": {"currentNodeCount": 3},
@@ -265,7 +275,12 @@ def test_pool_membership_survives_provider_restart():
                 "url": pool_url,
                 "response": {"currentNodeCount": 2},
             },
-            {
+            {  # terminate_node's instance-resolution read
+                "method": "GET",
+                "url": pool_url,
+                "response": {"currentNodeCount": 2},
+            },
+            {  # _resize_pool's own in-lock read
                 "method": "GET",
                 "url": pool_url,
                 "response": {"currentNodeCount": 2},
@@ -304,7 +319,9 @@ def test_gke_setsize_lost_update_retries_from_fresh_read():
     pool_url = _pool_url()
     p, t = make_provider(
         [
-            {"method": "GET", "url": pool_url,
+            {"method": "GET", "url": pool_url,  # create snapshot
+             "response": {"currentNodeCount": 2}},
+            {"method": "GET", "url": pool_url,  # in-lock resize read
              "response": {"currentNodeCount": 2}},
             {"method": "POST", "url": f"{pool_url}:setSize",
              "body_contains": ["3"],
@@ -334,7 +351,9 @@ def test_gke_setsize_conflict_rereads_before_retry():
     pool_url = _pool_url()
     p, t = make_provider(
         [
-            {"method": "GET", "url": pool_url,
+            {"method": "GET", "url": pool_url,  # create snapshot
+             "response": {"currentNodeCount": 2}},
+            {"method": "GET", "url": pool_url,  # in-lock resize read
              "response": {"currentNodeCount": 2}},
             {"method": "POST", "url": f"{pool_url}:setSize",
              "body_contains": ["3"], "error_status": 409,
@@ -384,6 +403,9 @@ def test_gke_targeted_scale_down_deletes_the_named_instance():
                           "instanceGroupUrls": [IG]}},
             {"method": "POST", "url": f"{IGM}/listManagedInstances",
              "response": _mi(["gke-node-aaa"])},
+            {"method": "GET", "url": pool_url,  # in-lock resize read
+             "response": {"currentNodeCount": 1,
+                          "instanceGroupUrls": [IG]}},
             {"method": "POST", "url": f"{pool_url}:setSize",
              "body_contains": ["2"],
              "response": {"name": "op-up", "status": "DONE"}},
@@ -407,6 +429,59 @@ def test_gke_targeted_scale_down_deletes_the_named_instance():
     assert pid == "tpu-pool#gke-node-bbb"
     p.terminate_node(pid)
     assert pid not in p._nodes
+    t.assert_done()
+
+
+def test_gke_clamped_noop_resize_skips_the_write():
+    """Scale-down of an already-empty pool clamps target==current: no
+    setSize is issued and no lost-update false positive burns retries."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,  # terminate's read
+             "response": {"currentNodeCount": 0}},
+            {"method": "GET", "url": pool_url,  # in-lock resize read
+             "response": {"currentNodeCount": 0}},
+            # No setSize: target 0 == current 0.
+        ]
+    )
+    p.terminate_node("tpu-pool#0")
+    t.assert_done()
+
+
+def test_gke_instance_listing_lag_retries_until_visible():
+    """The MIG listing can lag the resize; create_node re-reads until
+    the new instance shows instead of falling back to a slot id that
+    could never match instance-named membership."""
+    pool_url = _pool_url()
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 1,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa"])},
+            {"method": "GET", "url": pool_url,  # in-lock resize read
+             "response": {"currentNodeCount": 1,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["2"],
+             "response": {"name": "op-up", "status": "DONE"}},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2,
+                          "instanceGroupUrls": [IG]}},
+            # Lagging listing: still only the old instance.
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa"])},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa", "gke-node-new"])},
+        ]
+    )
+    pid = p.create_node("gke-v5e", {"TPU": 8})
+    assert pid == "tpu-pool#gke-node-new"
     t.assert_done()
 
 
@@ -611,7 +686,9 @@ def test_plain_400_validation_error_is_not_retried():
     pool_url = _pool_url()
     p, t = make_provider(
         [
-            {"method": "GET", "url": pool_url,
+            {"method": "GET", "url": pool_url,  # create snapshot
+             "response": {"currentNodeCount": 2}},
+            {"method": "GET", "url": pool_url,  # in-lock resize read
              "response": {"currentNodeCount": 2}},
             {"method": "POST", "url": f"{pool_url}:setSize",
              "error_status": 400,

@@ -77,6 +77,10 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "TLS_KEY": (str, "", "path to the PEM private key for TLS_CERT "
                          "(servers only)"),
     "RPC_MAX_FRAME": (int, 2 << 30, "largest accepted rpc frame (bytes)"),
+    # --- runtime envs
+    "ENV_CACHE_BYTES": (int, 10 << 30, "built runtime-env cache budget; "
+                                       "unreferenced envs evict oldest-"
+                                       "idle-first past it"),
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: method:prob[:mode] list"),
     "TRACE": (bool, False, "enable span collection in every process"),

@@ -727,8 +727,24 @@ def _normalize_options(options: dict) -> dict:
         # Fail bad specs HERE at submission — an invalid env otherwise
         # travels through scheduling and fails per lease attempt deep
         # in the node's locked env builder.
-        if renv.get("pip") and renv.get("uv"):
-            raise ValueError("runtime_env: specify 'pip' OR 'uv', not both")
+        exclusive = [k for k in ("pip", "uv", "conda") if renv.get(k)]
+        if len(exclusive) > 1:
+            raise ValueError(
+                f"runtime_env: {exclusive} are mutually exclusive — "
+                "specify one package manager, not both"
+            )
+        has_image = bool(renv.get("image_uri")) or bool(
+            isinstance(renv.get("container"), dict)
+            and renv["container"].get("image")
+        )
+        if has_image and exclusive:
+            # A host-built venv/conda interpreter does not exist inside
+            # the image; bake deps into the image instead (reference:
+            # image_uri envs exclude pip/conda the same way).
+            raise ValueError(
+                f"runtime_env: 'container'/'image_uri' cannot combine "
+                f"with {exclusive} — install packages in the image"
+            )
     return options
 
 

@@ -459,17 +459,18 @@ class GkeTpuNodeProvider(NodeProvider):
                     # non_terminated_nodes and the autoscaler would
                     # treat the node as failed, so raise instead and
                     # let the reconcile retry cleanly.
-                    for _ in range(5):
+                    for attempt in range(5):
+                        if attempt:
+                            time.sleep(self._poll_s)
+                            verify = self.http.request(
+                                "GET", self._gke_pool(name)
+                            )
                         after = self._list_pool_instances(verify) or {}
                         new = sorted(set(after) - set(before))
                         if new:
                             pid = f"{name}#{new[0]}"
                             self._nodes[pid] = node_type
                             return pid
-                        time.sleep(self._poll_s)
-                        verify = self.http.request(
-                            "GET", self._gke_pool(name)
-                        )
                     raise RuntimeError(
                         f"pool {name} grew to {self._pool_count(verify)}"
                         " but the managed-instance listing never showed"

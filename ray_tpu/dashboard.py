@@ -319,7 +319,8 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     req = json.loads(self._read_body() or b"{}")
                     entrypoint = req["entrypoint"]
-                except (ValueError, KeyError) as e:
+                # TypeError: valid JSON that isn't an object ('[1]').
+                except (ValueError, KeyError, TypeError) as e:
                     self._reply_json(
                         {"error": f"bad submit request: {e!r}"}, 400
                     )

@@ -148,6 +148,21 @@ _built_envs: dict[str, dict] = {}  # env hash → {"python": ..., "cwd": ...}
 _env_build_lock = threading.Lock()
 
 
+def _make_env_cache():
+    from ray_tpu._private import config
+    from ray_tpu.runtime.runtime_env import UriCache
+
+    # Evicted envs must also leave the build memo, or the next request
+    # would hand out a python/cwd whose files were just deleted.
+    return UriCache(
+        config.get("ENV_CACHE_BYTES"),
+        on_evict=lambda h: _built_envs.pop(h, None),
+    )
+
+
+_env_cache = _make_env_cache()
+
+
 def build_runtime_env(runtime_env: dict, h: str | None = None) -> dict:
     """Materialize a task/actor runtime env on this node: a venv for
     ``pip`` dependencies and a staged copy of ``working_dir``. Cached by
@@ -180,6 +195,10 @@ def build_runtime_env(runtime_env: dict, h: str | None = None) -> dict:
             fcntl.flock(lock_f, fcntl.LOCK_UN)
             lock_f.close()
         _built_envs[h] = info
+        if os.path.isdir(root):
+            # Only on-disk builds participate in byte-budget GC (named
+            # conda envs and pure env_vars envs occupy no cache space).
+            _env_cache.register(h, root)
         return info
 
 
@@ -188,8 +207,16 @@ def _build_env_locked(runtime_env: dict, root: str, info: dict) -> None:
 
     pip_pkgs = runtime_env.get("pip")
     uv_pkgs = runtime_env.get("uv")
-    if pip_pkgs and uv_pkgs:
-        raise ValueError("runtime_env: specify 'pip' OR 'uv', not both")
+    conda_spec = runtime_env.get("conda")
+    if sum(map(bool, (pip_pkgs, uv_pkgs, conda_spec))) > 1:
+        raise ValueError(
+            "runtime_env: 'pip', 'uv', 'conda' are mutually exclusive — "
+            "specify one package manager, not both"
+        )
+    if conda_spec:
+        from ray_tpu.runtime.runtime_env import build_conda_env
+
+        info["python"] = build_conda_env(conda_spec, root)
     if pip_pkgs or uv_pkgs:
         venv_dir = os.path.join(root, "venv")
         vpython = os.path.join(venv_dir, "bin", "python")
@@ -455,10 +482,17 @@ class NodeManager:
                 seen.add(entry)
         jax_platform = env_jax_platform()
         renv = runtime_env or {}
+        from ray_tpu.runtime import runtime_env as renv_mod
+
+        in_container = renv_mod.container_image(renv) is not None
+        # Pin the env BEFORE reading the build memo: a release-triggered
+        # eviction between the two would hand this worker a root whose
+        # files are being deleted.
+        _env_cache.acquire(ehash)
         built = _built_envs.get(ehash, {})
         python_exe = built.get("python") or sys.executable
         argv = [python_exe, "-m", "ray_tpu.runtime.worker_main"]
-        if jax_platform == "cpu" and not built.get("python"):
+        if jax_platform == "cpu" and not built.get("python") and not in_container:
             # CPU workers skip site initialization (the image's
             # sitecustomize imports jax + the TPU plugin, ~1.7 s per
             # interpreter); site-packages comes back via PYTHONPATH.
@@ -497,6 +531,29 @@ class NodeManager:
             # pipeline.
             "PYTHONUNBUFFERED": "1",
         }
+        if in_container:
+            # Containerized worker (reference: image_uri.py — the worker
+            # command runs under podman/docker with host networking and
+            # the runtime's paths mounted 1:1 so PYTHONPATH/store paths
+            # stay valid inside). Only the vars the worker needs are
+            # forwarded — the host environ is not the container's.
+            fwd = {
+                k: v
+                for k, v in env.items()
+                if k.startswith(("RAY_TPU_", "PYTHON", "JAX_"))
+                or k in self.worker_env
+                or k in (renv.get("env_vars") or {})
+            }
+            mounts = [
+                pkg_root,
+                self.store_dir,
+                _ENV_CACHE_ROOT,
+                built.get("cwd") or "",
+                *[os.path.abspath(m) for m in renv.get("py_modules", ())],
+            ]
+            argv = renv_mod.wrap_container_argv(
+                renv, argv, fwd, mounts, built.get("cwd")
+            )
         # Capture stdio to a per-worker log file (reference: worker logs
         # under /tmp/ray/session_*/logs; log_monitor tails them).
         self.log_dir.mkdir(parents=True, exist_ok=True)
@@ -556,6 +613,7 @@ class NodeManager:
         if runtime_env and (
             runtime_env.get("pip")
             or runtime_env.get("uv")
+            or runtime_env.get("conda")
             or runtime_env.get("working_dir")
         ):
             # Build the isolated env (venv + staged working dir) OFF the
@@ -1030,6 +1088,7 @@ class NodeManager:
         proc = w.get("proc")
         if proc and proc.poll() is None:
             proc.kill()
+        _env_cache.release(ehash)
 
     def _drain_pending(self):
         now = asyncio.get_event_loop().time()
@@ -1377,6 +1436,7 @@ class NodeManager:
                 ehash = (w or {}).get("env_hash", "")
                 if wid in self.idle[ehash]:
                     self.idle[ehash].remove(wid)
+                _env_cache.release(ehash)
                 if (
                     w
                     and w.get("state") == "spawning"

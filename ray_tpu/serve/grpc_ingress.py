@@ -19,12 +19,9 @@ from __future__ import annotations
 
 import asyncio
 import json
-import time
 
 from ray_tpu.serve.handle import DeploymentHandle
-
-_ROUTE_TTL_S = 2.0
-_DEFAULT_TIMEOUT_S = 60.0
+from ray_tpu.serve.routes import RouteTablePoller
 
 SERVICE_NAME = "raytpu.serve.ServeIngress"
 GRPC_INGRESS_NAME = "_serve_grpc_ingress"
@@ -61,9 +58,7 @@ class GrpcIngressActor:
     """Deployed detached by :func:`ray_tpu.serve.api.start_grpc`."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._routes: dict = {}
-        self._routes_ts = 0.0
-        self._controller = None
+        self._poller = RouteTablePoller()
         self._handles: dict = {}
         self._stream_handles: dict = {}
         self._port: int | None = None
@@ -124,61 +119,15 @@ class GrpcIngressActor:
         return True
 
     # ---------------------------------------------------------- routing
-    async def _refresh_routes(self, force: bool = False):
-        """Poll the controller's route table loop-natively (same pattern
-        as proxy.ProxyActor._refresh_routes: handle.result() would
-        deadlock the runtime loop)."""
-        now = time.monotonic()
-        if not force and now - self._routes_ts < _ROUTE_TTL_S and self._routes:
-            return
-        from ray_tpu import api as core_api
-        from ray_tpu.runtime.core_worker import ActorSubmitTarget
-        from ray_tpu.serve.api import CONTROLLER_NAME
-
-        core = core_api._runtime.core
-        if self._controller is None:
-            reply = await core.head.call("get_actor", name=CONTROLLER_NAME)
-            if not reply["ok"]:
-                raise RuntimeError("serve controller is not running")
-            self._controller = ActorSubmitTarget(
-                reply["actor_id"], reply["addr"]
-            )
-        try:
-            refs = await core.submit_task(
-                "get_route_table",
-                (),
-                {},
-                num_returns=1,
-                actor=self._controller,
-            )
-            self._routes = (await core.get(refs))[0]
-        except Exception:
-            self._controller = None
-            raise
-        self._routes_ts = time.monotonic()
-
-    def _apps(self) -> dict:
-        """Parse the proxy-shaped route table — prefix → (app, ingress,
-        request_timeout_s|None) — into app → (ingress, timeout)."""
-        by_app = {}
-        for app_name, ingress, *rest in self._routes.values():
-            timeout = (
-                rest[0]
-                if rest and rest[0] is not None
-                else _DEFAULT_TIMEOUT_S
-            )
-            by_app[app_name] = (ingress, timeout)
-        return by_app
-
     async def _resolve(self, request):
         """Map (application, deployment) onto a target deployment and
         per-deployment timeout via the controller route table."""
-        await self._refresh_routes()
+        await self._poller.refresh()
         app = request.application or "default"
-        if app not in self._apps():
+        if app not in self._poller.by_app():
             # One forced refresh covers the just-deployed case.
-            await self._refresh_routes(force=True)
-        by_app = self._apps()
+            await self._poller.refresh(force=True)
+        by_app = self._poller.by_app()
         if app not in by_app:
             return None, None, None
         ingress, timeout = by_app[app]
@@ -275,8 +224,8 @@ class GrpcIngressActor:
     async def _list_applications(self, request, context):
         from ray_tpu.serve.protos import serve_pb2
 
-        await self._refresh_routes(force=True)
-        apps = sorted({entry[0] for entry in self._routes.values()})
+        await self._poller.refresh(force=True)
+        apps = sorted(self._poller.by_app())
         return serve_pb2.ListApplicationsReply(application_names=apps)
 
     async def _healthz(self, request, context):

@@ -28,12 +28,10 @@ import time
 import urllib.parse
 
 from ray_tpu.serve.handle import (
-    CONTROLLER_NAME,
     DeploymentHandle,
     DeploymentStreamResponse,
 )
 
-_ROUTE_TTL_S = 2.0
 _REQUEST_TIMEOUT_S = 60.0
 _BODY_READ_TIMEOUT_S = 30.0
 _MAX_BODY = 64 * 1024 * 1024
@@ -81,10 +79,11 @@ class ProxyActor:
         max_inflight: int = _MAX_INFLIGHT,
     ):
         # prefix → (app, ingress, request_timeout_s|None)
+        from ray_tpu.serve.routes import RouteTablePoller
+
+        self._poller = RouteTablePoller()
         self._routes: dict[str, tuple] = {}
         self._handles: dict[str, DeploymentHandle] = {}
-        self._routes_ts = 0.0
-        self._controller = None
         self._server: asyncio.AbstractServer | None = None
         self._max_body = max_body_bytes
         self._max_inflight = max_inflight
@@ -112,39 +111,11 @@ class ProxyActor:
 
     # ---------------------------------------------------------- routing
     async def _refresh_routes(self, force: bool = False):
-        """Poll the controller's route table (loop-native: get_actor /
-        handle.result() would deadlock the runtime loop)."""
-        now = time.monotonic()
-        if not force and now - self._routes_ts < _ROUTE_TTL_S and self._routes:
-            return
-        from ray_tpu import api as core_api
-        from ray_tpu.runtime.core_worker import ActorSubmitTarget
-
-        core = core_api._runtime.core
-        if self._controller is None:
-            reply = await core.head.call("get_actor", name=CONTROLLER_NAME)
-            if not reply["ok"]:
-                raise RuntimeError("serve controller is not running")
-            self._controller = ActorSubmitTarget(
-                reply["actor_id"], reply["addr"]
-            )
-        try:
-            refs = await core.submit_task(
-                "get_route_table",
-                (),
-                {},
-                num_returns=1,
-                actor=self._controller,
-            )
-            self._routes = (await core.get(refs))[0]
-        except Exception:
-            # The controller may have been restarted as a new actor (this
-            # proxy is detached and outlives serve.shutdown/serve.run
-            # cycles): drop the cached target so the next refresh
-            # re-resolves it by name.
-            self._controller = None
-            raise
-        self._routes_ts = time.monotonic()
+        """Poll the controller's route table via the shared poller
+        (routes.py — one implementation for the HTTP and gRPC
+        ingresses, controller-restart recovery included)."""
+        await self._poller.refresh(force)
+        self._routes = self._poller.routes
 
     def _match_route(self, route: str):
         for prefix in sorted(self._routes, key=len, reverse=True):

@@ -1,0 +1,362 @@
+"""conda + container runtime envs and the refcounted env-cache GC
+(reference test model: python/ray/tests/test_runtime_env_conda_and_pip.py
+and test_runtime_env_container.py — conda-spec'd tasks run under the
+env's interpreter, containerized workers run under the engine with the
+session mounted; uri_cache tests evict unreferenced builds past the
+size cap).
+
+The CI hosts have neither conda nor podman, so both engines are PATH
+stubs that honor the real CLI contract: the fake conda materializes a
+prefix whose bin/python is the system interpreter; the fake podman
+parses run/--env/-v/--workdir, records them, and execs the worker
+command with ONLY the forwarded env — which proves the forwarded set
+is actually sufficient to boot a worker.
+"""
+
+import ast
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime.runtime_env import UriCache
+
+FAKE_BIN = None  # set by the fixture; prepended to PATH
+
+
+def _write_exe(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    global FAKE_BIN
+    fake_bin = tmp_path_factory.mktemp("fakebin")
+    FAKE_BIN = str(fake_bin)
+
+    _write_exe(
+        fake_bin / "conda",
+        textwrap.dedent(
+            f"""\
+            #!{sys.executable}
+            import json, os, subprocess, sys
+            log = os.environ.get("CONDA_FAKE_LOG")
+            if log:
+                with open(log, "a") as f:
+                    f.write(json.dumps(sys.argv[1:]) + "\\n")
+            args = sys.argv[1:]
+            if args[:1] == ["run"]:
+                # conda run -n NAME CMD... -> exec CMD with system python
+                sys.exit(subprocess.call(args[3:]))
+            if args[:2] == ["env", "create"]:
+                opts = dict(zip(args[2::2], args[3::2]))
+                prefix = opts["--prefix"]
+                # A real venv: bin/python + pyvenv.cfg, so the spawned
+                # worker's sys.executable reports the prefix path just
+                # like a real conda env's would.
+                sys.exit(subprocess.call(
+                    [sys.executable, "-m", "venv",
+                     "--system-site-packages", prefix]
+                ) or (json.load(open(opts["--file"])) and 0) or 0)
+            sys.exit(2)
+            """
+        ),
+    )
+    _write_exe(
+        fake_bin / "podman",
+        textwrap.dedent(
+            f"""\
+            #!{sys.executable}
+            import os, sys
+            args = sys.argv[1:]
+            assert args[0] == "run", args
+            i, mounts, env, workdir = 1, [], {{}}, None
+            while i < len(args):
+                a = args[i]
+                if a == "--rm":
+                    i += 1
+                elif a == "--network":
+                    i += 2
+                elif a == "-v":
+                    mounts.append(args[i + 1]); i += 2
+                elif a == "--env":
+                    k, _, v = args[i + 1].partition("="); env[k] = v; i += 2
+                elif a == "--workdir":
+                    workdir = args[i + 1]; i += 2
+                else:
+                    break
+            image, cmd = args[i], args[i + 1 :]
+            with open(os.environ["PODMAN_FAKE_LOG"], "a") as f:
+                f.write(repr({{"image": image, "mounts": mounts,
+                              "env_keys": sorted(env), "workdir": workdir,
+                              "cmd": cmd[:2]}}) + "\\n")
+            if workdir:
+                os.chdir(workdir)
+            # The runtime hands us the IMAGE's interpreter name
+            # ("python3"); this fake emulates an image whose python is
+            # the host env's, then execs with ONLY the forwarded env,
+            # like a real container.
+            exe = {sys.executable!r} if not os.path.isabs(cmd[0]) else cmd[0]
+            os.execve(exe, cmd, env)
+            """
+        ),
+    )
+
+    os.environ["PATH"] = f"{fake_bin}{os.pathsep}{os.environ['PATH']}"
+    # Builds cache on disk across processes; stale roots from earlier
+    # runs (or earlier fake-engine revisions) must not satisfy this
+    # suite's builds.
+    import shutil
+
+    from ray_tpu.runtime import node as node_mod
+
+    shutil.rmtree(node_mod._ENV_CACHE_ROOT, ignore_errors=True)
+    node_mod._built_envs.clear()
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------ conda
+
+
+def test_conda_package_list_env(cluster, tmp_path):
+    log = tmp_path / "conda.log"
+    os.environ["CONDA_FAKE_LOG"] = str(log)
+
+    @ray_tpu.remote(runtime_env={"conda": ["pytest"]})
+    def where():
+        return sys.executable
+
+    exe = ray_tpu.get(where.remote())
+    # The worker booted from the conda prefix's interpreter.
+    assert "/conda/bin/python" in exe
+    calls = [l for l in log.read_text().splitlines() if "create" in l]
+    assert len(calls) == 1  # built once, cached by env hash
+
+    # Same spec again: cache hit, no second create.
+    exe2 = ray_tpu.get(where.remote())
+    assert exe2 == exe
+    calls = [l for l in log.read_text().splitlines() if "create" in l]
+    assert len(calls) == 1
+
+
+def test_conda_named_env(cluster):
+    @ray_tpu.remote(runtime_env={"conda": "base"})
+    def ping():
+        return "ok"
+
+    # The fake's `conda run` resolves the named env to the system
+    # python, so the worker is just the system interpreter.
+    assert ray_tpu.get(ping.remote()) == "ok"
+
+
+def test_conda_and_pip_are_mutually_exclusive(cluster):
+    from ray_tpu.runtime.node import build_runtime_env
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        build_runtime_env({"conda": ["a"], "pip": ["b"]})
+    # And fail FAST at submission too.
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        ray_tpu.remote(runtime_env={"conda": ["a"], "uv": ["b"]})(
+            lambda: 1
+        )
+
+
+# -------------------------------------------------------------- container
+
+
+def test_containerized_worker(cluster, tmp_path):
+    log = tmp_path / "podman.log"
+    os.environ["PODMAN_FAKE_LOG"] = str(log)
+
+    @ray_tpu.remote(
+        runtime_env={
+            "container": {"image": "example.com/raytpu:test"},
+            "env_vars": {"INSIDE": "box"},
+        }
+    )
+    def who():
+        return os.environ.get("INSIDE"), os.getpid()
+
+    inside, pid = ray_tpu.get(who.remote())
+    assert inside == "box"
+    rec = ast.literal_eval(log.read_text().splitlines()[0])
+    assert rec["image"] == "example.com/raytpu:test"
+    # The worker command names the IMAGE's interpreter, never a host
+    # path (which would not exist inside a real container).
+    assert rec["cmd"][0] == "python3"
+    # The runtime's package root and store are mounted 1:1.
+    import ray_tpu as pkg
+
+    pkg_root = os.path.dirname(os.path.dirname(pkg.__file__))
+    assert any(m.startswith(pkg_root) for m in rec["mounts"])
+    assert "PYTHONPATH" in rec["env_keys"]
+    assert any("RAY_TPU_HEAD_ADDR" == k for k in rec["env_keys"])
+    assert rec["cmd"][0].endswith("python") or "python" in rec["cmd"][0]
+
+
+def test_image_uri_shorthand(cluster, tmp_path):
+    log = tmp_path / "podman2.log"
+    os.environ["PODMAN_FAKE_LOG"] = str(log)
+
+    @ray_tpu.remote(runtime_env={"image_uri": "example.com/other:1"})
+    def ping():
+        return "containered"
+
+    assert ray_tpu.get(ping.remote()) == "containered"
+    rec = ast.literal_eval(log.read_text().splitlines()[0])
+    assert rec["image"] == "example.com/other:1"
+
+
+# ------------------------------------------------------------------- GC
+
+
+def _wait_gone(path, timeout=5.0):
+    """Deletion happens on a background thread; poll for it."""
+    import time
+
+    deadline = time.time() + timeout
+    while os.path.exists(path):
+        if time.time() > deadline:
+            raise AssertionError(f"{path} still exists")
+        time.sleep(0.02)
+
+
+def test_uri_cache_refcounted_eviction(tmp_path):
+    evicted = []
+    cache = UriCache(
+        max_total_bytes=1500, on_evict=evicted.append, min_idle_s=0
+    )
+    roots = {}
+    for name in ("a", "b"):
+        root = tmp_path / name
+        root.mkdir()
+        (root / "blob").write_bytes(b"x" * 1000)
+        roots[name] = str(root)
+        cache.register(name, str(root))
+    cache.acquire("a")
+    cache.acquire("b")
+    assert cache.total_bytes() == 2000  # over budget but both pinned
+
+    cache.release("a")  # a unreferenced, b pinned → a evicts
+    assert evicted == ["a"]
+    _wait_gone(roots["a"])
+    assert os.path.exists(roots["b"])
+
+    cache.release("b")  # now b unreferenced; 1000 <= 1500 stays
+    assert evicted == ["a"]
+    assert os.path.exists(roots["b"])
+
+
+def test_uri_cache_evicts_oldest_idle_first(tmp_path):
+    evicted = []
+    cache = UriCache(
+        max_total_bytes=1000, on_evict=evicted.append, min_idle_s=0
+    )
+    for name in ("old", "new"):
+        root = tmp_path / name
+        root.mkdir()
+        (root / "blob").write_bytes(b"x" * 800)
+        cache.register(name, str(root))
+        cache.acquire(name)
+    cache.release("old")
+    assert evicted == ["old"]  # 1600 > 1000: idle 'old' goes
+    cache.release("new")
+    # 'new' at 800 <= 1000 survives its release.
+    assert evicted == ["old"]
+    assert os.path.exists(tmp_path / "new")
+
+
+def test_uri_cache_foreign_pid_pins_root(tmp_path):
+    """A live ref marker from ANOTHER process (a sibling node daemon
+    sharing the host cache) blocks eviction even at refs==0 here."""
+    evicted = []
+    cache = UriCache(
+        max_total_bytes=1, on_evict=evicted.append, min_idle_s=0
+    )
+    root = tmp_path / "shared"
+    (root / ".refs").mkdir(parents=True)
+    (root / "blob").write_bytes(b"x" * 100)
+    # PID 1 is alive (init) and is not us.
+    (root / ".refs" / "1").touch()
+    cache.register("shared", str(root))
+    cache.acquire("shared")
+    cache.release("shared")
+    assert evicted == []
+    assert os.path.exists(root)
+
+    # A DEAD foreign pid does not pin (and its marker is cleaned).
+    os.unlink(root / ".refs" / "1")
+    (root / ".refs" / "999999999").touch()
+    cache.acquire("shared")
+    cache.release("shared")
+    assert evicted == ["shared"]
+    _wait_gone(root)
+
+
+def test_uri_cache_min_idle_grace(tmp_path):
+    """A freshly built env (refs==0, not yet acquired by its spawning
+    worker) is not evictable inside the grace window."""
+    evicted = []
+    cache = UriCache(
+        max_total_bytes=1, on_evict=evicted.append, min_idle_s=60.0
+    )
+    root = tmp_path / "fresh"
+    root.mkdir()
+    (root / "blob").write_bytes(b"x" * 100)
+    cache.register("fresh", str(root))
+    cache.release("other")  # any release triggers an eviction sweep
+    assert evicted == []
+    assert os.path.exists(root)
+
+
+def test_env_cache_gc_end_to_end(cluster, tmp_path):
+    """A worker's death releases its env; over-budget unreferenced
+    envs are deleted on disk and forgotten in the build memo, and the
+    next use rebuilds cleanly."""
+    from ray_tpu import api as core_api
+    from ray_tpu.runtime import node as node_mod
+
+    wd = tmp_path / "appdir"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload " * 512)
+
+    env = {"working_dir": str(wd)}
+
+    @ray_tpu.remote(runtime_env=env)
+    def read():
+        return open("data.txt").read()[:7]
+
+    assert ray_tpu.get(read.remote()) == "payload"
+    h = node_mod.env_hash(env)
+    root = os.path.join(node_mod._ENV_CACHE_ROOT, h)
+    assert os.path.isdir(root)
+    assert node_mod._env_cache.refs(h) >= 1
+
+    # Shrink the budget, drop the fresh-build grace, and kill the env's
+    # pooled workers: the release pushes the now-unreferenced env out.
+    old_budget = node_mod._env_cache.max_total_bytes
+    old_grace = node_mod._env_cache.min_idle_s
+    node_mod._env_cache.max_total_bytes = 1
+    node_mod._env_cache.min_idle_s = 0
+    try:
+        node = core_api._runtime.node
+        for wid, w in list(node.workers.items()):
+            if w.get("env_hash") == h:
+                node._kill_worker(wid)
+        assert node_mod._env_cache.refs(h) == 0
+        _wait_gone(root)
+        assert h not in node_mod._built_envs
+    finally:
+        node_mod._env_cache.max_total_bytes = old_budget
+        node_mod._env_cache.min_idle_s = old_grace
+
+    # Next use rebuilds from scratch.
+    assert ray_tpu.get(read.remote()) == "payload"
+    assert os.path.isdir(root)

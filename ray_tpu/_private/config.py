@@ -77,6 +77,14 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "TLS_KEY": (str, "", "path to the PEM private key for TLS_CERT "
                          "(servers only)"),
     "RPC_MAX_FRAME": (int, 2 << 30, "largest accepted rpc frame (bytes)"),
+    "WORKER_MODE": (str, "subprocess", "worker isolation: 'subprocess' "
+                                       "(default) or 'inproc' — scale-"
+                                       "simulation mode where workers "
+                                       "are CoreWorkers on the node "
+                                       "loop; the control plane "
+                                       "(registration, leases, sync, "
+                                       "journal) stays real, only "
+                                       "process isolation is simulated"),
     # --- runtime envs
     "ENV_CACHE_BYTES": (int, 10 << 30, "built runtime-env cache budget; "
                                        "unreferenced envs evict oldest-"

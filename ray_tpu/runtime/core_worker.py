@@ -2156,7 +2156,11 @@ class CoreWorker:
             return {"status": "error", "error": _dumps_small(_as_task_error(e))}
 
     async def _on_exit_worker(self, conn):
-        asyncio.get_running_loop().call_later(0.05, _hard_exit)
+        # Process workers die hard; inproc workers (WORKER_MODE=inproc,
+        # node.py _spawn_worker_inproc) install a soft stop — one
+        # simulated worker must not take the host process with it.
+        cb = getattr(self, "_exit_cb", None) or _hard_exit
+        asyncio.get_running_loop().call_later(0.05, cb)
         return {"ok": True}
 
     # -------------------------------------------------- execution loop

@@ -661,10 +661,85 @@ class HeadService:
         """Gang-reserve resource bundles (reference:
         GcsPlacementGroupManager gcs_placement_group_manager.h:50 with the
         2PC prepare/commit scheduler gcs_placement_group_scheduler.h:115;
-        strategies python/ray/util/placement_group.py)."""
+        strategies python/ray/util/placement_group.py).
+
+        The plan comes from the head's resource VIEW, which can lag a
+        just-finished scheduling burst (sync is push-on-change); a node
+        may therefore refuse its reservation at prepare time. Like the
+        reference's scheduler, the refusal reschedules the group around
+        the refusing node instead of failing the creation.
+        """
+        excluded: set[str] = set()
+        last_error = "no nodes"
+        for _attempt in range(4):
+            plan = self._plan_placement(bundles, strategy, excluded)
+            if not plan.get("ok"):
+                return plan
+            placed = plan["placed"]
+            committed = []
+            refusing: str | None = None
+            try:
+                for (nid, i), bundle in zip(placed, bundles):
+                    reply = await self._node_conns[nid].call(
+                        "reserve_bundle",
+                        pg_id=pg_id,
+                        index=i,
+                        resources=bundle,
+                    )
+                    if not reply.get("ok"):
+                        refusing = nid
+                        raise rpc.RpcError(
+                            reply.get("error", "reserve failed")
+                        )
+                    committed.append((nid, i))
+            except Exception as e:  # noqa: BLE001 - roll back prepares
+                for nid, i in committed:
+                    try:
+                        await self._node_conns[nid].call(
+                            "free_bundle", pg_id=pg_id, index=i
+                        )
+                    except rpc.RpcError:
+                        pass
+                last_error = str(e)
+                if refusing is None:
+                    return {"ok": False, "error": last_error}
+                excluded.add(refusing)
+                continue
+            self.placement_groups[pg_id] = {
+                "bundles": bundles,
+                "strategy": strategy,
+                "nodes": [nid for nid, _ in placed],
+            }
+            self._journal_append(
+                "pg",
+                "put",
+                {
+                    "pg_id": pg_id,
+                    "fields": dict(self.placement_groups[pg_id]),
+                },
+            )
+            return {
+                "ok": True,
+                "nodes": [
+                    {"node_id": nid, "addr": self.nodes[nid]["addr"]}
+                    for nid, _ in placed
+                ],
+            }
+        return {
+            "ok": False,
+            "error": f"placement retries exhausted: {last_error}",
+        }
+
+    def _plan_placement(
+        self, bundles: list, strategy: str, excluded: set
+    ) -> dict:
+        """Pick a host node per bundle from the head's resource view.
+        Returns {"ok": True, "placed": [(node_id, idx)]} or an error."""
         placed: list[tuple[str, int]] = []  # (node_id, bundle_idx)
         avail = {
-            nid: dict(n["available"]) for nid, n in self.nodes.items()
+            nid: dict(n["available"])
+            for nid, n in self.nodes.items()
+            if nid not in excluded
         }
 
         def fits(nid, bundle):
@@ -674,7 +749,7 @@ class HeadService:
             for k, v in bundle.items():
                 avail[nid][k] = avail[nid].get(k, 0) - v
 
-        node_ids = list(self.nodes)
+        node_ids = list(avail)
         if not node_ids:
             return {"ok": False, "error": "no nodes"}
 
@@ -723,44 +798,7 @@ class HeadService:
                 take(chosen, bundle)
                 used.add(chosen)
                 placed.append((chosen, i))
-
-        # Prepare/commit on the owning nodes.
-        committed = []
-        try:
-            for (nid, i), bundle in zip(placed, bundles):
-                reply = await self._node_conns[nid].call(
-                    "reserve_bundle", pg_id=pg_id, index=i, resources=bundle
-                )
-                if not reply.get("ok"):
-                    raise rpc.RpcError(reply.get("error", "reserve failed"))
-                committed.append((nid, i))
-        except Exception as e:  # noqa: BLE001 - roll back prepared bundles
-            for nid, i in committed:
-                try:
-                    await self._node_conns[nid].call(
-                        "free_bundle", pg_id=pg_id, index=i
-                    )
-                except rpc.RpcError:
-                    pass
-            return {"ok": False, "error": str(e)}
-
-        self.placement_groups[pg_id] = {
-            "bundles": bundles,
-            "strategy": strategy,
-            "nodes": [nid for nid, _ in placed],
-        }
-        self._journal_append(
-            "pg",
-            "put",
-            {"pg_id": pg_id, "fields": dict(self.placement_groups[pg_id])},
-        )
-        return {
-            "ok": True,
-            "nodes": [
-                {"node_id": nid, "addr": self.nodes[nid]["addr"]}
-                for nid, _ in placed
-            ],
-        }
+        return {"ok": True, "placed": placed}
 
     async def _on_remove_placement_group(self, conn, pg_id: str):
         pg = self.placement_groups.pop(pg_id, None)

@@ -148,6 +148,28 @@ _built_envs: dict[str, dict] = {}  # env hash → {"python": ..., "cwd": ...}
 _env_build_lock = threading.Lock()
 
 
+def _locked_env_delete(h: str, root: str):
+    """GC deletion under the SAME per-hash flock build_runtime_env
+    takes: a concurrent rebuild of the just-evicted hash either waits
+    for the delete to finish (then rebuilds from a clean slate) or
+    holds the lock first (then the marker it wrote stays intact —
+    this delete re-checks and aborts)."""
+    import fcntl
+    import shutil as _shutil
+
+    os.makedirs(_ENV_CACHE_ROOT, exist_ok=True)
+    with open(os.path.join(_ENV_CACHE_ROOT, f".{h}.lock"), "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            if h in _built_envs:
+                # A rebuild re-registered this hash while the delete
+                # was queued: the tree is live again, leave it.
+                return
+            _shutil.rmtree(root, ignore_errors=True)
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
+
+
 def _make_env_cache():
     from ray_tpu._private import config
     from ray_tpu.runtime.runtime_env import UriCache
@@ -157,6 +179,7 @@ def _make_env_cache():
     return UriCache(
         config.get("ENV_CACHE_BYTES"),
         on_evict=lambda h: _built_envs.pop(h, None),
+        delete_fn=_locked_env_delete,
     )
 
 
@@ -463,6 +486,18 @@ class NodeManager:
         worker_id = WorkerID.random().hex()
         if ehash is None:
             ehash = env_hash(runtime_env)
+        from ray_tpu._private import config
+
+        if config.get("WORKER_MODE") == "inproc":
+            # Scale-simulation mode (see the WORKER_MODE knob and the
+            # reference's many-node release benchmarks,
+            # release/benchmarks/distributed/test_many_actors.py): the
+            # worker is a CoreWorker on this node's loop. It still
+            # dials the node/head over real sockets and registers like
+            # a process worker — the control plane cannot tell the
+            # difference — but costs ~100 KB instead of an interpreter,
+            # so thousands of actors fit one host.
+            return self._spawn_worker_inproc(worker_id, runtime_env, ehash)
         # Workers must find the ray_tpu package regardless of their cwd.
         import ray_tpu
 
@@ -531,41 +566,53 @@ class NodeManager:
             # pipeline.
             "PYTHONUNBUFFERED": "1",
         }
-        if in_container:
-            # Containerized worker (reference: image_uri.py — the worker
-            # command runs under podman/docker with host networking and
-            # the runtime's paths mounted 1:1 so PYTHONPATH/store paths
-            # stay valid inside). Only the vars the worker needs are
-            # forwarded — the host environ is not the container's.
-            fwd = {
-                k: v
-                for k, v in env.items()
-                if k.startswith(("RAY_TPU_", "PYTHON", "JAX_"))
-                or k in self.worker_env
-                or k in (renv.get("env_vars") or {})
-            }
-            mounts = [
-                pkg_root,
-                self.store_dir,
-                _ENV_CACHE_ROOT,
-                built.get("cwd") or "",
-                *[os.path.abspath(m) for m in renv.get("py_modules", ())],
-            ]
-            argv = renv_mod.wrap_container_argv(
-                renv, argv, fwd, mounts, built.get("cwd")
-            )
-        # Capture stdio to a per-worker log file (reference: worker logs
-        # under /tmp/ray/session_*/logs; log_monitor tails them).
-        self.log_dir.mkdir(parents=True, exist_ok=True)
-        log_path = self.log_dir / f"worker-{worker_id}.log"
-        with open(log_path, "ab") as log_f:
-            proc = subprocess.Popen(
-                argv,
-                env=env,
-                cwd=built.get("cwd"),
-                stdout=log_f,
-                stderr=subprocess.STDOUT,
-            )
+        try:
+            if in_container:
+                # Containerized worker (reference: image_uri.py — the
+                # worker command runs under podman/docker with host
+                # networking and the runtime's paths mounted 1:1 so
+                # PYTHONPATH/store paths stay valid inside). Only the
+                # vars the worker needs are forwarded — the host
+                # environ is not the container's.
+                fwd = {
+                    k: v
+                    for k, v in env.items()
+                    if k.startswith(("RAY_TPU_", "PYTHON", "JAX_"))
+                    or k in self.worker_env
+                    or k in (renv.get("env_vars") or {})
+                }
+                mounts = [
+                    pkg_root,
+                    self.store_dir,
+                    _ENV_CACHE_ROOT,
+                    built.get("cwd") or "",
+                    *[
+                        os.path.abspath(m)
+                        for m in renv.get("py_modules", ())
+                    ],
+                ]
+                argv = renv_mod.wrap_container_argv(
+                    renv, argv, fwd, mounts, built.get("cwd")
+                )
+            # Capture stdio to a per-worker log file (reference: worker
+            # logs under /tmp/ray/session_*/logs; log_monitor tails
+            # them).
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            log_path = self.log_dir / f"worker-{worker_id}.log"
+            with open(log_path, "ab") as log_f:
+                proc = subprocess.Popen(
+                    argv,
+                    env=env,
+                    cwd=built.get("cwd"),
+                    stdout=log_f,
+                    stderr=subprocess.STDOUT,
+                )
+        except Exception:
+            # Spawn failed before a worker record existed: nothing will
+            # ever release the ref taken above, so release it here or
+            # the env is pinned against GC forever.
+            _env_cache.release(ehash)
+            raise
         self.workers[worker_id] = {
             "proc": proc,
             "state": "spawning",
@@ -573,6 +620,65 @@ class NodeManager:
             "runtime_env": runtime_env,
             "log_path": str(log_path),
         }
+        return worker_id
+
+    def _spawn_worker_inproc(
+        self, worker_id: str, runtime_env: dict | None, ehash: str
+    ) -> str:
+        self.workers[worker_id] = {
+            "proc": None,
+            "inproc": True,
+            "state": "spawning",
+            "env_hash": ehash,
+            "runtime_env": runtime_env,
+            "log_path": "",
+        }
+
+        async def boot():
+            from ray_tpu.runtime.core_worker import CoreWorker
+
+            core = CoreWorker(
+                mode="worker",
+                head_addr=self.head_addr,
+                node_addr=self.addr or "",
+                store_dir=self.store_dir,
+                worker_id=worker_id,
+            )
+            def soft_exit():
+                # Mark the record so the reap loop runs the same death
+                # path (lease failure, head notification) a subprocess
+                # worker's proc.poll() would trigger.
+                w2 = self.workers.get(worker_id)
+                if w2 is not None:
+                    w2["exited"] = True
+                asyncio.ensure_future(core.stop())
+
+            core._exit_cb = soft_exit
+            try:
+                addr = await core.start()
+                w = self.workers.get(worker_id)
+                if w is None:  # killed while booting
+                    await core.stop()
+                    return
+                w["core"] = core
+                await core.node.call(
+                    "register_worker",
+                    worker_id=worker_id,
+                    addr=addr,
+                    pid=os.getpid(),
+                )
+            except Exception:  # noqa: BLE001 - boot failed
+                # A subprocess worker dying mid-boot is reaped via
+                # proc.poll(); mark this one so the reap loop runs the
+                # same path (record cleanup, waiter replacement)
+                # instead of leaving a permanent "spawning" zombie
+                # whose n_spawning count blocks future spawns.
+                w2 = self.workers.get(worker_id)
+                if w2 is not None:
+                    w2["exited"] = True
+                await core.stop()
+
+        asyncio.ensure_future(boot())
         return worker_id
 
     # ------------------------------------------------------------ leases
@@ -1088,6 +1194,9 @@ class NodeManager:
         proc = w.get("proc")
         if proc and proc.poll() is None:
             proc.kill()
+        core = w.get("core")
+        if core is not None:  # inproc worker: stop its rpc endpoints
+            asyncio.ensure_future(core.stop())
         _env_cache.release(ehash)
 
     def _drain_pending(self):
@@ -1429,7 +1538,11 @@ class NodeManager:
             dead = [
                 wid
                 for wid, w in self.workers.items()
-                if w.get("proc") is not None and w["proc"].poll() is not None
+                if (
+                    w.get("proc") is not None
+                    and w["proc"].poll() is not None
+                )
+                or w.get("exited")  # inproc worker told to exit
             ]
             for wid in dead:
                 w = self.workers.pop(wid, None)

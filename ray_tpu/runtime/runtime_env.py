@@ -224,10 +224,16 @@ class UriCache:
     """
 
     def __init__(self, max_total_bytes: int, on_evict=None,
-                 min_idle_s: float = 30.0):
+                 min_idle_s: float = 30.0, delete_fn=None):
         self.max_total_bytes = max_total_bytes
         self.min_idle_s = min_idle_s
         self._on_evict = on_evict
+        # delete_fn(h, root) runs on the GC thread; the node passes one
+        # that holds the per-hash build flock so a concurrent rebuild of
+        # the same hash cannot interleave with the delete.
+        self._delete_fn = delete_fn or (
+            lambda _h, root: shutil.rmtree(root, ignore_errors=True)
+        )
         self._lock = threading.Lock()
         # hash → {root, bytes, refs, last_used}
         self._entries: dict[str, dict] = {}
@@ -284,10 +290,9 @@ class UriCache:
             if self._on_evict:
                 self._on_evict(eh)
         if evicted:
-            roots = [root for _h, root in evicted]
             threading.Thread(
                 target=lambda: [
-                    shutil.rmtree(r, ignore_errors=True) for r in roots
+                    self._delete_fn(h, root) for h, root in evicted
                 ],
                 name="ray_tpu-env-gc",
                 daemon=True,

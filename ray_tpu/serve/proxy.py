@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import asyncio
 import json
-import time
 import urllib.parse
 
 from ray_tpu.serve.handle import (

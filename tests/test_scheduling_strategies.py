@@ -148,3 +148,51 @@ def test_placement_group_strategy_object(cluster, zone_b_node):
         assert addr
     finally:
         remove_placement_group(pg)
+
+
+def test_pg_reschedules_around_refusing_node(cluster, zone_b_node):
+    """The head plans from its resource VIEW; a node whose actual
+    availability lags the view refuses reserve_bundle at prepare time.
+    Creation must reschedule on another node, not fail (found by the
+    50x1000 scale smoke; reference: GcsPlacementGroupScheduler retries
+    on failed prepares, gcs_placement_group_scheduler.h:115)."""
+    from ray_tpu.placement import placement_group, remove_placement_group
+
+    import time
+
+    from ray_tpu.util import state
+
+    # Wait for the head's resource view to recover from the module's
+    # earlier tests: every node must show the bundle as feasible so the
+    # ONLY failure source is our injected refusal.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        nodes = state.list_nodes()
+        if len(nodes) >= 2 and all(
+            n["available"].get("CPU", 0) >= 1 for n in nodes
+        ):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"view never recovered: {state.list_nodes()}")
+
+    rt = core_api._runtime
+    orig = rt.node._on_reserve_bundle
+    refused = []
+
+    async def refuse_once(conn, pg_id, index, resources):
+        if not refused:
+            refused.append(pg_id)
+            return {"ok": False, "error": "stale view: no capacity"}
+        return await orig(conn, pg_id=pg_id, index=index,
+                          resources=resources)
+
+    rt.node._on_reserve_bundle = refuse_once
+    try:
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert refused, "the driver node should have been tried first"
+        # The bundle landed on the OTHER node.
+        assert pg.node_infos[0]["node_id"] == zone_b_node.node_id
+        remove_placement_group(pg)
+    finally:
+        rt.node._on_reserve_bundle = orig

@@ -1,9 +1,13 @@
 """Blocks: the unit of data held in the object store.
 
 The reference's block is a pyarrow Table in plasma (reference:
-python/ray/data/block.py, `BlockAccessor`). Here the canonical block is a
-**columnar dict of numpy arrays** — the zero-copy host format for feeding
-JAX/TPU input pipelines — with pandas/arrow conversion at the edges.
+python/ray/data/block.py, `BlockAccessor`; arrow_block.py:213
+ArrowBlockAccessor). Here a block is EITHER a **columnar dict of numpy
+arrays** — the zero-copy host format for feeding JAX/TPU input
+pipelines — or a **pyarrow Table** (Arrow-native scans keep their
+table; see arrow_block.py). Every function below dispatches on the
+block kind; ops that need column math call :func:`ensure_numpy` once
+at their kernel entry.
 """
 
 from __future__ import annotations
@@ -12,8 +16,38 @@ from typing import Any, Iterable, Iterator
 
 import numpy as np
 
-# A block is dict[str, np.ndarray]; all columns share length.
-Block = dict
+try:
+    # Eager import so the tensor extension type registers in EVERY
+    # process that touches blocks BEFORE any table is deserialized or
+    # scanned — a fresh worker reading parquet written elsewhere must
+    # already know ray_tpu.tensor or the column degrades to a plain
+    # fixed_size_list and loses its shape.
+    from ray_tpu.data import arrow_block as _arrow_mod
+except ImportError:  # pyarrow not installed: numpy-dict blocks only
+    _arrow_mod = None
+
+# A block is dict[str, np.ndarray] | pyarrow.Table; columns share length.
+Block = Any
+
+
+def _arrow():
+    if _arrow_mod is None:
+        raise ImportError("pyarrow is required for Arrow blocks")
+    return _arrow_mod
+
+
+def _is_table(block) -> bool:
+    if isinstance(block, dict) or block is None or _arrow_mod is None:
+        return False
+    return _arrow_mod.is_arrow_block(block)
+
+
+def ensure_numpy(block: Block) -> dict:
+    """Normalize to the numpy column dict (one conversion, at the edge
+    where column math happens — sort/groupby/join kernels)."""
+    if _is_table(block):
+        return _arrow().numpy_dict_from_table(block)
+    return block
 
 
 def _as_array(values) -> np.ndarray:
@@ -54,16 +88,23 @@ def from_pandas(df) -> Block:
 
 
 def from_arrow(table) -> Block:
-    return {name: col.to_numpy(zero_copy_only=False) for name, col in zip(table.column_names, table.columns)}
+    """Arrow tables ARE blocks now — the scan's table flows through
+    the pipeline without an eager numpy copy (conversion happens only
+    at a numpy/pandas batch edge or a column-math kernel)."""
+    return table
 
 
 def num_rows(block: Block) -> int:
+    if _is_table(block):
+        return _arrow().num_rows(block)
     if not block:
         return 0
     return len(next(iter(block.values())))
 
 
 def size_bytes(block: Block) -> int:
+    if _is_table(block):
+        return _arrow().size_bytes(block)
     total = 0
     for arr in block.values():
         if arr.dtype == object:
@@ -74,14 +115,20 @@ def size_bytes(block: Block) -> int:
 
 
 def schema(block: Block) -> dict[str, Any]:
+    if _is_table(block):
+        return _arrow().schema(block)
     return {k: v.dtype for k, v in block.items()}
 
 
 def slice_block(block: Block, start: int, end: int) -> Block:
+    if _is_table(block):
+        return _arrow().slice_table(block, start, end)  # zero-copy
     return {k: v[start:end] for k, v in block.items()}
 
 
 def take_idx(block: Block, idx: np.ndarray) -> Block:
+    if _is_table(block):
+        return _arrow().take_table(block, idx)
     return {k: v[idx] for k, v in block.items()}
 
 
@@ -89,11 +136,19 @@ def concat(blocks: list[Block]) -> Block:
     blocks = [b for b in blocks if num_rows(b) > 0]
     if not blocks:
         return {}
+    if all(_is_table(b) for b in blocks):
+        return _arrow().concat_tables(blocks)
+    # Mixed ancestry (an Arrow scan unioned with numpy-born blocks):
+    # land on the numpy dict, the canonical compute format.
+    blocks = [ensure_numpy(b) for b in blocks]
     keys = blocks[0].keys()
     return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
 
 
 def to_rows(block: Block) -> Iterator[dict]:
+    if _is_table(block):
+        yield from _arrow().to_rows(block)
+        return
     n = num_rows(block)
     keys = list(block.keys())
     for i in range(n):
@@ -103,19 +158,27 @@ def to_rows(block: Block) -> Iterator[dict]:
 def to_pandas(block: Block):
     import pandas as pd
 
+    if _is_table(block):
+        return block.to_pandas()
     return pd.DataFrame({k: list(v) if v.dtype == object else v for k, v in block.items()})
+
+
+def to_arrow(block: Block):
+    """Block → pyarrow Table; ndim>=2 numpy columns become tensor
+    extension columns (arrow_block.ArrowTensorType)."""
+    if _is_table(block):
+        return block
+    return _arrow().table_from_numpy_dict(block)
 
 
 def to_batch(block: Block, batch_format: str):
     """Convert a block to the user-facing batch format."""
     if batch_format in ("numpy", "default", None):
-        return dict(block)
+        return ensure_numpy(block) if _is_table(block) else dict(block)
     if batch_format == "pandas":
         return to_pandas(block)
     if batch_format == "pyarrow":
-        import pyarrow as pa
-
-        return pa.table({k: list(v) if v.dtype == object else v for k, v in block.items()})
+        return to_arrow(block)
     raise ValueError(f"unknown batch_format {batch_format!r}")
 
 
@@ -136,7 +199,7 @@ def from_batch(batch) -> Block:
         import pyarrow as pa
 
         if isinstance(batch, pa.Table):
-            return from_arrow(batch)
+            return batch  # Arrow-native: stays a table
     except ImportError:
         pass
     raise TypeError(f"map_batches must return dict/DataFrame/Table, got {type(batch)}")

@@ -194,6 +194,13 @@ class Dataset:
     def to_pandas(self):
         return B.to_pandas(B.concat(list(self.iter_blocks())))
 
+    def to_arrow(self):
+        """Materialize as one pyarrow Table; ndim>=2 numpy columns
+        become tensor extension columns (reference: Dataset.to_arrow_refs,
+        data/dataset.py — block-level tables concatenated here since
+        the driver already holds the refs)."""
+        return B.to_arrow(B.concat(list(self.iter_blocks())))
+
     def schema(self) -> dict:
         for blk in self.iter_blocks():
             if B.num_rows(blk):
@@ -220,15 +227,15 @@ class Dataset:
     def write_parquet(self, path: str) -> None:
         import os
 
-        import pyarrow as pa
         import pyarrow.parquet as pq
 
         os.makedirs(path, exist_ok=True)
         for i, blk in enumerate(self.iter_blocks()):
             if not B.num_rows(blk):
                 continue
-            tbl = pa.table({k: list(v) if v.dtype == object else v
-                            for k, v in blk.items()})
+            # Arrow blocks pass through; numpy dicts convert (ndim>=2
+            # columns become tensor extension columns).
+            tbl = B.to_arrow(blk)
             pq.write_table(tbl, os.path.join(path, f"part-{i:05d}.parquet"))
 
     def stats(self) -> str:

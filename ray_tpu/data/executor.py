@@ -85,12 +85,20 @@ def _apply_chain(chain: list, blk: B.Block) -> B.Block:
             blk = B.from_rows(rows)
         elif kind == "add_column":
             _, name, fn = op
-            blk = dict(blk)
+            blk = dict(B.ensure_numpy(blk))
             blk[name] = B._as_array(fn(dict(blk)))
         elif kind == "drop_columns":
-            blk = {k: v for k, v in blk.items() if k not in op[1]}
+            if B._is_table(blk):
+                blk = blk.drop_columns(
+                    [c for c in op[1] if c in blk.column_names]
+                )
+            else:
+                blk = {k: v for k, v in blk.items() if k not in op[1]}
         elif kind == "select_columns":
-            blk = {k: blk[k] for k in op[1]}
+            if B._is_table(blk):
+                blk = blk.select(op[1])
+            else:
+                blk = {k: blk[k] for k in op[1]}
         else:
             raise AssertionError(kind)
     return blk
@@ -153,6 +161,7 @@ def _shuffle_reduce(seed, *parts):
 
 @ray_tpu.remote
 def _sample_keys(key, k, blk):
+    blk = B.ensure_numpy(blk)
     nr = B.num_rows(blk)
     if nr == 0:
         return np.array([])
@@ -162,6 +171,7 @@ def _sample_keys(key, k, blk):
 
 @ray_tpu.remote
 def _range_part(key, boundaries, blk):
+    blk = B.ensure_numpy(blk)
     n = len(boundaries) + 1
     keys = blk[key]
     assign = np.searchsorted(boundaries, keys, side="right")
@@ -171,7 +181,7 @@ def _range_part(key, boundaries, blk):
 
 @ray_tpu.remote
 def _merge_sorted(key, descending, *parts):
-    blk = B.concat(list(parts))
+    blk = B.ensure_numpy(B.concat(list(parts)))
     order = np.argsort(blk[key], kind="stable") if blk else np.array([], dtype=np.int64)
     if descending:
         order = order[::-1]
@@ -190,6 +200,7 @@ def _stable_hash(k, n: int) -> int:
 
 @ray_tpu.remote
 def _hash_part(key, n, blk):
+    blk = B.ensure_numpy(blk)
     if not blk:
         return tuple({} for _ in range(n)) if n > 1 else {}
     keys = blk[key]
@@ -206,7 +217,7 @@ def _agg_one(kind, vals):
 
 @ray_tpu.remote
 def _agg_partition(key, aggs, *parts):
-    blk = B.concat(list(parts))
+    blk = B.ensure_numpy(B.concat(list(parts)))
     if not blk:
         return {}
     rows = []
@@ -229,7 +240,7 @@ def _agg_partition(key, aggs, *parts):
 
 @ray_tpu.remote
 def _map_groups(key, fn, batch_format, *parts):
-    blk = B.concat(list(parts))
+    blk = B.ensure_numpy(B.concat(list(parts)))
     if not blk:
         return {}
     keys = blk[key]
@@ -244,8 +255,10 @@ def _map_groups(key, fn, batch_format, *parts):
 
 @ray_tpu.remote
 def _zip_blocks(meta, left, *rights):
-    right = B.concat([B.slice_block(rights[i], s, e) for i, s, e in meta])
-    out = dict(left)
+    right = B.ensure_numpy(
+        B.concat([B.slice_block(rights[i], s, e) for i, s, e in meta])
+    )
+    out = dict(B.ensure_numpy(left))
     for k, v in right.items():
         out[k if k not in out else k + "_1"] = v
     return out
@@ -493,6 +506,7 @@ def execute(plan: P.LogicalPlan, ctx: DataContext | None = None) -> Iterator:
 
 @ray_tpu.remote
 def _block_schema(blk):
+    blk = B.ensure_numpy(blk)
     return {c: str(blk[c].dtype) for c in blk}
 
 
@@ -519,6 +533,9 @@ def _join_fill(dtype, n: int) -> np.ndarray:
 
 @ray_tpu.remote
 def _hash_join(on, how, suffix, lschema, rschema, n_left, *parts):
+    # Join inputs can arrive as Arrow tables (direct joins without a
+    # repartition pass); the kernel does numpy column math throughout.
+    parts = tuple(B.ensure_numpy(p) for p in parts)
     left = [p for p in parts[:n_left] if p]
     right = [p for p in parts[n_left:] if p]
     left = B.concat(left) if left else {}

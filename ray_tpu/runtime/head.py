@@ -677,20 +677,28 @@ class HeadService:
                 return plan
             placed = plan["placed"]
             committed = []
-            refusing: str | None = None
+            failing: str | None = None
             try:
                 for (nid, i), bundle in zip(placed, bundles):
-                    reply = await self._node_conns[nid].call(
+                    # Any failure against THIS node — an explicit
+                    # refusal (stale view), a dropped conn, or a node
+                    # that died after planning — reschedules around it;
+                    # other nodes may still fit the group.
+                    failing = nid
+                    conn_ = self._node_conns.get(nid)
+                    if conn_ is None:
+                        raise rpc.RpcError(f"node {nid} has no conn")
+                    reply = await conn_.call(
                         "reserve_bundle",
                         pg_id=pg_id,
                         index=i,
                         resources=bundle,
                     )
                     if not reply.get("ok"):
-                        refusing = nid
                         raise rpc.RpcError(
                             reply.get("error", "reserve failed")
                         )
+                    failing = None
                     committed.append((nid, i))
             except Exception as e:  # noqa: BLE001 - roll back prepares
                 for nid, i in committed:
@@ -701,9 +709,9 @@ class HeadService:
                     except rpc.RpcError:
                         pass
                 last_error = str(e)
-                if refusing is None:
+                if failing is None:
                     return {"ok": False, "error": last_error}
-                excluded.add(refusing)
+                excluded.add(failing)
                 continue
             self.placement_groups[pg_id] = {
                 "bundles": bundles,

@@ -475,6 +475,15 @@ class NodeManager:
                     proc.wait(timeout=2)
                 except subprocess.TimeoutExpired:
                     proc.kill()
+            core = w.get("core")
+            if core is not None:
+                # Inproc workers (WORKER_MODE=inproc) have no process
+                # to reap: stop their CoreWorker servers/tasks or they
+                # keep running on the loop after the node is gone.
+                try:
+                    await core.stop()
+                except Exception:  # noqa: BLE001 - best-effort teardown
+                    pass
         if self.head:
             await self.head.close()
         await self.server.stop()

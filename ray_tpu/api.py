@@ -288,8 +288,11 @@ def put(value: Any) -> ObjectRef:
 
 
 def broadcast(
-    ref: "ObjectRef", timeout: float | None = None, strict: bool = True
-) -> int:
+    ref: "ObjectRef",
+    timeout: float | None = None,
+    strict: bool = True,
+    return_details: bool = False,
+):
     """Relay-broadcast a store-resident object into every node's store
     (reference: put-then-fan-out rides push_manager.h:28 chunked pushes;
     here waves of node prefetches double the source set each round).
@@ -300,7 +303,8 @@ def broadcast(
     With ``strict`` (default), a node that could not be reached raises
     ObjectLostError naming it — callers relying on every-node locality
     must not silently proceed without it. ``strict=False`` returns the
-    partial count instead."""
+    partial count instead. ``return_details`` returns the full reply
+    dict (nodes/cached/failed/waves) instead of the count."""
     reply = _runtime.run(
         _runtime.core.broadcast_object(ref, timeout), timeout
     )
@@ -311,7 +315,7 @@ def broadcast(
             f"broadcast incomplete ({reply['nodes']} pulled, "
             f"{len(reply['failed'])} failed): {reply['failed']}"
         )
-    return reply["nodes"]
+    return reply if return_details else reply["nodes"]
 
 
 def get(refs, timeout: float | None = _DEFAULT_TIMEOUT):

@@ -40,7 +40,13 @@ class ResNetConfig:
         shapes = jax.eval_shape(
             lambda k: init_params(k, self), jax.random.key(0)
         )
-        return sum(int(jnp.size(p)) for p in jax.tree.leaves(shapes))
+        # math.prod over .shape: jnp.size on a ShapeDtypeStruct is
+        # deprecated (DeprecationWarning per leaf, removal planned).
+        import math
+
+        return sum(
+            math.prod(p.shape) for p in jax.tree.leaves(shapes)
+        )
 
 
 PRESETS = {

@@ -13,7 +13,16 @@ rllib/core/learner/learner_group.py:101, rllib/core/rl_module/):
 """
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.appo import APPO, APPOConfig
 from ray_tpu.rl.bc import BC, BCConfig
+from ray_tpu.rl.connectors import (
+    CastObs,
+    ClipObs,
+    ClipReward,
+    Connector,
+    ConnectorPipeline,
+    MeanStdObsFilter,
+)
 from ray_tpu.rl.dqn import DQN, DQNConfig
 from ray_tpu.rl.env import CartPole, Env, make_env, register_env
 from ray_tpu.rl.env_runner import EnvRunnerGroup
@@ -24,11 +33,19 @@ from ray_tpu.rl.replay import ReplayBuffer
 from ray_tpu.rl.sac import SAC, SACConfig
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
     "Algorithm",
     "AlgorithmConfig",
     "BC",
     "BCConfig",
     "CartPole",
+    "CastObs",
+    "ClipObs",
+    "ClipReward",
+    "Connector",
+    "ConnectorPipeline",
+    "MeanStdObsFilter",
     "DQN",
     "DQNConfig",
     "Env",

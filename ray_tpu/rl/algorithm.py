@@ -38,6 +38,9 @@ class AlgorithmConfig:
     hidden: tuple = (64, 64)
     seed: int = 0
     mesh: Any = None  # jax.sharding.Mesh with a 'dp' axis, or None
+    # env-to-module ConnectorPipeline (rl/connectors.py); every runner
+    # gets a copy, running stats sync through the group.
+    connectors: Any = None
 
     def copy(self, **kwargs) -> "AlgorithmConfig":
         return replace(self, **kwargs)
@@ -63,6 +66,7 @@ class Algorithm:
             rollout_len=config.rollout_len,
             env_kwargs=config.env_kwargs,
             seed=config.seed,
+            connectors=config.connectors,
         )
         self.runners.set_weights(self.learner.get_weights())
         self.iteration = 0

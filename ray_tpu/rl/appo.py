@@ -1,0 +1,210 @@
+"""APPO: asynchronous PPO on the IMPALA architecture (reference:
+rllib/algorithms/appo/appo.py — IMPALA's async actor-learner loop with
+a PPO clipped-surrogate loss, V-trace off-policy correction computed
+against a periodically-refreshed TARGET network, and an optional KL
+penalty toward that target; loss math in
+appo/torch/appo_torch_learner.py).
+
+TPU-native shape: same async rollout consumption as rl/impala.py (the
+learner takes whichever runner's rollout lands first), with the whole
+V-trace recursion and clipped update in one jit program; the target
+network is just a second param pytree carried as an extra loss arg —
+no separate actor, no weight copy off-device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm, make_adam
+from ray_tpu.rl.impala import IMPALAConfig
+from ray_tpu.rl.learner import Learner
+
+
+def appo_loss(
+    params,
+    module,
+    batch,
+    target_params,
+    clip_eps,
+    gamma,
+    rho_clip,
+    c_clip,
+    vf_coeff,
+    ent_coeff,
+    kl_coeff,
+):
+    """Clipped surrogate on V-trace advantages; targets and the KL
+    anchor come from the TARGET network (reference: APPOTorchLearner
+    compute_loss_for_module — old_target_policy drives v-trace)."""
+    T, N = batch["actions"].shape
+    obs = batch["obs"].reshape(T * N, -1)
+    out = module.forward(params, obs)
+    logits = out["logits"].reshape(T, N, -1)
+    values = out["value"].reshape(T, N)
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None], axis=-1
+    )[..., 0]
+
+    tgt = jax.lax.stop_gradient(
+        jax.tree.map(lambda x: x, module.forward(target_params, obs))
+    )
+    tgt_logits = tgt["logits"].reshape(T, N, -1)
+    tgt_values = tgt["value"].reshape(T, N)
+    tgt_logp_all = jax.nn.log_softmax(tgt_logits)
+    tgt_logp = jnp.take_along_axis(
+        tgt_logp_all, batch["actions"][..., None], axis=-1
+    )[..., 0]
+
+    # V-trace with ratios of the TARGET policy vs the behavior policy
+    # (the target changes slowly, so the correction stays stable while
+    # the online policy takes several clipped steps against it).
+    rhos = jnp.exp(tgt_logp - batch["logp"])
+    clipped_rho = jnp.minimum(rhos, rho_clip)
+    cs = jnp.minimum(rhos, c_clip)
+    last_value = jax.lax.stop_gradient(
+        module.forward(target_params, batch["next_obs"])["value"]
+    )
+    discounts = gamma * (1.0 - batch["dones"])
+    next_values = jnp.concatenate(
+        [tgt_values[1:], last_value[None]], axis=0
+    )
+    deltas = clipped_rho * (
+        batch["rewards"] + discounts * next_values - tgt_values
+    )
+
+    def backward(carry, xs):
+        delta, disc, c = xs
+        carry = delta + disc * c * carry
+        return carry, carry
+
+    _, acc_rev = jax.lax.scan(
+        backward,
+        jnp.zeros(N),
+        (deltas[::-1], discounts[::-1], cs[::-1]),
+    )
+    vs = tgt_values + acc_rev[::-1]
+    vs_next = jnp.concatenate([vs[1:], last_value[None]], axis=0)
+    pg_adv = jax.lax.stop_gradient(
+        clipped_rho
+        * (batch["rewards"] + discounts * vs_next - tgt_values)
+    )
+    pg_adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+    # PPO clipped surrogate: the ONLINE policy's ratio vs behavior.
+    ratio = jnp.exp(logp - batch["logp"])
+    surrogate = -jnp.minimum(
+        ratio * pg_adv,
+        jnp.clip(ratio, 1 - clip_eps, 1 + clip_eps) * pg_adv,
+    ).mean()
+
+    vf_loss = 0.5 * ((jax.lax.stop_gradient(vs) - values) ** 2).mean()
+    entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+    # KL(target || online): keeps the online policy from drifting far
+    # from the policy that anchors the V-trace targets.
+    kl = (
+        (jnp.exp(tgt_logp_all) * (tgt_logp_all - logp_all))
+        .sum(-1)
+        .mean()
+    )
+    loss = (
+        surrogate
+        + vf_coeff * vf_loss
+        - ent_coeff * entropy
+        + kl_coeff * kl
+    )
+    return loss, {
+        "policy_loss": surrogate,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "kl_to_target": kl,
+        "mean_rho": rhos.mean(),
+        "clip_frac": (jnp.abs(ratio - 1) > clip_eps).mean(),
+    }
+
+
+@dataclass(frozen=True)
+class APPOConfig(IMPALAConfig):
+    clip_eps: float = 0.3
+    kl_coeff: float = 0.1
+    # Learner updates between target-network refreshes (reference:
+    # target_network_update_freq, counted in env steps there).
+    target_update_freq: int = 8
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(Algorithm):
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        self._inflight: dict = {}
+        self._updates_since_target = 0
+        self.target_params = jax.tree.map(
+            jnp.asarray, self.learner.params
+        )
+
+    def _make_learner(self) -> Learner:
+        cfg = self.config
+
+        def loss(params, module, batch, target_params):
+            return appo_loss(
+                params, module, batch, target_params, cfg.clip_eps,
+                cfg.gamma, cfg.rho_clip, cfg.c_clip, cfg.vf_coeff,
+                cfg.ent_coeff, cfg.kl_coeff,
+            )
+
+        return Learner(
+            self.module, loss, make_adam(cfg.lr), mesh=cfg.mesh,
+            seed=cfg.seed,
+        )
+
+    def training_step(self) -> dict:
+        if not self._inflight:
+            self._inflight = {
+                r.sample.remote(): r for r in self.runners.runners
+            }
+        ready, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=1, timeout=120
+        )
+        if not ready:
+            raise TimeoutError(
+                "APPO: no env-runner rollout completed within 120s "
+                f"({len(self._inflight)} outstanding)"
+            )
+        ref = ready[0]
+        runner = self._inflight.pop(ref)
+        s = ray_tpu.get(ref)
+        self._record_episodes([s])
+        if s.get("connector_state"):
+            self.runners.sync_connectors([s["connector_state"]])
+
+        batch = {
+            "obs": s["obs"],
+            "actions": s["actions"],
+            "rewards": s["rewards"],
+            "dones": s["dones"],
+            "logp": s["logp"],
+            "next_obs": s["next_obs"],
+        }
+        for _ in range(max(1, self.config.updates_per_rollout)):
+            metrics = self.learner.update(batch, self.target_params)
+            self._updates_since_target += 1
+            if self._updates_since_target >= self.config.target_update_freq:
+                self.target_params = jax.tree.map(
+                    jnp.asarray, self.learner.params
+                )
+                self._updates_since_target = 0
+        runner.set_weights.remote(self.learner.get_weights())
+        self._inflight[runner.sample.remote()] = runner
+        metrics["num_env_steps_sampled"] = int(s["rewards"].size)
+        return metrics
+
+    def stop(self) -> None:
+        self._inflight.clear()
+        super().stop()

@@ -31,6 +31,7 @@ class EnvRunner:
         num_envs: int,
         rollout_len: int,
         seed: int,
+        connectors=None,
     ):
         import jax
 
@@ -44,9 +45,31 @@ class EnvRunner:
         self._episode_returns = np.zeros(num_envs)
         self._completed: list[float] = []
         self._fwd = jax.jit(module.forward, backend="cpu")
+        # env-to-module connector pipeline (reference: connectors/
+        # env_to_module) — each runner actor owns its copy; running
+        # stats are merged by the group after sampling.
+        self.connectors = connectors
 
     def set_weights(self, params: Any) -> None:
         self.params = params
+
+    def get_connector_state(self) -> dict:
+        return self.connectors.get_state() if self.connectors else {}
+
+    def set_connector_state(self, state: dict) -> None:
+        if self.connectors:
+            self.connectors.set_state(state)
+
+    def _module_obs(self, obs: np.ndarray, update_stats: bool = True):
+        """Run the env-to-module pipeline; the transformed view is both
+        what the policy sees and what lands in the rollout buffer."""
+        if self.connectors is None:
+            return obs
+        out = self.connectors(
+            {"obs": obs.copy()},
+            {"phase": "step", "update_stats": update_stats},
+        )
+        return out["obs"]
 
     def sample(self, epsilon: float = 0.0) -> dict:
         """Collect [T, N, ...] batches; also returns logp/value for PPO."""
@@ -59,7 +82,8 @@ class EnvRunner:
         val_buf = np.zeros((T, N), np.float32)
 
         for t in range(T):
-            out = self._fwd(self.params, self.obs)
+            mobs = self._module_obs(self.obs)
+            out = self._fwd(self.params, mobs)
             logits = np.asarray(out["logits"])
             values = np.asarray(out["value"])
             # Sample from the categorical policy (Gumbel trick), with
@@ -74,7 +98,7 @@ class EnvRunner:
                     actions,
                 )
             logp = logits - _logsumexp(logits)
-            obs_buf[t] = self.obs
+            obs_buf[t] = mobs
             act_buf[t] = actions
             val_buf[t] = values
             logp_buf[t] = logp[np.arange(N), actions]
@@ -90,9 +114,12 @@ class EnvRunner:
                 self.obs[i] = nobs
 
         # Bootstrap value for the state after the last step (PPO GAE).
-        last_val = np.asarray(self._fwd(self.params, self.obs)["value"])
+        # update_stats=False: this obs is re-transformed (and counted)
+        # at the start of the next sample().
+        next_mobs = self._module_obs(self.obs, update_stats=False)
+        last_val = np.asarray(self._fwd(self.params, next_mobs)["value"])
         completed, self._completed = self._completed, []
-        return {
+        sample = {
             "obs": obs_buf,
             "actions": act_buf,
             "rewards": rew_buf,
@@ -100,9 +127,16 @@ class EnvRunner:
             "logp": logp_buf,
             "values": val_buf,
             "last_value": last_val,
-            "next_obs": self.obs.copy(),
+            "next_obs": next_mobs.copy(),
             "episode_returns": completed,
         }
+        if self.connectors is not None:
+            sample = self.connectors(sample, {"phase": "batch"})
+            # Deltas only (cleared on report): the group absorbs them
+            # into the global state and rebroadcasts — absolute states
+            # would re-count shared history once per runner per sync.
+            sample["connector_state"] = self.connectors.report_delta()
+        return sample
 
 
 def _logsumexp(x: np.ndarray) -> np.ndarray:
@@ -123,7 +157,11 @@ class EnvRunnerGroup:
         rollout_len: int = 64,
         env_kwargs: dict | None = None,
         seed: int = 0,
+        connectors=None,
     ):
+        # Driver-side pipeline copy: used to merge the per-runner
+        # running stats (reference: FilterManager.synchronize_filters).
+        self.connectors = connectors
         runner_cls = ray_tpu.remote(EnvRunner)
         self.runners = [
             runner_cls.remote(
@@ -133,6 +171,7 @@ class EnvRunnerGroup:
                 num_envs_per_runner,
                 rollout_len,
                 seed + 1000 * i,
+                connectors,
             )
             for i in range(num_runners)
         ]
@@ -141,4 +180,26 @@ class EnvRunnerGroup:
         ray_tpu.get([r.set_weights.remote(params) for r in self.runners])
 
     def sample(self, epsilon: float = 0.0) -> list[dict]:
-        return ray_tpu.get([r.sample.remote(epsilon) for r in self.runners])
+        samples = ray_tpu.get(
+            [r.sample.remote(epsilon) for r in self.runners]
+        )
+        self.sync_connectors(
+            [s.get("connector_state", {}) for s in samples]
+        )
+        return samples
+
+    def sync_connectors(self, deltas: list[dict]) -> None:
+        """Absorb per-runner delta reports into the driver's global
+        pipeline state and rebroadcast it, so every runner normalizes
+        with the same view and every observation is pooled exactly
+        once."""
+        if self.connectors is None:
+            return
+        deltas = [d for d in deltas if d]
+        if not deltas:
+            return
+        self.connectors.absorb_deltas(deltas)
+        merged = self.connectors.get_state()
+        ray_tpu.get(
+            [r.set_connector_state.remote(merged) for r in self.runners]
+        )

@@ -722,11 +722,12 @@ class CoreWorker:
         # concurrent pulls than links just thrash buffers (measured on
         # loopback; real clusters bound this by per-node NIC anyway).
         max_wave = 4
-        transferred = cached = 0
+        transferred = cached = waves = 0
         failed: list = []
         while pending:
             width = min(sources, max_wave)
             wave, pending = pending[:width], pending[width:]
+            waves += 1
 
             async def prefetch(addr):
                 c = await self._connect(addr, retries=1)
@@ -760,6 +761,9 @@ class CoreWorker:
             "nodes": transferred,
             "cached": cached,
             "failed": failed,
+            # Relay-tree depth: doubling waves mean ~log2(n) + cap
+            # spill, NOT n sequential pushes — floored in perf CI.
+            "waves": waves,
             "inline": False,
         }
 
@@ -2388,6 +2392,17 @@ class CoreWorker:
             )
             return {"status": "ok", "results": results}
         except Exception as e:  # noqa: BLE001 - travels to the owner
+            # Post-mortem attach point (reference: RAY_DEBUG_POST_MORTEM,
+            # util/rpdb.py): with RAY_TPU_POST_MORTEM set, the worker
+            # parks at the failure frame until a debugger attaches and
+            # continues; the error then travels to the owner as usual.
+            # Runs on an executor thread — the accept() must not block
+            # this event loop, which also answers node health RPCs.
+            from ray_tpu.util.rpdb import _maybe_post_mortem
+
+            await asyncio.get_running_loop().run_in_executor(
+                None, functools.partial(_maybe_post_mortem, e.__traceback__)
+            )
             self.record_task_event(
                 spec, "RUNNING", ts=exec_start,
                 dur=time.time() - exec_start, failed=True,

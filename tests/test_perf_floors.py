@@ -47,10 +47,16 @@ def test_microbench_floors():
     )
     assert bcast is not None, "benchmark 'broadcast' missing"
     # Aggregate store-to-store GB/s; conservative floor (the 1-core CI
-    # VM is memcpy-bound and noisy — this catches order-of-magnitude
-    # regressions like a return to sequential single-holder pulls).
-    assert bcast["agg_GB_s"] >= 0.02, (
+    # VM is memcpy-bound and noisy — this catches large regressions
+    # like a return to sequential single-holder pulls).
+    assert bcast["agg_GB_s"] >= 0.035, (
         f"broadcast regressed: {bcast['agg_GB_s']} GB/s aggregate"
+    )
+    # Relay-tree depth is what the code actually controls and is
+    # deterministic: 8 nodes through doubling waves (cap 4) is 1+2+4+1
+    # = 4 waves; sequential pushes would be 8.
+    assert bcast.get("waves", 99) <= 4, (
+        f"broadcast relay degraded to {bcast.get('waves')} waves"
     )
     llm = next(
         (r for r in results if r["name"].startswith("llm paged decode")),

@@ -1,0 +1,114 @@
+"""Remote debugger (reference test model: python/ray/tests/test_ray_debugger.py
+— set_trace blocks a task until a client attaches over TCP; post-mortem
+activation on failure behind the env flag)."""
+
+import socket
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+def _attach(port: int, timeout: float = 30.0) -> socket.socket:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=2)
+            s.settimeout(10)
+            return s
+        except OSError:
+            time.sleep(0.2)
+    raise TimeoutError(f"debugger never listened on {port}")
+
+
+def _recv_until(s: socket.socket, marker: bytes, limit: int = 65536) -> bytes:
+    buf = b""
+    while marker not in buf and len(buf) < limit:
+        chunk = s.recv(4096)
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_set_trace_blocks_until_continue(cluster):
+    port = _free_port()
+
+    @ray_tpu.remote
+    def stuck(port):
+        from ray_tpu.util import rpdb
+
+        secret = 41  # noqa: F841 - inspected through the debugger
+        rpdb.set_trace(port=port)
+        return secret + 1
+
+    ref = stuck.remote(port)
+    s = _attach(port)
+    banner = _recv_until(s, b"(ray_tpu-pdb) ")
+    assert b"rpdb.set_trace" in banner or b"stuck" in banner
+
+    s.sendall(b"p secret\n")
+    out = _recv_until(s, b"(ray_tpu-pdb) ")
+    assert b"41" in out
+
+    s.sendall(b"c\n")
+    s.close()
+    assert ray_tpu.get(ref, timeout=60) == 42
+
+
+def test_post_mortem_on_task_failure(cluster):
+    port = _free_port()
+
+    @ray_tpu.remote(
+        runtime_env={
+            "env_vars": {
+                "RAY_TPU_POST_MORTEM": "1",
+                "RAY_TPU_RPDB_PORT": str(port),
+            }
+        }
+    )
+    def boom():
+        clue = "smoking-gun"  # noqa: F841
+        raise ValueError("kapow")
+
+    ref = boom.remote()
+    s = _attach(port)
+    _recv_until(s, b"(ray_tpu-pdb) ")
+
+    # We are parked at the raise frame: locals are inspectable.
+    s.sendall(b"p clue\n")
+    out = _recv_until(s, b"(ray_tpu-pdb) ")
+    assert b"smoking-gun" in out
+
+    s.sendall(b"q\n")
+    s.close()
+    # The original error still reaches the owner after the session.
+    with pytest.raises(Exception, match="kapow"):
+        ray_tpu.get(ref, timeout=60)
+
+
+def test_post_mortem_disabled_by_default(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise RuntimeError("plain failure")
+
+    t0 = time.time()
+    with pytest.raises(Exception, match="plain failure"):
+        ray_tpu.get(boom.remote(), timeout=60)
+    assert time.time() - t0 < 30  # no debugger wait

@@ -194,6 +194,52 @@ def _dense_ffn(h: jnp.ndarray, p: Params, cfg: LlamaConfig):
     return (gate * up) @ p["w_down"].astype(dt), jnp.float32(0.0)
 
 
+def _int8_ckpt(x: jnp.ndarray, name: str) -> jnp.ndarray:
+    """Quantize-through-checkpoint: the value crossing the remat
+    boundary is int8 + a per-row fp32 scale (tagged for
+    save_only_these_names), halving the residual HBM of a saved bf16
+    activation. The compute graph continues on the DEQUANTIZED value
+    with a straight-through estimator, so gradients flow as identity
+    while the backward replay reconstructs the activation from the
+    saved int8 instead of re-running the producing matmul."""
+    scale = (
+        jnp.max(jnp.abs(x), axis=-1, keepdims=True).astype(jnp.float32)
+        / 127.0
+        + 1e-12
+    )
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    q = checkpoint_name(q, name)
+    scale = checkpoint_name(scale, name + "_scale")
+    dq = (q.astype(jnp.float32) * scale).astype(x.dtype)
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+def _dense_ffn_save(h: jnp.ndarray, p: Params, cfg: LlamaConfig):
+    """FFN with bf16-tagged gate-pre/up activations (the unquantized
+    sibling of :func:`_dense_ffn_q8`)."""
+    dt = cfg.dtype
+    gate_pre = checkpoint_name(h @ p["w_gate"].astype(dt), "ffn_gate")
+    up = checkpoint_name(h @ p["w_up"].astype(dt), "ffn_up")
+    return (jax.nn.silu(gate_pre) * up) @ p["w_down"].astype(dt), (
+        jnp.float32(0.0)
+    )
+
+
+def _dense_ffn_q8(h: jnp.ndarray, p: Params, cfg: LlamaConfig):
+    """FFN whose gate-pre/up activations cross the remat boundary as
+    int8: with their names pinned by the checkpoint policy, the
+    backward replay skips BOTH [B,S,d]x[d,ff] forward matmuls
+    (PROFILE_r04 'int8 saved FFN activations' lever)."""
+    dt = cfg.dtype
+    gate_pre = _int8_ckpt(h @ p["w_gate"].astype(dt), "ffn_gate")
+    up = _int8_ckpt(h @ p["w_up"].astype(dt), "ffn_up")
+    return (jax.nn.silu(gate_pre) * up) @ p["w_down"].astype(dt), (
+        jnp.float32(0.0)
+    )
+
+
 def _block(
     x: jnp.ndarray,
     p: Params,
@@ -285,6 +331,41 @@ def forward_with_aux(
             body,
             policy=jax.checkpoint_policies.save_only_these_names(
                 "flash_out", "flash_lse", "flash_qkv"
+            ),
+        )
+    elif cfg.remat == "flash_qkv_ffn":
+        # bf16-saved FFN activations (no quantization): same skipped
+        # recompute as ffn8 at 2x the residual memory — OOM-bound at
+        # bench scale (PROFILE_r03/r04), kept for smaller models.
+        if ffn_fn is _dense_ffn:
+            ffn_fn = _dense_ffn_save
+            body = partial(
+                _block, cos=cos, sin=sin, cfg=cfg, attn_fn=attn_fn,
+                ffn_fn=ffn_fn,
+            )
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse", "flash_qkv",
+                "ffn_gate", "ffn_up",
+            ),
+        )
+    elif cfg.remat == "flash_qkv_ffn8":
+        # "flash_qkv" plus int8-saved FFN activations: the replay skips
+        # the two FFN up-projection matmuls too, from residuals stored
+        # at half the bf16 footprint (gate over loss parity — see
+        # PROFILE_r04).
+        if ffn_fn is _dense_ffn:
+            ffn_fn = _dense_ffn_q8
+            body = partial(
+                _block, cos=cos, sin=sin, cfg=cfg, attn_fn=attn_fn,
+                ffn_fn=ffn_fn,
+            )
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse", "flash_qkv",
+                "ffn_gate", "ffn_gate_scale", "ffn_up", "ffn_up_scale",
             ),
         )
     elif cfg.remat == "dots":

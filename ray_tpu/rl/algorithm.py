@@ -115,12 +115,18 @@ class Algorithm:
 
     def save(self, path: str) -> str:
         os.makedirs(path, exist_ok=True)
+        connectors = self.runners.connectors
         with open(os.path.join(path, "algorithm.pkl"), "wb") as f:
             pickle.dump(
                 {
                     "weights": self.learner.get_weights(),
                     "iteration": self.iteration,
                     "config": self.config,
+                    # Filter statistics are part of the policy: a net
+                    # trained on normalized obs is garbage without them.
+                    "connector_state": (
+                        connectors.get_state() if connectors else None
+                    ),
                 },
                 f,
             )
@@ -132,14 +138,32 @@ class Algorithm:
         self.learner.set_weights(state["weights"])
         self.iteration = state["iteration"]
         self.runners.set_weights(self.learner.get_weights())
+        cstate = state.get("connector_state")
+        if cstate and self.runners.connectors is not None:
+            self.runners.connectors.set_state(cstate)
+            import ray_tpu
+
+            ray_tpu.get(
+                [
+                    r.set_connector_state.remote(cstate)
+                    for r in self.runners.runners
+                ]
+            )
 
     def get_policy_weights(self) -> Any:
         return self.learner.get_weights()
 
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
-        """Greedy action for a batch of observations (serving path)."""
+        """Greedy action for a batch of observations (serving path).
+        Observations run through the SAME connector pipeline the policy
+        trained on (stats frozen — serving must not mutate them)."""
         import jax.numpy as jnp
 
+        if self.runners.connectors is not None:
+            obs = self.runners.connectors(
+                {"obs": np.asarray(obs)},
+                {"phase": "step", "update_stats": False},
+            )["obs"]
         out = self.module.forward(self.learner.params, jnp.asarray(obs))
         return np.asarray(out["logits"].argmax(-1))
 

@@ -19,9 +19,8 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-import ray_tpu
-from ray_tpu.rl.algorithm import Algorithm, make_adam
-from ray_tpu.rl.impala import IMPALAConfig
+from ray_tpu.rl.algorithm import make_adam
+from ray_tpu.rl.impala import IMPALA, IMPALAConfig
 from ray_tpu.rl.learner import Learner
 
 
@@ -51,9 +50,9 @@ def appo_loss(
         logp_all, batch["actions"][..., None], axis=-1
     )[..., 0]
 
-    tgt = jax.lax.stop_gradient(
-        jax.tree.map(lambda x: x, module.forward(target_params, obs))
-    )
+    # No stop_gradient needed: grads are taken w.r.t. params only and
+    # target_params is a separate loss argument.
+    tgt = module.forward(target_params, obs)
     tgt_logits = tgt["logits"].reshape(T, N, -1)
     tgt_values = tgt["value"].reshape(T, N)
     tgt_logp_all = jax.nn.log_softmax(tgt_logits)
@@ -140,10 +139,14 @@ class APPOConfig(IMPALAConfig):
         return APPO(self)
 
 
-class APPO(Algorithm):
+class APPO(IMPALA):
+    """IMPALA's async loop (sample consumption, connector sync,
+    runner refresh) with the APPO loss and a target network — the only
+    differences ARE the loss and the target refresh, expressed through
+    IMPALA's _extra_update_args/_after_update hooks."""
+
     def __init__(self, config: APPOConfig):
         super().__init__(config)
-        self._inflight: dict = {}
         self._updates_since_target = 0
         self.target_params = jax.tree.map(
             jnp.asarray, self.learner.params
@@ -164,47 +167,13 @@ class APPO(Algorithm):
             seed=cfg.seed,
         )
 
-    def training_step(self) -> dict:
-        if not self._inflight:
-            self._inflight = {
-                r.sample.remote(): r for r in self.runners.runners
-            }
-        ready, _ = ray_tpu.wait(
-            list(self._inflight), num_returns=1, timeout=120
-        )
-        if not ready:
-            raise TimeoutError(
-                "APPO: no env-runner rollout completed within 120s "
-                f"({len(self._inflight)} outstanding)"
+    def _extra_update_args(self) -> tuple:
+        return (self.target_params,)
+
+    def _after_update(self) -> None:
+        self._updates_since_target += 1
+        if self._updates_since_target >= self.config.target_update_freq:
+            self.target_params = jax.tree.map(
+                jnp.asarray, self.learner.params
             )
-        ref = ready[0]
-        runner = self._inflight.pop(ref)
-        s = ray_tpu.get(ref)
-        self._record_episodes([s])
-        if s.get("connector_state"):
-            self.runners.sync_connectors([s["connector_state"]])
-
-        batch = {
-            "obs": s["obs"],
-            "actions": s["actions"],
-            "rewards": s["rewards"],
-            "dones": s["dones"],
-            "logp": s["logp"],
-            "next_obs": s["next_obs"],
-        }
-        for _ in range(max(1, self.config.updates_per_rollout)):
-            metrics = self.learner.update(batch, self.target_params)
-            self._updates_since_target += 1
-            if self._updates_since_target >= self.config.target_update_freq:
-                self.target_params = jax.tree.map(
-                    jnp.asarray, self.learner.params
-                )
-                self._updates_since_target = 0
-        runner.set_weights.remote(self.learner.get_weights())
-        self._inflight[runner.sample.remote()] = runner
-        metrics["num_env_steps_sampled"] = int(s["rewards"].size)
-        return metrics
-
-    def stop(self) -> None:
-        self._inflight.clear()
-        super().stop()
+            self._updates_since_target = 0

@@ -188,11 +188,15 @@ class EnvRunnerGroup:
         )
         return samples
 
-    def sync_connectors(self, deltas: list[dict]) -> None:
+    def sync_connectors(
+        self, deltas: list[dict], blocking: bool = True
+    ) -> None:
         """Absorb per-runner delta reports into the driver's global
         pipeline state and rebroadcast it, so every runner normalizes
         with the same view and every observation is pooled exactly
-        once."""
+        once. Async algorithms pass ``blocking=False``: actor calls
+        execute in order, so awaiting the broadcast would barrier on
+        every runner's in-flight rollout."""
         if self.connectors is None:
             return
         deltas = [d for d in deltas if d]
@@ -200,6 +204,8 @@ class EnvRunnerGroup:
             return
         self.connectors.absorb_deltas(deltas)
         merged = self.connectors.get_state()
-        ray_tpu.get(
-            [r.set_connector_state.remote(merged) for r in self.runners]
-        )
+        refs = [
+            r.set_connector_state.remote(merged) for r in self.runners
+        ]
+        if blocking:
+            ray_tpu.get(refs)

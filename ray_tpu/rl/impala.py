@@ -122,6 +122,15 @@ class IMPALA(Algorithm):
             seed=cfg.seed,
         )
 
+    # -- subclass hooks (APPO rides this loop; rl/appo.py) ---------------
+    def _extra_update_args(self) -> tuple:
+        """Extra positional args for the learner's loss (APPO: the
+        target-network params)."""
+        return ()
+
+    def _after_update(self) -> None:
+        """Called after each learner update (APPO: target refresh)."""
+
     def training_step(self) -> dict:
         # Keep one sample request outstanding per runner; consume the
         # FIRST one to finish (async actor-learner — other runners keep
@@ -136,14 +145,22 @@ class IMPALA(Algorithm):
         )
         if not ready:
             raise TimeoutError(
-                "IMPALA: no env-runner rollout completed within 120s "
-                f"({len(self._inflight)} outstanding) — envs hung or "
-                "cluster overloaded"
+                f"{type(self).__name__}: no env-runner rollout completed "
+                f"within 120s ({len(self._inflight)} outstanding) — envs "
+                "hung or cluster overloaded"
             )
         ref = ready[0]
         runner = self._inflight.pop(ref)
         s = ray_tpu.get(ref)
         self._record_episodes([s])
+        if s.get("connector_state"):
+            # Absorb this runner's filter deltas; non-blocking — the
+            # other runners' set_connector_state calls queue behind
+            # their in-flight rollouts, and awaiting them here would
+            # turn the async loop into a barrier.
+            self.runners.sync_connectors(
+                [s["connector_state"]], blocking=False
+            )
 
         batch = {
             "obs": s["obs"],
@@ -154,7 +171,10 @@ class IMPALA(Algorithm):
             "next_obs": s["next_obs"],
         }
         for _ in range(max(1, self.config.updates_per_rollout)):
-            metrics = self.learner.update(batch)
+            metrics = self.learner.update(
+                batch, *self._extra_update_args()
+            )
+            self._after_update()
         # Refresh only the runner that just reported, then put it back
         # to work; the rest run behind by design.
         runner.set_weights.remote(self.learner.get_weights())

@@ -109,6 +109,15 @@ class RemotePdb(pdb.Pdb):
 
     do_q = do_exit = do_quit
 
+    def do_EOF(self, arg):
+        # Abrupt client disconnect (nc killed, network drop) lands
+        # here: release the sockets or a pinned RAY_TPU_RPDB_PORT stays
+        # bound (EADDRINUSE) for every later session in this worker.
+        try:
+            return super().do_EOF(arg)
+        finally:
+            self._close()
+
 
 def set_trace(host: str | None = None, port: int | None = None):
     """Breakpoint inside a remote task/actor: blocks the task until a
@@ -125,9 +134,11 @@ def post_mortem(tb=None, host: str | None = None, port: int | None = None):
     if tb is None:
         raise ValueError("no traceback to debug")
     debugger = RemotePdb(host=host, port=port)
-    debugger.reset()
-    debugger.interaction(None, tb)
-    debugger._close()
+    try:
+        debugger.reset()
+        debugger.interaction(None, tb)
+    finally:
+        debugger._close()
 
 
 def _maybe_post_mortem(tb=None) -> bool:

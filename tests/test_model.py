@@ -118,3 +118,51 @@ def test_sharded_matches_single_device(mesh8):
     )
     out = jax.jit(lambda p, t: forward(p, t, CFG))(sp, st)
     np.testing.assert_allclose(ref, out, atol=2e-4, rtol=1e-4)
+
+
+def test_ffn_checkpoint_remat_modes_match_full():
+    """flash_qkv_ffn / flash_qkv_ffn8 numerics: the saved-activation
+    (and int8-quantized) FFN paths must match remat=full to bf16-level
+    (exact for bf16-saved; small bounded quantization error for int8 —
+    PROFILE_r04 records both modes' measured TPU throughput)."""
+    import dataclasses
+
+    from ray_tpu.models.llama import forward_with_aux
+
+    params = init_params(jax.random.key(0), CFG)
+    tokens = jax.random.randint(
+        jax.random.key(1), (2, 32), 0, CFG.vocab_size
+    )
+
+    def loss_and_grad(remat):
+        cfg = dataclasses.replace(CFG, remat=remat)
+
+        def loss(p):
+            logits, aux = forward_with_aux(p, tokens, cfg)
+            tgt = jnp.roll(tokens, -1, axis=1)
+            lp = jax.nn.log_softmax(logits)
+            return (
+                -jnp.take_along_axis(lp, tgt[..., None], axis=-1).mean()
+                + aux
+            )
+
+        return jax.jit(jax.value_and_grad(loss))(params)
+
+    l_full, g_full = loss_and_grad("full")
+    l_bf16, g_bf16 = loss_and_grad("flash_qkv_ffn")
+    l_q8, g_q8 = loss_and_grad("flash_qkv_ffn8")
+
+    # bf16-saved: identical math, only the residual set differs.
+    np.testing.assert_allclose(float(l_full), float(l_bf16), rtol=1e-6)
+    # int8-saved: bounded quantization error through the STE.
+    assert abs(float(l_full) - float(l_q8)) / float(l_full) < 0.02
+
+    def gnorm(g):
+        return float(
+            jax.tree_util.tree_reduce(
+                lambda a, b: a + jnp.sum(b.astype(jnp.float32) ** 2), g, 0.0
+            )
+        ) ** 0.5
+
+    np.testing.assert_allclose(gnorm(g_full), gnorm(g_bf16), rtol=1e-5)
+    np.testing.assert_allclose(gnorm(g_full), gnorm(g_q8), rtol=0.05)

@@ -1,0 +1,77 @@
+// C++ driver client for a ray_tpu cluster.
+//
+// Reference: cpp/include/ray/api.h — the reference ships a C++ worker
+// API (Init/Put/Get/Task(...).Remote()); this is the TPU-native
+// driver-side equivalent over the runtime's native protocol: a
+// blocking TCP client speaking the versioned-msgpack control plane
+// (ray_tpu/_private/rpc.py framing), with cluster KV, node listing,
+// and CROSS-LANGUAGE task calls — Python functions registered via
+// ray_tpu._private.xlang.register_function, invoked by name with
+// msgpack args, results returned as msgpack (pickle never crosses the
+// boundary).
+//
+// Usage:
+//   raytpu::Client head(host, port, token);
+//   head.KvPut("greeting", "hello");
+//   raytpu::Driver drv(head_addr, token);
+//   raytpu::Value out = drv.Call("my_fn", {raytpu::Value::I(2)});
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "raytpu/msgpack_lite.h"
+
+namespace raytpu {
+
+// One rpc connection: REQ out, RESP/ERR in (PUSH frames are ignored —
+// a blocking driver does not subscribe).
+class Client {
+ public:
+  Client(const std::string& host, int port, const std::string& token);
+  ~Client();
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Call a control-plane method; kwargs is a msgpack map.
+  Value Call(const std::string& method, ValueMap kwargs);
+
+  // -- convenience wrappers over head methods -----------------------
+  void KvPut(const std::string& key, const std::string& value,
+             bool overwrite = true);
+  // Returns false when the key is absent.
+  bool KvGet(const std::string& key, std::string* value_out);
+  std::vector<std::string> KvKeys(const std::string& prefix);
+  // node_id -> addr from the head's node table.
+  ValueMap Nodes();
+
+ private:
+  void WriteFrame(const std::string& payload);
+  std::string ReadFrame();
+  int fd_ = -1;
+  uint64_t next_id_ = 0;
+};
+
+// Cross-language task driver: lease a worker, push the task, return
+// the lease (the same drive cycle core_worker._drive_normal_task runs).
+class Driver {
+ public:
+  // head_addr "host:port". Connects to the head, discovers a node.
+  Driver(const std::string& head_addr, const std::string& token);
+
+  // Invoke a Python function registered as xfn:<name> with msgpack
+  // args; returns its msgpack result. Throws std::runtime_error with
+  // the remote error text on failure.
+  Value Call(const std::string& name, ValueVec args, double num_cpus = 1.0);
+
+  Client& head() { return head_; }
+
+ private:
+  std::string token_;
+  Client head_;
+  std::string node_host_;
+  int node_port_ = 0;
+};
+
+}  // namespace raytpu

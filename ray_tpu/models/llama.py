@@ -194,14 +194,17 @@ def _dense_ffn(h: jnp.ndarray, p: Params, cfg: LlamaConfig):
     return (gate * up) @ p["w_down"].astype(dt), jnp.float32(0.0)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _int8_ckpt(x: jnp.ndarray, name: str) -> jnp.ndarray:
     """Quantize-through-checkpoint: the value crossing the remat
     boundary is int8 + a per-row fp32 scale (tagged for
     save_only_these_names), halving the residual HBM of a saved bf16
-    activation. The compute graph continues on the DEQUANTIZED value
-    with a straight-through estimator, so gradients flow as identity
-    while the backward replay reconstructs the activation from the
-    saved int8 instead of re-running the producing matmul."""
+    activation. A custom_vjp (straight-through cotangent) rather than
+    the x + stop_gradient(dq - x) identity trick: that formulation
+    keeps the UN-quantized x structurally live in the primal output,
+    so the backward replay would re-run the producing matmul anyway —
+    the primal here depends only on (q, scale), which the policy
+    saves."""
     scale = (
         jnp.max(jnp.abs(x), axis=-1, keepdims=True).astype(jnp.float32)
         / 127.0
@@ -212,8 +215,18 @@ def _int8_ckpt(x: jnp.ndarray, name: str) -> jnp.ndarray:
     ).astype(jnp.int8)
     q = checkpoint_name(q, name)
     scale = checkpoint_name(scale, name + "_scale")
-    dq = (q.astype(jnp.float32) * scale).astype(x.dtype)
-    return x + jax.lax.stop_gradient(dq - x)
+    return (q.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def _int8_ckpt_fwd(x, name):
+    return _int8_ckpt(x, name), ()
+
+
+def _int8_ckpt_bwd(name, _res, g):
+    return (g,)  # straight-through: quantization grad is identity
+
+
+_int8_ckpt.defvjp(_int8_ckpt_fwd, _int8_ckpt_bwd)
 
 
 def _dense_ffn_save(h: jnp.ndarray, p: Params, cfg: LlamaConfig):

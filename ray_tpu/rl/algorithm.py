@@ -140,15 +140,7 @@ class Algorithm:
         self.runners.set_weights(self.learner.get_weights())
         cstate = state.get("connector_state")
         if cstate and self.runners.connectors is not None:
-            self.runners.connectors.set_state(cstate)
-            import ray_tpu
-
-            ray_tpu.get(
-                [
-                    r.set_connector_state.remote(cstate)
-                    for r in self.runners.runners
-                ]
-            )
+            self.runners.broadcast_connector_state(cstate)
 
     def get_policy_weights(self) -> Any:
         return self.learner.get_weights()

@@ -203,9 +203,19 @@ class EnvRunnerGroup:
         if not deltas:
             return
         self.connectors.absorb_deltas(deltas)
-        merged = self.connectors.get_state()
+        self.broadcast_connector_state(
+            self.connectors.get_state(), blocking=blocking
+        )
+
+    def broadcast_connector_state(
+        self, state: dict, blocking: bool = True
+    ) -> None:
+        """Push a full pipeline state to every runner (sync tail +
+        checkpoint restore share this fanout)."""
+        if self.connectors is not None:
+            self.connectors.set_state(state)
         refs = [
-            r.set_connector_state.remote(merged) for r in self.runners
+            r.set_connector_state.remote(state) for r in self.runners
         ]
         if blocking:
             ray_tpu.get(refs)

@@ -313,6 +313,19 @@ class CoreWorker:
         return fn_id
 
     async def _fetch_function(self, fn_id: str) -> Any:
+        # "xfn:<name>" = cross-language registry entry (_private/xlang
+        # register_function): the id IS the KV key, named by the
+        # registrar rather than content-hashed — and therefore MUTABLE
+        # (re-register/unregister), so never cached: a pooled worker
+        # must not keep executing a stale implementation.
+        if fn_id.startswith("xfn:"):
+            reply = await self.head.call("kv_get", key=fn_id)
+            if not reply["ok"]:
+                raise RayTaskError(
+                    f"cross-language function {fn_id[4:]!r} is not "
+                    "registered"
+                )
+            return deserialize(reply["value"])
         fn = self._fn_cache.get(fn_id)
         if fn is not None:
             return fn
@@ -344,6 +357,10 @@ class CoreWorker:
             slot = entry[0]
             if entry[1] == "ref":
                 value = await self._get_one(entry[2], entry[3], timeout=None)
+            elif entry[1] == "mp":
+                # Cross-language caller: plain msgpack data, never
+                # pickle (reference: cross-language serialization).
+                value = rpc.unpack_frame(entry[2])
             else:
                 value = deserialize(entry[2], entry[3])
             if slot is None:
@@ -2346,6 +2363,28 @@ class CoreWorker:
                 )
             results = []
             task_id = TaskID.from_hex(spec["task_id"])
+            if spec.get("xlang"):
+                # Cross-language caller (cpp/ client): results go back
+                # as plain msgpack inline — the foreign driver is the
+                # owner and decodes natively; pickle never crosses the
+                # language boundary (reference: cross-language
+                # serialization is msgpack both ways).
+                for i, value in enumerate(values):
+                    oid_hex = ObjectID.for_return(task_id, i).hex()
+                    try:
+                        results.append(
+                            (oid_hex, "xmp", rpc.pack_frame(value))
+                        )
+                    except (TypeError, ValueError) as e:
+                        raise RayTaskError(
+                            "cross-language task returned a value that "
+                            f"is not msgpack-encodable: {e}"
+                        ) from None
+                self.record_task_event(
+                    spec, "RUNNING", ts=exec_start,
+                    dur=time.time() - exec_start,
+                )
+                return {"status": "ok", "results": results}
             transport = spec.get("tensor_transport")
             if transport and actor_id is not None:
                 # Tensor transport: values stay in THIS actor's device
@@ -2407,7 +2446,14 @@ class CoreWorker:
                 spec, "RUNNING", ts=exec_start,
                 dur=time.time() - exec_start, failed=True,
             )
-            return {"status": "error", "error": _dumps_small(_as_task_error(e))}
+            reply = {
+                "status": "error",
+                "error": _dumps_small(_as_task_error(e)),
+            }
+            if spec.get("xlang"):
+                # Foreign drivers cannot unpickle: give them text too.
+                reply["error_text"] = f"{type(e).__name__}: {e}"
+            return reply
 
 
 class ActorSubmitTarget:

@@ -16,6 +16,7 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.hyperopt_search import HyperOptSearch
 from ray_tpu.tune.optuna_search import OptunaSearch
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
@@ -73,7 +74,7 @@ __all__ = [
     "Tuner", "TuneConfig", "RunConfig", "ResultGrid", "TrialResult",
     "Trainable", "Trial", "StopTrial", "report", "get_checkpoint",
     "uniform", "loguniform", "randint", "choice", "grid_search",
-    "TPESearcher", "OptunaSearch", "ConcurrencyLimiter", "Repeater",
+    "TPESearcher", "OptunaSearch", "HyperOptSearch", "ConcurrencyLimiter", "Repeater",
     "Domain", "Choice", "Searcher", "BasicVariantGenerator",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",

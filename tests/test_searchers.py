@@ -225,3 +225,74 @@ def test_optuna_end_to_end_with_tuner():
         assert grid.get_best_result().metrics["loss"] < 0.25
     finally:
         ray_tpu.shutdown()
+
+
+# -------------------------------------------------------------- hyperopt
+def test_hyperopt_search_converts_space_and_optimizes():
+    """Same adapter contract as OptunaSearch over the hyperopt seam
+    (reference: tune/search/hyperopt/hyperopt_search.py)."""
+    from ray_tpu.tune.hyperopt_search import HyperOptSearch
+    from ray_tpu.tune.search import loguniform, randint
+
+    def objective(cfg):
+        assert cfg["fixed"] == "const"
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        assert 1 <= cfg["layers"] < 8
+        return (cfg["x"] - 0.7) ** 2 + (0.0 if cfg["opt"] == "adam" else 0.5)
+
+    s = HyperOptSearch(
+        {
+            "x": uniform(0, 1),
+            "lr": loguniform(1e-4, 1e-1),
+            "layers": randint(1, 8),
+            "opt": choice(["sgd", "adam"]),
+            "fixed": "const",
+        },
+        metric="loss", mode="min", seed=0,
+    )
+    hist = _drive(s, objective, 60)
+    best = min(v for _c, v in hist)
+    assert best < 0.05
+    late = [c for c, _v in hist[-15:]]
+    assert sum(1 for c in late if c["opt"] == "adam") >= 8
+    assert s.best_params is not None and "x" in s.best_params
+
+
+def test_hyperopt_search_rejects_grid_axes():
+    import pytest as _pytest
+
+    from ray_tpu.tune.hyperopt_search import HyperOptSearch
+    from ray_tpu.tune.search import grid_search
+
+    with _pytest.raises(ValueError):
+        HyperOptSearch({"x": grid_search([1, 2])})
+
+
+def test_hyperopt_end_to_end_with_tuner():
+    import ray_tpu
+    from ray_tpu import tune
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        def objective(config):
+            tune.report({"loss": (config["lr"] - 0.3) ** 2})
+
+        tuner = tune.Tuner(
+            objective,
+            param_space={"lr": tune.uniform(0.0, 1.0)},
+            tune_config=tune.TuneConfig(
+                num_samples=12,
+                max_concurrent_trials=2,
+                metric="loss",
+                mode="min",
+                search_alg=tune.HyperOptSearch(
+                    {"lr": tune.uniform(0.0, 1.0)},
+                    metric="loss", mode="min", seed=0,
+                ),
+            ),
+        )
+        results = tuner.fit()
+        best = results.get_best_result(metric="loss", mode="min")
+        assert best.metrics["loss"] < 0.3
+    finally:
+        ray_tpu.shutdown()

@@ -71,6 +71,7 @@ class LLMEngine:
         kv: str = "paged",  # "paged" (block-table pool) | "dense" (slab)
         page_size: int = 64,
         num_pages: int | None = None,
+        speculate: int = 0,  # draft tokens per step (prompt lookup)
     ):
         cfg = PRESETS[model] if isinstance(model, str) else model
         self.cfg = cfg
@@ -92,12 +93,16 @@ class LLMEngine:
         # Flash prefill on a bare TPU backend; under a mesh the dense
         # path keeps XLA's SPMD partitioner in charge.
         use_flash = mesh is None and jax.default_backend() == "tpu"
+        if speculate and kv != "paged":
+            raise ValueError("speculative decoding needs kv='paged'")
+        self.speculate = int(speculate)
         if kv == "paged":
             from ray_tpu.llm.paged_kv import (
                 PageAllocator,
                 init_paged_kv,
                 paged_decode,
                 paged_prefill,
+                paged_verify,
             )
 
             # Default token budget matches the dense slab so existing
@@ -114,6 +119,7 @@ class LLMEngine:
             self.max_pages_per_seq = -(-self.max_seq // page_size)
             self._prefill_paged = partial(paged_prefill, cfg=cfg)
             self._decode_paged = partial(paged_decode, cfg=cfg)
+            self._verify_paged = partial(paged_verify, cfg=cfg)
             self._step_key = jax.random.key(seed)
             self._temps = np.zeros((max_batch,), np.float32)
         else:
@@ -382,14 +388,22 @@ class LLMEngine:
 
     def _step_paged(self, finished: list[dict]) -> None:
         P = self.page_size
-        # Grow block tables for slots whose next token starts a new page;
-        # exhausted pool → preempt the youngest active request (last
-        # inserted into _active) until the page fits.
+        K = 1 + self.speculate
+        # Grow block tables to cover every position this step may write
+        # ([position, position + K - 1] with speculation); exhausted
+        # pool → preempt the youngest active request until pages fit.
         for slot, req in list(self._active.items()):
             if req.slot == -1 or req.done:
                 continue
-            if req.position % P == 0 and req.position // P == len(req.pages):
-                while self.alloc.free_pages == 0:
+            # Clamp to the table width: near max_seq a K-wide step may
+            # reach past capacity — the kernel routes those writes to
+            # the dump page and _finish_if_done stops the request at
+            # max_seq before any overflow token is kept.
+            needed = min(
+                (req.position + K - 1) // P + 1, self.max_pages_per_seq
+            )
+            while len(req.pages) < needed and req.slot != -1:
+                if self.alloc.free_pages == 0:
                     victims = [
                         r for r in self._active.values() if r is not req
                     ]
@@ -408,6 +422,9 @@ class LLMEngine:
         for slot, req in self._active.items():
             tables[slot, : len(req.pages)] = req.pages
         self._step_key, sub = jax.random.split(self._step_key)
+        if self.speculate:
+            self._step_paged_speculative(tables, sub, finished)
+            return
         sampled, logits, self.cache = self._decode_paged(
             self.params,
             jnp.asarray(self._tokens),
@@ -430,6 +447,62 @@ class LLMEngine:
             else:
                 tok = int(sampled[slot])
             self._record_token(req, tok, finished)
+
+    def _step_paged_speculative(self, tables, sub, finished) -> None:
+        """Prompt-lookup speculative step (reference capability: vLLM
+        speculative decoding behind ray.llm): verify K = 1 + speculate
+        positions per slot in one dispatch and accept the longest
+        draft prefix the model agrees with. Greedy slots only —
+        stochastic sampling would need rejection-sampling acceptance,
+        so temperature/top_k slots run with an empty draft (their
+        position-0 output is exactly a normal decode step)."""
+        from ray_tpu.llm.paged_kv import propose_ngram_draft
+
+        K = 1 + self.speculate
+        toks = np.zeros((self.max_batch, K), np.int32)
+        toks[:, 0] = self._tokens[:, 0]
+        draft_len = np.zeros((self.max_batch,), np.int32)
+        for slot, req in self._active.items():
+            if req.sampling.temperature != 0:
+                continue  # stochastic slots: no draft (see docstring)
+            draft = propose_ngram_draft(
+                req.prompt + req.out_tokens, K - 1
+            )
+            if draft:
+                draft_len[slot] = len(draft)
+                toks[slot, 1: 1 + len(draft)] = draft
+
+        sampled, logits, self.cache = self._verify_paged(
+            self.params,
+            jnp.asarray(toks),
+            self.cache,
+            jnp.asarray(tables),
+            jnp.asarray(self._positions),
+            jnp.asarray(self._temps),
+            sub,
+        )
+        sampled = np.asarray(sampled)  # [B, K]
+        host_logits = None
+        for slot, req in list(self._active.items()):
+            if req.sampling.top_k and req.sampling.temperature > 0:
+                if host_logits is None:
+                    host_logits = np.asarray(logits)  # [B, V]: pos 0
+                tok = self._sample(host_logits[slot], req.sampling)
+                self._record_token(req, tok, finished)
+                continue
+            # Accept while the model's sampled token matches the draft
+            # it was fed; always emit position 0 (the normal token),
+            # plus one model token per accepted draft position.
+            n_acc = 0
+            while (
+                n_acc < draft_len[slot]
+                and sampled[slot, n_acc] == toks[slot, n_acc + 1]
+            ):
+                n_acc += 1
+            for j in range(n_acc + 1):
+                self._record_token(req, int(sampled[slot, j]), finished)
+                if req.done:
+                    break
 
     def abort_request(self, request_id: str) -> bool:
         """Drop a request (queued or active), freeing its slot — the

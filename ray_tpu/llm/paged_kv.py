@@ -178,7 +178,6 @@ def paged_prefill(
     return logits, {"k": k_pool, "v": v_pool}
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
 def paged_decode(
     params,
     tokens: jnp.ndarray,  # [B, 1] int32
@@ -189,52 +188,86 @@ def paged_decode(
     rng_key: jnp.ndarray,
     cfg: LlamaConfig,
 ):
-    """One decode step over the page pool. Attention gathers each slot's
-    pages; the new K/V lands in page block_tables[b, pos // P] at offset
-    pos % P. Sampling happens ON DEVICE (greedy or temperature) — the
-    host receives [B] token ids, not [B, V] logits (the dense engine's
-    per-token logits transfer was its decode bottleneck).
+    """One decode step over the page pool — exactly the K=1 case of
+    :func:`paged_verify` (one source of truth for the page-attention
+    body; divergence between cache paths would silently change decode
+    results). Sampling happens ON DEVICE — the host receives [B]
+    token ids, not [B, V] logits.
 
-    Returns (sampled [B] int32, pool).
+    Returns (sampled [B] int32, logits [B, V] fp32, pool).
     """
-    b = tokens.shape[0]
-    x = params["tok_emb"].astype(cfg.dtype)[tokens]  # [B, 1, d]
+    sampled, logits, pool = paged_verify(
+        params, tokens, pool, block_tables, positions, temperature,
+        rng_key, cfg=cfg,
+    )
+    return sampled[:, 0], logits, pool
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+def paged_verify(
+    params,
+    tokens: jnp.ndarray,  # [B, K] int32: next token + K-1 draft tokens
+    pool: PagedKV,
+    block_tables: jnp.ndarray,  # [B, max_pages] int32 (-1 = unused)
+    positions: jnp.ndarray,  # [B] int32: position tokens[:, 0] writes at
+    temperature: jnp.ndarray,  # [B] fp32 (0 = greedy)
+    rng_key: jnp.ndarray,
+    cfg: LlamaConfig,
+):
+    """Speculative verify step: process K tokens per slot in ONE pass
+    (reference capability: vLLM's speculative/prompt-lookup decoding,
+    the serving engine behind ray.llm). tokens[:, 0] is the ordinary
+    next token; tokens[:, 1:] are HOST-PROPOSED draft tokens (n-gram
+    prompt lookup — no draft model). The engine accepts the longest
+    prefix where the model's own sampled token agrees with the draft,
+    advancing up to K tokens per dispatch.
+
+    Rejected drafts need no rollback: a rejected position's K/V cell is
+    re-written by the next step's scatter BEFORE any query attends that
+    position (scatter precedes gather within each layer, and the causal
+    mask hides cells beyond each query's position until then).
+
+    Returns (sampled [B, K] int32, logits [B, V] fp32 for position 0,
+    pool).
+    """
+    b, kk_w = tokens.shape
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]  # [B, K, d]
     page_size = pool["k"].shape[2]
     max_pages = block_tables.shape[1]
     window = max_pages * page_size
-    # RoPE table over the pool-capacity horizon for correct rotations.
     cos, sin = rope_frequencies(cfg.head_dim, window, cfg.rope_theta)
 
-    key_idx = jnp.arange(window)[None, :]
-    mask = key_idx > positions[:, None]  # [B, window] True = masked
+    pos2d = positions[:, None] + jnp.arange(kk_w)[None, :]  # [B, K]
+    key_idx = jnp.arange(window)[None, None, :]
+    mask = key_idx > pos2d[:, :, None]  # [B, K, window]
 
-    page_of = positions // page_size  # [B] page slot index
-    off_of = positions % page_size
-    # The physical page each slot's new token writes into. Inactive
-    # slots (table -1) clamp to the dump page 0 — their writes are
-    # discarded garbage nobody attends to.
-    write_page = jnp.maximum(
-        jnp.take_along_axis(block_tables, page_of[:, None], axis=1)[:, 0],
-        0,
-    )  # [B]
+    page_of = jnp.minimum(pos2d // page_size, max_pages - 1)  # [B, K]
+    off_of = pos2d % page_size
+    # Physical pages for each write. Two overflow routes to the dump
+    # page 0 (whose contents nobody attends): inactive slots
+    # (table -1) and draft positions past the table window — near
+    # max_seq a K-wide step can extend beyond capacity, and clamping
+    # into the LAST page would corrupt live cells.
+    write_pages = jnp.maximum(
+        jnp.take_along_axis(block_tables, page_of, axis=1), 0
+    )
+    write_pages = jnp.where(pos2d < window, write_pages, 0)  # [B, K]
 
     def body(x, layer):
         p, k_pool, v_pool = layer
-        q, k, v = _project_qkv(x, p, cfg)  # [B,1,H,Dh]
-        pos2d = positions[:, None]
+        q, k, v = _project_qkv(x, p, cfg)  # [B, K, H, Dh]
         q = apply_rope(q, cos, sin, positions=pos2d)
         k = apply_rope(k, cos, sin, positions=pos2d)
 
-        # Scatter the new token's K/V: one (page, offset) cell per slot.
-        k_pool = k_pool.at[write_page, off_of, :, :].set(
-            k[:, 0].astype(cfg.dtype)
+        # Scatter all K cells per slot (drafts may span a page
+        # boundary — each position indexes its own physical page).
+        k_pool = k_pool.at[write_pages, off_of, :, :].set(
+            k.astype(cfg.dtype)
         )
-        v_pool = v_pool.at[write_page, off_of, :, :].set(
-            v[:, 0].astype(cfg.dtype)
+        v_pool = v_pool.at[write_pages, off_of, :, :].set(
+            v.astype(cfg.dtype)
         )
 
-        # Gather each slot's window: [B, max_pages, P, Hkv, Dh]. Table
-        # entries of -1 (unused tail) clamp to 0 — harmless, masked.
         tables = jnp.maximum(block_tables, 0)
         kk = jnp.take(k_pool, tables, axis=0).reshape(
             b, window, cfg.n_kv_heads, cfg.head_dim
@@ -253,10 +286,10 @@ def paged_decode(
             )
             * scale
         )
-        logits = jnp.where(mask[:, None, None, :], _NEG_INF, logits)
+        logits = jnp.where(mask[:, None, :, :], _NEG_INF, logits)
         probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
-        x = x + attn.reshape(b, 1, -1) @ p["wo"].astype(cfg.dtype)
+        x = x + attn.reshape(b, kk_w, -1) @ p["wo"].astype(cfg.dtype)
         x = _mlp(x, p, cfg)
         return x, (k_pool, v_pool)
 
@@ -265,12 +298,53 @@ def paged_decode(
     )
     x = rms_norm(x, params["final_norm"])
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
-    logits = logits[:, 0]  # [B, V]
 
-    sampled = sample_on_device(logits, temperature, rng_key)
-    # logits ride along as a device array; the engine only transfers
-    # them for slots whose sampling needs host logic (top_k).
-    return sampled, logits, {"k": k_pool, "v": v_pool}
+    # Per-position sampling: greedy for temp 0 (the only slots the
+    # engine speculates on), temperature draw otherwise.
+    flat = logits.reshape(b * kk_w, -1)
+    temp_flat = jnp.repeat(temperature, kk_w)
+    keys = jax.random.split(rng_key, b * kk_w)
+    greedy = jnp.argmax(flat, axis=-1)
+    drawn = jax.vmap(jax.random.categorical)(
+        keys, flat / jnp.maximum(temp_flat, 1e-6)[:, None]
+    )
+    sampled = jnp.where(temp_flat > 0.0, drawn, greedy).astype(jnp.int32)
+    # Only position 0's logits ever reach the host (top_k fallback);
+    # shipping [B, K, V] would multiply that transfer by K for nothing.
+    return (
+        sampled.reshape(b, kk_w),
+        logits[:, 0],
+        {"k": k_pool, "v": v_pool},
+    )
+
+
+def propose_ngram_draft(
+    context: list[int] | np.ndarray, k: int, ngram: int = 2
+) -> list[int]:
+    """Prompt-lookup drafting (host side, no draft model): find the
+    most recent earlier occurrence of the last ``ngram`` tokens and
+    propose the ``k`` tokens that followed it. Returns [] when no match
+    — the verify pass then degenerates to a normal decode step.
+
+    Vectorized: one numpy sliding-window comparison per call — this
+    runs per greedy slot per decode step, so a Python slice-compare
+    scan would put O(context) interpreter work on the serial host path
+    in front of every dispatch."""
+    ctx = np.asarray(context, dtype=np.int64)
+    n = len(ctx)
+    if n < ngram + 1 or k <= 0:
+        return []
+    tail = ctx[n - ngram:]
+    # Window starts eligible as a match: exclude the tail itself.
+    hits = ctx[: n - 1 - (ngram - 1)] == tail[0]
+    for j in range(1, ngram):
+        hits = hits & (ctx[j: n - 1 - (ngram - 1) + j] == tail[j])
+    idx = np.nonzero(hits)[0]
+    if idx.size == 0:
+        return []
+    start = int(idx[-1])  # rightmost: recent repetition predicts best
+    follow = ctx[start + ngram: start + ngram + k]
+    return follow.astype(int).tolist()
 
 
 def sample_on_device(

@@ -52,11 +52,42 @@ std::string RandomHex(int bytes) {
 }
 
 void SplitAddr(const std::string& addr, std::string* host, int* port) {
+  if (!addr.empty() && addr[0] == '[') {
+    // Bracketed IPv6 literal: "[::1]:8000".
+    auto close = addr.find(']');
+    if (close == std::string::npos || close + 1 >= addr.size() ||
+        addr[close + 1] != ':')
+      throw std::runtime_error("raytpu: address must be [v6host]:port");
+    *host = addr.substr(1, close - 1);
+    *port = std::stoi(addr.substr(close + 2));
+    return;
+  }
+  // Unbracketed: split at the LAST colon. The port is always the final
+  // component, so this is also correct for the unbracketed IPv6
+  // literals the Python side announces (node/head format addresses as
+  // f"{host}:{port}" with no brackets).
   auto pos = addr.rfind(':');
   if (pos == std::string::npos)
     throw std::runtime_error("raytpu: address must be host:port");
   *host = addr.substr(0, pos);
   *port = std::stoi(addr.substr(pos + 1));
+}
+
+// The wire's frame-length header is little-endian by protocol
+// (matching the Python side's struct '<I'); serialize it explicitly
+// so big-endian hosts speak the same bytes.
+void PutLe32(char* dst, uint32_t v) {
+  dst[0] = static_cast<char>(v & 0xff);
+  dst[1] = static_cast<char>((v >> 8) & 0xff);
+  dst[2] = static_cast<char>((v >> 16) & 0xff);
+  dst[3] = static_cast<char>((v >> 24) & 0xff);
+}
+
+uint32_t GetLe32(const char* src) {
+  return static_cast<uint32_t>(static_cast<uint8_t>(src[0])) |
+         (static_cast<uint32_t>(static_cast<uint8_t>(src[1])) << 8) |
+         (static_cast<uint32_t>(static_cast<uint8_t>(src[2])) << 16) |
+         (static_cast<uint32_t>(static_cast<uint8_t>(src[3])) << 24);
 }
 }  // namespace
 
@@ -80,7 +111,7 @@ Client::Client(const std::string& host, int port, const std::string& token) {
     std::string blob = "RTPUAUTH" + token;
     uint32_t len = static_cast<uint32_t>(blob.size());
     char hdr[4];
-    std::memcpy(hdr, &len, 4);  // little-endian hosts (x86/arm)
+    PutLe32(hdr, len);
     WriteAll(fd_, hdr, 4);
     WriteAll(fd_, blob.data(), blob.size());
   }
@@ -93,7 +124,7 @@ Client::~Client() {
 void Client::WriteFrame(const std::string& payload) {
   uint32_t len = static_cast<uint32_t>(payload.size() + 1);
   char hdr[5];
-  std::memcpy(hdr, &len, 4);
+  PutLe32(hdr, len);
   hdr[4] = static_cast<char>(kWireVersion);
   WriteAll(fd_, hdr, 5);
   WriteAll(fd_, payload.data(), payload.size());
@@ -102,8 +133,7 @@ void Client::WriteFrame(const std::string& payload) {
 std::string Client::ReadFrame() {
   char hdr[4];
   ReadAll(fd_, hdr, 4);
-  uint32_t len;
-  std::memcpy(&len, hdr, 4);
+  uint32_t len = GetLe32(hdr);
   if (len == 0) throw std::runtime_error("raytpu: empty frame");
   std::string body(len, '\0');
   ReadAll(fd_, body.data(), len);

@@ -242,6 +242,15 @@ class GkeTpuNodeProvider(NodeProvider):
         # (non_terminated_nodes), so a restarted provider process
         # re-discovers existing slices instead of leaking them.
         self._nodes: dict[str, str] = {}
+        # pool name → pre-grow membership snapshot, recorded when a
+        # successful setSize(+1)'s new instance never surfaced in the
+        # lagging MIG listing. The next create_node claims an instance
+        # outside this basis (and untracked) instead of resizing again
+        # — the basis is what distinguishes OUR lagged instance from
+        # pre-existing members this provider never created. In-memory
+        # only: after a restart the orphan is simply a normal pool
+        # member visible through non_terminated_nodes.
+        self._pending_grow: dict[str, frozenset] = {}
         # pool name → node_type reverse map for node_pool-mode ids
         # ("<pool>#<i>"), stable across provider restarts.
         self._pool_types = {
@@ -449,6 +458,48 @@ class GkeTpuNodeProvider(NodeProvider):
             with self._pool_lock(name):
                 got = self.http.request("GET", self._gke_pool(name))
                 before = self._list_pool_instances(got)
+                if before is not None and name in self._pending_grow:
+                    # A previous create grew the pool but the MIG
+                    # listing never surfaced the instance. Claim an
+                    # orphan (listed, outside the pre-grow basis, and
+                    # untracked) instead of resizing again — the second
+                    # setSize is how capacity leaks.
+                    basis = self._pending_grow[name]
+                    for attempt in range(5):
+                        if attempt:
+                            time.sleep(self._poll_s)
+                            got = self.http.request(
+                                "GET", self._gke_pool(name)
+                            )
+                            before = (
+                                self._list_pool_instances(got) or {}
+                            )
+                        orphans = sorted(
+                            inst for inst in set(before) - basis
+                            if f"{name}#{inst}" not in self._nodes
+                        )
+                        if orphans:
+                            del self._pending_grow[name]
+                            pid = f"{name}#{orphans[0]}"
+                            self._nodes[pid] = node_type
+                            return pid
+                    if self._pool_count(got) <= len(basis):
+                        # The pool no longer holds the extra capacity
+                        # (operator resize-down, quota rollback, MIG
+                        # repair): the pending grow is gone for good.
+                        # Clear it and fall through to a fresh resize —
+                        # without this the pool is wedged until the
+                        # provider restarts. (If the count is still
+                        # above the basis, the capacity exists and only
+                        # the listing lags: resizing now WOULD leak, so
+                        # keep waiting across retries instead.)
+                        del self._pending_grow[name]
+                    else:
+                        raise RuntimeError(
+                            f"pool {name} has a pending grown instance"
+                            " the managed-instance listing still does"
+                            " not show"
+                        )
                 current, verify = self._resize_pool(name, +1)
                 if before is not None:
                     # Instance-backed id: the instance the resize added,
@@ -471,10 +522,22 @@ class GkeTpuNodeProvider(NodeProvider):
                             pid = f"{name}#{new[0]}"
                             self._nodes[pid] = node_type
                             return pid
+                    # The resize succeeded but we cannot name the new
+                    # instance. Do NOT shrink: an anonymous setSize(-1)
+                    # lets GKE pick the scale-in victim, which can kill
+                    # a tracked busy slice while the new instance
+                    # survives (the same hazard targeted scale-down
+                    # exists to prevent). Record the grow instead so
+                    # the reconcile retry CLAIMS the orphan rather than
+                    # resizing +1 again — no compounding leak, and the
+                    # instance surfaces in non_terminated_nodes once
+                    # the listing catches up.
+                    self._pending_grow[name] = frozenset(before)
                     raise RuntimeError(
                         f"pool {name} grew to {self._pool_count(verify)}"
                         " but the managed-instance listing never showed"
-                        " the new instance"
+                        " the new instance (grow recorded; the retry"
+                        " will claim it instead of resizing again)"
                     )
                 # No instance groups exposed: slot-indexed ids,
                 # derivable from the pool size, stable across provider

@@ -99,28 +99,44 @@ class ConnectorPipeline(Connector):
         self.connectors.pop(self._index_of(name))
         return self
 
+    def _state_keys(self) -> "list[tuple[str, Connector]]":
+        """(key, connector) pairs with keys unique per INSTANCE: the
+        first occurrence of a class keeps its bare name, later ones get
+        ``Name_1``, ``Name_2``… in pipeline order. Two ClipObs with
+        different bounds therefore sync independently instead of one
+        silently overwriting the other — valid as long as runner and
+        driver pipelines are composed identically, which filter sync
+        already requires."""
+        seen: dict[str, int] = {}
+        out = []
+        for c in self.connectors:
+            n = seen.get(c.name, 0)
+            seen[c.name] = n + 1
+            out.append((c.name if n == 0 else f"{c.name}_{n}", c))
+        return out
+
     def get_state(self) -> dict:
         return {
-            c.name: s for c in self.connectors if (s := c.get_state())
+            k: s for k, c in self._state_keys() if (s := c.get_state())
         }
 
     def set_state(self, state: dict) -> None:
-        for c in self.connectors:
-            if c.name in state:
-                c.set_state(state[c.name])
+        for k, c in self._state_keys():
+            if k in state:
+                c.set_state(state[k])
 
     def report_delta(self) -> dict:
         return {
-            c.name: d for c in self.connectors if (d := c.report_delta())
+            k: d for k, c in self._state_keys() if (d := c.report_delta())
         }
 
     def absorb_deltas(self, deltas: list[dict]) -> None:
         """Fold per-runner delta reports into this (driver) pipeline's
         global state, connector by connector."""
-        for c in self.connectors:
+        for k, c in self._state_keys():
             for report in deltas:
-                if c.name in report:
-                    c.absorb_delta(report[c.name])
+                if k in report:
+                    c.absorb_delta(report[k])
 
 
 # ------------------------------------------------------------- builtins

@@ -702,11 +702,20 @@ class HeadService:
                     committed.append((nid, i))
             except Exception as e:  # noqa: BLE001 - roll back prepares
                 for nid, i in committed:
+                    # A node that died between reserve and rollback must
+                    # not abort freeing the remaining nodes' bundles
+                    # (its own reservations die with it), so: tolerate a
+                    # missing conn and catch broadly — any per-node
+                    # failure here is that node's problem, not the
+                    # rollback's.
+                    conn_ = self._node_conns.get(nid)
+                    if conn_ is None:
+                        continue
                     try:
-                        await self._node_conns[nid].call(
+                        await conn_.call(
                             "free_bundle", pg_id=pg_id, index=i
                         )
-                    except rpc.RpcError:
+                    except Exception:  # noqa: BLE001 - best-effort free
                         pass
                 last_error = str(e)
                 if failing is None:

@@ -634,6 +634,11 @@ class NodeManager:
     def _spawn_worker_inproc(
         self, worker_id: str, runtime_env: dict | None, ehash: str
     ) -> str:
+        # Pair with the unconditional release in the reap loop /
+        # _kill_worker: without this, inproc workers decrement a
+        # refcount they never took and a registered on-disk env can be
+        # evicted while process workers still use it.
+        _env_cache.acquire(ehash)
         self.workers[worker_id] = {
             "proc": None,
             "inproc": True,

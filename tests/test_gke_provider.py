@@ -699,3 +699,108 @@ def test_plain_400_validation_error_is_not_retried():
         p.create_node("gke-v5e", {"TPU": 8})
     assert ei.value.status == 400
     t.assert_done()
+
+
+def test_listing_lag_retry_claims_the_orphan_without_resizing():
+    """Regression: when setSize(+1) succeeds but the managed-instance
+    listing never shows the new instance, create_node must NOT shrink
+    (an anonymous setSize(-1) lets GKE kill an arbitrary busy slice)
+    and must NOT let the retry resize +1 again (that compounds the
+    leak). Instead the failure records the grow and the retry claims
+    the instance once the listing catches up — WITHOUT claiming
+    pre-existing members the provider never created (gke-node-aaa here
+    stays unclaimed because it is inside the pre-grow basis)."""
+    pool_url = _pool_url()
+    lagged = _mi(["gke-node-aaa"])  # listing lags the resize
+    grown = {"currentNodeCount": 2, "instanceGroupUrls": [IG]}
+    p, t = make_provider(
+        [
+            {"method": "GET", "url": pool_url,  # before-snapshot
+             "response": {"currentNodeCount": 1,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url,  # in-lock resize read
+             "response": {"currentNodeCount": 1,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["2"],
+             "response": {"name": "op-up", "status": "DONE"}},
+            {"method": "GET", "url": pool_url,  # resize verify re-read
+             "response": grown},
+            # attempt 0 reuses the verify body; attempts 1-4 re-read.
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": grown},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": grown},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": grown},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": grown},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            # retry create_node: the listing has caught up; the orphan
+            # (outside the pre-grow basis) is claimed with NO setSize.
+            {"method": "GET", "url": pool_url, "response": grown},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa", "gke-node-bbb"])},
+        ]
+    )
+    with pytest.raises(RuntimeError, match="grow recorded"):
+        p.create_node("gke-v5e", {"TPU": 8})
+    assert p._nodes == {}
+    assert p._pending_grow["tpu-pool"] == frozenset({"gke-node-aaa"})
+    pid = p.create_node("gke-v5e", {"TPU": 8})
+    assert pid == "tpu-pool#gke-node-bbb"
+    assert "tpu-pool" not in p._pending_grow
+    t.assert_done()
+
+
+def test_externally_shrunk_pending_grow_unwedges_the_pool():
+    """If the pending grown instance is removed externally (operator
+    resize-down, MIG repair) before the retry can claim it, the claim
+    branch must clear the stale pending entry and fall through to a
+    fresh resize — not wedge create_node for that pool forever."""
+    pool_url = _pool_url()
+    lagged = _mi(["gke-node-aaa"])
+    back_to_one = {"currentNodeCount": 1, "instanceGroupUrls": [IG]}
+    p, t = make_provider(
+        [
+            # retry after a recorded grow: pool is back at basis size,
+            # 5 claim attempts find no orphan → clear + fresh resize.
+            {"method": "GET", "url": pool_url, "response": back_to_one},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": back_to_one},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": back_to_one},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": back_to_one},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            {"method": "GET", "url": pool_url, "response": back_to_one},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": lagged},
+            # fall-through: fresh resize, listing keeps up this time.
+            {"method": "GET", "url": pool_url, "response": back_to_one},
+            {"method": "POST", "url": f"{pool_url}:setSize",
+             "body_contains": ["2"],
+             "response": {"name": "op-up", "status": "DONE"}},
+            {"method": "GET", "url": pool_url,
+             "response": {"currentNodeCount": 2,
+                          "instanceGroupUrls": [IG]}},
+            {"method": "POST", "url": f"{IGM}/listManagedInstances",
+             "response": _mi(["gke-node-aaa", "gke-node-ccc"])},
+        ]
+    )
+    p._pending_grow["tpu-pool"] = frozenset({"gke-node-aaa"})
+    pid = p.create_node("gke-v5e", {"TPU": 8})
+    assert pid == "tpu-pool#gke-node-ccc"
+    assert "tpu-pool" not in p._pending_grow
+    t.assert_done()

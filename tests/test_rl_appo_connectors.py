@@ -296,3 +296,36 @@ def test_save_restore_carries_connector_state(cluster, tmp_path):
         )
     finally:
         algo.stop()
+
+
+def test_duplicate_connector_instances_sync_independently():
+    """Regression: two instances of the same connector class in one
+    pipeline must not share a state-sync key — with class-name keying,
+    one instance's filter state silently overwrote the other's."""
+    runner = ConnectorPipeline(MeanStdObsFilter(), MeanStdObsFilter())
+    rng = np.random.default_rng(2)
+    runner({"obs": rng.normal(5, 1, size=(20, 2))}, {"phase": "step"})
+    report = runner.report_delta()
+    assert set(report) == {"MeanStdObsFilter", "MeanStdObsFilter_1"}
+    # The second filter sees the FIRST one's normalized output, so the
+    # two deltas must differ — distinct instances, distinct stats.
+    assert report["MeanStdObsFilter"]["mean"][0] != pytest.approx(
+        report["MeanStdObsFilter_1"]["mean"][0]
+    )
+    driver = ConnectorPipeline(MeanStdObsFilter(), MeanStdObsFilter())
+    driver.absorb_deltas([report])
+    state = driver.get_state()
+    np.testing.assert_allclose(
+        state["MeanStdObsFilter"]["mean"],
+        report["MeanStdObsFilter"]["mean"],
+    )
+    np.testing.assert_allclose(
+        state["MeanStdObsFilter_1"]["mean"],
+        report["MeanStdObsFilter_1"]["mean"],
+    )
+    # Round-trip: set_state routes each keyed state to its own instance.
+    fresh = ConnectorPipeline(MeanStdObsFilter(), MeanStdObsFilter())
+    fresh.set_state(state)
+    assert fresh.connectors[0].count != fresh.connectors[1].count or (
+        not np.allclose(fresh.connectors[0].mean, fresh.connectors[1].mean)
+    )

@@ -72,6 +72,7 @@ class LLMEngine:
         page_size: int = 64,
         num_pages: int | None = None,
         speculate: int = 0,  # draft tokens per step (prompt lookup)
+        prefill_chunk: int | None = None,  # tokens per prefill chunk
     ):
         cfg = PRESETS[model] if isinstance(model, str) else model
         self.cfg = cfg
@@ -95,6 +96,8 @@ class LLMEngine:
         use_flash = mesh is None and jax.default_backend() == "tpu"
         if speculate and kv != "paged":
             raise ValueError("speculative decoding needs kv='paged'")
+        if prefill_chunk is not None and kv != "paged":
+            raise ValueError("chunked prefill needs kv='paged'")
         self.speculate = int(speculate)
         if kv == "paged":
             from ray_tpu.llm.paged_kv import (
@@ -117,12 +120,46 @@ class LLMEngine:
             # +1: physical page 0 is the allocator's dump page.
             self.cache = init_paged_kv(cfg, num_pages + 1, page_size)
             self.max_pages_per_seq = -(-self.max_seq // page_size)
+            # Pallas paged-attention kernel on a bare TPU backend (the
+            # sharded path keeps XLA's SPMD partitioner in charge, like
+            # use_flash above). RAY_TPU_PAGED_ATTN=0/1 overrides — =1
+            # on CPU runs the kernel interpreted (parity tests).
+            import os
+
+            env_flag = os.environ.get("RAY_TPU_PAGED_ATTN", "").strip()
+            if env_flag in ("0", "1"):
+                use_kernel = env_flag == "1"
+            else:
+                use_kernel = (
+                    mesh is None and jax.default_backend() == "tpu"
+                )
+            self.paged_attn_kernel = use_kernel
+            # Chunked prefill: a prompt longer than the chunk is
+            # prefilled one page-aligned chunk per step(), interleaved
+            # with decode — one long admission no longer stalls every
+            # in-flight request for its full dense pass (reference
+            # capability: vLLM chunked prefill behind ray.llm).
+            if prefill_chunk is not None:
+                prefill_chunk = max(
+                    -(-prefill_chunk // page_size) * page_size, page_size
+                )
+            self.prefill_chunk = prefill_chunk
+            self._prefilling: dict | None = None
+            from ray_tpu.llm.paged_kv import paged_prefill_chunk
+
+            self._prefill_chunk_fn = partial(paged_prefill_chunk, cfg=cfg)
             self._prefill_paged = partial(paged_prefill, cfg=cfg)
-            self._decode_paged = partial(paged_decode, cfg=cfg)
-            self._verify_paged = partial(paged_verify, cfg=cfg)
+            self._decode_paged = partial(
+                paged_decode, cfg=cfg, use_kernel=use_kernel
+            )
+            self._verify_paged = partial(
+                paged_verify, cfg=cfg, use_kernel=use_kernel
+            )
             self._step_key = jax.random.key(seed)
             self._temps = np.zeros((max_batch,), np.float32)
         else:
+            self.prefill_chunk = None
+            self._prefilling = None
             self.cache = init_kv_cache(cfg, max_batch, self.max_seq)
             # donate the cache slab: without donation every functional
             # .at[].set update forces XLA to copy the whole cache.
@@ -189,7 +226,9 @@ class LLMEngine:
         return rid
 
     def has_unfinished(self) -> bool:
-        return bool(self._queue or self._active)
+        return bool(
+            self._queue or self._active or self._prefilling is not None
+        )
 
     def _sample(self, logits: np.ndarray, s: SamplingParams) -> int:
         if s.temperature <= 0.0:
@@ -257,12 +296,18 @@ class LLMEngine:
             )
             self._post_prefill(req, slot, logits, len(req.prompt), finished)
 
-    def _post_prefill(self, req, slot, logits, ctx_len, finished) -> None:
+    def _post_prefill(
+        self, req, slot, logits, ctx_len, finished, logit_idx=None
+    ) -> None:
         """Shared dense/paged tail of admission: sample the next token
         from the context's last logits, activate, run stop checks.
         ctx_len is the true (unpadded) prefilled length — prompt plus
-        any tokens generated before a preemption."""
-        last = np.asarray(logits[0, ctx_len - 1])
+        any tokens generated before a preemption. logit_idx overrides
+        the row to sample from (chunked prefill: the last token's index
+        LOCAL to the final chunk)."""
+        last = np.asarray(
+            logits[0, ctx_len - 1 if logit_idx is None else logit_idx]
+        )
         req.slot = slot
         req.position = ctx_len
         req.last_token = self._sample(last, req.sampling)
@@ -287,6 +332,10 @@ class LLMEngine:
         False when the pool cannot hold the next request yet."""
         from ray_tpu.llm.paged_kv import prefix_hashes
 
+        if self._prefilling is not None:
+            # One chunked prefill at a time: its pages are committed and
+            # its chunks are the per-step prefill budget already.
+            return False
         P = self.page_size
         req = self._queue[0]
         # Full context: the prompt plus anything generated before a
@@ -325,6 +374,25 @@ class LLMEngine:
                 self.alloc.register_prefix(hashes[i], pg)
             pages.append(pg)
         req.pages = pages
+        if (
+            self.prefill_chunk is not None
+            and len(context) > self.prefill_chunk
+        ):
+            # Long prompt: hold the slot and prefill one chunk per
+            # step(), interleaved with decode. Chunks cover only the
+            # context's own pages (ceil(ctx/P)); the bucket's growth
+            # pages stay unwritten until decode reaches them.
+            self._prefilling = {
+                "req": req,
+                "slot": slot,
+                "context": context,
+                "pages": np.asarray(pages, np.int32),
+                "next_start": 0,
+                "ctx_pad": -(-len(context) // P) * P,
+                "need_pages": need_pages,
+            }
+            self._prefill_step(finished)
+            return True
         tokens = np.zeros((1, pad), np.int32)
         tokens[0, : len(context)] = context
         # Prefill rewrites shared pages with byte-identical values (K/V
@@ -340,10 +408,45 @@ class LLMEngine:
         self._post_prefill(req, slot, logits, len(context), finished)
         return True
 
+    def _prefill_step(self, finished: list[dict]) -> None:
+        """Advance the in-flight chunked prefill by ONE chunk; on the
+        final chunk, sample the first token and activate the slot."""
+        st = self._prefilling
+        assert st is not None
+        P = self.page_size
+        context = st["context"]
+        start = st["next_start"]
+        end = min(start + self.prefill_chunk, st["ctx_pad"])
+        tokens = np.zeros((1, end - start), np.int32)
+        valid = context[start: min(end, len(context))]
+        tokens[0, : len(valid)] = valid
+        logits, self.cache = self._prefill_chunk_fn(
+            self.params,
+            jnp.asarray(tokens),
+            self.cache,
+            jnp.asarray(st["pages"]),
+            jnp.int32(start),
+            n_write_pages=st["need_pages"],
+            chunk_pages=(end - start) // P,
+        )
+        st["next_start"] = end
+        if end >= st["ctx_pad"]:
+            self._prefilling = None
+            # ctx_len-1 always falls in the final chunk: ctx_pad is
+            # page-aligned, so ctx_pad - len(context) < P <= chunk.
+            self._post_prefill(
+                st["req"], st["slot"], logits, len(context), finished,
+                logit_idx=len(context) - 1 - start,
+            )
+
     def step(self) -> list[dict]:
         """Admit + one decode step. Returns finished request dicts."""
         finished: list[dict] = []
         with self._lock:
+            if self._prefilling is not None:
+                # Continue the in-flight chunked prefill: one chunk per
+                # step bounds the stall it adds to this step's decodes.
+                self._prefill_step(finished)
             self._admit(finished)
             if not self._active:
                 return finished
@@ -452,10 +555,15 @@ class LLMEngine:
         """Prompt-lookup speculative step (reference capability: vLLM
         speculative decoding behind ray.llm): verify K = 1 + speculate
         positions per slot in one dispatch and accept the longest
-        draft prefix the model agrees with. Greedy slots only —
-        stochastic sampling would need rejection-sampling acceptance,
-        so temperature/top_k slots run with an empty draft (their
-        position-0 output is exactly a normal decode step)."""
+        draft prefix the model agrees with. Greedy slots accept on
+        argmax equality (bit-identical to plain decode); stochastic
+        slots use exact rejection sampling computed on device (see
+        paged_kv.paged_verify) so their emitted stream is distributed
+        exactly as plain temperature sampling. top_k slots run with an
+        empty draft (their position-0 output is a normal decode step).
+
+        Acceptance is one vectorized mismatch-argmax over [B, K-1] —
+        not a per-slot interpreted loop on the serial dispatch path."""
         from ray_tpu.llm.paged_kv import propose_ngram_draft
 
         K = 1 + self.speculate
@@ -463,8 +571,8 @@ class LLMEngine:
         toks[:, 0] = self._tokens[:, 0]
         draft_len = np.zeros((self.max_batch,), np.int32)
         for slot, req in self._active.items():
-            if req.sampling.temperature != 0:
-                continue  # stochastic slots: no draft (see docstring)
+            if req.sampling.top_k and req.sampling.temperature > 0:
+                continue  # host-sampled: no draft
             draft = propose_ngram_draft(
                 req.prompt + req.out_tokens, K - 1
             )
@@ -472,7 +580,7 @@ class LLMEngine:
                 draft_len[slot] = len(draft)
                 toks[slot, 1: 1 + len(draft)] = draft
 
-        sampled, logits, self.cache = self._verify_paged(
+        sampled, accept, rej, logits, self.cache = self._verify_paged(
             self.params,
             jnp.asarray(toks),
             self.cache,
@@ -482,6 +590,13 @@ class LLMEngine:
             sub,
         )
         sampled = np.asarray(sampled)  # [B, K]
+        accept = np.asarray(accept)  # [B, K-1] bool
+        rej = np.asarray(rej)  # [B, K-1]
+        # Vectorized acceptance: n_acc[b] = index of the first rejected
+        # (or absent) draft position.
+        stop = ~accept
+        stop |= np.arange(K - 1)[None, :] >= draft_len[:, None]
+        n_acc = np.where(stop.any(axis=1), stop.argmax(axis=1), K - 1)
         host_logits = None
         for slot, req in list(self._active.items()):
             if req.sampling.top_k and req.sampling.temperature > 0:
@@ -490,17 +605,17 @@ class LLMEngine:
                 tok = self._sample(host_logits[slot], req.sampling)
                 self._record_token(req, tok, finished)
                 continue
-            # Accept while the model's sampled token matches the draft
-            # it was fed; always emit position 0 (the normal token),
-            # plus one model token per accepted draft position.
-            n_acc = 0
-            while (
-                n_acc < draft_len[slot]
-                and sampled[slot, n_acc] == toks[slot, n_acc + 1]
-            ):
-                n_acc += 1
-            for j in range(n_acc + 1):
-                self._record_token(req, int(sampled[slot, j]), finished)
+            na = int(n_acc[slot])
+            # Accepted drafts verbatim, then the boundary token: the
+            # residual sample if a draft was REJECTED there, the full-p
+            # sample if the draft simply ran out (or none existed).
+            emit = list(toks[slot, 1: 1 + na])
+            if na < draft_len[slot]:
+                emit.append(int(rej[slot, na]))
+            else:
+                emit.append(int(sampled[slot, na]))
+            for tok in emit:
+                self._record_token(req, int(tok), finished)
                 if req.done:
                     break
 
@@ -511,6 +626,14 @@ class LLMEngine:
         with self._lock:
             self._stream_ids.discard(request_id)
             self._deltas.pop(request_id, None)
+            st = self._prefilling
+            if st is not None and st["req"].request_id == request_id:
+                # Mid-chunked-prefill abort: free the held slot + pages
+                # and drop the chunk state.
+                self._prefilling = None
+                self._free.append(st["slot"])
+                self._release_pages(st["req"])
+                return True
             for i, r in enumerate(self._queue):
                 if r.request_id == request_id:
                     del self._queue[i]

@@ -123,6 +123,40 @@ def prefix_hashes(tokens: list[int], page_size: int) -> list[int]:
 from ray_tpu.llm.kv_cache import _mlp, _project_qkv  # noqa: E402
 
 
+def _gather_page_attention(q, k_pool, v_pool, page_index, mask, cfg):
+    """Dense masked attention over gathered pool pages — the XLA
+    fallback shared by decode/verify and chunked prefill (one body: a
+    numerics change here changes every gather-path caller at once).
+
+    q: [B, Q, H, Dh]; page_index: [B, n_pages] int32 (>= 0);
+    mask: [B, Q, window] bool, True = hidden. Returns [B, Q, H, Dh].
+    """
+    b = q.shape[0]
+    page_size = k_pool.shape[1]
+    window = page_index.shape[1] * page_size
+    kk = jnp.take(k_pool, page_index, axis=0).reshape(
+        b, window, cfg.n_kv_heads, cfg.head_dim
+    )
+    vv = jnp.take(v_pool, page_index, axis=0).reshape(
+        b, window, cfg.n_kv_heads, cfg.head_dim
+    )
+    n_rep = cfg.n_heads // cfg.n_kv_heads
+    kk = jnp.repeat(kk, n_rep, axis=2)
+    vv = jnp.repeat(vv, n_rep, axis=2)
+    scale = cfg.head_dim**-0.5
+    logits = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, kk,
+            preferred_element_type=jnp.float32,
+        )
+        * scale
+    )
+    logits = jnp.where(mask[:, None, :, :], _NEG_INF, logits)
+    probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+    return attn
+
+
 @partial(
     jax.jit,
     static_argnames=("cfg", "n_write_pages"),
@@ -178,6 +212,75 @@ def paged_prefill(
     return logits, {"k": k_pool, "v": v_pool}
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "n_write_pages", "chunk_pages"),
+    donate_argnames=("pool",),
+)
+def paged_prefill_chunk(
+    params,
+    tokens: jnp.ndarray,  # [1, C] int32, C = chunk_pages * page_size
+    pool: PagedKV,
+    pages: jnp.ndarray,  # [n_write_pages] int32: the FULL context table
+    start: jnp.ndarray,  # [] int32: global position of tokens[0, 0]
+    cfg: LlamaConfig,
+    n_write_pages: int,
+    chunk_pages: int,
+):
+    """One prefill CHUNK: compute K/V for ``tokens`` at positions
+    ``start .. start+C-1``, scatter them into the chunk's slice of
+    ``pages``, and attend each chunk query over the whole context so
+    far (earlier chunks' pages + this chunk, causal within the chunk).
+
+    Splitting prefill this way is what lets the engine interleave a
+    long prompt with decode steps instead of stalling every in-flight
+    request for the prompt's full dense pass (reference capability:
+    vLLM's chunked prefill, which ray.llm buys via engine_kwargs).
+    ``start`` must be page-aligned; K/V of a position depend only on
+    tokens <= it, so chunking is mathematically exact.
+
+    Returns (logits [1, C, V] fp32, pool).
+    """
+    c = tokens.shape[1]
+    page_size = pool["k"].shape[2]
+    window = n_write_pages * page_size
+    cos, sin = rope_frequencies(cfg.head_dim, window, cfg.rope_theta)
+    pos = start + jnp.arange(c, dtype=jnp.int32)[None, :]  # [1, C]
+    x = params["tok_emb"].astype(cfg.dtype)[tokens]
+    chunk_slice = jax.lax.dynamic_slice(
+        pages, [start // page_size], [chunk_pages]
+    )
+    key_idx = jnp.arange(window)[None, None, :]
+    mask = key_idx > pos[:, :, None]  # [1, C, window]
+
+    def body(x, layer):
+        p, k_pool, v_pool = layer
+        q, k, v = _project_qkv(x, p, cfg)  # [1, C, H, Dh]
+        q = apply_rope(q, cos, sin, positions=pos)
+        k = apply_rope(k, cos, sin, positions=pos)
+        kp = k.astype(cfg.dtype).reshape(
+            chunk_pages, page_size, cfg.n_kv_heads, cfg.head_dim
+        )
+        vp = v.astype(cfg.dtype).reshape(
+            chunk_pages, page_size, cfg.n_kv_heads, cfg.head_dim
+        )
+        k_pool = k_pool.at[chunk_slice].set(kp)
+        v_pool = v_pool.at[chunk_slice].set(vp)
+        attn = _gather_page_attention(
+            q, k_pool, v_pool, pages[None, :], mask, cfg
+        )
+        x = x + attn.reshape(1, c, -1) @ p["wo"].astype(cfg.dtype)
+        x = _mlp(x, p, cfg)
+        return x, (k_pool, v_pool)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        body, x, (params["blocks"], pool["k"], pool["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"k": k_pool, "v": v_pool}
+
+
 def paged_decode(
     params,
     tokens: jnp.ndarray,  # [B, 1] int32
@@ -187,6 +290,7 @@ def paged_decode(
     temperature: jnp.ndarray,  # [B] fp32 (0 = greedy)
     rng_key: jnp.ndarray,
     cfg: LlamaConfig,
+    use_kernel: bool = False,
 ):
     """One decode step over the page pool — exactly the K=1 case of
     :func:`paged_verify` (one source of truth for the page-attention
@@ -196,14 +300,18 @@ def paged_decode(
 
     Returns (sampled [B] int32, logits [B, V] fp32, pool).
     """
-    sampled, logits, pool = paged_verify(
+    sampled, _accept, _rej, logits, pool = paged_verify(
         params, tokens, pool, block_tables, positions, temperature,
-        rng_key, cfg=cfg,
+        rng_key, cfg=cfg, use_kernel=use_kernel,
     )
     return sampled[:, 0], logits, pool
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("pool",))
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "use_kernel"),
+    donate_argnames=("pool",),
+)
 def paged_verify(
     params,
     tokens: jnp.ndarray,  # [B, K] int32: next token + K-1 draft tokens
@@ -213,22 +321,37 @@ def paged_verify(
     temperature: jnp.ndarray,  # [B] fp32 (0 = greedy)
     rng_key: jnp.ndarray,
     cfg: LlamaConfig,
+    use_kernel: bool = False,
 ):
     """Speculative verify step: process K tokens per slot in ONE pass
     (reference capability: vLLM's speculative/prompt-lookup decoding,
     the serving engine behind ray.llm). tokens[:, 0] is the ordinary
     next token; tokens[:, 1:] are HOST-PROPOSED draft tokens (n-gram
     prompt lookup — no draft model). The engine accepts the longest
-    prefix where the model's own sampled token agrees with the draft,
-    advancing up to K tokens per dispatch.
+    prefix the model agrees with, advancing up to K tokens per
+    dispatch.
+
+    Acceptance inputs are computed ON DEVICE for every slot:
+
+    - greedy slots (temp 0): ``accept[b, j]`` = the model's argmax
+      after position j equals draft token j+1 — exactly the original
+      host comparison.
+    - stochastic slots: exact rejection sampling against the
+      prompt-lookup draft's delta distribution q(x) = 1{x = draft}:
+      accept with probability min(1, p(draft)/q(draft)) = p(draft),
+      and on rejection emit a sample from the residual
+      norm(max(p - q, 0)) — i.e. p with the draft token masked out.
+      The emitted stream is distributed EXACTLY as sampling from p
+      (Leviathan et al.; vLLM's rejection sampler).
 
     Rejected drafts need no rollback: a rejected position's K/V cell is
     re-written by the next step's scatter BEFORE any query attends that
     position (scatter precedes gather within each layer, and the causal
     mask hides cells beyond each query's position until then).
 
-    Returns (sampled [B, K] int32, logits [B, V] fp32 for position 0,
-    pool).
+    Returns (sampled [B, K] int32, accept [B, K-1] bool,
+    rej [B, K-1] int32 residual samples, logits [B, V] fp32 for
+    position 0, pool).
     """
     b, kk_w = tokens.shape
     x = params["tok_emb"].astype(cfg.dtype)[tokens]  # [B, K, d]
@@ -268,27 +391,21 @@ def paged_verify(
             v.astype(cfg.dtype)
         )
 
-        tables = jnp.maximum(block_tables, 0)
-        kk = jnp.take(k_pool, tables, axis=0).reshape(
-            b, window, cfg.n_kv_heads, cfg.head_dim
-        )
-        vv = jnp.take(v_pool, tables, axis=0).reshape(
-            b, window, cfg.n_kv_heads, cfg.head_dim
-        )
-        n_rep = cfg.n_heads // cfg.n_kv_heads
-        kk = jnp.repeat(kk, n_rep, axis=2)
-        vv = jnp.repeat(vv, n_rep, axis=2)
-        scale = cfg.head_dim**-0.5
-        logits = (
-            jnp.einsum(
-                "bqhd,bkhd->bhqk", q, kk,
-                preferred_element_type=jnp.float32,
+        if use_kernel:
+            # Pallas path: pages read in place, GQA-grouped, per-slot
+            # length early-exit (see ops/pallas/paged_attention.py).
+            from ray_tpu.ops.pallas.paged_attention import paged_attention
+
+            attn = paged_attention(
+                q, k_pool, v_pool, block_tables, positions,
+                n_kv_heads=cfg.n_kv_heads,
+                interpret=jax.default_backend() != "tpu",
             )
-            * scale
-        )
-        logits = jnp.where(mask[:, None, :, :], _NEG_INF, logits)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+        else:
+            attn = _gather_page_attention(
+                q, k_pool, v_pool, jnp.maximum(block_tables, 0),
+                mask, cfg,
+            )
         x = x + attn.reshape(b, kk_w, -1) @ p["wo"].astype(cfg.dtype)
         x = _mlp(x, p, cfg)
         return x, (k_pool, v_pool)
@@ -299,8 +416,10 @@ def paged_verify(
     x = rms_norm(x, params["final_norm"])
     logits = (x @ params["lm_head"].astype(cfg.dtype)).astype(jnp.float32)
 
-    # Per-position sampling: greedy for temp 0 (the only slots the
-    # engine speculates on), temperature draw otherwise.
+    # Per-position sampling: greedy for temp 0, temperature draw
+    # otherwise (the full-p sample — used for position 0, for the
+    # bonus token when a whole draft is accepted, and for every
+    # position on greedy slots).
     flat = logits.reshape(b * kk_w, -1)
     temp_flat = jnp.repeat(temperature, kk_w)
     keys = jax.random.split(rng_key, b * kk_w)
@@ -309,10 +428,54 @@ def paged_verify(
         keys, flat / jnp.maximum(temp_flat, 1e-6)[:, None]
     )
     sampled = jnp.where(temp_flat > 0.0, drawn, greedy).astype(jnp.int32)
+    sampled = sampled.reshape(b, kk_w)
+
+    if kk_w > 1:
+        # Draft acceptance inputs (see docstring). Positions 0..K-2
+        # judge draft tokens 1..K-1.
+        drafts = tokens[:, 1:]  # [B, K-1]
+        head = logits[:, : kk_w - 1]  # [B, K-1, V] fp32
+        temp_c = jnp.maximum(temperature, 1e-6)[:, None, None]
+        probs = jax.nn.softmax(head / temp_c, axis=-1)
+        p_draft = jnp.take_along_axis(
+            probs, drafts[:, :, None], axis=-1
+        )[..., 0]  # [B, K-1]
+        u = jax.random.uniform(
+            jax.random.fold_in(rng_key, 1), (b, kk_w - 1)
+        )
+        acc_greedy = jnp.argmax(head, axis=-1) == drafts
+        accept = jnp.where(
+            temperature[:, None] > 0.0, u < p_draft, acc_greedy
+        )
+        # Residual emission on rejection: p with the draft token masked
+        # (stochastic); the plain argmax for greedy (identical to the
+        # original host behavior — rejection implies argmax != draft).
+        masked = head + jnp.where(
+            jax.nn.one_hot(drafts, head.shape[-1], dtype=jnp.bool_),
+            _NEG_INF,
+            0.0,
+        )
+        rej_keys = jax.random.split(
+            jax.random.fold_in(rng_key, 2), b * (kk_w - 1)
+        )
+        rej_drawn = jax.vmap(jax.random.categorical)(
+            rej_keys,
+            (masked / temp_c).reshape(b * (kk_w - 1), -1),
+        ).reshape(b, kk_w - 1)
+        rej = jnp.where(
+            temperature[:, None] > 0.0,
+            rej_drawn,
+            jnp.argmax(head, axis=-1),
+        ).astype(jnp.int32)
+    else:
+        accept = jnp.zeros((b, 0), jnp.bool_)
+        rej = jnp.zeros((b, 0), jnp.int32)
     # Only position 0's logits ever reach the host (top_k fallback);
     # shipping [B, K, V] would multiply that transfer by K for nothing.
     return (
-        sampled.reshape(b, kk_w),
+        sampled,
+        accept,
+        rej,
         logits[:, 0],
         {"k": k_pool, "v": v_pool},
     )

@@ -37,6 +37,10 @@ class Client {
   // Call a control-plane method; kwargs is a msgpack map.
   Value Call(const std::string& method, ValueMap kwargs);
 
+  // Block until the peer closes the connection, discarding any frames
+  // (the worker runtime uses this to tie its lifetime to the node's).
+  void WaitClosed();
+
   // -- convenience wrappers over head methods -----------------------
   void KvPut(const std::string& key, const std::string& value,
              bool overwrite = true);

@@ -14,28 +14,25 @@
 #include <sstream>
 #include <stdexcept>
 
+#include "raytpu/wire.h"
+
 namespace raytpu {
 
 namespace {
-constexpr uint8_t kWireVersion = 1;
-constexpr int kReq = 0, kResp = 1, kErr = 2, kPush = 3;
+using wire::kErr;
+using wire::kPush;
+using wire::kReq;
+using wire::kResp;
+using wire::kWireVersion;
 
 void WriteAll(int fd, const char* data, size_t n) {
-  while (n > 0) {
-    ssize_t w = ::write(fd, data, n);
-    if (w <= 0) throw std::runtime_error("raytpu: connection write failed");
-    data += w;
-    n -= static_cast<size_t>(w);
-  }
+  if (!wire::WriteAllNoThrow(fd, data, n))
+    throw std::runtime_error("raytpu: connection write failed");
 }
 
 void ReadAll(int fd, char* data, size_t n) {
-  while (n > 0) {
-    ssize_t r = ::read(fd, data, n);
-    if (r <= 0) throw std::runtime_error("raytpu: connection closed");
-    data += r;
-    n -= static_cast<size_t>(r);
-  }
+  if (!wire::ReadAllNoThrow(fd, data, n))
+    throw std::runtime_error("raytpu: connection closed");
 }
 
 std::string RandomHex(int bytes) {
@@ -73,22 +70,8 @@ void SplitAddr(const std::string& addr, std::string* host, int* port) {
   *port = std::stoi(addr.substr(pos + 1));
 }
 
-// The wire's frame-length header is little-endian by protocol
-// (matching the Python side's struct '<I'); serialize it explicitly
-// so big-endian hosts speak the same bytes.
-void PutLe32(char* dst, uint32_t v) {
-  dst[0] = static_cast<char>(v & 0xff);
-  dst[1] = static_cast<char>((v >> 8) & 0xff);
-  dst[2] = static_cast<char>((v >> 16) & 0xff);
-  dst[3] = static_cast<char>((v >> 24) & 0xff);
-}
-
-uint32_t GetLe32(const char* src) {
-  return static_cast<uint32_t>(static_cast<uint8_t>(src[0])) |
-         (static_cast<uint32_t>(static_cast<uint8_t>(src[1])) << 8) |
-         (static_cast<uint32_t>(static_cast<uint8_t>(src[2])) << 16) |
-         (static_cast<uint32_t>(static_cast<uint8_t>(src[3])) << 24);
-}
+using wire::GetLe32;
+using wire::PutLe32;
 }  // namespace
 
 Client::Client(const std::string& host, int port, const std::string& token) {
@@ -161,6 +144,14 @@ Value Client::Call(const std::string& method, ValueMap kwargs) {
     if (kind == kErr)
       throw std::runtime_error("raytpu rpc error: " + (*reply.arr)[2].s);
     return (*reply.arr)[2];
+  }
+}
+
+void Client::WaitClosed() {
+  try {
+    for (;;) (void)ReadFrame();
+  } catch (const std::exception&) {
+    // connection closed (or broke) — either way, the peer is gone.
   }
 }
 

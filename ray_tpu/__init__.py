@@ -31,4 +31,8 @@ def __getattr__(name):
                 f"which failed to import: {e}"
             ) from e
         return getattr(_api, name)
+    if name == "cross_language":
+        import ray_tpu.cross_language as _xl
+
+        return _xl
     raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

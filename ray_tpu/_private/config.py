@@ -89,6 +89,10 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "ENV_CACHE_BYTES": (int, 10 << 30, "built runtime-env cache budget; "
                                        "unreferenced envs evict oldest-"
                                        "idle-first past it"),
+    "CPP_WORKER_CMD": (str, "", "command line for the C++ worker binary "
+                                "(e.g. cpp/build/raytpu_worker); spawned "
+                                "for leases whose runtime_env is "
+                                "{'language': 'cpp'}"),
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: method:prob[:mode] list"),
     "TRACE": (bool, False, "enable span collection in every process"),

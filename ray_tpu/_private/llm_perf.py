@@ -83,25 +83,24 @@ def bench_attention_op_batch64(
 
     kern = partial(paged_attention, n_kv_heads=Hkv)
 
+    # The gather baseline is the REAL fallback body (one source of
+    # truth in paged_kv): the benchmark measures the code path the
+    # engine actually runs, not a private re-implementation.
+    from ray_tpu.llm.paged_kv import _gather_page_attention
+    from ray_tpu.models.llama import LlamaConfig
+
+    # head_dim is d_model // n_heads: pin d_model so it comes out Dh.
+    cfg = LlamaConfig(
+        d_model=H * Dh, n_heads=H, n_kv_heads=Hkv, dtype=jnp.bfloat16
+    )
+
     @jax.jit
     def gather_path(q, kp, vp, tables, positions):
         window = maxp * P
-        t = jnp.maximum(tables, 0)
-        kk = jnp.take(kp, t, axis=0).reshape(B, window, Hkv, Dh)
-        vv = jnp.take(vp, t, axis=0).reshape(B, window, Hkv, Dh)
-        kk = jnp.repeat(kk, H // Hkv, axis=2)
-        vv = jnp.repeat(vv, H // Hkv, axis=2)
         pos2d = positions[:, None] + jnp.arange(K)[None, :]
         mask = jnp.arange(window)[None, None, :] > pos2d[:, :, None]
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, kk,
-            preferred_element_type=jnp.float32,
-        ) * Dh**-0.5
-        s = jnp.where(mask[:, None, :, :], -2.0e38, s)
-        p = jax.nn.softmax(s, axis=-1).astype(jnp.bfloat16)
-        return jnp.einsum(
-            "bhqk,bkhd->bqhd", p, vv,
-            preferred_element_type=jnp.float32,
+        return _gather_page_attention(
+            q, kp, vp, jnp.maximum(tables, 0), mask, cfg
         )
 
     def timeit(f):
@@ -252,12 +251,13 @@ def main(argv=None) -> dict:
         "prefill_stall": stall,
     }
 
-    # Floors. The op rows are the clean signal: measured ~2.5x at the
-    # bench model's (8, 4) heads and ~6.8x at llama-8B's (32, 8) on
-    # v5e. The engine rows are tunnel-RTT-dominated on this rig, so
-    # their floor only catches inversions, and the chunked-prefill p99
-    # must beat the monolithic stall.
-    assert op_bench["speedup"] > 1.7, op_bench
+    # Floors. The op rows are the clean signal: measured ~1.8x at the
+    # bench model's (8, 4) heads and ~6.2x at llama-8B's (32, 8) on
+    # v5e against the REAL fallback body (PERF.json rows). The engine
+    # rows are tunnel-RTT-dominated on this rig, so their floor only
+    # catches inversions, and the chunked-prefill p99 must beat the
+    # monolithic stall.
+    assert op_bench["speedup"] > 1.4, op_bench
     assert op_8b["speedup"] > 4.0, op_8b
     assert decode["speedup"] > 1.1, decode
     assert stall["stall_ratio_p99"] > 1.3, stall

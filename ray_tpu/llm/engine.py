@@ -580,6 +580,13 @@ class LLMEngine:
                 draft_len[slot] = len(draft)
                 toks[slot, 1: 1 + len(draft)] = draft
 
+        # Static flag: an all-greedy batch (the common speculative
+        # configuration) skips the rejection-sampling tensors entirely
+        # — at most two compiled variants, like use_kernel.
+        any_stochastic = any(
+            r.sampling.temperature > 0 and not r.sampling.top_k
+            for r in self._active.values()
+        )
         sampled, accept, rej, logits, self.cache = self._verify_paged(
             self.params,
             jnp.asarray(toks),
@@ -588,6 +595,7 @@ class LLMEngine:
             jnp.asarray(self._positions),
             jnp.asarray(self._temps),
             sub,
+            stochastic=any_stochastic,
         )
         sampled = np.asarray(sampled)  # [B, K]
         accept = np.asarray(accept)  # [B, K-1] bool

@@ -302,14 +302,14 @@ def paged_decode(
     """
     sampled, _accept, _rej, logits, pool = paged_verify(
         params, tokens, pool, block_tables, positions, temperature,
-        rng_key, cfg=cfg, use_kernel=use_kernel,
+        rng_key, cfg=cfg, use_kernel=use_kernel, stochastic=False,
     )
     return sampled[:, 0], logits, pool
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "use_kernel"),
+    static_argnames=("cfg", "use_kernel", "stochastic"),
     donate_argnames=("pool",),
 )
 def paged_verify(
@@ -322,6 +322,7 @@ def paged_verify(
     rng_key: jnp.ndarray,
     cfg: LlamaConfig,
     use_kernel: bool = False,
+    stochastic: bool = True,
 ):
     """Speculative verify step: process K tokens per slot in ONE pass
     (reference capability: vLLM's speculative/prompt-lookup decoding,
@@ -435,38 +436,48 @@ def paged_verify(
         # judge draft tokens 1..K-1.
         drafts = tokens[:, 1:]  # [B, K-1]
         head = logits[:, : kk_w - 1]  # [B, K-1, V] fp32
-        temp_c = jnp.maximum(temperature, 1e-6)[:, None, None]
-        probs = jax.nn.softmax(head / temp_c, axis=-1)
-        p_draft = jnp.take_along_axis(
-            probs, drafts[:, :, None], axis=-1
-        )[..., 0]  # [B, K-1]
-        u = jax.random.uniform(
-            jax.random.fold_in(rng_key, 1), (b, kk_w - 1)
-        )
-        acc_greedy = jnp.argmax(head, axis=-1) == drafts
-        accept = jnp.where(
-            temperature[:, None] > 0.0, u < p_draft, acc_greedy
-        )
-        # Residual emission on rejection: p with the draft token masked
-        # (stochastic); the plain argmax for greedy (identical to the
-        # original host behavior — rejection implies argmax != draft).
-        masked = head + jnp.where(
-            jax.nn.one_hot(drafts, head.shape[-1], dtype=jnp.bool_),
-            _NEG_INF,
-            0.0,
-        )
-        rej_keys = jax.random.split(
-            jax.random.fold_in(rng_key, 2), b * (kk_w - 1)
-        )
-        rej_drawn = jax.vmap(jax.random.categorical)(
-            rej_keys,
-            (masked / temp_c).reshape(b * (kk_w - 1), -1),
-        ).reshape(b, kk_w - 1)
-        rej = jnp.where(
-            temperature[:, None] > 0.0,
-            rej_drawn,
-            jnp.argmax(head, axis=-1),
-        ).astype(jnp.int32)
+        head_argmax = jnp.argmax(head, axis=-1)
+        acc_greedy = head_argmax == drafts
+        if stochastic:
+            temp_c = jnp.maximum(temperature, 1e-6)[:, None, None]
+            probs = jax.nn.softmax(head / temp_c, axis=-1)
+            p_draft = jnp.take_along_axis(
+                probs, drafts[:, :, None], axis=-1
+            )[..., 0]  # [B, K-1]
+            u = jax.random.uniform(
+                jax.random.fold_in(rng_key, 1), (b, kk_w - 1)
+            )
+            accept = jnp.where(
+                temperature[:, None] > 0.0, u < p_draft, acc_greedy
+            )
+            # Residual emission on rejection: p with the draft token
+            # masked (stochastic); the plain argmax for greedy
+            # (identical to the original host behavior — rejection
+            # implies argmax != draft).
+            masked = head + jnp.where(
+                jax.nn.one_hot(drafts, head.shape[-1], dtype=jnp.bool_),
+                _NEG_INF,
+                0.0,
+            )
+            rej_keys = jax.random.split(
+                jax.random.fold_in(rng_key, 2), b * (kk_w - 1)
+            )
+            rej_drawn = jax.vmap(jax.random.categorical)(
+                rej_keys,
+                (masked / temp_c).reshape(b * (kk_w - 1), -1),
+            ).reshape(b, kk_w - 1)
+            rej = jnp.where(
+                temperature[:, None] > 0.0,
+                rej_drawn,
+                head_argmax,
+            ).astype(jnp.int32)
+        else:
+            # All-greedy batch (static flag from the engine): the
+            # rejection tensors — a [B, K-1, V] softmax, one_hot mask,
+            # and b*(K-1) categorical draws — would be dead weight on
+            # every dispatch.
+            accept = acc_greedy
+            rej = head_argmax.astype(jnp.int32)
     else:
         accept = jnp.zeros((b, 0), jnp.bool_)
         rej = jnp.zeros((b, 0), jnp.int32)

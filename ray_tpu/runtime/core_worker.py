@@ -351,6 +351,25 @@ class CoreWorker:
                 encoded.append((slot, "val", s.inband, s.buffers))
         return encoded
 
+    def _encode_args_mp(self, args: Sequence, kwargs: dict) -> list:
+        """Cross-language args: plain msgpack only (numbers, strings,
+        bytes, lists, maps) — a foreign worker cannot unpickle, and
+        refs would need an owner protocol it does not speak."""
+        if kwargs:
+            raise TypeError(
+                "cross-language calls take positional arguments only"
+            )
+        encoded = []
+        for value in args:
+            try:
+                encoded.append((None, "mp", rpc.pack_frame(value)))
+            except (TypeError, ValueError) as e:
+                raise TypeError(
+                    "cross-language arguments must be msgpack-encodable "
+                    f"plain data: {e}"
+                ) from None
+        return encoded
+
     async def _decode_args(self, encoded: list) -> tuple[list, dict]:
         args, kwargs = [], {}
         for entry in encoded:
@@ -859,18 +878,40 @@ class CoreWorker:
             self._waiters.setdefault(oid_hex, [])
 
         # Actor calls carry the method *name*; normal tasks export the
-        # function to the cluster KV and carry its id.
-        fn_id = fn if actor is not None else await self.export_function(fn)
+        # function to the cluster KV and carry its id. "cfn:<name>"
+        # targets a function DEFINED in a foreign worker (C++
+        # RAYTPU_REMOTE registration): nothing to export — the name is
+        # resolved inside the executing worker's own registry, args and
+        # results cross as msgpack (reference: cross_language.py
+        # cpp_function + ray_remote.h).
+        xlang_target = isinstance(fn, str) and fn.startswith("cfn:")
+        if xlang_target:
+            if num_returns != 1:
+                # The foreign worker replies with exactly one msgpack
+                # result; extra return refs would never resolve.
+                raise ValueError(
+                    "cross-language tasks return exactly one value "
+                    f"(got num_returns={num_returns!r})"
+                )
+            fn_id = fn
+        else:
+            fn_id = fn if actor is not None else await self.export_function(fn)
         spec = {
             "task_id": task_id.hex(),
             "fn_id": fn_id,
             "name": (
                 fn if isinstance(fn, str) else getattr(fn, "__name__", "")
             ),
-            "args": self._encode_args(args, kwargs),
+            "args": (
+                self._encode_args_mp(args, kwargs)
+                if xlang_target
+                else self._encode_args(args, kwargs)
+            ),
             "num_returns": num_returns,
             "owner_addr": self.addr,
         }
+        if xlang_target:
+            spec["xlang"] = True
         if streaming:
             spec["streaming"] = True
             self._gen_attempt[task_id.hex()] = 0
@@ -1494,7 +1535,14 @@ class CoreWorker:
     ) -> bool:
         """Returns True when the reply carries a task error."""
         if reply["status"] == "error":
-            err = deserialize(reply["error"])
+            if "error" in reply:
+                err = deserialize(reply["error"])
+            else:
+                # A foreign (C++) worker cannot pickle a RayTaskError;
+                # it sends the text only.
+                err = RayTaskError(
+                    reply.get("error_text") or "foreign task failed"
+                )
             for oid_hex in oids:
                 self._store_result(oid_hex, ("error", err))
             if task_id is not None:
@@ -1507,6 +1555,15 @@ class CoreWorker:
                 self._store_result(oid_hex, ("value", rest[0], rest[1]))
             elif kind == "tensor":  # payload stays in the producer
                 self._store_result(oid_hex, ("tensor", rest[0]))
+            elif kind == "xmp":
+                # Cross-language result: msgpack from a foreign worker,
+                # re-serialized into the owner's normal value path.
+                s = serialize(
+                    rpc.unpack_frame(rest[0])
+                ).materialize_buffers()
+                self._store_result(
+                    oid_hex, ("value", s.inband, s.buffers)
+                )
             else:  # in a node's shared store (rest = [holder_node_addr])
                 self._store_result(
                     oid_hex, ("in_store", rest[0] if rest else None)

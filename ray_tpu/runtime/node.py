@@ -497,6 +497,11 @@ class NodeManager:
             ehash = env_hash(runtime_env)
         from ray_tpu._private import config
 
+        if (runtime_env or {}).get("language") == "cpp":
+            # Checked BEFORE the inproc branch: a cpp lease must never
+            # silently get a Python CoreWorker (the binary is a real
+            # subprocess even in scale-simulation mode).
+            return self._spawn_worker_cpp(worker_id, runtime_env, ehash)
         if config.get("WORKER_MODE") == "inproc":
             # Scale-simulation mode (see the WORKER_MODE knob and the
             # reference's many-node release benchmarks,
@@ -620,6 +625,62 @@ class NodeManager:
             # Spawn failed before a worker record existed: nothing will
             # ever release the ref taken above, so release it here or
             # the env is pinned against GC forever.
+            _env_cache.release(ehash)
+            raise
+        self.workers[worker_id] = {
+            "proc": proc,
+            "state": "spawning",
+            "env_hash": ehash,
+            "runtime_env": runtime_env,
+            "log_path": str(log_path),
+        }
+        return worker_id
+
+    def _spawn_worker_cpp(
+        self, worker_id: str, runtime_env: dict | None, ehash: str
+    ) -> str:
+        """Spawn the configured C++ worker binary (reference: the C++
+        worker the raylet starts for RAY_REMOTE tasks, cpp/src/ray/
+        runtime/task/task_executor.cc). It registers back over the
+        native wire exactly like a Python worker; the {'language':
+        'cpp'} runtime_env gives these their own worker pool, so the
+        lease machinery never hands a cpp task to a Python process or
+        vice versa."""
+        import shlex
+
+        from ray_tpu._private import config
+
+        cmd = config.get("CPP_WORKER_CMD")
+        if not cmd:
+            raise RuntimeError(
+                "runtime_env {'language': 'cpp'} needs RAY_TPU_CPP_"
+                "WORKER_CMD to point at a worker binary (build one "
+                "with make -C cpp: build/raytpu_worker)"
+            )
+        _env_cache.acquire(ehash)  # pairs with release on worker death
+        env = {
+            **os.environ,
+            **self.worker_env,
+            "RAY_TPU_HEAD_ADDR": self.head_addr,
+            "RAY_TPU_NODE_ADDR": self.addr or "",
+            "RAY_TPU_STORE_DIR": self.store_dir,
+            "RAY_TPU_WORKER_ID": worker_id,
+            # The binary reads the token from env only (it has no
+            # config registry); programmatic overrides would otherwise
+            # be invisible to it.
+            "RAY_TPU_AUTH_TOKEN": config.get("AUTH_TOKEN"),
+        }
+        try:
+            self.log_dir.mkdir(parents=True, exist_ok=True)
+            log_path = self.log_dir / f"worker-{worker_id}.log"
+            with open(log_path, "ab") as log_f:
+                proc = subprocess.Popen(
+                    shlex.split(cmd),
+                    env=env,
+                    stdout=log_f,
+                    stderr=subprocess.STDOUT,
+                )
+        except Exception:
             _env_cache.release(ehash)
             raise
         self.workers[worker_id] = {

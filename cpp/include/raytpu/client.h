@@ -18,18 +18,24 @@
 #pragma once
 
 #include <cstdint>
+#include <functional>
+#include <memory>
 #include <string>
 #include <vector>
 
 #include "raytpu/msgpack_lite.h"
+#include "raytpu/transport.h"
 
 namespace raytpu {
 
 // One rpc connection: REQ out, RESP/ERR in (PUSH frames are ignored —
-// a blocking driver does not subscribe).
+// a blocking driver does not subscribe). With a non-empty cert path
+// the connection runs over TLS pinned to the cluster certificate
+// (start --tls; matches the Python client's pinning posture).
 class Client {
  public:
-  Client(const std::string& host, int port, const std::string& token);
+  Client(const std::string& host, int port, const std::string& token,
+         const std::string& cert = "");
   ~Client();
   Client(const Client&) = delete;
   Client& operator=(const Client&) = delete;
@@ -53,8 +59,35 @@ class Client {
  private:
   void WriteFrame(const std::string& payload);
   std::string ReadFrame();
-  int fd_ = -1;
+  std::unique_ptr<Transport> transport_;
   uint64_t next_id_ = 0;
+};
+
+// Client endpoint that survives peer restarts: re-dials with backoff
+// on connection loss and retries the in-flight call until a deadline
+// (semantics of the Python ReconnectingClient, _private/rpc.py:500 —
+// route IDEMPOTENT methods only; a call whose response was lost is
+// re-sent).
+class ReconnectingClient {
+ public:
+  ReconnectingClient(const std::string& host, int port,
+                     const std::string& token,
+                     const std::string& cert = "",
+                     double reconnect_timeout_s = 20.0);
+
+  // retry=false: non-idempotent call — a transport failure after the
+  // request may have been sent surfaces instead of re-sending.
+  Value Call(const std::string& method, ValueMap kwargs,
+             bool retry = true);
+
+ private:
+  Client& Ensure();
+  std::string host_;
+  int port_;
+  std::string token_;
+  std::string cert_;
+  double reconnect_timeout_s_;
+  std::unique_ptr<Client> conn_;
 };
 
 // Cross-language task driver: lease a worker, push the task, return
@@ -62,7 +95,8 @@ class Client {
 class Driver {
  public:
   // head_addr "host:port". Connects to the head, discovers a node.
-  Driver(const std::string& head_addr, const std::string& token);
+  Driver(const std::string& head_addr, const std::string& token,
+         const std::string& cert = "");
 
   // Invoke a Python function registered as xfn:<name> with msgpack
   // args; returns its msgpack result. Throws std::runtime_error with
@@ -73,6 +107,7 @@ class Driver {
 
  private:
   std::string token_;
+  std::string cert_;
   Client head_;
   std::string node_host_;
   int node_port_ = 0;

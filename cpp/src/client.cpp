@@ -25,16 +25,6 @@ using wire::kReq;
 using wire::kResp;
 using wire::kWireVersion;
 
-void WriteAll(int fd, const char* data, size_t n) {
-  if (!wire::WriteAllNoThrow(fd, data, n))
-    throw std::runtime_error("raytpu: connection write failed");
-}
-
-void ReadAll(int fd, char* data, size_t n) {
-  if (!wire::ReadAllNoThrow(fd, data, n))
-    throw std::runtime_error("raytpu: connection closed");
-}
-
 std::string RandomHex(int bytes) {
   static thread_local std::mt19937_64 rng{std::random_device{}()};
   static const char* hex = "0123456789abcdef";
@@ -72,54 +62,52 @@ void SplitAddr(const std::string& addr, std::string* host, int* port) {
 
 using wire::GetLe32;
 using wire::PutLe32;
+
+void SleepMs(int ms) {
+  struct timespec ts {
+    ms / 1000, (ms % 1000) * 1000000L
+  };
+  nanosleep(&ts, nullptr);
+}
+
+double NowS() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
 }  // namespace
 
-Client::Client(const std::string& host, int port, const std::string& token) {
-  addrinfo hints{}, *res = nullptr;
-  hints.ai_family = AF_UNSPEC;
-  hints.ai_socktype = SOCK_STREAM;
-  std::string port_s = std::to_string(port);
-  if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0 || !res)
-    throw std::runtime_error("raytpu: cannot resolve " + host);
-  fd_ = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-  if (fd_ < 0 || ::connect(fd_, res->ai_addr, res->ai_addrlen) != 0) {
-    freeaddrinfo(res);
-    throw std::runtime_error("raytpu: cannot connect to " + host + ":" +
-                             port_s);
-  }
-  freeaddrinfo(res);
-  int one = 1;
-  ::setsockopt(fd_, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+Client::Client(const std::string& host, int port, const std::string& token,
+               const std::string& cert)
+    : transport_(Transport::Connect(host, port, cert)) {
   if (!token.empty()) {
     std::string blob = "RTPUAUTH" + token;
     uint32_t len = static_cast<uint32_t>(blob.size());
     char hdr[4];
     PutLe32(hdr, len);
-    WriteAll(fd_, hdr, 4);
-    WriteAll(fd_, blob.data(), blob.size());
+    transport_->WriteAll(hdr, 4);
+    transport_->WriteAll(blob.data(), blob.size());
   }
 }
 
-Client::~Client() {
-  if (fd_ >= 0) ::close(fd_);
-}
+Client::~Client() = default;
 
 void Client::WriteFrame(const std::string& payload) {
   uint32_t len = static_cast<uint32_t>(payload.size() + 1);
   char hdr[5];
   PutLe32(hdr, len);
   hdr[4] = static_cast<char>(kWireVersion);
-  WriteAll(fd_, hdr, 5);
-  WriteAll(fd_, payload.data(), payload.size());
+  transport_->WriteAll(hdr, 5);
+  transport_->WriteAll(payload.data(), payload.size());
 }
 
 std::string Client::ReadFrame() {
   char hdr[4];
-  ReadAll(fd_, hdr, 4);
+  transport_->ReadAll(hdr, 4);
   uint32_t len = GetLe32(hdr);
   if (len == 0) throw std::runtime_error("raytpu: empty frame");
   std::string body(len, '\0');
-  ReadAll(fd_, body.data(), len);
+  transport_->ReadAll(body.data(), len);
   if (static_cast<uint8_t>(body[0]) != kWireVersion)
     throw std::runtime_error("raytpu: wire version mismatch");
   return body.substr(1);
@@ -195,8 +183,53 @@ ValueMap Client::Nodes() {
   return out;
 }
 
-Driver::Driver(const std::string& head_addr, const std::string& token)
+Client& ReconnectingClient::Ensure() {
+  if (!conn_)
+    conn_ = std::make_unique<Client>(host_, port_, token_, cert_);
+  return *conn_;
+}
+
+ReconnectingClient::ReconnectingClient(const std::string& host, int port,
+                                       const std::string& token,
+                                       const std::string& cert,
+                                       double reconnect_timeout_s)
+    : host_(host),
+      port_(port),
+      token_(token),
+      cert_(cert),
+      reconnect_timeout_s_(reconnect_timeout_s) {}
+
+Value ReconnectingClient::Call(const std::string& method, ValueMap kwargs,
+                               bool retry) {
+  double deadline = NowS() + reconnect_timeout_s_;
+  int backoff_ms = 200;
+  for (;;) {
+    bool had_conn = static_cast<bool>(conn_);
+    try {
+      // kwargs are consumed by the encode; keep a copy for retries.
+      ValueMap kw = kwargs;
+      return Ensure().Call(method, std::move(kw));
+    } catch (const ConnectionError& e) {
+      conn_.reset();
+      // A failure on a FRESH dial provably never sent the request, so
+      // even retry=false calls may re-dial; a drop on an established
+      // connection may have lost a sent request — only idempotent
+      // (retry=true) calls re-send, matching the Python client.
+      if (had_conn && !retry) throw;
+      if (NowS() >= deadline)
+        throw ConnectionError(std::string("raytpu: peer did not come "
+                                          "back within deadline: ") +
+                              e.what());
+      SleepMs(backoff_ms);
+      backoff_ms = backoff_ms < 2000 ? backoff_ms * 2 : 2000;
+    }
+  }
+}
+
+Driver::Driver(const std::string& head_addr, const std::string& token,
+               const std::string& cert)
     : token_(token),
+      cert_(cert),
       head_([&] {
         std::string host;
         int port;
@@ -210,7 +243,7 @@ Driver::Driver(const std::string& head_addr, const std::string& token)
               SplitAddr(head_addr, &host, &port);
               return port;
             }(),
-            token) {
+            token, cert) {
   // Probe the table: entries for recently-departed drivers linger
   // until the head's health sweep, so take the first node that
   // actually accepts a connection.
@@ -221,7 +254,7 @@ Driver::Driver(const std::string& head_addr, const std::string& token)
     int port = 0;
     try {
       SplitAddr(addr.s, &host, &port);
-      Client probe(host, port, token_);
+      Client probe(host, port, token_, cert_);
       node_host_ = host;
       node_port_ = port;
       return;
@@ -233,7 +266,7 @@ Driver::Driver(const std::string& head_addr, const std::string& token)
 }
 
 Value Driver::Call(const std::string& name, ValueVec args, double num_cpus) {
-  Client node(node_host_, node_port_, token_);
+  Client node(node_host_, node_port_, token_, cert_);
   ValueMap resources;
   resources.emplace("CPU", Value::F(num_cpus));
   ValueMap lease_kw;
@@ -269,7 +302,7 @@ Value Driver::Call(const std::string& name, ValueVec args, double num_cpus) {
   SplitAddr(worker_addr, &whost, &wport);
   Value reply;
   try {
-    Client worker(whost, wport, token_);
+    Client worker(whost, wport, token_, cert_);
     reply = worker.Call("push_task", std::move(push_kw));
   } catch (...) {
     ValueMap ret;

@@ -1,6 +1,8 @@
 // Demo/e2e driver: connect to a ray_tpu cluster from C++, exercise the
 // cluster KV, node listing, and cross-language task calls.
-// Usage: raytpu_demo <head_host:port> [token]
+// Usage: raytpu_demo <head_host:port> [token] [tls_cert]
+// (token/cert also read from RAY_TPU_AUTH_TOKEN / RAY_TPU_TLS_CERT.)
+#include <csignal>
 #include <cstdlib>
 #include <iostream>
 
@@ -12,17 +14,21 @@ using raytpu::Value;
 using raytpu::ValueVec;
 
 int main(int argc, char** argv) {
+  std::signal(SIGPIPE, SIG_IGN);
   if (argc < 2) {
-    std::cerr << "usage: raytpu_demo <head_host:port> [token]\n";
+    std::cerr << "usage: raytpu_demo <head_host:port> [token] [tls_cert]\n";
     return 2;
   }
   std::string head_addr = argv[1];
   std::string token = argc > 2 ? argv[2] : "";
   if (token.empty() && std::getenv("RAY_TPU_AUTH_TOKEN"))
     token = std::getenv("RAY_TPU_AUTH_TOKEN");
+  std::string cert = argc > 3 ? argv[3] : "";
+  if (cert.empty() && std::getenv("RAY_TPU_TLS_CERT"))
+    cert = std::getenv("RAY_TPU_TLS_CERT");
 
   try {
-    Driver drv(head_addr, token);
+    Driver drv(head_addr, token, cert);
 
     // 1. Cluster KV round trip.
     drv.head().KvPut("cpp:hello", "from-cpp");

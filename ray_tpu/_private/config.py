@@ -131,6 +131,15 @@ def get(name: str) -> Any:
     return CONFIG_DEFS[name][1]
 
 
+def clear_system_config(*names: str) -> None:
+    """Remove programmatic overrides AND their env exports (tests that
+    set_system_config must clear both — popping only _overrides leaves
+    the env var, which get() still resolves)."""
+    for name in names:
+        _overrides.pop(name, None)
+        os.environ.pop(f"RAY_TPU_{name}", None)
+
+
 def set_system_config(config: dict[str, Any]) -> None:
     """Programmatic overrides (reference: ray.init(_system_config=...)).
     Also exported to the environment so spawned workers inherit them."""

@@ -171,4 +171,46 @@ def make_multislice_mesh(
         devices=devices,
         allow_split_physical_axes=True,
     )
-    return Mesh(dev_array, MESH_AXES)
+    # Unwrap fake-slice shims (fake_slice_devices below): the hybrid
+    # ARRANGEMENT ran on the shims' slice_index; the Mesh must hold the
+    # real runtime devices.
+    unwrap = np.vectorize(
+        lambda d: getattr(d, "_raytpu_device", d), otypes=[object]
+    )
+    return Mesh(unwrap(dev_array), MESH_AXES)
+
+
+class _FakeSliceDevice:
+    """Attribute-forwarding shim giving a device a fake slice_index —
+    lets single-slice rigs (virtual CPU meshes, one real chip) drive
+    make_multislice_mesh's REAL hybrid arrangement path in tests and
+    dryruns. make_multislice_mesh unwraps these before building the
+    Mesh."""
+
+    def __init__(self, device, slice_index: int):
+        self._raytpu_device = device
+        self.slice_index = slice_index
+
+    def __getattr__(self, name):
+        return getattr(self._raytpu_device, name)
+
+    def __repr__(self):
+        return f"FakeSlice({self.slice_index}, {self._raytpu_device!r})"
+
+
+def fake_slice_devices(
+    n_slices: int, devices: Sequence[jax.Device] | None = None
+) -> list:
+    """Partition ``devices`` into ``n_slices`` contiguous fake slices
+    (test/dryrun shim; see _FakeSliceDevice)."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if len(devices) % n_slices:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_slices} slices"
+        )
+    per = len(devices) // n_slices
+    return [
+        _FakeSliceDevice(d, i // per) for i, d in enumerate(devices)
+    ]

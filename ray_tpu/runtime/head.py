@@ -63,6 +63,13 @@ class HeadService:
         # as ONE demand unit, and entries age out seconds after the
         # requester stops polling (granted or gave up).
         self.unschedulable: dict[str, tuple[dict, float]] = {}
+        # Vectorized scheduling columns: per-resource-kind numpy views
+        # over a stable node ordering, rebuilt on membership change and
+        # updated in place on each resource sync. The label-free pick
+        # (the hot path under actor/PG storms) scans these instead of
+        # per-node Python dicts — profiled 50→100-node sublinearity was
+        # dominated by that scan (PROFILE_r05.md). None = rebuild.
+        self._sched_cols: dict | None = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         if self.journal is not None:
@@ -208,6 +215,7 @@ class HeadService:
             "conn": conn,
         }
         conn.state["node_id"] = node_id
+        self._sched_cols = None  # membership changed
         old = self._node_conns.pop(node_id, None)
         if old is not None:
             await old.close()
@@ -236,6 +244,14 @@ class HeadService:
         node["res_version"] = version
         node["available"] = available
         node["pending"] = pending or []
+        cols = self._sched_cols
+        if cols is not None:
+            i = cols["idx"].get(node_id)
+            if i is None or any(k not in cols["avail"] for k in available):
+                self._sched_cols = None  # new node/kind: full rebuild
+            else:
+                for k, col in cols["avail"].items():
+                    col[i] = available.get(k, 0.0)
         return {"ok": True}
 
     async def _on_keepalive(self, conn, node_id: str):
@@ -300,6 +316,13 @@ class HeadService:
         from ray_tpu.util.scheduling_strategies import labels_match
 
         resources = resources or {}
+        if not labels_hard and not labels_soft:
+            # Hot path (actor/PG storms are label-free): one vectorized
+            # scan over the maintained columns instead of per-node dict
+            # work — the O(picks x nodes) Python constant was what bent
+            # the 50→100-node curve sublinear (PROFILE_r05.md).
+            best = self._pick_node_fast(resources)
+            return self._pick_node_reply(best, resources, requester)
         # Hybrid policy (reference: hybrid_scheduling_policy.h:25-50):
         # skip infeasible, prefer nodes that can run NOW, rank by
         # post-placement utilization, then pick RANDOMLY among the top-k
@@ -353,6 +376,90 @@ class HeadService:
                 c for c in top_k if c[0][:2] == top_k[0][0][:2]
             ]
             best = random.choice(top_k)[1]
+        return self._pick_node_reply(best, resources, requester)
+
+    def _sched_columns(self) -> dict:
+        """(Re)build the vectorized scheduling columns from self.nodes:
+        a stable node list plus per-resource-kind total/available numpy
+        arrays. Membership changes invalidate; _on_sync writes in
+        place."""
+        cols = self._sched_cols
+        if cols is None:
+            import numpy as np
+
+            nids = list(self.nodes)
+            kinds: set[str] = set()
+            for n in self.nodes.values():
+                kinds.update(n["resources"])
+                kinds.update(n["available"])
+            cols = self._sched_cols = {
+                "nids": nids,
+                "idx": {nid: i for i, nid in enumerate(nids)},
+                "total": {
+                    k: np.array(
+                        [
+                            float(self.nodes[nid]["resources"].get(k, 0))
+                            for nid in nids
+                        ]
+                    )
+                    for k in kinds
+                },
+                "avail": {
+                    k: np.array(
+                        [
+                            float(self.nodes[nid]["available"].get(k, 0))
+                            for nid in nids
+                        ]
+                    )
+                    for k in kinds
+                },
+            }
+        return cols
+
+    def _pick_node_fast(self, resources: dict) -> str | None:
+        """Label-free hybrid pick over the vectorized columns — same
+        ranking as the general path (feasible → available-now class →
+        post-placement utilization → random among the top-3 of the best
+        class), with the per-node work done by numpy."""
+        import random
+
+        import numpy as np
+
+        cols = self._sched_columns()
+        n = len(cols["nids"])
+        if n == 0:
+            return None
+        feasible = np.ones(n, bool)
+        avail_now = np.ones(n, bool)
+        util = np.zeros(n)
+        for k, v in resources.items():
+            tot = cols["total"].get(k)
+            if tot is None:
+                return None  # no node has this resource kind at all
+            av = cols["avail"][k]
+            feasible &= tot >= v
+            avail_now &= av >= v
+            pos = tot > 0
+            u = np.zeros(n)
+            u[pos] = (tot[pos] - av[pos] + v) / tot[pos]
+            util = np.maximum(util, u)
+        idx = np.nonzero(feasible)[0]
+        if idx.size == 0:
+            return None
+        # Lexicographic (not available_now, util) folded into one key:
+        # util is bounded (~1 + v/min_total), far under the 1e9 class
+        # separator.
+        comp = (~avail_now[idx]).astype(np.float64) * 1e9 + util[idx]
+        k3 = min(3, idx.size)
+        part = np.argpartition(comp, k3 - 1)[:k3]
+        top = idx[part[np.argsort(comp[part], kind="stable")]]
+        best_class = avail_now[top[0]]
+        same = [int(t) for t in top if avail_now[t] == best_class]
+        return cols["nids"][random.choice(same)]
+
+    def _pick_node_reply(
+        self, best: str | None, resources: dict, requester: str | None
+    ) -> dict:
         if best is None:
             # Record cluster-wide unschedulable demand: the autoscaler's
             # strongest scale-up signal (reference: pending demand in
@@ -919,6 +1026,7 @@ class HeadService:
             for nid, node in list(self.nodes.items()):
                 if now - node["last_seen"] > config.get("HEALTH_TIMEOUT_S"):
                     del self.nodes[nid]
+                    self._sched_cols = None  # membership changed
                     conn = self._node_conns.pop(nid, None)
                     if conn is not None:
                         await conn.close()

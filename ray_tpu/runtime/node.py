@@ -33,11 +33,21 @@ SPAWN_TIMEOUT_S = 30.0
 PENDING_SPILL_S = 2.0  # queued lease age before bouncing to spillback
 
 
+_mem_frac_cache: "tuple[float, float]" = (-1.0, 0.0)  # (ts, value)
+
+
 def system_memory_fraction() -> float:
     """Fraction of system memory in use, cgroup-aware like the
     reference's MemoryMonitor (reference: memory_monitor.h:52 reads
     cgroup limits before /proc/meminfo). Test override:
-    RAY_TPU_FAKE_MEMORY_FRAC_FILE names a file holding a float."""
+    RAY_TPU_FAKE_MEMORY_FRAC_FILE names a file holding a float.
+
+    Cached process-wide for 200 ms: parsing /proc/meminfo costs ~1 ms
+    and every node-manager loop (memory monitor, spill) polls it — at
+    scale-simulation density (hundreds of NodeManagers per process)
+    the uncached reads alone ate ~7% of the core (PROFILE_r05.md)."""
+    import time as _time
+
     from ray_tpu._private import config
 
     fake = config.get("FAKE_MEMORY_FRAC_FILE")
@@ -47,6 +57,17 @@ def system_memory_fraction() -> float:
                 return float(f.read().strip())
         except (OSError, ValueError):
             return 0.0
+    global _mem_frac_cache
+    ts, cached = _mem_frac_cache
+    now = _time.monotonic()
+    if now - ts < 0.2:
+        return cached
+    value = _read_memory_fraction()
+    _mem_frac_cache = (now, value)
+    return value
+
+
+def _read_memory_fraction() -> float:
     # cgroup v2 (container limits beat host totals)
     try:
         with open("/sys/fs/cgroup/memory.max") as f:

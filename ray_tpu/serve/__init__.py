@@ -32,7 +32,7 @@ from ray_tpu.serve.api import (
     start_http,
     status,
 )
-from ray_tpu.serve.grpc_ingress import grpc_request, grpc_stream
+from ray_tpu.serve.grpc_ingress import grpc_chat, grpc_request, grpc_stream
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig
 from ray_tpu.serve.context import get_multiplexed_model_id
@@ -52,6 +52,7 @@ __all__ = [
     "get_multiplexed_model_id",
     "multiplexed",
     "RpcIngressActor",
+    "grpc_chat",
     "grpc_request",
     "grpc_stream",
     "rpc_request",

@@ -195,12 +195,16 @@ def start_http(host: str = "127.0.0.1", port: int = 0) -> int:
     return ray_tpu.get(proxy.get_port.remote())
 
 
-def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
+def start_grpc(
+    host: str = "127.0.0.1", port: int = 0, require_auth: bool = False
+) -> int:
     """Start the gRPC ingress actor; returns the bound port.
 
     (reference: serve/_private/proxy.py:534 gRPCProxy — the reference
     serves gRPC next to HTTP; clients consume
-    ray_tpu/serve/protos/serve.proto in any language.)"""
+    ray_tpu/serve/protos/serve.proto in any language.) With
+    ``require_auth=True`` every non-Healthz call must carry the cluster
+    token as ``authorization: Bearer <token>`` metadata."""
     from ray_tpu.serve.grpc_ingress import GRPC_INGRESS_NAME, GrpcIngressActor
 
     try:
@@ -214,6 +218,6 @@ def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
                 max_concurrency=1000,
                 num_cpus=0.1,
             )
-            .remote(host, port)
+            .remote(host, port, require_auth)
         )
     return ray_tpu.get(ingress.get_port.remote())

@@ -16,6 +16,7 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.bohb_search import BOHBSearch
 from ray_tpu.tune.hyperopt_search import HyperOptSearch
 from ray_tpu.tune.optuna_search import OptunaSearch
 from ray_tpu.tune.search import (
@@ -74,7 +75,8 @@ __all__ = [
     "Tuner", "TuneConfig", "RunConfig", "ResultGrid", "TrialResult",
     "Trainable", "Trial", "StopTrial", "report", "get_checkpoint",
     "uniform", "loguniform", "randint", "choice", "grid_search",
-    "TPESearcher", "OptunaSearch", "HyperOptSearch", "ConcurrencyLimiter", "Repeater",
+    "TPESearcher", "OptunaSearch", "HyperOptSearch", "BOHBSearch",
+    "ConcurrencyLimiter", "Repeater",
     "Domain", "Choice", "Searcher", "BasicVariantGenerator",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",

@@ -47,6 +47,9 @@ def cluster(worker_bin):
     )
     yield info
     ray_tpu.shutdown()
+    from ray_tpu._private import config as _config
+
+    _config.clear_system_config("CPP_WORKER_CMD")
 
 
 def test_python_driver_calls_cpp_functions(cluster):

@@ -1,0 +1,99 @@
+"""Offline RL through the data pipeline: record → parquet → BC → eval.
+
+(reference: rllib/offline/offline_data.py — recorded episodes read
+back through the Data layer with shuffling handled by the dataset, and
+offline-trained policies judged against the behavior data.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.offline import (
+    OfflineBCConfig,
+    dataset_report,
+    evaluate_policy,
+    record_rollouts,
+)
+from ray_tpu.rl.ppo import PPOConfig
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def recorded(cluster, tmp_path_factory):
+    """Train PPO briefly on the chain, then record its rollouts."""
+    path = str(tmp_path_factory.mktemp("episodes"))
+    algo = PPOConfig(
+        env="Chain", env_kwargs={"n": 6},
+        num_env_runners=2, num_envs_per_runner=4, rollout_len=32,
+        lr=3e-3, seed=0,
+    ).build()
+    for _ in range(12):
+        algo.train()
+    summary = record_rollouts(algo, path, num_rounds=3)
+    return path, summary
+
+
+def test_recording_writes_episode_schema(recorded):
+    import ray_tpu.data as rdata
+
+    path, summary = recorded
+    assert summary["rows"] > 0 and summary["episodes"] > 0
+    ds = rdata.read_parquet(path)
+    row = ds.take(1)[0]
+    assert set(row) >= {"eps_id", "t", "obs", "action", "reward", "done"}
+    assert len(row["obs"]) == 6  # chain obs size
+    assert ds.count() == summary["rows"]
+
+
+def test_dataset_report_behavior_stats(recorded):
+    path, summary = recorded
+    report = dataset_report(path)
+    assert report["rows"] == summary["rows"]
+    assert report["episodes_completed"] > 0
+    # A mostly-trained behavior policy finishes chains: positive mean.
+    assert report["behavior_return_mean"] > 0.3
+
+
+def test_bc_from_parquet_beats_random(recorded):
+    """The end-to-end offline claim: BC trained purely from the files
+    recovers a policy whose LIVE evaluated return beats random by a
+    wide margin (random on a 6-chain almost never finishes; the cloned
+    policy nearly always does)."""
+    path, _ = recorded
+    algo = OfflineBCConfig(
+        env="Chain", env_kwargs={"n": 6},
+        input_path=path, batch_size=256, updates_per_step=16,
+        lr=3e-3, seed=0,
+    ).build()
+    for _ in range(10):
+        metrics = algo.train()
+    assert metrics["accuracy"] > 0.8  # clones the behavior actions
+    assert metrics["epoch"] >= 2  # shuffled windowed epochs cycled
+
+    module, params = algo.get_policy()
+    ev = evaluate_policy(
+        module, params, "Chain", env_kwargs={"n": 6},
+        n_episodes=20, max_steps=30,
+    )
+    rand_module = algo.module
+    import jax
+
+    rand_ev = evaluate_policy(
+        rand_module, rand_module.init(jax.random.key(123)), "Chain",
+        env_kwargs={"n": 6}, n_episodes=20, max_steps=30,
+        greedy=False,
+    )
+    assert ev["return_mean"] > 0.9
+    assert ev["return_mean"] > rand_ev["return_mean"] + 0.5
+
+
+def test_offline_bc_requires_input_path():
+    with pytest.raises(ValueError, match="input_path"):
+        OfflineBCConfig(env="Chain").build()

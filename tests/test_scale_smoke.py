@@ -70,3 +70,50 @@ def test_scale_smoke_reduced(tmp_path):
     assert rows.get("scale: nodes hosting actors", 0) >= 3
     # The journal actually recorded the churn.
     assert rows.get("scale: head journal after churn", 0) > 0
+
+
+def test_throughput_per_node_holds_as_nodes_double(tmp_path):
+    """Node-count scaling regression gate (PROFILE_r05.md): at FIXED
+    actor load, doubling the node count must not collapse control-plane
+    throughput. Before the vectorized scheduler columns, the per-pick
+    O(nodes) Python scan bent this curve superlinearly (actor-ready
+    throughput FELL when nodes doubled); now the remaining falloff is
+    the one-core simulation itself, bounded here at 2.5x."""
+
+    def run(n_nodes, journal_dir):
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": f"{os.path.dirname(os.path.dirname(__file__))}"
+            f"{os.pathsep}{os.environ.get('PYTHONPATH', '')}",
+        }
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "ray_tpu._private.scale_smoke",
+                "--nodes", str(n_nodes),
+                "--actors", "200",
+                "--pgs", "10",
+                "--journal-dir", str(journal_dir),
+            ],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        rows = {}
+        for line in proc.stdout.splitlines():
+            try:
+                r = json.loads(line)
+                rows[r["name"]] = r["value"]
+            except (ValueError, KeyError):
+                continue
+        return rows
+
+    a = run(16, tmp_path / "a")
+    b = run(32, tmp_path / "b")
+    for metric in (
+        "scale: actor ready throughput",
+        "scale: pg throughput",
+    ):
+        assert b[metric] >= a[metric] / 2.5, (
+            f"{metric} collapsed when nodes doubled: "
+            f"{a[metric]:.1f} -> {b[metric]:.1f}"
+        )

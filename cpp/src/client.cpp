@@ -204,18 +204,22 @@ Value ReconnectingClient::Call(const std::string& method, ValueMap kwargs,
   double deadline = NowS() + reconnect_timeout_s_;
   int backoff_ms = 200;
   for (;;) {
-    bool had_conn = static_cast<bool>(conn_);
+    // Dial and request are SEPARATE failure classes (the Python
+    // client's err.sent distinction): a dial failure provably never
+    // sent the request, so even retry=false calls re-dial; once the
+    // request may have hit the wire, only idempotent (retry=true)
+    // calls re-send — a lost RESPONSE must not double-execute a
+    // non-idempotent method.
+    bool dialed = false;
     try {
+      Client& conn = Ensure();
+      dialed = true;
       // kwargs are consumed by the encode; keep a copy for retries.
       ValueMap kw = kwargs;
-      return Ensure().Call(method, std::move(kw));
+      return conn.Call(method, std::move(kw));
     } catch (const ConnectionError& e) {
       conn_.reset();
-      // A failure on a FRESH dial provably never sent the request, so
-      // even retry=false calls may re-dial; a drop on an established
-      // connection may have lost a sent request — only idempotent
-      // (retry=true) calls re-send, matching the Python client.
-      if (had_conn && !retry) throw;
+      if (dialed && !retry) throw;
       if (NowS() >= deadline)
         throw ConnectionError(std::string("raytpu: peer did not come "
                                           "back within deadline: ") +

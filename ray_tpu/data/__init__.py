@@ -77,11 +77,43 @@ def read_numpy(paths) -> Dataset:
     return _from_read_tasks(datasource.file_tasks(paths, "numpy"))
 
 
+def read_delta(table: str, *, columns=None) -> Dataset:
+    """Read a Delta Lake table (parquet + JSON transaction log): one
+    read task per active data file, partition values as columns
+    (data/delta.py; reference surface: ray.data lakehouse
+    datasources)."""
+    from ray_tpu.data import delta
+
+    return _from_read_tasks(delta.delta_tasks(table, columns=columns))
+
+
+def read_bigquery(
+    *,
+    project: str,
+    query: str | None = None,
+    dataset: str | None = None,
+    transport=None,
+) -> Dataset:
+    """Read BigQuery rows over the REST v2 API (data/bigquery.py;
+    reference: python/ray/data read_bigquery). ``dataset`` is
+    "dataset.table" sugar for a full-table SELECT. ``transport``
+    injects a recorded transport in tests (zero-egress CI), exactly
+    like the GKE provider's fixtures."""
+    from ray_tpu.data import bigquery
+
+    return _from_read_tasks(
+        bigquery.bigquery_tasks(
+            project=project, query=query, dataset=dataset,
+            transport=transport,
+        )
+    )
+
+
 __all__ = [
     "Dataset", "MaterializedDataset", "GroupedData", "DataIterator",
     "DataContext", "range", "from_items", "from_blocks", "from_pandas",
     "from_arrow", "from_numpy", "read_parquet", "read_csv", "read_json",
-    "read_text", "read_numpy",
+    "read_text", "read_numpy", "read_delta", "read_bigquery",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

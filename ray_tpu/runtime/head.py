@@ -435,10 +435,16 @@ class HeadService:
         for k, v in resources.items():
             tot = cols["total"].get(k)
             if tot is None:
-                return None  # no node has this resource kind at all
+                if v > 0:
+                    return None  # no node has this kind at all
+                # Zero demand for an unknown kind constrains nothing
+                # (matches the general path: total.get(k, 0) < 0 is
+                # never true) — e.g. .options(num_tpus=0).
+                continue
             av = cols["avail"][k]
-            feasible &= tot >= v
-            avail_now &= av >= v
+            if v > 0:
+                feasible &= tot >= v
+                avail_now &= av >= v
             pos = tot > 0
             u = np.zeros(n)
             u[pos] = (tot[pos] - av[pos] + v) / tot[pos]

@@ -83,3 +83,26 @@ def test_pick_node_prefers_available_and_spreads(cluster, remote_node):
         assert reply["ok"]
         seen.add(reply["addr"])
     assert len(seen) >= 2, f"herded onto {seen}"
+
+
+def test_zero_valued_resource_demand_constrains_nothing(cluster):
+    """Regression (round-5 review): {'TPU': 0.0} from
+    .options(num_tpus=0) must schedule on a CPU-only cluster — zero
+    demand for a kind no node advertises is satisfiable, on both the
+    vectorized fast path and the label path."""
+    rt = core_api._runtime
+
+    async def pick(**kw):
+        return await rt.core.head.call("pick_node", **kw)
+
+    fast = rt.run(pick(resources={"CPU": 1.0, "TPU": 0.0}))
+    assert fast["ok"], fast
+    labeled = rt.run(
+        pick(
+            resources={"CPU": 1.0, "TPU": 0.0},
+            labels_soft={"whatever": "x"},
+        )
+    )
+    assert labeled["ok"], labeled
+    # Positive demand for the unknown kind stays infeasible.
+    assert not rt.run(pick(resources={"TPU": 1.0}))["ok"]

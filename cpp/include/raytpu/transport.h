@@ -36,6 +36,12 @@ class Transport {
   static std::unique_ptr<Transport> Connect(const std::string& host,
                                             int port,
                                             const std::string& cert_path);
+
+  // Server side over an accepted fd: with a cert+key, runs the TLS
+  // handshake (the worker runtime's listener in a --tls cluster).
+  static std::unique_ptr<Transport> Accept(int fd,
+                                           const std::string& cert_path,
+                                           const std::string& key_path);
 };
 
 }  // namespace raytpu

@@ -6,8 +6,6 @@
 // can never desynchronize the two.
 #pragma once
 
-#include <unistd.h>
-
 #include <cstddef>
 #include <cstdint>
 #include <string>
@@ -32,28 +30,8 @@ inline uint32_t GetLe32(const char* src) {
          (static_cast<uint32_t>(static_cast<uint8_t>(src[3])) << 24);
 }
 
-// Loop-until-done IO. The bool forms return false on error/EOF (the
-// worker's connection handler treats that as peer-gone); callers that
-// prefer exceptions wrap them.
-inline bool WriteAllNoThrow(int fd, const char* data, size_t n) {
-  while (n > 0) {
-    ssize_t w = ::write(fd, data, n);
-    if (w <= 0) return false;
-    data += w;
-    n -= static_cast<size_t>(w);
-  }
-  return true;
-}
-
-inline bool ReadAllNoThrow(int fd, char* data, size_t n) {
-  while (n > 0) {
-    ssize_t r = ::read(fd, data, n);
-    if (r <= 0) return false;
-    data += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
-}
+// All byte IO goes through Transport (transport.h) — raw-fd helpers
+// were removed so nothing can silently bypass TLS.
 
 }  // namespace wire
 }  // namespace raytpu

@@ -74,6 +74,10 @@ struct SslApi {
   using SSL_METHOD = void;
 
   const SSL_METHOD* (*TLS_client_method)();
+  const SSL_METHOD* (*TLS_server_method)();
+  int (*SSL_CTX_use_certificate_chain_file)(SSL_CTX*, const char*);
+  int (*SSL_CTX_use_PrivateKey_file)(SSL_CTX*, const char*, int);
+  int (*SSL_accept)(SSL*);
   SSL_CTX* (*SSL_CTX_new)(const SSL_METHOD*);
   void (*SSL_CTX_free)(SSL_CTX*);
   int (*SSL_CTX_load_verify_locations)(SSL_CTX*, const char*, const char*);
@@ -109,6 +113,16 @@ struct SslApi {
     };
     api.TLS_client_method = reinterpret_cast<const SSL_METHOD* (*)()>(
         bind("TLS_client_method"));
+    api.TLS_server_method = reinterpret_cast<const SSL_METHOD* (*)()>(
+        bind("TLS_server_method"));
+    api.SSL_CTX_use_certificate_chain_file =
+        reinterpret_cast<int (*)(SSL_CTX*, const char*)>(
+            bind("SSL_CTX_use_certificate_chain_file"));
+    api.SSL_CTX_use_PrivateKey_file =
+        reinterpret_cast<int (*)(SSL_CTX*, const char*, int)>(
+            bind("SSL_CTX_use_PrivateKey_file"));
+    api.SSL_accept =
+        reinterpret_cast<int (*)(SSL*)>(bind("SSL_accept"));
     api.SSL_CTX_new = reinterpret_cast<SSL_CTX* (*)(const SSL_METHOD*)>(
         bind("SSL_CTX_new"));
     api.SSL_CTX_free =
@@ -143,17 +157,21 @@ constexpr long kX509VOk = 0;       // X509_V_OK
 
 class TlsTransport : public Transport {
  public:
+  // fd ownership: on ANY constructor throw the fd is left OPEN — the
+  // Transport::Connect/Accept factories are the single owner of the
+  // fd until a transport is fully built (avoids double-close races
+  // with concurrently accepted fds reusing the number).
   TlsTransport(int fd, const std::string& cert_path) : fd_(fd) {
     const SslApi& api = SslApi::Get();
     ctx_ = api.SSL_CTX_new(api.TLS_client_method());
     if (!ctx_) {
-      Cleanup();
+      FreeSsl();
       throw std::runtime_error("raytpu: SSL_CTX_new failed");
     }
     // Pin: the cluster cert is the only trust root.
     if (api.SSL_CTX_load_verify_locations(ctx_, cert_path.c_str(),
                                           nullptr) != 1) {
-      Cleanup();
+      FreeSsl();
       throw std::runtime_error("raytpu: cannot load TLS cert " +
                                cert_path);
     }
@@ -166,7 +184,7 @@ class TlsTransport : public Transport {
       // caller gets a non-retryable error (ReconnectingClient must
       // not spin its whole deadline against a wrong/rotated cert).
       long verify = api.SSL_get_verify_result(ssl_);
-      Cleanup();
+      FreeSsl();
       if (verify != kX509VOk)
         throw std::runtime_error(
             "raytpu: server certificate does not match the pinned "
@@ -175,7 +193,7 @@ class TlsTransport : public Transport {
       throw ConnectionError("raytpu: TLS handshake failed");
     }
     if (api.SSL_get_verify_result(ssl_) != kX509VOk) {
-      Cleanup();
+      FreeSsl();
       throw std::runtime_error(
           "raytpu: server certificate does not match the pinned "
           "cluster cert");
@@ -184,7 +202,8 @@ class TlsTransport : public Transport {
 
   ~TlsTransport() override {
     if (ssl_) SslApi::Get().SSL_shutdown(ssl_);
-    Cleanup();
+    FreeSsl();
+    if (fd_ >= 0) ::close(fd_);
   }
 
   void WriteAll(const char* data, size_t n) override {
@@ -208,14 +227,85 @@ class TlsTransport : public Transport {
   }
 
  private:
-  void Cleanup() {
+  void FreeSsl() {
     const SslApi& api = SslApi::Get();
     if (ssl_) api.SSL_free(ssl_);
     if (ctx_) api.SSL_CTX_free(ctx_);
     ssl_ = nullptr;
     ctx_ = nullptr;
+  }
+
+  int fd_;
+  SslApi::SSL_CTX* ctx_ = nullptr;
+  SslApi::SSL* ssl_ = nullptr;
+};
+
+// Server-side TLS over an ACCEPTED fd (the worker runtime's listener
+// in a --tls cluster; cert/key are the cluster's own material, same
+// files the Python servers load).
+class TlsServerTransport : public Transport {
+ public:
+  // Same fd-ownership contract as TlsTransport: on constructor throw
+  // the fd stays OPEN for the factory to close exactly once.
+  TlsServerTransport(int fd, const std::string& cert_path,
+                     const std::string& key_path)
+      : fd_(fd) {
+    constexpr int kFiletypePem = 1;  // SSL_FILETYPE_PEM
+    const SslApi& api = SslApi::Get();
+    ctx_ = api.SSL_CTX_new(api.TLS_server_method());
+    if (!ctx_) {
+      FreeSsl();
+      throw std::runtime_error("raytpu: SSL_CTX_new (server) failed");
+    }
+    if (api.SSL_CTX_use_certificate_chain_file(
+            ctx_, cert_path.c_str()) != 1 ||
+        api.SSL_CTX_use_PrivateKey_file(ctx_, key_path.c_str(),
+                                        kFiletypePem) != 1) {
+      FreeSsl();
+      throw std::runtime_error(
+          "raytpu: cannot load TLS cert/key for serving");
+    }
+    ssl_ = api.SSL_new(ctx_);
+    api.SSL_set_fd(ssl_, fd_);
+    if (api.SSL_accept(ssl_) != 1) {
+      FreeSsl();
+      throw ConnectionError("raytpu: TLS accept failed");
+    }
+  }
+
+  ~TlsServerTransport() override {
+    if (ssl_) SslApi::Get().SSL_shutdown(ssl_);
+    FreeSsl();
     if (fd_ >= 0) ::close(fd_);
-    fd_ = -1;
+  }
+
+  void WriteAll(const char* data, size_t n) override {
+    const SslApi& api = SslApi::Get();
+    while (n > 0) {
+      int w = api.SSL_write(ssl_, data, static_cast<int>(n));
+      if (w <= 0) throw ConnectionError("raytpu: TLS write failed");
+      data += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+
+  void ReadAll(char* data, size_t n) override {
+    const SslApi& api = SslApi::Get();
+    while (n > 0) {
+      int r = api.SSL_read(ssl_, data, static_cast<int>(n));
+      if (r <= 0) throw ConnectionError("raytpu: TLS connection closed");
+      data += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+ private:
+  void FreeSsl() {
+    const SslApi& api = SslApi::Get();
+    if (ssl_) api.SSL_free(ssl_);
+    if (ctx_) api.SSL_CTX_free(ctx_);
+    ssl_ = nullptr;
+    ctx_ = nullptr;
   }
 
   int fd_;
@@ -230,7 +320,24 @@ std::unique_ptr<Transport> Transport::Connect(
   int fd = DialTcp(host, port);
   if (cert_path.empty())
     return std::make_unique<PlainTransport>(fd);
-  return std::make_unique<TlsTransport>(fd, cert_path);
+  try {
+    return std::make_unique<TlsTransport>(fd, cert_path);
+  } catch (...) {
+    ::close(fd);  // sole owner until the transport adopts the fd
+    throw;
+  }
+}
+
+std::unique_ptr<Transport> Transport::Accept(
+    int fd, const std::string& cert_path, const std::string& key_path) {
+  if (cert_path.empty())
+    return std::make_unique<PlainTransport>(fd);
+  try {
+    return std::make_unique<TlsServerTransport>(fd, cert_path, key_path);
+  } catch (...) {
+    ::close(fd);
+    throw;
+  }
 }
 
 }  // namespace raytpu

@@ -38,6 +38,7 @@
 #include "raytpu/client.h"
 #include "raytpu/msgpack_lite.h"
 #include "raytpu/ray_remote.h"
+#include "raytpu/transport.h"
 #include "raytpu/wire.h"
 
 namespace raytpu {
@@ -47,23 +48,33 @@ using wire::kReq;
 using wire::kResp;
 using wire::kWireVersion;
 
-bool WriteFrame(int fd, const std::string& payload) {
+bool WriteFrame(Transport& t, const std::string& payload) {
   char hdr[5];
   wire::PutLe32(hdr, static_cast<uint32_t>(payload.size() + 1));
   hdr[4] = static_cast<char>(kWireVersion);
-  return wire::WriteAllNoThrow(fd, hdr, 5) &&
-         wire::WriteAllNoThrow(fd, payload.data(), payload.size());
+  try {
+    t.WriteAll(hdr, 5);
+    t.WriteAll(payload.data(), payload.size());
+    return true;
+  } catch (const ConnectionError&) {
+    return false;
+  }
 }
 
 // Reads one framed blob WITHOUT interpreting the version byte — the
 // auth preamble has none, frames do.
-bool ReadBlob(int fd, std::string* out, uint32_t max_len = 1u << 30) {
+bool ReadBlob(Transport& t, std::string* out, uint32_t max_len = 1u << 30) {
   char hdr[4];
-  if (!wire::ReadAllNoThrow(fd, hdr, 4)) return false;
-  uint32_t len = wire::GetLe32(hdr);
-  if (len == 0 || len > max_len) return false;
-  out->resize(len);
-  return wire::ReadAllNoThrow(fd, out->data(), len);
+  try {
+    t.ReadAll(hdr, 4);
+    uint32_t len = wire::GetLe32(hdr);
+    if (len == 0 || len > max_len) return false;
+    out->resize(len);
+    t.ReadAll(out->data(), len);
+    return true;
+  } catch (const ConnectionError&) {
+    return false;
+  }
 }
 
 std::mutex g_exec_mutex;
@@ -107,21 +118,28 @@ Value ExecutePushTask(const Value& spec) {
   return Value::M(std::move(reply));
 }
 
-void ServeConn(int fd, const std::string& token) {
+void ServeConn(int fd, const std::string& token,
+               const std::string& cert, const std::string& key) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::unique_ptr<Transport> transport;
+  try {
+    // Accept owns the fd: it closes exactly once on failure.
+    transport = Transport::Accept(fd, cert, key);
+  } catch (const std::exception&) {
+    return;
+  }
+  Transport& t = *transport;
   std::string blob;
   if (!token.empty()) {
     // First blob must be the auth preamble; constant-time-ish compare
     // is unnecessary here (the token has full entropy and this worker
     // binds like the Python workers do).
-    if (!ReadBlob(fd, &blob, 4096) || blob != "RTPUAUTH" + token) {
-      ::close(fd);
-      return;
-    }
+    if (!ReadBlob(t, &blob, 4096) || blob != "RTPUAUTH" + token)
+      return;  // transport dtor closes the fd
   }
   for (;;) {
-    if (!ReadBlob(fd, &blob)) break;
+    if (!ReadBlob(t, &blob)) break;
     if (static_cast<uint8_t>(blob[0]) != kWireVersion) break;
     Value frame;
     int64_t req_id = 0;
@@ -154,8 +172,7 @@ void ServeConn(int fd, const std::string& token) {
         resp.push_back(Value::I(kResp));
         resp.push_back(Value::I(req_id));
         resp.push_back(Value::M(std::move(ok)));
-        WriteFrame(fd, encode(Value::A(std::move(resp))));
-        ::close(fd);
+        WriteFrame(t, encode(Value::A(std::move(resp))));
         std::exit(0);
       } else {
         throw std::runtime_error("cpp worker: unknown method " + method);
@@ -164,7 +181,7 @@ void ServeConn(int fd, const std::string& token) {
       resp.push_back(Value::I(kResp));
       resp.push_back(Value::I(req_id));
       resp.push_back(std::move(result));
-      if (!WriteFrame(fd, encode(Value::A(std::move(resp))))) break;
+      if (!WriteFrame(t, encode(Value::A(std::move(resp))))) break;
     } catch (const std::exception& e) {
       // Task-level failures travel as status=error replies (the owner
       // raises RayTaskError); only protocol-level breakage uses kErr.
@@ -175,10 +192,9 @@ void ServeConn(int fd, const std::string& token) {
       resp.push_back(Value::I(kResp));
       resp.push_back(Value::I(req_id));
       resp.push_back(Value::M(std::move(reply)));
-      if (!WriteFrame(fd, encode(Value::A(std::move(resp))))) break;
+      if (!WriteFrame(t, encode(Value::A(std::move(resp))))) break;
     }
   }
-  ::close(fd);
 }
 
 std::string EnvOr(const char* key, const std::string& fallback) {
@@ -193,6 +209,10 @@ int WorkerMain() {
   std::string node_addr = EnvOr("RAY_TPU_NODE_ADDR", "");
   std::string worker_id = EnvOr("RAY_TPU_WORKER_ID", "");
   std::string token = EnvOr("RAY_TPU_AUTH_TOKEN", "");
+  // In a --tls cluster the node exports the cluster cert/key; the
+  // worker then dials out TLS-pinned AND serves TLS itself.
+  std::string cert = EnvOr("RAY_TPU_TLS_CERT", "");
+  std::string key = EnvOr("RAY_TPU_TLS_KEY", "");
   if (node_addr.empty() || worker_id.empty()) {
     std::cerr << "raytpu_worker: RAY_TPU_NODE_ADDR and RAY_TPU_WORKER_ID "
                  "must be set (this binary is spawned by the node manager)"
@@ -225,7 +245,7 @@ int WorkerMain() {
 
   // Register with the node over a persistent connection; its closure
   // means the node died -> exit (same contract as worker_main.py).
-  auto* node = new Client(node_host, node_port, token);
+  auto* node = new Client(node_host, node_port, token, cert);
   ValueMap kw;
   kw.emplace("worker_id", Value::S(worker_id));
   kw.emplace("addr", Value::S(my_addr));
@@ -247,7 +267,7 @@ int WorkerMain() {
   for (;;) {
     int fd = ::accept(listen_fd, nullptr, nullptr);
     if (fd < 0) continue;
-    std::thread(ServeConn, fd, token).detach();
+    std::thread(ServeConn, fd, token, cert, key).detach();
   }
 }
 

@@ -66,10 +66,10 @@ def bench_attention_op_batch64(
     npages = B * maxp
     q = jnp.asarray(rng.normal(size=(B, K, H, Dh)), jnp.bfloat16)
     kp = jnp.asarray(
-        rng.normal(size=(npages, P, Hkv, Dh)), jnp.bfloat16
+        rng.normal(size=(npages, Hkv, P, Dh)), jnp.bfloat16
     )
     vp = jnp.asarray(
-        rng.normal(size=(npages, P, Hkv, Dh)), jnp.bfloat16
+        rng.normal(size=(npages, Hkv, P, Dh)), jnp.bfloat16
     )
     lens = np.where(np.arange(B) % 4 == 0, 2047, 256)
     tables = np.full((B, maxp), -1, np.int32)
@@ -251,15 +251,23 @@ def main(argv=None) -> dict:
         "prefill_stall": stall,
     }
 
-    # Floors. The op rows are the clean signal: measured ~1.8x at the
-    # bench model's (8, 4) heads and ~6.2x at llama-8B's (32, 8) on
-    # v5e against the REAL fallback body (PERF.json rows). The engine
-    # rows are tunnel-RTT-dominated on this rig, so their floor only
-    # catches inversions, and the chunked-prefill p99 must beat the
-    # monolithic stall.
-    assert op_bench["speedup"] > 1.4, op_bench
-    assert op_8b["speedup"] > 4.0, op_8b
-    assert decode["speedup"] > 1.1, decode
+    # Floors. After the round-5 einsum-folded fallback rewrite (GQA-
+    # grouped q, no materialized window transpose or head repeat), the
+    # XLA gather path itself is ~4-5x faster than round 4's (17.4 ->
+    # 4.6 ms at 32/8 heads), so the kernel's RELATIVE edge at this
+    # window size is 1.1-1.3x (its in-place page reads avoid
+    # materializing the gathered window, which matters more at wider
+    # tables). Floors therefore gate against INVERSION (kernel slower
+    # than fallback) plus absolute regressions of either path; the
+    # engine rows are tunnel-RTT-dominated on this rig, and the
+    # chunked-prefill p99 must beat the monolithic stall.
+    assert op_bench["speedup"] > 0.9, op_bench
+    assert op_8b["speedup"] > 1.0, op_8b
+    assert op_bench["kernel_us"] < 8000, op_bench
+    assert op_8b["gather_us"] < 9000, op_8b  # r4 fallback was ~17-21ms
+    # Engine-level the two paths are now EQUIVALENT through the tunnel
+    # (~0.95-1.4x run to run): guard only against a real inversion.
+    assert decode["speedup"] > 0.8, decode
     assert stall["stall_ratio_p99"] > 1.3, stall
     print(json.dumps(results, indent=None if args.json else 2))
     return results

@@ -7,7 +7,11 @@ the vLLM-style alternative the reference gets from its serving engine
 python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_models.py:234 —
 block_size / num_gpu_blocks are vLLM's page knobs):
 
-- One **page pool** per layer: [L, num_pages, page_size, Hkv, Dh].
+- One **page pool** per layer: [L, num_pages, Hkv, page_size, Dh]
+  (HEAD-major: the Pallas decode kernel reads one KV head's page tile
+  as a contiguous slice — measured ~40% faster than page-major; the
+  XLA fallback folds the layout into its einsums, see
+  _gather_page_attention).
   Capacity is a token budget (num_pages × page_size), independent of
   how many requests share it or how long each runs.
 - A **block table** per request: the ordered list of page ids holding
@@ -43,13 +47,13 @@ from ray_tpu.ops.rope import apply_rope, rope_frequencies
 
 _NEG_INF = -2.0e38
 
-PagedKV = dict[str, jnp.ndarray]  # {"k","v": [L, num_pages, P, Hkv, Dh]}
+PagedKV = dict[str, jnp.ndarray]  # {"k","v": [L, num_pages, Hkv, P, Dh]}
 
 
 def init_paged_kv(
     cfg: LlamaConfig, num_pages: int, page_size: int = 64
 ) -> PagedKV:
-    shape = (cfg.n_layers, num_pages, page_size, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, num_pages, cfg.n_kv_heads, page_size, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -131,30 +135,38 @@ def _gather_page_attention(q, k_pool, v_pool, page_index, mask, cfg):
     q: [B, Q, H, Dh]; page_index: [B, n_pages] int32 (>= 0);
     mask: [B, Q, window] bool, True = hidden. Returns [B, Q, H, Dh].
     """
-    b = q.shape[0]
-    page_size = k_pool.shape[1]
-    window = page_index.shape[1] * page_size
-    kk = jnp.take(k_pool, page_index, axis=0).reshape(
-        b, window, cfg.n_kv_heads, cfg.head_dim
-    )
-    vv = jnp.take(v_pool, page_index, axis=0).reshape(
-        b, window, cfg.n_kv_heads, cfg.head_dim
-    )
-    n_rep = cfg.n_heads // cfg.n_kv_heads
-    kk = jnp.repeat(kk, n_rep, axis=2)
-    vv = jnp.repeat(vv, n_rep, axis=2)
-    scale = cfg.head_dim**-0.5
+    b, q_len = q.shape[0], q.shape[1]
+    hkv = cfg.n_kv_heads
+    n_rep = cfg.n_heads // hkv
+    dh = cfg.head_dim
+    n_pages = page_index.shape[1]
+    page_size = k_pool.shape[2]
+    window = n_pages * page_size
+    # Head-major pool gathers to [B, n_pages, Hkv, P, Dh]; the page and
+    # cell dims contract/flatten INSIDE the einsums — no materialized
+    # layout transpose and no GQA repeat (q is grouped by KV head
+    # instead: head h = g*n_rep + r).
+    kk = jnp.take(k_pool, page_index, axis=0)
+    vv = jnp.take(v_pool, page_index, axis=0)
+    qg = q.reshape(b, q_len, hkv, n_rep, dh)
+    scale = dh**-0.5
     logits = (
         jnp.einsum(
-            "bqhd,bkhd->bhqk", q, kk,
+            "bqgrd,bngpd->bgrqnp", qg, kk,
             preferred_element_type=jnp.float32,
         )
         * scale
+    ).reshape(b, hkv, n_rep, q_len, window)
+    logits = jnp.where(
+        mask[:, None, None, :, :], _NEG_INF, logits
     )
-    logits = jnp.where(mask[:, None, :, :], _NEG_INF, logits)
     probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
-    return attn
+    attn = jnp.einsum(
+        "bgrqnp,bngpd->bqgrd",
+        probs.reshape(b, hkv, n_rep, q_len, n_pages, page_size),
+        vv,
+    )
+    return attn.reshape(b, q_len, cfg.n_heads, dh)
 
 
 @partial(
@@ -179,14 +191,14 @@ def paged_prefill(
     Returns (logits [1, S_pad, V] fp32, pool).
     """
     seq = tokens.shape[1]
-    page_size = pool["k"].shape[2]
+    page_size = pool["k"].shape[3]
     cos, sin = rope_frequencies(cfg.head_dim, seq, cfg.rope_theta)
     x = params["tok_emb"].astype(cfg.dtype)[tokens]
 
     from ray_tpu.ops.attention import causal_attention
 
     def body(x, layer):
-        p, k_pool, v_pool = layer  # k_pool [num_pages, P, Hkv, Dh]
+        p, k_pool, v_pool = layer  # k_pool [num_pages, Hkv, P, Dh]
         q, k, v = _project_qkv(x, p, cfg)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -196,10 +208,10 @@ def paged_prefill(
         # [1, S, Hkv, Dh] → [n_pages, P, Hkv, Dh] scatter at page ids.
         kp = k.astype(cfg.dtype).reshape(
             n_write_pages, page_size, cfg.n_kv_heads, cfg.head_dim
-        )
+        ).transpose(0, 2, 1, 3)
         vp = v.astype(cfg.dtype).reshape(
             n_write_pages, page_size, cfg.n_kv_heads, cfg.head_dim
-        )
+        ).transpose(0, 2, 1, 3)
         k_pool = k_pool.at[pages].set(kp)
         v_pool = v_pool.at[pages].set(vp)
         return x, (k_pool, v_pool)
@@ -242,7 +254,7 @@ def paged_prefill_chunk(
     Returns (logits [1, C, V] fp32, pool).
     """
     c = tokens.shape[1]
-    page_size = pool["k"].shape[2]
+    page_size = pool["k"].shape[3]
     window = n_write_pages * page_size
     cos, sin = rope_frequencies(cfg.head_dim, window, cfg.rope_theta)
     pos = start + jnp.arange(c, dtype=jnp.int32)[None, :]  # [1, C]
@@ -260,10 +272,10 @@ def paged_prefill_chunk(
         k = apply_rope(k, cos, sin, positions=pos)
         kp = k.astype(cfg.dtype).reshape(
             chunk_pages, page_size, cfg.n_kv_heads, cfg.head_dim
-        )
+        ).transpose(0, 2, 1, 3)
         vp = v.astype(cfg.dtype).reshape(
             chunk_pages, page_size, cfg.n_kv_heads, cfg.head_dim
-        )
+        ).transpose(0, 2, 1, 3)
         k_pool = k_pool.at[chunk_slice].set(kp)
         v_pool = v_pool.at[chunk_slice].set(vp)
         attn = _gather_page_attention(
@@ -356,7 +368,7 @@ def paged_verify(
     """
     b, kk_w = tokens.shape
     x = params["tok_emb"].astype(cfg.dtype)[tokens]  # [B, K, d]
-    page_size = pool["k"].shape[2]
+    page_size = pool["k"].shape[3]
     max_pages = block_tables.shape[1]
     window = max_pages * page_size
     cos, sin = rope_frequencies(cfg.head_dim, window, cfg.rope_theta)
@@ -385,10 +397,12 @@ def paged_verify(
 
         # Scatter all K cells per slot (drafts may span a page
         # boundary — each position indexes its own physical page).
-        k_pool = k_pool.at[write_pages, off_of, :, :].set(
+        # Advanced indices at dims 0 and 2 with the Hkv slice
+        # between: result dims are [B, K, Hkv, Dh], matching k.
+        k_pool = k_pool.at[write_pages, :, off_of, :].set(
             k.astype(cfg.dtype)
         )
-        v_pool = v_pool.at[write_pages, off_of, :, :].set(
+        v_pool = v_pool.at[write_pages, :, off_of, :].set(
             v.astype(cfg.dtype)
         )
 

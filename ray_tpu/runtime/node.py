@@ -686,10 +686,13 @@ class NodeManager:
             "RAY_TPU_NODE_ADDR": self.addr or "",
             "RAY_TPU_STORE_DIR": self.store_dir,
             "RAY_TPU_WORKER_ID": worker_id,
-            # The binary reads the token from env only (it has no
-            # config registry); programmatic overrides would otherwise
-            # be invisible to it.
+            # The binary reads these from env only (it has no config
+            # registry); programmatic overrides would otherwise be
+            # invisible to it. Cert/key let it serve AND dial TLS in a
+            # --tls cluster.
             "RAY_TPU_AUTH_TOKEN": config.get("AUTH_TOKEN"),
+            "RAY_TPU_TLS_CERT": config.get("TLS_CERT"),
+            "RAY_TPU_TLS_KEY": config.get("TLS_KEY"),
         }
         try:
             self.log_dir.mkdir(parents=True, exist_ok=True)

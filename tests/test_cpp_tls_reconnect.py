@@ -134,3 +134,30 @@ def test_cpp_reconnecting_client_survives_head_restart(binaries, tmp_path):
     finally:
         ray_tpu.shutdown()
         _config.clear_system_config("HEAD_JOURNAL")
+
+def test_cpp_worker_serves_in_tls_cluster(binaries, tmp_path):
+    """Full-TLS cluster with C++-defined remote functions: the worker
+    binary dials the node TLS-pinned AND serves its own task endpoint
+    over TLS (Python driver -> TLS -> C++ worker round trip)."""
+    cert = str(tmp_path / "tls.crt")
+    key = str(tmp_path / "tls.key")
+    generate_self_signed(cert, key)
+    info = ray_tpu.init(
+        num_cpus=4,
+        _system_config={
+            "TLS_CERT": cert,
+            "TLS_KEY": key,
+            "AUTH_TOKEN": "tls-worker-token",
+            "CPP_WORKER_CMD": str(binaries / "raytpu_worker"),
+        },
+    )
+    try:
+        add = ray_tpu.cross_language.cpp_function("Add")
+        assert ray_tpu.get(add.remote(40, 2)) == 42
+        sort = ray_tpu.cross_language.cpp_function("SortInts")
+        assert ray_tpu.get(sort.remote([3, 1, 2]))["sorted"] == [1, 2, 3]
+    finally:
+        ray_tpu.shutdown()
+        _config.clear_system_config(
+            "TLS_CERT", "TLS_KEY", "AUTH_TOKEN", "CPP_WORKER_CMD"
+        )

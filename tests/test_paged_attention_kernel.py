@@ -19,14 +19,19 @@ from ray_tpu.ops.pallas.paged_attention import paged_attention
 
 
 def _reference(q, kp, vp, tables, positions):
-    """The gather+repeat+dense-softmax math from paged_kv.paged_verify."""
+    """The gather+repeat+dense-softmax math from paged_kv.paged_verify
+    (pools are head-major: [pages, Hkv, P, Dh])."""
     b, k, h, dh = q.shape
-    _, p, hkv, _ = kp.shape
+    _, hkv, p, _ = kp.shape
     maxp = tables.shape[1]
     window = maxp * p
     t = jnp.maximum(tables, 0)
-    kk = jnp.take(kp, t, axis=0).reshape(b, window, hkv, dh)
-    vv = jnp.take(vp, t, axis=0).reshape(b, window, hkv, dh)
+    kk = jnp.take(kp, t, axis=0).transpose(0, 1, 3, 2, 4).reshape(
+        b, window, hkv, dh
+    )
+    vv = jnp.take(vp, t, axis=0).transpose(0, 1, 3, 2, 4).reshape(
+        b, window, hkv, dh
+    )
     kk = jnp.repeat(kk, h // hkv, axis=2)
     vv = jnp.repeat(vv, h // hkv, axis=2)
     pos2d = positions[:, None] + jnp.arange(k)[None, :]
@@ -48,8 +53,8 @@ def _case(seed, b, k, h, hkv, dh, p, maxp, positions):
     rng = np.random.default_rng(seed)
     npages = b * maxp + 1
     q = jnp.asarray(rng.normal(size=(b, k, h, dh)), jnp.float32)
-    kp = jnp.asarray(rng.normal(size=(npages, p, hkv, dh)), jnp.float32)
-    vp = jnp.asarray(rng.normal(size=(npages, p, hkv, dh)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(npages, hkv, p, dh)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(npages, hkv, p, dh)), jnp.float32)
     tables = np.full((b, maxp), -1, np.int32)
     nxt = 1
     for i, pos in enumerate(positions):
@@ -115,8 +120,8 @@ def test_stale_cells_beyond_frontier_are_masked():
             if pg < 0:
                 continue
             lo = max(0, frontier - pi * 8)
-            kp2[pg, lo:] = 999.0
-            vp2[pg, lo:] = -999.0
+            kp2[pg, :, lo:] = 999.0  # head-major: positions at dim 2
+            vp2[pg, :, lo:] = -999.0
     out = paged_attention(
         jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
         tables, pos, n_kv_heads=2, interpret=True,

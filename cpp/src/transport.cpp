@@ -155,13 +155,71 @@ struct SslApi {
 constexpr int kVerifyPeer = 0x01;  // SSL_VERIFY_PEER
 constexpr long kX509VOk = 0;       // X509_V_OK
 
-class TlsTransport : public Transport {
+// Shared TLS plumbing: fd/ctx/ssl ownership, IO loops, teardown. The
+// two subclasses differ only in handshake direction and trust setup.
+// fd ownership: on ANY constructor throw the fd is left OPEN — the
+// Transport::Connect/Accept factories are the single owner of the fd
+// until a transport is fully built (avoids double-close races with
+// concurrently accepted fds reusing the number).
+class TlsBase : public Transport {
  public:
-  // fd ownership: on ANY constructor throw the fd is left OPEN — the
-  // Transport::Connect/Accept factories are the single owner of the
-  // fd until a transport is fully built (avoids double-close races
-  // with concurrently accepted fds reusing the number).
-  TlsTransport(int fd, const std::string& cert_path) : fd_(fd) {
+  ~TlsBase() override {
+    if (ssl_) SslApi::Get().SSL_shutdown(ssl_);
+    FreeSsl();
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  void WriteAll(const char* data, size_t n) override {
+    const SslApi& api = SslApi::Get();
+    while (n > 0) {
+      int w = api.SSL_write(ssl_, data, static_cast<int>(n));
+      if (w <= 0) throw ConnectionError("raytpu: TLS write failed");
+      data += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+
+  void ReadAll(char* data, size_t n) override {
+    const SslApi& api = SslApi::Get();
+    while (n > 0) {
+      int r = api.SSL_read(ssl_, data, static_cast<int>(n));
+      if (r <= 0) throw ConnectionError("raytpu: TLS connection closed");
+      data += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+ protected:
+  explicit TlsBase(int fd) : fd_(fd) {}
+
+  void FreeSsl() {
+    const SslApi& api = SslApi::Get();
+    if (ssl_) api.SSL_free(ssl_);
+    if (ctx_) api.SSL_CTX_free(ctx_);
+    ssl_ = nullptr;
+    ctx_ = nullptr;
+  }
+
+  // Allocate ssl_ on ctx_ and bind the fd; throws (leaving the fd to
+  // the factory) instead of letting SSL_accept/connect crash on null.
+  void NewSslOrThrow() {
+    const SslApi& api = SslApi::Get();
+    ssl_ = api.SSL_new(ctx_);
+    if (!ssl_) {
+      FreeSsl();
+      throw ConnectionError("raytpu: SSL_new failed");
+    }
+    api.SSL_set_fd(ssl_, fd_);
+  }
+
+  int fd_;
+  SslApi::SSL_CTX* ctx_ = nullptr;
+  SslApi::SSL* ssl_ = nullptr;
+};
+
+class TlsTransport : public TlsBase {
+ public:
+  TlsTransport(int fd, const std::string& cert_path) : TlsBase(fd) {
     const SslApi& api = SslApi::Get();
     ctx_ = api.SSL_CTX_new(api.TLS_client_method());
     if (!ctx_) {
@@ -176,8 +234,7 @@ class TlsTransport : public Transport {
                                cert_path);
     }
     api.SSL_CTX_set_verify(ctx_, kVerifyPeer, nullptr);
-    ssl_ = api.SSL_new(ctx_);
-    api.SSL_set_fd(ssl_, fd_);
+    NewSslOrThrow();
     if (api.SSL_connect(ssl_) != 1) {
       // With SSL_VERIFY_PEER, a pinning mismatch fails INSIDE the
       // handshake: read the verify result before cleanup so the
@@ -199,57 +256,16 @@ class TlsTransport : public Transport {
           "cluster cert");
     }
   }
-
-  ~TlsTransport() override {
-    if (ssl_) SslApi::Get().SSL_shutdown(ssl_);
-    FreeSsl();
-    if (fd_ >= 0) ::close(fd_);
-  }
-
-  void WriteAll(const char* data, size_t n) override {
-    const SslApi& api = SslApi::Get();
-    while (n > 0) {
-      int w = api.SSL_write(ssl_, data, static_cast<int>(n));
-      if (w <= 0) throw ConnectionError("raytpu: TLS write failed");
-      data += w;
-      n -= static_cast<size_t>(w);
-    }
-  }
-
-  void ReadAll(char* data, size_t n) override {
-    const SslApi& api = SslApi::Get();
-    while (n > 0) {
-      int r = api.SSL_read(ssl_, data, static_cast<int>(n));
-      if (r <= 0) throw ConnectionError("raytpu: TLS connection closed");
-      data += r;
-      n -= static_cast<size_t>(r);
-    }
-  }
-
- private:
-  void FreeSsl() {
-    const SslApi& api = SslApi::Get();
-    if (ssl_) api.SSL_free(ssl_);
-    if (ctx_) api.SSL_CTX_free(ctx_);
-    ssl_ = nullptr;
-    ctx_ = nullptr;
-  }
-
-  int fd_;
-  SslApi::SSL_CTX* ctx_ = nullptr;
-  SslApi::SSL* ssl_ = nullptr;
 };
 
-// Server-side TLS over an ACCEPTED fd (the worker runtime's listener
-// in a --tls cluster; cert/key are the cluster's own material, same
+// Server side over an ACCEPTED fd (the worker runtime's listener in a
+// --tls cluster; cert/key are the cluster's own material, the same
 // files the Python servers load).
-class TlsServerTransport : public Transport {
+class TlsServerTransport : public TlsBase {
  public:
-  // Same fd-ownership contract as TlsTransport: on constructor throw
-  // the fd stays OPEN for the factory to close exactly once.
   TlsServerTransport(int fd, const std::string& cert_path,
                      const std::string& key_path)
-      : fd_(fd) {
+      : TlsBase(fd) {
     constexpr int kFiletypePem = 1;  // SSL_FILETYPE_PEM
     const SslApi& api = SslApi::Get();
     ctx_ = api.SSL_CTX_new(api.TLS_server_method());
@@ -265,52 +281,12 @@ class TlsServerTransport : public Transport {
       throw std::runtime_error(
           "raytpu: cannot load TLS cert/key for serving");
     }
-    ssl_ = api.SSL_new(ctx_);
-    api.SSL_set_fd(ssl_, fd_);
+    NewSslOrThrow();
     if (api.SSL_accept(ssl_) != 1) {
       FreeSsl();
       throw ConnectionError("raytpu: TLS accept failed");
     }
   }
-
-  ~TlsServerTransport() override {
-    if (ssl_) SslApi::Get().SSL_shutdown(ssl_);
-    FreeSsl();
-    if (fd_ >= 0) ::close(fd_);
-  }
-
-  void WriteAll(const char* data, size_t n) override {
-    const SslApi& api = SslApi::Get();
-    while (n > 0) {
-      int w = api.SSL_write(ssl_, data, static_cast<int>(n));
-      if (w <= 0) throw ConnectionError("raytpu: TLS write failed");
-      data += w;
-      n -= static_cast<size_t>(w);
-    }
-  }
-
-  void ReadAll(char* data, size_t n) override {
-    const SslApi& api = SslApi::Get();
-    while (n > 0) {
-      int r = api.SSL_read(ssl_, data, static_cast<int>(n));
-      if (r <= 0) throw ConnectionError("raytpu: TLS connection closed");
-      data += r;
-      n -= static_cast<size_t>(r);
-    }
-  }
-
- private:
-  void FreeSsl() {
-    const SslApi& api = SslApi::Get();
-    if (ssl_) api.SSL_free(ssl_);
-    if (ctx_) api.SSL_CTX_free(ctx_);
-    ssl_ = nullptr;
-    ctx_ = nullptr;
-  }
-
-  int fd_;
-  SslApi::SSL_CTX* ctx_ = nullptr;
-  SslApi::SSL* ssl_ = nullptr;
 };
 
 }  // namespace

@@ -126,7 +126,11 @@ void ServeConn(int fd, const std::string& token,
   try {
     // Accept owns the fd: it closes exactly once on failure.
     transport = Transport::Accept(fd, cert, key);
-  } catch (const std::exception&) {
+  } catch (const std::exception& e) {
+    // A TLS misconfiguration (bad key, missing cert) silently eating
+    // every connection is undebuggable: say why each accept died.
+    std::cerr << "raytpu_worker: connection rejected: " << e.what()
+              << std::endl;
     return;
   }
   Transport& t = *transport;

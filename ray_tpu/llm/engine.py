@@ -119,6 +119,26 @@ class LLMEngine:
             self.alloc = PageAllocator(num_pages, page_size)
             # +1: physical page 0 is the allocator's dump page.
             self.cache = init_paged_kv(cfg, num_pages + 1, page_size)
+            if (
+                mesh is not None
+                and mesh.shape.get("tp", 1) > 1
+                and cfg.n_kv_heads % mesh.shape["tp"] == 0
+            ):
+                # Shard the pool on the KV-head dim over tp (the
+                # head-major layout's natural TP split): each chip
+                # holds 1/tp of the KV bytes — the reference's
+                # tensor_parallel_size KV split — and the attention
+                # einsums contract per-head, so SPMD needs no
+                # resharding on the hot path.
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                self.cache = jax.device_put(
+                    self.cache,
+                    NamedSharding(
+                        mesh, P(None, None, "tp", None, None)
+                    ),
+                )
             self.max_pages_per_seq = -(-self.max_seq // page_size)
             # Pallas paged-attention kernel on a bare TPU backend (the
             # sharded path keeps XLA's SPMD partitioner in charge, like

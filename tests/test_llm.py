@@ -178,3 +178,17 @@ def test_llm_batch_inference(cluster):
     ).take_all()
     assert len(rows) == 3
     assert all(isinstance(r["generated"], str) for r in rows)
+
+
+def test_tp_shards_paged_pool_bytes(params, mesh8):
+    """Under a tp mesh the paged KV pool is sharded on the KV-head dim:
+    each chip holds 1/tp of the pool bytes (the reference's
+    tensor_parallel_size KV split), not a full replica."""
+    tp = LLMEngine(CFG, max_batch=2, max_seq=64, params=params,
+                   mesh=mesh8, kv="paged", page_size=16)
+    pool = tp.cache["k"]
+    shard = pool.addressable_shards[0].data
+    assert shard.shape[2] == CFG.n_kv_heads // 2  # tp=2 splits Hkv
+    # And generation still works end to end on the sharded pool.
+    out = tp.generate([[1, 2, 3]], SamplingParams(max_tokens=3))
+    assert len(out[0]) == 3

@@ -157,10 +157,11 @@ constexpr long kX509VOk = 0;       // X509_V_OK
 
 // Shared TLS plumbing: fd/ctx/ssl ownership, IO loops, teardown. The
 // two subclasses differ only in handshake direction and trust setup.
-// fd ownership: on ANY constructor throw the fd is left OPEN — the
-// Transport::Connect/Accept factories are the single owner of the fd
-// until a transport is fully built (avoids double-close races with
-// concurrently accepted fds reusing the number).
+// fd ownership: TlsBase ADOPTS the fd at construction (which cannot
+// fail), so even when a derived constructor throws, ~TlsBase runs and
+// closes the fd exactly once — the factories never close it, which is
+// what prevents double-close races against concurrently accepted fds
+// reusing the number.
 class TlsBase : public Transport {
  public:
   ~TlsBase() override {
@@ -296,24 +297,16 @@ std::unique_ptr<Transport> Transport::Connect(
   int fd = DialTcp(host, port);
   if (cert_path.empty())
     return std::make_unique<PlainTransport>(fd);
-  try {
-    return std::make_unique<TlsTransport>(fd, cert_path);
-  } catch (...) {
-    ::close(fd);  // sole owner until the transport adopts the fd
-    throw;
-  }
+  // TlsBase adopted the fd the moment construction began; on a
+  // handshake throw its destructor already closed it.
+  return std::make_unique<TlsTransport>(fd, cert_path);
 }
 
 std::unique_ptr<Transport> Transport::Accept(
     int fd, const std::string& cert_path, const std::string& key_path) {
   if (cert_path.empty())
     return std::make_unique<PlainTransport>(fd);
-  try {
-    return std::make_unique<TlsServerTransport>(fd, cert_path, key_path);
-  } catch (...) {
-    ::close(fd);
-    throw;
-  }
+  return std::make_unique<TlsServerTransport>(fd, cert_path, key_path);
 }
 
 }  // namespace raytpu

@@ -118,7 +118,6 @@ class LLMEngine:
                 )
             self.alloc = PageAllocator(num_pages, page_size)
             # +1: physical page 0 is the allocator's dump page.
-            self.cache = init_paged_kv(cfg, num_pages + 1, page_size)
             if (
                 mesh is not None
                 and mesh.shape.get("tp", 1) > 1
@@ -129,15 +128,25 @@ class LLMEngine:
                 # holds 1/tp of the KV bytes — the reference's
                 # tensor_parallel_size KV split — and the attention
                 # einsums contract per-head, so SPMD needs no
-                # resharding on the hot path.
+                # resharding on the hot path. Allocated DIRECTLY
+                # sharded (out_shardings on the zeros program): pools
+                # are sized toward per-chip HBM x tp, so a transient
+                # unsharded replica would OOM at init.
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
 
-                self.cache = jax.device_put(
-                    self.cache,
-                    NamedSharding(
-                        mesh, P(None, None, "tp", None, None)
+                ns = NamedSharding(
+                    mesh, P(None, None, "tp", None, None)
+                )
+                self.cache = jax.jit(
+                    partial(
+                        init_paged_kv, cfg, num_pages + 1, page_size
                     ),
+                    out_shardings={"k": ns, "v": ns},
+                )()
+            else:
+                self.cache = init_paged_kv(
+                    cfg, num_pages + 1, page_size
                 )
             self.max_pages_per_seq = -(-self.max_seq // page_size)
             # Pallas paged-attention kernel on a bare TPU backend (the

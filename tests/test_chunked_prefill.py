@@ -101,3 +101,65 @@ def test_short_prompts_skip_chunking(params):
     eng.add_request([1, 2, 3], SamplingParams(max_tokens=8))
     eng.step()
     assert eng._prefilling is None and len(eng._active) == 1
+
+
+def test_chunked_prefill_composes_with_speculation(params):
+    """Chunked prefill + speculative decoding together must stay
+    bit-identical to the plain engine on greedy streams (the two
+    features share the step loop: chunk first, then verify-decode)."""
+    long_prompt = (
+        [7, 8, 9] * 20 + [7, 8]  # repetitive: drafts accept
+    )
+    prompts = [[1, 2, 3], long_prompt]
+    sp = SamplingParams(max_tokens=8)
+    plain = LLMEngine(CFG, max_batch=2, max_seq=128, params=params,
+                      kv="paged", page_size=16)
+    combo = LLMEngine(CFG, max_batch=2, max_seq=128, params=params,
+                      kv="paged", page_size=16, prefill_chunk=32,
+                      speculate=3)
+    assert plain.generate(prompts, sp) == combo.generate(prompts, sp)
+
+
+def test_chunked_prefill_through_serve(params):
+    """engine_kwargs carry prefill_chunk+speculate through the serve
+    deployment: a long-prompt SSE stream completes normally."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import build_llm_deployment
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        serve.run(
+            build_llm_deployment(
+                CFG,
+                engine_kwargs={
+                    "max_batch": 2,
+                    "max_seq": 128,
+                    "params": params,
+                    "page_size": 16,
+                    "prefill_chunk": 32,
+                    "speculate": 3,
+                },
+            )
+        )
+        port = serve.start_http()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/", method="POST",
+            data=_json.dumps(
+                {"prompt": "ab" * 40, "max_tokens": 6, "stream": True}
+            ).encode(),
+            headers={
+                "Accept": "text/event-stream",
+                "Content-Type": "application/json",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            frames = [ln.decode().strip() for ln in r if ln.strip()]
+        assert frames[-1] == "data: [DONE]"
+        assert len(frames) >= 2  # streamed at least one token delta
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()

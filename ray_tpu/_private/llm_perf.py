@@ -109,7 +109,12 @@ def bench_attention_op_batch64(
         )
 
     def timeit(f):
-        r = f(q, kp, vp, tables_j, positions)
+        # Warm with a SHORT LOOP, not one call: the first sustained
+        # dispatch burst in a process pays ~15 ms of one-time overhead
+        # that a single warm-up call does not absorb (measured — it
+        # inflated whichever variant ran first by up to 6x).
+        for _ in range(6):
+            r = f(q, kp, vp, tables_j, positions)
         # axon gotcha: block_until_ready is unreliable — force sync
         # with a host transfer.
         float(jnp.sum(r.astype(jnp.float32)))

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
+    HyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
@@ -78,7 +79,7 @@ __all__ = [
     "TPESearcher", "OptunaSearch", "HyperOptSearch", "BOHBSearch",
     "ConcurrencyLimiter", "Repeater",
     "Domain", "Choice", "Searcher", "BasicVariantGenerator",
-    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
 ]
 

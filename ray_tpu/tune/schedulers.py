@@ -98,6 +98,58 @@ class ASHAScheduler(TrialScheduler):
         return CONTINUE
 
 
+class HyperBandScheduler(TrialScheduler):
+    """HyperBand (Li et al. 2018): multiple successive-halving BRACKETS
+    with different exploration/exploitation trade-offs — bracket s
+    starts its rung ladder at ``grace_period * reduction_factor**s``,
+    so some trials get long uninterrupted budgets while others face
+    aggressive early halving (reference: hyperband.py; run
+    asynchronously per bracket the way the reference's
+    ASHAScheduler(brackets=N) does, which fits this package's
+    per-result decision seam — synchronous band barriers would need a
+    PAUSE decision the Trial model deliberately omits).
+
+    Trials are assigned to brackets round-robin at their first result.
+    """
+
+    def __init__(self, metric: str, mode: str = "max", time_attr: str =
+                 "training_iteration", grace_period: int = 1,
+                 reduction_factor: int = 3, max_t: int = 81,
+                 num_brackets: int = 3):
+        assert mode in ("max", "min")
+        assert num_brackets >= 1
+        self.metric, self.mode, self.time_attr = metric, mode, time_attr
+        self._brackets = [
+            ASHAScheduler(
+                metric, mode=mode, time_attr=time_attr,
+                grace_period=grace_period * reduction_factor**s,
+                reduction_factor=reduction_factor, max_t=max_t,
+            )
+            for s in range(num_brackets)
+        ]
+        # Drop brackets whose first rung already exceeds max_t (they
+        # would never halve — pure FIFO copies of each other).
+        self._brackets = [
+            b for b in self._brackets if b._milestones
+        ] or self._brackets[:1]
+        self._assignment: dict[str, int] = {}
+        self._next = 0
+
+    def bracket_of(self, trial) -> "ASHAScheduler":
+        idx = self._assignment.get(trial.trial_id)
+        if idx is None:
+            idx = self._next % len(self._brackets)
+            self._assignment[trial.trial_id] = idx
+            self._next += 1
+        return self._brackets[idx]
+
+    def _record(self, trial, result: dict) -> None:
+        self.bracket_of(trial)._record(trial, result)
+
+    def _decide(self, trial, result: dict, trials: list) -> str:
+        return self.bracket_of(trial)._decide(trial, result, trials)
+
+
 class MedianStoppingRule(TrialScheduler):
     """Stop a trial whose best result so far is worse than the median of
     other trials' running averages at the same step (reference:

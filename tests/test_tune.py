@@ -171,3 +171,58 @@ def test_dataframe(cluster, tmp_path):
     df = grid.get_dataframe()
     assert set(df["config/x"]) == {1, 2}
     assert set(df["m"]) == {2, 4}
+
+
+def test_hyperband_brackets_trade_exploration(cluster, tmp_path):
+    """HyperBand (reference: hyperband.py run as async per-bracket
+    halving): the best trial survives to max_t, weak trials in
+    aggressive brackets stop early, and different brackets genuinely
+    use different rung ladders."""
+
+    class Curve(tune.Trainable):
+        def setup(self, config):
+            self.slope = config["slope"]
+            self.t = 0
+
+        def step(self):
+            self.t += 1
+            return {"score": self.slope * self.t}
+
+    sched = tune.HyperBandScheduler(
+        metric="score", mode="max", grace_period=1,
+        reduction_factor=2, max_t=8, num_brackets=3,
+    )
+    # Brackets ladder at grace 1, 2, 4.
+    assert [b.grace for b in sched._brackets] == [1, 2, 4]
+    grid = tune.Tuner(
+        Curve,
+        param_space={
+            "slope": tune.grid_search([1, 2, 3, 4, 5, 6])
+        },
+        tune_config=tune.TuneConfig(
+            scheduler=sched, metric="score", mode="max",
+            max_iterations=8,
+        ),
+        run_config=tune.RunConfig(
+            name="hb", storage_path=str(tmp_path)
+        ),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.config["slope"] == 6
+    iters = {
+        r.config["slope"]: r.metrics["training_iteration"] for r in grid
+    }
+    assert iters[6] == 8  # the winner ran to completion
+    assert min(iters.values()) < 8  # someone was halved early
+    # Round-robin really spread trials over all brackets.
+    assert len(set(sched._assignment.values())) == 3
+
+
+def test_hyperband_degenerate_brackets_pruned():
+    """Brackets whose first rung exceeds max_t never halve — they are
+    dropped rather than kept as duplicate FIFOs."""
+    sched = tune.HyperBandScheduler(
+        metric="m", grace_period=4, reduction_factor=4, max_t=8,
+        num_brackets=3,
+    )
+    assert len(sched._brackets) == 1  # grace 16 and 64 rungs pruned

@@ -217,6 +217,17 @@ class LLMEngine:
         # tokens nobody drains.
         self._deltas: dict[str, list[int]] = {}
         self._stream_ids: set[str] = set()
+        # Serving observability counters (reference: the vLLM stats
+        # ray.llm surfaces — requests, tokens, acceptance, preemption).
+        self._stats = {
+            "requests_submitted": 0,
+            "requests_finished": 0,
+            "tokens_generated": 0,
+            "draft_tokens_proposed": 0,
+            "draft_tokens_accepted": 0,
+            "preemptions": 0,
+            "prefill_chunks": 0,
+        }
 
     # ------------------------------------------------------ request API
     def add_request(
@@ -249,6 +260,7 @@ class LLMEngine:
                 )
         rid = request_id or f"req-{next(self._ids)}"
         with self._lock:
+            self._stats["requests_submitted"] += 1
             if stream:
                 self._stream_ids.add(rid)
             self._queue.append(_Request(rid, list(prompt), sampling))
@@ -288,6 +300,7 @@ class LLMEngine:
             if d and d[-1] == tok:
                 d.pop()
         req.done = True
+        self._stats["requests_finished"] += 1
         self._stream_ids.discard(req.request_id)
         finished.append(
             {
@@ -340,6 +353,7 @@ class LLMEngine:
         req.slot = slot
         req.position = ctx_len
         req.last_token = self._sample(last, req.sampling)
+        self._stats["tokens_generated"] += 1  # the prefill-sampled token
         req.out_tokens.append(req.last_token)
         if req.request_id in self._stream_ids:
             self._deltas.setdefault(req.request_id, []).append(
@@ -459,6 +473,7 @@ class LLMEngine:
             chunk_pages=(end - start) // P,
         )
         st["next_start"] = end
+        self._stats["prefill_chunks"] += 1
         if end >= st["ctx_pad"]:
             self._prefilling = None
             # ctx_len-1 always falls in the final chunk: ctx_pad is
@@ -497,6 +512,7 @@ class LLMEngine:
 
     def _record_token(self, req, tok: int, finished: list[dict]) -> None:
         req.position += 1
+        self._stats["tokens_generated"] += 1
         req.out_tokens.append(tok)
         if req.request_id in self._stream_ids:
             self._deltas.setdefault(req.request_id, []).append(tok)
@@ -511,6 +527,7 @@ class LLMEngine:
         context (prompt + generated so far), so generation resumes
         exactly where it stopped. req.prompt itself is never mutated —
         finished dicts must echo the prompt the caller submitted."""
+        self._stats["preemptions"] += 1
         self._release_pages(req)
         if req.slot in self._active:
             del self._active[req.slot]
@@ -607,6 +624,7 @@ class LLMEngine:
             )
             if draft:
                 draft_len[slot] = len(draft)
+                self._stats["draft_tokens_proposed"] += len(draft)
                 toks[slot, 1: 1 + len(draft)] = draft
 
         # Static flag: an all-greedy batch (the common speculative
@@ -643,6 +661,7 @@ class LLMEngine:
                 self._record_token(req, tok, finished)
                 continue
             na = int(n_acc[slot])
+            self._stats["draft_tokens_accepted"] += na
             # Accepted drafts verbatim, then the boundary token: the
             # residual sample if a draft was REJECTED there, the full-p
             # sample if the draft simply ran out (or none existed).
@@ -683,6 +702,27 @@ class LLMEngine:
                     self._release_pages(r)
                     return True
         return False
+
+    def stats(self) -> dict:
+        """Serving counters + live occupancy (reference shape: the
+        vLLM engine stats ray.llm's deployments surface): request and
+        token totals, speculative proposal/acceptance, preemptions,
+        chunked-prefill progress, and the pool/slot occupancy."""
+        with self._lock:
+            out = dict(self._stats)
+            out["active_requests"] = len(self._active)
+            out["queued_requests"] = len(self._queue)
+            out["prefilling"] = self._prefilling is not None
+            if self.kv == "paged":
+                out["pages_total"] = self.alloc.num_pages
+                out["pages_free"] = self.alloc.free_pages
+            if out["draft_tokens_proposed"]:
+                out["draft_acceptance_rate"] = round(
+                    out["draft_tokens_accepted"]
+                    / out["draft_tokens_proposed"],
+                    4,
+                )
+        return out
 
     def drain_deltas(self) -> dict[str, list[int]]:
         """Return and clear per-request tokens emitted since the last

@@ -130,11 +130,27 @@ class LLMServer:
             # nobody.
             self.engine.abort_request(rid)
 
+    async def stats(self) -> dict:
+        """Engine serving counters (reference shape: the vLLM metrics
+        ray.llm deployments expose) — callable as a deployment method:
+        HTTP {"method": "stats"} or handle.options(method_name=
+        "stats"). Async via the executor: engine.stats() takes the
+        engine lock, which the pump holds across whole step() calls —
+        grabbing it on the event loop would freeze the replica for a
+        step (minutes on a first compile)."""
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self.engine.stats
+        )
+
     async def __call__(self, request: dict):
         body = request.get("body") if isinstance(request, dict) else None
         if isinstance(body, dict):
             # HTTP ingress shape: parameters ride in the JSON body.
             request = body
+        if request.get("method") == "stats":
+            return await self.stats()
         if request.get("stream"):
             return self.stream(
                 request["prompt"],

@@ -735,6 +735,13 @@ class HeadService:
             actor_id = self.named_actors.get(name)
         if actor_id is None or actor_id not in self.actors:
             return {"ok": False, "error": "actor not found"}
+        if self.actors[actor_id]["state"] == "DEAD":
+            # A killed detached actor must not resolve by name: the
+            # get-or-create pattern (serve's controller/proxy bootstrap)
+            # would otherwise revive a handle to a corpse right after
+            # shutdown (reference: ray.get_actor raises for dead
+            # actors).
+            return {"ok": False, "error": "actor not found (dead)"}
         return {
             "ok": True,
             "actor_id": actor_id,

@@ -192,3 +192,47 @@ def test_tp_shards_paged_pool_bytes(params, mesh8):
     # And generation still works end to end on the sharded pool.
     out = tp.generate([[1, 2, 3]], SamplingParams(max_tokens=3))
     assert len(out[0]) == 3
+
+
+def test_engine_stats_counters(params):
+    """Serving observability (reference shape: vLLM stats through
+    ray.llm): request/token totals, speculative acceptance, chunk and
+    preemption counts, pool occupancy."""
+    eng = LLMEngine(CFG, max_batch=2, max_seq=128, params=params,
+                    kv="paged", page_size=16, speculate=3,
+                    prefill_chunk=32)
+    prompts = [[7, 8, 9] * 12, [1, 2, 3]]
+    outs = eng.generate(prompts, SamplingParams(max_tokens=6))
+    s = eng.stats()
+    assert s["requests_submitted"] == 2
+    assert s["requests_finished"] == 2
+    assert s["tokens_generated"] == sum(len(o) for o in outs)
+    assert s["prefill_chunks"] >= 2  # the 36-token prompt chunked
+    assert s["draft_tokens_proposed"] > 0
+    assert 0.0 <= s.get("draft_acceptance_rate", 0.0) <= 1.0
+    assert s["pages_free"] == s["pages_total"]  # all released
+    assert s["active_requests"] == 0 and s["queued_requests"] == 0
+
+
+def test_stats_through_serve_deployment(cluster, params):
+    from ray_tpu import serve
+
+    app = build_llm_deployment(
+        CFG,
+        engine_kwargs={
+            "max_batch": 2, "max_seq": 64,
+            "params": params, "page_size": 16,
+        },
+    )
+    handle = serve.run(app, name="llm_stats")
+    try:
+        handle.generate.remote("hi", max_tokens=4).result(timeout=60)
+        # Deployment-method dispatch…
+        stats = handle.stats.remote().result(timeout=60)
+        assert stats["requests_finished"] >= 1
+        assert stats["tokens_generated"] >= 4
+        # …and the HTTP-body routing shape ({"method": "stats"}).
+        stats2 = handle.remote({"method": "stats"}).result(timeout=60)
+        assert stats2["requests_finished"] >= stats["requests_finished"]
+    finally:
+        serve.shutdown()

@@ -199,7 +199,13 @@ def run_scale_smoke(
             await node.stop()
 
     try:
-        rt.run(stop_nodes(), timeout=120)
+        # Teardown is NOT a measurement: on a loaded host stopping
+        # hundreds of simulated nodes can exceed any fixed budget —
+        # never let it invalidate the rows already collected
+        # (shutdown() below reaps whatever remains).
+        rt.run(stop_nodes(), timeout=240)
+    except Exception as e:  # noqa: BLE001 - best-effort teardown
+        print(f"# teardown incomplete (ignored): {e!r}", flush=True)
     finally:
         ray_tpu.shutdown()
     return rows

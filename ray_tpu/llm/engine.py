@@ -225,6 +225,7 @@ class LLMEngine:
             "tokens_generated": 0,
             "draft_tokens_proposed": 0,
             "draft_tokens_accepted": 0,
+            "requests_aborted": 0,
             "preemptions": 0,
             "prefill_chunks": 0,
         }
@@ -661,7 +662,6 @@ class LLMEngine:
                 self._record_token(req, tok, finished)
                 continue
             na = int(n_acc[slot])
-            self._stats["draft_tokens_accepted"] += na
             # Accepted drafts verbatim, then the boundary token: the
             # residual sample if a draft was REJECTED there, the full-p
             # sample if the draft simply ran out (or none existed).
@@ -670,8 +670,13 @@ class LLMEngine:
                 emit.append(int(rej[slot, na]))
             else:
                 emit.append(int(sampled[slot, na]))
-            for tok in emit:
+            for idx, tok in enumerate(emit):
                 self._record_token(req, int(tok), finished)
+                if idx < na:
+                    # Count acceptance by tokens actually EMITTED —
+                    # verified drafts discarded when the request
+                    # finishes mid-emit must not inflate the rate.
+                    self._stats["draft_tokens_accepted"] += 1
                 if req.done:
                     break
 
@@ -689,10 +694,12 @@ class LLMEngine:
                 self._prefilling = None
                 self._free.append(st["slot"])
                 self._release_pages(st["req"])
+                self._stats["requests_aborted"] += 1
                 return True
             for i, r in enumerate(self._queue):
                 if r.request_id == request_id:
                     del self._queue[i]
+                    self._stats["requests_aborted"] += 1
                     return True
             for slot, r in list(self._active.items()):
                 if r.request_id == request_id:
@@ -700,6 +707,7 @@ class LLMEngine:
                     del self._active[slot]
                     self._free.append(slot)
                     self._release_pages(r)
+                    self._stats["requests_aborted"] += 1
                     return True
         return False
 

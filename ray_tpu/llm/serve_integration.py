@@ -138,8 +138,6 @@ class LLMServer:
         engine lock, which the pump holds across whole step() calls —
         grabbing it on the event loop would freeze the replica for a
         step (minutes on a first compile)."""
-        import asyncio
-
         return await asyncio.get_running_loop().run_in_executor(
             None, self.engine.stats
         )

@@ -156,8 +156,6 @@ def delete(name: str):
 
 
 def shutdown():
-    import time as _time
-
     controller = _get_controller()
     if controller is not None:
         try:
@@ -172,23 +170,10 @@ def shutdown():
             ray_tpu.kill(ray_tpu.get_actor(name))
         except ValueError:
             pass
-    # Wait for the detached names to actually deregister: kill is
-    # asynchronous, and a serve.run() issued right after shutdown()
-    # would otherwise find the STALE controller name and call a dead
-    # actor (observed as ActorDiedError/cannot-connect on restart).
-    deadline = _time.monotonic() + 10.0
-    names = (CONTROLLER_NAME, PROXY_NAME, GRPC_INGRESS_NAME)
-    while _time.monotonic() < deadline:
-        remaining = []
-        for name in names:
-            try:
-                ray_tpu.get_actor(name)
-                remaining.append(name)
-            except ValueError:
-                pass
-        if not remaining:
-            break
-        _time.sleep(0.05)
+    # No deregistration wait is needed: kill synchronously marks the
+    # actor DEAD at the head, and the head's get_actor treats DEAD as
+    # not-found — a serve.run() issued right after shutdown() creates
+    # a fresh controller instead of reviving the corpse.
 
 
 def start_http(host: str = "127.0.0.1", port: int = 0) -> int:

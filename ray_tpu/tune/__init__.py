@@ -4,7 +4,8 @@ Capability-equivalent of the reference's Tune (reference:
 python/ray/tune/ — Tuner.fit → TuneController event loop over trial
 actors, searchers, schedulers, ResultGrid), reduced to the surfaces the
 rest of this framework uses: function and class trainables, grid/random
-search, ASHA / median-stopping / PBT schedulers.
+search, ASHA / HyperBand / median-stopping / PBT schedulers, and
+TPE / Optuna / HyperOpt / BOHB searchers.
 """
 
 from __future__ import annotations

@@ -19,6 +19,12 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.bohb_search import BOHBSearch
+from ray_tpu.tune.callbacks import (
+    Callback,
+    JsonLoggerCallback,
+    MLflowLoggerCallback,
+    WandbLoggerCallback,
+)
 from ray_tpu.tune.hyperopt_search import HyperOptSearch
 from ray_tpu.tune.optuna_search import OptunaSearch
 from ray_tpu.tune.search import (
@@ -82,6 +88,8 @@ __all__ = [
     "Domain", "Choice", "Searcher", "BasicVariantGenerator",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
+    "Callback", "JsonLoggerCallback", "WandbLoggerCallback",
+    "MLflowLoggerCallback",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -46,6 +46,9 @@ class TuneConfig:
 class RunConfig:
     name: str = "tune_run"
     storage_path: str = "/tmp/ray_tpu_results"
+    # tune.Callback instances (loggers / experiment trackers — see
+    # tune/callbacks.py); hooks fire per trial start/result/complete.
+    callbacks: tuple = ()
 
 
 @dataclass
@@ -118,8 +121,19 @@ class Tuner:
         is_class = inspect.isclass(self.trainable) and issubclass(
             self.trainable, Trainable
         )
+        callbacks = list(self.run_config.callbacks)
+        for cb in callbacks:
+            # Loggers default their output into THIS experiment's dir;
+            # re-point auto-filled ones on reuse across fits (a sticky
+            # exp_dir would append run B's rows into run A's files).
+            if getattr(cb, "exp_dir", "unset") is None or getattr(
+                cb, "_auto_exp_dir", False
+            ):
+                cb.exp_dir = exp_dir
+                cb._auto_exp_dir = True
         controller = _TuneController(
-            self.trainable, is_class, searcher, scheduler, cfg, exp_dir
+            self.trainable, is_class, searcher, scheduler, cfg, exp_dir,
+            callbacks=callbacks,
         )
         results = controller.run()
         return ResultGrid(results, metric=cfg.metric, mode=cfg.mode)
@@ -148,16 +162,38 @@ class _TuneController:
     """(reference: TuneController tune_controller.py:68 — state machine
     stepping trials and consuming results.)"""
 
-    def __init__(self, trainable, is_class, searcher, scheduler, cfg, exp_dir):
+    def __init__(self, trainable, is_class, searcher, scheduler, cfg,
+                 exp_dir, callbacks=()):
         self.trainable = trainable
         self.is_class = is_class
         self.searcher = searcher
         self.scheduler = scheduler
         self.cfg = cfg
         self.exp_dir = exp_dir
+        self.callbacks = list(callbacks)
+        self._cb_warned: set = set()
         self.trials: list[Trial] = []
         self._next_id = 0
         self._exhausted = False
+
+    def _cb(self, hook: str, *args) -> None:
+        """Fire a callback hook; a logger bug degrades logging, not the
+        run — but it is WARNED (once per callback+hook), because a
+        silently-swallowed signature error would otherwise produce an
+        empty log dir with zero diagnostics."""
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(*args)
+            except Exception as e:  # noqa: BLE001
+                key = (id(cb), hook)
+                if key not in self._cb_warned:
+                    self._cb_warned.add(key)
+                    import logging
+
+                    logging.getLogger("ray_tpu.tune").warning(
+                        "callback %s.%s failed (suppressed): %r",
+                        type(cb).__name__, hook, e,
+                    )
 
     def _new_trial(self) -> Trial | None:
         trial_id = f"t{self._next_id:04d}"
@@ -185,10 +221,15 @@ class _TuneController:
             ray_tpu.get(trial.actor.start_fn.remote(
                 self.trainable, trial.config, trial.checkpoint))
         trial.status = RUNNING
+        self._cb("on_trial_start", trial.trial_id, trial.config)
 
     def _finish(self, trial: Trial, status: str, error: str | None = None):
         trial.status = status
         trial.error = error
+        self._cb(
+            "on_trial_complete", trial.trial_id,
+            trial.last_result if error is None else None, error,
+        )
         # Feed the searcher so adaptive algorithms learn from outcomes
         # (reference: SearchAlgorithm.on_trial_complete, tune/search/).
         try:
@@ -209,7 +250,7 @@ class _TuneController:
     def _running(self):
         return [t for t in self.trials if t.status == RUNNING]
 
-    def run(self) -> list:
+    def _run_inner(self) -> list:
         cap = max(1, self.cfg.max_concurrent_trials)
         while True:
             # Fill free slots.
@@ -228,13 +269,33 @@ class _TuneController:
                 self._step_class_trials(running)
             else:
                 self._poll_fn_trials(running)
-        return [
+        results = [
             TrialResult(
                 config=t.config, metrics=t.last_result,
                 checkpoint=t.checkpoint, path=t.local_dir, error=t.error,
             )
             for t in self.trials
         ]
+        return results
+
+    def run(self) -> list:
+        try:
+            return self._run_inner()
+        finally:
+            # Teardown hooks must fire even when a trial actor dies on
+            # an unguarded path — otherwise log files stay open and
+            # tracker runs are left dangling.
+            self._cb(
+                "on_experiment_end",
+                [
+                    TrialResult(
+                        config=t.config, metrics=t.last_result,
+                        checkpoint=t.checkpoint, path=t.local_dir,
+                        error=t.error,
+                    )
+                    for t in self.trials
+                ],
+            )
 
     # ------------------------------------------------------- class API
     def _step_class_trials(self, running: list):
@@ -252,6 +313,7 @@ class _TuneController:
             t.iteration = metrics.get("training_iteration", t.iteration + 1)
             t.results.append(metrics)
             t.last_result = metrics
+            self._cb("on_trial_result", t.trial_id, t.config, metrics)
             batch.append((t, metrics))
         decisions = self.scheduler.on_batch(batch, self.trials)
         max_it = self.cfg.max_iterations
@@ -293,6 +355,9 @@ class _TuneController:
                 metrics.setdefault("training_iteration", t.iteration)
                 t.results.append(metrics)
                 t.last_result = metrics
+                self._cb(
+                    "on_trial_result", t.trial_id, t.config, metrics
+                )
                 if "checkpoint" in entry:
                     t.checkpoint = entry["checkpoint"]
                 decision = self.scheduler.on_result(t, metrics, self.trials)

@@ -226,3 +226,64 @@ def test_hyperband_degenerate_brackets_pruned():
         num_brackets=3,
     )
     assert len(sched._brackets) == 1  # grace 16 and 64 rungs pruned
+
+
+def test_callbacks_and_tracking_integrations(cluster, tmp_path):
+    """Callback hooks fire per trial (reference: tune.Callback +
+    air/integrations wandb/mlflow): the JSONL logger writes one result
+    file per trial, the wandb adapter opens/logs/finishes one run per
+    trial, and mlflow gets params + stepped metrics."""
+    import json as _json
+    import os as _os
+
+    wandb_cb = tune.WandbLoggerCallback(project="p", _force_fake=True)
+    mlflow_cb = tune.MLflowLoggerCallback(
+        experiment_name="e", _force_fake=True
+    )
+    json_cb = tune.JsonLoggerCallback()
+
+    def trainable(config):
+        for _ in range(3):
+            tune.report({"loss": config["x"] * 1.0})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+        run_config=tune.RunConfig(
+            name="cb", storage_path=str(tmp_path),
+            callbacks=(json_cb, wandb_cb, mlflow_cb),
+        ),
+    ).fit()
+    assert len(grid) == 2
+
+    exp_dir = _os.path.join(str(tmp_path), "cb")
+    logs = sorted(
+        f for f in _os.listdir(exp_dir) if f.endswith(".result.jsonl")
+    )
+    assert len(logs) == 2
+    rows = [
+        _json.loads(ln)
+        for ln in open(_os.path.join(exp_dir, logs[0]))
+    ]
+    assert len(rows) == 3 and "loss" in rows[0]
+
+    runs = wandb_cb._wandb.runs
+    assert len(runs) == 2
+    assert all(r.finished for r in runs)
+    assert all(len(r.logged) == 3 for r in runs)
+    assert {r.config["x"] for r in runs} == {1, 2}
+
+    ml = mlflow_cb._mlflow
+    assert ml.experiment == "e"
+    by_name: dict = {}
+    for run in ml.runs:
+        by_name.setdefault(run["run_name"], []).append(run)
+    assert len(by_name) == 2
+    # Params logged once per trial; metrics carry steps.
+    for name, runs_ in by_name.items():
+        assert any(r["params"] for r in runs_)
+        steps = [
+            s for r in runs_ for (s, _m) in r["metrics"]
+        ]
+        assert steps and all(s is not None for s in steps)

@@ -94,7 +94,14 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                 "for leases whose runtime_env is "
                                 "{'language': 'cpp'}"),
     # --- misc
-    "RPC_FAILURE": (str, "", "chaos spec: method:prob[:mode] list"),
+    "RPC_FAILURE": (str, "", "chaos spec: comma-separated method:prob "
+                             "list ('*' matches any method)"),
+    "COLLECTIVE_TIMEOUT_S": (float, 60.0, "default collective deadline "
+                                          "(rendezvous and per-op); "
+                                          "group override via "
+                                          "init_collective_group("
+                                          "timeout_s=), per-op via the "
+                                          "verb's timeout_s="),
     "TRACE": (bool, False, "enable span collection in every process"),
     "ADDRESS": (str, "", "default cluster address for init()"),
 }

@@ -122,19 +122,33 @@ class ConnectionLost(RpcError):
 
 
 def _chaos_drop(method: str) -> bool:
-    """Chaos injection: RAY_TPU_RPC_FAILURE="method:probability" drops
+    """Chaos injection: RAY_TPU_RPC_FAILURE="m1:p1,m2:p2,…" drops
     matching requests before send (reference: rpc_chaos.h:24,
     RAY_testing_rpc_failure ray_config_def.h:850). Read per-call so
-    tests can flip it at runtime; method="*" matches everything."""
+    tests can flip it at runtime; method="*" matches everything. A
+    comma-separated spec targets several RPC types in one run (the
+    collective-abort tests drop op and rendezvous traffic together)."""
     from ray_tpu._private import config
 
     chaos = config.get("RPC_FAILURE")
     if not chaos:
         return False
-    name, _, prob = chaos.partition(":")
-    return (name == "*" or method == name) and random.random() < float(
-        prob or 0
-    )
+    for spec in chaos.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        # rpartition: method names may themselves contain colons
+        # (extension handlers like "col_op:<group>").
+        name, _, prob = spec.rpartition(":")
+        if name != "*" and method != name:
+            continue
+        try:
+            p = float(prob or 0)
+        except ValueError:
+            continue
+        if random.random() < p:
+            return True
+    return False
 
 
 def _auth_token() -> str:

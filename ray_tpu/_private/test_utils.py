@@ -10,6 +10,21 @@ import asyncio
 import random
 
 
+def sigkill_pid(pid: int) -> None:
+    """SIGKILL one worker process — the targeted mid-op member killer
+    the collective chaos tests use (WorkerKillerActor kills *random*
+    leased workers; collective-abort assertions need to know which rank
+    died). The node's reap loop notices within ~1s and the head fans the
+    death out to the victim's collective groups."""
+    import os
+    import signal
+
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
 class WorkerKillerActor:
     """Kills leased task workers on an interval. Deploy with
     ``ray_tpu.remote(WorkerKillerActor).remote(...)`` and call

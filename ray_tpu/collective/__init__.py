@@ -7,13 +7,28 @@ The default data plane is XLA collectives (ICI within a slice, DCN across
 slices) instead of NCCL/Gloo; host-memory tensors use the CPU backend over
 the runtime RPC. Groups are process-wide, keyed by name, and rendezvous
 through the cluster head's KV store.
+
+Fault tolerance: every op takes a deadline (group default via
+``init_collective_group(timeout_s=)``, per-op override on each verb);
+expiry raises CollectiveTimeoutError naming the missing ranks. Members
+register with the head, which fans out node/worker death on the
+"collective" pubsub channel — survivors' in-flight and future ops fail
+fast with CollectiveMemberDiedError, and ``reform_group()`` re-runs
+rendezvous with the survivors (new world size, re-ranked).
 """
 
 from __future__ import annotations
 
 from typing import Any, Sequence
 
-from ray_tpu.collective.types import Backend, ReduceOp
+from ray_tpu.collective.types import (
+    Backend,
+    CollectiveError,
+    CollectiveGroupDestroyedError,
+    CollectiveMemberDiedError,
+    CollectiveTimeoutError,
+    ReduceOp,
+)
 
 _groups: dict[str, Any] = {}
 
@@ -36,13 +51,37 @@ def _resolve_backend(backend) -> Backend:
     return backend
 
 
+async def _ensure_death_watch(core) -> None:
+    """Subscribe this process (once per CoreWorker) to the head's
+    "collective" channel and route member-death fan-out to the local
+    group objects: a head-declared dead node/worker poisons every group
+    it belonged to, immediately."""
+    if getattr(core, "_collective_death_watch", False):
+        return
+    core._collective_death_watch = True
+
+    def _on_event(msg):
+        if not isinstance(msg, dict) or msg.get("event") != "member_dead":
+            return
+        g = _groups.get(msg.get("group"))
+        if g is not None and hasattr(g, "_on_member_dead"):
+            g._on_member_dead(msg.get("ranks") or [], epoch=msg.get("epoch"))
+
+    await core.subscribe("collective", _on_event)
+
+
 def init_collective_group(
     world_size: int,
     rank: int,
     backend: str | Backend = Backend.AUTO,
     group_name: str = "default",
+    timeout_s: float | None = None,
 ) -> None:
-    """Join this process into a named collective group."""
+    """Join this process into a named collective group.
+
+    ``timeout_s`` is the group's default deadline for rendezvous and
+    every op (config COLLECTIVE_TIMEOUT_S when None); individual verbs
+    can override per call."""
     if group_name in _groups:
         raise ValueError(f"collective group {group_name!r} already exists")
     backend = _resolve_backend(backend)
@@ -51,7 +90,9 @@ def init_collective_group(
         from ray_tpu.collective.backends.cpu_group import CpuGroup
 
         async def make():
-            g = CpuGroup(rt.core, group_name, world_size, rank)
+            g = CpuGroup(
+                rt.core, group_name, world_size, rank, timeout_s=timeout_s
+            )
             await g.init()
             return g
 
@@ -73,9 +114,13 @@ def init_collective_group(
         )
 
         rt.run(
-            bootstrap_distributed(rt.core, group_name, world_size, rank)
+            bootstrap_distributed(
+                rt.core, group_name, world_size, rank, timeout_s=timeout_s
+            )
         )
-        _groups[group_name] = XlaDistGroup(world_size, rank)
+        _groups[group_name] = XlaDistGroup(
+            world_size, rank, timeout_s=timeout_s
+        )
     else:
         raise ValueError(f"unsupported backend {backend}")
 
@@ -85,9 +130,38 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    """Destroy the local group object. In-flight op futures on it are
+    cancelled/failed (CollectiveGroupDestroyedError) rather than left
+    pending."""
     g = _groups.pop(group_name, None)
     if g is not None and hasattr(g, "destroy"):
         _runtime().run(g.destroy())
+
+
+def reform_group(
+    group_name: str = "default", timeout_s: float | None = None
+) -> tuple[int, int]:
+    """Abort-and-reform: re-run rendezvous with the surviving ranks of a
+    poisoned (or op-desynced) group. Every survivor must call this; the
+    group keeps its public name but gets a new epoch, dense re-ranking,
+    and a fresh op sequence. Returns ``(new_rank, new_world)``."""
+    g = get_group(group_name)
+    if not hasattr(g, "reform"):
+        raise ValueError(
+            f"backend {type(g).__name__} does not support reform_group"
+        )
+    new_g = _runtime().run(g.reform(timeout_s=timeout_s))
+    _groups[group_name] = new_g
+    return new_g.rank, new_g.world
+
+
+def straggler_stats(group_name: str = "default") -> dict:
+    """Per-rank slowest-contributor telemetry (hub rank only; other
+    ranks see zeros). Chronic stragglers show up here — and in the
+    collective_straggler_* metrics — before they become timeouts."""
+    g = get_group(group_name)
+    fn = getattr(g, "straggler_stats", None)
+    return fn() if fn is not None else {}
 
 
 def get_group(group_name: str = "default"):
@@ -126,43 +200,80 @@ def _dispatch(name: str, group_name: str, *args, **kw):
     return fn(*args, **kw)
 
 
-def allreduce(tensor, group_name: str = "default", op=ReduceOp.SUM):
-    return _dispatch("allreduce", group_name, tensor, op=ReduceOp(op))
+def allreduce(
+    tensor, group_name: str = "default", op=ReduceOp.SUM, timeout_s=None
+):
+    return _dispatch(
+        "allreduce", group_name, tensor, op=ReduceOp(op), timeout_s=timeout_s
+    )
 
 
-def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op=ReduceOp.SUM):
-    return _dispatch("reduce", group_name, tensor, root=dst_rank, op=ReduceOp(op))
+def reduce(
+    tensor,
+    dst_rank: int = 0,
+    group_name: str = "default",
+    op=ReduceOp.SUM,
+    timeout_s=None,
+):
+    return _dispatch(
+        "reduce", group_name, tensor, root=dst_rank, op=ReduceOp(op),
+        timeout_s=timeout_s,
+    )
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _dispatch("broadcast", group_name, tensor, root=src_rank)
+def broadcast(
+    tensor, src_rank: int = 0, group_name: str = "default", timeout_s=None
+):
+    return _dispatch(
+        "broadcast", group_name, tensor, root=src_rank, timeout_s=timeout_s
+    )
 
 
-def allgather(tensor, group_name: str = "default"):
-    return _dispatch("allgather", group_name, tensor)
+def allgather(tensor, group_name: str = "default", timeout_s=None):
+    return _dispatch("allgather", group_name, tensor, timeout_s=timeout_s)
 
 
-def reducescatter(tensor, group_name: str = "default", op=ReduceOp.SUM):
-    return _dispatch("reducescatter", group_name, tensor, op=ReduceOp(op))
+def reducescatter(
+    tensor, group_name: str = "default", op=ReduceOp.SUM, timeout_s=None
+):
+    return _dispatch(
+        "reducescatter", group_name, tensor, op=ReduceOp(op),
+        timeout_s=timeout_s,
+    )
 
 
-def barrier(group_name: str = "default"):
-    return _dispatch("barrier", group_name)
+def barrier(group_name: str = "default", timeout_s=None):
+    return _dispatch("barrier", group_name, timeout_s=timeout_s)
 
 
-def send(tensor, dst_rank: int, group_name: str = "default", seq: int = 0):
-    return _dispatch("send", group_name, tensor, dst_rank, seq=seq)
+def send(
+    tensor, dst_rank: int, group_name: str = "default", seq: int = 0,
+    timeout_s=None,
+):
+    return _dispatch(
+        "send", group_name, tensor, dst_rank, seq=seq, timeout_s=timeout_s
+    )
 
 
-def recv(src_rank: int, group_name: str = "default", seq: int = 0):
-    return _dispatch("recv", group_name, src_rank, seq=seq)
+def recv(
+    src_rank: int, group_name: str = "default", seq: int = 0, timeout_s=None
+):
+    return _dispatch(
+        "recv", group_name, src_rank, seq=seq, timeout_s=timeout_s
+    )
 
 
 __all__ = [
     "Backend",
     "ReduceOp",
+    "CollectiveError",
+    "CollectiveTimeoutError",
+    "CollectiveMemberDiedError",
+    "CollectiveGroupDestroyedError",
     "init_collective_group",
     "destroy_collective_group",
+    "reform_group",
+    "straggler_stats",
     "is_group_initialized",
     "get_rank",
     "get_collective_group_size",

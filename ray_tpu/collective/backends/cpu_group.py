@@ -10,18 +10,50 @@ plane-sized tensors (accelerator tensors take the XLA backends).
 Rendezvous replaces the reference's NCCLUniqueID named-actor store
 (nccl_collective_group.py:29): members publish rank→addr in the head KV
 and poll until the group is complete.
+
+Fault tolerance (reference: NCCL abort + destroy_collective_group
+semantics; "Efficient AllReduce with Stragglers" motivates the
+telemetry):
+
+- Every op and the rendezvous itself run under a deadline. The hub arms
+  a timer when an op's first contribution arrives; expiry answers every
+  waiting member with a structured timeout naming the missing ranks
+  (raised member-side as CollectiveTimeoutError) and fire-and-forgets a
+  head probe so a genuinely dead member is *confirmed* dead instead of
+  timing out again next op.
+- Members register with the head (addr + node addr + worker id). When
+  the head declares a member dead — node heartbeat loss, worker reap,
+  or a probe — it publishes on the "collective" channel; survivors
+  poison the group and fail in-flight and future ops with
+  CollectiveMemberDiedError immediately instead of burning the full
+  timeout. The hub additionally watches member connections: a dropped
+  conn aborts pending ops at once.
+- A poisoned (or op-desynced) group is repaired by reform(): survivors
+  re-rendezvous under a bumped epoch (fresh KV keys, fresh op
+  sequence), re-ranked densely, with the lowest surviving rank as the
+  new hub.
+- Straggler telemetry: the hub records per-op first→last contribution
+  lag and the slowest rank (util/metrics.py histogram + counter), so a
+  chronically slow member is visible before it becomes a timeout.
 """
 
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Any
 
 import numpy as np
 
 from ray_tpu._private import rpc
 from ray_tpu._private.serialization import deserialize, serialize
-from ray_tpu.collective.types import ReduceOp
+from ray_tpu.collective.types import (
+    CollectiveGroupDestroyedError,
+    CollectiveMemberDiedError,
+    CollectiveTimeoutError,
+    ReduceOp,
+)
+from ray_tpu.util.metrics import Counter, Histogram
 
 _REDUCERS = {
     ReduceOp.SUM: lambda xs: np.sum(xs, axis=0),
@@ -30,14 +62,40 @@ _REDUCERS = {
     ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
 }
 
+# Extra member-side wait beyond the hub's deadline: the hub answers
+# expiry itself, so a member only hits its own backstop when the hub
+# process is gone or wedged.
+_HUB_GRACE_S = 5.0
+
+_LAG_HIST = Histogram(
+    "collective_straggler_lag_s",
+    "first→last contribution spread per collective op (hub-measured)",
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    tag_keys=("group", "op"),
+)
+_STRAGGLER_TOTAL = Counter(
+    "collective_straggler_total",
+    "ops in which this rank was the slowest (or missing) contributor",
+    tag_keys=("group", "rank"),
+)
+_ABORT_TOTAL = Counter(
+    "collective_abort_total",
+    "collective ops aborted by timeout or member death",
+    tag_keys=("group", "reason"),
+)
+
 
 class _Pending:
-    __slots__ = ("contrib", "futures", "arrived")
+    __slots__ = ("contrib", "futures", "arrived", "started", "arrive_ts",
+                 "timer")
 
     def __init__(self, world: int):
         self.contrib: list = [None] * world
         self.futures: list = []
         self.arrived = 0
+        self.started = time.monotonic()
+        self.arrive_ts: dict[int, float] = {}
+        self.timer: asyncio.TimerHandle | None = None
 
 
 def _pack(value) -> tuple[bytes, list[bytes]]:
@@ -49,57 +107,351 @@ def _unpack(packed: tuple) -> Any:
     return deserialize(packed[0], packed[1])
 
 
+def _default_timeout() -> float:
+    from ray_tpu._private import config
+
+    return config.get("COLLECTIVE_TIMEOUT_S")
+
+
 class CpuGroup:
-    def __init__(self, core, group_name: str, world_size: int, rank: int):
+    def __init__(
+        self,
+        core,
+        group_name: str,
+        world_size: int,
+        rank: int,
+        timeout_s: float | None = None,
+        epoch: int = 0,
+    ):
         self.core = core  # CoreWorker (for RPC + head KV)
-        self.name = group_name
+        self.base_name = group_name
+        self.epoch = epoch
+        # Epoch-scoped internal name: a reformed group must never
+        # rendezvous against (or serve ops for) a previous incarnation's
+        # KV keys / handlers.
+        self.name = group_name if epoch == 0 else f"{group_name}~e{epoch}"
         self.world = world_size
         self.rank = rank
+        self.timeout_s = (
+            _default_timeout() if timeout_s is None else float(timeout_s)
+        )
         self.root_addr: str | None = None
         self._seq = 0
         self._pending: dict[tuple, _Pending] = {}  # (op_kind, seq) → state
         # (src, seq) → (deque[payload], deque[waiter futures])
         self._mailbox: dict[tuple, tuple] = {}
+        self._dead: set[int] = set()      # ranks declared dead (poison)
+        self._destroyed = False
+        self._inflight: set[asyncio.Future] = set()  # member-side calls
+        self._rank_conns: dict[int, rpc.Connection] = {}  # hub-side
+        # straggler telemetry (hub-side): rank → times slowest
+        self._straggler_counts: dict[int, int] = {}
+        self._ops_completed = 0
+        self._last_lag_s = 0.0
         if rank == 0:
             self.core.ext_handlers[f"col_op:{self.name}"] = self._on_op
         self.core.ext_handlers[f"col_sendrecv:{self.name}"] = self._on_sendrecv
 
     # --------------------------------------------------------- bootstrap
-    async def init(self):
+    async def init(self, timeout_s: float | None = None):
+        """Rendezvous through the head KV, bounded by the group deadline:
+        members that never join surface as CollectiveTimeoutError with
+        the missing ranks, not an infinite poll loop."""
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
+        deadline = time.monotonic() + t
         key = f"collective:{self.name}:{self.rank}"
         await self.core.head.call("kv_put", key=key, value=self.core.addr.encode())
-        root_key = f"collective:{self.name}:0"
-        while True:
-            reply = await self.core.head.call("kv_get", key=root_key)
-            if reply["ok"]:
-                self.root_addr = reply["value"].decode()
-                break
-            await asyncio.sleep(0.05)
+        # Membership registration: the head's table is what lets node /
+        # worker death fan out to survivors as a typed abort.
+        try:
+            await self.core.head.call(
+                "collective_register",
+                group=self.base_name,
+                rank=self.rank,
+                epoch=self.epoch,
+                addr=self.core.addr,
+                node_addr=getattr(self.core, "node_addr", None),
+                worker_id=getattr(self.core, "worker_id", None),
+            )
+        except rpc.RpcError:
+            pass  # older head without the membership table: deadline
+            # enforcement still works, only death fan-out is lost
+        import ray_tpu.collective as _col
 
-    async def destroy(self):
+        await _col._ensure_death_watch(self.core)
+        prefix = f"collective:{self.name}:"
+        while True:
+            reply = await self.core.head.call("kv_keys", prefix=prefix)
+            present = set()
+            for k in reply.get("keys", []):
+                tail = k[len(prefix):]
+                if tail.isdigit():
+                    present.add(int(tail))
+            if len(present & set(range(self.world))) == self.world:
+                break
+            if time.monotonic() > deadline:
+                missing = sorted(set(range(self.world)) - present)
+                await self._cleanup_rendezvous(key)
+                raise CollectiveTimeoutError(
+                    self.base_name, "rendezvous", t, missing_ranks=missing
+                )
+            await asyncio.sleep(0.05)
+        reply = await self.core.head.call(
+            "kv_get", key=f"{prefix}0"
+        )
+        self.root_addr = reply["value"].decode()
+
+    async def _cleanup_rendezvous(self, key: str):
+        """Failed init must not leave a half-registered member behind."""
         self.core.ext_handlers.pop(f"col_op:{self.name}", None)
         self.core.ext_handlers.pop(f"col_sendrecv:{self.name}", None)
+        try:
+            await self.core.head.call("kv_del", key=key)
+            await self.core.head.call(
+                "collective_deregister",
+                group=self.base_name,
+                epoch=self.epoch,
+                rank=self.rank,
+            )
+        except rpc.RpcError:
+            pass
+
+    async def destroy(self):
+        """Tear down AND fail everything in flight: hub-side pending op
+        futures, member-side in-flight calls, and mailbox recv waiters —
+        an awaiting coroutine must never stay pending past destroy."""
+        self._destroyed = True
+        self.core.ext_handlers.pop(f"col_op:{self.name}", None)
+        self.core.ext_handlers.pop(f"col_sendrecv:{self.name}", None)
+        for key, st in list(self._pending.items()):
+            if st.timer is not None:
+                st.timer.cancel()
+            for _rank, fut in st.futures:
+                if not fut.done():
+                    fut.set_result({"ok": False, "error": "destroyed"})
+        self._pending.clear()
+        for call in list(self._inflight):
+            call.cancel()
+        for payloads, waiters in self._mailbox.values():
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_exception(
+                        CollectiveGroupDestroyedError(self.base_name, "recv")
+                    )
+        self._mailbox.clear()
+        try:
+            await self.core.head.call(
+                "collective_deregister",
+                group=self.base_name,
+                epoch=self.epoch,
+                rank=self.rank,
+            )
+        except rpc.RpcError:
+            pass
         if self.rank == 0:
             for r in range(self.world):
+                try:
+                    await self.core.head.call(
+                        "kv_del", key=f"collective:{self.name}:{r}"
+                    )
+                except rpc.RpcError:
+                    pass
+
+    # ------------------------------------------------- abort-and-reform
+    async def reform(self, timeout_s: float | None = None) -> "CpuGroup":
+        """Re-run rendezvous with the surviving ranks under a bumped
+        epoch: new dense ranks (order-preserving), new world size, the
+        lowest surviving rank becomes the hub. Also repairs a desynced
+        group after an op timeout (dead set empty → same shape, fresh
+        op sequence)."""
+        survivors = [r for r in range(self.world) if r not in self._dead]
+        if self.rank not in survivors:
+            raise CollectiveMemberDiedError(
+                self.base_name,
+                "reform",
+                dead_ranks=sorted(self._dead),
+                detail="this rank is itself marked dead",
+            )
+        g = CpuGroup(
+            self.core,
+            self.base_name,
+            len(survivors),
+            survivors.index(self.rank),
+            timeout_s=self.timeout_s if timeout_s is None else timeout_s,
+            epoch=self.epoch + 1,
+        )
+        await self.destroy()
+        await g.init()
+        return g
+
+    # ------------------------------------------------ death propagation
+    def _on_member_dead(self, ranks, epoch: int | None = None):
+        """Head fan-out (or hub conn-loss) declared members dead: poison
+        the group and abort everything in flight, now."""
+        if self._destroyed:
+            return
+        if epoch is not None and epoch != self.epoch:
+            return  # stale event about a previous incarnation
+        dead = {int(r) for r in ranks} - {self.rank}
+        if not dead or dead <= self._dead:
+            return
+        self._dead |= dead
+        _ABORT_TOTAL.inc(
+            tags={"group": self.base_name, "reason": "member_died"}
+        )
+        reply = {
+            "ok": False,
+            "error": "member_died",
+            "dead_ranks": sorted(self._dead),
+        }
+        for key, st in list(self._pending.items()):
+            if st.timer is not None:
+                st.timer.cancel()
+            for _rank, fut in st.futures:
+                if not fut.done():
+                    fut.set_result(dict(reply))
+        self._pending.clear()
+        for call in list(self._inflight):
+            call.cancel()
+        err = CollectiveMemberDiedError(
+            self.base_name, "recv", dead_ranks=sorted(self._dead)
+        )
+        for payloads, waiters in self._mailbox.values():
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_exception(err)
+
+    def _watch_conn(self, rank: int, conn: rpc.Connection):
+        """Hub-side: a member's dropped connection is a death signal —
+        abort its group-mates' pending ops instead of waiting out the
+        deadline (reference: NCCL comm abort on peer loss)."""
+        if self._rank_conns.get(rank) is conn:
+            return
+        self._rank_conns[rank] = conn
+        prev = conn.on_close
+
+        def on_close(c, _prev=prev, _rank=rank):
+            if _prev:
+                _prev(c)
+            if (
+                not self._destroyed
+                and self._rank_conns.get(_rank) is c
+            ):
+                self._on_member_dead([_rank])
+
+        conn.on_close = on_close
+
+    def _check_alive(self, op: str):
+        if self._destroyed:
+            raise CollectiveGroupDestroyedError(self.base_name, op)
+        if self._dead:
+            raise CollectiveMemberDiedError(
+                self.base_name,
+                op,
+                dead_ranks=sorted(self._dead),
+                detail="group is poisoned; reform_group() to continue",
+            )
+
+    def _probe_missing(self, ranks):
+        """Fire-and-forget head probe: confirm whether silent ranks are
+        dead so the next failure is a fast typed abort, and a dead node
+        is reaped without waiting out HEALTH_TIMEOUT_S."""
+        async def probe():
+            try:
                 await self.core.head.call(
-                    "kv_del", key=f"collective:{self.name}:{r}"
+                    "collective_probe",
+                    group=self.base_name,
+                    ranks=list(ranks),
                 )
+            except rpc.RpcError:
+                pass
+
+        asyncio.ensure_future(probe())
 
     # -------------------------------------------------------- hub (rank0)
     async def _on_op(
         self, conn, kind: str, seq: int, rank: int, payload: tuple, meta: dict
     ):
+        if self._destroyed:
+            return {"ok": False, "error": "destroyed"}
+        if self._dead:
+            return {
+                "ok": False,
+                "error": "member_died",
+                "dead_ranks": sorted(self._dead),
+            }
         key = (kind, seq)
         st = self._pending.get(key)
         if st is None:
             st = self._pending[key] = _Pending(self.world)
+            timeout = float(meta.get("timeout_s") or self.timeout_s)
+            st.timer = asyncio.get_running_loop().call_later(
+                timeout, self._expire, key, timeout
+            )
+        self._watch_conn(rank, conn)
         st.contrib[rank] = _unpack(payload)
         st.arrived += 1
+        st.arrive_ts[rank] = time.monotonic()
         fut = asyncio.get_running_loop().create_future()
         st.futures.append((rank, fut))
         if st.arrived == self.world:
+            if st.timer is not None:
+                st.timer.cancel()
+            self._record_op_stats(kind, st)
             self._complete(key, st, kind, meta)
         return await fut
+
+    def _expire(self, key: tuple, timeout: float):
+        """Hub deadline: answer every waiting member with the missing
+        ranks, then probe them — a dead member becomes a confirmed
+        death, a merely slow one shows up in the straggler stats."""
+        st = self._pending.pop(key, None)
+        if st is None:
+            return
+        missing = [r for r in range(self.world) if st.contrib[r] is None]
+        _ABORT_TOTAL.inc(tags={"group": self.base_name, "reason": "timeout"})
+        for r in missing:
+            self._straggler_counts[r] = self._straggler_counts.get(r, 0) + 1
+            _STRAGGLER_TOTAL.inc(
+                tags={"group": self.base_name, "rank": str(r)}
+            )
+        reply = {
+            "ok": False,
+            "error": "timeout",
+            "missing_ranks": missing,
+            "timeout_s": timeout,
+            "op": key[0],
+        }
+        for _rank, fut in st.futures:
+            if not fut.done():
+                fut.set_result(dict(reply))
+        self._probe_missing(missing)
+
+    def _record_op_stats(self, kind: str, st: _Pending):
+        self._ops_completed += 1
+        if len(st.arrive_ts) < 2:
+            return
+        first = min(st.arrive_ts.values())
+        last = max(st.arrive_ts.values())
+        self._last_lag_s = last - first
+        slowest = max(st.arrive_ts, key=st.arrive_ts.get)
+        self._straggler_counts[slowest] = (
+            self._straggler_counts.get(slowest, 0) + 1
+        )
+        _LAG_HIST.observe(
+            self._last_lag_s, tags={"group": self.base_name, "op": kind}
+        )
+        _STRAGGLER_TOTAL.inc(
+            tags={"group": self.base_name, "rank": str(slowest)}
+        )
+
+    def straggler_stats(self) -> dict:
+        """Hub-side per-rank slowest/missing counts (empty off-hub)."""
+        return {
+            "ops_completed": self._ops_completed,
+            "last_lag_s": self._last_lag_s,
+            "slowest_counts": dict(self._straggler_counts),
+        }
 
     def _complete(self, key, st: _Pending, kind: str, meta: dict):
         del self._pending[key]
@@ -121,43 +473,116 @@ class CpuGroup:
             if fut.done():
                 continue
             if kind == "reducescatter":
-                fut.set_result(_pack(result[rank]))
+                fut.set_result({"ok": True, "payload": _pack(result[rank])})
             elif kind == "reduce" and rank != meta.get("root", 0):
-                fut.set_result(_pack(None))
+                fut.set_result({"ok": True, "payload": _pack(None)})
             else:
-                fut.set_result(_pack(result))
+                fut.set_result({"ok": True, "payload": _pack(result)})
 
     # ----------------------------------------------------------- verbs
-    async def _op(self, kind: str, tensor: Any, **meta):
-        self._seq += 1
-        conn = await self.core._connect(self.root_addr)
-        reply = await conn.call(
-            f"col_op:{self.name}",
-            kind=kind,
-            seq=self._seq,
-            rank=self.rank,
-            payload=_pack(tensor),
-            meta=meta,
+    def _interpret(self, kind: str, reply: dict):
+        if reply.get("ok"):
+            return _unpack(reply["payload"]) if "payload" in reply else None
+        error = reply.get("error")
+        if error == "timeout":
+            raise CollectiveTimeoutError(
+                self.base_name,
+                kind,
+                reply.get("timeout_s"),
+                missing_ranks=reply.get("missing_ranks"),
+            )
+        if error == "destroyed":
+            raise CollectiveGroupDestroyedError(self.base_name, kind)
+        dead = [int(r) for r in reply.get("dead_ranks") or []]
+        self._dead.update(d for d in dead if d != self.rank)
+        raise CollectiveMemberDiedError(
+            self.base_name, kind, dead_ranks=dead
         )
-        return _unpack(reply)
 
-    async def allreduce(self, tensor, op=ReduceOp.SUM):
-        return await self._op("allreduce", np.asarray(tensor), op=op.value)
+    async def _op(
+        self, kind: str, tensor: Any, timeout_s: float | None = None, **meta
+    ):
+        self._check_alive(kind)
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
+        self._seq += 1
+        seq = self._seq
+        try:
+            conn = await self.core._connect(self.root_addr)
+        except rpc.ConnectionLost:
+            self._dead.add(0)
+            raise CollectiveMemberDiedError(
+                self.base_name, kind, dead_ranks=[0],
+                detail="cannot reach the hub rank",
+            )
+        call = asyncio.ensure_future(
+            conn.call(
+                f"col_op:{self.name}",
+                kind=kind,
+                seq=seq,
+                rank=self.rank,
+                payload=_pack(tensor),
+                meta={**meta, "timeout_s": t},
+            )
+        )
+        self._inflight.add(call)
+        try:
+            # The hub answers its own deadline; the grace-padded backstop
+            # only fires when the hub process itself is gone or wedged.
+            reply = await asyncio.wait_for(call, t + _HUB_GRACE_S)
+        except asyncio.TimeoutError:
+            self._probe_missing([0])
+            raise CollectiveTimeoutError(
+                self.base_name, kind, t,
+                detail="hub rank 0 did not answer within the deadline",
+            )
+        except asyncio.CancelledError:
+            # destroy() / death fan-out cancelled the in-flight call.
+            if self._destroyed:
+                raise CollectiveGroupDestroyedError(self.base_name, kind)
+            if self._dead:
+                raise CollectiveMemberDiedError(
+                    self.base_name, kind, dead_ranks=sorted(self._dead)
+                )
+            raise
+        except rpc.ConnectionLost:
+            self._dead.add(0)
+            raise CollectiveMemberDiedError(
+                self.base_name, kind, dead_ranks=[0],
+                detail="hub connection lost",
+            )
+        finally:
+            self._inflight.discard(call)
+        return self._interpret(kind, reply)
 
-    async def reduce(self, tensor, root=0, op=ReduceOp.SUM):
-        return await self._op("reduce", np.asarray(tensor), root=root, op=op.value)
+    async def allreduce(self, tensor, op=ReduceOp.SUM, timeout_s=None):
+        return await self._op(
+            "allreduce", np.asarray(tensor), timeout_s=timeout_s, op=op.value
+        )
 
-    async def broadcast(self, tensor, root=0):
-        return await self._op("broadcast", np.asarray(tensor), root=root)
+    async def reduce(self, tensor, root=0, op=ReduceOp.SUM, timeout_s=None):
+        return await self._op(
+            "reduce", np.asarray(tensor), timeout_s=timeout_s,
+            root=root, op=op.value,
+        )
 
-    async def allgather(self, tensor):
-        return await self._op("allgather", np.asarray(tensor))
+    async def broadcast(self, tensor, root=0, timeout_s=None):
+        return await self._op(
+            "broadcast", np.asarray(tensor), timeout_s=timeout_s, root=root
+        )
 
-    async def reducescatter(self, tensor, op=ReduceOp.SUM):
-        return await self._op("reducescatter", np.asarray(tensor), op=op.value)
+    async def allgather(self, tensor, timeout_s=None):
+        return await self._op(
+            "allgather", np.asarray(tensor), timeout_s=timeout_s
+        )
 
-    async def barrier(self):
-        await self._op("barrier", None)
+    async def reducescatter(self, tensor, op=ReduceOp.SUM, timeout_s=None):
+        return await self._op(
+            "reducescatter", np.asarray(tensor), timeout_s=timeout_s,
+            op=op.value,
+        )
+
+    async def barrier(self, timeout_s=None):
+        await self._op("barrier", None, timeout_s=timeout_s)
 
     # ------------------------------------------------------- send / recv
     # Mailbox is a queue per (src, seq) so back-to-back sends with the
@@ -180,24 +605,44 @@ class CpuGroup:
         payloads.append(payload)
         return {"ok": True}
 
-    async def send(self, tensor, dst_rank: int, seq: int = 0):
-        reply = await self.core.head.call(
-            "kv_get", key=f"collective:{self.name}:{dst_rank}"
-        )
-        if not reply["ok"]:
-            raise rpc.RpcError(f"rank {dst_rank} not in group {self.name}")
-        conn = await self.core._connect(reply["value"].decode())
-        await conn.call(
-            f"col_sendrecv:{self.name}",
-            src_rank=self.rank,
-            seq=seq,
-            payload=_pack(np.asarray(tensor)),
-        )
+    async def send(self, tensor, dst_rank: int, seq: int = 0, timeout_s=None):
+        self._check_alive("send")
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
 
-    async def recv(self, src_rank: int, seq: int = 0):
+        async def _send():
+            reply = await self.core.head.call(
+                "kv_get", key=f"collective:{self.name}:{dst_rank}"
+            )
+            if not reply["ok"]:
+                raise rpc.RpcError(
+                    f"rank {dst_rank} not in group {self.name}"
+                )
+            conn = await self.core._connect(reply["value"].decode())
+            await conn.call(
+                f"col_sendrecv:{self.name}",
+                src_rank=self.rank,
+                seq=seq,
+                payload=_pack(np.asarray(tensor)),
+            )
+
+        try:
+            await asyncio.wait_for(_send(), t)
+        except asyncio.TimeoutError:
+            raise CollectiveTimeoutError(
+                self.base_name, "send", t, missing_ranks=[dst_rank]
+            )
+
+    async def recv(self, src_rank: int, seq: int = 0, timeout_s=None):
+        self._check_alive("recv")
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
         payloads, waiters = self._mail_queues((src_rank, seq))
         if payloads:
             return _unpack(payloads.popleft())
         fut = asyncio.get_running_loop().create_future()
         waiters.append(fut)
-        return _unpack(await fut)
+        try:
+            return _unpack(await asyncio.wait_for(fut, t))
+        except asyncio.TimeoutError:
+            raise CollectiveTimeoutError(
+                self.base_name, "recv", t, missing_ranks=[src_rank]
+            )

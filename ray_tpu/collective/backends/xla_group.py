@@ -29,13 +29,22 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.collective.types import ReduceOp
+from ray_tpu.collective.types import (
+    CollectiveTimeoutError,
+    ReduceOp,
+)
 
 _PSUM_OPS = {
     ReduceOp.SUM: jax.lax.psum,
     ReduceOp.MAX: jax.lax.pmax,
     ReduceOp.MIN: jax.lax.pmin,
 }
+
+
+def _default_timeout() -> float:
+    from ray_tpu._private import config
+
+    return config.get("COLLECTIVE_TIMEOUT_S")
 
 
 class XlaMeshGroup:
@@ -84,7 +93,13 @@ class XlaMeshGroup:
         return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
     # ------------------------------------------------------------- verbs
-    def allreduce(self, tensors: Sequence[Any], op=ReduceOp.SUM) -> list:
+    # timeout_s is accepted for API parity with the fault-tolerant
+    # backends: in-process device collectives either complete or raise —
+    # there is no remote member to wait on.
+    def allreduce(
+        self, tensors: Sequence[Any], op=ReduceOp.SUM, timeout_s=None
+    ) -> list:
+        del timeout_s
         x = self._stack(tensors)
         key = ("allreduce", x.shape, str(x.dtype), op)
         if op is ReduceOp.PRODUCT:
@@ -105,11 +120,15 @@ class XlaMeshGroup:
             )
         return self._unstack(prog(x))
 
-    def broadcast(self, tensors: Sequence[Any], root: int = 0) -> list:
+    def broadcast(
+        self, tensors: Sequence[Any], root: int = 0, timeout_s=None
+    ) -> list:
+        del timeout_s
         src = jnp.asarray(tensors[root])
         return [jax.device_put(src, d) for d in self.devices]
 
-    def allgather(self, tensors: Sequence[Any]) -> list:
+    def allgather(self, tensors: Sequence[Any], timeout_s=None) -> list:
+        del timeout_s
         x = self._stack(tensors)
         key = ("allgather", x.shape, str(x.dtype))
         prog = self._program(
@@ -125,7 +144,10 @@ class XlaMeshGroup:
         )
         return self._unstack(prog(x))
 
-    def reducescatter(self, tensors: Sequence[Any], op=ReduceOp.SUM) -> list:
+    def reducescatter(
+        self, tensors: Sequence[Any], op=ReduceOp.SUM, timeout_s=None
+    ) -> list:
+        del timeout_s
         x = self._stack(tensors)
         if x.shape[1] % self.world:
             raise ValueError(
@@ -165,10 +187,13 @@ class XlaMeshGroup:
         )
         return self._unstack(prog(x))
 
-    def reduce(self, tensors: Sequence[Any], root: int = 0, op=ReduceOp.SUM):
+    def reduce(
+        self, tensors: Sequence[Any], root: int = 0, op=ReduceOp.SUM,
+        timeout_s=None,
+    ):
         """Single-controller semantics: returns the reduced tensor (the
         'root' distinction is process-level and meaningless in-process)."""
-        del root
+        del root, timeout_s
         return self.allreduce(tensors, op=op)
 
     def send(self, *a, **kw):
@@ -179,7 +204,8 @@ class XlaMeshGroup:
 
     recv = send
 
-    def barrier(self):
+    def barrier(self, timeout_s=None):
+        del timeout_s
         ones = [jnp.zeros((), jnp.int32) for _ in range(self.world)]
         self.allreduce(ones)
 
@@ -199,9 +225,14 @@ class XlaDistGroup:
 
     expects_per_rank_tensors = False
 
-    def __init__(self, world_size: int, rank: int):
+    def __init__(
+        self, world_size: int, rank: int, timeout_s: float | None = None
+    ):
         self.world = world_size
         self.rank = rank
+        self.timeout_s = (
+            _default_timeout() if timeout_s is None else float(timeout_s)
+        )
         by_proc: dict[int, jax.Device] = {}
         for d in jax.devices():
             by_proc.setdefault(d.process_index, d)
@@ -214,6 +245,7 @@ class XlaDistGroup:
         self.my_device = by_proc[jax.process_index()]
         self.mesh = Mesh(np.array(self.devices), ("ranks",))
         self._programs: dict[tuple, Any] = {}
+        self._sync_pool: Any = None  # lazy single-thread deadline pool
 
     def _global(self, tensor) -> jax.Array:
         local = jax.device_put(jnp.asarray(tensor)[None], self.my_device)
@@ -234,7 +266,40 @@ class XlaDistGroup:
             prog = self._programs[key] = jax.jit(mapped)
         return prog(x)
 
-    def allreduce(self, tensor, op=ReduceOp.SUM):
+    def _sync(self, arr: jax.Array, op: str, timeout_s) -> jax.Array:
+        """Deadline-bounded device sync. A peer process dying mid-op
+        leaves the compiled collective blocked inside the runtime with
+        no abort handle (the NCCL-comm-abort gap on XLA); waiting on a
+        side thread turns that silent hang into a typed
+        CollectiveTimeoutError. The wedged thread is abandoned — the
+        caller is expected to tear down / reform via jax.distributed
+        re-init, matching destroy-and-reform semantics."""
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
+        if not t or t <= 0:
+            return jax.block_until_ready(arr)
+        if self._sync_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._sync_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="xla_col_sync"
+            )
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        fut = self._sync_pool.submit(jax.block_until_ready, arr)
+        try:
+            return fut.result(t)
+        except _FutTimeout:
+            # The pool thread stays wedged on the dead collective; drop
+            # the pool so a post-reform op gets a fresh thread.
+            self._sync_pool = None
+            raise CollectiveTimeoutError(
+                "xla_dist", op, t,
+                detail="compiled collective never completed (peer "
+                       "process lost?); re-init jax.distributed to "
+                       "recover",
+            )
+
+    def allreduce(self, tensor, op=ReduceOp.SUM, timeout_s=None):
         x = self._global(tensor)
         psum = _PSUM_OPS[op]
         out = self._run(
@@ -242,9 +307,9 @@ class XlaDistGroup:
             lambda s: psum(s, "ranks"),
             x,
         )
-        return self._local(out)
+        return self._local(self._sync(out, "allreduce", timeout_s))
 
-    def allgather(self, tensor):
+    def allgather(self, tensor, timeout_s=None):
         x = self._global(tensor)
         out = self._run(
             ("allgather", x.shape, str(x.dtype)),
@@ -253,13 +318,15 @@ class XlaDistGroup:
             ],
             x,
         )
-        return self._local(out)
+        return self._local(self._sync(out, "allgather", timeout_s))
 
-    def broadcast(self, tensor, root: int = 0):
-        gathered = self.allgather(jnp.asarray(tensor)[None])
+    def broadcast(self, tensor, root: int = 0, timeout_s=None):
+        gathered = self.allgather(
+            jnp.asarray(tensor)[None], timeout_s=timeout_s
+        )
         return gathered[root]
 
-    def reducescatter(self, tensor, op=ReduceOp.SUM):
+    def reducescatter(self, tensor, op=ReduceOp.SUM, timeout_s=None):
         x = self._global(tensor)
         if op is ReduceOp.SUM:
             out = self._run(
@@ -269,13 +336,13 @@ class XlaDistGroup:
                 )[None],
                 x,
             )
-            return self._local(out)
-        full = self.allreduce(tensor, op=op)
+            return self._local(self._sync(out, "reducescatter", timeout_s))
+        full = self.allreduce(tensor, op=op, timeout_s=timeout_s)
         chunk = full.shape[0] // self.world
         return full[self.rank * chunk : (self.rank + 1) * chunk]
 
-    def barrier(self):
-        self.allreduce(jnp.zeros((), jnp.int32))
+    def barrier(self, timeout_s=None):
+        self.allreduce(jnp.zeros((), jnp.int32), timeout_s=timeout_s)
 
 
 async def bootstrap_distributed(
@@ -284,16 +351,21 @@ async def bootstrap_distributed(
     world_size: int,
     rank: int,
     local_device_ids: Sequence[int] | None = None,
+    timeout_s: float | None = None,
 ):
     """Multi-host jax.distributed bootstrap with head-KV rendezvous.
 
     Rank 0 publishes a coordinator address in the cluster KV; every rank
     then calls jax.distributed.initialize. This replaces the reference's
     NCCLUniqueID rendezvous actor (nccl_collective_group.py:29-56) with
-    the jax coordination service.
+    the jax coordination service. The coordinator poll is deadline-
+    bounded: a rank-0 process that never comes up raises
+    CollectiveTimeoutError instead of polling the KV forever.
     """
     import socket
+    import time as _time
 
+    t = _default_timeout() if timeout_s is None else float(timeout_s)
     key = f"jaxdist:{group_name}:coordinator"
     if rank == 0:
         with socket.socket() as s:
@@ -303,11 +375,17 @@ async def bootstrap_distributed(
         coord = f"{host}:{port}"
         await core.head.call("kv_put", key=key, value=coord.encode())
     else:
+        deadline = _time.monotonic() + t
         while True:
             reply = await core.head.call("kv_get", key=key)
             if reply["ok"]:
                 coord = reply["value"].decode()
                 break
+            if _time.monotonic() > deadline:
+                raise CollectiveTimeoutError(
+                    group_name, "rendezvous", t, missing_ranks=[0],
+                    detail="jax.distributed coordinator never published",
+                )
             await asyncio.sleep(0.05)
 
     def _init():

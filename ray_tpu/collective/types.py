@@ -1,8 +1,12 @@
-"""Collective types (reference: python/ray/util/collective/types.py)."""
+"""Collective types and fault-tolerance exceptions (reference:
+python/ray/util/collective/types.py; abort semantics follow the
+reference's NCCL-abort / destroy_collective_group contract)."""
 
 from __future__ import annotations
 
 import enum
+
+from ray_tpu.exceptions import RayTpuError
 
 
 class Backend(str, enum.Enum):
@@ -30,3 +34,93 @@ class ReduceOp(str, enum.Enum):
 
 
 UNSET_RANK = -1
+
+
+class CollectiveError(RayTpuError):
+    """Base for collective fault-tolerance errors. All subclasses keep
+    their fields in ``args`` so they survive the task-error pickle path
+    (a worker's abort reaches the driver typed, as ``.cause``)."""
+
+
+class CollectiveTimeoutError(CollectiveError):
+    """A collective op or rendezvous missed its deadline.
+
+    ``missing_ranks`` names the ranks whose contribution (or rendezvous
+    key) never arrived — None when the caller cannot know (e.g. the hub
+    stopped answering)."""
+
+    def __init__(
+        self,
+        group: str = "",
+        op: str = "",
+        timeout_s: float | None = None,
+        missing_ranks=None,
+        detail: str = "",
+    ):
+        super().__init__(group, op, timeout_s, missing_ranks, detail)
+        self.group = group
+        self.op = op
+        self.timeout_s = timeout_s
+        self.missing_ranks = (
+            sorted(missing_ranks) if missing_ranks is not None else None
+        )
+        self.detail = detail
+
+    def __str__(self):
+        missing = (
+            f" missing ranks {self.missing_ranks}"
+            if self.missing_ranks is not None
+            else ""
+        )
+        tail = f" ({self.detail})" if self.detail else ""
+        return (
+            f"collective {self.op or 'op'} on group {self.group!r} timed "
+            f"out after {self.timeout_s}s:{missing or ' no contribution'}"
+            f"{tail}"
+        )
+
+
+class CollectiveMemberDiedError(CollectiveError):
+    """A group member died (head-declared node/worker death, or the hub
+    connection dropped). The group is poisoned: every in-flight and
+    future op fails with this until ``reform_group()`` re-forms it from
+    the survivors."""
+
+    def __init__(
+        self,
+        group: str = "",
+        op: str = "",
+        dead_ranks=(),
+        detail: str = "",
+    ):
+        super().__init__(group, op, tuple(dead_ranks), detail)
+        self.group = group
+        self.op = op
+        self.dead_ranks = sorted(dead_ranks)
+        self.detail = detail
+
+    def __str__(self):
+        tail = f" ({self.detail})" if self.detail else ""
+        return (
+            f"collective group {self.group!r} member(s) "
+            f"{self.dead_ranks} died"
+            + (f" during {self.op}" if self.op else "")
+            + f"; reform_group() to continue with the survivors{tail}"
+        )
+
+
+class CollectiveGroupDestroyedError(CollectiveError):
+    """The group was destroyed while this op was in flight —
+    destroy_collective_group fails pending futures instead of leaving
+    their awaiting coroutines pending forever."""
+
+    def __init__(self, group: str = "", op: str = ""):
+        super().__init__(group, op)
+        self.group = group
+        self.op = op
+
+    def __str__(self):
+        return (
+            f"collective group {self.group!r} was destroyed"
+            + (f" while {self.op} was in flight" if self.op else "")
+        )

@@ -57,6 +57,12 @@ class HeadService:
         self.task_latest: collections.OrderedDict = collections.OrderedDict()
         # worker addr → latest metrics snapshot {name: record}
         self.metrics: dict[str, dict] = {}
+        # Collective-group membership (the fault-tolerance layer's view):
+        # group → {"epoch": int, "members": {rank: {addr, node_addr,
+        # worker_id, dead}}}. Node/worker death fans out to survivors on
+        # the "collective" pubsub channel so in-flight ops abort instead
+        # of burning their full deadline.
+        self.collective_members: dict[str, dict] = {}
         # Cluster-wide infeasible lease demand, deduped per waiting
         # request: requester id → (resources, ts). Each spill-waiting
         # request refreshes its single entry, so one pending lease reads
@@ -771,8 +777,145 @@ class HeadService:
         return {"ok": True}
 
     async def _on_publish(self, conn, channel: str, msg):
+        # Worker-death reports from node reap loops double as collective
+        # abort triggers: a SIGKILLed member on a LIVE node must poison
+        # its groups without waiting for any op deadline.
+        if (
+            channel == "worker"
+            and isinstance(msg, dict)
+            and msg.get("event") == "died"
+        ):
+            self._collective_member_died(worker_id=msg.get("worker_id"))
         self.publish(channel, msg)
         return {"ok": True}
+
+    # ------------------------------------------------ collective groups
+    async def _on_collective_register(
+        self,
+        conn,
+        group: str,
+        rank: int,
+        epoch: int = 0,
+        addr: str | None = None,
+        node_addr: str | None = None,
+        worker_id: str | None = None,
+    ):
+        """Membership registration (reference: the NCCL group's named
+        rendezvous actor, here head-owned so death detection can cross-
+        reference the node table). A higher epoch — a reform — replaces
+        the previous incarnation wholesale."""
+        rec = self.collective_members.get(group)
+        if rec is None or epoch > rec["epoch"]:
+            rec = self.collective_members[group] = {
+                "epoch": int(epoch),
+                "members": {},
+            }
+        if epoch < rec["epoch"]:
+            return {"ok": False, "stale": True}
+        rec["members"][int(rank)] = {
+            "addr": addr,
+            "node_addr": node_addr,
+            "worker_id": worker_id,
+            "dead": False,
+        }
+        return {"ok": True}
+
+    async def _on_collective_deregister(
+        self, conn, group: str, epoch: int | None = None, rank=None
+    ):
+        rec = self.collective_members.get(group)
+        if rec is None:
+            return {"ok": False}
+        if epoch is not None and rec["epoch"] != int(epoch):
+            return {"ok": False, "stale": True}
+        if rank is None:
+            del self.collective_members[group]
+        else:
+            rec["members"].pop(int(rank), None)
+            if not rec["members"]:
+                del self.collective_members[group]
+        return {"ok": True}
+
+    def _collective_member_died(
+        self,
+        node_addr: str | None = None,
+        worker_id: str | None = None,
+    ):
+        """Cross-reference a dead node/worker against every collective
+        group and fan the member deaths out to the survivors."""
+        for group, rec in self.collective_members.items():
+            dead = []
+            for r, m in rec["members"].items():
+                if m.get("dead"):
+                    continue
+                if (node_addr is not None and m.get("node_addr") == node_addr) or (
+                    worker_id is not None
+                    and m.get("worker_id") == worker_id
+                ):
+                    m["dead"] = True
+                    dead.append(r)
+            if dead:
+                self.publish(
+                    "collective",
+                    {
+                        "event": "member_dead",
+                        "group": group,
+                        "epoch": rec["epoch"],
+                        "ranks": sorted(dead),
+                    },
+                )
+
+    async def _on_collective_probe(
+        self, conn, group: str, ranks=None
+    ):
+        """Active member health check, fired by a group when an op
+        deadline expires (reference: gcs_health_check_manager.h:45 active
+        probes vs passive heartbeats). Confirms whether the silent ranks
+        are actually dead — a dead NODE is removed from the cluster now
+        (instead of aging out of HEALTH_TIMEOUT_S), a dead WORKER on a
+        live node fans out member death; a merely-slow member is left
+        alone."""
+        rec = self.collective_members.get(group)
+        if rec is None:
+            return {"ok": False, "error": f"unknown group {group!r}"}
+        members = rec["members"]
+        targets = (
+            [int(r) for r in ranks] if ranks is not None else list(members)
+        )
+        confirmed: list[int] = []
+        for r in targets:
+            m = members.get(r)
+            if m is None or m.get("dead"):
+                continue
+            node_addr = m.get("node_addr")
+            nid = next(
+                (
+                    i
+                    for i, n in self.nodes.items()
+                    if n["addr"] == node_addr
+                ),
+                None,
+            )
+            if node_addr and nid is None:
+                # Node already gone from the table: the member died with it.
+                self._collective_member_died(node_addr=node_addr)
+                confirmed.append(r)
+                continue
+            node_conn = self._node_conns.get(nid) if nid else None
+            if node_conn is not None:
+                try:
+                    reply = await node_conn.call("list_workers", timeout=2.0)
+                except Exception:  # noqa: BLE001 - any failure = dead node
+                    await self._remove_node(nid)
+                    confirmed.append(r)
+                    continue
+                wid = m.get("worker_id")
+                if wid is not None and wid not in {
+                    w["worker_id"] for w in reply.get("workers", [])
+                }:
+                    self._collective_member_died(worker_id=wid)
+                    confirmed.append(r)
+        return {"ok": True, "dead_ranks": sorted(confirmed)}
 
     # -------------------------------------------------- placement groups
     async def _on_create_placement_group(
@@ -1026,6 +1169,31 @@ class HeadService:
         }
 
     # ----------------------------------------------------------- health
+    async def _remove_node(self, nid: str):
+        """Declare a node dead: drop it from every table, fan collective
+        member death out to surviving group members, and restart its
+        actors within budget. Shared by the passive heartbeat reaper and
+        the active collective probe."""
+        node = self.nodes.pop(nid, None)
+        if node is None:
+            return
+        self._sched_cols = None  # membership changed
+        conn = self._node_conns.pop(nid, None)
+        if conn is not None:
+            await conn.close()
+        self.publish(
+            "node",
+            {"event": "removed", "node_id": nid, "addr": node["addr"]},
+        )
+        self._collective_member_died(node_addr=node["addr"])
+        for aid, actor in self.actors.items():
+            if actor["node_id"] == nid and actor["state"] == "ALIVE":
+                # Node death goes through the same restart budget as
+                # worker death (reference: actors on dead nodes are
+                # rescheduled while max_restarts remains,
+                # gcs_actor_manager).
+                self._spawn_restart(aid, actor["addr"])
+
     async def _health_loop(self):
         """Mark nodes dead on heartbeat timeout (reference:
         gcs_health_check_manager.h:45 does active gRPC probes)."""
@@ -1038,18 +1206,4 @@ class HeadService:
             now = time.monotonic()
             for nid, node in list(self.nodes.items()):
                 if now - node["last_seen"] > config.get("HEALTH_TIMEOUT_S"):
-                    del self.nodes[nid]
-                    self._sched_cols = None  # membership changed
-                    conn = self._node_conns.pop(nid, None)
-                    if conn is not None:
-                        await conn.close()
-                    self.publish(
-                        "node", {"event": "removed", "node_id": nid}
-                    )
-                    for aid, actor in self.actors.items():
-                        if actor["node_id"] == nid and actor["state"] == "ALIVE":
-                            # Node death goes through the same restart
-                            # budget as worker death (reference: actors
-                            # on dead nodes are rescheduled while
-                            # max_restarts remains, gcs_actor_manager).
-                            self._spawn_restart(aid, actor["addr"])
+                    await self._remove_node(nid)

@@ -1227,6 +1227,18 @@ class NodeManager:
     async def _on_kill_worker(self, conn, worker_id: str, force: bool = True):
         self._kill_worker(worker_id)
         self._release_worker_leases(worker_id)
+        # _kill_worker drops the record, so the reap loop never sees this
+        # death — publish it here or collective groups (and any other
+        # "worker" subscriber) would only learn via op deadlines.
+        if self.head:
+            try:
+                await self.head.call(
+                    "publish",
+                    channel="worker",
+                    msg={"event": "died", "worker_id": worker_id},
+                )
+            except rpc.RpcError:
+                pass
         return {"ok": True}
 
     def _release_worker_leases(self, worker_id: str):

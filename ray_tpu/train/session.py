@@ -24,6 +24,10 @@ class TrainContext:
     # eager-collective group formed by the trainer backend (empty when
     # ScalingConfig.distributed is off); attempt-scoped name
     collective_group: str = ""
+    # controller retry attempt this worker belongs to (0 on the first
+    # try) — lets a train loop scope its own collective-group names per
+    # attempt so a retry never rendezvouses with a dead attempt's KV keys
+    attempt: int = 0
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)

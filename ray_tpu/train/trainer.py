@@ -47,6 +47,11 @@ class ScalingConfig:
     # worker). Required for FSDP/TP across hosts; off for independent
     # per-worker DP loops.
     distributed: bool = False
+    # Deadline for the worker group's collective ops and rendezvous
+    # (None = config COLLECTIVE_TIMEOUT_S). A member lost mid-step then
+    # surfaces as a typed collective abort within this bound, which the
+    # controller turns into an elastic resize instead of a hang.
+    collective_timeout_s: float | None = None
 
     def bundle(self) -> dict:
         b = {"CPU": 1.0}
@@ -65,12 +70,18 @@ class ScalingPolicy:
     """Decides each attempt's worker-group size (reference:
     train/v2/_internal/execution/scaling_policy/scaling_policy.py).
     The default keeps the configured size: a failed attempt retries at
-    full width."""
+    full width. ``last_error`` carries the previous attempt's failure —
+    a CollectiveError (member death / op timeout) is the resize trigger
+    the collective layer surfaces to elastic policies."""
 
     def workers_for_attempt(
-        self, scaling: "ScalingConfig", attempt: int, cluster_free: list[dict]
+        self,
+        scaling: "ScalingConfig",
+        attempt: int,
+        cluster_free: list[dict],
+        last_error: Exception | None = None,
     ) -> int:
-        del attempt, cluster_free
+        del attempt, cluster_free, last_error
         return scaling.num_workers
 
 
@@ -90,8 +101,13 @@ class ElasticScalingPolicy(ScalingPolicy):
         self.min_workers = min_workers
 
     def workers_for_attempt(
-        self, scaling: "ScalingConfig", attempt: int, cluster_free: list[dict]
+        self,
+        scaling: "ScalingConfig",
+        attempt: int,
+        cluster_free: list[dict],
+        last_error: Exception | None = None,
     ) -> int:
+        del last_error  # any failure re-fits; the kind only affects settle
         if attempt == 0:
             return scaling.num_workers
         bundle = scaling.bundle()
@@ -159,6 +175,9 @@ class TrainWorker:
             else:
                 os.environ.pop("JAX_PLATFORMS", None)
         collective_group = ""
+        attempt = int(backend_env.get("RAY_TPU_TRAIN_ATTEMPT", "0"))
+        col_timeout = backend_env.get("RAY_TPU_TRAIN_COLLECTIVE_TIMEOUT_S")
+        col_timeout = float(col_timeout) if col_timeout else None
         if backend_env.get("RAY_TPU_TRAIN_DISTRIBUTED") == "1":
             # One global mesh across the worker group: bootstrap
             # jax.distributed through the head-KV rendezvous BEFORE any
@@ -170,7 +189,6 @@ class TrainWorker:
             # previous attempt's coordinator KV entry.
             from ray_tpu import collective as col
 
-            attempt = backend_env.get("RAY_TPU_TRAIN_ATTEMPT", "0")
             collective_group = f"train:{experiment_name}:a{attempt}"
             if not col.is_group_initialized(collective_group):
                 col.init_collective_group(
@@ -178,6 +196,7 @@ class TrainWorker:
                     self.rank,
                     backend="xla_dist",
                     group_name=collective_group,
+                    timeout_s=col_timeout,
                 )
         self.ctx = TrainContext(
             world_size=self.world_size,
@@ -188,6 +207,7 @@ class TrainWorker:
             config=config,
             dataset_shards=dataset_shards or {},
             collective_group=collective_group,
+            attempt=attempt,
         )
         return True
 
@@ -198,6 +218,23 @@ class TrainWorker:
                 train_loop(self.ctx.config)
             else:
                 train_loop()
+        except Exception as e:
+            # Collective abort (a group member died / an op timed out
+            # mid-step): tear down this worker's groups so their pending
+            # futures fail instead of leaking, then fail the attempt —
+            # the controller surfaces the abort to the scaling policy as
+            # a resize trigger and restores from the last checkpoint.
+            from ray_tpu.collective.types import CollectiveError
+
+            if isinstance(e, CollectiveError):
+                import ray_tpu.collective as col
+
+                for name in list(col._groups):
+                    try:
+                        col.destroy_collective_group(name)
+                    except Exception:  # noqa: BLE001 - teardown best-effort
+                        pass
+            raise
         finally:
             _set_context(None)
         return {
@@ -261,9 +298,7 @@ class JaxTrainer:
         latest_checkpoint: str | None = None
         last_err: Exception | None = None
         while True:
-            n = self.scaling_policy.workers_for_attempt(
-                self.scaling, failures, self._cluster_free()
-            )
+            n = self._policy_workers(failures, last_err)
             try:
                 return self._run_attempt(latest_checkpoint, failures, n)
             except Exception as e:  # noqa: BLE001 - controller retry loop
@@ -274,19 +309,87 @@ class JaxTrainer:
                 )
                 if failures > self.run_config.failure_config.max_failures:
                     break
-                # Let the cluster view settle before sizing the retry:
-                # the dead slice must age out of the node table
-                # (HEALTH_TIMEOUT_S) and survivors' heartbeats must
-                # republish bundles freed by the failed attempt's PG.
-                from ray_tpu._private import config as _config
-
-                time.sleep(_config.get("HEALTH_TIMEOUT_S") + 2.0)
+                self._settle_cluster_view(e)
         return Result(
             metrics={},
             checkpoint=latest_checkpoint,
             path=self._run_dir(),
             error=last_err,
         )
+
+    def _policy_workers(
+        self, attempt: int, last_err: Exception | None
+    ) -> int:
+        try:
+            return self.scaling_policy.workers_for_attempt(
+                self.scaling,
+                attempt,
+                self._cluster_free(),
+                last_error=last_err,
+            )
+        except TypeError:
+            # User policy predating the last_error hook.
+            return self.scaling_policy.workers_for_attempt(
+                self.scaling, attempt, self._cluster_free()
+            )
+
+    @staticmethod
+    def _is_collective_abort(err: Exception | None) -> bool:
+        """Did the attempt fail on a typed collective abort? Checks the
+        exception and its carried causes — worker errors arrive wrapped
+        in RayTaskError with the original in .cause (or stringified when
+        unpicklable)."""
+        from ray_tpu.collective.types import CollectiveError
+
+        seen = 0
+        while err is not None and seen < 8:
+            if isinstance(err, CollectiveError):
+                return True
+            if any(
+                name in str(err)
+                for name in (
+                    "CollectiveTimeoutError",
+                    "CollectiveMemberDiedError",
+                )
+            ):
+                return True
+            err = getattr(err, "cause", None) or err.__cause__
+            seen += 1
+        return False
+
+    def _settle_cluster_view(self, err: Exception | None) -> None:
+        """Let the cluster view settle before sizing the retry.
+
+        Default failure (a hang inferred from worker death): the dead
+        slice must age out of the node table (HEALTH_TIMEOUT_S) and
+        survivors' heartbeats must republish bundles freed by the failed
+        attempt's PG — wait the full window.
+
+        Collective abort: the failure was *detected*, and the abort path
+        already probed the head (collective_probe removes a confirmed-
+        dead node immediately), so poll until the node table holds still
+        instead of sleeping the worst case."""
+        from ray_tpu._private import config as _config
+
+        budget = _config.get("HEALTH_TIMEOUT_S") + 2.0
+        if not self._is_collective_abort(err):
+            time.sleep(budget)
+            return
+        deadline = time.monotonic() + budget
+        prev: frozenset | None = None
+        stable = 0
+        while time.monotonic() < deadline:
+            try:
+                rt = ray_tpu.api._runtime
+                status = rt.run(rt.core.head.call("cluster_status"))
+                view = frozenset(status.get("nodes", {}).keys())
+            except Exception:  # noqa: BLE001 - head busy: keep waiting
+                view = None
+            stable = stable + 1 if view is not None and view == prev else 0
+            prev = view
+            if stable >= 3:
+                return
+            time.sleep(0.5)
 
     def _cluster_free(self) -> list[dict]:
         """Per-live-node available resources (the scaling policy's view
@@ -335,9 +438,15 @@ class JaxTrainer:
             # TPU workers own the chip runtime; everything else stays on
             # the JAX CPU backend so it never contends for the slice.
             env["RAY_TPU_WORKER_JAX_PLATFORMS"] = ""
+        # Attempt is always exposed (not only for distributed) so train
+        # loops can scope their own collective groups per attempt.
+        env["RAY_TPU_TRAIN_ATTEMPT"] = str(attempt)
+        if self.scaling.collective_timeout_s is not None:
+            env["RAY_TPU_TRAIN_COLLECTIVE_TIMEOUT_S"] = str(
+                self.scaling.collective_timeout_s
+            )
         if self.scaling.distributed and n > 1:
             env["RAY_TPU_TRAIN_DISTRIBUTED"] = "1"
-            env["RAY_TPU_TRAIN_ATTEMPT"] = str(attempt)
         return env
 
     def _run_attempt(

@@ -55,6 +55,14 @@ class _Metric:
             )
         return tuple(merged.get(k, "") for k in self.tag_keys)
 
+    def value(self, tags: dict[str, str] | None = None, default=None):
+        """Read the current value for one tag set (counter/gauge: float;
+        histogram: [bucket_counts, sum, count]). In-process observers —
+        the collective straggler telemetry's tests, health checks —
+        read this instead of round-tripping a snapshot."""
+        with _LOCK:
+            return self._series.get(self._key(tags), default)
+
 
 class Counter(_Metric):
     kind = "counter"

@@ -25,6 +25,57 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Wall-clock ceiling for collective tests: a hung collective (the exact
+# failure mode the fault-tolerance layer exists to remove) must fail the
+# one test, not wedge the whole suite until the CI timeout.
+COLLECTIVE_WALLCLOCK_S = 60
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: kill-based fault-injection tests (worker/node processes "
+        "are SIGKILLed mid-op)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests excluded from tier-1 (-m 'not slow')",
+    )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    import signal
+    import threading
+
+    guarded = (
+        hasattr(signal, "SIGALRM")
+        and threading.current_thread() is threading.main_thread()
+        and (
+            "collective" in getattr(getattr(item, "module", None),
+                                    "__name__", "")
+            or item.get_closest_marker("chaos") is not None
+        )
+    )
+    if not guarded:
+        yield
+        return
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"collective test exceeded {COLLECTIVE_WALLCLOCK_S}s wall "
+            "clock — a collective op hung instead of raising its typed "
+            "deadline/abort error"
+        )
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(COLLECTIVE_WALLCLOCK_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
 
 @pytest.fixture(scope="session")
 def mesh8():

@@ -1,0 +1,308 @@
+"""Collective fault tolerance: deadlines, abort propagation, reform.
+
+Deterministic variants (timeouts, chaos RPC injection, destroy) run in
+tier-1; the SIGKILL variants carry the ``chaos`` marker. Every test in
+this module is under the conftest 60s wall-clock guard — the one outcome
+none of them may produce is a hang.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective.types import (
+    CollectiveGroupDestroyedError,
+    CollectiveMemberDiedError,
+    CollectiveTimeoutError,
+)
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Member:
+    """One collective member; returns outcomes as plain data so the
+    asserts don't depend on cross-process exception pickling."""
+
+    def setup(self, world, rank, group, timeout_s):
+        import ray_tpu.collective as col
+
+        col.init_collective_group(
+            world, rank, backend="cpu", group_name=group, timeout_s=timeout_s
+        )
+        return os.getpid()
+
+    def guarded_allreduce(self, group, value, timeout_s=None):
+        import ray_tpu.collective as col
+
+        t0 = time.monotonic()
+        try:
+            out = col.allreduce(
+                np.full((4,), value, np.float32),
+                group_name=group,
+                timeout_s=timeout_s,
+            )
+            return {"ok": True, "sum": float(np.asarray(out)[0])}
+        except (CollectiveTimeoutError, CollectiveMemberDiedError) as e:
+            return {
+                "ok": False,
+                "type": type(e).__name__,
+                "missing": getattr(e, "missing_ranks", None),
+                "dead": getattr(e, "dead_ranks", None),
+                "elapsed": time.monotonic() - t0,
+            }
+
+    def reform_and_allreduce(self, group, value):
+        import ray_tpu.collective as col
+
+        rank, world = col.reform_group(group)
+        out = col.allreduce(
+            np.full((2,), value, np.float32), group_name=group
+        )
+        return {"rank": rank, "world": world, "sum": float(np.asarray(out)[0])}
+
+    def chaos_allreduce(self, group, value, spec):
+        """Deterministic injection: drop this member's own op RPC."""
+        os.environ["RAY_TPU_RPC_FAILURE"] = spec
+        try:
+            return self.guarded_allreduce(group, value, timeout_s=4.0)
+        finally:
+            del os.environ["RAY_TPU_RPC_FAILURE"]
+
+    def straggler_allreduce(self, group, value, delay_s):
+        import ray_tpu.collective as col
+
+        time.sleep(delay_s)
+        col.allreduce(np.full((2,), value, np.float32), group_name=group)
+        return True
+
+    def stats(self, group):
+        import ray_tpu.collective as col
+
+        return col.straggler_stats(group)
+
+
+# ------------------------------------------------------------ deadlines
+def test_rendezvous_timeout_names_missing_ranks(cluster):
+    """KV rendezvous must not poll forever when a member never joins."""
+    import ray_tpu.collective as col
+
+    t0 = time.monotonic()
+    with pytest.raises(CollectiveTimeoutError) as ei:
+        col.init_collective_group(
+            2, 0, backend="cpu", group_name="never", timeout_s=1.0
+        )
+    assert ei.value.missing_ranks == [1]
+    assert time.monotonic() - t0 < 10
+    assert not col.is_group_initialized("never")
+
+
+def test_op_timeout_names_missing_ranks_then_reform(cluster):
+    """A rank that skips an op trips the hub deadline for everyone else;
+    reform_group() repairs the desynced group in place."""
+    world = 3
+    members = [Member.remote() for _ in range(world)]
+    ray_tpu.get(
+        [m.setup.remote(world, i, "gt", 30.0) for i, m in enumerate(members)]
+    )
+    # Ranks 0 and 1 reduce; rank 2 never shows up for this op.
+    refs = [
+        m.guarded_allreduce.remote("gt", 1.0, timeout_s=1.5)
+        for m in members[:2]
+    ]
+    outs = ray_tpu.get(refs, timeout=30)
+    for out in outs:
+        assert out["ok"] is False
+        assert out["type"] == "CollectiveTimeoutError"
+        assert out["missing"] == [2]
+        assert out["elapsed"] < 10
+    # All three reform (no ranks died → same shape, fresh op sequence).
+    outs = ray_tpu.get(
+        [m.reform_and_allreduce.remote("gt", float(i + 1))
+         for i, m in enumerate(members)],
+        timeout=30,
+    )
+    assert sorted(o["rank"] for o in outs) == [0, 1, 2]
+    assert all(o["world"] == 3 and o["sum"] == 6.0 for o in outs)
+
+
+def test_chaos_rpc_injection_is_typed(cluster):
+    """Deterministic multi-spec chaos: the victim's dropped op RPC and
+    the survivor's hub deadline both surface typed, not as hangs."""
+    members = [Member.remote() for _ in range(2)]
+    ray_tpu.get(
+        [m.setup.remote(2, i, "gc", 30.0) for i, m in enumerate(members)]
+    )
+    # Multi-spec: first entry inert, second drops this group's op RPC.
+    spec = "push_task:0.0,col_op:gc:1.0"
+    r1 = members[1].chaos_allreduce.remote("gc", 1.0, spec)
+    r0 = members[0].guarded_allreduce.remote("gc", 1.0, timeout_s=4.0)
+    out1 = ray_tpu.get(r1, timeout=30)
+    out0 = ray_tpu.get(r0, timeout=30)
+    assert out1["ok"] is False  # its own RPC was chaos-dropped
+    assert out1["type"] == "CollectiveMemberDiedError"
+    assert out0["ok"] is False  # hub deadline: rank 1 never arrived
+    assert out0["type"] == "CollectiveTimeoutError"
+    assert out0["missing"] == [1]
+
+
+def test_recv_timeout(cluster):
+    """recv with no sender must raise after its deadline, not block."""
+
+    @ray_tpu.remote
+    class Recv:
+        def setup(self):
+            import ray_tpu.collective as col
+
+            col.init_collective_group(
+                2, 1, backend="cpu", group_name="gr2", timeout_s=30.0
+            )
+
+        def recv(self):
+            import ray_tpu.collective as col
+
+            try:
+                col.recv(0, group_name="gr2", timeout_s=1.0)
+                return {"ok": True}
+            except CollectiveTimeoutError as e:
+                return {"ok": False, "missing": e.missing_ranks}
+
+    a, b = Member.remote(), Recv.remote()
+    ray_tpu.get([a.setup.remote(2, 0, "gr2", 30.0), b.setup.remote()])
+    out = ray_tpu.get(b.recv.remote(), timeout=30)
+    assert out == {"ok": False, "missing": [0]}
+
+
+# ------------------------------------------------------------- destroy
+def test_destroy_fails_inflight_futures(cluster):
+    """destroy_collective_group must fail pending op futures instead of
+    leaving their awaiting coroutines pending (driver blocks in recv on
+    a side thread; main thread destroys the group)."""
+    import ray_tpu.collective as col
+
+    m = Member.remote()
+    setup_ref = m.setup.remote(2, 1, "gd", 30.0)
+    col.init_collective_group(2, 0, backend="cpu", group_name="gd",
+                              timeout_s=30.0)
+    ray_tpu.get(setup_ref)
+
+    errs: list = []
+
+    def blocked_recv():
+        try:
+            col.recv(1, group_name="gd", timeout_s=25.0)
+            errs.append(None)
+        except BaseException as e:  # noqa: BLE001 - capture for assert
+            errs.append(e)
+
+    t = threading.Thread(target=blocked_recv, daemon=True)
+    t.start()
+    time.sleep(0.5)  # let the recv register its waiter
+    col.destroy_collective_group("gd")
+    t.join(timeout=10)
+    assert not t.is_alive(), "recv stayed pending after destroy"
+    assert isinstance(errs[0], CollectiveGroupDestroyedError)
+
+
+# ----------------------------------------------------------- telemetry
+def test_straggler_stats_visible_on_hub(cluster):
+    members = [Member.remote() for _ in range(2)]
+    ray_tpu.get(
+        [m.setup.remote(2, i, "gs", 30.0) for i, m in enumerate(members)]
+    )
+    for _ in range(2):
+        refs = [
+            members[0].straggler_allreduce.remote("gs", 1.0, 0.0),
+            members[1].straggler_allreduce.remote("gs", 2.0, 0.3),
+        ]
+        assert all(ray_tpu.get(refs, timeout=30))
+    stats = ray_tpu.get(members[0].stats.remote("gs"), timeout=30)
+    assert stats["ops_completed"] == 2
+    assert stats["slowest_counts"].get(1, 0) >= 2  # rank 1 is the straggler
+    assert stats["last_lag_s"] >= 0.1
+
+
+# ----------------------------------------------------- SIGKILL (chaos)
+def _kill_and_collect(members, group, victim_idx, survivor_idxs, pids,
+                      timeout_s):
+    from ray_tpu._private.test_utils import sigkill_pid
+
+    refs = {
+        i: members[i].guarded_allreduce.remote(
+            group, float(i + 1), timeout_s=timeout_s
+        )
+        for i in survivor_idxs
+    }
+    time.sleep(0.7)  # survivors are now in-flight
+    t_kill = time.monotonic()
+    sigkill_pid(pids[victim_idx])
+    outs = {i: ray_tpu.get(r, timeout=45) for i, r in refs.items()}
+    return outs, time.monotonic() - t_kill
+
+
+@pytest.mark.chaos
+def test_sigkill_nonhub_member_aborts_survivors(cluster):
+    """SIGKILL a non-hub member mid-allreduce: every survivor gets a
+    typed abort within the deadline — no hangs."""
+    world = 3
+    members = [Member.remote() for _ in range(world)]
+    pids = ray_tpu.get(
+        [m.setup.remote(world, i, "gk", 30.0) for i, m in enumerate(members)]
+    )
+    deadline = 8.0
+    outs, elapsed = _kill_and_collect(
+        members, "gk", 2, [0, 1], pids, deadline
+    )
+    for out in outs.values():
+        assert out["ok"] is False
+        assert out["type"] in (
+            "CollectiveMemberDiedError", "CollectiveTimeoutError"
+        )
+        dead_or_missing = out["dead"] or out["missing"]
+        assert 2 in dead_or_missing
+        assert out["elapsed"] < deadline + 6  # hub grace backstop bound
+    # Abort-and-reform: the survivors re-form at world 2 and complete a
+    # collective.
+    outs = ray_tpu.get(
+        [m.reform_and_allreduce.remote("gk", float(i + 1))
+         for i, m in enumerate(members[:2])],
+        timeout=45,
+    )
+    assert sorted(o["rank"] for o in outs) == [0, 1]
+    assert all(o["world"] == 2 and o["sum"] == 3.0 for o in outs)
+
+
+@pytest.mark.chaos
+def test_sigkill_hub_member_aborts_survivors(cluster):
+    """SIGKILL the hub (rank 0) mid-allreduce: survivors' in-flight ops
+    fail fast on the dropped hub connection, and reform elects the
+    lowest surviving rank as the new hub."""
+    world = 3
+    members = [Member.remote() for _ in range(world)]
+    pids = ray_tpu.get(
+        [m.setup.remote(world, i, "gh", 30.0) for i, m in enumerate(members)]
+    )
+    deadline = 8.0
+    outs, _ = _kill_and_collect(members, "gh", 0, [1, 2], pids, deadline)
+    for out in outs.values():
+        assert out["ok"] is False
+        assert out["type"] in (
+            "CollectiveMemberDiedError", "CollectiveTimeoutError"
+        )
+        assert out["elapsed"] < deadline + 6
+    outs = ray_tpu.get(
+        [m.reform_and_allreduce.remote("gh", float(i + 1))
+         for i, m in enumerate(members[1:], start=1)],
+        timeout=45,
+    )
+    assert sorted(o["rank"] for o in outs) == [0, 1]
+    assert all(o["world"] == 2 and o["sum"] == 5.0 for o in outs)

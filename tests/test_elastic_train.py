@@ -144,3 +144,118 @@ def test_slice_death_resizes_and_resumes(two_slice_cluster, tmp_path):
     with open(os.path.join(ck, "state.json")) as f:
         final = json.load(f)
     assert final == {"epoch": 2, "world": 1}
+
+
+def _col_loop(config):
+    """Per-epoch checkpoint + a cpu-backend allreduce across the worker
+    group. On the first attempt the rank-1 victim signals readiness
+    (writing its node addr so the killer can find its slice) and never
+    contributes — the survivor's allreduce must abort typed, not hang."""
+    import numpy as np
+
+    import ray_tpu.collective as col
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    start_epoch = 0
+    ck = train.get_checkpoint()
+    if ck:
+        with open(os.path.join(ck, "state.json")) as f:
+            start_epoch = json.load(f)["epoch"] + 1
+
+    group = f"elastic_col:a{ctx.attempt}"
+    col.init_collective_group(
+        ctx.world_size, ctx.rank, backend="cpu", group_name=group,
+        timeout_s=6.0,
+    )
+    for epoch in range(start_epoch, config["epochs"]):
+        ckdir = os.path.join(
+            config["scratch"], f"rank{ctx.rank}_ep{epoch}"
+        )
+        os.makedirs(ckdir, exist_ok=True)
+        with open(os.path.join(ckdir, "state.json"), "w") as f:
+            json.dump({"epoch": epoch, "world": ctx.world_size}, f)
+        train.report(
+            {"epoch": epoch, "world": ctx.world_size}, checkpoint=ckdir
+        )
+        if epoch == 0 and ctx.world_size == 2 and ctx.rank == 1:
+            from ray_tpu import api as _api
+
+            with open(config["marker"], "w") as f:
+                f.write(_api._runtime.core.node_addr or "")
+            time.sleep(600)  # die with the slice, never contributing
+        # Mid-step collective: a member lost here must surface as a
+        # typed abort that fails the attempt fast (slice-atomic).
+        col.allreduce(
+            np.full((2,), float(ctx.rank + 1), np.float32), group_name=group
+        )
+
+
+def test_mid_allreduce_slice_death_resizes_and_resumes(
+    two_slice_cluster, tmp_path
+):
+    """Acceptance path: a collective member dies mid-allreduce → the
+    surviving rank raises a typed collective abort within the deadline →
+    the controller resizes via ElasticScalingPolicy and resumes from the
+    last checkpoint."""
+    info, nodes = two_slice_cluster
+    marker = str(tmp_path / "victim_node")
+    scratch = str(tmp_path / "ck_scratch")
+    os.makedirs(scratch, exist_ok=True)
+
+    trainer = JaxTrainer(
+        _col_loop,
+        train_loop_config={
+            "epochs": 3,
+            "marker": marker,
+            "scratch": scratch,
+        },
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            resources_per_worker={"SLICE": 1.0},
+            collective_timeout_s=6.0,
+        ),
+        scaling_policy=ElasticScalingPolicy(min_workers=1),
+        run_config=RunConfig(
+            name="elastic_col_run",
+            storage_path=str(tmp_path / "results"),
+            failure_config=FailureConfig(max_failures=3),
+        ),
+    )
+
+    import threading
+
+    def killer():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not os.path.exists(marker):
+            time.sleep(0.2)
+        with open(marker) as f:
+            victim_node_addr = f.read().strip()
+        rt = core_api._runtime
+        for node in nodes:
+            if node.addr != victim_node_addr:
+                continue
+            for w in list(node.workers.values()):
+                proc = w.get("proc")
+                if proc and proc.poll() is None:
+                    proc.kill()
+            rt.run(node.stop())
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    result = trainer.fit()
+    t.join(timeout=30)
+
+    assert result.error is None, result.error
+    assert result.metrics["world"] == 1
+    assert result.metrics["epoch"] == 2
+    ck = result.checkpoint
+    assert ck is not None
+    with open(os.path.join(ck, "state.json")) as f:
+        final = json.load(f)
+    # Resumed from the epoch-0 checkpoint at the reduced world size.
+    assert final == {"epoch": 2, "world": 1}
+    # The whole recovery — detect, abort, resize, resume — is bounded:
+    # nothing waited out a hang.
+    assert time.monotonic() - t0 < 120

@@ -103,6 +103,10 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                           "timeout_s=), per-op via the "
                                           "verb's timeout_s="),
     "TRACE": (bool, False, "enable span collection in every process"),
+    "TRAIN_TELEMETRY": (bool, True, "train step-phase spans + goodput/"
+                                    "MFU accounting (always-cheap; 0 "
+                                    "makes step_span a pinned-budget "
+                                    "no-op)"),
     "ADDRESS": (str, "", "default cluster address for init()"),
 }
 

@@ -19,6 +19,14 @@ logger = logging.getLogger("ray_tpu.autoscaler")
 from ray_tpu import api as core_api
 from ray_tpu.autoscaler.providers import NodeProvider
 from ray_tpu.autoscaler.scheduler import fit_demand
+from ray_tpu.util.metrics import Gauge
+
+_CHRONIC_STRAGGLER = Gauge(
+    "ray_tpu_autoscaler_chronic_straggler",
+    "slowest/missing collective-contribution count of a node flagged "
+    "for replacement",
+    tag_keys=("node",),
+)
 
 
 @dataclass
@@ -45,12 +53,18 @@ class Autoscaler:
         idle_timeout_s: float = 30.0,
         interval_s: float = 1.0,
         boot_grace_s: float = 600.0,
+        straggler_threshold: int = 20,
     ):
         self.provider = provider
         self.node_types = node_types
         self.idle_timeout_s = idle_timeout_s
         self.interval_s = interval_s
         self.boot_grace_s = boot_grace_s
+        # A node whose collective_straggler_total (slowest or missing
+        # contributor, summed across its ranks/groups) reaches this is
+        # flagged as a chronic straggler — replacement candidate.
+        self.straggler_threshold = straggler_threshold
+        self._flagged_stragglers: set[str] = set()
         self._tracked: dict[str, _TrackedNode] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -99,6 +113,43 @@ class Autoscaler:
             return await rt.core.head.call("cluster_status")
 
         return rt.run(go())
+
+    def _straggler_node_counts(self) -> dict[str, float]:
+        """Per-node chronic-straggler counts from the head (summed
+        collective_straggler_total resolved through the collective
+        membership table)."""
+        rt = core_api._runtime
+
+        async def go():
+            return await rt.core.head.call("collective_straggler_stats")
+
+        try:
+            return rt.run(go()).get("nodes") or {}
+        except Exception:  # noqa: BLE001 - telemetry must not stop ticks
+            return {}
+
+    def _check_stragglers(
+        self, node_counts: dict[str, float]
+    ) -> dict[str, float]:
+        """Flag chronic collective stragglers (log once + gauge). The
+        autoscaler does not kill them itself — a straggler is slow, not
+        dead, and may host other work — it surfaces the replacement
+        signal (metric + last_status) for the operator/policy layer."""
+        chronic: dict[str, float] = {}
+        for nid, count in node_counts.items():
+            if count < self.straggler_threshold:
+                continue
+            chronic[nid] = count
+            _CHRONIC_STRAGGLER.set(count, tags={"node": nid})
+            if nid not in self._flagged_stragglers:
+                self._flagged_stragglers.add(nid)
+                logger.warning(
+                    "node %s was the slowest/missing collective "
+                    "contributor %d times (threshold %d): chronic "
+                    "straggler, flagging for replacement",
+                    nid[:12], int(count), self.straggler_threshold,
+                )
+        return chronic
 
     def _launch(self, node_type: str):
         pid = self.provider.create_node(
@@ -212,5 +263,8 @@ class Autoscaler:
             "tracked": {
                 pid: t.node_type for pid, t in self._tracked.items()
             },
+            "chronic_stragglers": self._check_stragglers(
+                self._straggler_node_counts()
+            ),
         }
         return self.last_status

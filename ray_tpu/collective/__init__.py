@@ -100,7 +100,7 @@ def init_collective_group(
     elif backend is Backend.XLA_MESH:
         from ray_tpu.collective.backends.xla_group import XlaMeshGroup
 
-        g = XlaMeshGroup()
+        g = XlaMeshGroup(name=group_name)
         if g.world != world_size:
             raise ValueError(
                 f"xla_mesh backend: {g.world} local devices != "
@@ -119,7 +119,7 @@ def init_collective_group(
             )
         )
         _groups[group_name] = XlaDistGroup(
-            world_size, rank, timeout_s=timeout_s
+            world_size, rank, timeout_s=timeout_s, name=group_name
         )
     else:
         raise ValueError(f"unsupported backend {backend}")
@@ -196,7 +196,16 @@ def _dispatch(name: str, group_name: str, *args, **kw):
     import inspect
 
     if inspect.iscoroutinefunction(fn):
-        return _runtime().run(fn(*args, **kw))
+        from ray_tpu.util import tracing
+
+        coro = fn(*args, **kw)
+        # Carry the caller's trace context onto the runtime loop so the
+        # flight recorder's op span parents under the issuing task
+        # (contextvars do not cross run_coroutine_threadsafe).
+        ctx = tracing._active()
+        if ctx is not None:
+            coro = tracing.carry_context(coro, ctx)
+        return _runtime().run(coro)
     return fn(*args, **kw)
 
 

@@ -47,6 +47,7 @@ import numpy as np
 
 from ray_tpu._private import rpc
 from ray_tpu._private.serialization import deserialize, serialize
+from ray_tpu.collective.flight_recorder import record_op
 from ray_tpu.collective.types import (
     CollectiveGroupDestroyedError,
     CollectiveMemberDiedError,
@@ -506,6 +507,8 @@ class CpuGroup:
         t = self.timeout_s if timeout_s is None else float(timeout_s)
         self._seq += 1
         seq = self._seq
+        wall_start = time.time()
+        t0 = time.perf_counter()
         try:
             conn = await self.core._connect(self.root_addr)
         except rpc.ConnectionLost:
@@ -552,7 +555,12 @@ class CpuGroup:
             )
         finally:
             self._inflight.discard(call)
-        return self._interpret(kind, reply)
+        result = self._interpret(kind, reply)
+        record_op(
+            self.base_name, kind, "cpu", self.world, tensor,
+            wall_start, time.perf_counter() - t0,
+        )
+        return result
 
     async def allreduce(self, tensor, op=ReduceOp.SUM, timeout_s=None):
         return await self._op(
@@ -608,6 +616,9 @@ class CpuGroup:
     async def send(self, tensor, dst_rank: int, seq: int = 0, timeout_s=None):
         self._check_alive("send")
         t = self.timeout_s if timeout_s is None else float(timeout_s)
+        arr = np.asarray(tensor)
+        wall_start = time.time()
+        t0 = time.perf_counter()
 
         async def _send():
             reply = await self.core.head.call(
@@ -622,7 +633,7 @@ class CpuGroup:
                 f"col_sendrecv:{self.name}",
                 src_rank=self.rank,
                 seq=seq,
-                payload=_pack(np.asarray(tensor)),
+                payload=_pack(arr),
             )
 
         try:
@@ -631,18 +642,30 @@ class CpuGroup:
             raise CollectiveTimeoutError(
                 self.base_name, "send", t, missing_ranks=[dst_rank]
             )
+        record_op(
+            self.base_name, "send", "cpu", self.world, arr,
+            wall_start, time.perf_counter() - t0,
+        )
 
     async def recv(self, src_rank: int, seq: int = 0, timeout_s=None):
         self._check_alive("recv")
         t = self.timeout_s if timeout_s is None else float(timeout_s)
+        wall_start = time.time()
+        t0 = time.perf_counter()
         payloads, waiters = self._mail_queues((src_rank, seq))
         if payloads:
-            return _unpack(payloads.popleft())
-        fut = asyncio.get_running_loop().create_future()
-        waiters.append(fut)
-        try:
-            return _unpack(await asyncio.wait_for(fut, t))
-        except asyncio.TimeoutError:
-            raise CollectiveTimeoutError(
-                self.base_name, "recv", t, missing_ranks=[src_rank]
-            )
+            result = _unpack(payloads.popleft())
+        else:
+            fut = asyncio.get_running_loop().create_future()
+            waiters.append(fut)
+            try:
+                result = _unpack(await asyncio.wait_for(fut, t))
+            except asyncio.TimeoutError:
+                raise CollectiveTimeoutError(
+                    self.base_name, "recv", t, missing_ranks=[src_rank]
+                )
+        record_op(
+            self.base_name, "recv", "cpu", self.world, result,
+            wall_start, time.perf_counter() - t0,
+        )
+        return result

@@ -20,6 +20,8 @@ Two flavors:
 from __future__ import annotations
 
 import asyncio
+import functools
+import time
 from functools import partial
 from typing import Any, Sequence
 
@@ -29,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu.collective.flight_recorder import record_op
 from ray_tpu.collective.types import (
     CollectiveTimeoutError,
     ReduceOp,
@@ -47,6 +50,37 @@ def _default_timeout() -> float:
     return config.get("COLLECTIVE_TIMEOUT_S")
 
 
+def _recorded(verb: str):
+    """Flight-recorder wrapper for an eager verb: latency + bytes +
+    bus-bandwidth metrics and a timeline SPAN on success. Reentrancy-
+    guarded per group — verbs that lower onto other verbs (reduce →
+    allreduce, barrier → allreduce, non-sum reducescatter) record only
+    the outermost call."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kw):
+            if self._in_recorded_op:
+                return fn(self, *args, **kw)
+            self._in_recorded_op = True
+            wall_start = time.time()
+            t0 = time.perf_counter()
+            try:
+                out = fn(self, *args, **kw)
+            finally:
+                self._in_recorded_op = False
+            record_op(
+                self.name, verb, self.backend_tag, self.world,
+                args[0] if args else None,
+                wall_start, time.perf_counter() - t0,
+            )
+            return out
+
+        return wrapper
+
+    return deco
+
+
 class XlaMeshGroup:
     """Eager collectives over the devices visible to this process.
 
@@ -55,12 +89,19 @@ class XlaMeshGroup:
     results."""
 
     expects_per_rank_tensors = True
+    backend_tag = "xla_mesh"
 
-    def __init__(self, devices: Sequence[jax.Device] | None = None):
+    def __init__(
+        self,
+        devices: Sequence[jax.Device] | None = None,
+        name: str = "xla_mesh",
+    ):
         self.devices = list(devices if devices is not None else jax.devices())
         self.world = len(self.devices)
+        self.name = name
         self.mesh = Mesh(np.array(self.devices), ("ranks",))
         self._programs: dict[tuple, Any] = {}
+        self._in_recorded_op = False
 
     # ------------------------------------------------------------ plumbing
     def _stack(self, tensors: Sequence[Any]) -> jax.Array:
@@ -96,6 +137,7 @@ class XlaMeshGroup:
     # timeout_s is accepted for API parity with the fault-tolerant
     # backends: in-process device collectives either complete or raise —
     # there is no remote member to wait on.
+    @_recorded("allreduce")
     def allreduce(
         self, tensors: Sequence[Any], op=ReduceOp.SUM, timeout_s=None
     ) -> list:
@@ -120,6 +162,7 @@ class XlaMeshGroup:
             )
         return self._unstack(prog(x))
 
+    @_recorded("broadcast")
     def broadcast(
         self, tensors: Sequence[Any], root: int = 0, timeout_s=None
     ) -> list:
@@ -127,6 +170,7 @@ class XlaMeshGroup:
         src = jnp.asarray(tensors[root])
         return [jax.device_put(src, d) for d in self.devices]
 
+    @_recorded("allgather")
     def allgather(self, tensors: Sequence[Any], timeout_s=None) -> list:
         del timeout_s
         x = self._stack(tensors)
@@ -144,6 +188,7 @@ class XlaMeshGroup:
         )
         return self._unstack(prog(x))
 
+    @_recorded("reducescatter")
     def reducescatter(
         self, tensors: Sequence[Any], op=ReduceOp.SUM, timeout_s=None
     ) -> list:
@@ -174,6 +219,7 @@ class XlaMeshGroup:
             r[i * chunk : (i + 1) * chunk] for i, r in enumerate(reduced)
         ]
 
+    @_recorded("permute")
     def permute(self, tensors: Sequence[Any], perm: list[tuple[int, int]]):
         """collective_permute: the P2P primitive TPU channels are built on
         (replaces NCCL send/recv, reference: nccl_group.py)."""
@@ -187,6 +233,7 @@ class XlaMeshGroup:
         )
         return self._unstack(prog(x))
 
+    @_recorded("reduce")
     def reduce(
         self, tensors: Sequence[Any], root: int = 0, op=ReduceOp.SUM,
         timeout_s=None,
@@ -204,6 +251,7 @@ class XlaMeshGroup:
 
     recv = send
 
+    @_recorded("barrier")
     def barrier(self, timeout_s=None):
         del timeout_s
         ones = [jnp.zeros((), jnp.int32) for _ in range(self.world)]
@@ -224,12 +272,19 @@ class XlaDistGroup:
     """
 
     expects_per_rank_tensors = False
+    backend_tag = "xla_dist"
 
     def __init__(
-        self, world_size: int, rank: int, timeout_s: float | None = None
+        self,
+        world_size: int,
+        rank: int,
+        timeout_s: float | None = None,
+        name: str = "xla_dist",
     ):
         self.world = world_size
         self.rank = rank
+        self.name = name
+        self._in_recorded_op = False
         self.timeout_s = (
             _default_timeout() if timeout_s is None else float(timeout_s)
         )
@@ -299,6 +354,7 @@ class XlaDistGroup:
                        "recover",
             )
 
+    @_recorded("allreduce")
     def allreduce(self, tensor, op=ReduceOp.SUM, timeout_s=None):
         x = self._global(tensor)
         psum = _PSUM_OPS[op]
@@ -309,6 +365,7 @@ class XlaDistGroup:
         )
         return self._local(self._sync(out, "allreduce", timeout_s))
 
+    @_recorded("allgather")
     def allgather(self, tensor, timeout_s=None):
         x = self._global(tensor)
         out = self._run(
@@ -320,12 +377,14 @@ class XlaDistGroup:
         )
         return self._local(self._sync(out, "allgather", timeout_s))
 
+    @_recorded("broadcast")
     def broadcast(self, tensor, root: int = 0, timeout_s=None):
         gathered = self.allgather(
             jnp.asarray(tensor)[None], timeout_s=timeout_s
         )
         return gathered[root]
 
+    @_recorded("reducescatter")
     def reducescatter(self, tensor, op=ReduceOp.SUM, timeout_s=None):
         x = self._global(tensor)
         if op is ReduceOp.SUM:
@@ -341,6 +400,7 @@ class XlaDistGroup:
         chunk = full.shape[0] // self.world
         return full[self.rank * chunk : (self.rank + 1) * chunk]
 
+    @_recorded("barrier")
     def barrier(self, timeout_s=None):
         self.allreduce(jnp.zeros((), jnp.int32), timeout_s=timeout_s)
 

@@ -1,0 +1,105 @@
+"""Collective flight recorder: always-on, per-op telemetry.
+
+Every collective verb (CPU hub-reduce and the XLA backends) records the
+member-visible op latency into a Histogram, the per-rank payload bytes
+into a Counter, and the derived achieved *bus* bandwidth into a Gauge —
+the attribution layer papers like "Efficient AllReduce with Stragglers"
+(arxiv 2505.23523) and T3 (arxiv 2401.16677) assume exists. Each op also
+emits a SPAN event onto the task-event pipeline so `ray_tpu timeline`
+renders collective ops as slices alongside tasks (and, when the caller
+runs under a trace context, parented to the issuing task's span).
+
+Bus bandwidth follows the nccl-tests convention: busbw = algbw × a
+verb-specific factor of the world size, where algbw = per-rank bytes /
+op time. That makes numbers comparable across verbs and world sizes
+(an allreduce moving N bytes/rank does ~2(n-1)/n × N of wire traffic).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+OP_LATENCY = Histogram(
+    "ray_tpu_collective_op_latency_seconds",
+    "member-visible collective op latency",
+    boundaries=(
+        0.00005, 0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+        1.0, 5.0, 30.0,
+    ),
+    tag_keys=("group", "verb", "backend"),
+)
+OP_BYTES = Counter(
+    "ray_tpu_collective_bytes_total",
+    "per-rank payload bytes moved by collective ops",
+    tag_keys=("group", "verb", "dtype"),
+)
+BUS_BANDWIDTH = Gauge(
+    "ray_tpu_collective_bus_bandwidth_bytes_per_s",
+    "achieved bus bandwidth of the most recent collective op "
+    "(nccl-tests busbw convention)",
+    tag_keys=("group", "verb", "dtype"),
+)
+
+# verb → busbw factor as a function of world size (nccl-tests
+# performance docs); verbs without an entry (send/recv/permute/
+# broadcast/reduce) move each byte once → factor 1.
+_BUS_FACTORS = {
+    "allreduce": lambda n: 2.0 * (n - 1) / n,
+    "allgather": lambda n: (n - 1) / n,
+    "reducescatter": lambda n: (n - 1) / n,
+}
+
+
+def payload_info(tensor) -> tuple[int, str]:
+    """(per-rank bytes, dtype string) of an op payload. A sequence of
+    per-rank tensors (the single-controller mesh backend) reports one
+    rank's slice — bandwidth math is per-rank by convention."""
+    if tensor is None:
+        return 0, "none"
+    if isinstance(tensor, (list, tuple)):
+        if not tensor:
+            return 0, "none"
+        tensor = tensor[0]
+    nbytes = getattr(tensor, "nbytes", None)
+    dtype = getattr(tensor, "dtype", None)
+    if nbytes is None:
+        try:
+            import numpy as np
+
+            arr = np.asarray(tensor)
+            nbytes, dtype = arr.nbytes, arr.dtype
+        except Exception:  # noqa: BLE001 - unknown payload: size-less
+            return 0, "unknown"
+    return int(nbytes), str(dtype) if dtype is not None else "unknown"
+
+
+def record_op(
+    group: str,
+    verb: str,
+    backend: str,
+    world: int,
+    tensor,
+    start: float,
+    dur: float,
+) -> None:
+    """Record one completed collective op (success path only — aborts
+    and timeouts are counted by the fault-tolerance counters)."""
+    nbytes, dtype = payload_info(tensor)
+    OP_LATENCY.observe(
+        dur, tags={"group": group, "verb": verb, "backend": backend}
+    )
+    attrs: dict = {"group": group, "verb": verb, "backend": backend}
+    if nbytes:
+        tags = {"group": group, "verb": verb, "dtype": dtype}
+        OP_BYTES.inc(nbytes, tags=tags)
+        attrs["bytes"] = nbytes
+        attrs["dtype"] = dtype
+        if dur > 0:
+            factor = _BUS_FACTORS.get(verb)
+            bus = (factor(world) if factor and world else 1.0) * (
+                nbytes / dur
+            )
+            BUS_BANDWIDTH.set(bus, tags=tags)
+            attrs["bus_bytes_per_s"] = round(bus, 1)
+    tracing.emit_span(f"collective:{verb}", start, dur, **attrs)

@@ -52,6 +52,13 @@ def _pgs():
     return state.list_placement_groups()
 
 
+@_route("/api/train")
+def _train():
+    """Per-train-job goodput/MFU (head train-step accounting), incl.
+    time lost to elastic attempt restarts."""
+    return state.train_stats()
+
+
 _job_client = None
 _job_client_lock = threading.Lock()
 

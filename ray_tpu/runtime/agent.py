@@ -12,6 +12,7 @@ Endpoints:
     /api/stats       resources, store usage, spill/oom counters
     /api/logs        worker log listing (node-local files)
     /api/logs/<wid>  one worker's log (raw text, ?tail=N bytes)
+    /api/train       per-job train goodput (head passthrough)
     /metrics         node-local Prometheus text
 """
 
@@ -117,6 +118,14 @@ class NodeAgent:
                 return data.decode("utf-8", "replace")
         return None
 
+    async def _train(self, query) -> dict:
+        """Head passthrough: per-job train goodput, answerable from any
+        node's agent (operators probing a node don't need the driver
+        dashboard up)."""
+        if self.node.head is None:
+            return {"error": "node has no head connection"}
+        return await self.node.head.call("train_stats")
+
     def _metrics(self, query) -> str:
         s = self._stats(query)
         lines = [
@@ -169,6 +178,11 @@ class NodeAgent:
                     await self._send(writer, 404, b"no such worker log")
                     return
                 body, ctype = text, "text/plain"
+            elif path == "/api/train":
+                body, ctype = (
+                    json.dumps(await self._train(query)),
+                    "application/json",
+                )
             elif path == "/metrics":
                 body, ctype = self._metrics(query), "text/plain; version=0.0.4"
             else:

@@ -1360,20 +1360,27 @@ class CoreWorker:
         except Exception:  # noqa: BLE001 - observability is best-effort
             pass
 
-    async def _flush_events_loop(self):
+    async def flush_observability(self):
+        """Eagerly drain buffered task events and push a metrics
+        snapshot — the 1 Hz loop's work, on demand. Called at moments
+        the process may be about to die (a train attempt ending), so
+        the last second of spans/metrics isn't lost with the worker."""
         from ray_tpu.util import metrics as _metrics
 
+        await self._flush_events()
+        snap = _metrics.snapshot()
+        if snap:
+            try:
+                await self.head.call(
+                    "report_metrics", worker=self.addr, metrics=snap
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _flush_events_loop(self):
         while True:
             await asyncio.sleep(1.0)
-            await self._flush_events()
-            snap = _metrics.snapshot()
-            if snap:
-                try:
-                    await self.head.call(
-                        "report_metrics", worker=self.addr, metrics=snap
-                    )
-                except Exception:  # noqa: BLE001
-                    pass
+            await self.flush_observability()
 
     async def _drive_normal_task(
         self, spec, oids, resources, retries, placement=None,

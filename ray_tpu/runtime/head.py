@@ -57,6 +57,13 @@ class HeadService:
         self.task_latest: collections.OrderedDict = collections.OrderedDict()
         # worker addr → latest metrics snapshot {name: record}
         self.metrics: dict[str, dict] = {}
+        # Per-train-job goodput accounting, folded from rank-0
+        # "train:step" SPAN events as they arrive on the task-event
+        # pipeline: productive step time vs. time lost to stalls
+        # (inter-step gaps, data wait, checkpointing) and to elastic
+        # attempt restarts (gap between the last step of attempt N and
+        # the first step of attempt N+1).
+        self.train_runs: dict[str, dict] = {}
         # Collective-group membership (the fault-tolerance layer's view):
         # group → {"epoch": int, "members": {rank: {addr, node_addr,
         # worker_id, dead}}}. Node/worker death fans out to survivors on
@@ -865,6 +872,42 @@ class HeadService:
                     },
                 )
 
+    async def _on_collective_straggler_stats(self, conn):
+        """Straggler telemetry aggregated to NODES: sum the hub-reported
+        collective_straggler_total series across worker snapshots and
+        resolve each (group, rank) to its member's node through the
+        membership table. This is the autoscaler's chronic-straggler
+        signal — a node that is repeatedly the slowest (or missing)
+        contributor is a replacement candidate before it becomes a
+        timeout."""
+        from ray_tpu.util.metrics import parse_tag_str
+
+        per_pair: dict[tuple[str, str], float] = {}
+        for rec in self.metrics.values():
+            m = rec["snap"].get("collective_straggler_total")
+            if not m:
+                continue
+            for tag_str, val in m.get("series", {}).items():
+                tags = parse_tag_str(tag_str)
+                key = (tags.get("group", ""), tags.get("rank", ""))
+                per_pair[key] = per_pair.get(key, 0.0) + float(val)
+        nodes: dict[str, float] = {}
+        groups: dict[str, dict] = {}
+        addr_to_nid = {n["addr"]: nid for nid, n in self.nodes.items()}
+        for (group, rank), val in per_pair.items():
+            groups.setdefault(group, {})[rank] = val
+            members = self.collective_members.get(group, {}).get(
+                "members", {}
+            )
+            try:
+                node_addr = members.get(int(rank), {}).get("node_addr")
+            except (TypeError, ValueError):
+                node_addr = None
+            nid = addr_to_nid.get(node_addr) if node_addr else None
+            if nid is not None:
+                nodes[nid] = nodes.get(nid, 0.0) + val
+        return {"ok": True, "nodes": nodes, "groups": groups}
+
     async def _on_collective_probe(
         self, conn, group: str, ranks=None
     ):
@@ -1123,8 +1166,12 @@ class HeadService:
             self.task_events.append(ev)
             tid = ev.get("task_id")
             if ev.get("state") == "SPAN":
-                continue  # spans live in the raw stream only, not the
-                # merged task table (they would evict real task states)
+                # Spans live in the raw stream only, not the merged task
+                # table (they would evict real task states). Rank-0 train
+                # step spans additionally drive per-job goodput.
+                if ev.get("name") == "train:step" and ev.get("train_job"):
+                    self._train_step_event(ev)
+                continue
             if tid:
                 prev = self.task_latest.pop(tid, None)
                 merged = dict(prev or {})
@@ -1143,12 +1190,162 @@ class HeadService:
         return {"ok": True}
 
     async def _on_list_task_events(
-        self, conn, limit: int = 1000, raw: bool = False
+        self,
+        conn,
+        limit: int = 1000,
+        raw: bool = False,
+        state: str | None = None,
     ):
+        """`state` filters BEFORE `limit` applies: a span query must not
+        come back empty just because busy task traffic fills the
+        newest-N window."""
         if raw:
-            return {"events": list(self.task_events)[-limit:]}
-        items = list(self.task_latest.values())[-limit:]
-        return {"events": items}
+            events = list(self.task_events)
+            if state is not None:
+                events = [e for e in events if e.get("state") == state]
+            return {"events": events[-limit:]}
+        items = list(self.task_latest.values())
+        if state is not None:
+            items = [e for e in items if e.get("state") == state]
+        return {"events": items[-limit:]}
+
+    # ------------------------------------------------- train goodput
+    def _train_step_event(self, ev: dict) -> None:
+        """Fold one rank-0 train-step span into the job's goodput
+        ledger. Attempt boundaries (TrainContext.attempt) mark elastic
+        restarts: the wall-clock hole between attempts is restart-lost
+        time, including any partial step the dying attempt never
+        finished."""
+        if ev.get("train_rank") != 0:
+            return
+        job = str(ev["train_job"])
+        rec = self.train_runs.get(job)
+        if rec is None:
+            if len(self.train_runs) >= 200:
+                oldest = min(
+                    self.train_runs, key=lambda j: self.train_runs[j]["first_ts"]
+                )
+                del self.train_runs[oldest]
+            rec = self.train_runs[job] = {
+                "attempt": -1,
+                "attempts_seen": 0,
+                "steps": 0,
+                "productive_s": 0.0,
+                "stall_s": 0.0,
+                "restart_lost_s": 0.0,
+                "first_ts": float(ev.get("ts") or 0.0),
+                "last_end_ts": None,
+                "mfu": None,
+                "phase_s": {},
+            }
+        try:
+            attempt = int(ev.get("train_attempt") or 0)
+            start = float(ev["ts"])
+            dur = max(0.0, float(ev.get("dur") or 0.0))
+        except (TypeError, ValueError):
+            return
+        if attempt < rec["attempt"]:
+            return  # straggling flush from a superseded attempt
+        if attempt > rec["attempt"]:
+            if rec["attempt"] >= 0 and rec["last_end_ts"] is not None:
+                rec["restart_lost_s"] += max(
+                    0.0, start - rec["last_end_ts"]
+                )
+            rec["attempt"] = attempt
+            rec["attempts_seen"] += 1
+        elif rec["last_end_ts"] is not None:
+            # Same attempt: the hole between consecutive steps is stall.
+            rec["stall_s"] += max(0.0, start - rec["last_end_ts"])
+        phases = ev.get("phases") or {}
+        in_step_lost = 0.0
+        for ph, s in phases.items():
+            try:
+                s = float(s)
+            except (TypeError, ValueError):
+                continue
+            rec["phase_s"][ph] = rec["phase_s"].get(ph, 0.0) + s
+            if ph in ("data_wait", "checkpoint"):
+                in_step_lost += s
+        in_step_lost = min(in_step_lost, dur)
+        rec["steps"] += 1
+        rec["productive_s"] += dur - in_step_lost
+        rec["stall_s"] += in_step_lost
+        if isinstance(ev.get("mfu"), (int, float)):
+            rec["mfu"] = float(ev["mfu"])
+        rec["last_end_ts"] = max(rec["last_end_ts"] or 0.0, start + dur)
+
+    @staticmethod
+    def _train_job_public(rec: dict) -> dict:
+        denom = (
+            rec["productive_s"] + rec["stall_s"] + rec["restart_lost_s"]
+        )
+        return {
+            "goodput": rec["productive_s"] / denom if denom > 0 else 1.0,
+            "productive_s": rec["productive_s"],
+            "stall_s": rec["stall_s"],
+            "restart_lost_s": rec["restart_lost_s"],
+            "steps": rec["steps"],
+            "attempts": rec["attempts_seen"],
+            "current_attempt": rec["attempt"],
+            "mfu": rec["mfu"],
+            "phase_s": dict(rec["phase_s"]),
+            "first_ts": rec["first_ts"],
+            "last_ts": rec["last_end_ts"],
+        }
+
+    async def _on_train_stats(self, conn):
+        """Per-job goodput/MFU rollup (dashboard /api/train, agent
+        passthrough, `ray_tpu goodput`)."""
+        return {
+            "jobs": {
+                job: self._train_job_public(rec)
+                for job, rec in self.train_runs.items()
+            }
+        }
+
+    def _train_metrics_snapshot(self) -> dict | None:
+        """Head-owned train gauges in worker-snapshot format, merged
+        into cluster_metrics under the pseudo-worker "head" — goodput
+        survives the workers (and attempts) it is computed from."""
+        if not self.train_runs:
+            return None
+        from ray_tpu.util.metrics import escape_label_value as _esc
+
+        gp: dict[str, float] = {}
+        lost: dict[str, float] = {}
+        mfu: dict[str, float] = {}
+        for job, rec in self.train_runs.items():
+            pub = self._train_job_public(rec)
+            tag = f'job="{_esc(job)}"'
+            gp[tag] = round(pub["goodput"], 6)
+            lost[tag] = round(rec["restart_lost_s"], 6)
+            if rec["mfu"] is not None:
+                mfu[tag] = rec["mfu"]
+        out = {
+            "ray_tpu_train_goodput_ratio": {
+                "kind": "gauge",
+                "description": "productive step time / (productive + "
+                               "stalls + restart loss) per train job",
+                "series": gp,
+                "boundaries": None,
+            },
+            "ray_tpu_train_restart_lost_seconds": {
+                "kind": "gauge",
+                "description": "wall time lost to elastic attempt "
+                               "restarts per train job",
+                "series": lost,
+                "boundaries": None,
+            },
+        }
+        if mfu:
+            out["ray_tpu_train_mfu"] = {
+                "kind": "gauge",
+                "description": "model FLOPs utilization of this "
+                               "worker's most recent step",
+                "series": mfu,
+                "boundaries": None,
+            }
+        return out
 
     METRICS_TTL_S = 60.0
 
@@ -1164,9 +1361,11 @@ class HeadService:
         for w, rec in list(self.metrics.items()):
             if now - rec["ts"] > self.METRICS_TTL_S:
                 del self.metrics[w]
-        return {
-            "workers": {w: rec["snap"] for w, rec in self.metrics.items()}
-        }
+        workers = {w: rec["snap"] for w, rec in self.metrics.items()}
+        train_snap = self._train_metrics_snapshot()
+        if train_snap:
+            workers["head"] = train_snap
+        return {"workers": workers}
 
     # ----------------------------------------------------------- health
     async def _remove_node(self, nid: str):

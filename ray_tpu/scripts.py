@@ -131,6 +131,42 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_goodput(args) -> int:
+    """Per-train-job goodput rollup: productive step time vs. stalls
+    and elastic restart loss, plus MFU and phase breakdowns (the head's
+    train-step accounting; same data as the dashboard's /api/train)."""
+    from ray_tpu.util import state
+
+    _connect(args.address, getattr(args, "session_dir", None))
+    jobs = state.train_stats().get("jobs", {})
+    if args.json:
+        json.dump(jobs, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    if not jobs:
+        print("no train jobs have reported steps")
+        return 0
+    for name, j in sorted(jobs.items()):
+        mfu = (
+            f"  mfu={j['mfu']:.4f}" if j.get("mfu") is not None else ""
+        )
+        print(
+            f"{name}: goodput={j['goodput']:.3f}  steps={j['steps']}  "
+            f"attempts={j['attempts']}{mfu}"
+        )
+        print(
+            f"  productive={j['productive_s']:.2f}s  "
+            f"stalls={j['stall_s']:.2f}s  "
+            f"restart_lost={j['restart_lost_s']:.2f}s"
+        )
+        if j.get("phase_s"):
+            phases = "  ".join(
+                f"{k}={v:.2f}s" for k, v in sorted(j["phase_s"].items())
+            )
+            print(f"  phases: {phases}")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     import time
 
@@ -442,6 +478,9 @@ def main(argv=None) -> int:
     tp = sub.add_parser("timeline")
     tp.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
     sub.add_parser("metrics")
+    gp = sub.add_parser("goodput")
+    gp.add_argument("--json", action="store_true",
+                    help="raw per-job stats as JSON")
     lg = sub.add_parser("logs")
     lg.add_argument("worker_id", nargs="?", default=None,
                     help="worker-id prefix; omit to list all logs")
@@ -459,6 +498,7 @@ def main(argv=None) -> int:
         "list": cmd_list,
         "timeline": cmd_timeline,
         "metrics": cmd_metrics,
+        "goodput": cmd_goodput,
         "logs": cmd_logs,
         "dashboard": cmd_dashboard,
         "config": cmd_config,

@@ -22,6 +22,7 @@ from ray_tpu.train.session import (
     get_context,
     get_dataset_shard,
     report,
+    step_span,
 )
 from ray_tpu.train.trainer import (
     ElasticScalingPolicy,
@@ -48,6 +49,7 @@ __all__ = [
     "get_context",
     "get_dataset_shard",
     "report",
+    "step_span",
     "ElasticScalingPolicy",
     "FailureConfig",
     "JaxTrainer",

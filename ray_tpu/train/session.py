@@ -5,8 +5,10 @@ ray.train.get_context(), python/ray/train/v2/_internal/execution/context).
 
 from __future__ import annotations
 
+import contextlib
 import os
 import shutil
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -31,6 +33,13 @@ class TrainContext:
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)
+    # step-telemetry bookkeeping (train/telemetry.py): set by the
+    # trainer at loop start / mutated as steps close
+    _loop_start_wall: float | None = None
+    _last_report_wall: float | None = None
+    _last_checkpoint_s: float = 0.0
+    _step_index: int = 0
+    _used_step_timer: bool = False
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -98,12 +107,49 @@ def get_dataset_shard(name: str = "train"):
     return MaterializedDataset(list(refs))
 
 
+@contextlib.contextmanager
+def step_span(
+    flops: float | None = None,
+    tokens: int | None = None,
+    flops_per_token: float | None = None,
+):
+    """Wrap one training step for phase attribution, MFU, and goodput.
+
+    ::
+
+        with train.step_span(tokens=8192, flops_per_token=6 * n_params) as s:
+            with s.phase("data_wait"):
+                batch = next(it)
+            with s.phase("compute"):
+                state, m = train_step(state, batch)
+
+    Phase durations feed the ``ray_tpu_train_step_phase_seconds``
+    histogram and render as slices in ``ray_tpu timeline``; the step's
+    FLOP count (``flops``, or ``tokens * flops_per_token``) yields
+    per-step MFU. Phases named ``data_wait`` / ``checkpoint`` count as
+    lost time in the head's per-job goodput. A no-op outside a train
+    session or with RAY_TPU_TRAIN_TELEMETRY=0; a step that raises emits
+    nothing (its time surfaces as restart loss, not productive time)."""
+    ctx = _context
+    from ray_tpu.train import telemetry
+
+    if ctx is None or not telemetry.telemetry_enabled():
+        yield telemetry.NOOP_STEP
+        return
+    if flops is None and tokens is not None and flops_per_token is not None:
+        flops = tokens * flops_per_token
+    timer = telemetry.StepTimer(flops)
+    yield timer
+    telemetry.finish_step(ctx, timer)
+
+
 def report(metrics: dict, checkpoint: str | None = None) -> None:
     """Report metrics (all ranks) and optionally a checkpoint directory
     (rank 0's is persisted; reference: ray.train.report semantics)."""
     ctx = get_context()
     ctx.latest_metrics = dict(metrics)
     entry: dict[str, Any] = {"metrics": dict(metrics)}
+    ctx._last_checkpoint_s = 0.0
     if checkpoint is not None and ctx.rank == 0:
         # Index continues from what's already persisted so a retry attempt
         # appends after the restored checkpoint instead of overwriting
@@ -115,11 +161,21 @@ def report(metrics: dict, checkpoint: str | None = None) -> None:
             for p in os.listdir(run_dir)
             if p.startswith("checkpoint_")
         ]
-        step = max(existing, default=-1) + 1
-        dest = os.path.join(run_dir, f"checkpoint_{step:06d}")
+        idx = max(existing, default=-1) + 1
+        dest = os.path.join(run_dir, f"checkpoint_{idx:06d}")
+        ckpt_t0 = time.perf_counter()
         if os.path.abspath(checkpoint) != os.path.abspath(dest):
             if os.path.exists(dest):
                 shutil.rmtree(dest)
             shutil.copytree(checkpoint, dest)
+        ctx._last_checkpoint_s = time.perf_counter() - ckpt_t0
         entry["checkpoint"] = dest
     ctx.reports.append(entry)
+    # Loops that never call train.step_span() still get goodput accounting:
+    # each report() closes one implicit step (checkpoint copy included).
+    from ray_tpu.train import telemetry
+
+    now = time.time()
+    if not ctx._used_step_timer and telemetry.telemetry_enabled():
+        telemetry.implicit_step(ctx, now, metrics)
+    ctx._last_report_wall = now

@@ -1,0 +1,218 @@
+"""Train-step telemetry: phase spans, per-step MFU, goodput events.
+
+Always-cheap instrumentation for the train loop (reference intent:
+ray.train's TrainingReport/metrics plumbing plus the per-step profiling
+the BENCH/PROFILE rounds hand-rolled). A step is wrapped by
+``ray_tpu.train.step_span()`` (or closed implicitly by ``report()``); on
+completion it
+
+- observes per-phase durations into ``ray_tpu_train_step_phase_seconds``
+  (data-wait / compute / collective / checkpoint / whole step),
+- computes per-step MFU from the step's FLOP count against the chip
+  generation's peak (the same table bench.py normalizes with) and sets
+  ``ray_tpu_train_mfu``,
+- emits ``train:step`` / ``train:<phase>`` SPAN events onto the
+  task-event pipeline. Rank 0's step spans are what the head folds into
+  per-job **goodput** (productive step time vs. time lost to stalls and
+  attempt restarts — see HeadService._train_step_event); all ranks'
+  spans render as slices in ``ray_tpu timeline``.
+
+Disable with RAY_TPU_TRAIN_TELEMETRY=0: ``step()`` then hands back a
+shared no-op timer whose overhead a perf-floor test pins
+(tests/test_perf_floors.py), so telemetry can never quietly tax the
+train loop.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+# Peak bf16 FLOP/s per chip by TPU generation (public spec sheets; the
+# same table bench.py uses for its vs_baseline normalization).
+PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+}
+DEFAULT_PEAK_FLOPS = 197e12
+
+STEP_PHASE_SECONDS = Histogram(
+    "ray_tpu_train_step_phase_seconds",
+    "train step time by phase ('step' = the whole step)",
+    boundaries=(
+        0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0, 300.0,
+    ),
+    tag_keys=("job", "phase"),
+)
+MFU_GAUGE = Gauge(
+    "ray_tpu_train_mfu",
+    "model FLOPs utilization of this worker's most recent step",
+    tag_keys=("job",),
+)
+STEPS_TOTAL = Counter(
+    "ray_tpu_train_steps_total",
+    "completed train steps",
+    tag_keys=("job",),
+)
+
+
+def telemetry_enabled() -> bool:
+    from ray_tpu._private import config
+
+    return config.get("TRAIN_TELEMETRY")
+
+
+def peak_flops_per_chip() -> float:
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    except Exception:  # noqa: BLE001 - no jax/devices: proxy peak
+        return DEFAULT_PEAK_FLOPS
+    for name, flops in PEAK_FLOPS.items():
+        if name in kind:
+            return flops
+    return DEFAULT_PEAK_FLOPS
+
+
+class _NoopPhase:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class NoopStepTimer:
+    """Disabled path: attribute-compatible with StepTimer, shared and
+    allocation-free."""
+
+    __slots__ = ()
+    phases: dict = {}
+    _noop = _NoopPhase()
+
+    def phase(self, name: str):
+        return self._noop
+
+
+NOOP_STEP = NoopStepTimer()
+
+
+class StepTimer:
+    """Measures one train step and its phases. Phase timing is a
+    perf_counter pair and a dict store; span/metric emission happens
+    once, at step end (finish_step)."""
+
+    __slots__ = ("phases", "flops", "start", "_t0", "_events")
+
+    def __init__(self, flops: float | None = None):
+        self.phases: dict[str, float] = {}
+        self.flops = flops
+        self.start = time.time()
+        self._t0 = time.perf_counter()
+        # (name, wall_start, dur) per phase invocation, for timeline
+        # slices placed at their true offsets.
+        self._events: list[tuple[str, float, float]] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        wall = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            d = time.perf_counter() - t0
+            self.phases[name] = self.phases.get(name, 0.0) + d
+            self._events.append((name, wall, d))
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self._t0
+
+
+def compute_mfu(flops: float | None, dur: float) -> float | None:
+    if not flops or dur <= 0:
+        return None
+    try:
+        import jax
+
+        n_chips = max(1, len(jax.devices()))
+    except Exception:  # noqa: BLE001
+        n_chips = 1
+    return flops / (dur * peak_flops_per_chip() * n_chips)
+
+
+def finish_step(ctx, timer: StepTimer) -> None:
+    """Close a completed step: metrics + SPAN emission + context
+    bookkeeping. Called only on the step's success path — a step that
+    raised must not count as productive time (its tail shows up as
+    restart loss in the head's goodput accounting instead)."""
+    dur = timer.elapsed()
+    job = ctx.experiment_name
+    STEPS_TOTAL.inc(tags={"job": job})
+    STEP_PHASE_SECONDS.observe(dur, tags={"job": job, "phase": "step"})
+    for ph, s in timer.phases.items():
+        STEP_PHASE_SECONDS.observe(s, tags={"job": job, "phase": ph})
+    mfu = compute_mfu(timer.flops, dur)
+    if mfu is not None:
+        MFU_GAUGE.set(mfu, tags={"job": job})
+    _emit_step_span(
+        ctx, timer.start, dur, phases=dict(timer.phases), mfu=mfu
+    )
+    from ray_tpu.util import tracing
+
+    for name, wall, d in timer._events:
+        tracing.emit_span(
+            f"train:{name}", wall, d,
+            train_job=job, train_attempt=ctx.attempt, train_rank=ctx.rank,
+        )
+    ctx._step_index += 1
+    ctx._used_step_timer = True
+    ctx._last_report_wall = time.time()
+
+
+def implicit_step(ctx, now: float, metrics: dict) -> None:
+    """report()-closed step for loops that never use step_span():
+    the stretch since the previous report (or loop start) is one step.
+    Keeps goodput accounting working for every existing train loop."""
+    base = ctx._last_report_wall or ctx._loop_start_wall
+    if base is None:
+        return
+    dur = max(0.0, now - base)
+    job = ctx.experiment_name
+    STEPS_TOTAL.inc(tags={"job": job})
+    STEP_PHASE_SECONDS.observe(dur, tags={"job": job, "phase": "step"})
+    mfu = metrics.get("mfu") if isinstance(metrics, dict) else None
+    mfu = float(mfu) if isinstance(mfu, (int, float)) else None
+    if mfu is not None:
+        MFU_GAUGE.set(mfu, tags={"job": job})
+    phases = {}
+    ckpt_s = getattr(ctx, "_last_checkpoint_s", 0.0)
+    if ckpt_s:
+        phases["checkpoint"] = ckpt_s
+        STEP_PHASE_SECONDS.observe(
+            ckpt_s, tags={"job": job, "phase": "checkpoint"}
+        )
+    _emit_step_span(ctx, base, dur, phases=phases, mfu=mfu)
+    ctx._step_index += 1
+
+
+def _emit_step_span(ctx, start, dur, phases, mfu) -> None:
+    from ray_tpu.util import tracing
+
+    attrs = dict(
+        train_job=ctx.experiment_name,
+        train_attempt=ctx.attempt,
+        train_rank=ctx.rank,
+        train_step=ctx._step_index,
+        phases=phases,
+    )
+    if mfu is not None:
+        attrs["mfu"] = round(mfu, 6)
+    tracing.emit_span("train:step", start, dur, **attrs)

@@ -212,7 +212,13 @@ class TrainWorker:
         return True
 
     def run_loop(self, train_loop: Callable, use_context_arg: bool):
+        from ray_tpu.util import tracing
+
         _set_context(self.ctx)
+        # Anchor for the first implicit step (report() with no explicit
+        # step_span) and for the attempt span below.
+        attempt_start = time.time()
+        self.ctx._loop_start_wall = attempt_start
         try:
             if use_context_arg:
                 train_loop(self.ctx.config)
@@ -237,6 +243,31 @@ class TrainWorker:
             raise
         finally:
             _set_context(None)
+            # One slice per controller attempt in the timeline: restart
+            # churn is visible as gaps between attempt spans.
+            tracing.emit_span(
+                "train:attempt",
+                attempt_start,
+                time.time() - attempt_start,
+                train_job=self.ctx.experiment_name,
+                train_attempt=self.ctx.attempt,
+                train_rank=self.ctx.rank,
+            )
+            # The controller kills this worker right after the attempt
+            # resolves — flush now or the attempt's last second of
+            # spans/metrics (the goodput boundary) dies with it.
+            try:
+                import asyncio as _asyncio
+
+                rt = ray_tpu.api._runtime
+                if rt.core is not None:
+                    rt.run(
+                        _asyncio.wait_for(
+                            rt.core.flush_observability(), 5.0
+                        )
+                    )
+            except Exception:  # noqa: BLE001 - flush is best-effort
+                pass
         return {
             "rank": self.rank,
             "reports": self.ctx.reports,
@@ -466,6 +497,11 @@ class JaxTrainer:
                 TrainWorker.options(
                     placement_group=pg,
                     placement_group_bundle_index=i,
+                    # Request what the bundle reserved: a non-default
+                    # resources_per_worker (fractional CPUs, TPU chips)
+                    # must be leased by the worker actor itself, not
+                    # just held by the bundle.
+                    resources=self.scaling.bundle(),
                 ).remote(i, n)
                 for i in range(n)
             ]

@@ -16,7 +16,7 @@ import bisect
 import threading
 from typing import Sequence
 
-_REGISTRY: dict[tuple, "_Metric"] = {}
+_REGISTRY: dict[str, "_Metric"] = {}
 _LOCK = threading.Lock()
 
 DEFAULT_BUCKETS = (
@@ -24,8 +24,56 @@ DEFAULT_BUCKETS = (
 )
 
 
+def escape_label_value(value) -> str:
+    """Prometheus label-value escaping: one hostile value must not be
+    able to break out of its quotes or inject exposition lines."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def parse_tag_str(tag_str: str) -> dict[str, str]:
+    """Inverse of the snapshot tag rendering (`k="v",k2="v2"`, values
+    escaped with escape_label_value)."""
+    out: dict[str, str] = {}
+    i, n = 0, len(tag_str)
+    while i < n:
+        eq = tag_str.find('="', i)
+        if eq < 0:
+            break
+        key = tag_str[i:eq]
+        j = eq + 2
+        buf: list[str] = []
+        while j < n:
+            c = tag_str[j]
+            if c == "\\" and j + 1 < n:
+                buf.append({"n": "\n"}.get(tag_str[j + 1], tag_str[j + 1]))
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        out[key] = "".join(buf)
+        i = j + 2  # past the closing quote and the separating comma
+    return out
+
+
 class _Metric:
     kind = ""
+
+    def __new__(cls, name: str, *args, **kwargs):
+        # Re-registration of an existing name with the same kind hands
+        # back the live instance (its series survive) instead of
+        # silently shadowing it; __init__ then verifies the shape.
+        with _LOCK:
+            existing = _REGISTRY.get(name)
+        if existing is not None and type(existing) is cls:
+            return existing
+        return object.__new__(cls)
 
     def __init__(
         self,
@@ -33,14 +81,30 @@ class _Metric:
         description: str = "",
         tag_keys: Sequence[str] = (),
     ):
-        self.name = name
-        self.description = description
-        self.tag_keys = tuple(tag_keys)
-        self._default_tags: dict[str, str] = {}
-        # tag-value tuple → value (float for counter/gauge, list for hist)
-        self._series: dict[tuple, object] = {}
+        tag_keys = tuple(tag_keys)
+        if getattr(self, "_registered", False):
+            if tag_keys != self.tag_keys:
+                raise ValueError(
+                    f"metric {name!r} already registered with tag_keys "
+                    f"{self.tag_keys}, cannot re-register with {tag_keys}"
+                )
+            return
         with _LOCK:
-            _REGISTRY[(self.kind, name)] = self
+            existing = _REGISTRY.get(name)
+            if existing is not None and existing is not self:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{existing.kind}, cannot re-register as {self.kind}"
+                )
+            self.name = name
+            self.description = description
+            self.tag_keys = tag_keys
+            self._default_tags: dict[str, str] = {}
+            # tag-value tuple → value (float for counter/gauge, list for
+            # hist)
+            self._series: dict[tuple, object] = {}
+            self._registered = True
+            _REGISTRY[name] = self
 
     def set_default_tags(self, tags: dict[str, str]):
         self._default_tags = dict(tags)
@@ -93,8 +157,17 @@ class Histogram(_Metric):
         boundaries: Sequence[float] = DEFAULT_BUCKETS,
         tag_keys: Sequence[str] = (),
     ):
+        boundaries = tuple(sorted(boundaries))
+        if (
+            getattr(self, "_registered", False)
+            and boundaries != self.boundaries
+        ):
+            raise ValueError(
+                f"histogram {name!r} already registered with boundaries "
+                f"{self.boundaries}, cannot re-register with {boundaries}"
+            )
         super().__init__(name, description, tag_keys)
-        self.boundaries = tuple(sorted(boundaries))
+        self.boundaries = boundaries
 
     def observe(self, value: float, tags: dict | None = None):
         key = self._key(tags)
@@ -114,11 +187,13 @@ def snapshot() -> dict:
     """Serializable {name: record} for this process's registry."""
     out = {}
     with _LOCK:
-        for (kind, name), m in _REGISTRY.items():
+        for name, m in _REGISTRY.items():
+            kind = m.kind
             series = {}
             for key, val in m._series.items():
                 tag_str = ",".join(
-                    f'{k}="{v}"' for k, v in zip(m.tag_keys, key)
+                    f'{k}="{escape_label_value(v)}"'
+                    for k, v in zip(m.tag_keys, key)
                 )
                 series[tag_str] = (
                     [list(val[0]), val[1], val[2]]
@@ -158,7 +233,9 @@ def merge_snapshots(worker_snaps: dict[str, dict]) -> dict:
             )
             for tag_str, val in rec["series"].items():
                 if rec["kind"] == "gauge":
-                    wtag = f'{tag_str},worker="{worker}"'.lstrip(",")
+                    wtag = (
+                        f'{tag_str},worker="{escape_label_value(worker)}"'
+                    ).lstrip(",")
                     m["series"][wtag] = val
                 elif rec["kind"] == "counter":
                     m["series"][tag_str] = m["series"].get(tag_str, 0.0) + val
@@ -180,7 +257,14 @@ def prometheus_text(merged: dict) -> str:
     lines = []
     for name, rec in merged.items():
         if rec["description"]:
-            lines.append(f"# HELP {name} {rec['description']}")
+            # HELP is one line by format: a newline in a description
+            # would start a bogus exposition line mid-scrape.
+            desc = (
+                rec["description"]
+                .replace("\\", "\\\\")
+                .replace("\n", " ")
+            )
+            lines.append(f"# HELP {name} {desc}")
         lines.append(f"# TYPE {name} {rec['kind']}")
         for tag_str, val in rec["series"].items():
             braces = f"{{{tag_str}}}" if tag_str else ""

@@ -113,7 +113,11 @@ def list_actors(state: str | None = None) -> list[dict]:
 
 
 def list_tasks(limit: int = 1000, state: str | None = None) -> list[dict]:
-    events = _call_head("list_task_events", limit=limit)["events"]
+    # The state filter runs on the head BEFORE limit, so filtered kinds
+    # aren't evicted from the newest-N window by other traffic.
+    events = _call_head("list_task_events", limit=limit, state=state)[
+        "events"
+    ]
     if state is not None:
         events = [e for e in events if e.get("state") == state]
     return events
@@ -161,12 +165,46 @@ def prometheus_metrics() -> str:
     return m.prometheus_text(cluster_metrics())
 
 
+def train_stats() -> dict:
+    """Per-train-job goodput accounting from the head: productive step
+    time vs. stalls (inter-step gaps, data wait, checkpointing) and
+    elastic restart loss, plus MFU and phase breakdowns. Backs the
+    dashboard's /api/train and the `ray_tpu goodput` CLI."""
+    return _call_head("train_stats")
+
+
+_SPAN_ARG_KEYS = (
+    "trace_id", "span_id", "parent_id", "group", "verb", "backend",
+    "bytes", "dtype", "bus_bytes_per_s", "train_job", "train_attempt",
+    "train_rank", "train_step", "phases", "mfu",
+)
+
+
 def timeline(path: str | None = None) -> list[dict] | str:
-    """Chrome-trace export of task execution spans (reference:
-    `ray timeline`, powered by GcsTaskManager events)."""
+    """Chrome-trace export of task execution spans plus SPAN events —
+    collective ops and train step phases render as slices alongside the
+    tasks that issued them (reference: `ray timeline`, powered by
+    GcsTaskManager events)."""
     events = _call_head("list_task_events", limit=20000, raw=True)["events"]
     trace = []
     for ev in events:
+        if ev.get("state") == "SPAN" and "dur" in ev:
+            trace.append(
+                {
+                    "ph": "X",
+                    "name": ev.get("name") or "span",
+                    "ts": ev["ts"] * 1e6,
+                    "dur": ev["dur"] * 1e6,
+                    "pid": ev.get("worker", "?"),
+                    # Separate track per worker so span slices don't
+                    # overlap the task slices they ran inside.
+                    "tid": "spans",
+                    "args": {
+                        k: ev[k] for k in _SPAN_ARG_KEYS if k in ev
+                    },
+                }
+            )
+            continue
         if ev.get("state") != "RUNNING" or "dur" not in ev:
             continue
         trace.append(

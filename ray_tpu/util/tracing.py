@@ -139,9 +139,11 @@ def span(name: str):
         )
 
 
-def record_span(trace_id, span_id, parent_id, name, start, dur):
+def record_span(trace_id, span_id, parent_id, name, start, dur, **attrs):
     """Spans ride the task-event buffer (flushed to the head like any
-    task state transition, core_worker._flush_events_loop)."""
+    task state transition, core_worker._flush_events_loop). Extra
+    keyword attributes (bytes moved, phase breakdowns, train job) travel
+    on the event and surface in the timeline's args."""
     try:
         import ray_tpu.api as api
 
@@ -158,16 +160,52 @@ def record_span(trace_id, span_id, parent_id, name, start, dur):
         parent_id=parent_id,
         ts=start,
         dur=dur,
+        **attrs,
     )
 
 
+def emit_span(name: str, start: float, dur: float, **attrs) -> None:
+    """Record an externally measured, already-completed span, linked
+    under the active trace context when one exists (fresh trace
+    otherwise). Used by the collective flight recorder and train step
+    telemetry; NOT gated on enable_tracing — these coarse spans are what
+    make `ray_tpu timeline` show collective ops and step phases without
+    a tracing opt-in, and recording one is an in-memory list append."""
+    cur = _active()
+    trace_id = cur[0] if cur else uuid.uuid4().hex[:16]
+    span_id = uuid.uuid4().hex[:16]
+    record_span(
+        trace_id, span_id, cur[1] if cur else "", name, start, dur, **attrs
+    )
+
+
+async def carry_context(coro, ctx: tuple[str, str]):
+    """Await `coro` with `ctx` installed as its trace context. The
+    collective dispatch layer hops from the caller's thread onto the
+    runtime loop (run_coroutine_threadsafe does not propagate
+    contextvars), so it captures the caller's active span and re-installs
+    it inside the coroutine — spans the op emits (flight recorder) then
+    parent under the task that issued the collective. Each asyncio task
+    runs in its own Context copy, so the set/reset cannot leak into
+    concurrent tasks."""
+    token = _current.set(ctx)
+    try:
+        return await coro
+    finally:
+        _current.reset(token)
+
+
 def get_trace_events(limit: int = 2000) -> list[dict]:
-    """All spans the head has collected (driver-side query)."""
+    """All spans the head has collected (driver-side query). The SPAN
+    filter runs on the head BEFORE `limit` is applied, so busy task
+    traffic cannot evict spans from the reply."""
     import ray_tpu.api as api
 
     rt = api._runtime
     reply = rt.run(
-        rt.core.head.call("list_task_events", limit=limit, raw=True)
+        rt.core.head.call(
+            "list_task_events", limit=limit, raw=True, state="SPAN"
+        )
     )
     return [e for e in reply["events"] if e.get("state") == "SPAN"]
 

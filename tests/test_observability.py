@@ -155,6 +155,338 @@ def test_job_failure_and_stop(cluster):
     assert client.get_job_status(slow) in ("STOPPED", "FAILED")
 
 
+def test_metrics_registry_reregistration():
+    """Re-registering a name with an identical shape returns the live
+    instance (series preserved); any mismatch raises instead of
+    silently clobbering the first metric's series."""
+    c1 = metrics.Counter("rereg_total", "d", tag_keys=("a",))
+    c1.inc(3, tags={"a": "x"})
+    c2 = metrics.Counter("rereg_total", "d", tag_keys=("a",))
+    assert c2 is c1
+    assert c2.value(tags={"a": "x"}) == 3
+    with pytest.raises(ValueError):
+        metrics.Counter("rereg_total", "d", tag_keys=("b",))
+    with pytest.raises(ValueError):  # same name, different kind
+        metrics.Gauge("rereg_total", "d", tag_keys=("a",))
+    h1 = metrics.Histogram("rereg_hist", "d", boundaries=(1.0, 2.0))
+    h1.observe(1.5)
+    assert metrics.Histogram("rereg_hist", "d", boundaries=(2.0, 1.0)) is h1
+    with pytest.raises(ValueError):
+        metrics.Histogram("rereg_hist", "d", boundaries=(1.0, 3.0))
+
+
+def test_prometheus_exposition_hygiene():
+    """Hostile label values and HELP text cannot corrupt the scrape:
+    quotes/backslashes/newlines are escaped, HELP stays one line."""
+    g = metrics.Gauge("escape_gauge", "line1\nline2", tag_keys=("k",))
+    g.set(1.0, tags={"k": 'a"b\\c\nd'})
+    text = metrics.prometheus_text(
+        metrics.merge_snapshots({"w\n1": metrics.snapshot()})
+    )
+    lines = text.splitlines()
+    series = [l for l in lines if l.startswith("escape_gauge{")]
+    assert len(series) == 1
+    assert '\\"' in series[0] and "\\\\" in series[0]
+    assert "\\n" in series[0]
+    help_line = next(l for l in lines if l.startswith("# HELP escape_gauge"))
+    assert "line1 line2" in help_line
+    # round-trip: the escaped tag string parses back to the raw value
+    tags = metrics.parse_tag_str('k="a\\"b\\\\c\\nd"')
+    assert tags["k"] == 'a"b\\c\nd'
+
+
+def test_collective_flight_recorder(cluster):
+    """Every collective verb records latency/bytes/bus-bandwidth and a
+    timeline SPAN (driver-side world-1 CPU group: no flush wait)."""
+    import numpy as np
+
+    from ray_tpu import collective as col
+    from ray_tpu.collective import flight_recorder as fr
+    from ray_tpu.util import tracing
+
+    col.init_collective_group(1, 0, backend="cpu", group_name="fr1")
+    try:
+        col.allreduce(np.ones(1024, np.float32), group_name="fr1")
+        lat = fr.OP_LATENCY.value(
+            tags={"group": "fr1", "verb": "allreduce", "backend": "cpu"}
+        )
+        assert lat is not None and lat[2] >= 1  # observation count
+        assert (
+            fr.OP_BYTES.value(
+                tags={"group": "fr1", "verb": "allreduce",
+                      "dtype": "float32"}
+            )
+            >= 4096
+        )
+        # The driver's snapshot rides the 1 Hz flush to the head; push
+        # it eagerly so the cluster-wide scrape is deterministic here.
+        rt = ray_tpu.api._runtime
+        rt.run(rt.core.flush_observability())
+        text = state.prometheus_metrics()
+        assert (
+            "# TYPE ray_tpu_collective_op_latency_seconds histogram"
+            in text
+        )
+        assert "ray_tpu_collective_bus_bandwidth_bytes_per_s" in text
+        assert "ray_tpu_collective_bytes_total" in text
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            spans = tracing.get_trace_events()
+            hits = [
+                s for s in spans
+                if s.get("name") == "collective:allreduce"
+                and s.get("group") == "fr1"
+            ]
+            if hits:
+                break
+            time.sleep(0.3)
+        assert hits, "no collective SPAN reached the head"
+        assert hits[0]["bytes"] == 4096
+    finally:
+        col.destroy_collective_group("fr1")
+
+
+def test_trace_context_through_collective_in_actor(cluster):
+    """A collective op issued inside a traced actor task parents its
+    span under the task's execution span (same trace, linked parent)."""
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote
+        class ColActor:
+            def run_op(self):
+                import numpy as np
+
+                from ray_tpu import collective as col
+
+                col.init_collective_group(
+                    1, 0, backend="cpu", group_name="trace_g"
+                )
+                try:
+                    col.allreduce(
+                        np.ones(8, np.float32), group_name="trace_g"
+                    )
+                finally:
+                    col.destroy_collective_group("trace_g")
+                return True
+
+        a = ColActor.remote()
+        assert ray_tpu.get(a.run_op.remote(), timeout=60)
+        task_span = col_span = None
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            spans = tracing.get_trace_events()
+            task_span = next(
+                (s for s in spans
+                 if str(s.get("name", "")).endswith("run_op")), None
+            )
+            col_span = next(
+                (s for s in spans
+                 if s.get("name") == "collective:allreduce"
+                 and s.get("group") == "trace_g"), None
+            )
+            if task_span and col_span:
+                break
+            time.sleep(0.3)
+        assert task_span and col_span, "spans did not reach the head"
+        assert col_span["trace_id"] == task_span["trace_id"]
+        assert col_span["parent_id"] == task_span["span_id"]
+        ray_tpu.kill(a)  # free its CPU for the trainer tests below
+    finally:
+        tracing.disable_tracing()
+
+
+def test_goodput_accounting_across_elastic_restart(cluster):
+    """Attempt 0 dies mid-step, attempt 1 finishes: the head's per-job
+    ledger shows goodput < 1 and restart-lost time > 0, and the train
+    metrics reach the Prometheus surface."""
+    import os
+
+    from ray_tpu._private import config as _config
+    from ray_tpu.train import (
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+    import ray_tpu.train as train
+
+    def loop(config):
+        import time as t
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        for i in range(3):
+            with train.step_span(flops=1e9) as s:
+                with s.phase("data_wait"):
+                    t.sleep(0.01)
+                with s.phase("compute"):
+                    t.sleep(0.05)
+            train.report({"i": i})
+            if ctx.attempt == 0 and i == 1:
+                t.sleep(0.03)
+                raise RuntimeError("attempt 0 dies mid-step")
+
+    # Short settle window so the retry doesn't wait the default 30s
+    # node-death ageout (same knob test_elastic_train uses).
+    _config.set_system_config({"HEALTH_TIMEOUT_S": 4.0})
+    try:
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="goodput_exp",
+                storage_path="/tmp/ray_tpu_test_goodput",
+                failure_config=FailureConfig(max_failures=1),
+            ),
+        )
+        result = trainer.fit()
+        assert result.error is None
+    finally:
+        _config.clear_system_config("HEALTH_TIMEOUT_S")
+    job = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        job = state.train_stats().get("jobs", {}).get("goodput_exp")
+        if job and job["attempts"] >= 2 and job["steps"] >= 5:
+            break
+        time.sleep(0.4)
+    assert job, "head never saw the train job"
+    assert job["attempts"] == 2
+    assert job["steps"] >= 5
+    assert job["restart_lost_s"] > 0
+    assert 0 < job["goodput"] < 1
+    assert job["mfu"] and job["mfu"] > 0
+    assert job["phase_s"].get("compute", 0) > 0
+    text = state.prometheus_metrics()
+    assert 'ray_tpu_train_goodput_ratio{job="goodput_exp"' in text
+    assert "ray_tpu_train_mfu" in text
+    assert "ray_tpu_train_restart_lost_seconds" in text
+    # the dashboard route serves the same ledger over HTTP
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard()
+    try:
+        with urllib.request.urlopen(dash.url + "/api/train") as r:
+            body = _json.loads(r.read())
+    finally:
+        dash.stop()
+    assert body["jobs"]["goodput_exp"]["restart_lost_s"] > 0
+
+
+def test_trainer_timeline_has_collective_and_phase_slices(cluster):
+    """`ray_tpu timeline` from a real JaxTrainer run renders collective
+    ops and train step phases as slices alongside tasks."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    import ray_tpu.train as train
+
+    def loop(config):
+        import numpy as np
+
+        import ray_tpu.train as train
+        from ray_tpu import collective as col
+
+        ctx = train.get_context()
+        gname = f"tl{ctx.attempt}"
+        col.init_collective_group(
+            2, ctx.get_world_rank(), backend="cpu", group_name=gname
+        )
+        try:
+            for i in range(2):
+                with train.step_span(tokens=128, flops_per_token=1e6) as s:
+                    with s.phase("data_wait"):
+                        x = np.ones(64, np.float32)
+                    with s.phase("collective"):
+                        col.allreduce(x, group_name=gname)
+                train.report({"i": i})
+        finally:
+            col.destroy_collective_group(gname)
+
+    trainer = JaxTrainer(
+        loop,
+        # Fractional CPUs: earlier tests in this module leak actors, so
+        # don't require 2 whole free cores for the gang.
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 0.5}
+        ),
+        run_config=RunConfig(
+            name="tl_exp", storage_path="/tmp/ray_tpu_test_timeline"
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    names: set = set()
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        names = {e["name"] for e in state.timeline()}
+        if "collective:allreduce" in names and "train:step" in names:
+            break
+        time.sleep(0.4)
+    assert "collective:allreduce" in names
+    assert "train:step" in names
+    assert "train:collective" in names
+    assert "train:attempt" in names
+    # collective slices carry their bandwidth accounting as args
+    slc = next(
+        e for e in state.timeline()
+        if e["name"] == "collective:allreduce"
+        and e["args"].get("group") == "tl0"
+    )
+    assert slc["args"].get("bytes") == 64 * 4
+
+
+def test_chronic_straggler_surfaces_to_autoscaler(cluster):
+    """collective_straggler_total resolves rank→node on the head, and
+    the autoscaler flags a node past the threshold (log + metric)."""
+    rt = ray_tpu.api._runtime
+    nodes = state.list_nodes()
+    nid, node_addr = nodes[0]["node_id"], nodes[0]["addr"]
+    rt.run(
+        rt.core.head.call(
+            "collective_register",
+            group="sg", rank=0, epoch=0, addr="fake",
+            node_addr=node_addr, worker_id="w_straggle",
+        )
+    )
+    snap = {
+        "collective_straggler_total": {
+            "kind": "counter",
+            "description": "",
+            "series": {'group="sg",rank="0"': 25.0},
+            "boundaries": None,
+        }
+    }
+    rt.run(
+        rt.core.head.call(
+            "report_metrics", worker="fake_hub", metrics=snap
+        )
+    )
+    try:
+        stats = rt.run(rt.core.head.call("collective_straggler_stats"))
+        assert stats["nodes"].get(nid) == 25.0
+        assert stats["groups"]["sg"]["0"] == 25.0
+
+        from ray_tpu.autoscaler.autoscaler import (
+            _CHRONIC_STRAGGLER,
+            Autoscaler,
+        )
+
+        asc = Autoscaler.__new__(Autoscaler)  # flagging logic only
+        asc.straggler_threshold = 20
+        asc._flagged_stragglers = set()
+        chronic = asc._check_stragglers(asc._straggler_node_counts())
+        assert chronic.get(nid) == 25.0
+        assert nid in asc._flagged_stragglers
+        assert _CHRONIC_STRAGGLER.value(tags={"node": nid}) == 25.0
+    finally:
+        rt.run(rt.core.head.call("collective_deregister", group="sg"))
+
+
 def test_job_driver_connects_to_cluster(cluster, tmp_path):
     """A submitted driver can init against the running cluster via env."""
     from ray_tpu.job import JobSubmissionClient

@@ -87,3 +87,38 @@ def test_microbench_floors():
         f"serve sse ttfb p99 {ttfb['p99_ms']}ms >= "
         f"{SSE_TTFB_P99_CEILING_MS}ms (streaming regressed to buffering?)"
     )
+
+
+# Disabled-path budget for train step telemetry: a no-op step_span +
+# phase (outside a session / RAY_TPU_TRAIN_TELEMETRY=0) plus one tagged
+# counter inc. Measured ~2µs/step on the dev box; 50µs catches a
+# structural regression (allocation storms, config lookups per phase,
+# span emission leaking into the disabled path) through CI noise.
+STEP_TELEMETRY_DISABLED_CEILING_S = 50e-6
+
+
+def test_step_telemetry_disabled_overhead():
+    import time
+
+    from ray_tpu.train import session
+    from ray_tpu.util.metrics import Counter
+
+    assert session._context is None  # outside a session → disabled path
+    counter = Counter("perf_floor_steps_total", "d", tag_keys=("job",))
+    n = 2000
+    for _ in range(100):  # warmup (lazy imports, bytecode)
+        with session.step_span() as s:
+            with s.phase("compute"):
+                pass
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with session.step_span() as s:
+            with s.phase("compute"):
+                pass
+        counter.inc(tags={"job": "perf"})
+    per_step = (time.perf_counter() - t0) / n
+    assert per_step < STEP_TELEMETRY_DISABLED_CEILING_S, (
+        f"disabled-path step telemetry costs {per_step * 1e6:.1f}µs/step "
+        f"(budget {STEP_TELEMETRY_DISABLED_CEILING_S * 1e6:.0f}µs) — "
+        "instrumentation is taxing the train loop"
+    )

@@ -93,9 +93,33 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                 "(e.g. cpp/build/raytpu_worker); spawned "
                                 "for leases whose runtime_env is "
                                 "{'language': 'cpp'}"),
+    # --- node drain / preemption
+    "DRAIN_DEADLINE_S": (float, 30.0, "default drain notice window: how "
+                                      "long a DRAINING node is expected "
+                                      "to keep serving before it dies "
+                                      "(GCE preemption notice is ~30s)"),
+    "DRAIN_SIGTERM_LINGER_S": (float, 0.0, "how long a SIGTERMed node "
+                                           "daemon keeps serving after "
+                                           "self-reporting drain (0 = "
+                                           "stop right after the "
+                                           "notice; a second signal "
+                                           "always cuts the linger "
+                                           "short)"),
+    "TRAIN_EMERGENCY_CHECKPOINT": (bool, True, "on a drain notice for "
+                                               "this worker's node, "
+                                               "report() raises "
+                                               "PreemptedError once a "
+                                               "checkpoint is in hand "
+                                               "so the attempt resumes "
+                                               "losing ≤1 step"),
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: comma-separated method:prob "
                              "list ('*' matches any method)"),
+    "PREEMPT_AFTER_S": (str, "", "chaos spec: '<delay_s>[@<substr>]' — "
+                                 "synthetic preemption notice: a node "
+                                 "whose node_id/addr contains <substr> "
+                                 "(every node when omitted) self-drains "
+                                 "<delay_s> seconds after start"),
     "COLLECTIVE_TIMEOUT_S": (float, 60.0, "default collective deadline "
                                           "(rendezvous and per-op); "
                                           "group override via "

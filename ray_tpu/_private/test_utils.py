@@ -8,6 +8,44 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
+
+
+def parse_preempt_spec(spec: str) -> "tuple[float, str]":
+    """Parse the RAY_TPU_PREEMPT_AFTER_S chaos spec (same env-spec
+    family as RAY_TPU_RPC_FAILURE): ``"<delay_s>[@<substr>]"`` — a
+    synthetic preemption notice fires <delay_s> seconds after the node
+    starts, on nodes whose node_id or addr contains <substr> (every
+    node when omitted). Example: ``"2.5@a1b2c3"`` preempts the node
+    whose id starts with a1b2c3 after 2.5s."""
+    delay, _, substr = spec.partition("@")
+    return float(delay), substr
+
+
+class FakePreemptionSource:
+    """Synthetic preemption-notice source (the test stand-in for the
+    GCE maintenance-event poller): fires once, deterministically, per
+    the RAY_TPU_PREEMPT_AFTER_S spec. Registered chaos tests use this
+    to exercise the full drain lifecycle — notice → DRAINING → emergency
+    checkpoint → replacement — without a cloud in sight."""
+
+    interval_s = 0.1
+
+    def __init__(self, spec: str):
+        self.delay_s, self.substr = parse_preempt_spec(spec)
+        self._t0 = time.monotonic()
+
+    def poll(self, node) -> "tuple[str, float] | None":
+        if self.substr and (
+            self.substr not in node.node_id
+            and self.substr not in (node.addr or "")
+        ):
+            return None
+        if time.monotonic() - self._t0 < self.delay_s:
+            return None
+        from ray_tpu._private import config
+
+        return ("synthetic-preemption", config.get("DRAIN_DEADLINE_S"))
 
 
 def sigkill_pid(pid: int) -> None:

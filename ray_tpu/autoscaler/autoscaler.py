@@ -54,6 +54,8 @@ class Autoscaler:
         interval_s: float = 1.0,
         boot_grace_s: float = 600.0,
         straggler_threshold: int = 20,
+        straggler_drain: bool = True,
+        straggler_drain_deadline_s: float = 120.0,
     ):
         self.provider = provider
         self.node_types = node_types
@@ -62,9 +64,17 @@ class Autoscaler:
         self.boot_grace_s = boot_grace_s
         # A node whose collective_straggler_total (slowest or missing
         # contributor, summed across its ranks/groups) reaches this is
-        # flagged as a chronic straggler — replacement candidate.
+        # flagged as a chronic straggler — and, with straggler_drain on,
+        # DRAINED through the head and replaced through the provider
+        # (drain-and-replace, not just log-and-gauge).
         self.straggler_threshold = straggler_threshold
+        self.straggler_drain = straggler_drain
+        self.straggler_drain_deadline_s = straggler_drain_deadline_s
         self._flagged_stragglers: set[str] = set()
+        self._drained_stragglers: set[str] = set()
+        # Draining runtime node ids we already launched a replacement
+        # for: one drain notice buys exactly one proactive launch.
+        self._drain_replaced: set[str] = set()
         self._tracked: dict[str, _TrackedNode] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -157,10 +167,88 @@ class Autoscaler:
         )
         self._tracked[pid] = _TrackedNode(pid, node_type)
 
+    def _drain_node_via_head(self, node_id: str, reason: str) -> bool:
+        rt = core_api._runtime
+
+        async def go():
+            return await rt.core.head.call(
+                "drain_node",
+                node_id=node_id,
+                reason=reason,
+                deadline_s=self.straggler_drain_deadline_s,
+            )
+
+        try:
+            return bool(rt.run(go()).get("ok"))
+        except Exception:  # noqa: BLE001 - retried next tick
+            return False
+
+    def _node_type_for(self, node_id: str, node: dict) -> str | None:
+        """Which configured node type a runtime node corresponds to:
+        the provider-tracked type when we launched it, else the first
+        type whose resource shape the node covers (static nodes)."""
+        for pid, tracked in self._tracked.items():
+            if self.provider.runtime_node_id(pid) == node_id:
+                return tracked.node_type
+        for name, cfg in self.node_types.items():
+            if all(
+                node.get("resources", {}).get(k, 0) >= v
+                for k, v in cfg.resources.items()
+            ):
+                return name
+        return None
+
+    def _handle_draining(
+        self, draining: dict, nodes: dict, counts: dict[str, int]
+    ) -> None:
+        """Act on drain notices: (1) proactively provision a replacement
+        per draining node — the whole point of the notice window is that
+        the replacement boots WHILE the old node finishes its work — and
+        (2) terminate provider-owned drained nodes once they are empty
+        or past their deadline."""
+        now_wall = time.time()
+        for nid, dinfo in draining.items():
+            if nid in self._drain_replaced:
+                continue
+            self._drain_replaced.add(nid)
+            ntype = self._node_type_for(nid, nodes.get(nid, {}))
+            if ntype is None:
+                continue
+            if counts.get(ntype, 0) < self.node_types[ntype].max_workers:
+                logger.info(
+                    "node %s draining (%s): provisioning a replacement "
+                    "%s", nid[:12], dinfo.get("reason", ""), ntype,
+                )
+                self._launch(ntype)
+                counts[ntype] = counts.get(ntype, 0) + 1
+        # Reap provider-owned drained nodes. Ignores min_workers — the
+        # replacement is already tracked against the same type.
+        for pid, tracked in list(self._tracked.items()):
+            rid = self.provider.runtime_node_id(pid)
+            if rid is None or rid not in draining:
+                continue
+            node = nodes.get(rid)
+            emptied = node is not None and not node.get("pending") and all(
+                node["available"].get(k, 0) >= v
+                for k, v in node["resources"].items()
+            )
+            expired = now_wall > draining[rid].get("deadline_ts", 0.0)
+            if node is None or emptied or expired:
+                logger.info(
+                    "terminating drained node %s (%s)", pid, tracked.node_type
+                )
+                try:
+                    self.provider.terminate_node(pid)
+                finally:
+                    del self._tracked[pid]
+        # Forget replacement markers for nodes no longer draining/alive.
+        self._drain_replaced &= set(draining)
+
     def update(self):
         """One reconcile tick (public for deterministic tests)."""
         status = self._cluster_status()
         nodes = status["nodes"]
+        draining = status.get("draining") or {}
 
         # Demand = per-node queued leases + cluster-wide unschedulable.
         demand = list(status.get("unschedulable", []))
@@ -171,7 +259,35 @@ class Autoscaler:
         for t in self._tracked.values():
             counts[t.node_type] = counts.get(t.node_type, 0) + 1
 
-        free = [dict(n["available"]) for n in nodes.values()]
+        # Chronic stragglers → drain-and-replace: the drain excludes the
+        # node from new placements and fans the notice out; the generic
+        # drain handling below provisions its replacement.
+        chronic = self._check_stragglers(self._straggler_node_counts())
+        if self.straggler_drain:
+            for nid in chronic:
+                if nid in self._drained_stragglers or nid not in nodes:
+                    continue
+                if self._drain_node_via_head(nid, "chronic straggler"):
+                    self._drained_stragglers.add(nid)
+                    draining = dict(draining)
+                    draining.setdefault(
+                        nid,
+                        {
+                            "reason": "chronic straggler",
+                            "deadline_ts": time.time()
+                            + self.straggler_drain_deadline_s,
+                        },
+                    )
+
+        self._handle_draining(draining, nodes, counts)
+
+        # A draining node's capacity is spoken for — counting it as free
+        # would cancel out the very demand its replacement should absorb.
+        free = [
+            dict(n["available"])
+            for nid, n in nodes.items()
+            if nid not in draining
+        ]
         # Credit capacity of launched-but-not-yet-registered nodes (real
         # providers take minutes to boot a slice): without this, every
         # tick re-launches for the same unmet demand. The credit expires
@@ -263,8 +379,7 @@ class Autoscaler:
             "tracked": {
                 pid: t.node_type for pid, t in self._tracked.items()
             },
-            "chronic_stragglers": self._check_stragglers(
-                self._straggler_node_counts()
-            ),
+            "draining": {nid: dict(d) for nid, d in draining.items()},
+            "chronic_stragglers": chronic,
         }
         return self.last_status

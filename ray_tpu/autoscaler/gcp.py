@@ -55,6 +55,62 @@ _METADATA_TOKEN_URL = (
 )
 
 
+_MAINTENANCE_EVENT_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/"
+    "instance/maintenance-event"
+)
+_PREEMPTED_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/"
+    "instance/preempted"
+)
+
+
+class GceMaintenanceEventSource:
+    """Preemption-notice source for the node daemon's drain watcher
+    (runtime/node.py): polls the GCE metadata server's maintenance-event
+    and preempted endpoints. A value other than NONE/FALSE means this VM
+    is about to be migrated or preempted — the node self-reports DRAIN
+    with the standard notice window so the trainer's emergency
+    checkpoint and the autoscaler's replacement both start inside it.
+
+    Only constructed on GCE hosts (the DMI product-name gate in
+    NodeManager._preemption_source keeps other machines off the
+    metadata endpoint). ``fetch`` is a seam for tests."""
+
+    interval_s = 5.0
+
+    def __init__(self, fetch: Callable[[str], str] | None = None):
+        self._fetch = fetch or self._metadata_get
+
+    @staticmethod
+    def _metadata_get(url: str) -> str:
+        req = urllib.request.Request(
+            url, headers={"Metadata-Flavor": "Google"}
+        )
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            return resp.read().decode().strip()
+
+    def poll(self, node) -> "tuple[str, float] | None":
+        del node
+        from ray_tpu._private import config
+
+        try:
+            if self._fetch(_PREEMPTED_URL).upper() == "TRUE":
+                return ("gce-preempted", config.get("DRAIN_DEADLINE_S"))
+        except OSError:
+            pass
+        try:
+            event = self._fetch(_MAINTENANCE_EVENT_URL)
+        except OSError:
+            return None
+        if event and event.upper() != "NONE":
+            return (
+                f"gce-maintenance:{event}",
+                config.get("DRAIN_DEADLINE_S"),
+            )
+        return None
+
+
 class GcpHttpError(RuntimeError):
     def __init__(self, status: int, body: str):
         super().__init__(f"HTTP {status}: {body[:500]}")

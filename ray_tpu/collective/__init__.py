@@ -61,11 +61,28 @@ async def _ensure_death_watch(core) -> None:
     core._collective_death_watch = True
 
     def _on_event(msg):
-        if not isinstance(msg, dict) or msg.get("event") != "member_dead":
+        if not isinstance(msg, dict):
             return
-        g = _groups.get(msg.get("group"))
-        if g is not None and hasattr(g, "_on_member_dead"):
-            g._on_member_dead(msg.get("ranks") or [], epoch=msg.get("epoch"))
+        event = msg.get("event")
+        if event == "member_dead":
+            g = _groups.get(msg.get("group"))
+            if g is not None and hasattr(g, "_on_member_dead"):
+                g._on_member_dead(
+                    msg.get("ranks") or [], epoch=msg.get("epoch")
+                )
+        elif event == "node_draining":
+            # Drain notices ride the same fan-out channel: record them
+            # process-locally so the train session (emergency
+            # checkpoint) and anyone polling preemption_notice() learns
+            # BEFORE the node dies. A drain does NOT poison groups —
+            # the node is alive until its deadline.
+            from ray_tpu.runtime import drain
+
+            drain.record(msg)
+        elif event == "node_undrain":
+            from ray_tpu.runtime import drain
+
+            drain.clear(msg.get("node_id"))
 
     await core.subscribe("collective", _on_event)
 
@@ -76,12 +93,20 @@ def init_collective_group(
     backend: str | Backend = Backend.AUTO,
     group_name: str = "default",
     timeout_s: float | None = None,
+    auto_reform: bool = False,
 ) -> None:
     """Join this process into a named collective group.
 
     ``timeout_s`` is the group's default deadline for rendezvous and
     every op (config COLLECTIVE_TIMEOUT_S when None); individual verbs
-    can override per call."""
+    can override per call.
+
+    ``auto_reform``: on an op failure where no member is actually dead
+    (a transient timeout, a peer that already reformed), re-run
+    rendezvous in place via :func:`reform_in_place` and retry the op
+    once — the caller keeps its in-memory state and never sees the
+    error. Confirmed member death still raises, so real failures
+    escalate to the elastic restart path."""
     if group_name in _groups:
         raise ValueError(f"collective group {group_name!r} already exists")
     backend = _resolve_backend(backend)
@@ -119,10 +144,32 @@ def init_collective_group(
             )
         )
         _groups[group_name] = XlaDistGroup(
-            world_size, rank, timeout_s=timeout_s, name=group_name
+            world_size, rank, timeout_s=timeout_s, name=group_name,
+            core=rt.core,
         )
+
+        async def _register_dist():
+            # Head membership + death watch: the fan-out is what lets
+            # XlaDistGroup's deadline-bounded sync abort EARLY (poison
+            # polling between bounded waits) instead of at the deadline.
+            try:
+                await rt.core.head.call(
+                    "collective_register",
+                    group=group_name,
+                    rank=rank,
+                    epoch=0,
+                    addr=rt.core.addr,
+                    node_addr=getattr(rt.core, "node_addr", None),
+                    worker_id=getattr(rt.core, "worker_id", None),
+                )
+            except Exception:  # noqa: BLE001 - membership is best-effort
+                pass
+            await _ensure_death_watch(rt.core)
+
+        rt.run(_register_dist())
     else:
         raise ValueError(f"unsupported backend {backend}")
+    _groups[group_name].auto_reform = auto_reform
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
@@ -153,6 +200,56 @@ def reform_group(
     new_g = _runtime().run(g.reform(timeout_s=timeout_s))
     _groups[group_name] = new_g
     return new_g.rank, new_g.world
+
+
+def reform_in_place(
+    group_name: str = "default", timeout_s: float | None = None
+) -> tuple[int, int] | None:
+    """Repair a desynced/poisoned group WITHOUT an attempt restart —
+    but only when every member is still alive.
+
+    Probes the head (collective_probe cross-references the node and
+    worker tables) to confirm whether any silent rank is actually dead.
+    If none is — a transient op timeout, or a drain of a node that
+    hosts no member — re-runs rendezvous via :func:`reform_group` at
+    the SAME shape and returns ``(rank, world)``: callers continue from
+    in-memory state, no checkpoint restore, no new attempt. If a member
+    is confirmed dead, returns ``None`` — the caller escalates to the
+    restart/elastic path."""
+    g = get_group(group_name)
+    if not hasattr(g, "reform"):
+        return None
+    rt = _runtime()
+    confirmed: set[int] = set()
+    try:
+        reply = rt.run(
+            rt.core.head.call(
+                "collective_probe",
+                group=getattr(g, "base_name", group_name),
+            )
+        )
+        if reply.get("ok"):
+            confirmed = {int(r) for r in reply.get("dead_ranks") or []}
+    except Exception:  # noqa: BLE001 - probe is advisory; the local
+        pass           # dead set below still gates the reform
+    if confirmed and hasattr(g, "_dead"):
+        g._dead |= confirmed
+    if confirmed or getattr(g, "_dead", None):
+        return None
+    return reform_group(group_name, timeout_s=timeout_s)
+
+
+def _reformable(e: Exception) -> bool:
+    """Errors worth an in-place reform attempt: transient timeouts, a
+    peer that already reformed under us, or a death claim the probe can
+    refute (reform_in_place re-checks). A deliberate destroy is not."""
+    if isinstance(e, CollectiveTimeoutError):
+        return True
+    if isinstance(e, CollectiveMemberDiedError):
+        return True
+    if isinstance(e, CollectiveGroupDestroyedError):
+        return "reform" in str(e)
+    return False
 
 
 def straggler_stats(group_name: str = "default") -> dict:
@@ -192,21 +289,43 @@ def _dispatch(name: str, group_name: str, *args, **kw):
             f"backend: pass a list of {g.world} per-rank tensors, one per "
             "device (each rank is a local device, not a process)"
         )
+    # With auto_reform, one failed dispatch may retry once after an
+    # in-place reform (no member actually dead → same shape, fresh
+    # epoch). The retry re-fetches the group: reform replaced it.
+    for attempt in range(2):
+        g = get_group(group_name)
+        out, err = _dispatch_once(g, name, *args, **kw)
+        if err is None:
+            return out
+        if (
+            attempt > 0
+            or not getattr(g, "auto_reform", False)
+            or not _reformable(err)
+        ):
+            raise err
+        if reform_in_place(group_name) is None:
+            raise err  # a member really died: escalate
+
+
+def _dispatch_once(g, name: str, *args, **kw):
     fn = getattr(g, name)
     import inspect
 
-    if inspect.iscoroutinefunction(fn):
-        from ray_tpu.util import tracing
+    try:
+        if inspect.iscoroutinefunction(fn):
+            from ray_tpu.util import tracing
 
-        coro = fn(*args, **kw)
-        # Carry the caller's trace context onto the runtime loop so the
-        # flight recorder's op span parents under the issuing task
-        # (contextvars do not cross run_coroutine_threadsafe).
-        ctx = tracing._active()
-        if ctx is not None:
-            coro = tracing.carry_context(coro, ctx)
-        return _runtime().run(coro)
-    return fn(*args, **kw)
+            coro = fn(*args, **kw)
+            # Carry the caller's trace context onto the runtime loop so
+            # the flight recorder's op span parents under the issuing
+            # task (contextvars do not cross run_coroutine_threadsafe).
+            ctx = tracing._active()
+            if ctx is not None:
+                coro = tracing.carry_context(coro, ctx)
+            return _runtime().run(coro), None
+        return fn(*args, **kw), None
+    except CollectiveError as e:
+        return None, e
 
 
 def allreduce(
@@ -282,6 +401,7 @@ __all__ = [
     "init_collective_group",
     "destroy_collective_group",
     "reform_group",
+    "reform_in_place",
     "straggler_stats",
     "is_group_initialized",
     "get_rank",

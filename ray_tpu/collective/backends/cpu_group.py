@@ -217,12 +217,22 @@ class CpuGroup:
         except rpc.RpcError:
             pass
 
-    async def destroy(self):
+    async def destroy(self, reason: str = "destroyed"):
         """Tear down AND fail everything in flight: hub-side pending op
         futures, member-side in-flight calls, and mailbox recv waiters —
-        an awaiting coroutine must never stay pending past destroy."""
+        an awaiting coroutine must never stay pending past destroy.
+
+        A tombstone handler replaces the hub's op endpoint so a straggler
+        member's LATE op against this incarnation gets a typed answer
+        (``reason`` of "reformed" lets auto_reform rejoin the new epoch)
+        instead of an unknown-method RpcError."""
         self._destroyed = True
-        self.core.ext_handlers.pop(f"col_op:{self.name}", None)
+
+        async def _tombstone(conn, **kw):
+            return {"ok": False, "error": reason}
+
+        if self.rank == 0:
+            self.core.ext_handlers[f"col_op:{self.name}"] = _tombstone
         self.core.ext_handlers.pop(f"col_sendrecv:{self.name}", None)
         for key, st in list(self._pending.items()):
             if st.timer is not None:
@@ -281,8 +291,9 @@ class CpuGroup:
             timeout_s=self.timeout_s if timeout_s is None else timeout_s,
             epoch=self.epoch + 1,
         )
-        await self.destroy()
+        await self.destroy(reason="reformed")
         await g.init()
+        g.auto_reform = getattr(self, "auto_reform", False)
         return g
 
     # ------------------------------------------------ death propagation
@@ -492,8 +503,12 @@ class CpuGroup:
                 reply.get("timeout_s"),
                 missing_ranks=reply.get("missing_ranks"),
             )
-        if error == "destroyed":
-            raise CollectiveGroupDestroyedError(self.base_name, kind)
+        if error in ("destroyed", "reformed"):
+            raise CollectiveGroupDestroyedError(
+                self.base_name,
+                kind,
+                detail="reformed" if error == "reformed" else "",
+            )
         dead = [int(r) for r in reply.get("dead_ranks") or []]
         self._dead.update(d for d in dead if d != self.rank)
         raise CollectiveMemberDiedError(

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_tpu.collective.flight_recorder import record_op
 from ray_tpu.collective.types import (
+    CollectiveMemberDiedError,
     CollectiveTimeoutError,
     ReduceOp,
 )
@@ -280,11 +281,20 @@ class XlaDistGroup:
         rank: int,
         timeout_s: float | None = None,
         name: str = "xla_dist",
+        core=None,
     ):
         self.world = world_size
         self.rank = rank
         self.name = name
+        self.base_name = name
+        self.epoch = 0
+        self.core = core  # CoreWorker, for head membership deregistration
         self._in_recorded_op = False
+        # Poison state, fed by the head's death fan-out (see
+        # _on_member_dead): the deadline-bounded sync polls this BETWEEN
+        # bounded waits, so a fan-out interrupts a wedged compiled
+        # collective well before its deadline, not at it.
+        self._dead: set[int] = set()
         self.timeout_s = (
             _default_timeout() if timeout_s is None else float(timeout_s)
         )
@@ -321,14 +331,57 @@ class XlaDistGroup:
             prog = self._programs[key] = jax.jit(mapped)
         return prog(x)
 
+    def _on_member_dead(self, ranks, epoch: int | None = None):
+        """Head fan-out declared members dead: poison the group. The
+        sync loop (and every future op's entry check) turns this into a
+        typed abort — there is no comm handle to cancel on XLA, but the
+        waiting THREAD can stop waiting immediately."""
+        if epoch is not None and epoch != self.epoch:
+            return
+        self._dead.update(
+            int(r) for r in (ranks or []) if int(r) != self.rank
+        )
+
+    def _check_poisoned(self, op: str):
+        if self._dead:
+            raise CollectiveMemberDiedError(
+                self.name,
+                op,
+                dead_ranks=sorted(self._dead),
+                detail="re-init jax.distributed to recover",
+            )
+
+    async def destroy(self):
+        """Deregister from the head's membership table and release the
+        sync pool; the jax.distributed runtime itself has no per-group
+        teardown (re-init covers reform)."""
+        if self._sync_pool is not None:
+            self._sync_pool.shutdown(wait=False)
+            self._sync_pool = None
+        if self.core is not None:
+            try:
+                await self.core.head.call(
+                    "collective_deregister",
+                    group=self.base_name,
+                    epoch=self.epoch,
+                    rank=self.rank,
+                )
+            except Exception:  # noqa: BLE001 - head may be gone
+                pass
+
+    _POISON_POLL_S = 0.25
+
     def _sync(self, arr: jax.Array, op: str, timeout_s) -> jax.Array:
         """Deadline-bounded device sync. A peer process dying mid-op
         leaves the compiled collective blocked inside the runtime with
         no abort handle (the NCCL-comm-abort gap on XLA); waiting on a
         side thread turns that silent hang into a typed
-        CollectiveTimeoutError. The wedged thread is abandoned — the
-        caller is expected to tear down / reform via jax.distributed
-        re-init, matching destroy-and-reform semantics."""
+        CollectiveTimeoutError. Between bounded waits the loop polls the
+        group's poison flag, so a head death fan-out aborts the wait as
+        soon as it arrives instead of at the deadline. The wedged thread
+        is abandoned — the caller is expected to tear down / reform via
+        jax.distributed re-init, matching destroy-and-reform
+        semantics."""
         t = self.timeout_s if timeout_s is None else float(timeout_s)
         if not t or t <= 0:
             return jax.block_until_ready(arr)
@@ -341,21 +394,30 @@ class XlaDistGroup:
         from concurrent.futures import TimeoutError as _FutTimeout
 
         fut = self._sync_pool.submit(jax.block_until_ready, arr)
-        try:
-            return fut.result(t)
-        except _FutTimeout:
-            # The pool thread stays wedged on the dead collective; drop
-            # the pool so a post-reform op gets a fresh thread.
-            self._sync_pool = None
-            raise CollectiveTimeoutError(
-                "xla_dist", op, t,
-                detail="compiled collective never completed (peer "
-                       "process lost?); re-init jax.distributed to "
-                       "recover",
-            )
+        deadline = time.monotonic() + t
+        while True:
+            if self._dead:
+                # Abandon the wedged wait NOW — the fan-out beat the
+                # deadline. Fresh pool for the post-reform op.
+                self._sync_pool = None
+                self._check_poisoned(op)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                self._sync_pool = None
+                raise CollectiveTimeoutError(
+                    "xla_dist", op, t,
+                    detail="compiled collective never completed (peer "
+                           "process lost?); re-init jax.distributed to "
+                           "recover",
+                )
+            try:
+                return fut.result(min(self._POISON_POLL_S, remaining))
+            except _FutTimeout:
+                continue
 
     @_recorded("allreduce")
     def allreduce(self, tensor, op=ReduceOp.SUM, timeout_s=None):
+        self._check_poisoned("allreduce")
         x = self._global(tensor)
         psum = _PSUM_OPS[op]
         out = self._run(
@@ -367,6 +429,7 @@ class XlaDistGroup:
 
     @_recorded("allgather")
     def allgather(self, tensor, timeout_s=None):
+        self._check_poisoned("allgather")
         x = self._global(tensor)
         out = self._run(
             ("allgather", x.shape, str(x.dtype)),
@@ -386,6 +449,7 @@ class XlaDistGroup:
 
     @_recorded("reducescatter")
     def reducescatter(self, tensor, op=ReduceOp.SUM, timeout_s=None):
+        self._check_poisoned("reducescatter")
         x = self._global(tensor)
         if op is ReduceOp.SUM:
             out = self._run(

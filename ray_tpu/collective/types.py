@@ -112,15 +112,21 @@ class CollectiveMemberDiedError(CollectiveError):
 class CollectiveGroupDestroyedError(CollectiveError):
     """The group was destroyed while this op was in flight —
     destroy_collective_group fails pending futures instead of leaving
-    their awaiting coroutines pending forever."""
+    their awaiting coroutines pending forever. ``detail`` of
+    ``"reformed"`` means the peer incarnation moved to a new epoch (a
+    reform happened under this op): reform_in_place()/auto_reform can
+    rejoin, a plain destroy cannot."""
 
-    def __init__(self, group: str = "", op: str = ""):
-        super().__init__(group, op)
+    def __init__(self, group: str = "", op: str = "", detail: str = ""):
+        super().__init__(group, op, detail)
         self.group = group
         self.op = op
+        self.detail = detail
 
     def __str__(self):
+        tail = f" ({self.detail})" if self.detail else ""
         return (
             f"collective group {self.group!r} was destroyed"
             + (f" while {self.op} was in flight" if self.op else "")
+            + tail
         )

@@ -50,13 +50,35 @@ def _resources(args) -> dict:
     return total
 
 
-async def _serve_until_signal(stoppables) -> None:
-    """Run until SIGTERM/SIGINT, then stop services newest-first."""
+async def _serve_until_signal(stoppables, node=None) -> None:
+    """Run until SIGTERM/SIGINT, then stop services newest-first.
+
+    With a local ``node``, SIGTERM is treated as a preemption notice
+    (GCE delivers ~30s of ACPI-shutdown warning as SIGTERM): the node
+    self-reports DRAINING to the head — so schedulers divert and train
+    workers get their emergency-checkpoint window — and then keeps
+    serving for RAY_TPU_DRAIN_SIGTERM_LINGER_S (default 0: notify and
+    stop, which keeps `ray_tpu stop` prompt). A second signal always
+    cuts the linger short."""
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if node is not None and not node.draining:
+        from ray_tpu._private import config
+
+        try:
+            await asyncio.wait_for(node.self_drain("sigterm"), 2.0)
+        except Exception:  # noqa: BLE001 - head may already be gone
+            pass
+        linger = config.get("DRAIN_SIGTERM_LINGER_S")
+        if linger > 0:
+            stop.clear()
+            try:
+                await asyncio.wait_for(stop.wait(), linger)
+            except asyncio.TimeoutError:
+                pass
     for s in reversed(stoppables):
         try:
             await s.stop()
@@ -186,6 +208,7 @@ async def _run_head(args) -> None:
     config.set_system_config({"ADDRESS": addr})
 
     stoppables = [head]
+    node = None
     if not args.head_only:
         node = NodeManager(
             head_addr=addr,
@@ -230,7 +253,7 @@ async def _run_head(args) -> None:
             f"{session_dir}/auth.token",
             flush=True,
         )
-    await _serve_until_signal(stoppables)
+    await _serve_until_signal(stoppables, node=node)
 
 
 async def _run_node(args) -> None:
@@ -245,7 +268,7 @@ async def _run_node(args) -> None:
     )
     addr = await node.start(host=args.host)
     print(f"node up at {addr} (head {args.address})", flush=True)
-    await _serve_until_signal([node])
+    await _serve_until_signal([node], node=node)
 
 
 def main(argv=None) -> int:

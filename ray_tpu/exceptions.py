@@ -32,3 +32,26 @@ class GetTimeoutError(RayTpuError, TimeoutError):
 
 class ObjectLostError(RayTpuError):
     """An object's value is unrecoverable (owner and copies gone)."""
+
+
+class PreemptedError(RayTpuError):
+    """This worker's node is DRAINING (preemption notice / operator
+    drain) and an emergency checkpoint was just persisted: the train
+    attempt unwinds now, at a step boundary, so the controller resizes
+    and resumes losing at most one step instead of the whole
+    inter-checkpoint interval."""
+
+    def __init__(
+        self,
+        node_id: str | None = None,
+        reason: str = "",
+        deadline_ts: float | None = None,
+    ):
+        self.node_id = node_id
+        self.reason = reason
+        self.deadline_ts = deadline_ts
+        nid = (node_id or "?")[:12]
+        super().__init__(
+            f"node {nid}… is draining ({reason or 'no reason given'}); "
+            "emergency checkpoint taken, unwinding the attempt"
+        )

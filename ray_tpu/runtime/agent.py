@@ -51,6 +51,8 @@ class NodeAgent:
             "uptime_s": round(time.monotonic() - self._t0, 1),
             "workers": len(n.workers),
             "leases": len(n.leases),
+            "draining": n.draining,
+            "drain_info": n.drain_info,
             "ok": True,
         }
 
@@ -68,6 +70,8 @@ class NodeAgent:
             "spilled_objects": n.spilled_objects,
             "oom_kills": n.oom_kills,
             "res_version": n._res_version,
+            "draining": n.draining,
+            "drain_info": n.drain_info,
         }
 
     async def _logs_list(self, query) -> list:
@@ -139,6 +143,8 @@ class NodeAgent:
             f"ray_tpu_node_spilled_bytes {s['spilled_bytes']}",
             "# TYPE ray_tpu_node_oom_kills counter",
             f"ray_tpu_node_oom_kills {s['oom_kills']}",
+            "# TYPE ray_tpu_node_draining gauge",
+            f"ray_tpu_node_draining {int(self.node.draining)}",
         ]
         for k, v in self.node.available.items():
             lines.append(

@@ -39,6 +39,14 @@ class HeadService:
             )
         # node_id hex → {addr, resources, labels, last_seen, conn}
         self.nodes: dict[str, dict] = {}
+        # node_id hex → {reason, deadline_ts, since}: DRAINING nodes.
+        # A draining node stays in the node table (its leases keep
+        # running, its heartbeats keep counting) but receives no new
+        # task leases, placements, or bundles; the notice fans out on
+        # pubsub so workers learn BEFORE the node dies. Journaled: a
+        # head restart must not resurrect a preempting node into the
+        # schedulable pool.
+        self.draining: dict[str, dict] = {}
         self.kv: dict[str, bytes] = {}
         # actor_id hex → {name, state, addr, node_id, class_name}
         self.actors: dict[str, dict] = {}
@@ -141,6 +149,17 @@ class HeadService:
                     pid: dict(pg)
                     for pid, pg in payload["placement_groups"].items()
                 }
+                self.draining = {
+                    nid: dict(d)
+                    for nid, d in payload.get("draining", {}).items()
+                }
+            elif table == "drain":
+                if op == "put":
+                    self.draining[payload["node_id"]] = dict(
+                        payload["fields"]
+                    )
+                else:
+                    self.draining.pop(payload["node_id"], None)
             elif table == "kv":
                 if op == "put":
                     self.kv[payload["key"]] = payload["value"]
@@ -176,6 +195,9 @@ class HeadService:
             "placement_groups": {
                 pid: dict(pg)
                 for pid, pg in self.placement_groups.items()
+            },
+            "draining": {
+                nid: dict(d) for nid, d in self.draining.items()
             },
         }
 
@@ -233,8 +255,30 @@ class HeadService:
         if old is not None:
             await old.close()
         self._node_conns[node_id] = await rpc.connect(addr)
+        if node_id in self.draining:
+            # A draining node re-registering (head restart, conn blip)
+            # must come back DRAINING on both sides: re-push the flag so
+            # its local lease path keeps refusing work.
+            d = self.draining[node_id]
+            asyncio.ensure_future(
+                self._push_set_draining(node_id, d)
+            )
         self.publish("node", {"event": "added", "node_id": node_id, "addr": addr})
         return {"ok": True}
+
+    async def _push_set_draining(self, node_id: str, d: dict):
+        conn = self._node_conns.get(node_id)
+        if conn is None:
+            return
+        try:
+            await conn.call(
+                "set_draining",
+                draining=True,
+                reason=d.get("reason", ""),
+                deadline_ts=d.get("deadline_ts"),
+            )
+        except Exception:  # noqa: BLE001 - node may be mid-death
+            pass
 
     async def _on_sync(
         self,
@@ -257,6 +301,10 @@ class HeadService:
         node["res_version"] = version
         node["available"] = available
         node["pending"] = pending or []
+        if node_id in self.draining:
+            # Draining nodes are excluded from the scheduling columns;
+            # their syncs must not trigger a rebuild (i would be None).
+            return {"ok": True}
         cols = self._sched_cols
         if cols is not None:
             i = cols["idx"].get(node_id)
@@ -281,6 +329,9 @@ class HeadService:
         self._expire_unschedulable()
         return {
             "unschedulable": [r for r, _ts in self.unschedulable.values()],
+            "draining": {
+                nid: dict(d) for nid, d in self.draining.items()
+            },
             "nodes": {
                 nid: {
                     "addr": n["addr"],
@@ -308,6 +359,98 @@ class HeadService:
             "node_id": node_id,
             "addr": node["addr"],
             "labels": node.get("labels", {}),
+        }
+
+    # ------------------------------------------------------ node drain
+    async def _on_drain_node(
+        self,
+        conn,
+        node_id: str,
+        reason: str = "",
+        deadline_s: float | None = None,
+    ):
+        """Move a node to DRAINING: excluded from every placement path
+        (pick_node, placement groups, actor restarts) while its existing
+        leases keep running, with the notice fanned out on pubsub so
+        workers learn before the node dies. Idempotent — the first
+        notice's deadline wins (a preemption clock does not restart)."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return {"ok": False, "error": f"unknown node {node_id[:12]}…"}
+        rec = self.draining.get(node_id)
+        if rec is not None:
+            return {"ok": True, "already": True, **rec}
+        from ray_tpu._private import config
+
+        if deadline_s is None:
+            deadline_s = config.get("DRAIN_DEADLINE_S")
+        now = time.time()
+        rec = self.draining[node_id] = {
+            "reason": reason,
+            "deadline_ts": now + float(deadline_s),
+            "since": now,
+        }
+        self._journal_append(
+            "drain", "put", {"node_id": node_id, "fields": dict(rec)}
+        )
+        self._sched_cols = None  # schedulable set changed
+        self.publish(
+            "node",
+            {
+                "event": "draining",
+                "node_id": node_id,
+                "addr": node["addr"],
+                "reason": reason,
+                "deadline_ts": rec["deadline_ts"],
+            },
+        )
+        # Reuse the death fan-out channel: every process watching for
+        # collective member deaths learns about the drain with no extra
+        # subscription — this is what gives train workers their
+        # emergency-checkpoint window.
+        self.publish(
+            "collective",
+            {
+                "event": "node_draining",
+                "node_id": node_id,
+                "node_addr": node["addr"],
+                "reason": reason,
+                "deadline_s": float(deadline_s),
+                "deadline_ts": rec["deadline_ts"],
+            },
+        )
+        await self._push_set_draining(node_id, rec)
+        return {"ok": True, **rec}
+
+    async def _on_undrain_node(self, conn, node_id: str):
+        """Cancel a drain (maintenance event cleared, operator abort):
+        the node rejoins the schedulable pool."""
+        rec = self.draining.pop(node_id, None)
+        if rec is None:
+            return {"ok": False}
+        self._journal_append("drain", "del", {"node_id": node_id})
+        self._sched_cols = None
+        node = self.nodes.get(node_id)
+        addr = node["addr"] if node else None
+        self.publish(
+            "node",
+            {"event": "undrained", "node_id": node_id, "addr": addr},
+        )
+        self.publish(
+            "collective",
+            {"event": "node_undrain", "node_id": node_id, "node_addr": addr},
+        )
+        conn_ = self._node_conns.get(node_id)
+        if conn_ is not None:
+            try:
+                await conn_.call("set_draining", draining=False)
+            except Exception:  # noqa: BLE001
+                pass
+        return {"ok": True}
+
+    async def _on_drain_table(self, conn):
+        return {
+            "draining": {nid: dict(d) for nid, d in self.draining.items()}
         }
 
     async def _on_pick_node(
@@ -342,6 +485,8 @@ class HeadService:
         # so concurrent drivers don't herd onto one node.
         candidates: list[tuple[tuple, str]] = []
         for nid, node in self.nodes.items():
+            if nid in self.draining:
+                continue  # drained nodes take no new leases
             avail = node["available"]
             total = node["resources"]
             if any(total.get(k, 0) < v for k, v in resources.items()):
@@ -400,11 +545,14 @@ class HeadService:
         if cols is None:
             import numpy as np
 
-            nids = list(self.nodes)
+            # Draining nodes never enter the columns (drain/undrain and
+            # membership changes all invalidate), so the hot label-free
+            # pick stays exclusion-free at scan time.
+            nids = [nid for nid in self.nodes if nid not in self.draining]
             kinds: set[str] = set()
-            for n in self.nodes.values():
-                kinds.update(n["resources"])
-                kinds.update(n["available"])
+            for nid in nids:
+                kinds.update(self.nodes[nid]["resources"])
+                kinds.update(self.nodes[nid]["available"])
             cols = self._sched_cols = {
                 "nids": nids,
                 "idx": {nid: i for i, nid in enumerate(nids)},
@@ -1062,7 +1210,7 @@ class HeadService:
         avail = {
             nid: dict(n["available"])
             for nid, n in self.nodes.items()
-            if nid not in excluded
+            if nid not in excluded and nid not in self.draining
         }
 
         def fits(nid, bundle):
@@ -1376,6 +1524,10 @@ class HeadService:
         node = self.nodes.pop(nid, None)
         if node is None:
             return
+        if self.draining.pop(nid, None) is not None:
+            # The drain completed in death; a journal replay must not
+            # carry the tombstone forward.
+            self._journal_append("drain", "del", {"node_id": nid})
         self._sched_cols = None  # membership changed
         conn = self._node_conns.pop(nid, None)
         if conn is not None:

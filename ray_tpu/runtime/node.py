@@ -438,6 +438,13 @@ class NodeManager:
         # versioned per-node updates pushed on CHANGE, not polled).
         self._res_version = 0
         self._sync_event: asyncio.Event | None = None
+        # DRAINING: set by the head's drain fan-out or by this node's
+        # own preemption watcher / SIGTERM handler. A draining node
+        # refuses NEW leases (retry_spill bounces the caller to the
+        # head, which excludes draining nodes) while existing leases
+        # and bundle-backed work keep running until the deadline.
+        self.draining = False
+        self.drain_info: dict | None = None
         # Per-node dashboard agent (reference: dashboard/agent.py).
         self.agent = None
 
@@ -473,6 +480,11 @@ class NodeManager:
         self._tasks.append(asyncio.ensure_future(self._spill_loop()))
         self._tasks.append(asyncio.ensure_future(self._memory_loop()))
         self._tasks.append(asyncio.ensure_future(self._log_monitor_loop()))
+        src = self._preemption_source()
+        if src is not None:
+            self._tasks.append(
+                asyncio.ensure_future(self._preemption_watch_loop(src))
+            )
         # Prestart workers up to the CPU count so the first task burst
         # doesn't pay Python-interpreter spawn latency per lease
         # (reference: WorkerPool prestarts workers, worker_pool.h:280).
@@ -874,6 +886,116 @@ class NodeManager:
             raise rpc.RpcError(f"node: unknown method {method!r}")
         return await fn(conn=conn, **rpc.tolerant_kwargs(fn, kw))
 
+    # ------------------------------------------------------- node drain
+    async def _on_set_draining(
+        self,
+        conn,
+        draining: bool = True,
+        reason: str = "",
+        deadline_ts: float | None = None,
+    ):
+        """Head-pushed drain flag (the head is the authority; this flag
+        makes the node's OWN lease path refuse work, which is what
+        diverts local-first task/actor placement to other nodes)."""
+        self.draining = bool(draining)
+        self.drain_info = (
+            {"reason": reason, "deadline_ts": deadline_ts}
+            if draining
+            else None
+        )
+        if draining:
+            # Queued-but-ungranted leases bounce now — their callers
+            # should spill to a node that will outlive them.
+            for resources, actor, fut, _ts, _renv in self._pending:
+                if not fut.done():
+                    fut.set_result(
+                        {
+                            "ok": False,
+                            "retry_spill": True,
+                            "draining": True,
+                            "error": "node is draining",
+                        }
+                    )
+            self._pending = []
+            self._bump_resources()
+        return {"ok": True}
+
+    async def self_drain(
+        self, reason: str, deadline_s: float | None = None
+    ) -> None:
+        """Self-reported drain (preemption notice, SIGTERM): flip the
+        local flag first — no new lease may slip in while the head RPC
+        is in flight — then tell the head so the notice fans out."""
+        from ray_tpu._private import config
+
+        if deadline_s is None:
+            deadline_s = config.get("DRAIN_DEADLINE_S")
+        already = self.draining
+        self.draining = True
+        self.drain_info = {
+            "reason": reason,
+            "deadline_ts": time.time() + float(deadline_s),
+        }
+        if already:
+            return
+        await self._on_set_draining(None, draining=True, reason=reason,
+                                    deadline_ts=self.drain_info["deadline_ts"])
+        if self.head is not None:
+            try:
+                await self.head.call(
+                    "drain_node",
+                    node_id=self.node_id,
+                    reason=reason,
+                    deadline_s=deadline_s,
+                )
+            except rpc.RpcError:
+                pass
+
+    def _preemption_source(self):
+        """Pluggable preemption-notice source: the synthetic
+        RAY_TPU_PREEMPT_AFTER_S spec for tests, the GCE maintenance-
+        event metadata poller on Google VMs, else none."""
+        from ray_tpu._private import config
+
+        spec = config.get("PREEMPT_AFTER_S")
+        if spec:
+            from ray_tpu._private.test_utils import FakePreemptionSource
+
+            return FakePreemptionSource(spec)
+        try:
+            with open("/sys/class/dmi/id/product_name") as f:
+                on_gce = "Google" in f.read()
+        except OSError:
+            on_gce = False
+        if on_gce:
+            try:
+                from ray_tpu.autoscaler.gcp import GceMaintenanceEventSource
+
+                return GceMaintenanceEventSource()
+            except Exception:  # noqa: BLE001 - optional dependency path
+                return None
+        return None
+
+    async def _preemption_watch_loop(self, source):
+        """Poll the preemption source until it reports a notice, then
+        self-drain with the notice's deadline and exit. The poll cadence
+        is the source's (metadata endpoints want seconds, the fake wants
+        sub-second determinism)."""
+        interval = getattr(source, "interval_s", 1.0)
+        while not self.draining:
+            await asyncio.sleep(interval)
+            try:
+                notice = source.poll(self)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 - a flaky metadata server
+                continue      # must not kill the watcher
+            if notice is None:
+                continue
+            reason, deadline_s = notice
+            await self.self_drain(reason, deadline_s)
+            return
+
     # ---------------------------------------------------- object serving
     def _store(self):
         if self._store_reader is None:
@@ -1124,6 +1246,17 @@ class NodeManager:
         ``bundle`` = (pg_id, index), resources come from that reserved
         placement-group bundle instead of the node's general pool."""
         resources = dict(resources or {"CPU": 1.0})
+        if self.draining and bundle is None:
+            # retry_spill (not infeasible): the caller's spillback path
+            # re-picks through the head, which excludes draining nodes.
+            # Bundle-backed leases stay honored — the bundle was gang-
+            # reserved before the drain and dies with the node anyway.
+            return {
+                "ok": False,
+                "retry_spill": True,
+                "draining": True,
+                "error": "node is draining; lease elsewhere",
+            }
         if bundle is not None:
             b = self.bundles.get(tuple(bundle))
             if b is None:
@@ -1199,6 +1332,13 @@ class NodeManager:
         self, conn, pg_id: str, index: int, resources: dict
     ):
         resources = dict(resources)
+        if self.draining and (pg_id, index) not in self.bundles:
+            # The head's planner already excludes draining nodes; this
+            # backstops a plan computed before the drain landed.
+            return {
+                "ok": False,
+                "error": f"node {self.node_id[:8]} is draining",
+            }
         if (pg_id, index) in self.bundles:
             # Idempotent re-reserve: the head may retry after a lost
             # response (reference: node_manager.proto documents per-RPC
@@ -1282,6 +1422,8 @@ class NodeManager:
             "spilled_bytes": self.spilled_bytes,
             "spilled_objects": self.spilled_objects,
             "oom_kills": self.oom_kills,
+            "draining": self.draining,
+            "drain_info": self.drain_info,
         }
 
     def _enforce_idle_cap(self):

@@ -186,8 +186,19 @@ class ServeController:
             await asyncio.sleep(_CONTROL_PERIOD_S)
         return True
 
+    async def _draining_nodes(self, core) -> set:
+        """Node ids the head reports as DRAINING — refreshed every
+        reconcile pass so migration starts within one control period of
+        the drain notice."""
+        try:
+            reply = await core.head.call("drain_table")
+            return set(reply.get("draining") or {})
+        except Exception:  # noqa: BLE001 - head busy/old: no migration
+            return set()
+
     async def _reconcile_once(self):
         core = core_api._runtime.core
+        draining = await self._draining_nodes(core)
         # Evict handle-demand entries from routers that stopped reporting.
         now = time.monotonic()
         for key, routers in list(self._handle_demand.items()):
@@ -208,16 +219,32 @@ class ServeController:
             # seconds must not freeze health checks and autoscaling for
             # every other deployment (the stale-record guard in
             # _start_replica makes late completions safe).
-            need = (
-                dep["target"] - len(dep["replicas"]) - dep.get("starting", 0)
+            #
+            # Drain migration is start-replacement-FIRST: replicas on
+            # draining nodes keep serving (they don't count as healthy,
+            # so `need` starts their replacements off-node — the head
+            # and the draining node itself refuse new placements there)
+            # and are retired only once the healthy count reaches
+            # target, via the victim ordering below. Requests never see
+            # a window with fewer than `target` live replicas.
+            n_draining = sum(
+                1
+                for r in dep["replicas"]
+                if r.get("node_id") in draining
             )
+            healthy = len(dep["replicas"]) - n_draining
+            need = dep["target"] - healthy - dep.get("starting", 0)
             for _ in range(max(0, need)):
                 dep["starting"] = dep.get("starting", 0) + 1
                 self._spawn_bg(self._start_replica_tracked(core, dep))
             excess = len(dep["replicas"]) - dep["target"]
             if excess > 0:
-                victims = dep["replicas"][-excess:]
-                dep["replicas"] = dep["replicas"][:-excess]
+                victims = self._scale_down_victims(
+                    dep["replicas"], draining, excess
+                )
+                dep["replicas"] = [
+                    r for r in dep["replicas"] if r not in victims
+                ]
                 dep["version"] += 1
                 for r in victims:
                     try:
@@ -226,9 +253,28 @@ class ServeController:
                         pass
             dep["status"] = (
                 "HEALTHY"
-                if len(dep["replicas"]) == dep["target"]
+                if len(dep["replicas"]) == dep["target"] and not n_draining
                 else "UPDATING"
             )
+
+    @staticmethod
+    def _scale_down_victims(
+        replicas: list, draining: set, excess: int
+    ) -> list:
+        """Scale-down victim order: draining-node replicas first (they
+        are already condemned), then the flakiest (highest health-poll
+        miss count), then the OLDEST — never the newest/warmest, which
+        the previous `replicas[-excess:]` slice used to kill right after
+        paying their cold start."""
+        ranked = sorted(
+            replicas,
+            key=lambda r: (
+                0 if r.get("node_id") in draining else 1,
+                -r.get("misses", 0),
+                r.get("started_at", 0.0),
+            ),
+        )
+        return ranked[:excess]
 
     async def _poll_stats(self, core, dep: dict):
         if not dep["replicas"]:
@@ -343,11 +389,28 @@ class ServeController:
                 2 * cfg.get("max_ongoing_requests", 5), 16
             ),
         )
+        # Which node hosts this replica? The head's actor registry knows
+        # — needed so drain migration and victim selection can reason
+        # per-node.
+        node_id = None
+        try:
+            info = await core.head.call("get_actor", actor_id=actor_id)
+            if info.get("ok"):
+                node_id = info.get("node_id")
+        except Exception:  # noqa: BLE001 - registry miss: unknown node
+            pass
         key = (dep["app"], dep["name"])
         if self._deployments.get(key) is not dep:
             # The deployment was redeployed or deleted while this replica
             # was starting; appending to the stale record would orphan it.
             await self._kill_quietly(core, {"actor_id": actor_id, "addr": addr})
             return
-        dep["replicas"].append({"actor_id": actor_id, "addr": addr})
+        dep["replicas"].append(
+            {
+                "actor_id": actor_id,
+                "addr": addr,
+                "node_id": node_id,
+                "started_at": time.monotonic(),
+            }
+        )
         dep["version"] += 1

@@ -16,11 +16,13 @@ from ray_tpu.train.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
+from ray_tpu.exceptions import PreemptedError
 from ray_tpu.train.session import (
     collective_group_name,
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    preemption_notice,
     report,
     step_span,
 )
@@ -48,6 +50,8 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "preemption_notice",
+    "PreemptedError",
     "report",
     "step_span",
     "ElasticScalingPolicy",

@@ -189,6 +189,30 @@ class CheckpointManager:
         entries = self._entries()
         return os.path.join(self.dir, entries[-1][1]) if entries else None
 
+    def restore_latest_valid(
+        self, target: Any = None, shardings: Any = None
+    ) -> tuple[str, Any] | None:
+        """Restore the newest checkpoint that actually loads.
+
+        A partially-written or corrupt orbax dir (node died mid-save
+        outside the rename window, disk hiccup) must cost one retention
+        slot, not the whole run: on a restore failure, fall back to the
+        next-older entry instead of failing the attempt. Returns
+        ``(path, state)`` or None when nothing restores."""
+        for _step, name in reversed(self._entries()):
+            path = os.path.join(self.dir, name)
+            try:
+                return path, restore_checkpoint(
+                    path, target=target, shardings=shardings
+                )
+            except Exception as e:  # noqa: BLE001 - any load failure
+                print(
+                    f"ray_tpu.train: checkpoint {name} failed to "
+                    f"restore ({e!r}); falling back to the previous one",
+                    flush=True,
+                )
+        return None
+
     def best(self) -> str | None:
         entries = self._entries()
         if not entries:

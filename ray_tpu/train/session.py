@@ -82,6 +82,44 @@ def get_checkpoint() -> str | None:
     return get_context().latest_checkpoint
 
 
+def _own_node_notice() -> dict | None:
+    """Drain notice for THIS worker's node (the one whose death this
+    process will not survive), or None."""
+    from ray_tpu.runtime import drain
+
+    try:
+        import ray_tpu.api as api
+
+        core = getattr(api._runtime, "core", None)
+        node_addr = getattr(core, "node_addr", None) if core else None
+    except Exception:  # noqa: BLE001 - session without a runtime
+        node_addr = None
+    return drain.for_node_addr(node_addr)
+
+
+def preemption_notice() -> dict | None:
+    """The active node-drain notice this train loop should react to, or
+    None. Own-node notices win; otherwise ANY draining node's notice is
+    returned so rank 0 can persist the emergency checkpoint for a peer
+    whose node is about to die.
+
+    The canonical loop pattern — checkpoint at the next step boundary
+    inside the notice window, losing at most one step::
+
+        ck = None
+        if step % ckpt_every == 0 or train.preemption_notice():
+            ck = save_my_state(...)
+        train.report(metrics, checkpoint=ck)
+
+    When this worker's OWN node is draining and a checkpoint was just
+    handed to report(), report() raises :class:`PreemptedError` to
+    unwind the attempt cleanly (toggle: RAY_TPU_TRAIN_EMERGENCY_
+    CHECKPOINT)."""
+    from ray_tpu.runtime import drain
+
+    return _own_node_notice() or drain.any_notice()
+
+
 def get_dataset_shard(name: str = "train"):
     """This worker's split of a dataset passed to JaxTrainer(datasets=...)
     (reference: ray.train.get_dataset_shard → DataIterator). Returns a
@@ -179,3 +217,22 @@ def report(metrics: dict, checkpoint: str | None = None) -> None:
     if not ctx._used_step_timer and telemetry.telemetry_enabled():
         telemetry.implicit_step(ctx, now, metrics)
     ctx._last_report_wall = now
+    # Emergency-checkpoint unwind: this worker's node is DRAINING and the
+    # loop just put a checkpoint in hand — end the attempt NOW, at a step
+    # boundary, so the controller resizes and resumes losing ≤1 step
+    # instead of whatever remained of the inter-checkpoint interval.
+    # Raised AFTER the step/checkpoint is fully accounted (ledger-wise
+    # the step that produced the emergency checkpoint is productive).
+    if checkpoint is not None:
+        from ray_tpu._private import config
+
+        if config.get("TRAIN_EMERGENCY_CHECKPOINT"):
+            notice = _own_node_notice()
+            if notice is not None:
+                from ray_tpu.exceptions import PreemptedError
+
+                raise PreemptedError(
+                    node_id=notice.get("node_id"),
+                    reason=notice.get("reason", ""),
+                    deadline_ts=notice.get("deadline_ts"),
+                )

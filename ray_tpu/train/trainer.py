@@ -174,6 +174,17 @@ class TrainWorker:
                 os.environ["JAX_PLATFORMS"] = jax_platform
             else:
                 os.environ.pop("JAX_PLATFORMS", None)
+        # Watch the head's drain fan-out (the PR-1 death channel): a
+        # preemption notice for any node must reach this worker BEFORE
+        # the node dies so the loop can take its emergency checkpoint
+        # at the next step boundary (train.preemption_notice()).
+        try:
+            import ray_tpu.collective as _col
+
+            rt = ray_tpu.api._runtime
+            rt.run(_col._ensure_death_watch(rt.core))
+        except Exception:  # noqa: BLE001 - client-mode / degraded head:
+            pass           # training works, only the notice window is lost
         collective_group = ""
         attempt = int(backend_env.get("RAY_TPU_TRAIN_ATTEMPT", "0"))
         col_timeout = backend_env.get("RAY_TPU_TRAIN_COLLECTIVE_TIMEOUT_S")
@@ -365,6 +376,24 @@ class JaxTrainer:
             )
 
     @staticmethod
+    def _is_preemption(err: Exception | None) -> bool:
+        """Did the attempt unwind on a drain-notice emergency checkpoint
+        (PreemptedError)? Like collective aborts, the failure is
+        *detected*, not inferred — the retry can size and start as soon
+        as the node table holds still."""
+        from ray_tpu.exceptions import PreemptedError
+
+        seen = 0
+        while err is not None and seen < 8:
+            if isinstance(err, PreemptedError) or "PreemptedError" in str(
+                err
+            ):
+                return True
+            err = getattr(err, "cause", None) or err.__cause__
+            seen += 1
+        return False
+
+    @staticmethod
     def _is_collective_abort(err: Exception | None) -> bool:
         """Did the attempt fail on a typed collective abort? Checks the
         exception and its carried causes — worker errors arrive wrapped
@@ -403,7 +432,7 @@ class JaxTrainer:
         from ray_tpu._private import config as _config
 
         budget = _config.get("HEALTH_TIMEOUT_S") + 2.0
-        if not self._is_collective_abort(err):
+        if not (self._is_collective_abort(err) or self._is_preemption(err)):
             time.sleep(budget)
             return
         deadline = time.monotonic() + budget
@@ -424,13 +453,17 @@ class JaxTrainer:
 
     def _cluster_free(self) -> list[dict]:
         """Per-live-node available resources (the scaling policy's view
-        of what an attempt can place)."""
+        of what an attempt can place). Draining nodes are excluded —
+        counting a preempting node's capacity would size an attempt the
+        placement layer can no longer satisfy."""
         try:
             rt = ray_tpu.api._runtime
             status = rt.run(rt.core.head.call("cluster_status"))
+            draining = set(status.get("draining") or {})
             return [
                 dict(n.get("available", {}))
-                for n in status.get("nodes", {}).values()
+                for nid, n in status.get("nodes", {}).items()
+                if nid not in draining
             ]
         except Exception:  # noqa: BLE001 - policy falls back to config
             return []
@@ -443,6 +476,11 @@ class JaxTrainer:
         )
 
     def _find_latest_checkpoint(self) -> str | None:
+        """Newest VALID checkpoint dir for the resume path. A dying
+        attempt can leave a half-copied (or empty) newest dir behind;
+        resuming from it would fail the next attempt too — fall back to
+        the previous entry instead (the restore_latest_valid semantics,
+        applied to the trainer's own report()-persisted dirs)."""
         import os
 
         d = self._run_dir()
@@ -451,7 +489,14 @@ class JaxTrainer:
         cks = sorted(
             p for p in os.listdir(d) if p.startswith("checkpoint_")
         )
-        return os.path.join(d, cks[-1]) if cks else None
+        for name in reversed(cks):
+            path = os.path.join(d, name)
+            try:
+                if os.path.isdir(path) and os.listdir(path):
+                    return path
+            except OSError:
+                continue
+        return None
 
     def _backend_env(
         self, rank: int, attempt: int = 0, n_workers: int | None = None

@@ -211,9 +211,14 @@ def snapshot() -> dict:
 
 
 def clear_registry():
-    """Test helper."""
+    """Test helper: zero every metric without deregistering it.
+
+    Live metric objects (module-level singletons like the collective
+    flight recorder's) keep recording after a clear; dropping them from
+    the registry would orphan them — still counting, never scraped."""
     with _LOCK:
-        _REGISTRY.clear()
+        for m in _REGISTRY.values():
+            m._series.clear()
 
 
 def merge_snapshots(worker_snaps: dict[str, dict]) -> dict:

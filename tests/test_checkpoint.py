@@ -83,6 +83,35 @@ def test_manager_keeps_topk_by_step(tmp_path):
     assert float(restore_checkpoint(latest)["x"]) == 3.0
 
 
+def test_manager_restore_latest_valid_falls_back(tmp_path):
+    """A corrupt/partial newest checkpoint (node preempted mid-save
+    outside the rename window) must cost one entry, not the run:
+    restore_latest_valid falls back to the previous one."""
+    import shutil
+
+    mgr = CheckpointManager(str(tmp_path / "run"), num_to_keep=3)
+    for step in range(3):
+        mgr.save(step, {"x": jnp.float32(step)})
+    # Corrupt the newest: gut its orbax state dir.
+    newest = mgr.latest()
+    assert newest.endswith("ckpt-00000002")
+    shutil.rmtree(newest + "/state")
+    (tmp_path / "run" / "ckpt-00000002" / "state").mkdir()
+
+    with pytest.raises(Exception):
+        restore_checkpoint(newest)  # plain restore still fails loudly
+    out = mgr.restore_latest_valid()
+    assert out is not None
+    path, state = out
+    assert path.endswith("ckpt-00000001")
+    assert float(state["x"]) == 1.0
+
+    # Nothing valid at all → None, not an exception.
+    for name in list((tmp_path / "run").iterdir()):
+        shutil.rmtree(name)
+    assert mgr.restore_latest_valid() is None
+
+
 def test_manager_keeps_best_by_metric(tmp_path):
     mgr = CheckpointManager(
         str(tmp_path / "run"),

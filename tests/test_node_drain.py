@@ -1,0 +1,535 @@
+"""Node drain lifecycle: DRAINING state, preemption-aware elastic
+training, warm serve-replica migration, and in-place collective reform.
+
+A drained node stays alive but takes no new work; the notice fans out on
+pubsub before the node dies, buying the trainer an emergency-checkpoint
+window (lose ≤1 step, not the inter-checkpoint interval), serve a
+start-replacement-first migration, and the autoscaler a head start on
+the replacement. Deterministic variants run in tier-1; the kill-based
+ones carry the ``chaos`` marker.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import api as core_api
+from ray_tpu._private import config as _config
+from ray_tpu.train import (
+    ElasticScalingPolicy,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def _head_call(method, **kw):
+    rt = core_api._runtime
+    return rt.run(rt.core.head.call(method, **kw))
+
+
+def _add_node(tmp_path, name, resources):
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+
+    async def launch():
+        node = NodeManager(
+            rt.core.head_addr,
+            str(tmp_path / f"{name}_store"),
+            resources=resources,
+        )
+        await node.start()
+        return node
+
+    return rt.run(launch())
+
+
+def _stop_node(node):
+    try:
+        core_api._runtime.run(node.stop())
+    except Exception:  # noqa: BLE001 - may already be dead
+        pass
+
+
+# ----------------------------------------------------- lifecycle basics
+@pytest.fixture
+def cluster_with_gpux(tmp_path):
+    ray_tpu.init(num_cpus=2)
+    node = _add_node(tmp_path, "gpux", {"CPU": 2.0, "GPUX": 4.0})
+    yield node
+    _stop_node(node)
+    ray_tpu.shutdown()
+
+
+def test_drain_excludes_node_from_scheduling(cluster_with_gpux):
+    """A DRAINING node gets no new picks, bundles, or direct leases —
+    and undrain restores all three."""
+    node = cluster_with_gpux
+    rt = core_api._runtime
+
+    reply = _head_call("pick_node", resources={"GPUX": 1.0})
+    assert reply["ok"] and reply["node_id"] == node.node_id
+
+    reply = _head_call(
+        "drain_node", node_id=node.node_id, reason="test", deadline_s=60
+    )
+    assert reply["ok"]
+    # Idempotent: the first deadline wins.
+    again = _head_call("drain_node", node_id=node.node_id, deadline_s=1)
+    assert again["ok"] and again.get("already")
+    assert node.node_id in _head_call("drain_table")["draining"]
+
+    # Head-side placement: both the fast label-free pick and the PG
+    # planner skip the draining node.
+    assert not _head_call("pick_node", resources={"GPUX": 1.0})["ok"]
+    pg = _head_call(
+        "create_placement_group",
+        pg_id="pg_drain",
+        bundles=[{"GPUX": 1.0}],
+        strategy="PACK",
+    )
+    assert not pg["ok"]
+
+    # Node-side lease path: direct leases bounce with retry_spill, new
+    # bundle reservations are refused.
+    async def direct_lease():
+        conn = await rt.core._connect(node.addr)
+        return await conn.call("lease_worker", resources={"CPU": 1.0})
+
+    granted = rt.run(direct_lease())
+    assert not granted["ok"] and granted.get("retry_spill")
+    assert granted.get("draining")
+    reserve = rt.run(
+        rt.core._connect(node.addr)
+    )
+    reply = rt.run(
+        reserve.call("reserve_bundle", pg_id="x", index=0,
+                     resources={"CPU": 1.0})
+    )
+    assert not reply["ok"] and "draining" in reply["error"]
+    assert node.draining and node.drain_info["reason"] == "test"
+
+    assert _head_call("undrain_node", node_id=node.node_id)["ok"]
+    assert not node.draining
+    assert _head_call("pick_node", resources={"GPUX": 1.0})["ok"]
+
+
+def test_drain_survives_head_restart(tmp_path):
+    """DRAINING is journaled: after a head crash+restart, the
+    re-registered node is still excluded from placement and gets its
+    drain flag re-pushed."""
+    journal = str(tmp_path / "head.journal")
+    info = ray_tpu.init(
+        num_cpus=2, _system_config={"HEAD_JOURNAL": journal}
+    )
+    node = _add_node(tmp_path, "drainj", {"CPU": 2.0, "JX": 1.0})
+    try:
+        assert _head_call(
+            "drain_node", node_id=node.node_id, reason="preempt",
+            deadline_s=300,
+        )["ok"]
+
+        rt = core_api._runtime
+        old_head = rt.head
+        host, port = info["address"].rsplit(":", 1)
+
+        async def crash_restart():
+            from ray_tpu.runtime.head import HeadService
+
+            if old_head._reaper:
+                old_head._reaper.cancel()
+            await old_head.server.stop()
+            if old_head.journal is not None:
+                old_head.journal.close()
+            new_head = HeadService(journal_path=journal)
+            await new_head.start(host, int(port))
+            return new_head
+
+        rt.head = rt.run(crash_restart())
+
+        # Wait for the node's reconnecting heartbeat to re-register.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            table = _head_call("node_table")
+            if node.node_id in table:
+                break
+            time.sleep(0.3)
+        assert node.node_id in table
+
+        drains = _head_call("drain_table")["draining"]
+        assert node.node_id in drains
+        assert drains[node.node_id]["reason"] == "preempt"
+        assert not _head_call("pick_node", resources={"JX": 1.0})["ok"]
+    finally:
+        _stop_node(node)
+        ray_tpu.shutdown()
+        _config._overrides.pop("HEAD_JOURNAL", None)
+        os.environ.pop("RAY_TPU_HEAD_JOURNAL", None)
+
+
+@pytest.mark.chaos
+def test_synthetic_preemption_notice_self_drains(tmp_path):
+    """RAY_TPU_PREEMPT_AFTER_S chaos spec: the targeted node's
+    preemption watcher self-reports DRAINING with the notice deadline;
+    other nodes are untouched."""
+    ray_tpu.init(num_cpus=2)
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+    node = NodeManager(
+        rt.core.head_addr,
+        str(tmp_path / "pre_store"),
+        resources={"CPU": 1.0, "PRE": 1.0},
+    )
+    os.environ["RAY_TPU_PREEMPT_AFTER_S"] = f"0.4@{node.node_id[:12]}"
+    try:
+        rt.run(node.start())
+        deadline = time.monotonic() + 15
+        drains = {}
+        while time.monotonic() < deadline:
+            drains = _head_call("drain_table")["draining"]
+            if node.node_id in drains:
+                break
+            time.sleep(0.2)
+        assert node.node_id in drains
+        assert drains[node.node_id]["reason"] == "synthetic-preemption"
+        assert node.draining
+        # Only the targeted node drained.
+        assert len(drains) == 1
+    finally:
+        os.environ.pop("RAY_TPU_PREEMPT_AFTER_S", None)
+        _stop_node(node)
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------- preemption-aware training
+@pytest.fixture
+def two_slice_cluster(tmp_path):
+    ray_tpu.init(num_cpus=2, _system_config={"HEALTH_TIMEOUT_S": 4.0})
+    nodes = [
+        _add_node(tmp_path, f"slice{i}", {"CPU": 2.0, "SLICE": 1.0})
+        for i in range(2)
+    ]
+    yield nodes
+    for node in nodes:
+        _stop_node(node)
+    ray_tpu.shutdown()
+    _config._overrides.pop("HEALTH_TIMEOUT_S", None)
+    os.environ.pop("RAY_TPU_HEALTH_TIMEOUT_S", None)
+
+
+def _preempt_loop(config):
+    """Checkpoints every 5 epochs — and immediately at the next step
+    boundary when a preemption notice is up (the documented emergency-
+    checkpoint pattern). Rank 0 of attempt 0 publishes its node addr so
+    the test can drain exactly that node."""
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    start_epoch = 0
+    ck = train.get_checkpoint()
+    if ck:
+        with open(os.path.join(ck, "state.json")) as f:
+            start_epoch = json.load(f)["epoch"] + 1
+    with open(
+        os.path.join(
+            config["scratch"], f"attempt{ctx.attempt}_rank{ctx.rank}"
+        ),
+        "w",
+    ) as f:
+        f.write(str(start_epoch))
+    if ctx.rank == 0 and ctx.attempt == 0:
+        from ray_tpu import api as _api
+
+        with open(config["marker"], "w") as f:
+            f.write(_api._runtime.core.node_addr or "")
+    for epoch in range(start_epoch, config["epochs"]):
+        time.sleep(0.15)  # one "step" of work
+        ckdir = None
+        if epoch % 5 == 0 or train.preemption_notice() is not None:
+            ckdir = os.path.join(
+                config["scratch"], f"rank{ctx.rank}_ep{epoch}"
+            )
+            os.makedirs(ckdir, exist_ok=True)
+            with open(os.path.join(ckdir, "state.json"), "w") as f:
+                json.dump({"epoch": epoch, "world": ctx.world_size}, f)
+        train.report(
+            {"epoch": epoch, "world": ctx.world_size}, checkpoint=ckdir
+        )
+
+
+@pytest.mark.chaos
+def test_drain_emergency_checkpoint_loses_at_most_one_step(
+    two_slice_cluster, tmp_path
+):
+    """Acceptance path: drain rank 0's node mid-train → the worker takes
+    an emergency checkpoint at the next step boundary inside the notice
+    window and unwinds typed (PreemptedError) → the controller resizes
+    onto the surviving slice and resumes from that checkpoint — no step
+    re-runs, vs. the full inter-checkpoint interval (up to 5 steps here)
+    on the unplanned-death path. The head's goodput ledger accounts the
+    planned restart as a bounded restart_lost window."""
+    nodes = two_slice_cluster
+    marker = str(tmp_path / "victim_addr")
+    scratch = str(tmp_path / "ck_scratch")
+    os.makedirs(scratch, exist_ok=True)
+    epochs = 12
+
+    trainer = JaxTrainer(
+        _preempt_loop,
+        train_loop_config={
+            "epochs": epochs,
+            "marker": marker,
+            "scratch": scratch,
+        },
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"SLICE": 1.0}
+        ),
+        scaling_policy=ElasticScalingPolicy(min_workers=1),
+        run_config=RunConfig(
+            name="drain_run",
+            storage_path=str(tmp_path / "results"),
+            failure_config=FailureConfig(max_failures=3),
+        ),
+    )
+
+    def drainer():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not os.path.exists(marker):
+            time.sleep(0.05)
+        with open(marker) as f:
+            victim_addr = f.read().strip()
+        victim = next(n for n in nodes if n.addr == victim_addr)
+        _head_call(
+            "drain_node",
+            node_id=victim.node_id,
+            reason="preemption-notice",
+            deadline_s=5.0,
+        )
+        # The notice window elapses; the node actually dies (this is a
+        # preemption, not a scare).
+        time.sleep(5.0)
+        for w in list(victim.workers.values()):
+            proc = w.get("proc")
+            if proc and proc.poll() is None:
+                proc.kill()
+        _stop_node(victim)
+
+    t = threading.Thread(target=drainer, daemon=True)
+    t.start()
+    result = trainer.fit()
+    t.join(timeout=30)
+
+    assert result.error is None, result.error
+    assert result.metrics["epoch"] == epochs - 1
+    assert result.metrics["world"] == 1
+
+    # Attempt 1 resumed from the EMERGENCY checkpoint, not the last
+    # periodic one: its start epoch is wherever the notice landed, never
+    # a multiple-of-5 rollback to epoch 0.
+    with open(os.path.join(scratch, "attempt1_rank0")) as f:
+        resumed_at = int(f.read())
+    assert resumed_at >= 1
+
+    # Ledger: every epoch ran exactly once across both attempts (≤1
+    # step lost means no re-run here: resume is ckpt_epoch + 1), and the
+    # planned restart's lost window is bounded.
+    deadline = time.time() + 20
+    job = {}
+    while time.time() < deadline:
+        job = _head_call("train_stats")["jobs"].get("drain_run") or {}
+        if job.get("steps", 0) >= epochs and job.get("attempts", 0) >= 2:
+            break
+        time.sleep(0.4)
+    assert job.get("steps") == epochs
+    assert job.get("attempts") == 2
+    assert job.get("restart_lost_s", 1e9) < 20.0
+
+
+# ------------------------------------------------- in-place group reform
+def _reform_loop(config):
+    """A transient straggle (rank 1 misses one op deadline) must heal
+    via auto in-place reform: same attempt, in-memory state kept, no
+    checkpoint restore."""
+    import numpy as np
+
+    import ray_tpu.collective as col
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    group = f"inplace:a{ctx.attempt}"
+    col.init_collective_group(
+        ctx.world_size,
+        ctx.rank,
+        backend="cpu",
+        group_name=group,
+        timeout_s=20.0,
+        auto_reform=True,
+    )
+    state = 0.0  # in-memory state that must survive the reform
+    for epoch in range(config["epochs"]):
+        if (
+            epoch == 1
+            and ctx.rank == 1
+            and not os.path.exists(config["slow_marker"])
+        ):
+            with open(config["slow_marker"], "w") as f:
+                f.write("x")
+            time.sleep(3.0)  # miss the 1s op deadline exactly once
+        out = col.allreduce(
+            np.full((2,), 1.0, "float32"), group_name=group, timeout_s=1.0
+        )
+        state += float(out[0])
+        train.report(
+            {"epoch": epoch, "state": state, "world": ctx.world_size}
+        )
+    col.destroy_collective_group(group)
+
+
+def test_inplace_reform_completes_without_attempt_restart(
+    two_slice_cluster, tmp_path
+):
+    """Acceptance path: a poisoned-but-nobody-died group reforms in
+    place (reform_group under auto_reform) and the run completes with NO
+    checkpoint restore and NO new attempt span."""
+    trainer = JaxTrainer(
+        _reform_loop,
+        train_loop_config={
+            "epochs": 4,
+            "slow_marker": str(tmp_path / "slowed"),
+        },
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"SLICE": 1.0}
+        ),
+        run_config=RunConfig(
+            name="reform_run",
+            storage_path=str(tmp_path / "results"),
+            failure_config=FailureConfig(max_failures=2),
+        ),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # Every epoch's allreduce summed both ranks — including the retried
+    # one — and the accumulated in-memory state survived the reform.
+    assert result.metrics["epoch"] == 3
+    assert result.metrics["state"] == pytest.approx(2.0 * 4)
+    assert result.metrics["world"] == 2
+
+    deadline = time.time() + 20
+    job = {}
+    while time.time() < deadline:
+        job = _head_call("train_stats")["jobs"].get("reform_run") or {}
+        if job.get("steps", 0) >= 4:
+            break
+        time.sleep(0.4)
+    # One attempt, zero restart loss: the recovery never left the loop.
+    assert job.get("attempts") == 1
+    assert job.get("restart_lost_s") == 0.0
+
+
+# -------------------------------------------------- serve drain migration
+def test_serve_drain_migrates_replicas_without_dropping_requests(tmp_path):
+    """Replicas on a draining node are replaced FIRST (on a healthy
+    node), then retired — live traffic through the handle sees zero
+    failures across the whole migration."""
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4)
+    nodes = [
+        _add_node(tmp_path, f"srv{i}", {"CPU": 2.0, "SRV": 2.0})
+        for i in range(2)
+    ]
+    try:
+        @serve.deployment(
+            num_replicas=2,
+            ray_actor_options={"resources": {"SRV": 1.0}},
+        )
+        def echo(x):
+            return x * 2
+
+        handle = serve.run(echo.bind(), name="drain_app")
+        assert handle.remote(21).result(timeout=60) == 42
+
+        def replica_nodes():
+            actors = _head_call("list_actors")["actors"]
+            return [
+                a["node_id"]
+                for a in actors.values()
+                if a["class_name"] == "ReplicaActor"
+                and a["state"] == "ALIVE"
+            ]
+
+        placed = replica_nodes()
+        assert len(placed) == 2
+        victim_nid = placed[0]
+
+        errors: list = []
+        results: list = []
+
+        def traffic():
+            for i in range(60):
+                try:
+                    results.append(handle.remote(i).result(timeout=15))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                time.sleep(0.05)
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert _head_call(
+            "drain_node", node_id=victim_nid, reason="preempt",
+            deadline_s=60,
+        )["ok"]
+        t.join(timeout=60)
+
+        assert not errors, errors[:3]
+        assert results == [i * 2 for i in range(60)]
+
+        # The reconcile loop moved every replica off the draining node
+        # (replacement-first, then retire).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            placed = replica_nodes()
+            if len(placed) == 2 and victim_nid not in placed:
+                break
+            time.sleep(0.3)
+        assert len(placed) == 2
+        assert victim_nid not in placed
+        st = serve.status()["drain_app"]["echo"]
+        assert st["replicas"] == 2
+        serve.shutdown()
+    finally:
+        for node in nodes:
+            _stop_node(node)
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------- victim-order satellite
+def test_scale_down_victim_ordering():
+    """Scale-down picks draining-node replicas first, then flakiest,
+    then oldest — never the newest/warmest (the old replicas[-excess:]
+    bug)."""
+    from ray_tpu.serve.controller import ServeController
+
+    replicas = [
+        {"actor_id": "old", "node_id": "n1", "started_at": 1.0},
+        {"actor_id": "flaky", "node_id": "n1", "started_at": 2.0,
+         "misses": 2},
+        {"actor_id": "draining", "node_id": "n2", "started_at": 3.0},
+        {"actor_id": "newest", "node_id": "n1", "started_at": 4.0},
+    ]
+    victims = ServeController._scale_down_victims(
+        replicas, draining={"n2"}, excess=3
+    )
+    assert [v["actor_id"] for v in victims] == ["draining", "flaky", "old"]
+    # The warm newest replica survives any partial scale-down.
+    assert ServeController._scale_down_victims(
+        replicas, draining=set(), excess=1
+    )[0]["actor_id"] == "flaky"

@@ -19,6 +19,11 @@ _CACHE = os.environ.get(
     os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu", "native"),
 )
 _lock = threading.Lock()
+# out path → Event set when a build attempt for it finishes. The lock
+# guards only this dict: the multi-second g++ run happens OUTSIDE the
+# critical section, so a cold-cache build can't stall every other
+# import-time caller on `_lock` (tpulint TPU201).
+_building: dict[str, threading.Event] = {}
 
 
 class NativeBuildError(RuntimeError):
@@ -34,16 +39,25 @@ def build_library(name: str, sources: list[str], extra_flags: list[str] | None =
             h.update(f.read())
     tag = h.hexdigest()[:16]
     out = os.path.join(_CACHE, f"lib{name}-{tag}.so")
-    if os.path.exists(out):
+    while not os.path.exists(out):
+        with _lock:
+            ev = _building.get(out)
+            if ev is None:
+                ev = _building[out] = threading.Event()
+                break  # this thread builds
+        # Another thread is building this library: wait for its
+        # attempt, then re-check the cache. If it failed, loop around
+        # and take our own turn (its exception is its caller's).
+        ev.wait()
+    else:
         return out
-    with _lock:
-        if os.path.exists(out):
-            return out
+    try:
         os.makedirs(_CACHE, exist_ok=True)
-        # Per-process temp name: concurrent cold-cache builds from
-        # several worker processes must not scribble on one .tmp file
-        # (the rename is atomic; last writer wins with identical bytes).
-        tmp = f"{out}.tmp{os.getpid()}"
+        # Per-process AND per-thread temp name: concurrent cold-cache
+        # builds (several worker processes, or two threads racing the
+        # event above) must not scribble on one .tmp file (the rename
+        # is atomic; last writer wins with identical bytes).
+        tmp = f"{out}.tmp{os.getpid()}.{threading.get_ident()}"
         cmd = (
             ["g++", "-O2", "-g", "-fPIC", "-shared", "-std=c++17"]
             + (extra_flags or [])
@@ -56,4 +70,8 @@ def build_library(name: str, sources: list[str], extra_flags: list[str] | None =
                 f"g++ failed for {name}:\n{proc.stderr[-4000:]}"
             )
         os.rename(tmp, out)
+    finally:
+        with _lock:
+            _building.pop(out, None)
+        ev.set()
     return out

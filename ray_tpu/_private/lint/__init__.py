@@ -1,0 +1,37 @@
+"""tpulint: ray_tpu-specific static analysis.
+
+Five AST passes grounded in this codebase's real failure classes (the
+bug shapes PRs 1-3 spent ~3k LoC defending against at runtime):
+
+- ``collective-divergence`` (TPU101/TPU102): collective ops under
+  rank-dependent control flow — the SPMD deadlock shape.
+- ``lock-discipline`` (TPU201/TPU202): blocking calls while a
+  ``threading.Lock`` with-block is open, plus cross-function
+  lock-order cycles.
+- ``broad-except`` (TPU301): ``except Exception``/bare ``except``
+  that neither re-raises, logs, nor carries an allow pragma.
+- ``metric-hygiene`` (TPU401/TPU402): metric constructors inside
+  functions (re-registration churn) and span APIs used without a
+  context manager.
+- ``rpc-reentrancy`` (TPU501): RPC handlers that call back into an
+  RPC handled by their own process (self-deadlock).
+
+Violations are suppressed line-by-line with::
+
+    # tpulint: allow(<rule> reason=<why this is deliberate>)
+
+and pre-existing debt is pinned in ``lint_baseline.json`` — only NEW
+violations fail CI (``ray_tpu lint --baseline lint_baseline.json``).
+"""
+
+from ray_tpu._private.lint.core import (  # noqa: F401
+    Violation,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)
+from ray_tpu._private.lint.baseline import (  # noqa: F401
+    diff_against_baseline,
+    load_baseline,
+    make_baseline,
+)

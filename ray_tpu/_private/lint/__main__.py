@@ -1,0 +1,5 @@
+import sys
+
+from ray_tpu._private.lint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,151 @@
+"""`ray_tpu lint` / `python -m ray_tpu._private.lint` — CLI.
+
+Exit codes: 0 clean (or everything baselined), 1 new violations,
+2 usage/IO error. `--update-baseline` rewrites the baseline from the
+current tree and always exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+import time
+
+from ray_tpu._private.lint import baseline as baseline_mod
+from ray_tpu._private.lint import core
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def _find_default_baseline(paths: list[str]) -> str | None:
+    """Look for lint_baseline.json next to / above the first target so
+    `ray_tpu lint ray_tpu/` from the repo root just works."""
+    probe = os.path.abspath(paths[0]) if paths else os.getcwd()
+    if os.path.isfile(probe):
+        probe = os.path.dirname(probe)
+    for _ in range(6):
+        cand = os.path.join(probe, DEFAULT_BASELINE)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="ray_tpu lint",
+        description="tpulint: ray_tpu-specific static analysis "
+                    "(collective divergence, lock discipline, exception "
+                    "hygiene, metric/span hygiene, RPC reentrancy)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to analyze (default: ray_tpu "
+                        "package next to this install)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        "found upward from the first path; 'off' "
+                        "disables)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from the current tree")
+    p.add_argument("--select", default=None, metavar="RULES",
+                   help="comma-separated rule ids/names to keep "
+                        "(e.g. TPU301,lock-order)")
+    p.add_argument("--relative-to", default=None,
+                   help="report paths relative to this directory "
+                        "(default: cwd)")
+    args = p.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        # The package we live in — `ray_tpu lint` bare lints the install.
+        paths = [os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"error: no such path: {path}", file=sys.stderr)
+            return 2
+
+    rel = args.relative_to or os.getcwd()
+    t0 = time.monotonic()
+    violations, errors = core.analyze_paths(paths, relative_to=rel)
+    elapsed = time.monotonic() - t0
+
+    if args.select:
+        keep = {t.strip() for t in args.select.split(",") if t.strip()}
+        violations = [v for v in violations
+                      if v.rule in keep or v.name in keep]
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = _find_default_baseline(paths)
+    elif baseline_path == "off":
+        baseline_path = None
+
+    if args.update_baseline:
+        out_path = baseline_path or DEFAULT_BASELINE
+        data = baseline_mod.make_baseline(violations)
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out_path}: {len(violations)} pinned violation(s) "
+              f"across {len(data['entries'])} fingerprint(s)")
+        return 0
+
+    stale: list[str] = []
+    reported = violations
+    if baseline_path:
+        try:
+            base = baseline_mod.load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        reported, stale = baseline_mod.diff_against_baseline(
+            violations, base)
+
+    if args.as_json:
+        print(json.dumps({
+            "violations": [v.to_dict() for v in reported],
+            "total_found": len(violations),
+            "baseline": baseline_path,
+            "baselined": len(violations) - len(reported),
+            "stale_baseline_entries": stale,
+            "parse_errors": [
+                {"path": p_, "error": e} for p_, e in errors],
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+    else:
+        for v in reported:
+            print(v.format())
+        for path_, err in errors:
+            print(f"{path_}: parse error: {err}", file=sys.stderr)
+        by_rule = collections.Counter(v.rule for v in reported)
+        summary = ", ".join(
+            f"{r}={n}" for r, n in sorted(by_rule.items())) or "none"
+        pinned = len(violations) - len(reported)
+        print(
+            f"tpulint: {len(reported)} new violation(s) ({summary}); "
+            f"{pinned} baselined; {elapsed:.2f}s",
+            file=sys.stderr,
+        )
+        if stale:
+            print(
+                f"tpulint: {len(stale)} baseline entr"
+                f"{'y is' if len(stale) == 1 else 'ies are'} stale "
+                "(debt paid) — regenerate with --update-baseline to "
+                "shrink the baseline",
+                file=sys.stderr,
+            )
+    return 1 if reported else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,279 @@
+"""tpulint core: file walking, pragma parsing, violation model.
+
+The passes are deliberately heuristic — name-based lock detection,
+token-based rank detection — tuned against THIS codebase's idioms
+(``self._lock``, ``col.allreduce``, ``_on_<method>`` RPC handlers).
+Precision comes from the pragma + baseline escape hatches, not from
+whole-program analysis; cross-file alias tracking is a ROADMAP item.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+PRAGMA_RE = re.compile(
+    r"#\s*tpulint:\s*allow\(\s*([A-Za-z0-9_,\s-]+?)\s+reason=[^)]+\)"
+)
+
+#: Rule id → pragma name. A pragma may name either form.
+RULES = {
+    "TPU101": "collective-divergence",
+    "TPU102": "collective-divergence",
+    "TPU201": "blocking-under-lock",
+    "TPU202": "lock-order",
+    "TPU301": "broad-except",
+    "TPU401": "metric-in-function",
+    "TPU402": "span-leak",
+    "TPU501": "rpc-reentrancy",
+}
+
+# Generated / vendored files nobody hand-edits.
+DEFAULT_EXCLUDES = ("_pb2.py", "_pb2_grpc.py")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str       # "TPU301"
+    name: str       # "broad-except"
+    path: str       # as given to the analyzer (usually repo-relative)
+    line: int
+    col: int
+    message: str
+    scope: str = "<module>"   # enclosing Class.function
+    snippet: str = ""         # stripped source line
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline: edits above a
+        pinned violation must not make it read as new."""
+        return "|".join(
+            (self.rule, self.path.replace(os.sep, "/"), self.scope,
+             self.snippet)
+        )
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        return d
+
+    def format(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.name}] {self.message}"
+        )
+
+
+def parse_pragmas(lines: list[str]) -> dict[int, set[str]]:
+    """1-based line → set of allowed rule tokens (rule ids or names).
+
+    A pragma without ``reason=`` is intentionally inert: the reason IS
+    the review artifact (why this broad except / blocking call is
+    deliberate), so an unexplained allow must not suppress anything.
+    """
+    out: dict[int, set[str]] = {}
+    for i, text in enumerate(lines, start=1):
+        if "tpulint" not in text:
+            continue
+        m = PRAGMA_RE.search(text)
+        if not m:
+            continue
+        tokens = {t.strip() for t in m.group(1).split(",") if t.strip()}
+        out[i] = tokens
+    return out
+
+
+class FileContext:
+    """One parsed file plus everything a pass needs to report on it."""
+
+    def __init__(self, path: str, source: str, display_path: str | None = None):
+        self.path = display_path or path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.pragmas = parse_pragmas(self.lines)
+        self.module = os.path.basename(path)[:-3] if path.endswith(
+            ".py") else os.path.basename(path)
+        self.violations: list[Violation] = []
+
+    def allowed(self, line: int, rule: str) -> bool:
+        """Pragma on the statement line or the line directly above."""
+        tokens = self.pragmas.get(line, set()) | self.pragmas.get(
+            line - 1, set())
+        return bool(tokens & {rule, RULES.get(rule, ""), "all"})
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def report(self, rule: str, node: ast.AST, message: str,
+               scope: str = "<module>") -> None:
+        line = getattr(node, "lineno", 1)
+        if self.allowed(line, rule):
+            return
+        self.violations.append(Violation(
+            rule=rule,
+            name=RULES[rule],
+            path=self.path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            scope=scope,
+            snippet=self.snippet(line),
+        ))
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'self._lock', 'time.sleep', 'col.allreduce' — '' if not a pure
+    Name/Attribute chain (calls, subscripts break the chain)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class ScopeVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing Class.function qualname."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self._class: list[str] = []
+        self._func: list[str] = []
+
+    @property
+    def scope(self) -> str:
+        parts = self._class[-1:] + self._func[-1:]
+        return ".".join(parts) if parts else "<module>"
+
+    @property
+    def in_function(self) -> bool:
+        return bool(self._func)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self._class.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def _visit_func(self, node):
+        self._func.append(node.name)
+        self.enter_function(node)
+        self.generic_visit(node)
+        self.exit_function(node)
+        self._func.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node)
+
+    def enter_function(self, node) -> None:  # hooks for passes
+        pass
+
+    def exit_function(self, node) -> None:
+        pass
+
+
+def iter_python_files(paths, excludes: tuple[str, ...] = DEFAULT_EXCLUDES):
+    """Yield .py files under the given files/directories, skipping
+    __pycache__ and excluded suffixes, in sorted order."""
+    seen = set()
+    for root in paths:
+        if os.path.isfile(root):
+            if root not in seen:
+                seen.add(root)
+                yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                if any(fn.endswith(suf) for suf in excludes):
+                    continue
+                p = os.path.join(dirpath, fn)
+                if p not in seen:
+                    seen.add(p)
+                    yield p
+
+
+def _passes():
+    # Imported lazily so `import ray_tpu._private.lint.core` works while
+    # a pass module is mid-edit (and to keep import cost off the
+    # non-lint path).
+    from ray_tpu._private.lint import (
+        pass_collective,
+        pass_exceptions,
+        pass_locks,
+        pass_metrics,
+        pass_rpc,
+    )
+    return [pass_collective, pass_exceptions, pass_locks, pass_metrics,
+            pass_rpc]
+
+
+def analyze_source(source: str, path: str = "<string>") -> list[Violation]:
+    """Run every pass over one in-memory module (fixture tests)."""
+    ctx = FileContext(path, source)
+    for mod in _passes():
+        state = mod.run(ctx)
+        if state is not None:
+            ctx.violations.extend(mod.finalize([state]))
+    ctx.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return ctx.violations
+
+
+def analyze_file(path: str, display_path: str | None = None) -> list[Violation]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    ctx = FileContext(path, source, display_path=display_path)
+    for mod in _passes():
+        state = mod.run(ctx)
+        if state is not None:
+            ctx.violations.extend(mod.finalize([state]))
+    ctx.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return ctx.violations
+
+
+def analyze_paths(paths, relative_to: str | None = None,
+                  excludes: tuple[str, ...] = DEFAULT_EXCLUDES):
+    """Analyze every .py file under ``paths``.
+
+    Returns (violations, errors) where errors is a list of
+    (path, message) for unparseable files — reported, never fatal:
+    one syntax-broken WIP file must not hide the report for the rest
+    of the tree.
+    """
+    contexts: list[FileContext] = []
+    errors: list[tuple[str, str]] = []
+    for path in iter_python_files(paths, excludes=excludes):
+        display = path
+        if relative_to:
+            display = os.path.relpath(path, relative_to)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            contexts.append(FileContext(path, source, display_path=display))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append((display, f"{type(e).__name__}: {e}"))
+
+    violations: list[Violation] = []
+    for mod in _passes():
+        states = []
+        for ctx in contexts:
+            state = mod.run(ctx)
+            if state is not None:
+                states.append(state)
+        violations.extend(mod.finalize(states))
+    for ctx in contexts:
+        violations.extend(ctx.violations)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations, errors

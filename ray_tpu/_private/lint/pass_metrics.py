@@ -1,0 +1,97 @@
+"""TPU401/TPU402 — metrics & span hygiene.
+
+- TPU401: ``Counter``/``Gauge``/``Histogram`` constructed inside a
+  function. The registry now tolerates re-registration (same shape
+  returns the live instance) but every call still pays lock + shape
+  verification on a hot path, and a tag/shape drift turns into a
+  runtime ValueError at the call site instead of import time. Metrics
+  belong at module scope.
+- TPU402: a span context manager (``tracing.span``/``thread_trace``/
+  ``activate``/``train.step_span``/``jax_profile``) called bare —
+  without ``with`` or ``enter_context(...)`` — constructs the CM and
+  drops it unentered: the span silently never records.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name
+
+_METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
+_SPAN_CMS = frozenset({
+    "span", "step_span", "thread_trace", "activate", "jax_profile",
+})
+_SPAN_RECEIVERS = ("tracing", "train", "telemetry", "trace")
+
+
+def _metric_ctor(call: ast.Call) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Name) and func.id in _METRIC_CTORS:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _METRIC_CTORS:
+        recv = dotted_name(func.value)
+        if recv and "metric" in recv.split(".")[-1].lower():
+            return func.attr
+    return None
+
+
+def _span_cm(call: ast.Call) -> str | None:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in _SPAN_CMS:
+        recv = dotted_name(func.value)
+        last = recv.split(".")[-1].lower() if recv else ""
+        if any(h in last for h in _SPAN_RECEIVERS):
+            return f"{recv}.{func.attr}"
+    elif isinstance(func, ast.Name) and func.id in ("step_span",
+                                                    "thread_trace"):
+        return func.id
+    return None
+
+
+class _Visitor(ScopeVisitor):
+    def __init__(self, ctx: FileContext):
+        super().__init__(ctx)
+        # Call nodes that ARE properly entered: with-items and
+        # enter_context(...) arguments.
+        self._entered: set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    self._entered.add(id(item.context_expr))
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name.endswith("enter_context"):
+                    for arg in node.args:
+                        self._entered.add(id(arg))
+
+    def visit_Call(self, node: ast.Call):
+        ctor = _metric_ctor(node)
+        if ctor is not None and self.in_function:
+            self.ctx.report(
+                "TPU401", node,
+                f"`{ctor}` constructed inside a function: registry "
+                "lookup + shape check on every call, and shape drift "
+                "becomes a runtime error here instead of import time — "
+                "hoist to module scope",
+                scope=self.scope,
+            )
+        cm = _span_cm(node)
+        if cm is not None and id(node) not in self._entered:
+            self.ctx.report(
+                "TPU402", node,
+                f"`{cm}(...)` called without `with` (or "
+                "`enter_context`): the context manager is never "
+                "entered, so the span never records",
+                scope=self.scope,
+            )
+        self.generic_visit(node)
+
+
+def run(ctx: FileContext):
+    _Visitor(ctx).visit(ctx.tree)
+    return None
+
+
+def finalize(states):
+    return []

@@ -1,0 +1,304 @@
+"""Runtime concurrency sanitizer: instrumented locks.
+
+The static side (``ray_tpu/_private/lint``) catches lock-order cycles
+it can SEE; this module is the dynamic backstop for the ones it can't
+(locks passed through data structures, order established across
+callbacks). An ``InstrumentedLock`` records, per thread, the stack of
+instrumented locks held at each acquisition and feeds a global
+lock-order graph:
+
+- acquiring B while holding A adds edge A→B; if B→…→A already exists,
+  the acquisition raises :class:`LockOrderViolation` NAMING the cycle —
+  at the acquisition that would deadlock, not minutes later when two
+  threads actually interleave.
+- releasing a lock held longer than ``RAY_TPU_SANITIZE_HOLD_MS``
+  (default 100) logs a warning with the hold duration — the
+  blocking-while-holding shape TPU201 flags statically.
+
+Opt-in: ``RAY_TPU_SANITIZE=1`` makes :func:`maybe_lock` /
+:func:`maybe_rlock` hand out instrumented locks, and
+:func:`install` monkeypatches ``threading.Lock``/``RLock`` so locks
+allocated by ray_tpu code during the install window are instrumented
+(allocation-site filtered: third-party/stdlib locks are left alone —
+their internal ordering conventions are not ours to police).
+``tests/conftest.py`` installs it for the chaos / fault-tolerance
+modules.
+"""
+
+from __future__ import annotations
+
+import _thread
+import logging
+import os
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_HOLD_MS = 100.0
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquiring this lock here can deadlock: the lock-order graph
+    already contains a path back to a lock this thread holds."""
+
+    def __init__(self, cycle: list[str], holder_hint: str = ""):
+        self.cycle = cycle
+        msg = " -> ".join(cycle)
+        if holder_hint:
+            msg += f" ({holder_hint})"
+        super().__init__(f"lock-order cycle: {msg}")
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_SANITIZE", "") == "1"
+
+
+def _hold_threshold_s() -> float:
+    try:
+        return float(
+            os.environ.get("RAY_TPU_SANITIZE_HOLD_MS", _DEFAULT_HOLD_MS)
+        ) / 1000.0
+    except ValueError:
+        return _DEFAULT_HOLD_MS / 1000.0
+
+
+class _OrderGraph:
+    """Global lock-order graph. Guarded by a RAW lock (allocated via
+    _thread, never instrumented: the sanitizer must not sanitize its
+    own plumbing into infinite recursion)."""
+
+    def __init__(self):
+        self._guard = _thread.allocate_lock()
+        self._edges: dict[int, set[int]] = {}
+        self._names: dict[int, str] = {}
+        self.cycles_detected = 0
+        self.long_holds = 0
+
+    def reset(self):
+        with self._guard:
+            self._edges.clear()
+            self._names.clear()
+            self.cycles_detected = 0
+            self.long_holds = 0
+
+    def check_and_add(self, held_id: int, held_name: str,
+                      new_id: int, new_name: str) -> list[str] | None:
+        """Add edge held→new; return the cycle as names if one forms."""
+        with self._guard:
+            self._names[held_id] = held_name
+            self._names[new_id] = new_name
+            # Path new → … → held already present means held→new closes
+            # a cycle.
+            path = self._find_path(new_id, held_id)
+            if path is not None:
+                self.cycles_detected += 1
+                names = [self._names.get(n, f"lock@{n:#x}")
+                         for n in [held_id] + path]
+                return names
+            self._edges.setdefault(held_id, set()).add(new_id)
+            return None
+
+    def _find_path(self, src: int, dst: int) -> list[int] | None:
+        if src == dst:
+            return [src]
+        seen = {src}
+        stack = [(src, [src])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in self._edges.get(node, ()):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+
+_graph = _OrderGraph()
+_tls = threading.local()
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+class InstrumentedLock:
+    """Drop-in for ``threading.Lock``/``RLock`` with order tracking."""
+
+    def __init__(self, name: str | None = None, reentrant: bool = False,
+                 hold_threshold_s: float | None = None):
+        # The ORIGINAL factories: threading.Lock/RLock may be patched
+        # to _patched_lock while install() is active — building the
+        # inner lock through them would recurse.
+        self._inner = _ORIG_RLOCK() if reentrant else _ORIG_LOCK()
+        self.name = name or f"lock@{id(self):#x}"
+        self.reentrant = reentrant
+        self._hold_threshold_s = (
+            hold_threshold_s if hold_threshold_s is not None
+            else _hold_threshold_s()
+        )
+        # owner bookkeeping for reentrancy / hold timing
+        self._acquired_at: dict[int, float] = {}
+        self._depth: dict[int, int] = {}
+
+    # ------------------------------------------------------- acquire
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        me = _thread.get_ident()
+        stack = _held_stack()
+        if self.reentrant and self._depth.get(me, 0) > 0:
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                self._depth[me] += 1
+            return got
+        for held in stack:
+            if held is self:
+                continue
+            cycle = _graph.check_and_add(
+                id(held), held.name, id(self), self.name)
+            if cycle is not None:
+                raise LockOrderViolation(
+                    cycle,
+                    holder_hint=(
+                        f"thread {threading.current_thread().name} "
+                        f"holds {held.name}, wants {self.name}"
+                    ),
+                )
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            stack.append(self)
+            self._acquired_at[me] = time.monotonic()
+            if self.reentrant:
+                self._depth[me] = 1
+        return got
+
+    def release(self):
+        me = _thread.get_ident()
+        if self.reentrant and self._depth.get(me, 0) > 1:
+            self._depth[me] -= 1
+            self._inner.release()
+            return
+        t0 = self._acquired_at.pop(me, None)
+        self._depth.pop(me, None)
+        stack = _held_stack()
+        if self in stack:
+            stack.remove(self)
+        self._inner.release()
+        if t0 is not None:
+            held_s = time.monotonic() - t0
+            if held_s > self._hold_threshold_s:
+                _graph.long_holds += 1
+                logger.warning(
+                    "sanitizer: %s held for %.0f ms (> %.0f ms) by "
+                    "thread %s — was something blocking inside the "
+                    "critical section?",
+                    self.name, held_s * 1e3,
+                    self._hold_threshold_s * 1e3,
+                    threading.current_thread().name,
+                )
+
+    # ------------------------------------------------------ protocol
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked() if hasattr(
+            self._inner, "locked") else False
+
+    def __repr__(self):
+        kind = "RLock" if self.reentrant else "Lock"
+        return f"<InstrumentedLock {kind} {self.name!r}>"
+
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_install_count = 0
+
+
+def maybe_lock(name: str | None = None):
+    """threading.Lock(), instrumented when RAY_TPU_SANITIZE=1."""
+    if enabled() or _install_count:
+        return InstrumentedLock(name=name)
+    return _ORIG_LOCK()
+
+
+def maybe_rlock(name: str | None = None):
+    if enabled() or _install_count:
+        return InstrumentedLock(name=name, reentrant=True)
+    return _ORIG_RLOCK()
+
+
+def _caller_module(depth: int = 2) -> str:
+    import sys
+    try:
+        frame = sys._getframe(depth)
+        return frame.f_globals.get("__name__", "")
+    except ValueError:
+        return ""
+
+
+def _patched_lock():
+    mod = _caller_module()
+    if mod.startswith("ray_tpu") or mod.startswith("test"):
+        return InstrumentedLock(name=f"{mod}.Lock@{_site_tag()}")
+    return _ORIG_LOCK()
+
+
+def _patched_rlock():
+    mod = _caller_module()
+    if mod.startswith("ray_tpu") or mod.startswith("test"):
+        return InstrumentedLock(
+            name=f"{mod}.RLock@{_site_tag()}", reentrant=True)
+    return _ORIG_RLOCK()
+
+
+def _site_tag() -> str:
+    import sys
+    try:
+        frame = sys._getframe(3)
+        return f"{os.path.basename(frame.f_code.co_filename)}:{frame.f_lineno}"
+    except ValueError:
+        return "?"
+
+
+def install():
+    """Monkeypatch threading.Lock/RLock: locks allocated by ray_tpu /
+    tests code while installed come back instrumented. Reference-
+    counted so nested installs (fixture + explicit) compose."""
+    global _install_count
+    _install_count += 1
+    if _install_count == 1:
+        threading.Lock = _patched_lock
+        threading.RLock = _patched_rlock
+
+
+def uninstall():
+    global _install_count
+    if _install_count == 0:
+        return
+    _install_count -= 1
+    if _install_count == 0:
+        threading.Lock = _ORIG_LOCK
+        threading.RLock = _ORIG_RLOCK
+
+
+def reset():
+    """Clear the global order graph (test isolation: one module's lock
+    order must not poison the next's)."""
+    _graph.reset()
+
+
+def stats() -> dict:
+    return {
+        "cycles_detected": _graph.cycles_detected,
+        "long_holds": _graph.long_holds,
+        "edges": sum(len(v) for v in _graph._edges.values()),
+    }

@@ -17,6 +17,7 @@ import asyncio
 import collections
 import hashlib
 import json
+import logging
 import os
 import subprocess
 import sys
@@ -27,6 +28,8 @@ from typing import Any
 
 from ray_tpu._private import rpc
 from ray_tpu._private.ids import NodeID, WorkerID
+
+logger = logging.getLogger(__name__)
 
 IDLE_WORKER_CAP = 4  # idle processes kept warm per node
 SPAWN_TIMEOUT_S = 30.0
@@ -232,10 +235,12 @@ def build_runtime_env(runtime_env: dict, h: str | None = None) -> dict:
         import fcntl
 
         lock_f = open(os.path.join(_ENV_CACHE_ROOT, f".{h}.lock"), "w")
+        # tpulint: allow(blocking-under-lock reason=thread lock plus file lock together are the design - one env build per thread AND per host; builds are expected to take seconds)
         fcntl.flock(lock_f, fcntl.LOCK_EX)
         try:
             _build_env_locked(runtime_env, root, info)
         finally:
+            # tpulint: allow(blocking-under-lock reason=unlock of the cross-process file lock cannot block)
             fcntl.flock(lock_f, fcntl.LOCK_UN)
             lock_f.close()
         _built_envs[h] = info
@@ -515,8 +520,11 @@ class NodeManager:
                 # keep running on the loop after the node is gone.
                 try:
                     await core.stop()
-                except Exception:  # noqa: BLE001 - best-effort teardown
-                    pass
+                except Exception:
+                    logger.debug(
+                        "inproc worker core stop failed during node "
+                        "teardown", exc_info=True,
+                    )
         if self.head:
             await self.head.close()
         await self.server.stop()
@@ -778,7 +786,11 @@ class NodeManager:
                     addr=addr,
                     pid=os.getpid(),
                 )
-            except Exception:  # noqa: BLE001 - boot failed
+            except Exception:
+                logger.warning(
+                    "inproc worker %s failed to boot", worker_id,
+                    exc_info=True,
+                )
                 # A subprocess worker dying mid-boot is reaped via
                 # proc.poll(); mark this one so the reap loop runs the
                 # same path (record cleanup, waiter replacement)
@@ -972,7 +984,11 @@ class NodeManager:
                 from ray_tpu.autoscaler.gcp import GceMaintenanceEventSource
 
                 return GceMaintenanceEventSource()
-            except Exception:  # noqa: BLE001 - optional dependency path
+            except Exception:
+                logger.debug(
+                    "GCE maintenance event source unavailable",
+                    exc_info=True,
+                )
                 return None
         return None
 
@@ -988,8 +1004,9 @@ class NodeManager:
                 notice = source.poll(self)
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 - a flaky metadata server
-                continue      # must not kill the watcher
+            # tpulint: allow(broad-except reason=metadata server polled every second; one flaky poll must not kill the watcher and logging each would spam)
+            except Exception:
+                continue
             if notice is None:
                 continue
             reason, deadline_s = notice
@@ -1141,6 +1158,7 @@ class NodeManager:
         if store.contains(oid):
             return {"ok": True, "cached": True}
         owner = await self._connect_peer(owner_addr)
+        # tpulint: allow(rpc-reentrancy reason=owner is a PEER node resolved from owner_addr, never this server; pull_object below would deadlock loopback anyway and never does)
         reply = await owner.call("get_object", oid_hex=oid_hex)
         if reply["kind"] == "value":
             store.put(
@@ -1163,6 +1181,7 @@ class NodeManager:
                 bad = [addr_of[c] for c in failed if c in addr_of]
                 if bad:
                     try:
+                        # tpulint: allow(rpc-reentrancy reason=owner is a peer node connection, not this process)
                         await owner.call(
                             "object_location_remove",
                             oid_hex=oid_hex,
@@ -1174,6 +1193,7 @@ class NodeManager:
         else:
             return {"ok": False, "error": f"unexpected kind {reply['kind']}"}
         try:
+            # tpulint: allow(rpc-reentrancy reason=owner is a peer node connection, not this process)
             await owner.call(
                 "object_location_add", oid_hex=oid_hex, addr=self.addr
             )
@@ -1478,7 +1498,8 @@ class NodeManager:
             result = await self._grant_lease(resources, actor, runtime_env)
             if not fut.done():
                 fut.set_result(result)
-        except Exception as e:  # noqa: BLE001
+        # tpulint: allow(broad-except reason=failure propagates to the waiter via fut.set_exception, not swallowed)
+        except Exception as e:
             if not fut.done():
                 fut.set_exception(e)
 
@@ -1539,8 +1560,10 @@ class NodeManager:
                     self._log_offsets[name] = off + len(data)
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 - log shipping is best-effort
-                pass
+            except Exception:
+                # Best-effort: the node's own logger is NOT among the
+                # tailed worker logs, so this cannot feedback-loop.
+                logger.debug("log shipping tick failed", exc_info=True)
 
     async def _on_list_logs(self, conn):
         out = []
@@ -1715,8 +1738,11 @@ class NodeManager:
                 self.spilled_objects += n
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 - spilling is best-effort
-                pass
+            except Exception:
+                logger.warning(
+                    "object spill tick failed (disk full? bad spill "
+                    "dir?)", exc_info=True,
+                )
 
     async def _memory_loop(self):
         """Kill a worker when the host runs out of memory (reference:
@@ -1757,8 +1783,9 @@ class NodeManager:
                         pass
             except asyncio.CancelledError:
                 raise
-            except Exception:  # noqa: BLE001 - monitoring is best-effort
-                pass
+            except Exception:
+                logger.debug("memory monitor tick failed",
+                             exc_info=True)
 
     def _pick_oom_victim(self):
         """(lease, worker_id) to kill, or None. Newest task lease first,

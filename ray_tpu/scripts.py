@@ -433,6 +433,14 @@ def cmd_config(args) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `ray_tpu lint` needs no cluster and owns its full flag set —
+    # delegate before the cluster-flavored parser sees the args.
+    if argv[:1] == ["lint"]:
+        from ray_tpu._private.lint.cli import main as lint_main
+
+        return lint_main(argv[1:])
+
     p = argparse.ArgumentParser(prog="ray_tpu")
     p.add_argument("--address", default=None, help="head address host:port")
     p.add_argument("--session-dir", default=None,
@@ -489,6 +497,13 @@ def main(argv=None) -> int:
     dp = sub.add_parser("dashboard")
     dp.add_argument("--port", type=int, default=8265)
     sub.add_parser("config")
+    # Dispatched above (before cluster flags); listed here so it shows
+    # in --help.
+    sub.add_parser(
+        "lint",
+        help="tpulint static analysis (see "
+             "`python -m ray_tpu._private.lint --help`)",
+    )
 
     args = p.parse_args(argv)
     return {

@@ -16,6 +16,7 @@ cannot block the loop thread).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 
 from ray_tpu import api as core_api
@@ -23,6 +24,8 @@ from ray_tpu.runtime.core_worker import ActorSubmitTarget
 from ray_tpu.serve.replica import ReplicaActor
 
 _CONTROL_PERIOD_S = 0.25
+
+logger = logging.getLogger(__name__)
 
 
 class ServeController:
@@ -111,8 +114,11 @@ class ServeController:
         for d in drains:
             try:
                 d.result(timeout=10)
-            except Exception:  # noqa: BLE001 - best-effort teardown
-                pass
+            except Exception:
+                logger.debug(
+                    "replica drain failed during app teardown",
+                    exc_info=True,
+                )
         return True
 
     async def _drain_replicas(self, dep: dict):
@@ -120,7 +126,8 @@ class ServeController:
         for r in list(dep["replicas"]):
             try:
                 await core.kill_actor(r["actor_id"], r["addr"])
-            except Exception:  # noqa: BLE001 - already dead is fine
+            # tpulint: allow(broad-except reason=drain kill of a replica that already died is the expected race, nothing to handle)
+            except Exception:
                 pass
         dep["replicas"] = []
 
@@ -181,8 +188,14 @@ class ServeController:
         while not self._shutdown:
             try:
                 await self._reconcile_once()
-            except Exception:  # noqa: BLE001 - keep the loop alive
-                pass
+            except Exception:
+                # Keep the loop alive, but never silently: a reconcile
+                # pass that throws every period is an outage in the
+                # making (stuck migrations, zombie replicas).
+                logger.warning(
+                    "serve reconcile pass failed; retrying next period",
+                    exc_info=True,
+                )
             await asyncio.sleep(_CONTROL_PERIOD_S)
         return True
 
@@ -193,7 +206,10 @@ class ServeController:
         try:
             reply = await core.head.call("drain_table")
             return set(reply.get("draining") or {})
-        except Exception:  # noqa: BLE001 - head busy/old: no migration
+        except Exception:
+            # Head busy or too old to know drain_table: skip migration
+            # this period rather than stall the reconcile.
+            logger.debug("drain_table poll failed", exc_info=True)
             return set()
 
     async def _reconcile_once(self):
@@ -249,7 +265,8 @@ class ServeController:
                 for r in victims:
                     try:
                         await core.kill_actor(r["actor_id"], r["addr"])
-                    except Exception:  # noqa: BLE001
+                    # tpulint: allow(broad-except reason=scale-down victim may already be dead; reconcile re-counts next period)
+                    except Exception:
                         pass
             dep["status"] = (
                 "HEALTHY"
@@ -323,7 +340,8 @@ class ServeController:
     async def _kill_quietly(core, r: dict):
         try:
             await core.kill_actor(r["actor_id"], r["addr"])
-        except Exception:  # noqa: BLE001 - already dead is fine
+        # tpulint: allow(broad-except reason=quiet kill by contract - replica already dead is the common case)
+        except Exception:
             pass
 
     def _autoscale(self, dep: dict, auto: dict, stats: dict):
@@ -359,10 +377,12 @@ class ServeController:
     async def _start_replica_tracked(self, core, dep: dict):
         try:
             await self._start_replica(core, dep)
-        except Exception:  # noqa: BLE001 - e.g. no feasible node; the
-            # reconcile loop will retry next period, so swallow rather
-            # than spam "Task exception was never retrieved".
-            pass
+        except Exception:
+            # e.g. no feasible node; the reconcile loop will retry next
+            # period, so log rather than let asyncio print "Task
+            # exception was never retrieved".
+            logger.debug("replica start failed; will retry",
+                         exc_info=True)
         finally:
             dep["starting"] = max(0, dep.get("starting", 0) - 1)
 
@@ -397,8 +417,9 @@ class ServeController:
             info = await core.head.call("get_actor", actor_id=actor_id)
             if info.get("ok"):
                 node_id = info.get("node_id")
-        except Exception:  # noqa: BLE001 - registry miss: unknown node
-            pass
+        except Exception:
+            logger.debug("actor node lookup failed; node_id unknown",
+                         exc_info=True)
         key = (dep["app"], dep["name"])
         if self._deployments.get(key) is not dep:
             # The deployment was redeployed or deleted while this replica

@@ -43,6 +43,45 @@ def pytest_configure(config):
     )
 
 
+# Fault-tolerance / chaos modules run under the runtime concurrency
+# sanitizer: locks ray_tpu code allocates during these tests are
+# instrumented, so a lock-order inversion raises LockOrderViolation at
+# the acquisition instead of wedging the suite (see
+# ray_tpu/_private/sanitize.py).
+_SANITIZED_MODULES = (
+    "test_collective_ft",
+    "test_fault_tolerance",
+    "test_head_ft",
+    "test_node_drain",
+    "test_chaos_and_bridges",
+)
+
+
+def _wants_sanitizer(item) -> bool:
+    mod = getattr(getattr(item, "module", None), "__name__", "")
+    return (
+        any(mod.endswith(m) for m in _SANITIZED_MODULES)
+        or item.get_closest_marker("chaos") is not None
+    )
+
+
+def pytest_runtest_setup(item):
+    if _wants_sanitizer(item):
+        from ray_tpu._private import sanitize
+
+        sanitize.install()
+
+
+def pytest_runtest_teardown(item, nextitem):
+    if _wants_sanitizer(item):
+        from ray_tpu._private import sanitize
+
+        sanitize.uninstall()
+        # One module's lock order must not poison the next test's graph
+        # (different cluster topology, same lock names).
+        sanitize.reset()
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
     import signal

@@ -1,0 +1,338 @@
+"""tpulint + runtime sanitizer self-tests (tier-1).
+
+Fixture tests pin EXACT rule ids and line numbers against the known-bad
+snippets in tests/lint_fixtures/ — a pass that silently stops firing
+(or fires on the wrong line) fails here, not in a code review three
+PRs later. The full-tree test is the enforcement gate: `ray_tpu lint
+ray_tpu/` must run clean against the checked-in lint_baseline.json.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import sanitize
+from ray_tpu._private.lint import analyze_file, analyze_paths, analyze_source
+from ray_tpu._private.lint.cli import main as lint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+PACKAGE = os.path.join(REPO_ROOT, "ray_tpu")
+BASELINE = os.path.join(REPO_ROOT, "lint_baseline.json")
+
+
+def _hits(name):
+    path = os.path.join(FIXTURES, name)
+    return [(v.rule, v.line) for v in analyze_file(path)]
+
+
+# --------------------------------------------------------------- fixtures
+def test_fixture_collective():
+    assert _hits("bad_collective.py") == [
+        ("TPU101", 9),
+        ("TPU101", 17),
+        ("TPU102", 23),
+    ]
+
+
+def test_fixture_locks():
+    assert _hits("bad_locks.py") == [
+        ("TPU201", 16),
+        ("TPU201", 17),
+        ("TPU201", 22),
+        ("TPU202", 27),
+    ]
+
+
+def test_fixture_except():
+    # 49 is the pragma-without-reason site: an unexplained allow is
+    # inert by design.
+    assert _hits("bad_except.py") == [
+        ("TPU301", 11),
+        ("TPU301", 18),
+        ("TPU301", 49),
+    ]
+
+
+def test_fixture_metrics():
+    assert _hits("bad_metrics.py") == [
+        ("TPU401", 12),
+        ("TPU401", 14),
+        ("TPU402", 19),
+    ]
+
+
+def test_fixture_rpc():
+    assert _hits("bad_rpc.py") == [("TPU501", 16)]
+
+
+def test_lock_order_cycle_cross_file(tmp_path):
+    # The acquisition graph is global: each half of the inversion lives
+    # in its own module.
+    (tmp_path / "a.py").write_text(
+        "import threading\n"
+        "from b import flush\n"
+        "_table_lock = threading.Lock()\n"
+        "def update():\n"
+        "    with _table_lock:\n"
+        "        flush()\n"
+    )
+    (tmp_path / "b.py").write_text(
+        "import threading\n"
+        "_flush_lock = threading.Lock()\n"
+        "def flush():\n"
+        "    with _flush_lock:\n"
+        "        pass\n"
+    )
+    violations, errors = analyze_paths([str(tmp_path)])
+    assert not errors
+    # One direction alone (a holds table, calls b's flush which takes
+    # flush_lock: edge table→flush) is NOT a cycle.
+    assert [v.rule for v in violations] == []
+    # c.py closes it: flush_lock held, then table_lock — imported names
+    # unify with their defining modules, so the edge is flush→table.
+    (tmp_path / "c.py").write_text(
+        "from b import _flush_lock\n"
+        "from a import _table_lock\n"
+        "def reverse():\n"
+        "    with _flush_lock:\n"
+        "        with _table_lock:\n"
+        "            pass\n"
+    )
+    violations, _ = analyze_paths([str(tmp_path)])
+    assert [v.rule for v in violations] == ["TPU202"]
+    assert "a._table_lock" in violations[0].message
+    assert "b._flush_lock" in violations[0].message
+
+
+def test_pragma_requires_reason():
+    clean = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    # tpulint: allow(broad-except reason=testing)\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert analyze_source(clean) == []
+    inert = clean.replace(" reason=testing", "")
+    assert [v.rule for v in analyze_source(inert)] == ["TPU301"]
+
+
+def test_pragma_accepts_rule_id():
+    src = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    # tpulint: allow(TPU301 reason=id form works too)\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert analyze_source(src) == []
+
+
+# ------------------------------------------------------------ enforcement
+def test_full_tree_clean_against_baseline(capsys):
+    """THE gate: `ray_tpu lint ray_tpu/` is clean against the checked-in
+    baseline. If this fails you either introduced a new violation (fix
+    it or pragma it with a reason) or fixed a pinned one (regenerate:
+    `python -m ray_tpu._private.lint ray_tpu --update-baseline`)."""
+    rc = lint_main([
+        PACKAGE, "--baseline", BASELINE, "--relative-to", REPO_ROOT,
+        "--json",
+    ])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, (
+        "new tpulint violations:\n" + "\n".join(
+            f"{v['path']}:{v['line']}: {v['rule']} {v['message']}"
+            for v in out["violations"])
+    )
+    assert out["parse_errors"] == []
+    # The two files PR 4 cleaned up must STAY clean — not re-baselined.
+    for fp in out.get("stale_baseline_entries", []):
+        assert not fp.startswith("TPU301|ray_tpu/runtime/node.py"), fp
+
+
+def test_full_tree_perf_floor():
+    """The analyzer must stay cheap enough to live in tier-1: a full
+    ray_tpu/ sweep under 10 s on CPU (currently ~3.5 s)."""
+    t0 = time.monotonic()
+    violations, errors = analyze_paths([PACKAGE], relative_to=REPO_ROOT)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"tpulint took {elapsed:.1f}s over ray_tpu/"
+    assert not errors
+    assert violations, "full tree has baselined debt; zero hits means a pass broke"
+
+
+def test_baseline_diff(tmp_path, capsys):
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    bad = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    (tree / "mod.py").write_text(bad)
+    baseline = tmp_path / "base.json"
+
+    # Pin the existing debt…
+    rc = lint_main([str(tree), "--baseline", str(baseline),
+                    "--update-baseline", "--relative-to", str(tmp_path)])
+    assert rc == 0
+    capsys.readouterr()
+
+    # …pinned violation passes…
+    rc = lint_main([str(tree), "--baseline", str(baseline),
+                    "--relative-to", str(tmp_path)])
+    assert rc == 0
+
+    # …a NEW violation fails, and only IT is reported.
+    (tree / "mod2.py").write_text(bad.replace("f()", "g()"))
+    rc = lint_main([str(tree), "--baseline", str(baseline),
+                    "--relative-to", str(tmp_path), "--json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert [v["path"] for v in out["violations"]] == ["pkg/mod2.py"]
+    assert out["baselined"] == 1
+
+    # Debt paid → stale entry surfaces, still rc 0.
+    (tree / "mod.py").write_text("x = 1\n")
+    rc = lint_main([str(tree), "--baseline", str(baseline),
+                    "--relative-to", str(tmp_path), "--json"])
+    capsys.readouterr()
+    assert rc == 1  # mod2.py still new
+    (tree / "mod2.py").write_text("x = 2\n")
+    rc = lint_main([str(tree), "--baseline", str(baseline),
+                    "--relative-to", str(tmp_path), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert len(out["stale_baseline_entries"]) == 1
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path, capsys):
+    """Inserting code ABOVE a pinned violation must not unpin it."""
+    tree = tmp_path / "pkg"
+    tree.mkdir()
+    body = (
+        "def f():\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    (tree / "mod.py").write_text(body)
+    baseline = tmp_path / "base.json"
+    lint_main([str(tree), "--baseline", str(baseline),
+               "--update-baseline", "--relative-to", str(tmp_path)])
+    capsys.readouterr()
+    (tree / "mod.py").write_text("import os  # shifts lines\n\n" + body)
+    rc = lint_main([str(tree), "--baseline", str(baseline),
+                    "--relative-to", str(tmp_path)])
+    assert rc == 0
+
+
+# -------------------------------------------------------------- sanitizer
+def test_sanitizer_lock_order_inversion():
+    """Seeded A→B / B→A inversion across two threads: the second
+    thread's inner acquire must raise LockOrderViolation naming the
+    cycle (not deadlock, not pass silently)."""
+    sanitize.reset()
+    A = sanitize.InstrumentedLock("test.A")
+    B = sanitize.InstrumentedLock("test.B")
+    phase = threading.Event()
+    caught = []
+
+    def forward():
+        with A:
+            with B:
+                phase.set()
+
+    def reverse():
+        phase.wait(5)
+        try:
+            with B:
+                with A:
+                    pass
+        except sanitize.LockOrderViolation as e:
+            caught.append(e)
+
+    t1 = threading.Thread(target=forward)
+    t2 = threading.Thread(target=reverse)
+    t1.start(); t2.start(); t1.join(5); t2.join(5)
+    assert len(caught) == 1
+    assert set(caught[0].cycle) == {"test.A", "test.B"}
+    assert sanitize.stats()["cycles_detected"] == 1
+
+
+def test_sanitizer_rlock_reentrant_no_self_cycle():
+    sanitize.reset()
+    R = sanitize.InstrumentedLock("test.R", reentrant=True)
+    with R:
+        with R:  # reentrant re-acquire is not an order edge
+            pass
+    assert sanitize.stats()["cycles_detected"] == 0
+
+
+def test_sanitizer_long_hold_warns(caplog):
+    sanitize.reset()
+    lk = sanitize.InstrumentedLock("test.slow", hold_threshold_s=0.01)
+    with caplog.at_level("WARNING", logger="ray_tpu._private.sanitize"):
+        with lk:
+            time.sleep(0.03)
+    assert any("held for" in r.message for r in caplog.records)
+    assert sanitize.stats()["long_holds"] == 1
+
+
+def test_sanitizer_install_filters_by_module():
+    """install() hands instrumented locks to ray_tpu/test code and raw
+    locks to everything else (this module counts as test code)."""
+    sanitize.reset()
+    sanitize.install()
+    try:
+        lk = threading.Lock()  # allocated from test_lint → instrumented
+        assert isinstance(lk, sanitize.InstrumentedLock)
+        with lk:
+            pass
+    finally:
+        sanitize.uninstall()
+    raw = threading.Lock()
+    assert not isinstance(raw, sanitize.InstrumentedLock)
+
+
+def test_sanitizer_nonblocking_acquire():
+    sanitize.reset()
+    lk = sanitize.InstrumentedLock("test.nb")
+    assert lk.acquire() is True
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(lk.acquire(blocking=False)))
+    t.start(); t.join(5)
+    assert got == [False]
+    lk.release()
+
+
+def test_cli_select_and_json(capsys):
+    rc = lint_main([
+        os.path.join(FIXTURES, "bad_rpc.py"), "--baseline", "off",
+        "--json", "--select", "rpc-reentrancy",
+        "--relative-to", REPO_ROOT,
+    ])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert [v["rule"] for v in out["violations"]] == ["TPU501"]
+    assert out["violations"][0]["line"] == 16
+
+
+@pytest.mark.parametrize("fixture", [
+    "bad_collective.py", "bad_locks.py", "bad_except.py",
+    "bad_metrics.py", "bad_rpc.py",
+])
+def test_fixtures_parse_as_valid_python(fixture):
+    import ast
+    with open(os.path.join(FIXTURES, fixture), encoding="utf-8") as f:
+        ast.parse(f.read())

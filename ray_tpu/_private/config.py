@@ -112,6 +112,25 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                                "checkpoint is in hand "
                                                "so the attempt resumes "
                                                "losing ≤1 step"),
+    # --- distributed checkpoints
+    "CKPT_REPLICATION": (int, 2, "total in-cluster copies of each "
+                                 "checkpoint chunk (1 = local store "
+                                 "only, no durability without a shared "
+                                 "filesystem)"),
+    "CKPT_CHUNK_BYTES": (int, 1 << 20, "content-addressed checkpoint "
+                                       "chunk size (the dedup "
+                                       "granularity)"),
+    "CKPT_KEEP": (int, 2, "complete checkpoints retained per run in the "
+                          "shard store; older manifests prune and their "
+                          "unreferenced chunks are collected"),
+    "CKPT_REPAIR_INTERVAL_S": (float, 2.0, "head repair-loop cadence for "
+                                           "re-replicating under-"
+                                           "replicated checkpoint "
+                                           "chunks"),
+    "CKPT_PERSIST_DELAY_S": (float, 0.0, "chaos spec: hold the window "
+                                         "between chunk writes and the "
+                                         "manifest commit open this "
+                                         "long (kill-mid-save tests)"),
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: comma-separated method:prob "
                              "list ('*' matches any method)"),

@@ -1,0 +1,409 @@
+"""Async snapshot-offload checkpointing.
+
+``AsyncCheckpointer.save()`` pays only the device→host copy on the step
+loop (double-buffered host arrays, bounded to ONE in-flight snapshot)
+and returns; a background writer thread then serializes the owned
+shards into the content-addressed chunk store, replicates each chunk to
+R-1 peer nodes over the existing object-transfer path, and commits the
+manifest to the head. The manifest commit is the linearization point:
+until it lands, the checkpoint does not exist, so a worker killed
+mid-persist leaves the previous checkpoint fully restorable and never
+exposes a partial one.
+
+The emergency-checkpoint path (node drain notice) reuses whatever
+snapshot is already offloaded: the drain window pays only the persist,
+never the copy — ``wait()``/``wait_pending()`` is the barrier.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ray_tpu.checkpoint import manifest as _manifest
+from ray_tpu.checkpoint.store import ShardStore, make_uri
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+logger = logging.getLogger("ray_tpu.checkpoint")
+
+CKPT_BYTES = Counter(
+    "ray_tpu_ckpt_bytes_total",
+    "checkpoint bytes by kind: 'logical' = snapshot size, 'written' = "
+    "new chunk bytes after dedup",
+    tag_keys=("job", "kind"),
+)
+DEDUP_RATIO = Gauge(
+    "ray_tpu_ckpt_dedup_ratio",
+    "fraction of the last checkpoint's bytes served by existing chunks",
+    tag_keys=("job",),
+)
+REPLICATION_LAG = Gauge(
+    "ray_tpu_ckpt_replication_lag_seconds",
+    "snapshot-offload to manifest-commit latency of the last checkpoint",
+    tag_keys=("job",),
+)
+PHASE_SECONDS = Histogram(
+    "ray_tpu_ckpt_phase_seconds",
+    "checkpoint pipeline time by phase (snapshot is the only one the "
+    "step loop pays)",
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    tag_keys=("job", "phase"),
+)
+
+# Live checkpointers in this process: the emergency-unwind barrier
+# (session.report → wait_pending) must reach them without the train loop
+# having to thread handles around.
+_live: "weakref.WeakSet[AsyncCheckpointer]" = weakref.WeakSet()
+
+# Step-loop stall seconds accumulated since the last report(): the
+# goodput ledger charges ONLY this (the snapshot copy), not the
+# background persist that overlaps compute.
+_stall_lock = threading.Lock()
+_stall_s = 0.0
+
+
+def _add_stall(seconds: float) -> None:
+    global _stall_s
+    with _stall_lock:
+        _stall_s += seconds
+
+
+def take_step_stall_seconds() -> float:
+    """Drain the accumulated checkpoint stall (called by report())."""
+    global _stall_s
+    with _stall_lock:
+        s = _stall_s
+        _stall_s = 0.0
+    return s
+
+
+def wait_pending(timeout: float | None = None) -> None:
+    """Barrier every in-flight checkpoint in this process (attempt end,
+    emergency unwind). Raises the first persist failure."""
+    for cp in list(_live):
+        cp.wait(timeout=timeout)
+
+
+def _runtime():
+    import ray_tpu.api as api
+
+    rt = api._runtime
+    if getattr(rt, "core", None) is None:
+        raise RuntimeError(
+            "ray_tpu.checkpoint needs an initialized runtime "
+            "(ray_tpu.init) — the shard store lives in the node object "
+            "store and manifests commit to the head"
+        )
+    return rt
+
+
+class AsyncCheckpointer:
+    """Distributed, replicated checkpoints for one training run.
+
+    ::
+
+        cp = ray_tpu.checkpoint.AsyncCheckpointer()   # run/rank from ctx
+        for step in ...:
+            state = train_step(state, batch)
+            uri = cp.save(step, state)      # device→host copy only
+            train.report(metrics, checkpoint=uri)
+        cp.wait()                           # end-of-attempt barrier
+    """
+
+    def __init__(
+        self,
+        run: str | None = None,
+        *,
+        replication: int | None = None,
+        rank: int | None = None,
+        world: int | None = None,
+    ):
+        from ray_tpu._private import config
+        from ray_tpu.train import session
+
+        ctx = session._context
+        self.run = run or (ctx.experiment_name if ctx else "default")
+        self.rank = rank if rank is not None else (ctx.rank if ctx else 0)
+        self.world = (
+            world if world is not None else (ctx.world_size if ctx else 1)
+        )
+        self.replication = int(
+            replication
+            if replication is not None
+            else config.get("CKPT_REPLICATION")
+        )
+        # key → list[(index_spec, host buffer)]: the double buffer. save()
+        # only runs while no persist is in flight, so the writer thread
+        # and the copy never touch the same buffers concurrently.
+        self._host: dict[str, list[tuple[list | None, np.ndarray]]] = {}
+        self._thread: threading.Thread | None = None
+        self._err: Exception | None = None
+        # Stats of the last completed persist (tests + dashboards).
+        self.last: dict = {}
+        _live.add(self)
+
+    # ------------------------------------------------------------- save
+    def save(self, step: int, state, metrics: dict | None = None) -> str:
+        """Snapshot ``state`` and return immediately; persistence +
+        replication + manifest commit happen in the background. Bounded
+        by one in-flight snapshot: a second save first waits out the
+        previous persist (backpressure, not a queue)."""
+        t0 = time.perf_counter()
+        self.wait()
+        snapshot: list[tuple[str, tuple, list]] = []
+        for key, leaf in _manifest.owned_items(state, self.rank, self.world):
+            # Global shape comes from the LEAF (a process-sharded
+            # array's local windows may not reach the far edge); a
+            # shapeless leaf (python scalar/list) uses its host copy's.
+            shape_attr = getattr(leaf, "shape", None)
+            shards = _manifest.local_shards(leaf)
+            global_shape = (
+                tuple(shape_attr)
+                if shape_attr is not None
+                else tuple(shards[0][1].shape)
+            )
+            bufs = self._host.get(key)
+            if (
+                bufs is None
+                or len(bufs) != len(shards)
+                or any(
+                    b.shape != a.shape or b.dtype != a.dtype
+                    for (_, b), (_, a) in zip(bufs, shards)
+                )
+            ):
+                bufs = [
+                    (idx, np.array(arr, copy=True)) for idx, arr in shards
+                ]
+                self._host[key] = bufs
+            else:
+                for (_, dst), (idx, src) in zip(bufs, shards):
+                    np.copyto(dst, src)
+                self._host[key] = bufs = [
+                    (idx, dst) for (_, dst), (idx, _) in zip(bufs, shards)
+                ]
+            snapshot.append((key, global_shape, bufs))
+        snap_s = time.perf_counter() - t0
+        _add_stall(snap_s)
+        PHASE_SECONDS.observe(snap_s, tags={"job": self.run, "phase": "snapshot"})
+        from ray_tpu.util import tracing
+
+        tracing.emit_span(
+            "ckpt:snapshot",
+            time.time() - snap_s,
+            snap_s,
+            train_job=self.run,
+            ckpt_step=int(step),
+        )
+        self._err = None
+        self._thread = threading.Thread(
+            target=self._persist,
+            args=(int(step), snapshot, dict(metrics or {}), time.time()),
+            name=f"ckpt-persist-{self.run}",
+            daemon=True,
+        )
+        self._thread.start()
+        return make_uri(self.run, step)
+
+    def wait(self, timeout: float | None = None) -> None:
+        """Block until the in-flight persist (if any) commits; raise its
+        failure. This is the attempt-end / emergency barrier."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"checkpoint persist for run {self.run!r} still "
+                    f"running after {timeout}s"
+                )
+            self._thread = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    @property
+    def in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # ---------------------------------------------------------- persist
+    def _persist(self, step, snapshot, metrics, t_offloaded) -> None:
+        try:
+            self._persist_inner(step, snapshot, metrics, t_offloaded)
+        except Exception as e:  # noqa: BLE001 - surfaced via wait()
+            logger.warning(
+                "checkpoint persist failed for %s step %s: %r",
+                self.run,
+                step,
+                e,
+            )
+            self._err = e
+
+    def _persist_inner(self, step, snapshot, metrics, t_offloaded) -> None:
+        from ray_tpu._private import config
+
+        rt = _runtime()
+        shard_store = ShardStore(rt.core.store)
+        own_addr = rt.core.node_addr or rt.core.addr
+        t0 = time.perf_counter()
+        entries: list[dict] = []
+        locations: dict[str, list[str]] = {}
+        logical = 0
+        new_bytes = 0
+        for key, global_shape, bufs in snapshot:
+            shards = []
+            for index, arr in bufs:
+                flat = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+                hashes, nb = shard_store.put_bytes(flat)
+                new_bytes += nb
+                logical += flat.nbytes
+                for h in hashes:
+                    locations.setdefault(h, [own_addr])
+                shards.append(
+                    {
+                        "index": index,
+                        "chunks": hashes,
+                        "nbytes": int(flat.nbytes),
+                    }
+                )
+            entries.append(
+                {
+                    "key": key,
+                    "shape": list(global_shape),
+                    "dtype": bufs[0][1].dtype.name,
+                    "shards": shards,
+                }
+            )
+        write_s = time.perf_counter() - t0
+        delay = config.get("CKPT_PERSIST_DELAY_S")
+        if delay:
+            # Chaos hook: hold the window between chunk writes and the
+            # manifest commit open so kill-mid-save tests can land inside
+            # the exact race the commit protocol closes.
+            time.sleep(float(delay))
+
+        t1 = time.perf_counter()
+        all_chunks = list(locations)
+        replicated = self._replicate(rt, all_chunks, own_addr, locations)
+        repl_s = time.perf_counter() - t1
+
+        t2 = time.perf_counter()
+        reply = rt.run(
+            rt.core.head.call(
+                "ckpt_commit",
+                run=self.run,
+                step=int(step),
+                rank=self.rank,
+                world=self.world,
+                entries=entries,
+                locations=locations,
+                metrics=metrics,
+            )
+        )
+        commit_s = time.perf_counter() - t2
+        lag = time.time() - t_offloaded
+
+        tags = {"job": self.run}
+        CKPT_BYTES.inc(logical, tags={"job": self.run, "kind": "logical"})
+        CKPT_BYTES.inc(new_bytes, tags={"job": self.run, "kind": "written"})
+        if logical:
+            DEDUP_RATIO.set(1.0 - new_bytes / logical, tags=tags)
+        REPLICATION_LAG.set(lag, tags=tags)
+        PHASE_SECONDS.observe(write_s, tags={"job": self.run, "phase": "write"})
+        PHASE_SECONDS.observe(
+            repl_s, tags={"job": self.run, "phase": "replicate"}
+        )
+        PHASE_SECONDS.observe(
+            commit_s, tags={"job": self.run, "phase": "commit"}
+        )
+        from ray_tpu.util import tracing
+
+        tracing.emit_span(
+            "ckpt:persist",
+            t_offloaded,
+            lag,
+            train_job=self.run,
+            ckpt_step=int(step),
+            bytes=logical,
+            new_bytes=new_bytes,
+        )
+        self.last = {
+            "step": int(step),
+            "uri": make_uri(self.run, step),
+            "logical_bytes": logical,
+            "new_bytes": new_bytes,
+            "chunks": len(all_chunks),
+            "replicas": replicated,
+            "complete": bool(reply.get("complete")),
+            "persist_s": write_s + repl_s + commit_s,
+            "replication_lag_s": lag,
+        }
+
+    # -------------------------------------------------------- replicate
+    def _pick_peers(self, rt, own_addr: str) -> list[str]:
+        """R-1 peer node addrs, preferring different-slice, non-draining
+        nodes (a replica on the same slice dies with the original)."""
+        try:
+            status = rt.run(rt.core.head.call("cluster_status"))
+        except Exception as e:  # noqa: BLE001 - degraded head: local-only
+            logger.warning("checkpoint peer pick failed: %r", e)
+            return []
+        draining = set(status.get("draining") or {})
+        nodes = status.get("nodes", {})
+        own_slice = None
+        for nid, n in nodes.items():
+            if n.get("addr") == own_addr:
+                own_slice = (n.get("labels") or {}).get("slice")
+        fresh, fallback = [], []
+        for nid, n in nodes.items():
+            addr = n.get("addr")
+            if not addr or addr == own_addr:
+                continue
+            labels = n.get("labels") or {}
+            if nid in draining:
+                fallback.append(addr)
+            elif own_slice is not None and labels.get("slice") == own_slice:
+                fallback.append(addr)
+            else:
+                fresh.append(addr)
+        # Deterministic per-rank rotation spreads replica load across the
+        # cluster instead of every rank hammering the same peer.
+        candidates = sorted(fresh) + sorted(fallback)
+        if candidates:
+            shift = self.rank % len(candidates)
+            candidates = candidates[shift:] + candidates[:shift]
+        return candidates[: max(0, self.replication - 1)]
+
+    def _replicate(
+        self, rt, chunks: list[str], own_addr: str, locations: dict
+    ) -> int:
+        """Push every chunk of this checkpoint at R-1 peers (peers skip
+        chunks they already hold, so dedup'd saves replicate for free).
+        Returns the number of peer replicas confirmed."""
+        if self.replication <= 1 or not chunks:
+            return 0
+        confirmed = 0
+        for peer in self._pick_peers(rt, own_addr):
+            try:
+                conn = rt.run(rt.core._connect(peer))
+                reply = rt.run(
+                    conn.call(
+                        "prefetch_objects",
+                        oids=chunks,
+                        owner_addr=own_addr,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - peer died: head repair
+                logger.warning(            # re-replicates once it notices
+                    "checkpoint replication to %s failed: %r", peer, e
+                )
+                continue
+            results = reply.get("results", {})
+            for h in chunks:
+                if results.get(h):
+                    locations.setdefault(h, []).append(peer)
+            confirmed += 1
+        return confirmed

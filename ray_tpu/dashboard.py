@@ -59,6 +59,13 @@ def _train():
     return state.train_stats()
 
 
+@_route("/api/checkpoints")
+def _checkpoints():
+    """In-cluster shard-store checkpoints: per-run steps with
+    completeness, dedup'd byte counts, and replica health."""
+    return state.list_checkpoints()
+
+
 _job_client = None
 _job_client_lock = threading.Lock()
 

@@ -13,6 +13,7 @@ Endpoints:
     /api/logs        worker log listing (node-local files)
     /api/logs/<wid>  one worker's log (raw text, ?tail=N bytes)
     /api/train       per-job train goodput (head passthrough)
+    /api/checkpoints shard-store checkpoint table (head passthrough)
     /metrics         node-local Prometheus text
 """
 
@@ -130,6 +131,14 @@ class NodeAgent:
             return {"error": "node has no head connection"}
         return await self.node.head.call("train_stats")
 
+    async def _checkpoints(self, query) -> dict:
+        """Head passthrough: shard-store checkpoint table (same data as
+        the dashboard's /api/checkpoints)."""
+        if self.node.head is None:
+            return {"error": "node has no head connection"}
+        run = query.get("run", [None])[0]
+        return await self.node.head.call("ckpt_list", run=run)
+
     def _metrics(self, query) -> str:
         s = self._stats(query)
         lines = [
@@ -187,6 +196,11 @@ class NodeAgent:
             elif path == "/api/train":
                 body, ctype = (
                     json.dumps(await self._train(query)),
+                    "application/json",
+                )
+            elif path == "/api/checkpoints":
+                body, ctype = (
+                    json.dumps(await self._checkpoints(query)),
                     "application/json",
                 )
             elif path == "/metrics":

@@ -12,11 +12,14 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import logging
 import time
 from typing import Any
 
 from ray_tpu._private import rpc
 from ray_tpu._private.ids import ActorID, NodeID
+
+logger = logging.getLogger("ray_tpu.head")
 
 class HeadService:
     def __init__(self, journal_path: str | None = None):
@@ -84,6 +87,17 @@ class HeadService:
         # as ONE demand unit, and entries age out seconds after the
         # requester stops polling (granted or gave up).
         self.unschedulable: dict[str, tuple[dict, float]] = {}
+        # Distributed checkpoint metadata (the shard store's authority):
+        # run → step → {"world", "ranks": {rank: {"entries", "metrics",
+        # "ts"}}, "complete_ts"}. A checkpoint EXISTS once every rank of
+        # its world has committed — partial shard sets are invisible to
+        # restore. Journaled (like the drain table) so replica state
+        # survives a head restart.
+        self.checkpoints: dict[str, dict[int, dict]] = {}
+        # chunk hash → set of node addrs holding a replica.
+        self.ckpt_locations: dict[str, set[str]] = {}
+        self._ckpt_repairing = False
+        self._ckpt_last_repair = 0.0
         # Vectorized scheduling columns: per-resource-kind numpy views
         # over a stable node ordering, rebuilt on membership change and
         # updated in place on each resource sync. The label-free pick
@@ -153,6 +167,20 @@ class HeadService:
                     nid: dict(d)
                     for nid, d in payload.get("draining", {}).items()
                 }
+                self.checkpoints = {
+                    run: {int(s): dict(rec) for s, rec in steps.items()}
+                    for run, steps in payload.get(
+                        "checkpoints", {}
+                    ).items()
+                }
+                self.ckpt_locations = {
+                    h: set(addrs)
+                    for h, addrs in payload.get(
+                        "ckpt_locations", {}
+                    ).items()
+                }
+            elif table == "ckpt":
+                self._ckpt_replay(op, payload)
             elif table == "drain":
                 if op == "put":
                     self.draining[payload["node_id"]] = dict(
@@ -198,6 +226,14 @@ class HeadService:
             },
             "draining": {
                 nid: dict(d) for nid, d in self.draining.items()
+            },
+            "checkpoints": {
+                run: {s: dict(rec) for s, rec in steps.items()}
+                for run, steps in self.checkpoints.items()
+            },
+            "ckpt_locations": {
+                h: sorted(addrs)
+                for h, addrs in self.ckpt_locations.items()
             },
         }
 
@@ -420,6 +456,9 @@ class HeadService:
             },
         )
         await self._push_set_draining(node_id, rec)
+        # Drain-aware checkpoint evacuation: chunks whose only replicas
+        # live on this node must re-replicate INSIDE the notice window.
+        self._schedule_ckpt_repair()
         return {"ok": True, **rec}
 
     async def _on_undrain_node(self, conn, node_id: str):
@@ -452,6 +491,432 @@ class HeadService:
         return {
             "draining": {nid: dict(d) for nid, d in self.draining.items()}
         }
+
+    # ------------------------------------------- distributed checkpoints
+    def _ckpt_replay(self, op: str, payload: dict) -> None:
+        """Fold one journaled "ckpt" op back into the tables."""
+        if op == "commit":
+            self._ckpt_apply_commit(**payload)
+        elif op == "loc":
+            self.ckpt_locations.setdefault(
+                payload["chunk"], set()
+            ).update(payload["addrs"])
+        elif op == "loc_del":
+            locs = self.ckpt_locations.get(payload["chunk"])
+            if locs is not None:
+                locs.difference_update(payload["addrs"])
+                if not locs:
+                    self.ckpt_locations.pop(payload["chunk"], None)
+        elif op == "prune":
+            steps = self.checkpoints.get(payload["run"])
+            if steps is not None:
+                steps.pop(payload["step"], None)
+                if not steps:
+                    self.checkpoints.pop(payload["run"], None)
+
+    def _ckpt_apply_commit(
+        self, run, step, rank, world, entries, metrics=None, ts=None
+    ) -> bool:
+        """Fold one rank's manifest; returns True when this commit
+        COMPLETES the checkpoint (every rank of its world committed)."""
+        steps = self.checkpoints.setdefault(run, {})
+        rec = steps.setdefault(
+            step, {"world": int(world), "ranks": {}, "complete_ts": None}
+        )
+        if rec["world"] != int(world):
+            # A retry attempt re-saving the same step at a new world
+            # size supersedes the old shape — stale ranks would make
+            # completeness undecidable.
+            rec["world"] = int(world)
+            rec["ranks"] = {}
+            rec["complete_ts"] = None
+        rec["ranks"][int(rank)] = {
+            "entries": list(entries),
+            "metrics": dict(metrics or {}),
+            "ts": ts if ts is not None else time.time(),
+        }
+        if rec["complete_ts"] is None and set(range(rec["world"])) <= set(
+            rec["ranks"]
+        ):
+            rec["complete_ts"] = ts if ts is not None else time.time()
+            return True
+        return False
+
+    async def _on_ckpt_commit(
+        self,
+        conn,
+        run: str,
+        step: int,
+        rank: int,
+        world: int,
+        entries: list,
+        locations: dict | None = None,
+        metrics: dict | None = None,
+    ):
+        """Commit one rank's shard manifest. The checkpoint becomes
+        visible to restore only once all ranks commit — this is the
+        consistency protocol: manifest commit = checkpoint exists."""
+        now = time.time()
+        completed = self._ckpt_apply_commit(
+            run, int(step), int(rank), int(world), entries, metrics, now
+        )
+        self._journal_append(
+            "ckpt",
+            "commit",
+            {
+                "run": run,
+                "step": int(step),
+                "rank": int(rank),
+                "world": int(world),
+                "entries": list(entries),
+                "metrics": dict(metrics or {}),
+                "ts": now,
+            },
+        )
+        for chunk, addrs in (locations or {}).items():
+            known = self.ckpt_locations.setdefault(chunk, set())
+            fresh = [a for a in addrs if a and a not in known]
+            if fresh:
+                known.update(fresh)
+                self._journal_append(
+                    "ckpt", "loc", {"chunk": chunk, "addrs": fresh}
+                )
+        if completed:
+            self._ckpt_prune(run)
+        rec = self.checkpoints[run][int(step)]
+        return {
+            "ok": True,
+            "complete": rec["complete_ts"] is not None,
+            "ranks": len(rec["ranks"]),
+            "world": rec["world"],
+        }
+
+    def _ckpt_referenced_chunks(self) -> set[str]:
+        from ray_tpu.checkpoint.manifest import manifest_chunks
+
+        out: set[str] = set()
+        for steps in self.checkpoints.values():
+            for rec in steps.values():
+                for r in rec["ranks"].values():
+                    out |= manifest_chunks(r["entries"])
+        return out
+
+    def _ckpt_prune(self, run: str) -> None:
+        """Retention: keep the newest CKPT_KEEP complete checkpoints per
+        run; older manifests — and incomplete ones a newer complete
+        checkpoint has obsoleted — prune, then their now-unreferenced
+        chunks are collected off the holder nodes."""
+        from ray_tpu._private import config
+
+        steps = self.checkpoints.get(run, {})
+        complete = sorted(
+            s for s, rec in steps.items() if rec["complete_ts"] is not None
+        )
+        if not complete:
+            return
+        keep = set(complete[-max(1, int(config.get("CKPT_KEEP"))):])
+        newest = complete[-1]
+        victims = [
+            s
+            for s, rec in steps.items()
+            if s not in keep
+            and (rec["complete_ts"] is not None or s < newest)
+        ]
+        if not victims:
+            return
+        from ray_tpu.checkpoint.manifest import manifest_chunks
+
+        victim_chunks: set[str] = set()
+        for s in victims:
+            rec = steps.pop(s)
+            for r in rec["ranks"].values():
+                victim_chunks |= manifest_chunks(r["entries"])
+            self._journal_append(
+                "ckpt", "prune", {"run": run, "step": s}
+            )
+        garbage = victim_chunks - self._ckpt_referenced_chunks()
+        if garbage:
+            asyncio.ensure_future(self._ckpt_gc(garbage))
+
+    async def _ckpt_gc(self, chunks: set[str]) -> None:
+        """Delete unreferenced chunks from their holder nodes (best
+        effort — a missed delete is shm garbage, not corruption)."""
+        by_addr: dict[str, list[str]] = {}
+        for chunk in chunks:
+            holders = self.ckpt_locations.pop(chunk, set())
+            for addr in holders:
+                by_addr.setdefault(addr, []).append(chunk)
+            if holders:
+                self._journal_append(
+                    "ckpt",
+                    "loc_del",
+                    {"chunk": chunk, "addrs": sorted(holders)},
+                )
+        conn_by_addr = {
+            n["addr"]: self._node_conns.get(nid)
+            for nid, n in self.nodes.items()
+        }
+        for addr, oids in by_addr.items():
+            conn = conn_by_addr.get(addr)
+            if conn is None:
+                continue
+            try:
+                await conn.call("delete_objects", oids=oids)
+            except Exception as e:  # noqa: BLE001 - node mid-death:
+                logger.debug(        # GC never blocks on a dying holder
+                    "checkpoint GC on %s failed: %r", addr, e
+                )
+
+    async def _on_ckpt_list(self, conn, run: str | None = None):
+        from ray_tpu.checkpoint.manifest import entry_bytes, manifest_chunks
+
+        out: dict[str, list] = {}
+        for rname, steps in self.checkpoints.items():
+            if run is not None and rname != run:
+                continue
+            rows = []
+            for s in sorted(steps):
+                rec = steps[s]
+                chunks: set[str] = set()
+                nbytes = 0
+                for r in rec["ranks"].values():
+                    chunks |= manifest_chunks(r["entries"])
+                    nbytes += sum(
+                        entry_bytes(e) for e in r["entries"]
+                    )
+                replicas = [
+                    len(self.ckpt_locations.get(h, ())) for h in chunks
+                ]
+                rows.append(
+                    {
+                        "step": s,
+                        "world": rec["world"],
+                        "ranks": sorted(rec["ranks"]),
+                        "complete": rec["complete_ts"] is not None,
+                        "ts": rec["complete_ts"],
+                        "bytes": nbytes,
+                        "chunks": len(chunks),
+                        "min_replicas": min(replicas, default=0),
+                    }
+                )
+            out[rname] = rows
+        return {"ok": True, "runs": out}
+
+    async def _on_ckpt_manifest(
+        self, conn, run: str, step: int | None = None
+    ):
+        """Merged manifest of the newest complete checkpoint (or an
+        exact complete step) plus current replica locations for every
+        referenced chunk — everything restore needs in one call."""
+        from ray_tpu.checkpoint.manifest import manifest_chunks
+
+        steps = self.checkpoints.get(run, {})
+        candidates = sorted(
+            s
+            for s, rec in steps.items()
+            if rec["complete_ts"] is not None
+            and (step is None or s == int(step))
+        )
+        if not candidates:
+            return {
+                "ok": False,
+                "error": f"no complete checkpoint for run {run!r}"
+                + (f" step {step}" if step is not None else ""),
+            }
+        s = candidates[-1]
+        rec = steps[s]
+        entries: dict[str, dict] = {}
+        for rank in sorted(rec["ranks"]):
+            for e in rec["ranks"][rank]["entries"]:
+                cur = entries.get(e["key"])
+                if cur is None:
+                    entries[e["key"]] = {
+                        "key": e["key"],
+                        "shape": list(e["shape"]),
+                        "dtype": e["dtype"],
+                        "shards": list(e["shards"]),
+                    }
+                else:
+                    # Process-sharded leaf: every rank holds disjoint
+                    # windows of the same key; restore stitches them.
+                    cur["shards"].extend(e["shards"])
+        chunks = manifest_chunks(entries)
+        return {
+            "ok": True,
+            "run": run,
+            "step": s,
+            "world": rec["world"],
+            "entries": entries,
+            "locations": {
+                h: sorted(self.ckpt_locations.get(h, ()))
+                for h in chunks
+            },
+        }
+
+    async def _on_ckpt_verify(self, conn, run: str | None = None):
+        """Probe every retained complete checkpoint's chunks on their
+        recorded holders; report under-replicated and lost chunks (the
+        `ray_tpu ckpt verify` backend)."""
+        from ray_tpu._private import config
+        from ray_tpu.checkpoint.manifest import manifest_chunks
+
+        want = int(config.get("CKPT_REPLICATION"))
+        alive = {n["addr"]: nid for nid, n in self.nodes.items()}
+        conn_by_addr = {
+            n["addr"]: self._node_conns.get(nid)
+            for nid, n in self.nodes.items()
+        }
+        reports = []
+        for rname, steps in self.checkpoints.items():
+            if run is not None and rname != run:
+                continue
+            for s, rec in sorted(steps.items()):
+                if rec["complete_ts"] is None:
+                    continue
+                chunks: set[str] = set()
+                for r in rec["ranks"].values():
+                    chunks |= manifest_chunks(r["entries"])
+                healthy_counts: dict[str, int] = {}
+                for h in sorted(chunks):
+                    n_ok = 0
+                    for addr in self.ckpt_locations.get(h, ()):
+                        node_conn = (
+                            conn_by_addr.get(addr)
+                            if addr in alive
+                            else None
+                        )
+                        if node_conn is None:
+                            continue
+                        try:
+                            meta = await node_conn.call(
+                                "get_object_meta", oid_hex=h
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            logger.debug(  # dead holder = missing replica
+                                "verify probe %s on %s: %r", h, addr, e
+                            )
+                            continue
+                        if meta.get("ok"):
+                            n_ok += 1
+                    healthy_counts[h] = n_ok
+                target = min(want, max(1, len(alive)))
+                reports.append(
+                    {
+                        "run": rname,
+                        "step": s,
+                        "chunks": len(chunks),
+                        "replication_target": target,
+                        "healthy": sum(
+                            1
+                            for v in healthy_counts.values()
+                            if v >= target
+                        ),
+                        "under_replicated": sorted(
+                            h
+                            for h, v in healthy_counts.items()
+                            if 0 < v < target
+                        ),
+                        "lost": sorted(
+                            h
+                            for h, v in healthy_counts.items()
+                            if v == 0
+                        ),
+                    }
+                )
+        return {"ok": True, "checkpoints": reports}
+
+    # ------------------------------------------------ checkpoint repair
+    def _schedule_ckpt_repair(self) -> None:
+        """Kick the repair pass (rate-limited, single-flight). Called
+        from the health loop tick and eagerly on node death/drain."""
+        from ray_tpu._private import config
+
+        if self._ckpt_repairing or not self.ckpt_locations or not self.nodes:
+            return
+        if (
+            time.monotonic() - self._ckpt_last_repair
+            < config.get("CKPT_REPAIR_INTERVAL_S")
+        ):
+            return
+        self._ckpt_repairing = True
+        asyncio.ensure_future(self._ckpt_repair_bg())
+
+    async def _ckpt_repair_bg(self) -> None:
+        try:
+            await self._ckpt_repair()
+        except Exception as e:  # noqa: BLE001 - repair must keep ticking
+            logger.warning("checkpoint repair pass failed: %r", e)
+        finally:
+            self._ckpt_last_repair = time.monotonic()
+            self._ckpt_repairing = False
+
+    async def _ckpt_repair(self) -> None:
+        """Re-replicate under-replicated checkpoint chunks.
+
+        A holder is *live* while its node is registered and *healthy*
+        while additionally not DRAINING — so a drain notice immediately
+        makes chunks whose only replicas live on the draining node
+        eligible for evacuation, before the node dies. Dead holders are
+        only forgotten once a chunk is healthy again (never drop the
+        last record of where data might still be)."""
+        from ray_tpu._private import config
+
+        want = int(config.get("CKPT_REPLICATION"))
+        alive = {n["addr"]: nid for nid, n in self.nodes.items()}
+        draining_addrs = {
+            self.nodes[nid]["addr"]
+            for nid in self.draining
+            if nid in self.nodes
+        }
+        healthy_addrs = set(alive) - draining_addrs
+        if not healthy_addrs:
+            return
+        referenced = self._ckpt_referenced_chunks()
+        # (source, target) → chunks: one batched prefetch per pair.
+        plan: dict[tuple[str, str], list[str]] = {}
+        for chunk in referenced:
+            locs = self.ckpt_locations.get(chunk)
+            if not locs:
+                continue
+            live = locs & set(alive)
+            healthy = live - draining_addrs
+            target_n = min(want, len(healthy_addrs))
+            if len(healthy) >= target_n:
+                dead = locs - set(alive)
+                if dead:
+                    locs.difference_update(dead)
+                    self._journal_append(
+                        "ckpt",
+                        "loc_del",
+                        {"chunk": chunk, "addrs": sorted(dead)},
+                    )
+                continue
+            sources = sorted(healthy) or sorted(live)
+            if not sources:
+                continue  # every replica gone until a holder returns
+            candidates = sorted(healthy_addrs - live)
+            for tgt in candidates[: target_n - len(healthy)]:
+                plan.setdefault((sources[0], tgt), []).append(chunk)
+        for (src, tgt), chunks in plan.items():
+            node_conn = self._node_conns.get(alive.get(tgt, ""))
+            if node_conn is None:
+                continue
+            try:
+                reply = await node_conn.call(
+                    "prefetch_objects", oids=chunks, owner_addr=src
+                )
+            except Exception as e:  # noqa: BLE001 - target died
+                logger.debug(        # mid-repair: next tick replans
+                    "repair prefetch %s→%s failed: %r", src, tgt, e
+                )
+                continue
+            results = reply.get("results", {})
+            for chunk in chunks:
+                if results.get(chunk):
+                    self.ckpt_locations.setdefault(chunk, set()).add(tgt)
+                    self._journal_append(
+                        "ckpt", "loc", {"chunk": chunk, "addrs": [tgt]}
+                    )
 
     async def _on_pick_node(
         self,
@@ -1537,6 +2002,8 @@ class HeadService:
             {"event": "removed", "node_id": nid, "addr": node["addr"]},
         )
         self._collective_member_died(node_addr=node["addr"])
+        # Checkpoint chunks this node held are now under-replicated.
+        self._schedule_ckpt_repair()
         for aid, actor in self.actors.items():
             if actor["node_id"] == nid and actor["state"] == "ALIVE":
                 # Node death goes through the same restart budget as
@@ -1558,3 +2025,4 @@ class HeadService:
             for nid, node in list(self.nodes.items()):
                 if now - node["last_seen"] > config.get("HEALTH_TIMEOUT_S"):
                     await self._remove_node(nid)
+            self._schedule_ckpt_repair()

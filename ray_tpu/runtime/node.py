@@ -1201,6 +1201,49 @@ class NodeManager:
             pass
         return {"ok": True, "cached": False}
 
+    async def _on_prefetch_objects(
+        self,
+        conn,
+        oids: list,
+        owner_addr: str,
+        timeout: float = 120.0,
+        concurrency: int = 4,
+    ):
+        """Batched prefetch (the checkpoint-replication primitive): pull
+        many content-addressed chunks into this node's store from one
+        owner, skipping the ones already held. Per-oid results let the
+        caller record exactly which replicas landed."""
+        sem = asyncio.Semaphore(max(1, concurrency))
+        results: dict[str, bool] = {}
+
+        async def one(oid_hex: str):
+            async with sem:
+                try:
+                    r = await self._on_prefetch_object(
+                        conn, oid_hex, owner_addr, timeout
+                    )
+                    results[oid_hex] = bool(r.get("ok"))
+                # tpulint: allow(broad-except reason=per-chunk prefetch failure is the RESULT of this batch op, reported per-oid to the caller; logging each would spam on a dead owner)
+                except Exception:
+                    results[oid_hex] = False
+
+        await asyncio.gather(*(one(o) for o in list(oids)))
+        return {"ok": True, "results": results}
+
+    async def _on_delete_objects(self, conn, oids: list):
+        """Drop store copies (checkpoint-chunk GC from the head)."""
+        from ray_tpu._private.ids import ObjectID
+
+        store = self._store()
+        deleted = 0
+        for oid_hex in oids:
+            try:
+                store.delete(ObjectID.from_hex(oid_hex))
+                deleted += 1
+            except ValueError:
+                continue
+        return {"ok": True, "deleted": deleted}
+
     async def _on_get_object_meta(self, conn, oid_hex: str):
         from ray_tpu._private.ids import ObjectID
         from ray_tpu.runtime.object_store import segment_meta

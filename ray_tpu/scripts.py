@@ -167,6 +167,57 @@ def cmd_goodput(args) -> int:
     return 0
 
 
+def cmd_ckpt(args) -> int:
+    """Shard-store checkpoints: `ckpt ls` lists per-run manifests with
+    dedup'd sizes and replica health; `ckpt verify` probes every chunk
+    on its recorded holders and reports under-replicated/lost ones."""
+    from ray_tpu.util import state
+
+    _connect(args.address, getattr(args, "session_dir", None))
+    if args.action == "verify":
+        report = state.verify_checkpoints(run=args.run)
+        if args.json:
+            json.dump(report, sys.stdout, indent=2, default=str)
+            print()
+            return 0
+        rows = report.get("checkpoints", [])
+        if not rows:
+            print("no complete checkpoints in the shard store")
+            return 0
+        bad = 0
+        for r in rows:
+            n_under = len(r["under_replicated"])
+            n_lost = len(r["lost"])
+            bad += n_under + n_lost
+            print(
+                f"{r['run']} step {r['step']}: {r['chunks']} chunks, "
+                f"{r['healthy']} at replication "
+                f"{r['replication_target']}, {n_under} under-replicated, "
+                f"{n_lost} lost"
+            )
+        return 1 if bad else 0
+    data = state.list_checkpoints(run=args.run)
+    if args.json:
+        json.dump(data, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    runs = data.get("runs", {})
+    if not any(runs.values()):
+        print("no checkpoints in the shard store")
+        return 0
+    for run, rows in sorted(runs.items()):
+        for r in rows:
+            status = "complete" if r["complete"] else (
+                f"partial {len(r['ranks'])}/{r['world']}"
+            )
+            print(
+                f"{run} step {r['step']}: {status}  world={r['world']}  "
+                f"bytes={r['bytes']}  chunks={r['chunks']}  "
+                f"min_replicas={r['min_replicas']}"
+            )
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     import time
 
@@ -489,6 +540,14 @@ def main(argv=None) -> int:
     gp = sub.add_parser("goodput")
     gp.add_argument("--json", action="store_true",
                     help="raw per-job stats as JSON")
+    cp = sub.add_parser("ckpt",
+                        help="in-cluster shard-store checkpoints")
+    cp.add_argument("action", choices=["ls", "verify"],
+                    help="ls: list checkpoints; verify: probe every "
+                         "chunk replica on its holders")
+    cp.add_argument("--run", default=None, help="restrict to one run")
+    cp.add_argument("--json", action="store_true",
+                    help="raw head reply as JSON")
     lg = sub.add_parser("logs")
     lg.add_argument("worker_id", nargs="?", default=None,
                     help="worker-id prefix; omit to list all logs")
@@ -514,6 +573,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "metrics": cmd_metrics,
         "goodput": cmd_goodput,
+        "ckpt": cmd_ckpt,
         "logs": cmd_logs,
         "dashboard": cmd_dashboard,
         "config": cmd_config,

@@ -11,11 +11,46 @@ ZeRO-3 run checkpoints without gathering full params on one host.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import shutil
 from typing import Any
 
 import jax
+
+logger = logging.getLogger("ray_tpu.train")
+
+# One naming scheme for every checkpoint directory this library writes
+# (CheckpointManager AND the report()-persisted dirs — they used to
+# disagree: ckpt-* vs checkpoint_*, and discovery missed one or the
+# other). Discovery still READS the legacy checkpoint_NNNNNN dirs so
+# runs that predate the unification keep resuming.
+CKPT_DIR_PREFIX = "ckpt-"
+_LEGACY_PREFIX = "checkpoint_"
+
+
+def checkpoint_dir_name(index: int) -> str:
+    return f"{CKPT_DIR_PREFIX}{index:08d}"
+
+
+def list_checkpoint_dirs(directory: str) -> list[tuple[int, str]]:
+    """(index, name) for every checkpoint dir under ``directory`` —
+    current and legacy naming — sorted by index. The single discovery
+    helper both the trainer and the manager use."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        for prefix in (CKPT_DIR_PREFIX, _LEGACY_PREFIX):
+            if name.startswith(prefix):
+                try:
+                    out.append((int(name[len(prefix):]), name))
+                except ValueError:
+                    pass
+                break
+    return sorted(out)
 
 
 def _checkpointer():
@@ -126,12 +161,17 @@ class CheckpointManager:
         num_to_keep: int = 2,
         score_attribute: str | None = None,
         score_order: str = "max",
+        store_run: str | None = None,
     ):
         self.dir = os.path.abspath(directory)
         os.makedirs(self.dir, exist_ok=True)
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
+        # When set, restore_latest_valid also falls back to the
+        # in-cluster replicated shard store (ray_tpu.checkpoint) under
+        # this run name — a cluster without shared storage still resumes.
+        self.store_run = store_run
 
     def _entries(self) -> list[tuple[int, str]]:
         # Recover any checkpoint whose save crashed mid-swap first, so
@@ -141,17 +181,10 @@ class CheckpointManager:
                 _recover_interrupted_swap(
                     os.path.join(self.dir, name[: -len(".old")])
                 )
-        out = []
-        for name in os.listdir(self.dir):
-            if name.startswith("ckpt-"):
-                try:
-                    out.append((int(name.split("-")[1]), name))
-                except ValueError:
-                    continue
-        return sorted(out)
+        return list_checkpoint_dirs(self.dir)
 
     def save(self, step: int, state: Any, metrics: dict | None = None) -> str:
-        path = os.path.join(self.dir, f"ckpt-{step:08d}")
+        path = os.path.join(self.dir, checkpoint_dir_name(step))
         save_checkpoint(
             path, state, metadata={"step": step, "metrics": metrics or {}}
         )
@@ -206,10 +239,32 @@ class CheckpointManager:
                     path, target=target, shardings=shardings
                 )
             except Exception as e:  # noqa: BLE001 - any load failure
-                print(
-                    f"ray_tpu.train: checkpoint {name} failed to "
-                    f"restore ({e!r}); falling back to the previous one",
-                    flush=True,
+                logger.warning(
+                    "checkpoint %s failed to restore (%r); falling back "
+                    "to the previous one",
+                    name,
+                    e,
+                )
+        if self.store_run is not None:
+            # No local dir restored (or none exist — e.g. no shared
+            # filesystem): fall back to the in-cluster shard store.
+            try:
+                from ray_tpu import checkpoint as dist_ckpt
+
+                step = dist_ckpt.latest_step(self.store_run)
+                if step is not None:
+                    state = dist_ckpt.restore(
+                        self.store_run,
+                        step,
+                        target=target,
+                        shardings=shardings,
+                    )
+                    return dist_ckpt.make_uri(self.store_run, step), state
+            except Exception as e:  # noqa: BLE001 - store degraded:
+                logger.warning(     # behave like no checkpoint found
+                    "shard-store restore for run %r failed: %r",
+                    self.store_run,
+                    e,
                 )
         return None
 

@@ -184,23 +184,34 @@ def step_span(
 def report(metrics: dict, checkpoint: str | None = None) -> None:
     """Report metrics (all ranks) and optionally a checkpoint directory
     (rank 0's is persisted; reference: ray.train.report semantics)."""
+    from ray_tpu.checkpoint.store import is_ckpt_uri
+
     ctx = get_context()
     ctx.latest_metrics = dict(metrics)
     entry: dict[str, Any] = {"metrics": dict(metrics)}
     ctx._last_checkpoint_s = 0.0
-    if checkpoint is not None and ctx.rank == 0:
+    if checkpoint is not None and is_ckpt_uri(checkpoint):
+        # In-cluster shard-store checkpoint: nothing to copy — the async
+        # persist runs in the background. The goodput ledger charges only
+        # the snapshot stall the save() paid on this step loop.
+        from ray_tpu.checkpoint import saver as _ckpt_saver
+
+        entry["checkpoint"] = checkpoint
+        ctx._last_checkpoint_s = _ckpt_saver.take_step_stall_seconds()
+    elif checkpoint is not None and ctx.rank == 0:
         # Index continues from what's already persisted so a retry attempt
         # appends after the restored checkpoint instead of overwriting
         # earlier ones (which would make the newest-named dir stale).
+        from ray_tpu.train.checkpoint import (
+            checkpoint_dir_name,
+            list_checkpoint_dirs,
+        )
+
         run_dir = os.path.join(ctx.storage_path, ctx.experiment_name)
         os.makedirs(run_dir, exist_ok=True)
-        existing = [
-            int(p.split("_")[1])
-            for p in os.listdir(run_dir)
-            if p.startswith("checkpoint_")
-        ]
+        existing = [i for i, _name in list_checkpoint_dirs(run_dir)]
         idx = max(existing, default=-1) + 1
-        dest = os.path.join(run_dir, f"checkpoint_{idx:06d}")
+        dest = os.path.join(run_dir, checkpoint_dir_name(idx))
         ckpt_t0 = time.perf_counter()
         if os.path.abspath(checkpoint) != os.path.abspath(dest):
             if os.path.exists(dest):
@@ -231,6 +242,13 @@ def report(metrics: dict, checkpoint: str | None = None) -> None:
             if notice is not None:
                 from ray_tpu.exceptions import PreemptedError
 
+                if is_ckpt_uri(checkpoint):
+                    # The snapshot is already offloaded; the drain window
+                    # pays only the persist — barrier it so the attempt
+                    # never unwinds on an uncommitted manifest.
+                    from ray_tpu import checkpoint as _dist_ckpt
+
+                    _dist_ckpt.wait_pending()
                 raise PreemptedError(
                     node_id=notice.get("node_id"),
                     reason=notice.get("reason", ""),

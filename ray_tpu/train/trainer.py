@@ -254,6 +254,16 @@ class TrainWorker:
             raise
         finally:
             _set_context(None)
+            # Attempt-end checkpoint barrier: an async persist still in
+            # flight must commit (or fail) before the controller kills
+            # this worker, or the attempt's last checkpoint is lost.
+            try:
+                from ray_tpu import checkpoint as _dist_ckpt
+
+                _dist_ckpt.wait_pending(timeout=30.0)
+            # tpulint: allow(broad-except reason=persist failures are already logged by the saver thread; the attempt outcome must not change because its LAST checkpoint failed — resume just uses an older one)
+            except Exception:
+                pass
             # One slice per controller attempt in the timeline: restart
             # churn is visible as gaps between attempt spans.
             tracing.emit_span(
@@ -476,26 +486,40 @@ class JaxTrainer:
         )
 
     def _find_latest_checkpoint(self) -> str | None:
-        """Newest VALID checkpoint dir for the resume path. A dying
-        attempt can leave a half-copied (or empty) newest dir behind;
-        resuming from it would fail the next attempt too — fall back to
-        the previous entry instead (the restore_latest_valid semantics,
-        applied to the trainer's own report()-persisted dirs)."""
+        """Newest VALID checkpoint for the resume path: the newest
+        non-empty report()-persisted dir (a dying attempt can leave a
+        half-copied or empty newest dir behind — fall back to the
+        previous entry, the restore_latest_valid semantics), else the
+        newest COMPLETE in-cluster shard-store checkpoint for this run
+        as a ``ckpt://`` URI — so a cluster with no shared checkpoint
+        directory still resumes from replicas."""
         import os
 
+        from ray_tpu.train.checkpoint import list_checkpoint_dirs
+
         d = self._run_dir()
-        if not os.path.isdir(d):
-            return None
-        cks = sorted(
-            p for p in os.listdir(d) if p.startswith("checkpoint_")
-        )
-        for name in reversed(cks):
+        for _idx, name in reversed(list_checkpoint_dirs(d)):
             path = os.path.join(d, name)
             try:
                 if os.path.isdir(path) and os.listdir(path):
                     return path
             except OSError:
                 continue
+        return self._latest_store_checkpoint()
+
+    def _latest_store_checkpoint(self) -> str | None:
+        """Newest complete shard-store checkpoint URI for this run (the
+        head's manifest table), or None (also on a degraded head — the
+        resume path must never fail the controller)."""
+        try:
+            from ray_tpu import checkpoint as dist_ckpt
+
+            step = dist_ckpt.latest_step(self.run_config.name)
+            if step is not None:
+                return dist_ckpt.make_uri(self.run_config.name, step)
+        # tpulint: allow(broad-except reason=resume discovery must never fail the controller; a degraded/absent head just means no store checkpoint to offer)
+        except Exception:
+            pass
         return None
 
     def _backend_env(

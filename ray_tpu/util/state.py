@@ -173,6 +173,19 @@ def train_stats() -> dict:
     return _call_head("train_stats")
 
 
+def list_checkpoints(run: str | None = None) -> dict:
+    """In-cluster shard-store checkpoints per run (step, world,
+    completeness, bytes, chunk count, min replica count). Backs the
+    dashboard's /api/checkpoints and `ray_tpu ckpt ls`."""
+    return _call_head("ckpt_list", run=run)
+
+
+def verify_checkpoints(run: str | None = None) -> dict:
+    """Probe every retained checkpoint chunk on its recorded holders;
+    reports under-replicated and lost chunks (`ray_tpu ckpt verify`)."""
+    return _call_head("ckpt_verify", run=run)
+
+
 _SPAN_ARG_KEYS = (
     "trace_id", "span_id", "parent_id", "group", "verb", "backend",
     "bytes", "dtype", "bus_bytes_per_s", "train_job", "train_attempt",

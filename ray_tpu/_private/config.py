@@ -145,6 +145,38 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                           "init_collective_group("
                                           "timeout_s=), per-op via the "
                                           "verb's timeout_s="),
+    "COLLECTIVE_PARTIAL_GRACE_S": (float, 1.0, "default partial-mode "
+                                               "sub-deadline past the "
+                                               "fastest arrival "
+                                               "(allreduce grace_s= "
+                                               "overrides per op)"),
+    "STRAGGLER_DELAY": (str, "", "chaos spec: comma-separated "
+                                 "'rank:seconds' — the named collective "
+                                 "ranks sleep that long before every "
+                                 "contribution (deterministic straggler "
+                                 "injection, cpu backend)"),
+    "COLLECTIVE_SKIP_DRAIN_THRESHOLD": (int, 10, "partial-collective "
+                                                 "skips of one rank "
+                                                 "within the sliding "
+                                                 "window that escalate "
+                                                 "it to the head as a "
+                                                 "chronic straggler"),
+    "COLLECTIVE_SKIP_WINDOW_S": (float, 60.0, "sliding window for the "
+                                              "chronic-skip escalation "
+                                              "threshold"),
+    "COLLECTIVE_SKIP_DRAIN": (bool, True, "head drains a reported "
+                                          "chronic straggler's node "
+                                          "(drain-and-replace) instead "
+                                          "of only flagging it"),
+    "TRAIN_GOODPUT_ALERT_RATIO": (float, 0.5, "head warns (log + "
+                                              "ray_tpu_train_goodput_"
+                                              "alert gauge) when a "
+                                              "job's stall+degraded "
+                                              "fraction over the alert "
+                                              "window exceeds this"),
+    "TRAIN_GOODPUT_ALERT_WINDOW_S": (float, 60.0, "sliding window for "
+                                                  "the goodput alert "
+                                                  "ratio"),
     "TRACE": (bool, False, "enable span collection in every process"),
     "TRAIN_TELEMETRY": (bool, True, "train step-phase spans + goodput/"
                                     "MFU accounting (always-cheap; 0 "

@@ -1,0 +1,31 @@
+"""Version shims for jax API moves.
+
+The repo targets the current jax surface (``jax.shard_map``); older
+jaxlibs (<= 0.4.x, what some images bake) still ship it as
+``jax.experimental.shard_map.shard_map``. Import from here so every
+shard_map user (collective backends, parallel layers, pallas wrappers)
+resolves the right symbol once instead of nine modules guessing.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # jax <= 0.4.x: experimental home, and the
+    # replication-check kwarg is still called check_rep there.
+    import functools
+
+    from jax.experimental.shard_map import (  # type: ignore
+        shard_map as _experimental_shard_map,
+    )
+
+    @functools.wraps(_experimental_shard_map)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _experimental_shard_map(f, *args, **kwargs)
+
+
+__all__ = ["shard_map"]

@@ -530,13 +530,15 @@ def collective_bench(quick: bool = False) -> list[dict]:
     # XLA path: psum over every device on the mesh (ICI on real TPUs).
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from ray_tpu._private.jax_compat import shard_map
+
     mesh = Mesh(np.asarray(devs, object).reshape(world), ("x",))
     shards = jax.device_put(
         jnp.ones((world, n_elem), jnp.float32),
         NamedSharding(mesh, P("x", None)),
     )
     allreduce = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a: jax.lax.psum(a, "x"),
             mesh=mesh,
             in_specs=P("x", None),

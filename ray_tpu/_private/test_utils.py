@@ -11,6 +11,38 @@ import random
 import time
 
 
+def parse_straggler_spec(spec: str) -> "dict[int, float]":
+    """Parse the RAY_TPU_STRAGGLER_DELAY chaos spec (same comma-
+    separated env-spec family as RAY_TPU_RPC_FAILURE):
+    ``"rank:seconds[,rank:seconds,…]"`` — the named collective ranks
+    sleep that long before contributing to every op. Example:
+    ``"2:0.5"`` makes rank 2 half a second late to each collective;
+    the partial-allreduce tests use it to skip a deterministic rank.
+    Malformed entries are ignored (chaos must never crash the op)."""
+    out: dict[int, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        rank, _, delay = entry.partition(":")
+        try:
+            out[int(rank)] = float(delay)
+        except ValueError:
+            continue
+    return out
+
+
+def straggler_delay_for_rank(rank: int) -> float:
+    """This rank's injected pre-contribution delay (0.0 = none). Read
+    per call so tests can flip RAY_TPU_STRAGGLER_DELAY at runtime."""
+    from ray_tpu._private import config
+
+    spec = config.get("STRAGGLER_DELAY")
+    if not spec:
+        return 0.0
+    return parse_straggler_spec(spec).get(rank, 0.0)
+
+
 def parse_preempt_spec(spec: str) -> "tuple[float, str]":
     """Parse the RAY_TPU_PREEMPT_AFTER_S chaos spec (same env-spec
     family as RAY_TPU_RPC_FAILURE): ``"<delay_s>[@<substr>]"`` — a

@@ -15,6 +15,13 @@ register with the head, which fans out node/worker death on the
 "collective" pubsub channel — survivors' in-flight and future ops fail
 fast with CollectiveMemberDiedError, and ``reform_group()`` re-runs
 rendezvous with the survivors (new world size, re-ranked).
+
+Straggler tolerance: ``allreduce(..., min_ranks=K, grace_s=...)`` is the
+partial K-of-N mode (Efficient AllReduce with Stragglers,
+arXiv:2505.23523) — the op proceeds with the contributions that beat a
+grace sub-deadline, rescales the mean, and returns PartialResult naming
+the skipped ranks; chronic skips escalate to the head's
+drain-and-replace path.
 """
 
 from __future__ import annotations
@@ -27,6 +34,7 @@ from ray_tpu.collective.types import (
     CollectiveGroupDestroyedError,
     CollectiveMemberDiedError,
     CollectiveTimeoutError,
+    PartialResult,
     ReduceOp,
 )
 
@@ -162,7 +170,8 @@ def init_collective_group(
                     node_addr=getattr(rt.core, "node_addr", None),
                     worker_id=getattr(rt.core, "worker_id", None),
                 )
-            except Exception:  # noqa: BLE001 - membership is best-effort
+            # tpulint: allow(broad-except reason=membership registration on an older head is best-effort; deadlines still work, only death fan-out is lost)
+            except Exception:
                 pass
             await _ensure_death_watch(rt.core)
 
@@ -230,8 +239,9 @@ def reform_in_place(
         )
         if reply.get("ok"):
             confirmed = {int(r) for r in reply.get("dead_ranks") or []}
-    except Exception:  # noqa: BLE001 - probe is advisory; the local
-        pass           # dead set below still gates the reform
+    # tpulint: allow(broad-except reason=the probe is advisory; the local dead set below still gates the reform)
+    except Exception:
+        pass
     if confirmed and hasattr(g, "_dead"):
         g._dead |= confirmed
     if confirmed or getattr(g, "_dead", None):
@@ -329,11 +339,41 @@ def _dispatch_once(g, name: str, *args, **kw):
 
 
 def allreduce(
-    tensor, group_name: str = "default", op=ReduceOp.SUM, timeout_s=None
+    tensor,
+    group_name: str = "default",
+    op=ReduceOp.SUM,
+    timeout_s=None,
+    min_ranks: int | None = None,
+    grace_s: float | None = None,
 ):
-    return _dispatch(
-        "allreduce", group_name, tensor, op=ReduceOp(op), timeout_s=timeout_s
+    """``min_ranks=K`` turns on straggler-tolerant partial mode: the op
+    proceeds once K of N contributions have arrived by ``grace_s`` past
+    the fastest arrival (config COLLECTIVE_PARTIAL_GRACE_S when None),
+    SUM rescaled by world/contributors, returning a
+    :class:`PartialResult` that names the skipped ranks. Skips feed
+    ``straggler_stats()`` and — chronically — the head's
+    drain-and-replace escalation. With the default ``min_ranks=None``
+    the classic all-N path runs, byte-identical to before."""
+    kw: dict = {}
+    if min_ranks is not None:
+        kw["min_ranks"] = min_ranks
+        kw["grace_s"] = grace_s
+    out = _dispatch(
+        "allreduce", group_name, tensor, op=ReduceOp(op),
+        timeout_s=timeout_s, **kw,
     )
+    if isinstance(out, PartialResult) and out.skipped:
+        # An active train session charges the skipped fraction of this
+        # step to the goodput ledger's "degraded" category. sys.modules
+        # lookup, not an import: no train session can be active unless
+        # the session module is already loaded, and pure collective
+        # users must not pay the train-package import.
+        import sys
+
+        _session = sys.modules.get("ray_tpu.train.session")
+        if _session is not None:
+            _session.note_partial_op(out)
+    return out
 
 
 def reduce(
@@ -394,6 +434,7 @@ def recv(
 __all__ = [
     "Backend",
     "ReduceOp",
+    "PartialResult",
     "CollectiveError",
     "CollectiveTimeoutError",
     "CollectiveMemberDiedError",

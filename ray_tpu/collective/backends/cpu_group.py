@@ -35,6 +35,21 @@ telemetry):
 - Straggler telemetry: the hub records per-op first→last contribution
   lag and the slowest rank (util/metrics.py histogram + counter), so a
   chronically slow member is visible before it becomes a timeout.
+- Partial K-of-N mode ("Efficient AllReduce with Stragglers",
+  arXiv:2505.23523): ``allreduce(..., min_ranks=K, grace_s=...)`` arms a
+  SECOND, earlier timer when the first contribution arrives. If the
+  grace sub-deadline passes with ≥K contributions in hand (or the K-th
+  lands after it), the hub completes the op over the contributors —
+  SUM rescaled by world/K so downstream mean math stays correct — and
+  answers everyone with typed PartialResult metadata naming the skipped
+  ranks. A "partial" tombstone keeps the op's reply around so a
+  straggler's late contribution is acked-and-discarded with the same
+  result (it rejoins op-sequence-synchronized instead of hanging or
+  desyncing). The hard deadline still raises CollectiveTimeoutError
+  when even K never arrive. Skips feed the straggler stats, the
+  ray_tpu_collective_partial_* metrics, and — past a sliding-window
+  threshold — an escalation report to the head that triggers the
+  chronic-straggler drain-and-replace path.
 """
 
 from __future__ import annotations
@@ -47,11 +62,12 @@ import numpy as np
 
 from ray_tpu._private import rpc
 from ray_tpu._private.serialization import deserialize, serialize
-from ray_tpu.collective.flight_recorder import record_op
+from ray_tpu.collective.flight_recorder import record_op, record_partial
 from ray_tpu.collective.types import (
     CollectiveGroupDestroyedError,
     CollectiveMemberDiedError,
     CollectiveTimeoutError,
+    PartialResult,
     ReduceOp,
 )
 from ray_tpu.util.metrics import Counter, Histogram
@@ -88,7 +104,8 @@ _ABORT_TOTAL = Counter(
 
 class _Pending:
     __slots__ = ("contrib", "futures", "arrived", "started", "arrive_ts",
-                 "timer")
+                 "timer", "grace_timer", "grace_passed", "min_ranks",
+                 "grace_s", "meta")
 
     def __init__(self, world: int):
         self.contrib: list = [None] * world
@@ -97,6 +114,19 @@ class _Pending:
         self.started = time.monotonic()
         self.arrive_ts: dict[int, float] = {}
         self.timer: asyncio.TimerHandle | None = None
+        # Partial K-of-N state (None min_ranks = classic all-N op; the
+        # partial path is never entered, byte-identical behavior).
+        self.grace_timer: asyncio.TimerHandle | None = None
+        self.grace_passed = False
+        self.min_ranks: int | None = None
+        self.grace_s: float = 0.0
+        self.meta: dict = {}
+
+    def cancel_timers(self):
+        if self.timer is not None:
+            self.timer.cancel()
+        if self.grace_timer is not None:
+            self.grace_timer.cancel()
 
 
 def _pack(value) -> tuple[bytes, list[bytes]]:
@@ -112,6 +142,12 @@ def _default_timeout() -> float:
     from ray_tpu._private import config
 
     return config.get("COLLECTIVE_TIMEOUT_S")
+
+
+def _default_partial_grace() -> float:
+    from ray_tpu._private import config
+
+    return config.get("COLLECTIVE_PARTIAL_GRACE_S")
 
 
 class CpuGroup:
@@ -149,6 +185,17 @@ class CpuGroup:
         self._straggler_counts: dict[int, int] = {}
         self._ops_completed = 0
         self._last_lag_s = 0.0
+        # Partial-mode state (hub-side). _partial_done is the tombstone
+        # cache: (kind, seq) → the completed op's reply, kept so a
+        # straggler's LATE contribution is acked with the same partial
+        # result instead of opening a fresh pending op that can only
+        # time out. _skip_events is the sliding window feeding the
+        # chronic-skip escalation to the head.
+        self._partial_done: "dict[tuple, dict]" = {}
+        self._partial_ops = 0
+        self._skip_counts: dict[int, int] = {}
+        self._skip_events: list[tuple[float, int]] = []
+        self._skip_reported: set[int] = set()
         if rank == 0:
             self.core.ext_handlers[f"col_op:{self.name}"] = self._on_op
         self.core.ext_handlers[f"col_sendrecv:{self.name}"] = self._on_sendrecv
@@ -235,12 +282,12 @@ class CpuGroup:
             self.core.ext_handlers[f"col_op:{self.name}"] = _tombstone
         self.core.ext_handlers.pop(f"col_sendrecv:{self.name}", None)
         for key, st in list(self._pending.items()):
-            if st.timer is not None:
-                st.timer.cancel()
+            st.cancel_timers()
             for _rank, fut in st.futures:
                 if not fut.done():
                     fut.set_result({"ok": False, "error": "destroyed"})
         self._pending.clear()
+        self._partial_done.clear()
         for call in list(self._inflight):
             call.cancel()
         for payloads, waiters in self._mailbox.values():
@@ -317,8 +364,7 @@ class CpuGroup:
             "dead_ranks": sorted(self._dead),
         }
         for key, st in list(self._pending.items()):
-            if st.timer is not None:
-                st.timer.cancel()
+            st.cancel_timers()
             for _rank, fut in st.futures:
                 if not fut.done():
                     fut.set_result(dict(reply))
@@ -393,13 +439,33 @@ class CpuGroup:
                 "dead_ranks": sorted(self._dead),
             }
         key = (kind, seq)
+        done = self._partial_done.get(key)
+        if done is not None:
+            # This op already partially completed without this rank:
+            # ack-and-discard the late contribution, answering with the
+            # SAME rescaled result + partial metadata (the straggler
+            # rejoins typed and op-sequence-synchronized; a fresh
+            # pending entry here could only hang until the deadline).
+            return done
         st = self._pending.get(key)
         if st is None:
             st = self._pending[key] = _Pending(self.world)
             timeout = float(meta.get("timeout_s") or self.timeout_s)
-            st.timer = asyncio.get_running_loop().call_later(
-                timeout, self._expire, key, timeout
-            )
+            loop = asyncio.get_running_loop()
+            st.timer = loop.call_later(timeout, self._expire, key, timeout)
+            min_ranks = meta.get("min_ranks")
+            if min_ranks is not None and kind == "allreduce":
+                # Two-stage timer: the grace sub-deadline is measured
+                # from the FASTEST arrival — which is this one, the
+                # contribution that created the pending entry.
+                st.min_ranks = max(1, min(int(min_ranks), self.world))
+                st.grace_s = float(
+                    meta.get("grace_s") or _default_partial_grace()
+                )
+                st.meta = dict(meta)
+                st.grace_timer = loop.call_later(
+                    st.grace_s, self._grace_fire, key
+                )
         self._watch_conn(rank, conn)
         st.contrib[rank] = _unpack(payload)
         st.arrived += 1
@@ -407,11 +473,29 @@ class CpuGroup:
         fut = asyncio.get_running_loop().create_future()
         st.futures.append((rank, fut))
         if st.arrived == self.world:
-            if st.timer is not None:
-                st.timer.cancel()
+            st.cancel_timers()
             self._record_op_stats(kind, st)
             self._complete(key, st, kind, meta)
+        elif (
+            st.grace_passed
+            and st.min_ranks is not None
+            and st.arrived >= st.min_ranks
+        ):
+            # The K-th contribution landed after the grace sub-deadline:
+            # proceed now rather than waiting out the hard deadline.
+            self._complete_partial(key, st, kind, meta)
         return await fut
+
+    def _grace_fire(self, key: tuple):
+        """Grace sub-deadline: proceed with the K-of-N contributions in
+        hand; with fewer than K, keep waiting (the K-th arrival or the
+        hard deadline resolves the op)."""
+        st = self._pending.get(key)
+        if st is None:
+            return
+        st.grace_passed = True
+        if st.min_ranks is not None and st.arrived >= st.min_ranks:
+            self._complete_partial(key, st, key[0], st.meta)
 
     def _expire(self, key: tuple, timeout: float):
         """Hub deadline: answer every waiting member with the missing
@@ -420,6 +504,7 @@ class CpuGroup:
         st = self._pending.pop(key, None)
         if st is None:
             return
+        st.cancel_timers()
         missing = [r for r in range(self.world) if st.contrib[r] is None]
         _ABORT_TOTAL.inc(tags={"group": self.base_name, "reason": "timeout"})
         for r in missing:
@@ -458,12 +543,97 @@ class CpuGroup:
         )
 
     def straggler_stats(self) -> dict:
-        """Hub-side per-rank slowest/missing counts (empty off-hub)."""
+        """Hub-side per-rank slowest/missing counts (empty off-hub).
+        ``partial_ops`` / ``skip_counts`` cover the K-of-N mode: how
+        many ops completed without someone, and who got skipped."""
         return {
             "ops_completed": self._ops_completed,
             "last_lag_s": self._last_lag_s,
             "slowest_counts": dict(self._straggler_counts),
+            "partial_ops": self._partial_ops,
+            "skip_counts": dict(self._skip_counts),
         }
+
+    # -------------------------------------------- partial K-of-N (hub)
+    def _complete_partial(self, key, st: _Pending, kind: str, meta: dict):
+        """Complete an op over the K..N-1 contributions in hand: reduce
+        the contributors, rescale SUM by world/K (so result/world is the
+        mean over actual contributors), answer every waiter with the
+        result + partial metadata, and leave a tombstone reply for the
+        stragglers' late contributions."""
+        del self._pending[key]
+        st.cancel_timers()
+        contributed = sorted(st.arrive_ts)
+        skipped = [r for r in range(self.world) if st.contrib[r] is None]
+        op = ReduceOp(meta.get("op", "sum"))
+        stacked = np.stack([st.contrib[r] for r in contributed])
+        result = _REDUCERS[op](stacked)
+        if op is ReduceOp.SUM:
+            result = result * (self.world / float(len(contributed)))
+        self._partial_ops += 1
+        self._ops_completed += 1
+        record_partial(self.base_name, kind, skipped)
+        now = time.monotonic()
+        for r in skipped:
+            self._skip_counts[r] = self._skip_counts.get(r, 0) + 1
+            self._straggler_counts[r] = self._straggler_counts.get(r, 0) + 1
+            _STRAGGLER_TOTAL.inc(
+                tags={"group": self.base_name, "rank": str(r)}
+            )
+            self._skip_events.append((now, r))
+        partial_meta = {
+            "contributed": contributed,
+            "skipped": skipped,
+            "world": self.world,
+        }
+        reply = {
+            "ok": True,
+            "payload": _pack(result),
+            "partial": partial_meta,
+        }
+        for rank, fut in st.futures:
+            if not fut.done():
+                fut.set_result(dict(reply))
+        # Tombstone for the stragglers (bounded: ops complete in seq
+        # order, old tombstones can no longer be asked for).
+        self._partial_done[key] = reply
+        while len(self._partial_done) > 128:
+            self._partial_done.pop(next(iter(self._partial_done)))
+        self._escalate_chronic_skips(now)
+
+    def _escalate_chronic_skips(self, now: float):
+        """Report a rank whose skip count crossed the sliding-window
+        threshold to the head — feeding the existing chronic-straggler
+        drain-and-replace escalation (autoscaler straggler_drain) from
+        inside the op instead of waiting on metric-snapshot latency."""
+        from ray_tpu._private import config
+
+        window = config.get("COLLECTIVE_SKIP_WINDOW_S")
+        threshold = config.get("COLLECTIVE_SKIP_DRAIN_THRESHOLD")
+        cutoff = now - window
+        self._skip_events = [e for e in self._skip_events if e[0] >= cutoff]
+        counts: dict[int, int] = {}
+        for _ts, r in self._skip_events:
+            counts[r] = counts.get(r, 0) + 1
+        for r, n in counts.items():
+            if n < threshold or r in self._skip_reported:
+                continue
+            self._skip_reported.add(r)
+
+            async def report(rank=r, skips=n):
+                try:
+                    await self.core.head.call(
+                        "collective_straggler_report",
+                        group=self.base_name,
+                        rank=rank,
+                        skips=skips,
+                        window_s=window,
+                    )
+                except rpc.RpcError:
+                    pass  # older head: the metric-snapshot path still
+                    # carries the signal, only the fast escalation is lost
+
+            asyncio.ensure_future(report())
 
     def _complete(self, key, st: _Pending, kind: str, meta: dict):
         del self._pending[key]
@@ -494,7 +664,16 @@ class CpuGroup:
     # ----------------------------------------------------------- verbs
     def _interpret(self, kind: str, reply: dict):
         if reply.get("ok"):
-            return _unpack(reply["payload"]) if "payload" in reply else None
+            value = _unpack(reply["payload"]) if "payload" in reply else None
+            partial = reply.get("partial")
+            if partial is not None:
+                return PartialResult(
+                    value=value,
+                    contributed=[int(r) for r in partial["contributed"]],
+                    skipped=[int(r) for r in partial["skipped"]],
+                    world=int(partial["world"]),
+                )
+            return value
         error = reply.get("error")
         if error == "timeout":
             raise CollectiveTimeoutError(
@@ -522,6 +701,16 @@ class CpuGroup:
         t = self.timeout_s if timeout_s is None else float(timeout_s)
         self._seq += 1
         seq = self._seq
+        # Deterministic straggler injection (RAY_TPU_STRAGGLER_DELAY=
+        # "rank:seconds,…"): the named ranks are late to every
+        # contribution — the chaos knob the partial-collective and
+        # straggler-stats tests are built on. Read per call so tests
+        # can flip it at runtime; zero-cost when the spec is unset.
+        from ray_tpu._private.test_utils import straggler_delay_for_rank
+
+        delay = straggler_delay_for_rank(self.rank)
+        if delay > 0:
+            await asyncio.sleep(delay)
         wall_start = time.time()
         t0 = time.perf_counter()
         try:
@@ -577,10 +766,40 @@ class CpuGroup:
         )
         return result
 
-    async def allreduce(self, tensor, op=ReduceOp.SUM, timeout_s=None):
-        return await self._op(
-            "allreduce", np.asarray(tensor), timeout_s=timeout_s, op=op.value
+    async def allreduce(
+        self,
+        tensor,
+        op=ReduceOp.SUM,
+        timeout_s=None,
+        min_ranks: int | None = None,
+        grace_s: float | None = None,
+    ):
+        """``min_ranks=K`` enables partial K-of-N mode: the hub proceeds
+        once K contributions are in hand after ``grace_s`` past the
+        fastest arrival, returning PartialResult metadata; with the
+        default None the classic all-N path runs unchanged."""
+        meta: dict = {"op": op.value}
+        if min_ranks is not None:
+            if not 1 <= int(min_ranks) <= self.world:
+                raise ValueError(
+                    f"min_ranks {min_ranks} out of range 1..{self.world}"
+                )
+            meta["min_ranks"] = int(min_ranks)
+            if grace_s is not None:
+                meta["grace_s"] = float(grace_s)
+        out = await self._op(
+            "allreduce", np.asarray(tensor), timeout_s=timeout_s, **meta
         )
+        if min_ranks is not None and not isinstance(out, PartialResult):
+            # Everyone made the grace window: same typed envelope, no
+            # skips — callers in partial mode always see PartialResult.
+            out = PartialResult(
+                value=out,
+                contributed=list(range(self.world)),
+                skipped=[],
+                world=self.world,
+            )
+        return out
 
     async def reduce(self, tensor, root=0, op=ReduceOp.SUM, timeout_s=None):
         return await self._op(

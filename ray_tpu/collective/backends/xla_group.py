@@ -31,10 +31,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.collective.flight_recorder import record_op
+from ray_tpu._private.jax_compat import shard_map
+
+from ray_tpu.collective.flight_recorder import record_op, record_partial
 from ray_tpu.collective.types import (
     CollectiveMemberDiedError,
     CollectiveTimeoutError,
+    PartialResult,
     ReduceOp,
 )
 
@@ -49,6 +52,31 @@ def _default_timeout() -> float:
     from ray_tpu._private import config
 
     return config.get("COLLECTIVE_TIMEOUT_S")
+
+
+def _default_partial_grace() -> float:
+    from ray_tpu._private import config
+
+    return config.get("COLLECTIVE_PARTIAL_GRACE_S")
+
+
+def _check_partial_args(op, dtype, min_ranks, world):
+    """Partial mode on the XLA backends is a masked psum: SUM only
+    (min/max/product have no meaningful zero-weight identity under the
+    rescale) over inexact dtypes (the mask multiply and world/K rescale
+    are float ops)."""
+    if op is not ReduceOp.SUM:
+        raise ValueError(
+            f"partial allreduce supports ReduceOp.SUM only, got {op}"
+        )
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        raise TypeError(
+            f"partial allreduce needs a floating dtype, got {dtype}"
+        )
+    if min_ranks is not None and not 1 <= int(min_ranks) <= world:
+        raise ValueError(
+            f"min_ranks {min_ranks} out of range 1..{world}"
+        )
 
 
 def _recorded(verb: str):
@@ -129,7 +157,7 @@ class XlaMeshGroup:
         return prog
 
     def _shmap(self, fn, donate=True):
-        mapped = jax.shard_map(
+        mapped = shard_map(
             fn, mesh=self.mesh, in_specs=P("ranks"), out_specs=P("ranks")
         )
         return jax.jit(mapped, donate_argnums=(0,) if donate else ())
@@ -140,9 +168,24 @@ class XlaMeshGroup:
     # there is no remote member to wait on.
     @_recorded("allreduce")
     def allreduce(
-        self, tensors: Sequence[Any], op=ReduceOp.SUM, timeout_s=None
+        self,
+        tensors: Sequence[Any],
+        op=ReduceOp.SUM,
+        timeout_s=None,
+        min_ranks: int | None = None,
+        grace_s=None,
+        skip_ranks: Sequence[int] | None = None,
     ) -> list:
-        del timeout_s
+        del timeout_s, grace_s
+        if min_ranks is not None or skip_ranks:
+            # Single-controller partial mode: local devices cannot
+            # straggle on the wire, so the "slow" set is EXPLICIT —
+            # ranks flagged by drain notices / external straggler
+            # telemetry mask to weight 0 in a compiled psum whose shape
+            # never changes (the T3-style integration point).
+            return self._partial_allreduce(
+                tensors, op, min_ranks, skip_ranks
+            )
         x = self._stack(tensors)
         key = ("allreduce", x.shape, str(x.dtype), op)
         if op is ReduceOp.PRODUCT:
@@ -162,6 +205,55 @@ class XlaMeshGroup:
                 key, lambda: self._shmap(lambda s: psum(s, "ranks"))
             )
         return self._unstack(prog(x))
+
+    def _partial_allreduce(
+        self, tensors, op, min_ranks, skip_ranks
+    ) -> PartialResult:
+        """Masked psum: contribution r is multiplied by weight w_r
+        (0 for skipped ranks) and the sum rescaled by world / Σw, so
+        result/world equals the mean over actual contributors. One
+        cached compiled program per (shape, dtype) — the mask is an
+        input, not a shape."""
+        x = self._stack(tensors)
+        _check_partial_args(op, x.dtype, min_ranks, self.world)
+        skipped = sorted({int(r) for r in (skip_ranks or ())})
+        contributed = [r for r in range(self.world) if r not in skipped]
+        if len(contributed) < int(min_ranks or 1):
+            raise CollectiveTimeoutError(
+                self.name,
+                "allreduce",
+                None,
+                missing_ranks=skipped,
+                detail=f"masking left {len(contributed)} contributors, "
+                       f"below min_ranks {min_ranks}",
+            )
+        world = self.world
+        key = ("partial_allreduce", x.shape, str(x.dtype))
+
+        def build():
+            def fn(s, w):
+                wb = w.reshape((1,) + (1,) * (s.ndim - 1))
+                tot = jax.lax.psum(s * wb, "ranks")
+                cnt = jax.lax.psum(w, "ranks")
+                return tot * (world / jnp.maximum(cnt, 1.0))
+
+            mapped = shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(P("ranks"), P("ranks")),
+                out_specs=P("ranks"),
+            )
+            return jax.jit(mapped)
+
+        prog = self._program(key, build)
+        w = np.ones((world,), dtype=x.dtype)
+        w[skipped] = 0
+        out = self._unstack(prog(x, jnp.asarray(w)))
+        if skipped:
+            record_partial(self.name, "allreduce", skipped)
+        return PartialResult(
+            value=out, contributed=contributed, skipped=skipped, world=world
+        )
 
     @_recorded("broadcast")
     def broadcast(
@@ -311,6 +403,7 @@ class XlaDistGroup:
         self.mesh = Mesh(np.array(self.devices), ("ranks",))
         self._programs: dict[tuple, Any] = {}
         self._sync_pool: Any = None  # lazy single-thread deadline pool
+        self._gate_seq = 0  # partial-mode pre-op gate sequence
 
     def _global(self, tensor) -> jax.Array:
         local = jax.device_put(jnp.asarray(tensor)[None], self.my_device)
@@ -325,7 +418,7 @@ class XlaDistGroup:
     def _run(self, key, fn, x):
         prog = self._programs.get(key)
         if prog is None:
-            mapped = jax.shard_map(
+            mapped = shard_map(
                 fn, mesh=self.mesh, in_specs=P("ranks"), out_specs=P("ranks")
             )
             prog = self._programs[key] = jax.jit(mapped)
@@ -366,7 +459,8 @@ class XlaDistGroup:
                     epoch=self.epoch,
                     rank=self.rank,
                 )
-            except Exception:  # noqa: BLE001 - head may be gone
+            # tpulint: allow(broad-except reason=deregistration during teardown; the head may already be gone and the membership table reaps dead members anyway)
+            except Exception:
                 pass
 
     _POISON_POLL_S = 0.25
@@ -416,8 +510,19 @@ class XlaDistGroup:
                 continue
 
     @_recorded("allreduce")
-    def allreduce(self, tensor, op=ReduceOp.SUM, timeout_s=None):
+    def allreduce(
+        self,
+        tensor,
+        op=ReduceOp.SUM,
+        timeout_s=None,
+        min_ranks: int | None = None,
+        grace_s: float | None = None,
+    ):
         self._check_poisoned("allreduce")
+        if min_ranks is not None:
+            return self._partial_allreduce(
+                tensor, op, min_ranks, grace_s, timeout_s
+            )
         x = self._global(tensor)
         psum = _PSUM_OPS[op]
         out = self._run(
@@ -426,6 +531,103 @@ class XlaDistGroup:
             x,
         )
         return self._local(self._sync(out, "allreduce", timeout_s))
+
+    def _gate_weight(self, grace_s: float) -> float:
+        """Pre-op bounded barrier, self-flagging: the first rank to
+        reach the op claims a gate-open timestamp in the head KV; a rank
+        arriving more than ``grace_s`` later contributes with weight 0.
+        Each rank owns only ITS OWN weight, so clock skew or KV races
+        can never make the compiled psum's inputs inconsistent — a
+        mis-decided rank merely includes/excludes itself. No waiting
+        happens here: the compiled op is the synchronization point, the
+        gate only prices the contribution."""
+        if self.core is None:
+            return 1.0
+        self._gate_seq += 1
+        key = f"pgate:{self.name}:{self._gate_seq}"
+        now = time.time()
+
+        async def claim():
+            reply = await self.core.head.call("kv_get", key=key)
+            if reply.get("ok"):
+                return float(reply["value"].decode())
+            await self.core.head.call("kv_put", key=key, value=str(now).encode())
+            if self._gate_seq > 1 and self.rank == 0:
+                # Best-effort GC of the previous op's gate key. A
+                # straggler still on that seq just re-claims it and
+                # self-prices at weight 1 — the safe direction.
+                await self.core.head.call(
+                    "kv_del", key=f"pgate:{self.name}:{self._gate_seq - 1}"
+                )
+            return now
+
+        try:
+            import ray_tpu.api as _api
+
+            open_ts = _api._runtime.run(claim())
+        except Exception as e:  # noqa: BLE001 - gate is advisory
+            import logging
+
+            logger = logging.getLogger("ray_tpu.collective")
+            logger.debug(
+                "partial gate unavailable (%s): contributing at weight 1",
+                e,
+            )
+            return 1.0
+        return 0.0 if (now - open_ts) > grace_s else 1.0
+
+    def _partial_allreduce(self, tensor, op, min_ranks, grace_s, timeout_s):
+        """Masked psum over ICI/DCN: every rank contributes
+        ``(grad * w, w)`` where w∈{0,1} comes from the pre-op gate, so
+        the compiled op's shape never changes whoever straggles. The
+        gathered weight mask doubles as the skipped-rank metadata, and
+        the rescale world/Σw happens inside the compiled program."""
+        grace = (
+            float(grace_s) if grace_s is not None
+            else _default_partial_grace()
+        )
+        x = self._global(tensor)
+        _check_partial_args(op, x.dtype, min_ranks, self.world)
+        w_self = self._gate_weight(grace)
+        w = self._global(jnp.asarray(w_self, x.dtype))
+        world = self.world
+        key = ("partial_allreduce", x.shape, str(x.dtype))
+        prog = self._programs.get(key)
+        if prog is None:
+
+            def fn(s, wv):
+                wb = wv.reshape((1,) + (1,) * (s.ndim - 1))
+                tot = jax.lax.psum(s * wb, "ranks")
+                cnt = jax.lax.psum(wv, "ranks")
+                mask = jax.lax.all_gather(wv[0], "ranks")
+                return tot * (world / jnp.maximum(cnt, 1.0)), mask[None]
+
+            mapped = shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(P("ranks"), P("ranks")),
+                out_specs=(P("ranks"), P("ranks")),
+            )
+            prog = self._programs[key] = jax.jit(mapped)
+        out, mask = prog(x, w)
+        out = self._local(self._sync(out, "allreduce", timeout_s))
+        maskv = np.asarray(self._local(mask))
+        contributed = [r for r in range(world) if maskv[r] > 0]
+        skipped = [r for r in range(world) if maskv[r] <= 0]
+        if len(contributed) < int(min_ranks):
+            raise CollectiveTimeoutError(
+                self.name,
+                "allreduce",
+                grace,
+                missing_ranks=skipped,
+                detail=f"only {len(contributed)} contributions beat the "
+                       f"partial grace window, below min_ranks {min_ranks}",
+            )
+        if skipped and self.rank == 0:
+            record_partial(self.name, "allreduce", skipped)
+        return PartialResult(
+            value=out, contributed=contributed, skipped=skipped, world=world
+        )
 
     @_recorded("allgather")
     def allgather(self, tensor, timeout_s=None):
@@ -518,7 +720,8 @@ async def bootstrap_distributed(
         # must be set before the backend initializes.
         try:
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
-        except Exception:  # noqa: BLE001 - older jaxlib without the knob
+        # tpulint: allow(broad-except reason=older jaxlib without the gloo knob; TPU backends ignore it and CPU tests would fail loudly at the first collective)
+        except Exception:
             pass
         jax.distributed.initialize(
             coordinator_address=coord,

@@ -17,6 +17,9 @@ op time. That makes numbers comparable across verbs and world sizes
 
 from __future__ import annotations
 
+import threading
+import time
+
 from ray_tpu.util import tracing
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
@@ -41,6 +44,18 @@ BUS_BANDWIDTH = Gauge(
     tag_keys=("group", "verb", "dtype"),
 )
 
+PARTIAL_OPS = Counter(
+    "ray_tpu_collective_partial_ops_total",
+    "collective ops completed in K-of-N partial mode (skipped at least "
+    "one straggler's contribution)",
+    tag_keys=("group", "verb"),
+)
+PARTIAL_SKIPS = Counter(
+    "ray_tpu_collective_partial_skips_total",
+    "times this rank's contribution was skipped by a partial collective",
+    tag_keys=("group", "rank"),
+)
+
 # verb → busbw factor as a function of world size (nccl-tests
 # performance docs); verbs without an entry (send/recv/permute/
 # broadcast/reduce) move each byte once → factor 1.
@@ -49,6 +64,56 @@ _BUS_FACTORS = {
     "allgather": lambda n: (n - 1) / n,
     "reducescatter": lambda n: (n - 1) / n,
 }
+
+# --------------------------------------------------- span rate limiting
+# Metrics (histogram/counter/gauge) are always recorded — they aggregate.
+# SPAN events are per-op list appends that ride the task-event pipeline;
+# a >1 kHz storm of sub-ms ops (partial-mode retry storms, tight
+# barrier loops) would evict every other event from the head's
+# ring buffer. Above _AUTO_RATE_HZ ops/s, sub-_AUTO_DUR_S ops emit
+# 1-in-_AUTO_SAMPLE spans (the span carries sample_rate so the timeline
+# can re-weight); an explicit sample_rate arg on record_op overrides.
+_AUTO_RATE_HZ = 1000
+_AUTO_DUR_S = 0.001
+_AUTO_SAMPLE = 100
+
+_span_lock = threading.Lock()
+# (group, verb) → [window_start_monotonic, ops_in_window, op_counter]
+_span_state: dict[tuple, list] = {}
+
+
+def _span_sample(
+    group: str, verb: str, dur: float, sample_rate: int | None
+) -> tuple[bool, int]:
+    """(emit this op's span?, effective 1-in-N rate). N=1 → always."""
+    with _span_lock:
+        st = _span_state.get((group, verb))
+        if st is None:
+            if len(_span_state) > 512:  # bound: groups come and go
+                _span_state.pop(next(iter(_span_state)))
+            st = _span_state[(group, verb)] = [time.monotonic(), 0, 0]
+        now = time.monotonic()
+        if now - st[0] > 1.0:
+            st[0], st[1] = now, 0
+        st[1] += 1
+        st[2] += 1
+        counter, rate_1s = st[2], st[1]
+    if sample_rate is not None and sample_rate > 1:
+        n = int(sample_rate)
+    elif rate_1s > _AUTO_RATE_HZ and dur < _AUTO_DUR_S:
+        n = _AUTO_SAMPLE
+    else:
+        return True, 1
+    return counter % n == 0, n
+
+
+def record_partial(group: str, verb: str, skipped) -> None:
+    """Record one partial-mode completion: op counter + per-skipped-rank
+    counter (the same per-rank series the chronic-straggler signal
+    aggregates, plus the dedicated partial counters)."""
+    PARTIAL_OPS.inc(tags={"group": group, "verb": verb})
+    for r in skipped:
+        PARTIAL_SKIPS.inc(tags={"group": group, "rank": str(r)})
 
 
 def payload_info(tensor) -> tuple[int, str]:
@@ -69,7 +134,8 @@ def payload_info(tensor) -> tuple[int, str]:
 
             arr = np.asarray(tensor)
             nbytes, dtype = arr.nbytes, arr.dtype
-        except Exception:  # noqa: BLE001 - unknown payload: size-less
+        # tpulint: allow(broad-except reason=an unconvertible payload records as size-less; telemetry must never fail the op it measures)
+        except Exception:
             return 0, "unknown"
     return int(nbytes), str(dtype) if dtype is not None else "unknown"
 
@@ -82,9 +148,14 @@ def record_op(
     tensor,
     start: float,
     dur: float,
+    sample_rate: int | None = None,
 ) -> None:
     """Record one completed collective op (success path only — aborts
-    and timeouts are counted by the fault-tolerance counters)."""
+    and timeouts are counted by the fault-tolerance counters).
+
+    ``sample_rate=N`` emits the timeline SPAN for 1-in-N ops (metrics
+    are always recorded); with the default None, spans auto-sample at
+    1-in-100 once a (group, verb) exceeds 1 kHz of sub-ms ops."""
     nbytes, dtype = payload_info(tensor)
     OP_LATENCY.observe(
         dur, tags={"group": group, "verb": verb, "backend": backend}
@@ -102,4 +173,9 @@ def record_op(
             )
             BUS_BANDWIDTH.set(bus, tags=tags)
             attrs["bus_bytes_per_s"] = round(bus, 1)
+    emit, n = _span_sample(group, verb, dur, sample_rate)
+    if not emit:
+        return
+    if n > 1:
+        attrs["sample_rate"] = n
     tracing.emit_span(f"collective:{verb}", start, dur, **attrs)

@@ -1,9 +1,12 @@
 """Collective types and fault-tolerance exceptions (reference:
 python/ray/util/collective/types.py; abort semantics follow the
-reference's NCCL-abort / destroy_collective_group contract)."""
+reference's NCCL-abort / destroy_collective_group contract; partial
+K-of-N semantics follow "Efficient AllReduce with Stragglers",
+arXiv:2505.23523)."""
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 
 from ray_tpu.exceptions import RayTpuError
@@ -34,6 +37,31 @@ class ReduceOp(str, enum.Enum):
 
 
 UNSET_RANK = -1
+
+
+@dataclasses.dataclass
+class PartialResult:
+    """Result of a K-of-N partial collective (``allreduce(...,
+    min_ranks=K, grace_s=...)``).
+
+    ``value`` is the reduced tensor over the ranks that contributed in
+    time; for SUM it is rescaled by ``world / len(contributed)`` so
+    ``value / world`` equals the *mean over actual contributors* — a
+    skipped rank dilutes nothing, it is simply absent from the mean.
+    ``skipped`` names the ranks whose contribution missed the grace
+    sub-deadline (empty when everyone arrived); a skipped rank receives
+    the SAME value with itself listed in ``skipped``, so the group stays
+    op-sequence-synchronized and the straggler rejoins typed instead of
+    hanging."""
+
+    value: object
+    contributed: list[int]
+    skipped: list[int]
+    world: int
+
+    @property
+    def is_partial(self) -> bool:
+        return bool(self.skipped)
 
 
 class CollectiveError(RayTpuError):

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ray_tpu._private.jax_compat import shard_map
+
 _NEG_INF = float("-inf")
 # Finite mask value: exp(_MASK - m) underflows to exactly 0 for any
 # finite row max m, so masked positions need NO NaN-guard `where` passes
@@ -569,7 +571,7 @@ def make_flash_attention(mesh, batch_axes=("dp", "fsdp"), head_axis="tp"):
         return kernel
     # check_vma=False: pallas_call outputs carry no varying-mesh-axes
     # metadata, which the checker would otherwise require.
-    return jax.shard_map(
+    return shard_map(
         kernel,
         mesh=mesh,
         in_specs=(spec, spec, spec),

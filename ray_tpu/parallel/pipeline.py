@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu._private.jax_compat import shard_map
+
 StageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
 
 
@@ -145,7 +147,7 @@ def pipeline_apply(
         else jax.tree.map(lambda _: P(axis), stage_params)
     )
     batch_spec = P(dp_axes if dp_axes else None)
-    return jax.shard_map(
+    return shard_map(
         per_device,
         mesh=mesh,
         in_specs=(spec_params, batch_spec),

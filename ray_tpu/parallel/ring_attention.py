@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu._private.jax_compat import shard_map
+
 from ray_tpu.ops.attention import _repeat_kv
 
 _NEG_BIG = -1.0e30
@@ -98,7 +100,7 @@ def make_ring_attention(mesh, batch_axes=("dp", "fsdp"), seq_axis="sp",
     ray_tpu.models.llama.forward(attn_fn=...)."""
     spec = P(batch_axes, seq_axis, head_axis, None)
     kernel = partial(ring_attention_kernel, axis_name=seq_axis)
-    return jax.shard_map(
+    return shard_map(
         kernel,
         mesh=mesh,
         in_specs=(spec, spec, spec),

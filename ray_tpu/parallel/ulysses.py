@@ -16,6 +16,8 @@ from typing import Callable
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ray_tpu._private.jax_compat import shard_map
+
 from ray_tpu.ops.attention import causal_attention
 
 
@@ -50,6 +52,6 @@ def make_ulysses_attention(mesh, batch_axes=("dp", "fsdp"), seq_axis="sp",
                            head_axis="tp"):
     spec = P(batch_axes, seq_axis, head_axis, None)
     kernel = partial(ulysses_attention_kernel, axis_name=seq_axis)
-    return jax.shard_map(
+    return shard_map(
         kernel, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )

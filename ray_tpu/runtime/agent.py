@@ -213,16 +213,19 @@ class NodeAgent:
             )
         except (ConnectionResetError, BrokenPipeError, asyncio.IncompleteReadError):
             pass
-        except Exception as e:  # noqa: BLE001 - observability must not crash
+        # tpulint: allow(broad-except reason=not swallowed - the handler error is returned to the HTTP client as a 500 body)
+        except Exception as e:
             try:
                 await self._send(writer, 500, repr(e).encode())
-            except Exception:  # noqa: BLE001
+            # tpulint: allow(broad-except reason=the client hung up before reading its 500; nobody is left to answer)
+            except Exception:
                 pass
         finally:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:  # noqa: BLE001
+            # tpulint: allow(broad-except reason=socket teardown on an already-broken connection; nothing actionable)
+            except Exception:
                 pass
 
     @staticmethod

@@ -40,6 +40,10 @@ from ray_tpu.exceptions import (
 )
 from ray_tpu.runtime.object_store import ObjectStore
 
+import logging
+
+logger = logging.getLogger("ray_tpu.core_worker")
+
 INLINE_MAX_BYTES = 100_000
 DEFAULT_RETRIES = 3
 GENERATOR_BACKPRESSURE_ITEMS = 8  # max undelivered items per stream
@@ -254,7 +258,10 @@ class CoreWorker:
             if handler is not None:
                 handler(payload.get("msg"))
         except Exception:  # noqa: BLE001 - a bad handler must not kill recv
-            pass
+            logger.warning(
+                "pubsub handler for channel %r raised",
+                payload.get("channel"), exc_info=True,
+            )
 
     async def subscribe(self, channel: str, handler) -> None:
         """Subscribe to a head pubsub channel; `handler(msg)` runs on the
@@ -703,7 +710,8 @@ class CoreWorker:
         # Cache locally so later readers on this node hit the store.
         try:
             self.store.put(oid, Serialized(inband, list(buffers)))
-        except Exception:  # noqa: BLE001 - cache is best-effort
+        # tpulint: allow(broad-except reason=local cache put is best-effort; the value is already in hand and returned to the caller regardless)
+        except Exception:
             pass
         else:
             if self.node_addr:
@@ -986,7 +994,8 @@ class CoreWorker:
             self.record_task_event(
                 spec, "FAILED" if errored else "FINISHED"
             )
-        except Exception as e:  # noqa: BLE001 - becomes the task's result
+        # tpulint: allow(broad-except reason=not swallowed - the error is recorded as the task FAILED event and stored as the result the owner reads)
+        except Exception as e:
             self.record_task_event(
                 spec,
                 "CANCELLED" if isinstance(e, TaskCancelledError) else "FAILED",
@@ -1052,7 +1061,8 @@ class CoreWorker:
                 entry["runtime_env"],
                 entry.get("scheduling"),
             )
-        except Exception as e:  # noqa: BLE001 - loss stays loss
+        # tpulint: allow(broad-except reason=not swallowed - the failure is stored as an error record so blocked readers fail with the cause)
+        except Exception as e:
             # Leave an error record so readers that blocked on the
             # cleared oids fail with the cause instead of waiting
             # forever.
@@ -1357,7 +1367,8 @@ class CoreWorker:
         batch, self._task_events = self._task_events, []
         try:
             await self.head.call("add_task_events", events=batch)
-        except Exception:  # noqa: BLE001 - observability is best-effort
+        # tpulint: allow(broad-except reason=1 Hz flush loop against a possibly-degraded head; logging every miss would spam - events re-flush next tick)
+        except Exception:
             pass
 
     async def flush_observability(self):
@@ -1374,7 +1385,8 @@ class CoreWorker:
                 await self.head.call(
                     "report_metrics", worker=self.addr, metrics=snap
                 )
-            except Exception:  # noqa: BLE001
+            # tpulint: allow(broad-except reason=eager pre-death flush; the head may already be unreachable and there is nobody left to tell)
+            except Exception:
                 pass
 
     async def _flush_events_loop(self):
@@ -1765,7 +1777,8 @@ class CoreWorker:
                 reply.setdefault("node_conn", self.node)
                 pool["inflight"] -= 1
                 self._offer_lease(key, reply)
-            except Exception as e:  # noqa: BLE001 - propagate to one waiter
+            # tpulint: allow(broad-except reason=not swallowed - the lease failure is set on the waiting future and raises at the submit site)
+            except Exception as e:
                 pool["inflight"] -= 1
                 while pool["waiters"]:
                     fut = pool["waiters"].popleft()
@@ -2237,7 +2250,8 @@ class CoreWorker:
             )
             self._actor_id = actor_id
             return {"status": "ok"}
-        except Exception as e:  # noqa: BLE001
+        # tpulint: allow(broad-except reason=not swallowed - the construction error is serialized into the reply and raises at the actor handle)
+        except Exception as e:
             return {"status": "error", "error": _dumps_small(_as_task_error(e))}
 
     async def _on_exit_worker(self, conn):
@@ -2309,7 +2323,8 @@ class CoreWorker:
                     await gen.aclose()
                 else:
                     getattr(gen, "close", lambda: None)()
-            except Exception:  # noqa: BLE001 - consumer already gone
+            # tpulint: allow(broad-except reason=generator close on a consumer that already went away; there is no caller to surface it to)
+            except Exception:
                 pass
 
         while True:
@@ -2494,7 +2509,8 @@ class CoreWorker:
                 spec, "RUNNING", ts=exec_start, dur=time.time() - exec_start
             )
             return {"status": "ok", "results": results}
-        except Exception as e:  # noqa: BLE001 - travels to the owner
+        # tpulint: allow(broad-except reason=not swallowed - the error is wrapped as RayTaskError and travels to the owner in the reply)
+        except Exception as e:
             # Post-mortem attach point (reference: RAY_DEBUG_POST_MORTEM,
             # util/rpdb.py): with RAY_TPU_POST_MORTEM set, the worker
             # parks at the failure frame until a debugger attaches and
@@ -2535,6 +2551,7 @@ def _dumps_small(value: Any) -> bytes:
 
     try:
         return cloudpickle.dumps(value)
+    # tpulint: allow(broad-except reason=unpicklable error values degrade to their repr so the reply still carries the failure)
     except Exception:
         return cloudpickle.dumps(RayTaskError(repr(value)))
 
@@ -2547,6 +2564,7 @@ def _as_task_error(e: Exception) -> Exception:
         wrapped = RayTaskError(f"{type(e).__name__}: {e}\n{tb}")
         wrapped.cause = e
         return wrapped
+    # tpulint: allow(broad-except reason=error wrapping must never raise; the traceback string alone still reaches the owner)
     except Exception:
         return RayTaskError(tb)
 

@@ -81,6 +81,11 @@ class HeadService:
         # the "collective" pubsub channel so in-flight ops abort instead
         # of burning their full deadline.
         self.collective_members: dict[str, dict] = {}
+        # node_id → partial-collective skips escalated by hubs
+        # (collective_straggler_report): merged into the chronic-
+        # straggler signal and — with COLLECTIVE_SKIP_DRAIN — acted on
+        # directly via the drain path.
+        self.chronic_skip_reports: dict[str, float] = {}
         # Cluster-wide infeasible lease demand, deduped per waiting
         # request: requester id → (resources, ts). Each spill-waiting
         # request refreshes its single entry, so one pending lease reads
@@ -139,7 +144,7 @@ class HeadService:
         try:
             await self.journal.compact_async(self._snapshot())
         except Exception:  # noqa: BLE001 - keep serving (e.g. disk full)
-            pass
+            logger.warning("journal compaction failed", exc_info=True)
         finally:
             # Raise the floor EVEN ON FAILURE: the next attempt then
             # needs 2× further growth, so a persistently failing disk
@@ -313,7 +318,8 @@ class HeadService:
                 reason=d.get("reason", ""),
                 deadline_ts=d.get("deadline_ts"),
             )
-        except Exception:  # noqa: BLE001 - node may be mid-death
+        # tpulint: allow(broad-except reason=the node may be mid-death; the pubsub fan-out already carried the notice, this direct push is belt-and-suspenders)
+        except Exception:
             pass
 
     async def _on_sync(
@@ -483,7 +489,8 @@ class HeadService:
         if conn_ is not None:
             try:
                 await conn_.call("set_draining", draining=False)
-            except Exception:  # noqa: BLE001
+            # tpulint: allow(broad-except reason=node may be mid-death; the undrain event already fanned out on pubsub and the table is authoritative)
+            except Exception:
                 pass
         return {"ok": True}
 
@@ -1208,7 +1215,8 @@ class HeadService:
             )
             try:
                 addr = await self._recreate_actor(actor_id, actor, spec)
-            except Exception as e:  # noqa: BLE001 - no node fits, etc.
+            # tpulint: allow(broad-except reason=not swallowed - the actor is journaled DEAD with the error published to watchers below)
+            except Exception as e:
                 actor["state"] = "DEAD"
                 self._journal_append(
                     "actor",
@@ -1249,7 +1257,8 @@ class HeadService:
                 await conn.call("exit_worker")
             finally:
                 await conn.close()
-        except Exception:  # noqa: BLE001
+        # tpulint: allow(broad-except reason=quiet kill of a superseded worker that may already be gone; success is not required, only attempted cleanup)
+        except Exception:
             pass
 
     def _spawn_restart(self, actor_id: str, failed_addr: str) -> None:
@@ -1519,7 +1528,64 @@ class HeadService:
             nid = addr_to_nid.get(node_addr) if node_addr else None
             if nid is not None:
                 nodes[nid] = nodes.get(nid, 0.0) + val
+        # Hub-escalated partial skips count too — they arrive ahead of
+        # the metric-snapshot flush latency.
+        for nid, val in self.chronic_skip_reports.items():
+            nodes[nid] = max(nodes.get(nid, 0.0), float(val))
         return {"ok": True, "nodes": nodes, "groups": groups}
+
+    async def _on_collective_straggler_report(
+        self,
+        conn,
+        group: str,
+        rank: int,
+        skips: int = 0,
+        window_s: float = 0.0,
+    ):
+        """A hub escalated a chronic partial-collective straggler: its
+        skip rate crossed the sliding-window threshold. Resolve the rank
+        to its node and — unless COLLECTIVE_SKIP_DRAIN is off — put the
+        node on the same drain-and-replace path the autoscaler uses for
+        chronic stragglers: DRAINING excludes it from new placements,
+        the notice fans out, and the autoscaler provisions a
+        replacement. A slow host becomes a bounded throughput dip that
+        self-heals instead of a stall-then-collapse."""
+        from ray_tpu._private import config
+
+        rec = self.collective_members.get(group)
+        members = (rec or {}).get("members", {})
+        node_addr = members.get(int(rank), {}).get("node_addr")
+        nid = next(
+            (
+                i
+                for i, n in self.nodes.items()
+                if node_addr and n["addr"] == node_addr
+            ),
+            None,
+        )
+        if nid is None:
+            return {"ok": False, "error": f"cannot resolve rank {rank} "
+                                          f"of group {group!r} to a node"}
+        self.chronic_skip_reports[nid] = max(
+            self.chronic_skip_reports.get(nid, 0.0), float(skips)
+        )
+        logger.warning(
+            "node %s (rank %d of collective group %r) was skipped by %d "
+            "partial collectives in %.0fs: chronic straggler",
+            nid[:12], int(rank), group, int(skips), window_s,
+        )
+        drained = False
+        if config.get("COLLECTIVE_SKIP_DRAIN") and nid not in self.draining:
+            reply = await self._on_drain_node(
+                conn,
+                node_id=nid,
+                reason=(
+                    f"chronic straggler: {int(skips)} partial-collective "
+                    f"skips in {window_s:.0f}s"
+                ),
+            )
+            drained = bool(reply.get("ok"))
+        return {"ok": True, "node_id": nid, "drained": drained}
 
     async def _on_collective_probe(
         self, conn, group: str, ranks=None
@@ -1561,7 +1627,8 @@ class HeadService:
             if node_conn is not None:
                 try:
                     reply = await node_conn.call("list_workers", timeout=2.0)
-                except Exception:  # noqa: BLE001 - any failure = dead node
+                # tpulint: allow(broad-except reason=any probe failure means the node is unreachable - acted on by removing the node, not swallowed)
+                except Exception:
                     await self._remove_node(nid)
                     confirmed.append(r)
                     continue
@@ -1619,7 +1686,8 @@ class HeadService:
                         )
                     failing = None
                     committed.append((nid, i))
-            except Exception as e:  # noqa: BLE001 - roll back prepares
+            # tpulint: allow(broad-except reason=not swallowed - prepares are rolled back and the error is returned or retried with the failing node excluded)
+            except Exception as e:
                 for nid, i in committed:
                     # A node that died between reserve and rollback must
                     # not abort freeing the remaining nodes' bundles
@@ -1634,7 +1702,8 @@ class HeadService:
                         await conn_.call(
                             "free_bundle", pg_id=pg_id, index=i
                         )
-                    except Exception:  # noqa: BLE001 - best-effort free
+                    # tpulint: allow(broad-except reason=a node that died between reserve and rollback frees its own bundles by dying; the loop must keep freeing the others)
+                    except Exception:
                         pass
                 last_error = str(e)
                 if failing is None:
@@ -1845,11 +1914,15 @@ class HeadService:
                 "steps": 0,
                 "productive_s": 0.0,
                 "stall_s": 0.0,
+                "degraded_s": 0.0,
                 "restart_lost_s": 0.0,
                 "first_ts": float(ev.get("ts") or 0.0),
                 "last_end_ts": None,
                 "mfu": None,
                 "phase_s": {},
+                # sliding alert window: (step_end_ts, total_s, lost_s)
+                "window": [],
+                "alert": False,
             }
         try:
             attempt = int(ev.get("train_attempt") or 0)
@@ -1859,6 +1932,7 @@ class HeadService:
             return
         if attempt < rec["attempt"]:
             return  # straggling flush from a superseded attempt
+        gap = 0.0
         if attempt > rec["attempt"]:
             if rec["attempt"] >= 0 and rec["last_end_ts"] is not None:
                 rec["restart_lost_s"] += max(
@@ -1868,7 +1942,8 @@ class HeadService:
             rec["attempts_seen"] += 1
         elif rec["last_end_ts"] is not None:
             # Same attempt: the hole between consecutive steps is stall.
-            rec["stall_s"] += max(0.0, start - rec["last_end_ts"])
+            gap = max(0.0, start - rec["last_end_ts"])
+            rec["stall_s"] += gap
         phases = ev.get("phases") or {}
         in_step_lost = 0.0
         for ph, s in phases.items():
@@ -1880,22 +1955,64 @@ class HeadService:
             if ph in ("data_wait", "checkpoint"):
                 in_step_lost += s
         in_step_lost = min(in_step_lost, dur)
+        # Degraded: the fraction of this step a partial collective ran
+        # without every rank's contribution — progress was made, but on
+        # a thinner gradient; a category of its own so "slow because
+        # skipping" never masquerades as productive OR as stall.
+        try:
+            dfrac = min(1.0, max(0.0, float(ev.get("degraded_frac") or 0.0)))
+        except (TypeError, ValueError):
+            dfrac = 0.0
+        degraded = min(dfrac * dur, dur - in_step_lost)
         rec["steps"] += 1
-        rec["productive_s"] += dur - in_step_lost
+        rec["productive_s"] += dur - in_step_lost - degraded
+        rec["degraded_s"] += degraded
         rec["stall_s"] += in_step_lost
         if isinstance(ev.get("mfu"), (int, float)):
             rec["mfu"] = float(ev["mfu"])
         rec["last_end_ts"] = max(rec["last_end_ts"] or 0.0, start + dur)
+        self._goodput_alert_check(
+            job, rec, start + dur, dur + gap, gap + in_step_lost + degraded
+        )
+
+    def _goodput_alert_check(
+        self, job: str, rec: dict, end_ts: float, total_s: float,
+        lost_s: float,
+    ) -> None:
+        """Per-phase goodput alerting: warn (log + gauge) when the lost
+        fraction — inter-step stalls, data-wait/checkpoint phases, and
+        the degraded partial-collective fraction — over the sliding
+        window exceeds the configured ratio. Log fires on the OFF→ON
+        transition only; the gauge tracks the current state."""
+        from ray_tpu._private import config
+
+        window_s = config.get("TRAIN_GOODPUT_ALERT_WINDOW_S")
+        ratio = config.get("TRAIN_GOODPUT_ALERT_RATIO")
+        rec["window"].append((end_ts, total_s, lost_s))
+        cutoff = end_ts - window_s
+        rec["window"] = [w for w in rec["window"] if w[0] >= cutoff]
+        total = sum(w[1] for w in rec["window"])
+        lost = sum(w[2] for w in rec["window"])
+        alert = total > 0 and lost / total > ratio
+        if alert and not rec["alert"]:
+            logger.warning(
+                "train job %r: %.0f%% of the last %.0fs was lost to "
+                "stalls/degraded collectives (alert ratio %.0f%%)",
+                job, 100.0 * lost / total, window_s, 100.0 * ratio,
+            )
+        rec["alert"] = alert
 
     @staticmethod
     def _train_job_public(rec: dict) -> dict:
         denom = (
-            rec["productive_s"] + rec["stall_s"] + rec["restart_lost_s"]
+            rec["productive_s"] + rec["stall_s"] + rec["degraded_s"]
+            + rec["restart_lost_s"]
         )
         return {
             "goodput": rec["productive_s"] / denom if denom > 0 else 1.0,
             "productive_s": rec["productive_s"],
             "stall_s": rec["stall_s"],
+            "degraded_s": rec["degraded_s"],
             "restart_lost_s": rec["restart_lost_s"],
             "steps": rec["steps"],
             "attempts": rec["attempts_seen"],
@@ -1904,6 +2021,7 @@ class HeadService:
             "phase_s": dict(rec["phase_s"]),
             "first_ts": rec["first_ts"],
             "last_ts": rec["last_end_ts"],
+            "alert": rec["alert"],
         }
 
     async def _on_train_stats(self, conn):
@@ -1926,19 +2044,24 @@ class HeadService:
 
         gp: dict[str, float] = {}
         lost: dict[str, float] = {}
+        degraded: dict[str, float] = {}
+        alert: dict[str, float] = {}
         mfu: dict[str, float] = {}
         for job, rec in self.train_runs.items():
             pub = self._train_job_public(rec)
             tag = f'job="{_esc(job)}"'
             gp[tag] = round(pub["goodput"], 6)
             lost[tag] = round(rec["restart_lost_s"], 6)
+            degraded[tag] = round(rec["degraded_s"], 6)
+            alert[tag] = 1.0 if rec["alert"] else 0.0
             if rec["mfu"] is not None:
                 mfu[tag] = rec["mfu"]
         out = {
             "ray_tpu_train_goodput_ratio": {
                 "kind": "gauge",
                 "description": "productive step time / (productive + "
-                               "stalls + restart loss) per train job",
+                               "stalls + degraded + restart loss) per "
+                               "train job",
                 "series": gp,
                 "boundaries": None,
             },
@@ -1947,6 +2070,22 @@ class HeadService:
                 "description": "wall time lost to elastic attempt "
                                "restarts per train job",
                 "series": lost,
+                "boundaries": None,
+            },
+            "ray_tpu_train_degraded_seconds": {
+                "kind": "gauge",
+                "description": "step time degraded by partial "
+                               "collectives skipping straggler "
+                               "contributions, per train job",
+                "series": degraded,
+                "boundaries": None,
+            },
+            "ray_tpu_train_goodput_alert": {
+                "kind": "gauge",
+                "description": "1 when the job's stall+degraded "
+                               "fraction over the alert window exceeds "
+                               "TRAIN_GOODPUT_ALERT_RATIO",
+                "series": alert,
                 "boundaries": None,
             },
         }

@@ -17,6 +17,7 @@ A truncated tail (crash mid-append) is ignored on replay.
 
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 import struct
@@ -107,6 +108,10 @@ class FileJournal:
                     try:
                         yield pickle.loads(data)
                     except Exception:  # noqa: BLE001 - corrupt frame
+                        logging.getLogger("ray_tpu.head").warning(
+                            "journal replay stopped at a corrupt frame "
+                            "(state up to this point is restored)"
+                        )
                         break
 
     def compact(self, snapshot: Any) -> None:

@@ -101,7 +101,8 @@ class ObjectStore:
             except Exception as e:  # noqa: BLE001 - fall back to file store
                 import logging
 
-                logging.getLogger("ray_tpu").warning(
+                logger = logging.getLogger("ray_tpu")
+                logger.warning(
                     "native shared-memory pool unavailable (%s: %s); "
                     "falling back to the file-per-object store",
                     type(e).__name__,

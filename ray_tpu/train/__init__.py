@@ -22,6 +22,7 @@ from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    partial_collective_opts,
     preemption_notice,
     report,
     step_span,
@@ -50,6 +51,7 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "partial_collective_opts",
     "preemption_notice",
     "PreemptedError",
     "report",

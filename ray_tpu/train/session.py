@@ -30,6 +30,12 @@ class TrainContext:
     # try) — lets a train loop scope its own collective-group names per
     # attempt so a retry never rendezvouses with a dead attempt's KV keys
     attempt: int = 0
+    # Straggler-tolerant gradient sync (ScalingConfig.allow_partial_grads
+    # threads these through): partial_collective_opts() turns them into
+    # the allreduce(min_ranks=, grace_s=) kwargs for the train loop.
+    allow_partial_grads: bool = False
+    partial_min_fraction: float = 0.75
+    partial_grace_s: float | None = None
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)
@@ -40,6 +46,9 @@ class TrainContext:
     _last_checkpoint_s: float = 0.0
     _step_index: int = 0
     _used_step_timer: bool = False
+    # skipped-rank fractions of this step's partial collectives; drained
+    # into the step span's degraded_frac by telemetry at step close
+    _partial_fracs: list = field(default_factory=list)
 
     def get_world_size(self) -> int:
         return self.world_size
@@ -80,6 +89,38 @@ def collective_group_name() -> str:
 def get_checkpoint() -> str | None:
     """Latest checkpoint directory to restore from (None on fresh start)."""
     return get_context().latest_checkpoint
+
+
+def partial_collective_opts(world: int | None = None) -> dict:
+    """The ``allreduce(min_ranks=, grace_s=)`` kwargs this worker group
+    was configured for (``ScalingConfig(allow_partial_grads=True,
+    partial_min_fraction=, partial_grace_s=)``), or ``{}`` when partial
+    gradient sync is off — so train loops can write
+    ``col.allreduce(grads, **train.partial_collective_opts())``
+    unconditionally. ``world`` defaults to the worker-group size; pass
+    the collective group's world when they differ."""
+    import math
+
+    ctx = get_context()
+    if not ctx.allow_partial_grads:
+        return {}
+    n = world if world is not None else ctx.world_size
+    return {
+        "min_ranks": max(1, min(n, math.ceil(n * ctx.partial_min_fraction))),
+        "grace_s": ctx.partial_grace_s,
+    }
+
+
+def note_partial_op(result) -> None:
+    """Collective layer callback: a partial op skipped ranks under an
+    active train session. The skipped fraction is charged to this step's
+    ``degraded_frac`` (→ the head ledger's "degraded" category)."""
+    ctx = _context
+    if ctx is None:
+        return
+    ctx._partial_fracs.append(
+        len(result.skipped) / max(1, result.world)
+    )
 
 
 def _own_node_notice() -> dict | None:

@@ -163,7 +163,8 @@ def finish_step(ctx, timer: StepTimer) -> None:
     if mfu is not None:
         MFU_GAUGE.set(mfu, tags={"job": job})
     _emit_step_span(
-        ctx, timer.start, dur, phases=dict(timer.phases), mfu=mfu
+        ctx, timer.start, dur, phases=dict(timer.phases), mfu=mfu,
+        degraded_frac=_take_degraded_frac(ctx),
     )
     from ray_tpu.util import tracing
 
@@ -199,11 +200,27 @@ def implicit_step(ctx, now: float, metrics: dict) -> None:
         STEP_PHASE_SECONDS.observe(
             ckpt_s, tags={"job": job, "phase": "checkpoint"}
         )
-    _emit_step_span(ctx, base, dur, phases=phases, mfu=mfu)
+    _emit_step_span(
+        ctx, base, dur, phases=phases, mfu=mfu,
+        degraded_frac=_take_degraded_frac(ctx),
+    )
     ctx._step_index += 1
 
 
-def _emit_step_span(ctx, start, dur, phases, mfu) -> None:
+def _take_degraded_frac(ctx) -> float:
+    """Drain this step's partial-collective skip fractions into one
+    degraded fraction (the worst op bounds the step: a gradient sync
+    that excluded 1/4 of the ranks degrades the whole step's update by
+    that fraction, however many clean ops surrounded it)."""
+    fracs = getattr(ctx, "_partial_fracs", None)
+    if not fracs:
+        return 0.0
+    frac = min(1.0, max(fracs))
+    fracs.clear()
+    return frac
+
+
+def _emit_step_span(ctx, start, dur, phases, mfu, degraded_frac=0.0) -> None:
     from ray_tpu.util import tracing
 
     attrs = dict(
@@ -215,4 +232,6 @@ def _emit_step_span(ctx, start, dur, phases, mfu) -> None:
     )
     if mfu is not None:
         attrs["mfu"] = round(mfu, 6)
+    if degraded_frac:
+        attrs["degraded_frac"] = round(degraded_frac, 6)
     tracing.emit_span("train:step", start, dur, **attrs)

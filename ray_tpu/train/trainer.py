@@ -52,6 +52,17 @@ class ScalingConfig:
     # surfaces as a typed collective abort within this bound, which the
     # controller turns into an elastic resize instead of a hang.
     collective_timeout_s: float | None = None
+    # Straggler-tolerant gradient sync: with allow_partial_grads on, the
+    # train loop's session.partial_collective_opts() maps to
+    # allreduce(min_ranks=ceil(world * partial_min_fraction),
+    # grace_s=partial_grace_s) — a slow host costs the step a bounded,
+    # rescaled skip (charged to the goodput ledger as "degraded") instead
+    # of stalling the world; chronic skips escalate into the
+    # drain-and-replace path. partial_grace_s None = config
+    # COLLECTIVE_PARTIAL_GRACE_S.
+    allow_partial_grads: bool = False
+    partial_min_fraction: float = 0.75
+    partial_grace_s: float | None = None
 
     def bundle(self) -> dict:
         b = {"CPU": 1.0}
@@ -209,6 +220,7 @@ class TrainWorker:
                     group_name=collective_group,
                     timeout_s=col_timeout,
                 )
+        partial_grace = backend_env.get("RAY_TPU_TRAIN_PARTIAL_GRACE_S")
         self.ctx = TrainContext(
             world_size=self.world_size,
             rank=self.rank,
@@ -219,6 +231,13 @@ class TrainWorker:
             dataset_shards=dataset_shards or {},
             collective_group=collective_group,
             attempt=attempt,
+            allow_partial_grads=(
+                backend_env.get("RAY_TPU_TRAIN_PARTIAL_GRADS") == "1"
+            ),
+            partial_min_fraction=float(
+                backend_env.get("RAY_TPU_TRAIN_PARTIAL_MIN_FRACTION", "0.75")
+            ),
+            partial_grace_s=float(partial_grace) if partial_grace else None,
         )
         return True
 
@@ -545,6 +564,15 @@ class JaxTrainer:
             env["RAY_TPU_TRAIN_COLLECTIVE_TIMEOUT_S"] = str(
                 self.scaling.collective_timeout_s
             )
+        if self.scaling.allow_partial_grads:
+            env["RAY_TPU_TRAIN_PARTIAL_GRADS"] = "1"
+            env["RAY_TPU_TRAIN_PARTIAL_MIN_FRACTION"] = str(
+                self.scaling.partial_min_fraction
+            )
+            if self.scaling.partial_grace_s is not None:
+                env["RAY_TPU_TRAIN_PARTIAL_GRACE_S"] = str(
+                    self.scaling.partial_grace_s
+                )
         if self.scaling.distributed and n > 1:
             env["RAY_TPU_TRAIN_DISTRIBUTED"] = "1"
         return env

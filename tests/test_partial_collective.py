@@ -1,0 +1,463 @@
+"""Straggler-tolerant partial collectives: K-of-N allreduce.
+
+Tier-1 coverage for the partial mode: with one rank delayed via the
+RAY_TPU_STRAGGLER_DELAY chaos knob, a partial allreduce completes within
+the grace window (not the straggler's delay), the result equals the
+rescaled mean of the contributors, skipped ranks show up in
+straggler_stats(), a chronic-skip scenario escalates into the head's
+straggler drain, and — without min_ranks — behavior is byte-identical
+to the classic all-N path. Every test runs under the conftest 60s
+collective wall-clock guard.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective.types import (
+    CollectiveTimeoutError,
+    PartialResult,
+)
+
+
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Member:
+    """One collective member returning outcomes as plain data (asserts
+    must not depend on cross-process exception pickling)."""
+
+    def setup(self, world, rank, group, timeout_s, env=None):
+        import ray_tpu.collective as col
+
+        os.environ.update(env or {})
+        col.init_collective_group(
+            world, rank, backend="cpu", group_name=group, timeout_s=timeout_s
+        )
+        return os.getpid()
+
+    def partial_allreduce(self, group, value, min_ranks, grace_s,
+                          timeout_s=None):
+        import ray_tpu.collective as col
+
+        t0 = time.monotonic()
+        try:
+            out = col.allreduce(
+                np.full((4,), value, np.float32),
+                group_name=group,
+                timeout_s=timeout_s,
+                min_ranks=min_ranks,
+                grace_s=grace_s,
+            )
+        except CollectiveTimeoutError as e:
+            return {
+                "ok": False,
+                "type": type(e).__name__,
+                "missing": e.missing_ranks,
+                "elapsed": time.monotonic() - t0,
+            }
+        assert isinstance(out, PartialResult)
+        return {
+            "ok": True,
+            "value": float(np.asarray(out.value)[0]),
+            "contributed": out.contributed,
+            "skipped": out.skipped,
+            "world": out.world,
+            "partial": out.is_partial,
+            "elapsed": time.monotonic() - t0,
+        }
+
+    def plain_allreduce(self, group, value):
+        import ray_tpu.collective as col
+
+        out = col.allreduce(
+            np.full((4,), value, np.float32), group_name=group
+        )
+        return {
+            "is_partial_type": isinstance(out, PartialResult),
+            "value": float(np.asarray(out)[0]),
+        }
+
+    def stats(self, group):
+        import ray_tpu.collective as col
+
+        return col.straggler_stats(group)
+
+    def set_env(self, env):
+        os.environ.update(env)
+        return True
+
+    def del_env(self, *names):
+        for n in names:
+            os.environ.pop(n, None)
+        return True
+
+
+def _setup_members(world, group, timeout_s=30.0, envs=None):
+    members = [Member.remote() for _ in range(world)]
+    ray_tpu.get(
+        [
+            m.setup.remote(
+                world, i, group, timeout_s,
+                (envs or {}).get(i),
+            )
+            for i, m in enumerate(members)
+        ],
+        timeout=30,
+    )
+    return members
+
+
+# ------------------------------------------------------------- tentpole
+def test_partial_allreduce_skips_straggler(cluster):
+    """Rank 2 is 2s late to every op (chaos knob); a K-of-N allreduce
+    with grace 0.3s completes in ~grace, returns the rescaled
+    contributor mean, and the straggler itself rejoins typed (same
+    result, itself listed as skipped) instead of hanging."""
+    world = 3
+    members = _setup_members(
+        world, "gp", envs={2: {"RAY_TPU_STRAGGLER_DELAY": "2:2.0"}}
+    )
+    refs = [
+        m.partial_allreduce.remote("gp", float(i + 1), 2, 0.3)
+        for i, m in enumerate(members)
+    ]
+    fast = ray_tpu.get(refs[:2], timeout=30)
+    for out in fast:
+        assert out["ok"], out
+        assert out["skipped"] == [2]
+        assert out["contributed"] == [0, 1]
+        assert out["partial"] is True
+        # (1+2) * world/K = 3 * 3/2: the mean over contributors once
+        # divided by world, not a mean diluted by the missing rank.
+        assert out["value"] == pytest.approx(4.5)
+        # Completed within grace territory, NOT the straggler's 2s delay
+        # (generous bound for slow CI, still well under the delay).
+        assert out["elapsed"] < 1.8
+    late = ray_tpu.get(refs[2], timeout=30)
+    assert late["ok"], late
+    assert late["value"] == pytest.approx(4.5)
+    assert late["skipped"] == [2]
+    # Skips are straggler telemetry: visible on the hub.
+    stats = ray_tpu.get(members[0].stats.remote("gp"), timeout=30)
+    assert stats["partial_ops"] >= 1
+    assert stats["skip_counts"].get(2, 0) >= 1
+    assert stats["slowest_counts"].get(2, 0) >= 1
+    # The group is still op-sequence-synchronized: a clean full
+    # allreduce (delay removed) completes with every rank.
+    ray_tpu.get(
+        members[2].del_env.remote("RAY_TPU_STRAGGLER_DELAY"), timeout=30
+    )
+    outs = ray_tpu.get(
+        [m.plain_allreduce.remote("gp", 1.0) for m in members], timeout=30
+    )
+    assert all(o["value"] == 3.0 for o in outs)
+
+
+def test_partial_below_min_ranks_hits_hard_deadline(cluster):
+    """Grace alone never completes an op below K: with the straggler
+    needed for K=2-of-2, the hard deadline still raises the classic
+    typed timeout naming the missing rank."""
+    members = _setup_members(
+        2, "gm", envs={1: {"RAY_TPU_STRAGGLER_DELAY": "1:30"}}
+    )
+    out = ray_tpu.get(
+        members[0].partial_allreduce.remote("gm", 1.0, 2, 0.2, 2.0),
+        timeout=30,
+    )
+    assert out["ok"] is False
+    assert out["type"] == "CollectiveTimeoutError"
+    assert out["missing"] == [1]
+    assert out["elapsed"] < 12
+
+
+def test_partial_all_arrive_is_not_partial(cluster):
+    """No straggler: partial mode returns the same sum as the classic
+    path in the PartialResult envelope with nothing skipped."""
+    members = _setup_members(2, "ga")
+    outs = ray_tpu.get(
+        [
+            m.partial_allreduce.remote("ga", float(i + 1), 1, 5.0)
+            for i, m in enumerate(members)
+        ],
+        timeout=30,
+    )
+    for out in outs:
+        assert out["ok"]
+        assert out["skipped"] == []
+        assert out["partial"] is False
+        assert out["value"] == pytest.approx(3.0)
+
+
+def test_without_min_ranks_byte_identical(cluster):
+    """No partial kwargs → no partial path: plain ndarray result, no
+    PartialResult envelope, zero partial state on the hub."""
+    members = _setup_members(2, "gb")
+    outs = ray_tpu.get(
+        [m.plain_allreduce.remote("gb", float(i + 1)) for i, m in
+         enumerate(members)],
+        timeout=30,
+    )
+    for out in outs:
+        assert out["is_partial_type"] is False
+        assert out["value"] == pytest.approx(3.0)
+    stats = ray_tpu.get(members[0].stats.remote("gb"), timeout=30)
+    assert stats["partial_ops"] == 0
+    assert stats["skip_counts"] == {}
+
+
+def test_chronic_skips_escalate_to_drain(cluster):
+    """A rank skipped repeatedly inside the sliding window crosses the
+    escalation threshold: the hub reports it to the head, which puts the
+    rank's node on the DRAINING path (the drain-and-replace loop the
+    autoscaler already acts on)."""
+    world = 2
+    members = _setup_members(
+        world,
+        "gc",
+        envs={
+            # Hub-side escalation knobs live in the hub's process.
+            0: {
+                "RAY_TPU_COLLECTIVE_SKIP_DRAIN_THRESHOLD": "3",
+                "RAY_TPU_COLLECTIVE_SKIP_WINDOW_S": "60",
+            },
+            1: {"RAY_TPU_STRAGGLER_DELAY": "1:1.0"},
+        },
+    )
+    for _ in range(3):
+        refs = [
+            m.partial_allreduce.remote("gc", 1.0, 1, 0.15)
+            for m in members
+        ]
+        outs = ray_tpu.get(refs, timeout=30)
+        assert all(o["ok"] for o in outs)
+        assert outs[0]["skipped"] == [1]
+    rt = ray_tpu.api._runtime
+    deadline = time.monotonic() + 10
+    reasons = {}
+    while time.monotonic() < deadline:
+        reply = rt.run(rt.core.head.call("drain_table"))
+        reasons = {
+            nid: d.get("reason", "")
+            for nid, d in reply.get("draining", {}).items()
+        }
+        if any("straggler" in r for r in reasons.values()):
+            break
+        time.sleep(0.25)
+    assert any("straggler" in r for r in reasons.values()), reasons
+    # The escalated skips also feed the chronic-straggler node signal
+    # the autoscaler polls.
+    reply = rt.run(rt.core.head.call("collective_straggler_stats"))
+    assert reply["ok"] and any(
+        v >= 3 for v in (reply.get("nodes") or {}).values()
+    ), reply
+
+
+# ------------------------------------------------------- xla masked psum
+def test_mesh_masked_psum_rescales():
+    """XLA partial semantics: a masked psum whose compiled shape never
+    changes — flagged ranks contribute weight 0 and SUM rescales by
+    world/K (same math as the cpu hub)."""
+    import jax
+
+    from ray_tpu.collective.backends.xla_group import XlaMeshGroup
+
+    world = len(jax.devices())
+    assert world == 8
+    g = XlaMeshGroup(name="mesh_partial")
+    tensors = [np.full((4,), float(i + 1), np.float32) for i in range(world)]
+    out = g.allreduce(tensors, min_ranks=4, skip_ranks=[1, 5])
+    assert isinstance(out, PartialResult)
+    assert out.skipped == [1, 5]
+    full_sum = sum(range(1, world + 1))
+    masked = full_sum - 2 - 6
+    expect = masked * world / (world - 2)
+    for per_rank in out.value:
+        assert float(np.asarray(per_rank)[0]) == pytest.approx(expect)
+    # Below min_ranks → typed timeout naming the masked ranks.
+    with pytest.raises(CollectiveTimeoutError):
+        g.allreduce(tensors, min_ranks=8, skip_ranks=[0])
+    # No partial kwargs → classic list-of-tensors path, unchanged.
+    plain = g.allreduce(tensors)
+    assert not isinstance(plain, PartialResult)
+    assert float(np.asarray(plain[0])[0]) == pytest.approx(full_sum)
+
+
+# ---------------------------------------------------- span rate limiting
+def test_flight_recorder_span_sampling():
+    """>1 kHz sub-ms op storms (partial-mode retries) sample spans
+    1-in-N instead of flooding the trace buffer; an explicit
+    sample_rate arg forces the ratio; slow ops always emit."""
+    from ray_tpu.collective import flight_recorder as fr
+
+    fr._span_state.clear()
+    # Explicit: 1-in-10 regardless of rate.
+    emitted = sum(
+        1 for _ in range(100)
+        if fr._span_sample("g1", "allreduce", 0.5, 10)[0]
+    )
+    assert emitted == 10
+    # Auto: the first _AUTO_RATE_HZ sub-ms ops in the window emit (the
+    # rate is unknown until it is exceeded), the storm's tail samples
+    # at 1-in-_AUTO_SAMPLE.
+    n = 3000
+    emitted = sum(
+        1 for _ in range(n)
+        if fr._span_sample("g2", "allreduce", 0.0001, None)[0]
+    )
+    assert emitted <= fr._AUTO_RATE_HZ + n // fr._AUTO_SAMPLE + 1
+    # Slow ops are never sampled away, whatever the rate.
+    assert all(
+        fr._span_sample("g2", "allreduce", 0.05, None)[0]
+        for _ in range(50)
+    )
+    fr._span_state.clear()
+
+
+# ------------------------------------------------- goodput ledger + alert
+def test_degraded_ledger_and_goodput_alert():
+    """Head-side unit: degraded_frac on rank-0 step spans lands in the
+    'degraded' ledger category, and a sliding-window lost fraction past
+    TRAIN_GOODPUT_ALERT_RATIO flips the alert (log + gauge)."""
+    from ray_tpu.runtime.head import HeadService
+
+    head = HeadService(journal_path="off")
+    t = 1000.0
+    for step in range(6):
+        head._train_step_event(
+            {
+                "train_job": "job",
+                "train_rank": 0,
+                "train_attempt": 0,
+                "ts": t,
+                "dur": 1.0,
+                "phases": {},
+                "degraded_frac": 0.8,
+                "mfu": 0.5,
+            }
+        )
+        t += 1.0
+    rec = head.train_runs["job"]
+    assert rec["degraded_s"] == pytest.approx(0.8 * 6)
+    assert rec["productive_s"] == pytest.approx(0.2 * 6)
+    pub = head._train_job_public(rec)
+    assert pub["degraded_s"] == pytest.approx(4.8)
+    assert pub["goodput"] == pytest.approx(0.2)
+    assert pub["alert"] is True
+    snap = head._train_metrics_snapshot()
+    assert snap["ray_tpu_train_goodput_alert"]["series"]['job="job"'] == 1.0
+    assert snap["ray_tpu_train_degraded_seconds"]["series"][
+        'job="job"'
+    ] == pytest.approx(4.8)
+    # A healthy job never alerts.
+    t2 = 2000.0
+    for _ in range(6):
+        head._train_step_event(
+            {
+                "train_job": "healthy",
+                "train_rank": 0,
+                "train_attempt": 0,
+                "ts": t2,
+                "dur": 1.0,
+                "phases": {},
+            }
+        )
+        t2 += 1.0
+    assert head._train_job_public(head.train_runs["healthy"])["alert"] is False
+
+
+# --------------------------------------------------- convergence sanity
+def _convergence_loop(config):
+    import numpy as np  # noqa: PLC0415 - worker-process import
+
+    import ray_tpu.collective as col
+    from ray_tpu import train
+    from ray_tpu.collective.types import PartialResult as PR
+
+    ctx = train.get_context()
+    if config.get("straggle") and ctx.rank == 1:
+        os.environ["RAY_TPU_STRAGGLER_DELAY"] = "1:0.3"
+    group = f"conv{config['tag']}:a{ctx.attempt}"
+    col.init_collective_group(
+        ctx.world_size, ctx.rank, backend="cpu", group_name=group,
+        timeout_s=30.0,
+    )
+    opts = train.partial_collective_opts()
+    rng = np.random.default_rng(42 + ctx.rank)
+    w_true = np.array([1.0, 2.0, 3.0, 4.0], np.float64)
+    X = rng.normal(size=(16, 4))
+    y = X @ w_true
+    w = np.zeros(4)
+    first_loss = None
+    for _ in range(30):
+        resid = X @ w - y
+        grad = 2.0 * X.T @ resid / len(y)
+        out = col.allreduce(grad, group_name=group, **opts)
+        if isinstance(out, PR):
+            out = out.value
+        # SUM rescale makes out/world the mean over contributors.
+        w = w - 0.2 * np.asarray(out) / ctx.world_size
+        loss = float(np.mean((X @ w - y) ** 2))
+        if first_loss is None:
+            first_loss = loss
+    stats = col.straggler_stats(group) if ctx.rank == 0 else {}
+    train.report(
+        {
+            "loss": loss,
+            "first_loss": first_loss,
+            "partial_ops": stats.get("partial_ops", 0),
+            "skips_of_rank1": (stats.get("skip_counts") or {}).get(1, 0),
+        }
+    )
+
+
+def _fit_convergence(tag, straggle):
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    trainer = JaxTrainer(
+        _convergence_loop,
+        train_loop_config={"tag": tag, "straggle": straggle},
+        scaling_config=ScalingConfig(
+            num_workers=2,
+            allow_partial_grads=True,
+            partial_min_fraction=0.5,
+            partial_grace_s=0.1,
+        ),
+        run_config=RunConfig(name=f"conv_{tag}"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    return result.metrics
+
+
+def test_partial_grads_convergence_sanity(cluster):
+    """Satellite: a small model trained with one injected straggler and
+    allow_partial_grads=True still converges comparably to the clean
+    run, and the skips are visible in straggler_stats()."""
+    clean = _fit_convergence("clean", straggle=False)
+    degraded = _fit_convergence("strag", straggle=True)
+    # Both runs must actually learn (zero-noise least squares: loss
+    # collapses by orders of magnitude over 12 steps).
+    assert clean["loss"] < 0.05 * clean["first_loss"]
+    assert degraded["loss"] < 0.05 * degraded["first_loss"]
+    # Comparable, not identical: the partial run sees half the data on
+    # skipped steps — allow a generous factor over the clean loss.
+    assert degraded["loss"] <= max(clean["loss"] * 100.0, 1e-3)
+    # The straggler's skips were recorded.
+    assert degraded["partial_ops"] >= 1
+    assert degraded["skips_of_rank1"] >= 1
+    # Degraded time reached the head's goodput ledger as its own
+    # category.
+    rt = ray_tpu.api._runtime
+    reply = rt.run(rt.core.head.call("train_stats"))
+    job = reply["jobs"].get("conv_strag")
+    assert job is not None
+    assert job["degraded_s"] > 0.0

@@ -149,7 +149,31 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                                "sub-deadline past the "
                                                "fastest arrival "
                                                "(allreduce grace_s= "
-                                               "overrides per op)"),
+                                               "overrides per op); "
+                                               "fallback when the "
+                                               "adaptive window has too "
+                                               "few lag samples"),
+    "COLLECTIVE_ADAPTIVE_GRACE": (bool, True, "derive the partial-mode "
+                                              "grace window from the "
+                                              "hub's straggler-lag "
+                                              "histogram (p99 * 1.5, "
+                                              "clamped to COLLECTIVE_"
+                                              "GRACE_MIN/MAX_S) instead "
+                                              "of the static default"),
+    "COLLECTIVE_GRACE_MIN_S": (float, 0.1, "lower clamp for the "
+                                           "adaptive grace window"),
+    "COLLECTIVE_GRACE_MAX_S": (float, 10.0, "upper clamp for the "
+                                            "adaptive grace window"),
+    "COLLECTIVE_ALGO_CROSSOVER": (str, "", "tree-to-ring crossover "
+                                           "override for algo='auto': "
+                                           "a byte count ('65536') or "
+                                           "per-world entries "
+                                           "('2:65536,8:262144'); "
+                                           "empty = built-in table"),
+    "COLLECTIVE_COMPRESSION_BLOCK": (int, 256, "elements per absmax "
+                                               "scale block of the "
+                                               "int8 collective "
+                                               "codec"),
     "STRAGGLER_DELAY": (str, "", "chaos spec: comma-separated "
                                  "'rank:seconds' — the named collective "
                                  "ranks sleep that long before every "

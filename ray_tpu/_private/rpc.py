@@ -31,6 +31,10 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+import logging
+
+logger = logging.getLogger("ray_tpu.rpc")
+
 REQ, RESP, ERR, PUSH = 0, 1, 2, 3
 WIRE_VERSION = 1
 _HDR = struct.Struct("<I")
@@ -302,6 +306,7 @@ class Connection:
         try:
             host, port = self.writer.get_extra_info("peername")[:2]
             return f"{host}:{port}"
+        # tpulint: allow(broad-except reason=peername is unavailable on a closing transport; this is a display label, never control flow)
         except Exception:
             return "?"
 
@@ -361,15 +366,11 @@ class Connection:
         except RpcError as e:
             # Version skew / malformed frame: say WHY before dropping
             # the peer, or the operator only ever sees ConnectionLost.
-            import logging
-
-            logging.getLogger("ray_tpu.rpc").warning(
+            logger.warning(
                 "dropping connection to %s: %s", self.peer, e
             )
         except Exception:  # noqa: BLE001 - decode bugs must be visible
-            import logging
-
-            logging.getLogger("ray_tpu.rpc").exception(
+            logger.exception(
                 "dropping connection to %s: frame decode failed", self.peer
             )
         finally:
@@ -382,13 +383,18 @@ class Connection:
                 raise RpcError("connection has no handler")
             result = await self.handler(method, kw, self)
             _write_frame(self.writer, (RESP, req_id, result))
+        # tpulint: allow(broad-except reason=the handler error IS propagated — serialized into the ERR frame the caller raises from)
         except Exception as e:  # noqa: BLE001 - errors travel to the caller
             try:
                 _write_frame(self.writer, (ERR, req_id, f"{type(e).__name__}: {e}"))
             except Exception:
-                pass
+                logger.debug(
+                    "could not deliver error reply to %s (conn closing)",
+                    self.peer,
+                )
         try:
             await self.writer.drain()
+        # tpulint: allow(broad-except reason=drain on a dying transport; the recv loop reports the drop with its cause)
         except Exception:
             pass
 
@@ -402,6 +408,7 @@ class Connection:
         self._pending.clear()
         try:
             self.writer.close()
+        # tpulint: allow(broad-except reason=closing an already-broken transport during teardown; every caller-visible failure was already delivered via the pending futures)
         except Exception:
             pass
         if self.on_close:
@@ -430,6 +437,9 @@ class Server:
                         _server_auth(reader, token), timeout=5.0
                     )
                 except (asyncio.TimeoutError, Exception):  # noqa: BLE001
+                    logger.warning(
+                        "auth handshake failed; refusing connection"
+                    )
                     ok = False
                 if not ok:
                     # Refuse before any frame dispatch: an
@@ -437,6 +447,7 @@ class Server:
                     # layer (deserialization = code execution).
                     try:
                         writer.close()
+                    # tpulint: allow(broad-except reason=refusing an unauthenticated peer; the socket may already be gone and there is nothing to tell it)
                     except Exception:  # noqa: BLE001
                         pass
                     return

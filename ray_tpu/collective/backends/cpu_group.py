@@ -36,20 +36,40 @@ telemetry):
   lag and the slowest rank (util/metrics.py histogram + counter), so a
   chronically slow member is visible before it becomes a timeout.
 - Partial K-of-N mode ("Efficient AllReduce with Stragglers",
-  arXiv:2505.23523): ``allreduce(..., min_ranks=K, grace_s=...)`` arms a
-  SECOND, earlier timer when the first contribution arrives. If the
-  grace sub-deadline passes with ≥K contributions in hand (or the K-th
-  lands after it), the hub completes the op over the contributors —
-  SUM rescaled by world/K so downstream mean math stays correct — and
-  answers everyone with typed PartialResult metadata naming the skipped
-  ranks. A "partial" tombstone keeps the op's reply around so a
+  arXiv:2505.23523): ``allreduce/reducescatter/allgather(...,
+  min_ranks=K, grace_s=...)`` arms a SECOND, earlier timer when the
+  first contribution arrives. If the grace sub-deadline passes with ≥K
+  contributions in hand (or the K-th lands after it), the hub completes
+  the op over the contributors — SUM rescaled by world/K so downstream
+  mean math stays correct; an allgather fills skipped slots with zeros —
+  and answers everyone with typed PartialResult metadata naming the
+  skipped ranks. A "partial" tombstone keeps the op's reply around so a
   straggler's late contribution is acked-and-discarded with the same
   result (it rejoins op-sequence-synchronized instead of hanging or
   desyncing). The hard deadline still raises CollectiveTimeoutError
   when even K never arrive. Skips feed the straggler stats, the
   ray_tpu_collective_partial_* metrics, and — past a sliding-window
   threshold — an escalation report to the head that triggers the
-  chronic-straggler drain-and-replace path.
+  chronic-straggler drain-and-replace path. The grace window itself is
+  adaptive by default: once the hub has enough full-op lag samples, it
+  derives grace from the straggler-lag histogram (p99 × 1.5, clamped)
+  instead of the static config default.
+- Compression (`EQuARX <https://arxiv.org/abs/2506.17615>`_):
+  ``compression="int8"`` on allreduce/reducescatter/allgather ships
+  block-scaled int8 + per-block fp32 absmax scales on the wire (~3.9×
+  fewer bytes at block=256) while the hub dequantizes and ACCUMULATES
+  IN FP32, requantizing only the reply — the codec is a wire format,
+  never an accumulator. Measured wire bytes (the actual packed RPC
+  payloads, both directions) feed the flight recorder's
+  ray_tpu_collective_wire_bytes_total counter and compression-ratio
+  gauge.
+- Topology-aware algorithms ("The Big Send-off", arXiv:2504.18658):
+  ``allreduce(..., algo=)`` can bypass the hub for a flat ring
+  (bandwidth-optimal reduce-scatter + all-gather over the p2p mailbox)
+  or a binomial tree (log2(n) latency terms — wins for small
+  messages); ``algo="auto"`` picks by message size via the
+  collective.algo crossover table. The default (None) keeps the hub
+  path, byte-identical to before.
 """
 
 from __future__ import annotations
@@ -62,6 +82,8 @@ import numpy as np
 
 from ray_tpu._private import rpc
 from ray_tpu._private.serialization import deserialize, serialize
+from ray_tpu.collective import algo as colalgo
+from ray_tpu.collective import codec
 from ray_tpu.collective.flight_recorder import record_op, record_partial
 from ray_tpu.collective.types import (
     CollectiveGroupDestroyedError,
@@ -78,6 +100,22 @@ _REDUCERS = {
     ReduceOp.MIN: lambda xs: np.min(xs, axis=0),
     ReduceOp.MAX: lambda xs: np.max(xs, axis=0),
 }
+
+# Pairwise combiners for the ring/tree p2p algorithms (streaming
+# accumulation instead of the hub's stack-and-reduce).
+_COMBINERS = {
+    ReduceOp.SUM: np.add,
+    ReduceOp.PRODUCT: np.multiply,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.MAX: np.maximum,
+}
+
+# Ops that support partial K-of-N completion on the hub.
+_PARTIAL_KINDS = frozenset({"allreduce", "reducescatter", "allgather"})
+
+# Full-op lag samples needed before the adaptive grace window replaces
+# the static default.
+_ADAPTIVE_MIN_SAMPLES = 16
 
 # Extra member-side wait beyond the hub's deadline: the hub answers
 # expiry itself, so a member only hits its own backstop when the hub
@@ -138,6 +176,43 @@ def _unpack(packed: tuple) -> Any:
     return deserialize(packed[0], packed[1])
 
 
+def _packed_nbytes(packed: tuple) -> int:
+    """Measured wire size of one packed payload (inband + oob buffers)."""
+    inband, buffers = packed
+    return len(inband) + sum(
+        int(getattr(b, "nbytes", 0)) or len(b) for b in buffers
+    )
+
+
+def _compress(arr: np.ndarray, compression: str | None):
+    """Payload → what goes on the wire (a codec dict when compressing)."""
+    if compression is None:
+        return arr
+    from ray_tpu._private import config
+
+    return codec.to_wire(
+        codec.quantize(arr, block=config.get("COLLECTIVE_COMPRESSION_BLOCK"))
+    )
+
+
+def _decompress(value):
+    """Inverse of :func:`_compress`, recursing into allgather lists."""
+    if codec.is_wire(value):
+        qt = codec.from_wire(value)
+        return codec.dequantize(qt, dtype=qt.dtype)
+    if isinstance(value, list):
+        return [_decompress(v) for v in value]
+    return value
+
+
+def _contrib_array(value) -> np.ndarray:
+    """A hub-side contribution as an fp32-accumulation-grade array
+    (dequantizing codec payloads; raw arrays pass through)."""
+    if codec.is_wire(value):
+        return codec.dequantize(codec.from_wire(value))
+    return np.asarray(value)
+
+
 def _default_timeout() -> float:
     from ray_tpu._private import config
 
@@ -196,6 +271,18 @@ class CpuGroup:
         self._skip_counts: dict[int, int] = {}
         self._skip_events: list[tuple[float, int]] = []
         self._skip_reported: set[int] = set()
+        # Adaptive grace: sliding window of full-op first→last lag
+        # samples (the straggler-lag histogram's raw feed); the hub
+        # derives the partial grace window from its p99 once enough
+        # samples exist.
+        from collections import deque
+
+        self._lag_samples: "deque[float]" = deque(maxlen=512)
+        # Ring/tree p2p algorithm state: op counter for tag scoping and
+        # a peer addr cache so each hop is one conn call, not a head KV
+        # lookup per send.
+        self._algo_seq = 0
+        self._peer_addrs: dict[int, str] = {}
         if rank == 0:
             self.core.ext_handlers[f"col_op:{self.name}"] = self._on_op
         self.core.ext_handlers[f"col_sendrecv:{self.name}"] = self._on_sendrecv
@@ -446,7 +533,10 @@ class CpuGroup:
             # SAME rescaled result + partial metadata (the straggler
             # rejoins typed and op-sequence-synchronized; a fresh
             # pending entry here could only hang until the deadline).
-            return done
+            # reducescatter tombstones are per-rank (each rank's chunk
+            # differs); the other kinds share one reply.
+            per_rank = done.get("per_rank")
+            return per_rank[rank] if per_rank is not None else done["reply"]
         st = self._pending.get(key)
         if st is None:
             st = self._pending[key] = _Pending(self.world)
@@ -454,13 +544,13 @@ class CpuGroup:
             loop = asyncio.get_running_loop()
             st.timer = loop.call_later(timeout, self._expire, key, timeout)
             min_ranks = meta.get("min_ranks")
-            if min_ranks is not None and kind == "allreduce":
+            if min_ranks is not None and kind in _PARTIAL_KINDS:
                 # Two-stage timer: the grace sub-deadline is measured
                 # from the FASTEST arrival — which is this one, the
                 # contribution that created the pending entry.
                 st.min_ranks = max(1, min(int(min_ranks), self.world))
                 st.grace_s = float(
-                    meta.get("grace_s") or _default_partial_grace()
+                    meta.get("grace_s") or self._resolve_grace()
                 )
                 st.meta = dict(meta)
                 st.grace_timer = loop.call_later(
@@ -531,6 +621,10 @@ class CpuGroup:
         first = min(st.arrive_ts.values())
         last = max(st.arrive_ts.values())
         self._last_lag_s = last - first
+        # Full-op arrivals only feed the adaptive-grace window: a
+        # partial completion's spread is censored at the grace deadline
+        # and would bias the p99 down.
+        self._lag_samples.append(self._last_lag_s)
         slowest = max(st.arrive_ts, key=st.arrive_ts.get)
         self._straggler_counts[slowest] = (
             self._straggler_counts.get(slowest, 0) + 1
@@ -542,34 +636,71 @@ class CpuGroup:
             tags={"group": self.base_name, "rank": str(slowest)}
         )
 
+    def _lag_p99(self) -> float | None:
+        if len(self._lag_samples) < _ADAPTIVE_MIN_SAMPLES:
+            return None
+        return float(np.percentile(np.asarray(self._lag_samples), 99))
+
+    def _resolve_grace(self) -> float:
+        """Partial-mode grace window when the caller passed none: the
+        straggler-lag histogram's p99 with 1.5x headroom, clamped to
+        [COLLECTIVE_GRACE_MIN_S, COLLECTIVE_GRACE_MAX_S] — a group
+        whose normal spread is 10ms stops waiting a full second for a
+        straggler, and one whose spread is 2s is not strangled by the
+        1s static default. Falls back to COLLECTIVE_PARTIAL_GRACE_S
+        until enough full-op samples exist (or when the adaptive knob
+        is off)."""
+        from ray_tpu._private import config
+
+        static = _default_partial_grace()
+        if not config.get("COLLECTIVE_ADAPTIVE_GRACE"):
+            return static
+        p99 = self._lag_p99()
+        if p99 is None:
+            return static
+        return float(
+            min(
+                max(p99 * 1.5, config.get("COLLECTIVE_GRACE_MIN_S")),
+                config.get("COLLECTIVE_GRACE_MAX_S"),
+            )
+        )
+
     def straggler_stats(self) -> dict:
         """Hub-side per-rank slowest/missing counts (empty off-hub).
         ``partial_ops`` / ``skip_counts`` cover the K-of-N mode: how
-        many ops completed without someone, and who got skipped."""
+        many ops completed without someone, and who got skipped.
+        ``adaptive_grace_s`` is the grace window a partial op with no
+        explicit grace_s would get right now; ``lag_p99_s`` the
+        histogram percentile behind it (None until enough samples)."""
         return {
             "ops_completed": self._ops_completed,
             "last_lag_s": self._last_lag_s,
             "slowest_counts": dict(self._straggler_counts),
             "partial_ops": self._partial_ops,
             "skip_counts": dict(self._skip_counts),
+            "lag_p99_s": self._lag_p99(),
+            "adaptive_grace_s": self._resolve_grace(),
         }
 
     # -------------------------------------------- partial K-of-N (hub)
     def _complete_partial(self, key, st: _Pending, kind: str, meta: dict):
         """Complete an op over the K..N-1 contributions in hand: reduce
-        the contributors, rescale SUM by world/K (so result/world is the
-        mean over actual contributors), answer every waiter with the
-        result + partial metadata, and leave a tombstone reply for the
-        stragglers' late contributions."""
+        the contributors (dequantized, fp32), rescale SUM by world/K (so
+        result/world is the mean over actual contributors), answer every
+        waiter with the result + partial metadata, and leave a tombstone
+        reply for the stragglers' late contributions.
+
+        Per kind: allreduce returns the rescaled reduction to everyone;
+        reducescatter returns each rank ITS chunk of it (per-rank
+        tombstones); allgather returns the contributed entries with
+        zero-filled slots for the skipped ranks — the skip list, not the
+        zeros, is the signal downstream code should branch on."""
         del self._pending[key]
         st.cancel_timers()
+        compression = meta.get("compression")
         contributed = sorted(st.arrive_ts)
         skipped = [r for r in range(self.world) if st.contrib[r] is None]
         op = ReduceOp(meta.get("op", "sum"))
-        stacked = np.stack([st.contrib[r] for r in contributed])
-        result = _REDUCERS[op](stacked)
-        if op is ReduceOp.SUM:
-            result = result * (self.world / float(len(contributed)))
         self._partial_ops += 1
         self._ops_completed += 1
         record_partial(self.base_name, kind, skipped)
@@ -586,17 +717,57 @@ class CpuGroup:
             "skipped": skipped,
             "world": self.world,
         }
-        reply = {
-            "ok": True,
-            "payload": _pack(result),
-            "partial": partial_meta,
-        }
+        done: dict
+        if kind == "allgather":
+            first = _contrib_array(st.contrib[contributed[0]])
+            zero = np.zeros_like(first)
+            entries = [
+                st.contrib[r]
+                if st.contrib[r] is not None
+                else _compress(zero, compression)
+                for r in range(self.world)
+            ]
+            reply = {
+                "ok": True,
+                "payload": _pack(entries),
+                "partial": partial_meta,
+            }
+            done = {"reply": reply}
+        else:
+            stacked = np.stack(
+                [_contrib_array(st.contrib[r]) for r in contributed]
+            )
+            result = _REDUCERS[op](stacked)
+            if op is ReduceOp.SUM:
+                result = result * (self.world / float(len(contributed)))
+            if kind == "reducescatter":
+                chunks = np.array_split(result, self.world, axis=0)
+                per_rank = [
+                    {
+                        "ok": True,
+                        "payload": _pack(_compress(c, compression)),
+                        "partial": partial_meta,
+                    }
+                    for c in chunks
+                ]
+                done = {"per_rank": per_rank}
+            else:
+                reply = {
+                    "ok": True,
+                    "payload": _pack(_compress(result, compression)),
+                    "partial": partial_meta,
+                }
+                done = {"reply": reply}
         for rank, fut in st.futures:
             if not fut.done():
-                fut.set_result(dict(reply))
+                per_rank = done.get("per_rank")
+                fut.set_result(
+                    dict(per_rank[rank] if per_rank is not None
+                         else done["reply"])
+                )
         # Tombstone for the stragglers (bounded: ops complete in seq
         # order, old tombstones can no longer be asked for).
-        self._partial_done[key] = reply
+        self._partial_done[key] = done
         while len(self._partial_done) > 128:
             self._partial_done.pop(next(iter(self._partial_done)))
         self._escalate_chronic_skips(now)
@@ -638,13 +809,32 @@ class CpuGroup:
     def _complete(self, key, st: _Pending, kind: str, meta: dict):
         del self._pending[key]
         op = ReduceOp(meta.get("op", "sum"))
+        compression = meta.get("compression")
+        if compression is None:
+            # Classic path: untouched numpy reduce over the raw
+            # contributions — byte-identical to before the codec landed.
+            contrib = st.contrib
+        else:
+            # Codec path: dequantize EVERY contribution and accumulate
+            # in fp32; only the reply is requantized.
+            contrib = [
+                _contrib_array(c) if c is not None else None
+                for c in st.contrib
+            ]
         if kind == "allreduce" or kind == "reduce":
-            result = _REDUCERS[op](np.stack(st.contrib))
+            result = _REDUCERS[op](np.stack(contrib))
+            if compression is not None and kind == "allreduce":
+                result = _compress(result, compression)
         elif kind == "allgather":
+            # Compressed allgather passes the members' wire payloads
+            # through untouched — nothing to reduce, nothing to requant.
             result = list(st.contrib)
         elif kind == "reducescatter":
-            red = _REDUCERS[op](np.stack(st.contrib))
-            result = np.array_split(red, self.world, axis=0)
+            red = _REDUCERS[op](np.stack(contrib))
+            result = [
+                _compress(c, compression)
+                for c in np.array_split(red, self.world, axis=0)
+            ]
         elif kind == "broadcast":
             result = st.contrib[meta.get("root", 0)]
         elif kind == "barrier":
@@ -721,13 +911,20 @@ class CpuGroup:
                 self.base_name, kind, dead_ranks=[0],
                 detail="cannot reach the hub rank",
             )
+        # The packed RPC payloads are the ACTUAL wire bytes of this op:
+        # measure them (both directions) for the flight recorder's wire
+        # counter — the compression win shows up here, not in the
+        # logical byte counter.
+        packed = _pack(_compress(tensor, meta.get("compression"))
+                       if tensor is not None else tensor)
+        wire_sent = _packed_nbytes(packed)
         call = asyncio.ensure_future(
             conn.call(
                 f"col_op:{self.name}",
                 kind=kind,
                 seq=seq,
                 rank=self.rank,
-                payload=_pack(tensor),
+                payload=packed,
                 meta={**meta, "timeout_s": t},
             )
         )
@@ -759,26 +956,25 @@ class CpuGroup:
             )
         finally:
             self._inflight.discard(call)
+        wire_recv = (
+            _packed_nbytes(reply["payload"])
+            if reply.get("ok") and "payload" in reply
+            else 0
+        )
         result = self._interpret(kind, reply)
+        if meta.get("compression") is not None:
+            if isinstance(result, PartialResult):
+                result.value = _decompress(result.value)
+            else:
+                result = _decompress(result)
         record_op(
             self.base_name, kind, "cpu", self.world, tensor,
             wall_start, time.perf_counter() - t0,
+            wire_bytes=wire_sent + wire_recv,
         )
         return result
 
-    async def allreduce(
-        self,
-        tensor,
-        op=ReduceOp.SUM,
-        timeout_s=None,
-        min_ranks: int | None = None,
-        grace_s: float | None = None,
-    ):
-        """``min_ranks=K`` enables partial K-of-N mode: the hub proceeds
-        once K contributions are in hand after ``grace_s`` past the
-        fastest arrival, returning PartialResult metadata; with the
-        default None the classic all-N path runs unchanged."""
-        meta: dict = {"op": op.value}
+    def _partial_meta(self, meta: dict, min_ranks, grace_s) -> dict:
         if min_ranks is not None:
             if not 1 <= int(min_ranks) <= self.world:
                 raise ValueError(
@@ -787,9 +983,9 @@ class CpuGroup:
             meta["min_ranks"] = int(min_ranks)
             if grace_s is not None:
                 meta["grace_s"] = float(grace_s)
-        out = await self._op(
-            "allreduce", np.asarray(tensor), timeout_s=timeout_s, **meta
-        )
+        return meta
+
+    def _wrap_partial(self, out, min_ranks):
         if min_ranks is not None and not isinstance(out, PartialResult):
             # Everyone made the grace window: same typed envelope, no
             # skips — callers in partial mode always see PartialResult.
@@ -800,6 +996,58 @@ class CpuGroup:
                 world=self.world,
             )
         return out
+
+    def _resolve_algo(self, algo: str | None, nbytes: int) -> str:
+        """None → the hub (the default data plane, byte-identical to
+        before algo= existed); "auto" → ring/tree by message size via
+        the crossover table; explicit names pass through validated."""
+        if algo is None:
+            return colalgo.HUB
+        if algo == colalgo.AUTO:
+            return colalgo.choose_algorithm(nbytes, self.world)
+        if algo not in (colalgo.HUB, colalgo.RING, colalgo.TREE):
+            raise ValueError(
+                f"cpu backend supports algo hub/ring/tree/auto, "
+                f"got {algo!r}"
+            )
+        return algo
+
+    async def allreduce(
+        self,
+        tensor,
+        op=ReduceOp.SUM,
+        timeout_s=None,
+        min_ranks: int | None = None,
+        grace_s: float | None = None,
+        compression: str | None = None,
+        algo: str | None = None,
+    ):
+        """``min_ranks=K`` enables partial K-of-N mode: the hub proceeds
+        once K contributions are in hand after ``grace_s`` past the
+        fastest arrival, returning PartialResult metadata; with the
+        default None the classic all-N path runs unchanged.
+
+        ``compression="int8"`` ships block-scaled int8 on the wire
+        (fp32 accumulation at the hub); ``algo=`` picks the data plane —
+        hub (default), ring, tree, or auto (crossover by size)."""
+        arr = np.asarray(tensor)
+        compression = codec.check_codec(compression)
+        chosen = self._resolve_algo(algo, arr.nbytes)
+        if chosen in (colalgo.RING, colalgo.TREE) and self.world > 1:
+            if min_ranks is not None:
+                raise ValueError(
+                    "partial mode (min_ranks=) requires the hub "
+                    "algorithm: ring/tree have no central grace timer"
+                )
+            return await self._algo_allreduce(
+                arr, op, chosen, timeout_s, compression
+            )
+        meta: dict = {"op": op.value}
+        if compression is not None:
+            meta["compression"] = compression
+        self._partial_meta(meta, min_ranks, grace_s)
+        out = await self._op("allreduce", arr, timeout_s=timeout_s, **meta)
+        return self._wrap_partial(out, min_ranks)
 
     async def reduce(self, tensor, root=0, op=ReduceOp.SUM, timeout_s=None):
         return await self._op(
@@ -812,19 +1060,249 @@ class CpuGroup:
             "broadcast", np.asarray(tensor), timeout_s=timeout_s, root=root
         )
 
-    async def allgather(self, tensor, timeout_s=None):
-        return await self._op(
-            "allgather", np.asarray(tensor), timeout_s=timeout_s
+    async def allgather(
+        self,
+        tensor,
+        timeout_s=None,
+        min_ranks: int | None = None,
+        grace_s: float | None = None,
+        compression: str | None = None,
+    ):
+        """Partial mode (``min_ranks=K``) returns the gathered list with
+        zero-filled entries for skipped ranks — the PartialResult's
+        ``skipped`` list, not the zeros, is the authoritative signal."""
+        meta: dict = {}
+        if codec.check_codec(compression) is not None:
+            meta["compression"] = compression
+        self._partial_meta(meta, min_ranks, grace_s)
+        out = await self._op(
+            "allgather", np.asarray(tensor), timeout_s=timeout_s, **meta
         )
+        return self._wrap_partial(out, min_ranks)
 
-    async def reducescatter(self, tensor, op=ReduceOp.SUM, timeout_s=None):
-        return await self._op(
-            "reducescatter", np.asarray(tensor), timeout_s=timeout_s,
-            op=op.value,
+    async def reducescatter(
+        self,
+        tensor,
+        op=ReduceOp.SUM,
+        timeout_s=None,
+        min_ranks: int | None = None,
+        grace_s: float | None = None,
+        compression: str | None = None,
+    ):
+        """Partial mode rescales SUM by world/K like allreduce; each
+        rank still receives its own chunk of the partial reduction."""
+        meta: dict = {"op": op.value}
+        if codec.check_codec(compression) is not None:
+            meta["compression"] = compression
+        self._partial_meta(meta, min_ranks, grace_s)
+        out = await self._op(
+            "reducescatter", np.asarray(tensor), timeout_s=timeout_s, **meta
         )
+        return self._wrap_partial(out, min_ranks)
 
     async def barrier(self, timeout_s=None):
         await self._op("barrier", None, timeout_s=timeout_s)
+
+    # ------------------------------------------- ring / tree algorithms
+    # Flat-ring and binomial-tree allreduce over the p2p mailbox ("The
+    # Big Send-off", arXiv:2504.18658): the ring moves 2(n-1)/n of the
+    # payload per rank across 2(n-1) latency-bound steps
+    # (bandwidth-optimal, wins for large messages); the tree moves the
+    # full payload across ~2*log2(n) rounds (latency-optimal, wins
+    # below the crossover size). Both compose with the int8 codec —
+    # every hop quantizes its payload and accumulates in fp32 after
+    # dequantizing.
+
+    async def _algo_allreduce(
+        self, arr, op, algo_name, timeout_s, compression
+    ):
+        self._check_alive("allreduce")
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
+        from ray_tpu._private.test_utils import straggler_delay_for_rank
+
+        delay = straggler_delay_for_rank(self.rank)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        wall_start = time.time()
+        t0 = time.perf_counter()
+        self._algo_seq += 1
+        tag_base = f"_{algo_name}{self._algo_seq}"
+        wire = [0]
+        run = (
+            self._ring_allreduce if algo_name == colalgo.RING
+            else self._tree_allreduce
+        )
+        try:
+            result = await asyncio.wait_for(
+                run(arr, op, tag_base, compression, wire), t
+            )
+        except asyncio.TimeoutError:
+            missing = sorted(set(range(self.world)) - {self.rank})
+            self._probe_missing(missing)
+            raise CollectiveTimeoutError(
+                self.base_name, "allreduce", t,
+                detail=f"{algo_name} algorithm starved waiting on a peer "
+                       "hop",
+            )
+        record_op(
+            self.base_name, "allreduce", "cpu", self.world, arr,
+            wall_start, time.perf_counter() - t0, wire_bytes=wire[0],
+        )
+        return result
+
+    async def _exchange(self, dst, src, tag, value, compression, wire):
+        """One algorithm hop: send ``value`` to ``dst`` while receiving
+        the same-tagged payload from ``src``; returns the received
+        array (dequantized when the codec is on)."""
+        packed = _pack(_compress(value, compression))
+        wire[0] += _packed_nbytes(packed)
+
+        async def _send():
+            conn = await self.core._connect(await self._peer_addr(dst))
+            await conn.call(
+                f"col_sendrecv:{self.name}",
+                src_rank=self.rank,
+                seq=tag,
+                payload=packed,
+            )
+
+        send_task = asyncio.ensure_future(_send())
+        try:
+            got = await self._p2p_recv(src, tag, wire)
+        except BaseException:
+            # Cancelled/timed out mid-hop: do not let the finally-await
+            # of a wedged send block the cancellation itself.
+            send_task.cancel()
+            raise
+        await send_task
+        return got
+
+    async def _peer_addr(self, rank: int) -> str:
+        addr = self._peer_addrs.get(rank)
+        if addr is None:
+            reply = await self.core.head.call(
+                "kv_get", key=f"collective:{self.name}:{rank}"
+            )
+            if not reply.get("ok"):
+                raise CollectiveMemberDiedError(
+                    self.base_name, "allreduce", dead_ranks=[rank],
+                    detail=f"rank {rank} left the rendezvous KV",
+                )
+            addr = reply["value"].decode()
+            self._peer_addrs[rank] = addr
+        return addr
+
+    async def _p2p_recv(self, src: int, tag, wire):
+        payloads, waiters = self._mail_queues((src, tag))
+        if payloads:
+            packed = payloads.popleft()
+        else:
+            fut = asyncio.get_running_loop().create_future()
+            waiters.append(fut)
+            packed = await fut  # outer wait_for bounds the whole op
+        # Algo tags are single-use: drop the drained queue entry so a
+        # long-lived group does not leak one mailbox slot per hop.
+        if not payloads and not waiters:
+            self._mailbox.pop((src, tag), None)
+        wire[0] += _packed_nbytes(packed)
+        got = _unpack(packed)
+        if codec.is_wire(got):
+            return codec.dequantize(codec.from_wire(got))
+        return got
+
+    async def _ring_allreduce(self, arr, op, tag_base, compression, wire):
+        """Flat ring: reduce-scatter (n-1 hops, each 1/n of the
+        payload) then all-gather (n-1 more). After hop s of the first
+        phase, chunk (rank-s-1) mod n holds the running reduction of
+        s+2 ranks; rank r ends owning the fully reduced chunk
+        (r+1) mod n."""
+        n, r = self.world, self.rank
+        combine = _COMBINERS[op]
+        acc_dtype = np.float32 if compression is not None else arr.dtype
+        flat = np.asarray(arr, acc_dtype).reshape(-1)
+        length = flat.size
+        chunk_len = max(1, -(-length // n))
+        padded = np.zeros(n * chunk_len, acc_dtype)
+        padded[:length] = flat
+        chunks = [
+            padded[i * chunk_len:(i + 1) * chunk_len].copy()
+            for i in range(n)
+        ]
+        right, left = (r + 1) % n, (r - 1) % n
+        for s in range(n - 1):
+            send_idx = (r - s) % n
+            recv_idx = (r - s - 1) % n
+            got = await self._exchange(
+                right, left, f"{tag_base}:rs{s}", chunks[send_idx],
+                compression, wire,
+            )
+            chunks[recv_idx] = combine(
+                chunks[recv_idx], np.asarray(got, acc_dtype)
+            )
+        for s in range(n - 1):
+            send_idx = (r + 1 - s) % n
+            recv_idx = (r - s) % n
+            got = await self._exchange(
+                right, left, f"{tag_base}:ag{s}", chunks[send_idx],
+                compression, wire,
+            )
+            chunks[recv_idx] = np.asarray(got, acc_dtype)
+        out = np.concatenate(chunks)[:length].reshape(arr.shape)
+        return out.astype(arr.dtype, copy=False)
+
+    async def _tree_allreduce(self, arr, op, tag_base, compression, wire):
+        """Binomial tree rooted at rank 0: reduce up (children with
+        lowbit m send to parent r-m), broadcast the result back down —
+        2*ceil(log2(n)) full-payload rounds, exponentially fewer
+        latency terms than the ring."""
+        n, r = self.world, self.rank
+        combine = _COMBINERS[op]
+        acc_dtype = np.float32 if compression is not None else arr.dtype
+        val = np.asarray(arr, acc_dtype).copy()
+        maxmask = 1 << max(0, (n - 1).bit_length())
+        lowbit = (r & -r) if r else maxmask
+        # Reduce: receive from my children (r+m for m < lowbit),
+        # smallest subtree first, then send the subtotal to my parent.
+        m = 1
+        while m < lowbit:
+            child = r + m
+            if child < n:
+                got = await self._p2p_recv(child, f"{tag_base}:r{m}", wire)
+                val = combine(val, np.asarray(got, acc_dtype))
+            m <<= 1
+        if r != 0:
+            packed = _pack(_compress(val, compression))
+            wire[0] += _packed_nbytes(packed)
+            conn = await self.core._connect(await self._peer_addr(r - lowbit))
+            await conn.call(
+                f"col_sendrecv:{self.name}",
+                src_rank=self.rank,
+                seq=f"{tag_base}:r{lowbit}",
+                payload=packed,
+            )
+            # Broadcast: the reduced total comes back from the parent.
+            got = await self._p2p_recv(
+                r - lowbit, f"{tag_base}:b{lowbit}", wire
+            )
+            val = np.asarray(got, acc_dtype)
+        # Relay down to my children, largest subtree first.
+        m = lowbit >> 1
+        while m >= 1:
+            child = r + m
+            if child < n:
+                packed = _pack(_compress(val, compression))
+                wire[0] += _packed_nbytes(packed)
+                conn = await self.core._connect(await self._peer_addr(child))
+                await conn.call(
+                    f"col_sendrecv:{self.name}",
+                    src_rank=self.rank,
+                    seq=f"{tag_base}:b{m}",
+                    payload=packed,
+                )
+            m >>= 1
+        return np.asarray(val, acc_dtype).reshape(arr.shape).astype(
+            arr.dtype, copy=False
+        )
 
     # ------------------------------------------------------- send / recv
     # Mailbox is a queue per (src, seq) so back-to-back sends with the

@@ -1,0 +1,209 @@
+"""Block-scaled int8 codec for compressed collectives.
+
+EQuARX (arXiv:2506.17615) shows a block-scaled quantized allreduce inside
+XLA recovers a near-2x communication speedup with negligible quality
+loss. This module is the codec both backends share:
+
+- **Quantization** is per-block absmax: the flat payload is padded to a
+  multiple of ``block`` elements, each block gets one fp32 scale
+  ``absmax / 127``, and values quantize to ``round(x / scale)`` clipped
+  to [-127, 127]. Bytes on the wire drop to
+  ``1 + 4/block`` per element vs 4 for f32 (~3.9x at block=256).
+- **Accumulation stays fp32**: reduction always dequantizes first, sums
+  in float32, then requantizes — int8 is a *wire* format, never an
+  accumulator (an int8 sum of K ranks would overflow at K=2).
+- **Error bound**: per element, ``|x - dq(q(x))| <= scale/2`` where
+  ``scale`` is that element's block scale — i.e. absmax(block)/254.
+  A reduce over K contributors with one requantize of the result is
+  bounded by ``sum_k scale_k/2 + scale_result/2``.
+
+The numpy half serializes to a plain dict (``to_wire``/``from_wire``) so
+it rides the existing collective RPC serializer; the jax half
+(:func:`quantize_jax` / :func:`dequantize_jax`) is shape-static and
+jit-safe so the XLA backends can compile it *around* their collectives
+(quantize → all_to_all/all_gather int8 → dequant) — the compiled shape
+never depends on the data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import numpy as np
+
+# Codec names accepted by the collective verbs' ``compression=`` kwarg.
+INT8 = "int8"
+CODECS = (INT8,)
+
+# Per-block element count for the absmax scales. 256 puts the scale
+# overhead at 4/256 = 1.6% of the int8 payload.
+DEFAULT_BLOCK = 256
+
+_QMAX = 127.0
+
+
+def check_codec(compression: str | None) -> str | None:
+    """Validate a ``compression=`` kwarg (None passes through)."""
+    if compression is None:
+        return None
+    if compression not in CODECS:
+        raise ValueError(
+            f"unknown compression {compression!r}; supported: {CODECS}"
+        )
+    return compression
+
+
+@dataclasses.dataclass
+class Quantized:
+    """One block-scaled int8 payload.
+
+    ``q`` is the padded flat int8 tensor ``(nblocks * block,)``;
+    ``scales`` the per-block fp32 scales ``(nblocks,)``; ``shape`` /
+    ``dtype`` restore the original array on dequantize."""
+
+    q: np.ndarray
+    scales: np.ndarray
+    shape: tuple
+    dtype: str
+    block: int = DEFAULT_BLOCK
+
+    @property
+    def wire_nbytes(self) -> int:
+        """Bytes this payload puts on the wire (int8 data + scales)."""
+        return int(self.q.nbytes + self.scales.nbytes)
+
+    @property
+    def logical_nbytes(self) -> int:
+        """Bytes the uncompressed payload would have moved."""
+        return int(
+            np.dtype(self.dtype).itemsize * math.prod(self.shape or (1,))
+        )
+
+    def max_error(self) -> float:
+        """Worst-case per-element round-trip error (absmax/254)."""
+        return float(self.scales.max(initial=0.0)) / 2.0
+
+
+def _blocks(flat: np.ndarray, block: int) -> np.ndarray:
+    n = flat.size
+    nblk = max(1, math.ceil(n / block))
+    padded = np.zeros(nblk * block, np.float32)
+    padded[:n] = flat
+    return padded.reshape(nblk, block)
+
+
+def quantize(
+    arr: Any, block: int = DEFAULT_BLOCK, out_dtype: str | None = None
+) -> Quantized:
+    """Block-scaled int8 quantization of any array-like (fp32 math)."""
+    a = np.asarray(arr)
+    shape, dtype = a.shape, str(out_dtype or a.dtype)
+    blocks = _blocks(a.astype(np.float32).reshape(-1), block)
+    scales = (np.max(np.abs(blocks), axis=1) / _QMAX).astype(np.float32)
+    safe = np.where(scales > 0, scales, 1.0).astype(np.float32)
+    q = np.clip(np.rint(blocks / safe[:, None]), -_QMAX, _QMAX).astype(
+        np.int8
+    )
+    return Quantized(
+        q=q.reshape(-1), scales=scales, shape=shape, dtype=dtype, block=block
+    )
+
+
+def dequantize(qt: Quantized, dtype: str | None = None) -> np.ndarray:
+    """Inverse of :func:`quantize`; accumulate-grade fp32 by default
+    (pass ``dtype`` to cast back to the original payload dtype)."""
+    blocks = qt.q.reshape(-1, qt.block).astype(np.float32)
+    flat = (blocks * qt.scales[:, None]).reshape(-1)
+    n = math.prod(qt.shape or (1,))
+    out = flat[:n].reshape(qt.shape)
+    return out.astype(dtype) if dtype is not None else out
+
+
+_WIRE_KEY = "__q8__"
+
+
+def to_wire(qt: Quantized) -> dict:
+    """Serializer-friendly dict (numpy leaves ride the buffer path)."""
+    return {
+        _WIRE_KEY: 1,
+        "q": qt.q,
+        "scales": qt.scales,
+        "shape": list(qt.shape),
+        "dtype": qt.dtype,
+        "block": qt.block,
+    }
+
+
+def is_wire(payload: Any) -> bool:
+    return isinstance(payload, dict) and _WIRE_KEY in payload
+
+
+def from_wire(d: dict) -> Quantized:
+    return Quantized(
+        q=np.asarray(d["q"], np.int8),
+        scales=np.asarray(d["scales"], np.float32),
+        shape=tuple(d["shape"]),
+        dtype=str(d["dtype"]),
+        block=int(d["block"]),
+    )
+
+
+# ------------------------------------------------------------------ jax
+# Shape-static codec for use INSIDE compiled programs (shard_map bodies).
+# Everything below is jit-safe: padded length and block count are
+# functions of the static input shape only, never the data.
+
+def padded_len(n: int, block: int = DEFAULT_BLOCK) -> int:
+    return max(1, math.ceil(n / block)) * block
+
+
+def quantize_jax(x, block: int = DEFAULT_BLOCK):
+    """``x`` (any shape) → ``(q int8 (nblk, block), scales f32 (nblk,))``."""
+    import jax.numpy as jnp
+
+    flat = x.astype(jnp.float32).reshape(-1)
+    total = padded_len(flat.shape[0], block)
+    flat = jnp.pad(flat, (0, total - flat.shape[0]))
+    blocks = flat.reshape(-1, block)
+    scales = jnp.max(jnp.abs(blocks), axis=1) / _QMAX
+    safe = jnp.where(scales > 0, scales, 1.0)
+    q = jnp.clip(jnp.round(blocks / safe[:, None]), -_QMAX, _QMAX).astype(
+        jnp.int8
+    )
+    return q, scales.astype(jnp.float32)
+
+
+def quantize_blocked_jax(blocks):
+    """``blocks (..., nblk, block)`` (already block-aligned, f32) →
+    ``(q int8 same shape, scales f32 (..., nblk))`` — the in-program
+    form the XLA backends use so the chunk axis survives for
+    all_to_all."""
+    import jax.numpy as jnp
+
+    scales = jnp.max(jnp.abs(blocks), axis=-1) / _QMAX
+    safe = jnp.where(scales > 0, scales, 1.0)
+    q = jnp.clip(
+        jnp.round(blocks / safe[..., None]), -_QMAX, _QMAX
+    ).astype(jnp.int8)
+    return q, scales.astype(jnp.float32)
+
+
+def dequantize_jax(q, scales):
+    """``(q (..., nblk, block), scales (..., nblk))`` → flat f32 of the
+    padded length (caller slices back to the logical size)."""
+    import jax.numpy as jnp
+
+    return (q.astype(jnp.float32) * scales[..., None]).reshape(
+        *q.shape[:-2], -1
+    )
+
+
+def wire_nbytes_jax(n_elements: int, block: int = DEFAULT_BLOCK) -> int:
+    """Wire bytes of one quantized payload of ``n_elements`` (int8 data
+    + fp32 scales) — the analytic size the XLA backends report, since a
+    compiled program's internal transfers cannot be measured from the
+    host."""
+    total = padded_len(n_elements, block)
+    return total + (total // block) * 4

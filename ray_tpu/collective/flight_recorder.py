@@ -34,8 +34,22 @@ OP_LATENCY = Histogram(
 )
 OP_BYTES = Counter(
     "ray_tpu_collective_bytes_total",
-    "per-rank payload bytes moved by collective ops",
+    "per-rank LOGICAL payload bytes moved by collective ops (the caller's "
+    "tensor size; see ray_tpu_collective_wire_bytes_total for what "
+    "actually crossed the wire)",
     tag_keys=("group", "verb", "dtype"),
+)
+WIRE_BYTES = Counter(
+    "ray_tpu_collective_wire_bytes_total",
+    "per-rank bytes this rank actually moved on the wire (compressed "
+    "codecs and multi-phase algorithms diverge from the logical size)",
+    tag_keys=("group", "verb", "dtype"),
+)
+COMPRESSION_RATIO = Gauge(
+    "ray_tpu_collective_compression_ratio",
+    "logical/wire byte ratio of the most recent collective op "
+    "(1.0 = uncompressed; ~3.9 for the block-256 int8 codec)",
+    tag_keys=("group", "verb"),
 )
 BUS_BANDWIDTH = Gauge(
     "ray_tpu_collective_bus_bandwidth_bytes_per_s",
@@ -58,11 +72,17 @@ PARTIAL_SKIPS = Counter(
 
 # verb → busbw factor as a function of world size (nccl-tests
 # performance docs); verbs without an entry (send/recv/permute/
-# broadcast/reduce) move each byte once → factor 1.
+# broadcast/reduce) move each byte once → factor 1. The hierarchical
+# two-level allreduce's aggregate traffic — ICI 2(m-1)/m * N plus DCN
+# 2(s-1)/s * N/m — sums to 2(n-1)/n * N for the two-slice split, same
+# as the flat convention; the op passes explicit wire_bytes= computed
+# from its actual (s, m) split, which bypasses this fallback entirely,
+# so the gauge stays honest for any slice shape.
 _BUS_FACTORS = {
     "allreduce": lambda n: 2.0 * (n - 1) / n,
     "allgather": lambda n: (n - 1) / n,
     "reducescatter": lambda n: (n - 1) / n,
+    "hier_allreduce": lambda n: 2.0 * (n - 1) / n if n > 1 else 1.0,
 }
 
 # --------------------------------------------------- span rate limiting
@@ -149,13 +169,21 @@ def record_op(
     start: float,
     dur: float,
     sample_rate: int | None = None,
+    wire_bytes: int | None = None,
 ) -> None:
     """Record one completed collective op (success path only — aborts
     and timeouts are counted by the fault-tolerance counters).
 
     ``sample_rate=N`` emits the timeline SPAN for 1-in-N ops (metrics
     are always recorded); with the default None, spans auto-sample at
-    1-in-100 once a (group, verb) exceeds 1 kHz of sub-ms ops."""
+    1-in-100 once a (group, verb) exceeds 1 kHz of sub-ms ops.
+
+    ``wire_bytes`` is what this rank ACTUALLY moved on the wire when it
+    differs from the logical payload size — compressed codecs,
+    multi-phase ring/tree algorithms, the hierarchical two-level op.
+    When given, the busbw gauge is computed from it directly
+    (wire/dur — no verb factor, honest for any algorithm) and the
+    logical/wire ratio lands in the compression-ratio gauge."""
     nbytes, dtype = payload_info(tensor)
     OP_LATENCY.observe(
         dur, tags={"group": group, "verb": verb, "backend": backend}
@@ -166,11 +194,23 @@ def record_op(
         OP_BYTES.inc(nbytes, tags=tags)
         attrs["bytes"] = nbytes
         attrs["dtype"] = dtype
+        if wire_bytes is not None:
+            WIRE_BYTES.inc(wire_bytes, tags=tags)
+            attrs["wire_bytes"] = int(wire_bytes)
+            if wire_bytes > 0:
+                ratio = nbytes / wire_bytes
+                COMPRESSION_RATIO.set(
+                    ratio, tags={"group": group, "verb": verb}
+                )
+                attrs["compression_ratio"] = round(ratio, 3)
         if dur > 0:
-            factor = _BUS_FACTORS.get(verb)
-            bus = (factor(world) if factor and world else 1.0) * (
-                nbytes / dur
-            )
+            if wire_bytes is not None and wire_bytes > 0:
+                bus = wire_bytes / dur
+            else:
+                factor = _BUS_FACTORS.get(verb)
+                bus = (factor(world) if factor and world else 1.0) * (
+                    nbytes / dur
+                )
             BUS_BANDWIDTH.set(bus, tags=tags)
             attrs["bus_bytes_per_s"] = round(bus, 1)
     emit, n = _span_sample(group, verb, dur, sample_rate)

@@ -368,6 +368,7 @@ class CompiledDAG:
             chan.close()
         try:
             ray_tpu.get(self._loop_refs, timeout=10)
+        # tpulint: allow(broad-except reason=teardown join: loop actors may already be dead or killed, which is exactly what teardown wants)
         except Exception:  # noqa: BLE001 - actors may already be dead
             pass
         import shutil
@@ -377,6 +378,7 @@ class CompiledDAG:
     def __del__(self):
         try:
             self.teardown()
+        # tpulint: allow(broad-except reason=__del__ during interpreter shutdown: modules may be half-torn-down and raising would print an unraisable-exception warning, not propagate)
         except Exception:  # noqa: BLE001 - interpreter shutdown
             pass
 
@@ -533,6 +535,7 @@ def _dag_actor_loop(
                         )
                 except ChannelClosed:
                     raise
+                # tpulint: allow(broad-except reason=the exception is captured as a typed _DagError and flows through the output channel to the caller, who re-raises it)
                 except Exception as e:  # noqa: BLE001 - flows to output
                     value = _DagError(e)
                 env[op["uid"]] = value
@@ -547,6 +550,7 @@ def _dag_actor_loop(
         for g in group_specs:
             try:
                 col.destroy_collective_group(g["name"])
+            # tpulint: allow(broad-except reason=loop teardown of per-execution groups; a poisoned or already-destroyed group raises typed errors with nothing left to clean)
             except Exception:  # noqa: BLE001
                 pass
     return {"ok": True}

@@ -24,7 +24,10 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import urllib.parse
+
+logger = logging.getLogger("ray_tpu.serve")
 
 from ray_tpu.serve.handle import (
     DeploymentHandle,
@@ -153,10 +156,14 @@ class ProxyActor:
             pass
         except Exception:  # noqa: BLE001 - never kill the accept loop
             self._stats["errors"] += 1
+            logger.warning(
+                "proxy connection handler crashed", exc_info=True
+            )
         finally:
             try:
                 writer.close()
                 await writer.wait_closed()
+            # tpulint: allow(broad-except reason=closing a client socket that may already be reset; the request outcome was decided above)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -312,6 +319,7 @@ class ProxyActor:
             self._stats["errors"] += 1
             await self._respond(writer, 408, b"request timed out", keep_alive)
             return keep_alive
+        # tpulint: allow(broad-except reason=the failure is propagated to the client as the 500 body and counted in proxy stats)
         except Exception as e:  # noqa: BLE001 - user/routing error → 500
             self._stats["errors"] += 1
             await self._respond(writer, 500, str(e).encode(), keep_alive)
@@ -438,6 +446,7 @@ class ProxyActor:
             # Client went away: stop the replica-side generator.
             await agen.aclose()
             return False
+        # tpulint: allow(broad-except reason=the failure reaches the client — as a 500 before the stream starts, as a terminal SSE error event mid-stream — and is counted in proxy stats)
         except Exception as e:  # noqa: BLE001
             self._stats["errors"] += 1
             await agen.aclose()

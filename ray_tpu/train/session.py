@@ -36,6 +36,10 @@ class TrainContext:
     allow_partial_grads: bool = False
     partial_min_fraction: float = 0.75
     partial_grace_s: float | None = None
+    # Compressed gradient sync (ScalingConfig.grad_compression): the
+    # codec name grad_sync_opts() forwards to the gradient collective
+    # ("int8" = block-scaled int8 wire format, fp32 accumulation).
+    grad_compression: str | None = None
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)
@@ -109,6 +113,20 @@ def partial_collective_opts(world: int | None = None) -> dict:
         "min_ranks": max(1, min(n, math.ceil(n * ctx.partial_min_fraction))),
         "grace_s": ctx.partial_grace_s,
     }
+
+
+def grad_sync_opts(world: int | None = None) -> dict:
+    """All gradient-sync kwargs this worker group was configured for —
+    the partial K-of-N opts (``allow_partial_grads``) merged with the
+    compression codec (``grad_compression``) — so train loops can write
+    ``col.allreduce(grads, **train.grad_sync_opts())`` unconditionally
+    and pick up both knobs. ``{}`` when neither is configured (the
+    collective then runs its classic byte-identical path)."""
+    opts = partial_collective_opts(world)
+    ctx = get_context()
+    if ctx.grad_compression:
+        opts["compression"] = ctx.grad_compression
+    return opts
 
 
 def note_partial_op(result) -> None:

@@ -10,6 +10,7 @@ ResultGrid). PBT exploitation uses the class-API save/restore path.
 from __future__ import annotations
 
 import inspect
+import logging
 import os
 import time
 import uuid
@@ -19,6 +20,8 @@ from typing import Any, Callable
 import ray_tpu
 from ray_tpu.tune import schedulers as S
 from ray_tpu.tune.search import DEFER, BasicVariantGenerator, Searcher
+
+logger = logging.getLogger("ray_tpu.tune")
 from ray_tpu.tune.trial import (
     ERROR,
     PENDING,
@@ -188,9 +191,7 @@ class _TuneController:
                 key = (id(cb), hook)
                 if key not in self._cb_warned:
                     self._cb_warned.add(key)
-                    import logging
-
-                    logging.getLogger("ray_tpu.tune").warning(
+                    logger.warning(
                         "callback %s.%s failed (suppressed): %r",
                         type(cb).__name__, hook, e,
                     )
@@ -237,12 +238,17 @@ class _TuneController:
                 trial.trial_id, trial.last_result if error is None else None
             )
         except Exception:  # noqa: BLE001 - searcher bugs must not kill the run
-            pass
+            logger.warning(
+                "searcher.on_trial_complete failed for %s; later "
+                "suggestions may ignore this result", trial.trial_id,
+                exc_info=True,
+            )
         if trial.actor is not None:
             try:
                 if trial.is_class_api:
                     ray_tpu.get(trial.actor.shutdown.remote())
                 ray_tpu.kill(trial.actor)
+            # tpulint: allow(broad-except reason=the trial actor is expected to be dead on the error path; a second kill has nothing to report)
             except Exception:  # noqa: BLE001 - actor may already be dead
                 pass
             trial.actor = None
@@ -307,6 +313,7 @@ class _TuneController:
         for t, ref in step_refs:
             try:
                 metrics = ray_tpu.get(ref)
+            # tpulint: allow(broad-except reason=the failure is recorded — the trial finishes in ERROR state carrying the stringified exception)
             except Exception as e:  # noqa: BLE001
                 self._finish(t, ERROR, error=str(e))
                 continue
@@ -345,6 +352,7 @@ class _TuneController:
         for t, ref in polls:
             try:
                 out = ray_tpu.get(ref)
+            # tpulint: allow(broad-except reason=the failure is recorded — the trial finishes in ERROR state carrying the stringified exception)
             except Exception as e:  # noqa: BLE001
                 self._finish(t, ERROR, error=str(e))
                 continue

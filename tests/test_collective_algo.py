@@ -1,0 +1,384 @@
+"""Topology-aware collective algorithm selection: crossover table,
+ring/tree data planes on the cpu backend, compiled ring lowering on the
+mesh backend, the hierarchical two-level ICI/DCN allreduce, and the
+adaptive partial-mode grace window.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import config as _config
+from ray_tpu.collective import algo as colalgo
+
+
+# -------------------------------------------------------- unit: selector
+def test_choose_algorithm_crossover():
+    """Tree below the per-world crossover, ring above; multi-slice
+    routes hierarchical; explicit override always wins."""
+    for world in (4, 8, 16):
+        xb = colalgo.crossover_bytes(world)
+        assert colalgo.choose_algorithm(xb - 1, world) == colalgo.TREE
+        assert colalgo.choose_algorithm(xb, world) == colalgo.RING
+    # Larger worlds amortize ring latency later → larger crossover.
+    assert colalgo.crossover_bytes(16) > colalgo.crossover_bytes(4)
+    # Two ranks degenerate to one exchange: always tree.
+    assert colalgo.choose_algorithm(1 << 30, 2) == colalgo.TREE
+    # Multi-slice topology: hierarchical regardless of size.
+    assert (
+        colalgo.choose_algorithm(1024, 8, n_slices=2)
+        == colalgo.HIERARCHICAL
+    )
+    # Explicit override short-circuits, bogus names are typed errors.
+    assert colalgo.choose_algorithm(1, 8, override="ring") == colalgo.RING
+    with pytest.raises(ValueError, match="unknown collective algo"):
+        colalgo.choose_algorithm(1, 8, override="nccl")
+
+
+def test_crossover_config_override():
+    """COLLECTIVE_ALGO_CROSSOVER: a flat byte count or per-world
+    entries replace the built-in table."""
+    try:
+        _config.set_system_config({"COLLECTIVE_ALGO_CROSSOVER": "4096"})
+        assert colalgo.crossover_bytes(8) == 4096
+        assert colalgo.choose_algorithm(8192, 8) == colalgo.RING
+        _config.set_system_config(
+            {"COLLECTIVE_ALGO_CROSSOVER": "2:1024,8:65536"}
+        )
+        assert colalgo.crossover_bytes(4) == 1024  # largest key <= world
+        assert colalgo.crossover_bytes(8) == 65536
+        assert colalgo.crossover_bytes(32) == 65536
+    finally:
+        _config.clear_system_config("COLLECTIVE_ALGO_CROSSOVER")
+    assert colalgo.crossover_bytes(8) == 256 << 10  # defaults restored
+
+
+def test_wire_bytes_per_rank():
+    """Analytic per-rank traffic: hub 2N, ring 2(n-1)/n N, tree
+    2·log2(n)·N, hierarchical ICI + DCN/m split."""
+    n, N = 8, 1 << 20
+    assert colalgo.wire_bytes_per_rank(colalgo.HUB, N, n) == 2 * N
+    assert colalgo.wire_bytes_per_rank(colalgo.RING, N, n) == int(
+        2 * 7 / 8 * N
+    )
+    assert colalgo.wire_bytes_per_rank(colalgo.TREE, N, n) == 6 * N
+    hier = colalgo.wire_bytes_per_rank(
+        colalgo.HIERARCHICAL, N, n, n_slices=2
+    )
+    m = n // 2
+    assert hier == int(2 * (m - 1) / m * N) + int(2 * (1 / 2) * (N / m))
+    # Compressed substitution prices the quantized payload.
+    assert colalgo.wire_bytes_per_rank(
+        colalgo.RING, N, n, compressed_nbytes=N // 4
+    ) == int(2 * 7 / 8 * N // 4)
+    assert colalgo.wire_bytes_per_rank(colalgo.RING, N, 1) == 0
+
+
+# ---------------------------------------------------------- cpu backend
+@pytest.fixture
+def cluster():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Member:
+    def setup(self, world, rank, group):
+        import ray_tpu.collective as col
+
+        col.init_collective_group(
+            world, rank, backend="cpu", group_name=group, timeout_s=30
+        )
+        return rank
+
+    def allreduce(self, group, arr, **kw):
+        import ray_tpu.collective as col
+
+        return np.asarray(col.allreduce(arr, group_name=group, **kw))
+
+    def stats(self, group):
+        import ray_tpu.collective as col
+
+        return col.straggler_stats(group)
+
+
+def _members(world, group):
+    ms = [Member.remote() for _ in range(world)]
+    ray_tpu.get(
+        [m.setup.remote(world, i, group) for i, m in enumerate(ms)],
+        timeout=30,
+    )
+    return ms
+
+
+def test_cpu_ring_tree_allreduce(cluster):
+    """Ring and binomial-tree data planes produce the hub's exact sum —
+    including a non-power-of-two world (tree handles ragged subtrees)
+    — and compose with the int8 codec."""
+    world = 3
+    ms = _members(world, "rt")
+    rng = np.random.default_rng(5)
+    arrs = [rng.normal(size=(1000,)).astype(np.float32) for _ in range(world)]
+    expect = arrs[0] + arrs[1] + arrs[2]
+    for algo in ("ring", "tree", "auto"):
+        outs = ray_tpu.get(
+            [
+                m.allreduce.remote("rt", arrs[i], algo=algo)
+                for i, m in enumerate(ms)
+            ],
+            timeout=30,
+        )
+        for o in outs:
+            np.testing.assert_allclose(o, expect, rtol=1e-5, err_msg=algo)
+    # MAX rides the pairwise combiners too.
+    from ray_tpu.collective.types import ReduceOp
+
+    outs = ray_tpu.get(
+        [
+            m.allreduce.remote("rt", arrs[i], algo="ring", op=ReduceOp.MAX)
+            for i, m in enumerate(ms)
+        ],
+        timeout=30,
+    )
+    np.testing.assert_allclose(
+        outs[0], np.max(np.stack(arrs), axis=0), rtol=1e-6
+    )
+    # Codec composes: every hop ships int8, accumulation is fp32.
+    outs = ray_tpu.get(
+        [
+            m.allreduce.remote(
+                "rt", arrs[i], algo="ring", compression="int8"
+            )
+            for i, m in enumerate(ms)
+        ],
+        timeout=30,
+    )
+    for o in outs:
+        np.testing.assert_allclose(
+            o, expect, atol=np.max(np.abs(expect)) * 0.05
+        )
+    # Partial mode stays a hub feature: typed rejection, not a hang.
+    with pytest.raises(Exception, match="hub"):
+        ray_tpu.get(
+            [
+                m.allreduce.remote(
+                    "rt", arrs[i], algo="ring", min_ranks=2
+                )
+                for i, m in enumerate(ms)
+            ],
+            timeout=30,
+        )
+
+
+def test_cpu_tree_allreduce_pow2(cluster):
+    world = 4
+    ms = _members(world, "t4")
+    arrs = [np.full((64,), float(i + 1), np.float32) for i in range(world)]
+    outs = ray_tpu.get(
+        [
+            m.allreduce.remote("t4", arrs[i], algo="tree")
+            for i, m in enumerate(ms)
+        ],
+        timeout=30,
+    )
+    for o in outs:
+        np.testing.assert_array_equal(o, np.full((64,), 10.0))
+
+
+# --------------------------------------------------------- mesh backend
+def test_mesh_ring_lowering_matches_psum():
+    """algo="ring" on the compiled backend lowers allreduce to
+    psum_scatter + all_gather — numerically identical to the one-shot
+    psum, with ring wire accounting."""
+    import jax
+
+    from ray_tpu.collective.backends.xla_group import XlaMeshGroup
+
+    world = len(jax.devices())
+    g = XlaMeshGroup(name="ringmesh")
+    rng = np.random.default_rng(6)
+    tensors = [
+        rng.normal(size=(33, 5)).astype(np.float32) for _ in range(world)
+    ]
+    expect = np.sum(tensors, axis=0)
+    ring = g.allreduce(tensors, algo="ring")
+    for o in ring:
+        np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-5)
+    assert g._last_wire_bytes == colalgo.wire_bytes_per_rank(
+        colalgo.RING, tensors[0].nbytes, world
+    )
+    tree = g.allreduce(tensors, algo="tree")
+    for o in tree:
+        np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-5)
+    # The cpu-only hub plane is a typed error on compiled backends.
+    with pytest.raises(ValueError, match="hub"):
+        g.allreduce(tensors, algo="hub")
+
+
+# ----------------------------------------------------- hierarchical (jax)
+def test_hierarchical_allreduce_matches_flat():
+    """Two-level ICI/DCN allreduce over 2 fake slices == flat psum (up
+    to fp32 reassociation), with the honest wire-byte record."""
+    import jax
+
+    from ray_tpu.collective import flight_recorder as fr
+    from ray_tpu.parallel.mesh import fake_slice_devices
+
+    devs = jax.devices()
+    n = len(devs)
+    assert n == 8
+    ms_devs = fake_slice_devices(2, devs)
+    rng = np.random.default_rng(7)
+    tensors = [
+        rng.normal(size=(1000,)).astype(np.float32) for _ in range(n)
+    ]
+    out = colalgo.hierarchical_allreduce(
+        tensors, devices=ms_devs, group="hier_t"
+    )
+    expect = np.sum(tensors, axis=0)
+    assert len(out) == n
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-4)
+    # Flat single-slice devices degenerate to the same result (dcn=1).
+    flat = colalgo.hierarchical_allreduce(
+        tensors, devices=devs, group="hier_t"
+    )
+    for o in flat:
+        np.testing.assert_allclose(np.asarray(o), expect, rtol=1e-4)
+    # The wire counter recorded the two-level split, not the flat
+    # convention.
+    tags = {"group": "hier_t", "verb": "hier_allreduce", "dtype": "float32"}
+    wire = fr.WIRE_BYTES.value(tags=tags)
+    assert wire is not None and wire > 0
+    with pytest.raises(ValueError, match="do not split"):
+        colalgo.hierarchical_allreduce(tensors, devices=devs, n_slices=3)
+
+
+# ------------------------------------------------- adaptive grace window
+def _stub_group():
+    import types
+
+    from ray_tpu.collective.backends.cpu_group import CpuGroup
+
+    core = types.SimpleNamespace(ext_handlers={}, addr="stub")
+    return CpuGroup(core, "ag", 2, 1, timeout_s=5.0)
+
+
+def test_adaptive_grace_from_lag_histogram():
+    """With enough full-op lag samples, the hub's grace window becomes
+    clamp(p99 * 1.5, min, max) — replacing the static default; below
+    the sample floor (or with the knob off) the static default holds."""
+    g = _stub_group()
+    static = _config.get("COLLECTIVE_PARTIAL_GRACE_S")
+    assert g._resolve_grace() == static  # no samples yet
+    # Tight group: p99 of ~20ms spread → clamped up to the min bound.
+    g._lag_samples.extend([0.02] * 40)
+    assert g._resolve_grace() == pytest.approx(
+        _config.get("COLLECTIVE_GRACE_MIN_S")
+    )
+    # Loose group: p99 of ~4s spread → 1.5x headroom, not the 1s static.
+    g._lag_samples.clear()
+    g._lag_samples.extend([4.0] * 40)
+    assert g._resolve_grace() == pytest.approx(6.0)
+    # Pathological spread clamps at the max.
+    g._lag_samples.extend([60.0] * 40)
+    assert g._resolve_grace() == _config.get("COLLECTIVE_GRACE_MAX_S")
+    # Knob off → static default regardless of samples.
+    try:
+        _config.set_system_config({"COLLECTIVE_ADAPTIVE_GRACE": "0"})
+        assert g._resolve_grace() == static
+    finally:
+        _config.clear_system_config("COLLECTIVE_ADAPTIVE_GRACE")
+    # The derived window is visible in straggler_stats.
+    stats = g.straggler_stats()
+    assert stats["adaptive_grace_s"] == _config.get(
+        "COLLECTIVE_GRACE_MAX_S"
+    )
+    assert stats["lag_p99_s"] == pytest.approx(60.0)
+
+
+def test_partial_reducescatter_allgather_rescale(cluster):
+    """Carried PR-6 follow-up: min_ranks/grace_s on reducescatter (SUM
+    rescaled by world/K, per-rank chunks) and allgather (zero-filled
+    skipped slots), with the straggler rejoining through the per-rank
+    tombstone."""
+    import os
+
+    @ray_tpu.remote
+    class P:
+        def setup(self, world, rank, group, env=None):
+            import ray_tpu.collective as col
+
+            os.environ.update(env or {})
+            col.init_collective_group(
+                world, rank, backend="cpu", group_name=group, timeout_s=30
+            )
+            return rank
+
+        def rs(self, group, value, **kw):
+            import ray_tpu.collective as col
+
+            out = col.reducescatter(
+                np.full((6,), value, np.float32), group_name=group, **kw
+            )
+            return {
+                "v": np.asarray(out.value).tolist(),
+                "skipped": out.skipped,
+            }
+
+        def ag(self, group, value, **kw):
+            import ray_tpu.collective as col
+
+            out = col.allgather(
+                np.full((2,), value, np.float32), group_name=group, **kw
+            )
+            return {
+                "v": [np.asarray(v).tolist() for v in out.value],
+                "skipped": out.skipped,
+            }
+
+        def stats(self, group):
+            import ray_tpu.collective as col
+
+            return col.straggler_stats(group)
+
+    world = 3
+    ms = [P.remote() for _ in range(world)]
+    ray_tpu.get(
+        [
+            m.setup.remote(
+                world, i, "prs",
+                {"RAY_TPU_STRAGGLER_DELAY": "2:2.0"} if i == 2 else None,
+            )
+            for i, m in enumerate(ms)
+        ],
+        timeout=30,
+    )
+    refs = [
+        m.rs.remote("prs", float(i + 1), min_ranks=2, grace_s=0.3)
+        for i, m in enumerate(ms)
+    ]
+    fast = ray_tpu.get(refs[:2], timeout=30)
+    # (1+2) * world/K = 4.5 per element; rank r gets its 2-element chunk.
+    for i, o in enumerate(fast):
+        assert o["skipped"] == [2]
+        assert o["v"] == pytest.approx([4.5, 4.5])
+    late = ray_tpu.get(refs[2], timeout=30)  # tombstone rejoin, own chunk
+    assert late["skipped"] == [2]
+    assert late["v"] == pytest.approx([4.5, 4.5])
+
+    refs = [
+        m.ag.remote("prs", float(i + 1), min_ranks=2, grace_s=0.3)
+        for i, m in enumerate(ms)
+    ]
+    fast = ray_tpu.get(refs[:2], timeout=30)
+    for o in fast:
+        assert o["skipped"] == [2]
+        assert o["v"] == [[1.0, 1.0], [2.0, 2.0], [0.0, 0.0]]
+    late = ray_tpu.get(refs[2], timeout=30)
+    assert late["v"] == [[1.0, 1.0], [2.0, 2.0], [0.0, 0.0]]
+    # Skips of BOTH kinds fed the straggler stats on the hub.
+    stats = ray_tpu.get(ms[0].stats.remote("prs"), timeout=30)
+    assert stats["partial_ops"] >= 2
+    assert stats["skip_counts"].get(2, 0) >= 2

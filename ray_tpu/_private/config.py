@@ -179,6 +179,22 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                  "ranks sleep that long before every "
                                  "contribution (deterministic straggler "
                                  "injection, cpu backend)"),
+    "SLICE_FAIL": (str, "", "chaos spec: comma-separated 'slice:when' — "
+                            "'1:0.5' delays every rank of slice 1 by "
+                            "0.5s per op (a whole-slice straggler); "
+                            "'1:kill' / '1:kill@2' SIGKILLs every rank "
+                            "of slice 1 (after 2s). The hierarchical "
+                            "allreduce treats a killed slice as dead "
+                            "(skipped in partial mode) and a delayed "
+                            "slice as late"),
+    "SLICE_FAULT_DOMAINS": (bool, True, "treat a slice as the unit of "
+                                        "failure: a drain notice or "
+                                        "unexpected death of any host "
+                                        "of a slice drains the WHOLE "
+                                        "slice, and the autoscaler "
+                                        "provisions one replacement "
+                                        "slice per draining slice "
+                                        "instead of per node"),
     "COLLECTIVE_SKIP_DRAIN_THRESHOLD": (int, 10, "partial-collective "
                                                  "skips of one rank "
                                                  "within the sliding "

@@ -43,6 +43,129 @@ def straggler_delay_for_rank(rank: int) -> float:
     return parse_straggler_spec(spec).get(rank, 0.0)
 
 
+def parse_slice_fail_spec(spec: str) -> "dict[int, tuple[str, float]]":
+    """Parse the RAY_TPU_SLICE_FAIL chaos spec (same comma-separated
+    env-spec family as RAY_TPU_STRAGGLER_DELAY): ``"slice:when[,…]"``
+    where ``when`` is either
+
+    - a float — every rank of that slice is delayed that many seconds
+      per op (the whole slice becomes a straggler): ``("delay", s)``;
+    - ``kill`` or ``kill@<after_s>`` — every rank of that slice is
+      SIGKILLed (after ``after_s`` seconds from the first chaos check):
+      ``("kill", after_s)``.
+
+    ``"1:0.5"`` makes slice 1 half a second late to every collective;
+    ``"1:kill@2"`` takes slice 1 down two seconds in. Malformed entries
+    are ignored (chaos must never crash the op)."""
+    out: "dict[int, tuple[str, float]]" = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        sl, _, when = entry.partition(":")
+        try:
+            idx = int(sl)
+        except ValueError:
+            continue
+        when = when.strip()
+        if when.startswith("kill"):
+            _, _, after = when.partition("@")
+            try:
+                out[idx] = ("kill", float(after) if after else 0.0)
+            except ValueError:
+                continue
+        else:
+            try:
+                out[idx] = ("delay", float(when))
+            except ValueError:
+                continue
+    return out
+
+
+def slice_fail_action(slice_index: int) -> "tuple[str, float] | None":
+    """This slice's injected failure (None = healthy). Read per call so
+    tests can flip RAY_TPU_SLICE_FAIL at runtime."""
+    from ray_tpu._private import config
+
+    spec = config.get("SLICE_FAIL")
+    if not spec:
+        return None
+    return parse_slice_fail_spec(spec).get(int(slice_index))
+
+
+# First time THIS process consulted the slice-fail clock: 'kill@2' means
+# two seconds after the process first checks, so every rank of the slice
+# dies deterministically relative to its own participation, not boot.
+_slice_fail_t0: "float | None" = None
+
+
+def maybe_fail_slice(slice_index: "int | None" = None) -> None:
+    """Apply the RAY_TPU_SLICE_FAIL action for this process's slice:
+    sleep for a "delay" spec, SIGKILL ourselves for a "kill" spec whose
+    ``after_s`` has elapsed. ``slice_index`` defaults to this process's
+    own slice (train context slice label, else the node's "slice"
+    label); a process that cannot resolve its slice is never failed.
+    Train loops under slice-chaos tests call this once per step — the
+    in-process analogue of GCE reaping every host of the slice at
+    once."""
+    global _slice_fail_t0
+    if slice_index is None:
+        slice_index = own_slice_index()
+    if slice_index is None:
+        return
+    action = slice_fail_action(slice_index)
+    if action is None:
+        return
+    kind, val = action
+    if kind == "delay":
+        time.sleep(val)
+        return
+    if _slice_fail_t0 is None:
+        _slice_fail_t0 = time.monotonic()
+    if time.monotonic() - _slice_fail_t0 >= val:
+        import os
+
+        sigkill_pid(os.getpid())
+
+
+def own_slice_index() -> "int | None":
+    """This process's slice index: the train context's slice label when
+    inside a train loop, else this node's "slice" label via the head
+    node table. None when unresolvable (no chaos applies)."""
+    label = None
+    try:
+        from ray_tpu.train import session
+
+        ctx = session._context
+        if ctx is not None and ctx.slice_label:
+            label = ctx.slice_label
+    # tpulint: allow(broad-except reason=chaos helper - a process without a train session simply falls through to the node-label lookup)
+    except Exception:
+        label = None
+    if label is None:
+        try:
+            import ray_tpu.api as api
+
+            rt = api._runtime
+            node_addr = getattr(rt.core, "node_addr", None)
+            if not node_addr:
+                return None
+            table = rt.run(rt.core.head.call("node_table"), 5)
+            for n in table.values():
+                if n.get("addr") == node_addr:
+                    label = (n.get("labels") or {}).get("slice")
+                    break
+        # tpulint: allow(broad-except reason=chaos helper - an unresolvable slice means no chaos applies, never an op failure)
+        except Exception:
+            return None
+    if label is None:
+        return None
+    try:
+        return int(str(label).lstrip("s"))
+    except ValueError:
+        return None
+
+
 def parse_preempt_spec(spec: str) -> "tuple[float, str]":
     """Parse the RAY_TPU_PREEMPT_AFTER_S chaos spec (same env-spec
     family as RAY_TPU_RPC_FAILURE): ``"<delay_s>[@<substr>]"`` — a

@@ -136,6 +136,9 @@ class Autoscaler:
         try:
             return rt.run(go()).get("nodes") or {}
         except Exception:  # noqa: BLE001 - telemetry must not stop ticks
+            logger.debug(
+                "straggler stats unavailable this tick", exc_info=True
+            )
             return {}
 
     def _check_stragglers(
@@ -181,6 +184,10 @@ class Autoscaler:
         try:
             return bool(rt.run(go()).get("ok"))
         except Exception:  # noqa: BLE001 - retried next tick
+            logger.warning(
+                "drain request for node %s failed; retrying next tick",
+                node_id[:12], exc_info=True,
+            )
             return False
 
     def _node_type_for(self, node_id: str, node: dict) -> str | None:
@@ -198,26 +205,39 @@ class Autoscaler:
                 return name
         return None
 
+    @staticmethod
+    def _drain_unit(nid: str, node: dict) -> str:
+        """Replacement-dedupe key for a draining node: its SLICE label
+        when it has one (the provider's create_node provisions a whole
+        slice, so a slice going away buys exactly ONE launch however
+        many hosts it has), else the node itself."""
+        slice_id = (node.get("labels") or {}).get("slice")
+        return f"slice:{slice_id}" if slice_id else nid
+
     def _handle_draining(
         self, draining: dict, nodes: dict, counts: dict[str, int]
     ) -> None:
         """Act on drain notices: (1) proactively provision a replacement
-        per draining node — the whole point of the notice window is that
-        the replacement boots WHILE the old node finishes its work — and
-        (2) terminate provider-owned drained nodes once they are empty
-        or past their deadline."""
+        per draining FAULT UNIT — one launch per draining slice (all its
+        hosts drain together under slice fault domains; the replacement
+        slice boots as a unit WHILE the old one finishes its work),
+        else per node — and (2) terminate provider-owned drained nodes
+        once they are empty or past their deadline."""
         now_wall = time.time()
         for nid, dinfo in draining.items():
-            if nid in self._drain_replaced:
+            unit = self._drain_unit(nid, nodes.get(nid, {}))
+            if unit in self._drain_replaced:
                 continue
-            self._drain_replaced.add(nid)
+            self._drain_replaced.add(unit)
             ntype = self._node_type_for(nid, nodes.get(nid, {}))
             if ntype is None:
                 continue
             if counts.get(ntype, 0) < self.node_types[ntype].max_workers:
                 logger.info(
-                    "node %s draining (%s): provisioning a replacement "
-                    "%s", nid[:12], dinfo.get("reason", ""), ntype,
+                    "%s draining (%s): provisioning a replacement %s",
+                    unit if unit.startswith("slice:")
+                    else f"node {nid[:12]}",
+                    dinfo.get("reason", ""), ntype,
                 )
                 self._launch(ntype)
                 counts[ntype] = counts.get(ntype, 0) + 1
@@ -241,8 +261,10 @@ class Autoscaler:
                     self.provider.terminate_node(pid)
                 finally:
                     del self._tracked[pid]
-        # Forget replacement markers for nodes no longer draining/alive.
-        self._drain_replaced &= set(draining)
+        # Forget replacement markers for units no longer draining/alive.
+        self._drain_replaced &= {
+            self._drain_unit(nid, nodes.get(nid, {})) for nid in draining
+        }
 
     def update(self):
         """One reconcile tick (public for deterministic tests)."""

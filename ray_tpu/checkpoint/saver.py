@@ -344,8 +344,12 @@ class AsyncCheckpointer:
 
     # -------------------------------------------------------- replicate
     def _pick_peers(self, rt, own_addr: str) -> list[str]:
-        """R-1 peer node addrs, preferring different-slice, non-draining
-        nodes (a replica on the same slice dies with the original)."""
+        """R-1 peer node addrs across DISTINCT slices: a replica on the
+        same slice as another copy dies with it under whole-slice
+        preemption, so the first R-1 picks cover R-1 different slices
+        when the cluster has them (one peer per slice, round-robin),
+        before doubling up within a slice; same-slice-as-us and
+        draining nodes come last."""
         try:
             status = rt.run(rt.core.head.call("cluster_status"))
         except Exception as e:  # noqa: BLE001 - degraded head: local-only
@@ -357,7 +361,9 @@ class AsyncCheckpointer:
         for nid, n in nodes.items():
             if n.get("addr") == own_addr:
                 own_slice = (n.get("labels") or {}).get("slice")
-        fresh, fallback = [], []
+        # slice label (or per-node singleton domain) → fresh addrs
+        by_slice: dict[str, list[str]] = {}
+        fallback = []
         for nid, n in nodes.items():
             addr = n.get("addr")
             if not addr or addr == own_addr:
@@ -368,10 +374,22 @@ class AsyncCheckpointer:
             elif own_slice is not None and labels.get("slice") == own_slice:
                 fallback.append(addr)
             else:
-                fresh.append(addr)
+                domain = labels.get("slice") or f"node:{addr}"
+                by_slice.setdefault(domain, []).append(addr)
+        # Interleave one addr per slice per round: the first R-1 picks
+        # maximize slice diversity by construction.
+        fresh: list[str] = []
+        rounds = [sorted(by_slice[d]) for d in sorted(by_slice)]
+        while rounds:
+            next_rounds = []
+            for addrs in rounds:
+                fresh.append(addrs.pop(0))
+                if addrs:
+                    next_rounds.append(addrs)
+            rounds = next_rounds
         # Deterministic per-rank rotation spreads replica load across the
         # cluster instead of every rank hammering the same peer.
-        candidates = sorted(fresh) + sorted(fallback)
+        candidates = fresh + sorted(fallback)
         if candidates:
             shift = self.rank % len(candidates)
             candidates = candidates[shift:] + candidates[:shift]

@@ -278,10 +278,27 @@ def _reformable(e: Exception) -> bool:
 def straggler_stats(group_name: str = "default") -> dict:
     """Per-rank slowest-contributor telemetry (hub rank only; other
     ranks see zeros). Chronic stragglers show up here — and in the
-    collective_straggler_* metrics — before they become timeouts."""
-    g = get_group(group_name)
+    collective_straggler_* metrics — before they become timeouts.
+
+    ``slice_skip_counts`` merges the hierarchical allreduce's per-slice
+    DCN skip counts (slice index → skips) when ``group_name`` names a
+    hierarchical op's group — that op is driver-side and needs no
+    init_collective_group, so the group object may not exist."""
+    from ray_tpu.collective import algo as _algo
+
+    slice_skips = _algo.slice_skip_stats(group_name)
+    g = _groups.get(group_name)
+    if g is None:
+        if slice_skips:
+            return {"slice_skip_counts": slice_skips}
+        raise ValueError(
+            f"collective group {group_name!r} not initialized"
+        )
     fn = getattr(g, "straggler_stats", None)
-    return fn() if fn is not None else {}
+    out = dict(fn()) if fn is not None else {}
+    if slice_skips:
+        out["slice_skip_counts"] = slice_skips
+    return out
 
 
 def get_group(group_name: str = "default"):

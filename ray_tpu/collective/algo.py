@@ -146,9 +146,109 @@ def wire_bytes_per_rank(
 # ------------------------------------------------- hierarchical (jax)
 _HIER_PROGRAMS: dict[tuple, Any] = {}
 
+# Per-slice DCN skip bookkeeping for the hierarchical partial op: the
+# analogue of the cpu hub's per-rank skip window, at slice granularity.
+# A slice chronically skipped on the DCN hop escalates to the head
+# (collective_slice_report) which drains the WHOLE slice — feeding the
+# same drain-and-replace path the rank-level chronic-skip signal uses.
+import threading as _threading
+
+_slice_lock = _threading.Lock()
+_slice_skips: dict[str, dict[int, int]] = {}         # group → slice → total
+_slice_skip_events: dict[str, list] = {}             # group → [(ts, slice)]
+_slice_reported: dict[str, set] = {}                 # group → reported slices
+
+
+def slice_skip_stats(group: str = "hier") -> dict[int, int]:
+    """Per-slice DCN-hop skip counts of the hierarchical partial
+    allreduce for ``group`` (merged into
+    ``collective.straggler_stats()`` as ``slice_skip_counts``)."""
+    with _slice_lock:
+        return dict(_slice_skips.get(group, {}))
+
+
+def _note_slice_skips(group: str, skipped: Sequence[int]) -> None:
+    """Count skips, slide the escalation window, and report a slice
+    whose skip rate crossed the chronic threshold to the head (which
+    drains the whole slice). Fire-and-forget: telemetry and escalation
+    must never fail the op."""
+    import time as _time
+
+    from ray_tpu._private import config
+
+    window = config.get("COLLECTIVE_SKIP_WINDOW_S")
+    threshold = config.get("COLLECTIVE_SKIP_DRAIN_THRESHOLD")
+    now = _time.monotonic()
+    chronic: list[tuple[int, int]] = []
+    with _slice_lock:
+        counts = _slice_skips.setdefault(group, {})
+        events = _slice_skip_events.setdefault(group, [])
+        reported = _slice_reported.setdefault(group, set())
+        for si in skipped:
+            counts[si] = counts.get(si, 0) + 1
+            events.append((now, si))
+        cutoff = now - window
+        events[:] = [e for e in events if e[0] >= cutoff]
+        in_window: dict[int, int] = {}
+        for _ts, si in events:
+            in_window[si] = in_window.get(si, 0) + 1
+        for si, cnt in in_window.items():
+            if cnt >= threshold and si not in reported:
+                reported.add(si)
+                chronic.append((si, cnt))
+    if not chronic:
+        return
+    try:
+        import ray_tpu.api as _api
+
+        rt = _api._runtime
+        if not rt.ready:
+            return
+        for si, cnt in chronic:
+            rt.run(
+                rt.core.head.call(
+                    "collective_slice_report",
+                    group=group,
+                    slice_id=str(si),
+                    skips=int(cnt),
+                    window_s=float(window),
+                )
+            )
+    # tpulint: allow(broad-except reason=escalation is advisory; without a runtime or a new-enough head the skip metrics still carry the signal)
+    except Exception:
+        pass
+
 
 def _slice_count(devices: Sequence) -> int:
     return len({getattr(d, "slice_index", 0) for d in devices})
+
+
+def hier_dcn_wire_bytes(
+    length: int,
+    itemsize: int,
+    world: int,
+    n_slices: int,
+    block: int | None = None,
+) -> int:
+    """Per-rank bytes the hierarchical allreduce's DCN hop moves.
+
+    Uncompressed: the inter-slice allreduce of the 1/m shard,
+    ``2(s-1)/s * length/m * itemsize``. With ``block`` (the int8 codec
+    on the DCN hop only): int8 data + 1/block fp32 scales through the
+    all_to_all + all_gather pair."""
+    s = max(1, int(n_slices))
+    n = max(1, int(world))
+    m = max(1, n // s)
+    if s <= 1:
+        return 0
+    shard_len = max(1, math.ceil(max(1, length) / m))
+    if block is None:
+        return int(2 * (s - 1) / s * shard_len * itemsize)
+    from ray_tpu.collective import codec
+
+    chunk_len = codec.padded_len(-(-shard_len // s), block)
+    q_payload = s * (chunk_len + (chunk_len // block) * 4)
+    return int(2 * (s - 1) / s * q_payload)
 
 
 def hierarchical_allreduce(
@@ -156,6 +256,10 @@ def hierarchical_allreduce(
     devices: Sequence | None = None,
     n_slices: int | None = None,
     group: str = "hier",
+    min_slices: int | None = None,
+    grace_s: float | None = None,
+    skip_slices: Sequence[int] | None = None,
+    compression: str | None = None,
 ):
     """Two-level allreduce over a multi-slice device set.
 
@@ -170,7 +274,32 @@ def hierarchical_allreduce(
     so the DCN hop moves ``1/m`` of the payload per rank. Single-slice
     inputs degenerate to a flat psum (same program shape, dcn axis of
     size 1). Returns the per-device reduced tensors, numerically equal
-    to a flat allreduce up to fp32 reassociation."""
+    to a flat allreduce up to fp32 reassociation.
+
+    **DCN-partial mode** (``min_slices=`` / ``skip_slices=``): the
+    slice is the failure unit — the intra-slice ICI reduce-scatter and
+    all-gather stay EXACT, and the PR-6 masked-partial semantics apply
+    only to the inter-slice DCN reduce: a dead or slow slice
+    contributes weight 0 and the sum is rescaled by ``S/Σw`` so the
+    mean over contributing slices is preserved. Returns a typed
+    :class:`PartialResult` whose ``contributed``/``skipped`` lists name
+    SLICE indices (``world`` = number of slices). ``skip_slices`` is
+    the explicit dead set (drain notices, external health signals);
+    the ``RAY_TPU_SLICE_FAIL`` chaos knob adds deterministic failures —
+    a "kill"-failed slice is treated as dead, a delayed slice is
+    skipped when its delay exceeds ``grace_s`` (config
+    COLLECTIVE_PARTIAL_GRACE_S when None). Fewer than ``min_slices``
+    surviving slices raises :class:`CollectiveTimeoutError`. Skips
+    feed per-slice DCN metrics, ``slice_skip_stats()``, and — past the
+    chronic threshold — a ``collective_slice_report`` to the head,
+    which drains the whole slice.
+
+    **Compressed DCN hop** (``compression="int8"``): the block-scaled
+    int8 codec applies to the inter-slice exchange ONLY — the slow DCN
+    link moves int8 + per-block scales (quantize → all_to_all →
+    fp32 accumulate → S/Σw rescale → requantize → all_gather) while
+    both ICI hops stay exact f32. Composes with partial mode inside
+    the same compiled program."""
     import time
 
     import jax
@@ -178,7 +307,16 @@ def hierarchical_allreduce(
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from ray_tpu._private.jax_compat import shard_map
-    from ray_tpu.collective.flight_recorder import record_op
+    from ray_tpu.collective import codec
+    from ray_tpu.collective.flight_recorder import (
+        record_dcn_slices,
+        record_op,
+        record_partial,
+    )
+    from ray_tpu.collective.types import (
+        CollectiveTimeoutError,
+        PartialResult,
+    )
 
     if devices is None:
         devices = jax.devices()
@@ -193,6 +331,49 @@ def hierarchical_allreduce(
     if n % s:
         raise ValueError(f"{n} devices do not split into {s} slices")
     m = n // s
+    compression = codec.check_codec(compression)
+    partial = min_slices is not None or skip_slices is not None
+
+    # Dead/slow slice set: explicit skips, then the chaos knob. A
+    # "kill"-failed slice is dead (the in-process analogue of GCE
+    # reaping all its hosts); a delayed slice is skipped when its delay
+    # exceeds the grace window in partial mode — otherwise the op pays
+    # the stall, which is exactly what partial mode exists to avoid.
+    skipped = sorted({int(si) for si in (skip_slices or ())})
+    from ray_tpu._private import config as _config
+    from ray_tpu._private.test_utils import slice_fail_action
+
+    if _config.get("SLICE_FAIL"):
+        grace = (
+            float(grace_s) if grace_s is not None
+            else _config.get("COLLECTIVE_PARTIAL_GRACE_S")
+        )
+        stall = 0.0
+        for si in range(s):
+            if si in skipped:
+                continue
+            action = slice_fail_action(si)
+            if action is None:
+                continue
+            kind, val = action
+            if kind == "kill" or (partial and val > grace):
+                skipped = sorted(set(skipped) | {si})
+                partial = True
+            elif kind == "delay":
+                stall = max(stall, val)
+        if stall > 0:
+            time.sleep(stall)
+    if partial:
+        contributed_slices = [si for si in range(s) if si not in skipped]
+        if len(contributed_slices) < max(1, int(min_slices or 1)):
+            raise CollectiveTimeoutError(
+                group,
+                "hier_allreduce",
+                grace_s,
+                missing_ranks=skipped,
+                detail=f"only {len(contributed_slices)} of {s} slices "
+                       f"contribute, below min_slices {min_slices}",
+            )
     # Runtime devices (unwrap fake-slice shims so device_put accepts them).
     runtime = [getattr(d, "_raytpu_device", d) for d in devices]
 
@@ -211,39 +392,163 @@ def hierarchical_allreduce(
         [jax.device_put(a, d) for a, d in zip(arrs, runtime)],
     )
 
-    key = (s, m, x.shape, str(dtype), tuple(d.id for d in runtime))
+    block = (
+        int(_config.get("COLLECTIVE_COMPRESSION_BLOCK"))
+        if compression is not None
+        else None
+    )
+    key = (
+        s, m, x.shape, str(dtype), tuple(d.id for d in runtime),
+        partial, block,
+    )
     prog = _HIER_PROGRAMS.get(key)
     if prog is None:
-
-        def fn(v):
-            flat = v.reshape(-1)
-            flat = jnp.pad(flat, (0, pad_to - length))
-            shard = jax.lax.psum_scatter(
-                flat, "ici", scatter_dimension=0, tiled=True
+        if partial or compression is not None:
+            prog = jax.jit(
+                shard_map(
+                    _hier_masked_fn(s, m, length, pad_to, block),
+                    mesh=mesh,
+                    in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))),
+                    out_specs=P(("dcn", "ici")),
+                )
             )
-            shard = jax.lax.psum(shard, "dcn")
-            full = jax.lax.all_gather(shard, "ici", axis=0, tiled=True)
-            return full[:length].reshape(v.shape)
+        else:
+            # Classic exact path: untouched program, byte-identical to
+            # before partial/compression existed (int dtypes included).
+            def fn(v):
+                flat = v.reshape(-1)
+                flat = jnp.pad(flat, (0, pad_to - length))
+                shard = jax.lax.psum_scatter(
+                    flat, "ici", scatter_dimension=0, tiled=True
+                )
+                shard = jax.lax.psum(shard, "dcn")
+                full = jax.lax.all_gather(
+                    shard, "ici", axis=0, tiled=True
+                )
+                return full[:length].reshape(v.shape)
 
-        mapped = shard_map(
-            fn,
-            mesh=mesh,
-            in_specs=P(("dcn", "ici")),
-            out_specs=P(("dcn", "ici")),
-        )
-        prog = _HIER_PROGRAMS[key] = jax.jit(mapped)
+            prog = jax.jit(
+                shard_map(
+                    fn,
+                    mesh=mesh,
+                    in_specs=P(("dcn", "ici")),
+                    out_specs=P(("dcn", "ici")),
+                )
+            )
+        _HIER_PROGRAMS[key] = prog
         if len(_HIER_PROGRAMS) > 64:
             _HIER_PROGRAMS.pop(next(iter(_HIER_PROGRAMS)))
-    out = prog(x)
+    if partial or compression is not None:
+        if not jnp.issubdtype(dtype, jnp.inexact):
+            raise TypeError(
+                f"partial/compressed hierarchical allreduce needs a "
+                f"floating dtype, got {dtype}"
+            )
+        w = np.ones((n,), dtype=np.dtype(dtype).name)
+        for si in skipped:
+            w[si * m:(si + 1) * m] = 0
+        wx = jax.make_array_from_single_device_arrays(
+            (n,), sharding,
+            [
+                jax.device_put(jnp.asarray(w[i:i + 1]), d)
+                for i, d in enumerate(runtime)
+            ],
+        )
+        out = prog(x, wx)
+    else:
+        out = prog(x)
     # Order results by global row, not shard-iteration order.
-    shards = sorted(
+    out_shards = sorted(
         out.addressable_shards, key=lambda sh: sh.index[0].start or 0
     )
-    result = [shard.data[0] for shard in shards]
+    result = [shard.data[0] for shard in out_shards]
+    dur = time.perf_counter() - t0
     nbytes = int(np.dtype(dtype).itemsize) * length
+    itemsize = int(np.dtype(dtype).itemsize)
+    ici_bytes = (
+        int(2 * (m - 1) / m * nbytes) if m > 1 else 0
+    )
+    dcn_bytes = hier_dcn_wire_bytes(length, itemsize, n, s, block=block)
     record_op(
         group, "hier_allreduce", "xla_mesh", n, tensors[0],
-        wall_start, time.perf_counter() - t0,
-        wire_bytes=wire_bytes_per_rank(HIERARCHICAL, nbytes, n, n_slices=s),
+        wall_start, dur, wire_bytes=ici_bytes + dcn_bytes,
     )
-    return result
+    if s > 1:
+        record_dcn_slices(
+            group,
+            contributed=[si for si in range(s) if si not in skipped],
+            skipped=skipped,
+            dcn_bytes=dcn_bytes,
+            dur=dur,
+        )
+    if not partial:
+        return result
+    if skipped:
+        record_partial(group, "hier_allreduce", skipped)
+        _note_slice_skips(group, skipped)
+    return PartialResult(
+        value=result,
+        contributed=[si for si in range(s) if si not in skipped],
+        skipped=skipped,
+        world=s,
+    )
+
+
+def _hier_masked_fn(s: int, m: int, length: int, pad_to: int,
+                    block: int | None):
+    """shard_map body of the masked (and optionally DCN-compressed)
+    hierarchical allreduce. ``w`` carries each device's SLICE weight
+    (0 = skipped slice): the ICI reduce-scatter stays exact; the DCN
+    reduce weights each slice's shard, rescales by ``S/Σw``, and — with
+    ``block`` — moves int8 + per-block scales instead of f32 on the
+    inter-slice hop (quantize → all_to_all → fp32 accumulate →
+    requantize → all_gather), the EQuARX treatment applied to exactly
+    the slow link."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.collective import codec
+
+    shard_len = pad_to // m
+    if block is not None:
+        chunk_len = codec.padded_len(-(-shard_len // s), block)
+        total2 = s * chunk_len
+        nblk = chunk_len // block
+
+    def fn(v, w):
+        flat = v.reshape(-1)
+        flat = jnp.pad(flat, (0, pad_to - length))
+        shard = jax.lax.psum_scatter(
+            flat, "ici", scatter_dimension=0, tiled=True
+        )
+        wv = w[0]
+        cnt = jax.lax.psum(wv, "dcn")
+        scale = s / jnp.maximum(cnt, 1.0)
+        if block is None:
+            red = jax.lax.psum(shard * wv, "dcn") * scale
+        else:
+            xq = (shard * wv).astype(jnp.float32)
+            xq = jnp.pad(xq, (0, total2 - shard_len))
+            q, scales = codec.quantize_blocked_jax(
+                xq.reshape(s, nblk, block)
+            )
+            q_t = jax.lax.all_to_all(
+                q, "dcn", split_axis=0, concat_axis=0, tiled=True
+            )
+            s_t = jax.lax.all_to_all(
+                scales, "dcn", split_axis=0, concat_axis=0, tiled=True
+            )
+            deq = q_t.astype(jnp.float32) * s_t[..., None]
+            acc = jnp.sum(deq, axis=0) * scale  # fp32 accumulate
+            q2, sc2 = codec.quantize_blocked_jax(acc)
+            qg = jax.lax.all_gather(q2, "dcn", axis=0, tiled=False)
+            sg = jax.lax.all_gather(sc2, "dcn", axis=0, tiled=False)
+            red = (
+                (qg.astype(jnp.float32) * sg[..., None])
+                .reshape(-1)[:shard_len]
+                .astype(v.dtype)
+            )
+        full = jax.lax.all_gather(red, "ici", axis=0, tiled=True)
+        return full[:length].reshape(v.shape)
+
+    return fn

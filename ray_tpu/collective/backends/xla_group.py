@@ -861,20 +861,67 @@ class XlaDistGroup:
         )
         return self._local(self._sync(out, "allreduce", timeout_s))
 
+    @staticmethod
+    def _coord_client():
+        """The jax coordination-service KV client, when
+        jax.distributed is initialized in this process (None
+        otherwise). The gate prefers it over head-KV round trips: the
+        coordination service is the same fault-domain as the compiled
+        op itself — a head restart, head-KV latency spike, or RPC
+        retry can no longer mis-price a contribution."""
+        try:
+            from jax._src import distributed as _dist
+
+            return _dist.global_state.client
+        # tpulint: allow(broad-except reason=jax internals moved or distributed never initialized; the gate falls back to head-KV)
+        except Exception:
+            return None
+
+    def _coord_gate_open_ts(self, key: str, now: float) -> float | None:
+        """First-arrival timestamp via the jax coordination service:
+        every rank races one ``key_value_set`` (first writer wins;
+        losers raise on the duplicate key) then reads the winner with a
+        bounded blocking get — after our own set attempt the value
+        exists, so the bound only matters during service teardown.
+        Returns None when the service is unavailable (head-KV fallback
+        applies)."""
+        client = self._coord_client()
+        if client is None:
+            return None
+        try:
+            try:
+                client.key_value_set(key, repr(now))
+            # tpulint: allow(broad-except reason=another rank won the first-writer set race; the bounded get below returns the winner)
+            except Exception:
+                pass
+            return float(client.blocking_key_value_get(key, 2000))
+        # tpulint: allow(broad-except reason=coordination service mid-teardown or pre-init; gate falls back to head-KV pricing)
+        except Exception:
+            return None
+
     def _gate_weight(self, grace_s: float) -> float:
         """Pre-op bounded barrier, self-flagging: the first rank to
-        reach the op claims a gate-open timestamp in the head KV; a rank
-        arriving more than ``grace_s`` later contributes with weight 0.
-        Each rank owns only ITS OWN weight, so clock skew or KV races
-        can never make the compiled psum's inputs inconsistent — a
-        mis-decided rank merely includes/excludes itself. No waiting
-        happens here: the compiled op is the synchronization point, the
-        gate only prices the contribution."""
-        if self.core is None:
-            return 1.0
+        reach the op claims a gate-open timestamp; a rank arriving more
+        than ``grace_s`` later contributes with weight 0. Each rank
+        owns only ITS OWN weight, so clock skew or races can never make
+        the compiled psum's inputs inconsistent — a mis-decided rank
+        merely includes/excludes itself. No waiting happens here: the
+        compiled op is the synchronization point, the gate only prices
+        the contribution.
+
+        The claim goes through the jax COORDINATION SERVICE when
+        jax.distributed is initialized (the ROADMAP follow-up: the gate
+        lives in the same fault domain as the op, not behind head-KV
+        wall clocks); the head KV remains the fallback for processes
+        without a coordination client."""
         self._gate_seq += 1
         key = f"pgate:{self.name}:{self._gate_seq}"
         now = time.time()
+        open_ts = self._coord_gate_open_ts(key, now)
+        if open_ts is not None:
+            return 0.0 if (now - open_ts) > grace_s else 1.0
+        if self.core is None:
+            return 1.0
 
         async def claim():
             reply = await self.core.head.call("kv_get", key=key)

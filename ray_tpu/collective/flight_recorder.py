@@ -58,6 +58,21 @@ BUS_BANDWIDTH = Gauge(
     tag_keys=("group", "verb", "dtype"),
 )
 
+DCN_CONTRIB = Counter(
+    "ray_tpu_collective_dcn_contrib_total",
+    "hierarchical-allreduce DCN hop participation by slice and outcome "
+    "(contributed vs skipped) — the slice-level health signal the "
+    "whole-slice drain escalation reads",
+    tag_keys=("group", "slice", "outcome"),
+)
+DCN_BUS_BANDWIDTH = Gauge(
+    "ray_tpu_collective_dcn_bus_bandwidth_bytes_per_s",
+    "achieved DCN-hop bus bandwidth of the most recent hierarchical "
+    "allreduce, per contributing slice (wire bytes of the inter-slice "
+    "exchange / op time)",
+    tag_keys=("group", "slice"),
+)
+
 PARTIAL_OPS = Counter(
     "ray_tpu_collective_partial_ops_total",
     "collective ops completed in K-of-N partial mode (skipped at least "
@@ -125,6 +140,35 @@ def _span_sample(
     else:
         return True, 1
     return counter % n == 0, n
+
+
+def record_dcn_slices(
+    group: str,
+    contributed,
+    skipped,
+    dcn_bytes: int,
+    dur: float,
+) -> None:
+    """Record one hierarchical allreduce's DCN hop at slice
+    granularity: a contribution counter per slice (labeled by outcome)
+    plus a per-slice DCN busbw gauge for the slices that carried
+    traffic. Zero-DCN ops (single slice) record nothing."""
+    if not skipped and not contributed:
+        return
+    for si in contributed:
+        DCN_CONTRIB.inc(
+            tags={
+                "group": group, "slice": str(si), "outcome": "contributed",
+            }
+        )
+        if dcn_bytes > 0 and dur > 0:
+            DCN_BUS_BANDWIDTH.set(
+                dcn_bytes / dur, tags={"group": group, "slice": str(si)}
+            )
+    for si in skipped:
+        DCN_CONTRIB.inc(
+            tags={"group": group, "slice": str(si), "outcome": "skipped"}
+        )
 
 
 def record_partial(group: str, verb: str, skipped) -> None:

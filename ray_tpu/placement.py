@@ -85,3 +85,20 @@ def slice_placement_group(
         [{"TPU": float(chips_per_host), "CPU": 1.0}] * num_hosts,
         strategy=strategy,
     )
+
+
+def cross_slice_placement_group(
+    num_bundles: int, bundle: "dict | None" = None
+) -> PlacementGroup:
+    """Reserve ``num_bundles`` bundles on ``num_bundles`` DISTINCT
+    slices (strategy ``STRICT_SPREAD_SLICES``): the fault-domain dual of
+    :func:`slice_placement_group`. A whole-slice preemption then takes
+    at most ONE bundle — the placement shape for checkpoint replica
+    holders, replicated serve deployments, and anything else that must
+    survive a slice going away as a unit. Nodes without a ``slice``
+    label count as their own singleton fault domain. Fails when the
+    cluster has fewer distinct slices than bundles."""
+    return placement_group(
+        [dict(bundle or {"CPU": 1.0})] * num_bundles,
+        strategy="STRICT_SPREAD_SLICES",
+    )

@@ -86,6 +86,18 @@ class HeadService:
         # straggler signal and — with COLLECTIVE_SKIP_DRAIN — acted on
         # directly via the drain path.
         self.chronic_skip_reports: dict[str, float] = {}
+        # Slice fault domains: slice label → {"nodes": [node_id],
+        # "state": healthy|draining|dead, "reason", "since"}. Membership
+        # comes from node registrations (the "slice" label); state is
+        # journaled like the drain table — a head restart must not
+        # forget that a slice was mid-drain (its nodes' DRAINING
+        # tombstones survive too, but the SLICE state is what stops the
+        # escalation logic from re-firing and what operators see). Real
+        # pods fail slice-at-a-time (a GKE maintenance event takes all
+        # hosts of a slice atomically), so one host's preemption or
+        # death drains the WHOLE slice and the autoscaler replaces the
+        # slice as a unit.
+        self.slices: dict[str, dict] = {}
         # Cluster-wide infeasible lease demand, deduped per waiting
         # request: requester id → (resources, ts). Each spill-waiting
         # request refreshes its single entry, so one pending lease reads
@@ -184,6 +196,17 @@ class HeadService:
                         "ckpt_locations", {}
                     ).items()
                 }
+                self.slices = {
+                    sid: dict(rec)
+                    for sid, rec in payload.get("slices", {}).items()
+                }
+            elif table == "slice":
+                if op == "put":
+                    self.slices[payload["slice_id"]] = dict(
+                        payload["fields"]
+                    )
+                else:
+                    self.slices.pop(payload["slice_id"], None)
             elif table == "ckpt":
                 self._ckpt_replay(op, payload)
             elif table == "drain":
@@ -240,6 +263,9 @@ class HeadService:
                 h: sorted(addrs)
                 for h, addrs in self.ckpt_locations.items()
             },
+            "slices": {
+                sid: dict(rec) for sid, rec in self.slices.items()
+            },
         }
 
     @staticmethod
@@ -292,6 +318,7 @@ class HeadService:
         }
         conn.state["node_id"] = node_id
         self._sched_cols = None  # membership changed
+        self._slice_register(node_id, labels or {})
         old = self._node_conns.pop(node_id, None)
         if old is not None:
             await old.close()
@@ -373,6 +400,9 @@ class HeadService:
             "unschedulable": [r for r, _ts in self.unschedulable.values()],
             "draining": {
                 nid: dict(d) for nid, d in self.draining.items()
+            },
+            "slices": {
+                sid: dict(rec) for sid, rec in self.slices.items()
             },
             "nodes": {
                 nid: {
@@ -465,6 +495,9 @@ class HeadService:
         # Drain-aware checkpoint evacuation: chunks whose only replicas
         # live on this node must re-replicate INSIDE the notice window.
         self._schedule_ckpt_repair()
+        # Slice fault domain: one host draining means the slice is
+        # going away — drain its siblings inside the same window.
+        await self._maybe_drain_slice(node_id, reason, deadline_s)
         return {"ok": True, **rec}
 
     async def _on_undrain_node(self, conn, node_id: str):
@@ -492,12 +525,191 @@ class HeadService:
             # tpulint: allow(broad-except reason=node may be mid-death; the undrain event already fanned out on pubsub and the table is authoritative)
             except Exception:
                 pass
+        # Slice state follows its members: once the last draining member
+        # of a DRAINING slice is undrained, the slice is healthy again
+        # (maintenance event cleared for the whole unit).
+        sid = self._slice_of(node_id)
+        if sid is not None:
+            srec = self.slices[sid]
+            if srec["state"] == "draining" and not any(
+                n in self.draining for n in srec["nodes"]
+            ):
+                srec["state"] = "healthy"
+                srec["reason"] = ""
+                self._slice_journal(sid)
         return {"ok": True}
 
     async def _on_drain_table(self, conn):
         return {
             "draining": {nid: dict(d) for nid, d in self.draining.items()}
         }
+
+    # ---------------------------------------------- slice fault domains
+    def _slice_journal(self, slice_id: str) -> None:
+        rec = self.slices.get(slice_id)
+        if rec is None:
+            self._journal_append("slice", "del", {"slice_id": slice_id})
+        else:
+            self._journal_append(
+                "slice", "put",
+                {"slice_id": slice_id, "fields": dict(rec)},
+            )
+
+    def _slice_register(self, node_id: str, labels: dict) -> None:
+        """Fold one node registration into the slice table. A node of a
+        DEAD slice re-registering revives the slice (a replacement
+        booted under the same label); a node of a DRAINING slice stays
+        draining — its per-node tombstone is re-pushed by the caller."""
+        slice_id = (labels or {}).get("slice")
+        if not slice_id:
+            return
+        rec = self.slices.get(slice_id)
+        if rec is None or rec.get("state") == "dead":
+            rec = self.slices[slice_id] = {
+                "nodes": [],
+                "state": "healthy",
+                "reason": "",
+                "since": time.time(),
+            }
+        if node_id not in rec["nodes"]:
+            rec["nodes"].append(node_id)
+            self._slice_journal(slice_id)
+
+    def _slice_of(self, node_id: str) -> str | None:
+        for sid, rec in self.slices.items():
+            if node_id in rec["nodes"]:
+                return sid
+        return None
+
+    async def _maybe_drain_slice(
+        self, node_id: str, reason: str, deadline_s: float | None = None
+    ) -> None:
+        """Whole-slice drain escalation: one host of a slice draining
+        means the slice is going away (GCE maintenance and preemption
+        reap slices atomically) — drain every sibling host NOW so their
+        work migrates inside the same notice window, and mark the slice
+        DRAINING so the autoscaler provisions one replacement slice,
+        not a node at a time."""
+        from ray_tpu._private import config
+
+        if not config.get("SLICE_FAULT_DOMAINS"):
+            return
+        slice_id = self._slice_of(node_id)
+        if slice_id is None:
+            return
+        rec = self.slices[slice_id]
+        if rec["state"] in ("draining", "dead"):
+            return  # escalation already ran (or there is nothing left)
+        rec["state"] = "draining"
+        rec["reason"] = reason
+        rec["since"] = time.time()
+        self._slice_journal(slice_id)
+        logger.warning(
+            "slice %s: host %s is going away (%s); draining the whole "
+            "slice (%d hosts)",
+            slice_id, node_id[:12], reason, len(rec["nodes"]),
+        )
+        self.publish(
+            "collective",
+            {
+                "event": "slice_draining",
+                "slice_id": slice_id,
+                "nodes": list(rec["nodes"]),
+                "reason": reason,
+            },
+        )
+        # The anchor node is included too when not already draining
+        # (the death path escalates via a SURVIVING sibling as anchor).
+        for sibling in list(rec["nodes"]):
+            if sibling in self.draining or sibling not in self.nodes:
+                continue
+            await self._on_drain_node(
+                None,
+                node_id=sibling,
+                reason=f"slice {slice_id} fault domain: {reason}",
+                deadline_s=deadline_s,
+            )
+
+    def _slice_node_gone(self, node_id: str) -> tuple[str, dict] | None:
+        """Drop a dead node from its slice's membership; returns the
+        (slice_id, record) when the node belonged to one. A slice whose
+        last host died is marked DEAD (kept for observability until a
+        replacement registers under the label)."""
+        slice_id = self._slice_of(node_id)
+        if slice_id is None:
+            return None
+        rec = self.slices[slice_id]
+        rec["nodes"].remove(node_id)
+        if not rec["nodes"]:
+            rec["state"] = "dead"
+            rec["since"] = time.time()
+        self._slice_journal(slice_id)
+        return slice_id, rec
+
+    async def _on_slice_table(self, conn):
+        return {
+            "slices": {
+                sid: dict(rec) for sid, rec in self.slices.items()
+            }
+        }
+
+    async def _on_collective_slice_report(
+        self,
+        conn,
+        group: str,
+        slice_id: str,
+        skips: int = 0,
+        window_s: float = 0.0,
+    ):
+        """The hierarchical allreduce escalated a chronically skipped
+        SLICE: its DCN-hop skip rate crossed the sliding-window
+        threshold. Resolve the slice (label match first, then
+        positional index against the sorted table — the collective
+        layer sees slice indices, not labels) and — unless
+        COLLECTIVE_SKIP_DRAIN is off — drain the whole slice: the
+        slice-level twin of collective_straggler_report."""
+        from ray_tpu._private import config
+
+        sid = slice_id if slice_id in self.slices else None
+        if sid is None:
+            try:
+                ordered = sorted(self.slices)
+                idx = int(slice_id)
+                if 0 <= idx < len(ordered):
+                    sid = ordered[idx]
+            except (TypeError, ValueError):
+                sid = None
+        if sid is None:
+            return {
+                "ok": False,
+                "error": f"cannot resolve slice {slice_id!r} of group "
+                         f"{group!r} to a registered slice",
+            }
+        logger.warning(
+            "slice %s (group %r) was skipped by %d hierarchical "
+            "DCN-partial collectives in %.0fs: chronic slice straggler",
+            sid, group, int(skips), window_s,
+        )
+        drained = False
+        rec = self.slices[sid]
+        if (
+            config.get("COLLECTIVE_SKIP_DRAIN")
+            and rec["state"] == "healthy"
+        ):
+            anchor = next(
+                (n for n in rec["nodes"] if n in self.nodes), None
+            )
+            if anchor is not None:
+                reply = await self._on_drain_node(
+                    conn,
+                    node_id=anchor,
+                    reason=(
+                        f"chronic slice straggler: {int(skips)} DCN-"
+                        f"partial skips in {window_s:.0f}s"
+                    ),
+                )
+                drained = bool(reply.get("ok"))
+        return {"ok": True, "slice_id": sid, "drained": drained}
 
     # ------------------------------------------- distributed checkpoints
     def _ckpt_replay(self, op: str, payload: dict) -> None:
@@ -773,6 +985,10 @@ class HeadService:
             n["addr"]: self._node_conns.get(nid)
             for nid, n in self.nodes.items()
         }
+        addr_slice = {
+            n["addr"]: (n.get("labels") or {}).get("slice")
+            for n in self.nodes.values()
+        }
         reports = []
         for rname, steps in self.checkpoints.items():
             if run is not None and rname != run:
@@ -784,8 +1000,10 @@ class HeadService:
                 for r in rec["ranks"].values():
                     chunks |= manifest_chunks(r["entries"])
                 healthy_counts: dict[str, int] = {}
+                healthy_holders: dict[str, list[str]] = {}
                 for h in sorted(chunks):
                     n_ok = 0
+                    holders: list[str] = []
                     for addr in self.ckpt_locations.get(h, ()):
                         node_conn = (
                             conn_by_addr.get(addr)
@@ -805,7 +1023,22 @@ class HeadService:
                             continue
                         if meta.get("ok"):
                             n_ok += 1
+                            holders.append(addr)
                     healthy_counts[h] = n_ok
+                    healthy_holders[h] = holders
+                # Replica spread: two replicas of a chunk sharing a
+                # slice are one preemption away from being one replica
+                # — flag them so `ray_tpu ckpt verify` warns before the
+                # slice goes away, not after.
+                colocated = []
+                for h, holders in healthy_holders.items():
+                    by_slice: dict[str, int] = {}
+                    for addr in holders:
+                        sl = addr_slice.get(addr)
+                        if sl:
+                            by_slice[sl] = by_slice.get(sl, 0) + 1
+                    if any(v >= 2 for v in by_slice.values()):
+                        colocated.append(h)
                 target = min(want, max(1, len(alive)))
                 reports.append(
                     {
@@ -828,6 +1061,7 @@ class HeadService:
                             for h, v in healthy_counts.items()
                             if v == 0
                         ),
+                        "colocated": sorted(colocated),
                     }
                 )
         return {"ok": True, "checkpoints": reports}
@@ -865,7 +1099,12 @@ class HeadService:
         makes chunks whose only replicas live on the draining node
         eligible for evacuation, before the node dies. Dead holders are
         only forgotten once a chunk is healthy again (never drop the
-        last record of where data might still be)."""
+        last record of where data might still be).
+
+        Target choice is SLICE-AWARE: a replica on the same slice as an
+        existing holder dies with it (whole-slice preemption), so
+        candidates on slices that do not already hold the chunk come
+        first — whole-slice loss then never destroys every copy."""
         from ray_tpu._private import config
 
         want = int(config.get("CKPT_REPLICATION"))
@@ -874,6 +1113,10 @@ class HeadService:
             self.nodes[nid]["addr"]
             for nid in self.draining
             if nid in self.nodes
+        }
+        addr_slice = {
+            n["addr"]: (n.get("labels") or {}).get("slice")
+            for n in self.nodes.values()
         }
         healthy_addrs = set(alive) - draining_addrs
         if not healthy_addrs:
@@ -901,7 +1144,17 @@ class HeadService:
             sources = sorted(healthy) or sorted(live)
             if not sources:
                 continue  # every replica gone until a holder returns
-            candidates = sorted(healthy_addrs - live)
+            held_slices = {
+                addr_slice.get(a) for a in live if addr_slice.get(a)
+            }
+            candidates = sorted(
+                healthy_addrs - live,
+                key=lambda a: (
+                    addr_slice.get(a) is not None
+                    and addr_slice[a] in held_slices,
+                    a,
+                ),
+            )
             for tgt in candidates[: target_n - len(healthy)]:
                 plan.setdefault((sources[0], tgt), []).append(chunk)
         for (src, tgt), chunks in plan.items():
@@ -1778,12 +2031,28 @@ class HeadService:
                 placed.append((host, i))
         else:
             used: set[str] = set()
+            used_slices: set[str] = set()
+
+            def slice_of(nid: str) -> str:
+                # Unlabeled nodes are their own singleton fault domain.
+                labels = self.nodes[nid].get("labels") or {}
+                return labels.get("slice") or f"node:{nid}"
+
             for i, bundle in enumerate(bundles):
                 if strategy == "PACK":
                     order = node_ids
                 elif strategy == "STRICT_SPREAD":
                     # Each bundle on a DISTINCT node, or fail.
                     order = [n for n in node_ids if n not in used]
+                elif strategy == "STRICT_SPREAD_SLICES":
+                    # Each bundle on a DISTINCT SLICE, or fail: the
+                    # cross-fault-domain gang (checkpoint replica
+                    # holders, replicated services) — whole-slice loss
+                    # then takes at most one bundle.
+                    order = [
+                        n for n in node_ids
+                        if slice_of(n) not in used_slices
+                    ]
                 else:  # SPREAD: best-effort rotation
                     order = (
                         node_ids[i % len(node_ids) :]
@@ -1791,17 +2060,25 @@ class HeadService:
                     )
                 chosen = next((n for n in order if fits(n, bundle)), None)
                 if chosen is None:
+                    detail = ""
+                    if strategy == "STRICT_SPREAD":
+                        detail = (
+                            " (STRICT_SPREAD needs a distinct node per "
+                            "bundle)"
+                        )
+                    elif strategy == "STRICT_SPREAD_SLICES":
+                        detail = (
+                            " (STRICT_SPREAD_SLICES needs a distinct "
+                            "slice per bundle)"
+                        )
                     return {
                         "ok": False,
                         "error": f"bundle {i} {bundle} infeasible"
-                        + (
-                            " (STRICT_SPREAD needs a distinct node per bundle)"
-                            if strategy == "STRICT_SPREAD"
-                            else ""
-                        ),
+                        + detail,
                     }
                 take(chosen, bundle)
                 used.add(chosen)
+                used_slices.add(slice_of(chosen))
                 placed.append((chosen, i))
         return {"ok": True, "placed": placed}
 
@@ -2143,6 +2420,19 @@ class HeadService:
         self._collective_member_died(node_addr=node["addr"])
         # Checkpoint chunks this node held are now under-replicated.
         self._schedule_ckpt_repair()
+        # Slice fault domain: an UNEXPECTED member death implicates the
+        # whole slice (preemption reaps hosts together; the stragglers
+        # are seconds behind) — drain the siblings before they die with
+        # work still on them. _slice_node_gone already moved the slice
+        # to "dead" when this was the last host.
+        gone = self._slice_node_gone(nid)
+        if gone is not None:
+            slice_id, rec = gone
+            if rec["nodes"] and rec["state"] == "healthy":
+                await self._maybe_drain_slice(
+                    rec["nodes"][0],
+                    f"slice {slice_id} host {nid[:12]}… died unexpectedly",
+                )
         for aid, actor in self.actors.items():
             if actor["node_id"] == nid and actor["state"] == "ALIVE":
                 # Node death goes through the same restart budget as

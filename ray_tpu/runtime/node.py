@@ -1918,6 +1918,13 @@ def detect_labels() -> dict[str, str]:
             labels[k.strip()] = v.strip()
     labels.update(detect_accelerator_labels())
     labels.update(_gce_metadata_labels())
+    # Canonical slice fault-domain label: the head's slice table, the
+    # checkpoint replicator's cross-slice placement, and the autoscaler's
+    # slice-unit replacement all key on "slice". On real TPU VMs the
+    # accelerator plugin reports the slice name under the ray-style
+    # label; alias it unless the operator set "slice" explicitly.
+    if "slice" not in labels and labels.get("ray_tpu.io/tpu-slice-name"):
+        labels["slice"] = labels["ray_tpu.io/tpu-slice-name"]
     return labels
 
 

@@ -195,6 +195,17 @@ def cmd_ckpt(args) -> int:
                 f"{r['replication_target']}, {n_under} under-replicated, "
                 f"{n_lost} lost"
             )
+            colocated = r.get("colocated") or []
+            if colocated:
+                # Not counted in the exit code: the replicas exist —
+                # but one slice preemption away from not existing.
+                print(
+                    f"  WARNING: {len(colocated)} chunks have two "
+                    "replicas on the SAME slice (whole-slice loss "
+                    "would drop them to one copy): "
+                    + ", ".join(h[:12] + "…" for h in colocated[:4])
+                    + ("…" if len(colocated) > 4 else "")
+                )
         return 1 if bad else 0
     data = state.list_checkpoints(run=args.run)
     if args.json:

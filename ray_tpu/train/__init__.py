@@ -26,6 +26,7 @@ from ray_tpu.train.session import (
     partial_collective_opts,
     preemption_notice,
     report,
+    slice_label,
     step_span,
 )
 from ray_tpu.train.trainer import (
@@ -57,6 +58,7 @@ __all__ = [
     "preemption_notice",
     "PreemptedError",
     "report",
+    "slice_label",
     "step_span",
     "ElasticScalingPolicy",
     "FailureConfig",

@@ -40,6 +40,11 @@ class TrainContext:
     # codec name grad_sync_opts() forwards to the gradient collective
     # ("int8" = block-scaled int8 wire format, fp32 accumulation).
     grad_compression: str | None = None
+    # This worker's node "slice" label (None off-slice): the fault
+    # domain it dies with. Resolved by TrainWorker.setup through the
+    # head node table; the RAY_TPU_SLICE_FAIL chaos knob and slice-
+    # aware train loops read it via train.slice_label().
+    slice_label: str | None = None
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)
@@ -127,6 +132,15 @@ def grad_sync_opts(world: int | None = None) -> dict:
     if ctx.grad_compression:
         opts["compression"] = ctx.grad_compression
     return opts
+
+
+def slice_label() -> str | None:
+    """This worker's node "slice" label (the fault domain it dies
+    with), or None off-slice / when unresolved. Train loops use it to
+    key slice-aware work (e.g. per-slice data shards) and the
+    RAY_TPU_SLICE_FAIL chaos knob reads it to fail whole slices
+    deterministically."""
+    return get_context().slice_label
 
 
 def note_partial_op(result) -> None:

@@ -239,6 +239,23 @@ class TrainWorker:
         grad_compression = (
             backend_env.get("RAY_TPU_TRAIN_GRAD_COMPRESSION") or None
         )
+        # The slice fault domain this worker dies with: its node's
+        # "slice" label (None off-slice). Resolved once at setup so the
+        # loop (and the SLICE_FAIL chaos knob) never pays a head RPC
+        # per step.
+        slice_label = None
+        try:
+            rt = ray_tpu.api._runtime
+            node_addr = getattr(rt.core, "node_addr", None)
+            if node_addr:
+                table = rt.run(rt.core.head.call("node_table"), 5)
+                for n in table.values():
+                    if n.get("addr") == node_addr:
+                        slice_label = (n.get("labels") or {}).get("slice")
+                        break
+        # tpulint: allow(broad-except reason=client-mode / degraded head: a worker without a resolvable slice simply has no slice fault domain)
+        except Exception:
+            slice_label = None
         self.ctx = TrainContext(
             world_size=self.world_size,
             rank=self.rank,
@@ -257,6 +274,7 @@ class TrainWorker:
             ),
             partial_grace_s=float(partial_grace) if partial_grace else None,
             grad_compression=grad_compression,
+            slice_label=slice_label,
         )
         return True
 

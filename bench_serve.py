@@ -1,0 +1,202 @@
+"""Serve load-generator bench: TTFT/latency percentiles + tokens/s.
+
+The pinned-baseline stub for the production-serve tentpole (ROADMAP:
+"Land a load-generator bench (`bench_serve.py`) reporting p50/p99 TTFT
++ tokens/s"). It drives real HTTP traffic through the proxy against
+
+- an **echo** deployment (the request-path floor: proxy + router +
+  replica round trip), and
+- a **tiny-model LLM** deployment with an SSE token stream (the
+  continuous-batching path: prefill/decode through the engine),
+
+measures client-side TTFT/latency percentiles, and cross-checks them
+against the head's serve SLO ledger (`serve_stats` — the same numbers
+`ray_tpu slo` and /api/serve show), so the bench and the telemetry can
+never drift apart silently. Emits ``BENCH_serve.json``:
+
+- ``echo``: requests, p50/p99 latency ms, requests/s
+- ``llm_stream``: requests, p50/p99 TTFT ms, p50/p99 latency ms,
+  generated tokens/s
+- ``serve_stats``: the head ledger rows for both deployments
+  (attainment, window percentiles, alert state)
+
+The serve tentpole PR (KV-aware routing, prefill/decode disaggregation,
+SLO autoscaling) pins its regressions against this format. A replica-
+kill leg (p50/p99 under a mid-bench kill) lands with that PR — the
+drain path it needs is already in place.
+
+Run: ``python bench_serve.py [--requests N] [--concurrency C]``
+(writes BENCH_serve.json next to this file).
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import socket
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
+    return ordered[idx]
+
+
+def _unary(port, path, body, timeout=60):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        resp.read()
+    return time.perf_counter() - t0
+
+
+def _sse(port, path, body, timeout=120):
+    """One streamed request; returns (ttft_s, latency_s, n_tokens)."""
+    payload = json.dumps(body).encode()
+    req = (
+        f"POST {path} HTTP/1.1\r\nHost: 127.0.0.1\r\n"
+        f"Accept: text/event-stream\r\n"
+        f"Content-Length: {len(payload)}\r\n\r\n"
+    ).encode() + payload
+    t0 = time.perf_counter()
+    ttft = None
+    tokens = 0
+    raw = b""
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.sendall(req)
+        while b"data: [DONE]" not in raw and b"event: error" not in raw:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            if ttft is None and b"data: " in raw + chunk:
+                ttft = time.perf_counter() - t0
+            raw += chunk
+    latency = time.perf_counter() - t0
+    for ln in raw.decode("utf-8", "replace").splitlines():
+        if ln.startswith("data: ") and ln != "data: [DONE]":
+            try:
+                tokens += len(json.loads(ln[len("data: "):])["tokens"])
+            except (ValueError, KeyError, TypeError):
+                pass
+    return ttft if ttft is not None else latency, latency, tokens
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--max-tokens", type=int, default=24)
+    ap.add_argument("--output", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_serve.json"))
+    args = ap.parse_args()
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm.serve_integration import build_llm_deployment
+    from ray_tpu.util import state
+
+    ray_tpu.init(num_cpus=max(8, args.concurrency))
+
+    @serve.deployment(max_ongoing_requests=64)
+    def echo(request):
+        return {"ok": True, "n": request["body"].get("n", 0)}
+
+    serve.run(echo.bind(), name="bench_echo", route_prefix="/echo")
+    llm = build_llm_deployment(
+        "tiny",
+        engine_kwargs={"max_batch": 8},
+        ray_actor_options={"num_cpus": 0.5},
+    )
+    serve.run(llm, name="bench_llm", route_prefix="/llm", timeout_s=180)
+    port = serve.start_http()
+
+    # Warmup (route tables, first compile).
+    _unary(port, "/echo", {"n": -1})
+    _sse(port, "/llm", {"prompt": "warm", "max_tokens": 4, "stream": True})
+
+    # ---- echo leg: unary request-path floor under concurrency
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
+        echo_lat = list(pool.map(
+            lambda i: _unary(port, "/echo", {"n": i}),
+            range(args.requests),
+        ))
+    echo_wall = time.perf_counter() - t0
+
+    # ---- llm leg: SSE token streaming through the batcher
+    n_llm = max(8, args.requests // 4)
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
+        llm_rows = list(pool.map(
+            lambda i: _sse(
+                port, "/llm",
+                {"prompt": f"bench {i}", "max_tokens": args.max_tokens,
+                 "stream": True},
+            ),
+            range(n_llm),
+        ))
+    llm_wall = time.perf_counter() - t0
+    ttfts = [r[0] for r in llm_rows]
+    lats = [r[1] for r in llm_rows]
+    toks = sum(r[2] for r in llm_rows)
+
+    # Give the 1 Hz span flush a beat, then read the head ledger — the
+    # cross-check that keeps client-side and telemetry numbers honest.
+    deadline = time.time() + 10
+    ledger = {}
+    while time.time() < deadline:
+        ledger = state.serve_stats().get("deployments", {})
+        got = ledger.get("bench_llm/LLMServer", {}).get("requests", 0)
+        if got >= n_llm:
+            break
+        time.sleep(0.5)
+
+    out = {
+        "bench": "serve",
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "echo": {
+            "requests": args.requests,
+            "latency_p50_ms": round(_percentile(echo_lat, 0.5) * 1e3, 2),
+            "latency_p99_ms": round(_percentile(echo_lat, 0.99) * 1e3, 2),
+            "requests_per_s": round(args.requests / echo_wall, 1),
+        },
+        "llm_stream": {
+            "requests": n_llm,
+            "max_tokens": args.max_tokens,
+            "ttft_p50_ms": round(_percentile(ttfts, 0.5) * 1e3, 2),
+            "ttft_p99_ms": round(_percentile(ttfts, 0.99) * 1e3, 2),
+            "latency_p50_ms": round(_percentile(lats, 0.5) * 1e3, 2),
+            "latency_p99_ms": round(_percentile(lats, 0.99) * 1e3, 2),
+            "tokens_per_s": round(toks / llm_wall, 1),
+        },
+        "serve_stats": {
+            k: v for k, v in ledger.items()
+            if k.startswith(("bench_echo/", "bench_llm/"))
+        },
+    }
+    with open(args.output, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out, indent=1))
+    print(f"wrote {args.output}")
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

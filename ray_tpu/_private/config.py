@@ -222,6 +222,34 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                     "MFU accounting (always-cheap; 0 "
                                     "makes step_span a pinned-budget "
                                     "no-op)"),
+    "SERVE_TELEMETRY": (bool, True, "serve request-path spans (ingress/"
+                                    "queue/prefill/decode) + TTFT/"
+                                    "latency histograms + the head SLO "
+                                    "ledger (always-cheap; 0 makes the "
+                                    "per-request hooks pinned-budget "
+                                    "no-ops)"),
+    "SERVE_SLO_TTFT_S": (float, 2.0, "per-request time-to-first-token "
+                                     "SLO target; streamed requests "
+                                     "attain when TTFT is at or under "
+                                     "it"),
+    "SERVE_SLO_LATENCY_S": (float, 30.0, "per-request end-to-end "
+                                         "latency SLO target (the "
+                                         "attainment bound for unary "
+                                         "requests, and a second bound "
+                                         "for streams)"),
+    "SERVE_SLO_TARGET": (float, 0.95, "required fraction of requests "
+                                      "attaining their SLO over the "
+                                      "window; below it the head warns "
+                                      "and sets ray_tpu_serve_slo_"
+                                      "alert"),
+    "SERVE_SLO_WINDOW_S": (float, 60.0, "sliding window for serve SLO "
+                                        "attainment and the burn-rate "
+                                        "alert"),
+    "LLM_PREFILL_DELAY": (float, 0.0, "chaos spec: sleep this long "
+                                      "inside every LLM engine prefill "
+                                      "admission (deterministic TTFT "
+                                      "injection for serve-tracing "
+                                      "tests)"),
     "ADDRESS": (str, "", "default cluster address for init()"),
 }
 

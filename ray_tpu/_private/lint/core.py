@@ -27,6 +27,7 @@ RULES = {
     "TPU301": "broad-except",
     "TPU401": "metric-in-function",
     "TPU402": "span-leak",
+    "TPU403": "unbounded-metric-label",
     "TPU501": "rpc-reentrancy",
 }
 

@@ -1,4 +1,4 @@
-"""TPU401/TPU402 — metrics & span hygiene.
+"""TPU401/TPU402/TPU403 — metrics & span hygiene.
 
 - TPU401: ``Counter``/``Gauge``/``Histogram`` constructed inside a
   function. The registry now tolerates re-registration (same shape
@@ -10,15 +10,33 @@
   ``activate``/``train.step_span``/``jax_profile``) called bare —
   without ``with`` or ``enter_context(...)`` — constructs the CM and
   drops it unentered: the span silently never records.
+- TPU403: unbounded-cardinality metric labels — a request/session/
+  trace id or uuid-shaped value used as a metric tag. Every distinct
+  label value is a new time series held forever by the registry and
+  shipped on every scrape; one busy serve deployment tagged by
+  request_id is an OOM with a delay fuse. Per-request identity belongs
+  on span attributes (ring-bounded), never on metric labels. Fires on
+  metric constructors whose ``tag_keys`` name an id-shaped key, and on
+  ``.inc/.set/.observe(..., tags={...})`` calls whose tag keys or
+  values are id-shaped (including uuid calls, f-strings and str()/
+  subscript wrappers around id-shaped names).
 """
 
 from __future__ import annotations
 
 import ast
+import re
 
 from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name
 
 _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
+# Identifier fragments that signal per-request/per-session cardinality.
+_UNBOUNDED_RE = re.compile(
+    r"request[_-]?id|session[_-]?id|trace[_-]?id|span[_-]?id|"
+    r"correlation[_-]?id|task[_-]?id|uuid|guid",
+    re.IGNORECASE,
+)
+_METRIC_METHODS = frozenset({"inc", "set", "observe"})
 _SPAN_CMS = frozenset({
     "span", "step_span", "thread_trace", "activate", "jax_profile",
 })
@@ -33,6 +51,88 @@ def _metric_ctor(call: ast.Call) -> str | None:
         recv = dotted_name(func.value)
         if recv and "metric" in recv.split(".")[-1].lower():
             return func.attr
+    return None
+
+
+def _unbounded_expr(node: ast.AST, depth: int = 0) -> str | None:
+    """A human-readable description of why ``node`` smells like an
+    unbounded id, or None. Unwraps the idioms ids hide in: uuid calls,
+    str()/format() coercion, f-strings, and `[:16]`-style slicing."""
+    if depth > 4 or node is None:
+        return None
+    name = dotted_name(node)
+    if name and _UNBOUNDED_RE.search(name.split(".")[-1]):
+        return name
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if "uuid" in fname.lower():
+            return f"{fname}(...)"
+        if isinstance(node.func, ast.Name) and node.func.id in (
+            "str", "repr", "format"
+        ):
+            for arg in node.args:
+                hit = _unbounded_expr(arg, depth + 1)
+                if hit:
+                    return hit
+        if isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "format", "hex", "lower", "upper", "strip"
+        ):
+            hit = _unbounded_expr(node.func.value, depth + 1)
+            if hit:
+                return hit
+            for arg in node.args:
+                hit = _unbounded_expr(arg, depth + 1)
+                if hit:
+                    return hit
+    if isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue):
+                hit = _unbounded_expr(v.value, depth + 1)
+                if hit:
+                    return hit
+    if isinstance(node, ast.Subscript):
+        return _unbounded_expr(node.value, depth + 1)
+    if isinstance(node, ast.Attribute):
+        # dotted_name already failed (call/subscript in the chain):
+        # inspect the final attribute name, then whatever it hangs off
+        # (uuid.uuid4().hex reaches here as Attribute-over-Call).
+        if _UNBOUNDED_RE.search(node.attr):
+            return node.attr
+        return _unbounded_expr(node.value, depth + 1)
+    return None
+
+
+def _tag_keys_hit(call: ast.Call) -> str | None:
+    """An id-shaped string constant inside a ctor's tag_keys=(...)."""
+    for kw in call.keywords:
+        if kw.arg != "tag_keys":
+            continue
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            for elt in kw.value.elts:
+                if (
+                    isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)
+                    and _UNBOUNDED_RE.search(elt.value)
+                ):
+                    return elt.value
+    return None
+
+
+def _tags_dict_hit(call: ast.Call) -> str | None:
+    """An id-shaped key or value inside a record call's tags={...}."""
+    for kw in call.keywords:
+        if kw.arg != "tags" or not isinstance(kw.value, ast.Dict):
+            continue
+        for k, v in zip(kw.value.keys, kw.value.values):
+            if (
+                isinstance(k, ast.Constant)
+                and isinstance(k.value, str)
+                and _UNBOUNDED_RE.search(k.value)
+            ):
+                return f"key {k.value!r}"
+            hit = _unbounded_expr(v)
+            if hit:
+                return f"value `{hit}`"
     return None
 
 
@@ -76,6 +176,32 @@ class _Visitor(ScopeVisitor):
                 "hoist to module scope",
                 scope=self.scope,
             )
+        if ctor is not None:
+            hit = _tag_keys_hit(node)
+            if hit is not None:
+                self.ctx.report(
+                    "TPU403", node,
+                    f"`{ctor}` declares id-shaped tag key {hit!r}: "
+                    "every distinct value is a permanent time series "
+                    "(unbounded cardinality) — put per-request identity "
+                    "on span attributes, not metric labels",
+                    scope=self.scope,
+                )
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _METRIC_METHODS
+        ):
+            hit = _tags_dict_hit(node)
+            if hit is not None:
+                self.ctx.report(
+                    "TPU403", node,
+                    f"metric `.{node.func.attr}()` tagged with "
+                    f"id-shaped {hit}: every distinct value is a "
+                    "permanent time series (unbounded cardinality) — "
+                    "put per-request identity on span attributes, not "
+                    "metric labels",
+                    scope=self.scope,
+                )
         cm = _span_cm(node)
         if cm is not None and id(node) not in self._entered:
             self.ctx.report(

@@ -87,17 +87,17 @@ PARTIAL_SKIPS = Counter(
 
 # verb → busbw factor as a function of world size (nccl-tests
 # performance docs); verbs without an entry (send/recv/permute/
-# broadcast/reduce) move each byte once → factor 1. The hierarchical
-# two-level allreduce's aggregate traffic — ICI 2(m-1)/m * N plus DCN
-# 2(s-1)/s * N/m — sums to 2(n-1)/n * N for the two-slice split, same
-# as the flat convention; the op passes explicit wire_bytes= computed
-# from its actual (s, m) split, which bypasses this fallback entirely,
-# so the gauge stays honest for any slice shape.
+# broadcast/reduce) move each byte once → factor 1. `hier_allreduce`
+# deliberately has NO entry: its wire traffic depends on the (s, m)
+# slice split and on whether the DCN hop is int8-compressed, so a flat
+# 2(n-1)/n factor over-reports busbw the moment compression="int8"
+# shrinks the DCN bytes. The op always passes explicit wire_bytes=
+# computed from its actual split (see algo.hierarchical_allreduce), and
+# busbw derives from those measured bytes only.
 _BUS_FACTORS = {
     "allreduce": lambda n: 2.0 * (n - 1) / n,
     "allgather": lambda n: (n - 1) / n,
     "reducescatter": lambda n: (n - 1) / n,
-    "hier_allreduce": lambda n: 2.0 * (n - 1) / n if n > 1 else 1.0,
 }
 
 # --------------------------------------------------- span rate limiting
@@ -115,6 +115,16 @@ _AUTO_SAMPLE = 100
 _span_lock = threading.Lock()
 # (group, verb) → [window_start_monotonic, ops_in_window, op_counter]
 _span_state: dict[tuple, list] = {}
+
+
+def span_sample(
+    group: str, verb: str, dur: float, sample_rate: int | None = None
+) -> tuple[bool, int]:
+    """Public entry to the high-rate span sampler for other span
+    sources with per-event storm potential (serve's per-token decode
+    spans key it by (deployment, name)). Same contract as the private
+    form below."""
+    return _span_sample(group, verb, dur, sample_rate)
 
 
 def _span_sample(
@@ -140,6 +150,34 @@ def _span_sample(
     else:
         return True, 1
     return counter % n == 0, n
+
+
+# ------------------------------------------------ op-interval ledger
+# Wall-clock (start, end) of every collective op completed in this
+# process, ring-bounded. The train step telemetry drains it at step
+# close and intersects the intervals with the step's compute phase to
+# split collective time into comm_exposed_s vs comm_overlapped_s — the
+# baseline the T3-style overlap work must move (today nothing overlaps,
+# and the ledger records that honestly rather than assuming it).
+_ops_lock = threading.Lock()
+_op_intervals: list[tuple[float, float]] = []
+_OP_INTERVAL_CAP = 4096
+
+
+def _note_op_interval(start: float, dur: float) -> None:
+    with _ops_lock:
+        _op_intervals.append((start, start + dur))
+        if len(_op_intervals) > _OP_INTERVAL_CAP:
+            del _op_intervals[: _OP_INTERVAL_CAP // 2]
+
+
+def take_op_intervals() -> list[tuple[float, float]]:
+    """Drain the completed-op (start, end) wall-clock intervals recorded
+    since the last call (one consumer: the step telemetry)."""
+    global _op_intervals
+    with _ops_lock:
+        out, _op_intervals = _op_intervals, []
+    return out
 
 
 def record_dcn_slices(
@@ -229,6 +267,7 @@ def record_op(
     (wire/dur — no verb factor, honest for any algorithm) and the
     logical/wire ratio lands in the compression-ratio gauge."""
     nbytes, dtype = payload_info(tensor)
+    _note_op_interval(start, dur)
     OP_LATENCY.observe(
         dur, tags={"group": group, "verb": verb, "backend": backend}
     )

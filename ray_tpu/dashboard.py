@@ -59,6 +59,14 @@ def _train():
     return state.train_stats()
 
 
+@_route("/api/serve")
+def _serve():
+    """Per-deployment serve SLO ledger (head serve:ingress-span
+    accounting): TTFT/latency percentiles over the sliding window,
+    attainment vs the SLO targets, and the burn-rate alert state."""
+    return state.serve_stats()
+
+
 @_route("/api/checkpoints")
 def _checkpoints():
     """In-cluster shard-store checkpoints: per-run steps with
@@ -284,6 +292,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(body, ctype)
         except BrokenPipeError:
             pass
+        # tpulint: allow(broad-except reason=the handler failure is returned to the HTTP client as the 500 explain body - nothing is swallowed)
         except Exception as e:  # noqa: BLE001
             self.send_error(500, explain=repr(e))
 
@@ -356,6 +365,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(404)
         except BrokenPipeError:
             pass
+        # tpulint: allow(broad-except reason=the handler failure is returned to the HTTP client as the 500 explain body - nothing is swallowed)
         except Exception as e:  # noqa: BLE001
             self.send_error(500, explain=repr(e))
 
@@ -376,6 +386,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(404)
         except BrokenPipeError:
             pass
+        # tpulint: allow(broad-except reason=the handler failure is returned to the HTTP client as the 500 explain body - nothing is swallowed)
         except Exception as e:  # noqa: BLE001
             self.send_error(500, explain=repr(e))
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -49,6 +50,13 @@ class _Request:
     last_token: int = 0
     done: bool = False
     pages: list = field(default_factory=list)  # paged mode: block table
+    # Request-path timing (wall clock), the feed for the serve:prefill /
+    # serve:decode spans and TTFT/TPOT histograms. First-write-wins so a
+    # preemption's recompute re-admission never resets TTFT.
+    submit_ts: float = 0.0
+    prefill_start_ts: float = 0.0
+    first_token_ts: float = 0.0
+    finish_ts: float = 0.0
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -73,6 +81,7 @@ class LLMEngine:
         num_pages: int | None = None,
         speculate: int = 0,  # draft tokens per step (prompt lookup)
         prefill_chunk: int | None = None,  # tokens per prefill chunk
+        prefill_delay_s: float = 0.0,  # chaos: injected TTFT (tests)
     ):
         cfg = PRESETS[model] if isinstance(model, str) else model
         self.cfg = cfg
@@ -90,6 +99,7 @@ class LLMEngine:
             raise ValueError(f"kv must be 'paged' or 'dense', got {kv!r}")
         self.kv = kv
         self.page_size = page_size
+        self.prefill_delay_s = float(prefill_delay_s)
 
         # Flash prefill on a bare TPU backend; under a mesh the dense
         # path keeps XLA's SPMD partitioner in charge.
@@ -264,8 +274,28 @@ class LLMEngine:
             self._stats["requests_submitted"] += 1
             if stream:
                 self._stream_ids.add(rid)
-            self._queue.append(_Request(rid, list(prompt), sampling))
+            self._queue.append(
+                _Request(
+                    rid, list(prompt), sampling,
+                    submit_ts=time.time(),
+                )
+            )
         return rid
+
+    def _begin_prefill(self, req: _Request) -> None:
+        """Mark prefill start (first-write-wins) and apply the injected
+        prefill delay (the ``prefill_delay_s`` engine kwarg, or the
+        RAY_TPU_LLM_PREFILL_DELAY env knob) — a deterministic TTFT
+        injection the serve-tracing tests bound spans against."""
+        if req.prefill_start_ts == 0.0:
+            req.prefill_start_ts = time.time()
+        delay = self.prefill_delay_s
+        if delay <= 0:
+            from ray_tpu._private import config
+
+            delay = config.get("LLM_PREFILL_DELAY")
+        if delay > 0:
+            time.sleep(delay)
 
     def has_unfinished(self) -> bool:
         return bool(
@@ -301,6 +331,7 @@ class LLMEngine:
             if d and d[-1] == tok:
                 d.pop()
         req.done = True
+        req.finish_ts = time.time()
         self._stats["requests_finished"] += 1
         self._stream_ids.discard(req.request_id)
         finished.append(
@@ -308,6 +339,7 @@ class LLMEngine:
                 "request_id": req.request_id,
                 "prompt": req.prompt,
                 "tokens": req.out_tokens,
+                "timing": self._request_timing(req),
             }
         )
         if req.slot in self._active:
@@ -315,6 +347,30 @@ class LLMEngine:
             self._free.append(req.slot)
         self._release_pages(req)
         return True
+
+    @staticmethod
+    def _request_timing(req: _Request) -> dict:
+        """Wall-clock phase breakdown of one finished request: queue
+        (submit→prefill start), prefill (prefill start→first token),
+        decode (first token→finish), plus TTFT — the serve telemetry
+        span/histogram feed."""
+        t = {
+            "submit_ts": req.submit_ts,
+            "prefill_start_ts": req.prefill_start_ts,
+            "first_token_ts": req.first_token_ts,
+            "finish_ts": req.finish_ts,
+        }
+        if req.submit_ts and req.prefill_start_ts:
+            t["queue_s"] = max(0.0, req.prefill_start_ts - req.submit_ts)
+        if req.prefill_start_ts and req.first_token_ts:
+            t["prefill_s"] = max(
+                0.0, req.first_token_ts - req.prefill_start_ts
+            )
+        if req.submit_ts and req.first_token_ts:
+            t["ttft_s"] = max(0.0, req.first_token_ts - req.submit_ts)
+        if req.first_token_ts and req.finish_ts:
+            t["decode_s"] = max(0.0, req.finish_ts - req.first_token_ts)
+        return t
 
     def _release_pages(self, req: _Request) -> None:
         if self.kv == "paged":
@@ -330,6 +386,7 @@ class LLMEngine:
                 continue
             req = self._queue.pop(0)
             slot = self._free.pop(0)
+            self._begin_prefill(req)
             pad = min(_bucket(len(req.prompt)), self.max_seq)
             tokens = np.zeros((1, pad), np.int32)
             tokens[0, : len(req.prompt)] = req.prompt
@@ -353,6 +410,8 @@ class LLMEngine:
         )
         req.slot = slot
         req.position = ctx_len
+        if req.first_token_ts == 0.0:
+            req.first_token_ts = time.time()
         req.last_token = self._sample(last, req.sampling)
         self._stats["tokens_generated"] += 1  # the prefill-sampled token
         req.out_tokens.append(req.last_token)
@@ -411,6 +470,7 @@ class LLMEngine:
             return False
         self._queue.pop(0)
         slot = self._free.pop(0)
+        self._begin_prefill(req)
         pages = [self.alloc.share(pg) for pg in shared]
         for i in range(len(shared), need_pages):
             pg = self.alloc.alloc()

@@ -11,6 +11,7 @@ behind each other.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from ray_tpu.llm.engine import LLMEngine, SamplingParams
 from ray_tpu.llm.tokenizer import ByteTokenizer
@@ -26,10 +27,29 @@ class LLMServer:
         # request_id → queue of token-delta lists; None marks the end of
         # a stream (the feed for SSE streaming responses).
         self._streams: dict[str, asyncio.Queue] = {}
+        # request_id → engine timing of a finished streamed request
+        # (the pump parks it here; stream() reads it after the None
+        # sentinel to emit the prefill/decode spans).
+        self._timings: dict[str, dict] = {}
         self._pump_task: asyncio.Task | None = None
+        # Deployment label for telemetry: replicas learn their own name
+        # from the first request's context (the engine pump itself runs
+        # outside any request).
+        self._deployment = "llm"
+
+    def _note_deployment(self) -> str:
+        from ray_tpu.serve.context import get_request_context
+
+        dep = get_request_context().deployment
+        if dep:
+            self._deployment = dep
+        return self._deployment
 
     async def _pump(self):
+        from ray_tpu.serve import telemetry as stel
+
         loop = asyncio.get_running_loop()
+        tel_on = stel.enabled()
         try:
             while self.engine.has_unfinished():
                 # step() is blocking JAX compute (seconds on a first
@@ -43,10 +63,30 @@ class LLMServer:
                 for fin in finished:
                     fut = self._waiters.pop(fin["request_id"], None)
                     if fut is not None and not fut.done():
-                        fut.set_result(fin["tokens"])
+                        fut.set_result(fin)
                     q = self._streams.get(fin["request_id"])
                     if q is not None:
+                        self._timings[fin["request_id"]] = fin
                         q.put_nowait(None)
+                if tel_on:
+                    # Saturation gauges at step cadence: decode-slot
+                    # occupancy + paged-KV pool utilization — the
+                    # engine-side signals the SLO autoscaler reads.
+                    eng = self.engine
+                    stel.set_engine_gauges(
+                        self._deployment,
+                        active=len(eng._active),
+                        max_batch=eng.max_batch,
+                        pages_free=(
+                            eng.alloc.free_pages
+                            if eng.kv == "paged" else None
+                        ),
+                        pages_total=(
+                            eng.alloc.num_pages
+                            if eng.kv == "paged" else None
+                        ),
+                    )
+        # tpulint: allow(broad-except reason=the pump failure is fanned out to every pending waiter future and stream queue - nothing is swallowed)
         except Exception as e:  # noqa: BLE001
             # Fail every pending caller rather than hanging them forever.
             waiters, self._waiters = self._waiters, {}
@@ -76,15 +116,25 @@ class LLMServer:
             temperature=temperature,
             stop_token_ids=tuple(stop_token_ids),
         )
+        from ray_tpu.serve import telemetry as stel
+
+        deployment = self._note_deployment()
         rid = self.engine.add_request(tokens, sampling)
         fut = asyncio.get_running_loop().create_future()
         self._waiters[rid] = fut
         self._ensure_pump()
-        out = await fut
+        fin = await fut
+        out = fin["tokens"]
+        timing = fin.get("timing") or {}
+        if stel.enabled():
+            # serve:prefill / serve:decode under this request's replica
+            # span (the contextvar survives the await — same task).
+            stel.record_engine_phases(deployment, timing, len(out))
         return {
             "tokens": out,
             "text": self.tokenizer.decode(out),
             "num_generated": len(out),
+            "ttft_s": timing.get("ttft_s"),
         }
 
     async def stream(
@@ -105,11 +155,16 @@ class LLMServer:
             temperature=temperature,
             stop_token_ids=tuple(stop_token_ids),
         )
+        from ray_tpu.serve import telemetry as stel
+
+        deployment = self._note_deployment()
+        tel_on = stel.enabled()
         rid = self.engine.add_request(tokens, sampling, stream=True)
         q: asyncio.Queue = asyncio.Queue()
         self._streams[rid] = q
         self._ensure_pump()
         produced = 0
+        last_ts = time.time()
         try:
             while True:
                 delta = await q.get()
@@ -118,12 +173,25 @@ class LLMServer:
                 if isinstance(delta, BaseException):
                     raise delta
                 produced += len(delta)
+                if tel_on:
+                    # Per-delta decode spans ride the high-rate sampler
+                    # so a long generation can't storm the recorder.
+                    now = time.time()
+                    stel.record_token_span(
+                        deployment, last_ts, now - last_ts, len(delta)
+                    )
+                    last_ts = now
                 yield {
                     "tokens": delta,
                     "text": self.tokenizer.decode(delta),
                     "num_generated": produced,
                 }
         finally:
+            fin = self._timings.pop(rid, None)
+            if tel_on and fin is not None:
+                stel.record_engine_phases(
+                    deployment, fin.get("timing"), produced
+                )
             self._streams.pop(rid, None)
             # Client gone (or stream complete — then this is a no-op):
             # free the engine slot instead of decoding to max_tokens for

@@ -90,7 +90,12 @@ def composed_trainer_loop(config):
     """train_loop_per_worker for JaxTrainer: builds the composed
     {pp:2, ep:2, fsdp:N} mesh and runs real optimizer steps over the
     composed program, reporting metrics and a checkpoint through the
-    Train session (exercises worker group + checkpoint plumbing)."""
+    Train session (exercises worker group + checkpoint plumbing). Steps
+    are wrapped in train.step_span with compute/collective phases and a
+    flight-recorder-visible cross-worker metric sync, so the head
+    goodput ledger gets per-phase time AND comm-exposure attribution
+    (comm_exposed_s vs comm_overlapped_s) from this loop — the dryrun
+    asserts it."""
     import os
     import tempfile
 
@@ -98,24 +103,48 @@ def composed_trainer_loop(config):
     import numpy as np
 
     import ray_tpu.train as train
+    from ray_tpu import collective as col
     from ray_tpu.parallel import make_mesh
 
     ctx = train.get_context()
     mesh = make_mesh({"pp": 2, "ep": 2, "fsdp": int(config["fsdp"])})
     params = make_composed_params(jax.random.key(7))
+    # Attempt-scoped group name: an elastic retry must not rendezvous
+    # with a dead attempt's KV keys.
+    gname = f"composed_sync{ctx.attempt}"
+    col.init_collective_group(
+        ctx.get_world_size(), ctx.get_world_rank(), backend="cpu",
+        group_name=gname,
+    )
     loss = None
-    for step in range(int(config.get("steps", 2))):
-        loss, grads = composed_value_and_grad(params, mesh)
-        params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
-        ckpt = None
-        if ctx.get_world_rank() == 0:
-            ckpt = tempfile.mkdtemp(prefix="composed_ck_")
-            np.savez(
-                os.path.join(ckpt, "params.npz"),
-                **{k: np.asarray(v) for k, v in params.items()},
+    try:
+        for step in range(int(config.get("steps", 2))):
+            with train.step_span() as sp:
+                with sp.phase("compute"):
+                    loss, grads = composed_value_and_grad(params, mesh)
+                    params = jax.tree.map(
+                        lambda p, g: p - 0.1 * g, params, grads
+                    )
+                with sp.phase("collective"):
+                    # Cross-worker loss mean through the recorded
+                    # collective path (the compiled program's psums are
+                    # invisible to the flight recorder; this op is what
+                    # the comm-exposure ledger attributes).
+                    mean_loss = col.allreduce(
+                        np.asarray([float(loss)], np.float32),
+                        group_name=gname,
+                    )[0] / max(1, ctx.get_world_size())
+            ckpt = None
+            if ctx.get_world_rank() == 0:
+                ckpt = tempfile.mkdtemp(prefix="composed_ck_")
+                np.savez(
+                    os.path.join(ckpt, "params.npz"),
+                    **{k: np.asarray(v) for k, v in params.items()},
+                )
+            train.report(
+                {"loss": float(mean_loss), "step": step,
+                 "mesh": {"pp": 2, "ep": 2, "fsdp": int(config["fsdp"])}},
+                checkpoint=ckpt,
             )
-        train.report(
-            {"loss": float(loss), "step": step,
-             "mesh": {"pp": 2, "ep": 2, "fsdp": int(config["fsdp"])}},
-            checkpoint=ckpt,
-        )
+    finally:
+        col.destroy_collective_group(gname)

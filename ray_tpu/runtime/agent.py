@@ -13,6 +13,7 @@ Endpoints:
     /api/logs        worker log listing (node-local files)
     /api/logs/<wid>  one worker's log (raw text, ?tail=N bytes)
     /api/train       per-job train goodput (head passthrough)
+    /api/serve       per-deployment serve SLO ledger (head passthrough)
     /api/checkpoints shard-store checkpoint table (head passthrough)
     /metrics         node-local Prometheus text
 """
@@ -139,6 +140,13 @@ class NodeAgent:
         run = query.get("run", [None])[0]
         return await self.node.head.call("ckpt_list", run=run)
 
+    async def _serve(self, query) -> dict:
+        """Head passthrough: per-deployment serve SLO ledger (same data
+        as the dashboard's /api/serve)."""
+        if self.node.head is None:
+            return {"error": "node has no head connection"}
+        return await self.node.head.call("serve_stats")
+
     def _metrics(self, query) -> str:
         s = self._stats(query)
         lines = [
@@ -201,6 +209,11 @@ class NodeAgent:
             elif path == "/api/checkpoints":
                 body, ctype = (
                     json.dumps(await self._checkpoints(query)),
+                    "application/json",
+                )
+            elif path == "/api/serve":
+                body, ctype = (
+                    json.dumps(await self._serve(query)),
                     "application/json",
                 )
             elif path == "/metrics":

@@ -75,6 +75,13 @@ class HeadService:
         # attempt restarts (gap between the last step of attempt N and
         # the first step of attempt N+1).
         self.train_runs: dict[str, dict] = {}
+        # Per-deployment serve SLO ledger, folded from "serve:ingress"
+        # SPAN events the same way train_runs folds "train:step":
+        # request/error counts, sliding TTFT/latency windows, SLO
+        # attainment over SERVE_SLO_WINDOW_S, and a burn-rate alert
+        # (ray_tpu_serve_slo_alert) with an OFF→ON warn log. Keyed
+        # "app/deployment".
+        self.serve_runs: dict[str, dict] = {}
         # Collective-group membership (the fault-tolerance layer's view):
         # group → {"epoch": int, "members": {rank: {addr, node_addr,
         # worker_id, dead}}}. Node/worker death fans out to survivors on
@@ -2130,6 +2137,13 @@ class HeadService:
                 # step spans additionally drive per-job goodput.
                 if ev.get("name") == "train:step" and ev.get("train_job"):
                     self._train_step_event(ev)
+                # Ingress spans additionally drive the per-deployment
+                # serve SLO ledger.
+                elif (
+                    ev.get("name") == "serve:ingress"
+                    and ev.get("deployment")
+                ):
+                    self._serve_request_event(ev)
                 continue
             if tid:
                 prev = self.task_latest.pop(tid, None)
@@ -2193,6 +2207,12 @@ class HeadService:
                 "stall_s": 0.0,
                 "degraded_s": 0.0,
                 "restart_lost_s": 0.0,
+                # comm-exposure attribution (rank 0's step spans):
+                # collective seconds NOT hidden behind compute vs the
+                # overlapped remainder, and the step-second denominator.
+                "comm_exposed_s": 0.0,
+                "comm_overlapped_s": 0.0,
+                "step_s": 0.0,
                 "first_ts": float(ev.get("ts") or 0.0),
                 "last_end_ts": None,
                 "mfu": None,
@@ -2245,6 +2265,12 @@ class HeadService:
         rec["productive_s"] += dur - in_step_lost - degraded
         rec["degraded_s"] += degraded
         rec["stall_s"] += in_step_lost
+        rec["step_s"] += dur
+        for key in ("comm_exposed_s", "comm_overlapped_s"):
+            try:
+                rec[key] += max(0.0, float(ev.get(key) or 0.0))
+            except (TypeError, ValueError):
+                pass
         if isinstance(ev.get("mfu"), (int, float)):
             rec["mfu"] = float(ev["mfu"])
         rec["last_end_ts"] = max(rec["last_end_ts"] or 0.0, start + dur)
@@ -2285,12 +2311,19 @@ class HeadService:
             rec["productive_s"] + rec["stall_s"] + rec["degraded_s"]
             + rec["restart_lost_s"]
         )
+        step_s = rec.get("step_s", 0.0)
+        exposed = rec.get("comm_exposed_s", 0.0)
         return {
             "goodput": rec["productive_s"] / denom if denom > 0 else 1.0,
             "productive_s": rec["productive_s"],
             "stall_s": rec["stall_s"],
             "degraded_s": rec["degraded_s"],
             "restart_lost_s": rec["restart_lost_s"],
+            "comm_exposed_s": exposed,
+            "comm_overlapped_s": rec.get("comm_overlapped_s", 0.0),
+            "comm_exposed_ratio": (
+                exposed / step_s if step_s > 0 else 0.0
+            ),
             "steps": rec["steps"],
             "attempts": rec["attempts_seen"],
             "current_attempt": rec["attempt"],
@@ -2309,6 +2342,162 @@ class HeadService:
                 job: self._train_job_public(rec)
                 for job, rec in self.train_runs.items()
             }
+        }
+
+    # --------------------------------------------------- serve SLO ledger
+    def _serve_request_event(self, ev: dict) -> None:
+        """Fold one proxy ``serve:ingress`` span into the deployment's
+        SLO ledger (the serving twin of _train_step_event). A request
+        ATTAINS its SLO when it succeeded AND its TTFT is within
+        SERVE_SLO_TTFT_S AND its end-to-end latency is within
+        SERVE_SLO_LATENCY_S; attainment over the sliding window below
+        SERVE_SLO_TARGET flips the burn-rate alert."""
+        key = f'{ev.get("app") or "default"}/{ev["deployment"]}'
+        rec = self.serve_runs.get(key)
+        if rec is None:
+            if len(self.serve_runs) >= 200:
+                oldest = min(
+                    self.serve_runs,
+                    key=lambda k: self.serve_runs[k]["first_ts"],
+                )
+                del self.serve_runs[oldest]
+            rec = self.serve_runs[key] = {
+                "requests": 0,
+                "errors": 0,
+                "streamed": 0,
+                "items": 0,
+                "first_ts": float(ev.get("ts") or 0.0),
+                "last_ts": None,
+                # sliding window: (end_ts, latency_s, ttft_s, attained)
+                "window": [],
+                "alert": False,
+            }
+        try:
+            start = float(ev["ts"])
+            dur = max(0.0, float(ev.get("dur") or 0.0))
+        except (TypeError, ValueError):
+            return
+        try:
+            ttft = float(ev.get("ttft_s")) if ev.get("ttft_s") is not None \
+                else dur
+        except (TypeError, ValueError):
+            ttft = dur
+        try:
+            status = int(ev.get("status") or 0)
+        except (TypeError, ValueError):
+            status = 0
+        from ray_tpu._private import config
+
+        ok = status < 400
+        attained = (
+            ok
+            and ttft <= config.get("SERVE_SLO_TTFT_S")
+            and dur <= config.get("SERVE_SLO_LATENCY_S")
+        )
+        rec["requests"] += 1
+        rec["errors"] += 0 if ok else 1
+        rec["streamed"] += 1 if ev.get("streamed") else 0
+        try:
+            rec["items"] += int(ev.get("items") or 0)
+        except (TypeError, ValueError):
+            pass
+        end_ts = start + dur
+        rec["last_ts"] = max(rec["last_ts"] or 0.0, end_ts)
+        window_s = config.get("SERVE_SLO_WINDOW_S")
+        rec["window"].append((end_ts, dur, ttft, attained))
+        cutoff = end_ts - window_s
+        rec["window"] = [w for w in rec["window"] if w[0] >= cutoff]
+        attain_frac = (
+            sum(1 for w in rec["window"] if w[3]) / len(rec["window"])
+            if rec["window"] else 1.0
+        )
+        alert = (
+            bool(rec["window"])
+            and attain_frac < config.get("SERVE_SLO_TARGET")
+        )
+        if alert and not rec["alert"]:
+            logger.warning(
+                "serve deployment %r: SLO attainment %.0f%% over the "
+                "last %.0fs fell below the %.0f%% target "
+                "(ttft<=%.2fs, latency<=%.2fs)",
+                key, 100.0 * attain_frac, window_s,
+                100.0 * config.get("SERVE_SLO_TARGET"),
+                config.get("SERVE_SLO_TTFT_S"),
+                config.get("SERVE_SLO_LATENCY_S"),
+            )
+        rec["alert"] = alert
+
+    @staticmethod
+    def _percentile(values: list[float], q: float) -> float | None:
+        if not values:
+            return None
+        ordered = sorted(values)
+        idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
+        return ordered[idx]
+
+    def _serve_deployment_public(self, rec: dict) -> dict:
+        ttfts = [w[2] for w in rec["window"]]
+        lats = [w[1] for w in rec["window"]]
+        attained = sum(1 for w in rec["window"] if w[3])
+        n = len(rec["window"])
+        return {
+            "requests": rec["requests"],
+            "errors": rec["errors"],
+            "streamed": rec["streamed"],
+            "items": rec["items"],
+            "window_requests": n,
+            "ttft_p50_s": self._percentile(ttfts, 0.50),
+            "ttft_p99_s": self._percentile(ttfts, 0.99),
+            "latency_p50_s": self._percentile(lats, 0.50),
+            "latency_p99_s": self._percentile(lats, 0.99),
+            "attainment": attained / n if n else 1.0,
+            "alert": rec["alert"],
+            "first_ts": rec["first_ts"],
+            "last_ts": rec["last_ts"],
+        }
+
+    async def _on_serve_stats(self, conn):
+        """Per-deployment serve SLO rollup (dashboard /api/serve, agent
+        passthrough, `ray_tpu slo`)."""
+        return {
+            "deployments": {
+                key: self._serve_deployment_public(rec)
+                for key, rec in self.serve_runs.items()
+            }
+        }
+
+    def _serve_metrics_snapshot(self) -> dict | None:
+        """Head-owned serve SLO gauges in worker-snapshot format (the
+        serving twin of _train_metrics_snapshot): attainment + alert per
+        deployment, surviving the proxies they were measured at."""
+        if not self.serve_runs:
+            return None
+        from ray_tpu.util.metrics import escape_label_value as _esc
+
+        attain: dict[str, float] = {}
+        alert: dict[str, float] = {}
+        for key, rec in self.serve_runs.items():
+            pub = self._serve_deployment_public(rec)
+            tag = f'deployment="{_esc(key)}"'
+            attain[tag] = round(pub["attainment"], 6)
+            alert[tag] = 1.0 if rec["alert"] else 0.0
+        return {
+            "ray_tpu_serve_slo_attainment": {
+                "kind": "gauge",
+                "description": "fraction of requests meeting their "
+                               "TTFT/latency SLO over the sliding "
+                               "window, per deployment",
+                "series": attain,
+                "boundaries": None,
+            },
+            "ray_tpu_serve_slo_alert": {
+                "kind": "gauge",
+                "description": "1 when a deployment's SLO attainment "
+                               "over the window is below "
+                               "SERVE_SLO_TARGET",
+                "series": alert,
+                "boundaries": None,
+            },
         }
 
     def _train_metrics_snapshot(self) -> dict | None:
@@ -2391,9 +2580,10 @@ class HeadService:
             if now - rec["ts"] > self.METRICS_TTL_S:
                 del self.metrics[w]
         workers = {w: rec["snap"] for w, rec in self.metrics.items()}
-        train_snap = self._train_metrics_snapshot()
-        if train_snap:
-            workers["head"] = train_snap
+        head_snap = dict(self._train_metrics_snapshot() or {})
+        head_snap.update(self._serve_metrics_snapshot() or {})
+        if head_snap:
+            workers["head"] = head_snap
         return {"workers": workers}
 
     # ----------------------------------------------------------- health

@@ -159,12 +159,65 @@ def cmd_goodput(args) -> int:
             f"stalls={j['stall_s']:.2f}s  "
             f"restart_lost={j['restart_lost_s']:.2f}s"
         )
+        if j.get("comm_exposed_s") or j.get("comm_overlapped_s"):
+            print(
+                f"  comm: exposed={j['comm_exposed_s']:.2f}s  "
+                f"overlapped={j.get('comm_overlapped_s', 0.0):.2f}s  "
+                f"exposed_ratio={j.get('comm_exposed_ratio', 0.0):.3f}"
+            )
         if j.get("phase_s"):
             phases = "  ".join(
                 f"{k}={v:.2f}s" for k, v in sorted(j["phase_s"].items())
             )
             print(f"  phases: {phases}")
     return 0
+
+
+def _fmt_ms(v) -> str:
+    return f"{v * 1e3:.0f}ms" if v is not None else "—"
+
+
+def print_slo(deployments: dict, as_json: bool = False) -> int:
+    """Render the per-deployment serve SLO ledger (factored out of
+    cmd_slo so tier-1 can smoke the exact CLI output path without a
+    daemonized cluster)."""
+    if as_json:
+        json.dump(deployments, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    if not deployments:
+        print("no serve deployments have reported requests")
+        return 0
+    for name, d in sorted(deployments.items()):
+        alert = "  ALERT" if d.get("alert") else ""
+        print(
+            f"{name}: requests={d['requests']}  errors={d['errors']}  "
+            f"attainment={d['attainment']:.3f}{alert}"
+        )
+        print(
+            f"  ttft p50={_fmt_ms(d.get('ttft_p50_s'))} "
+            f"p99={_fmt_ms(d.get('ttft_p99_s'))}  "
+            f"latency p50={_fmt_ms(d.get('latency_p50_s'))} "
+            f"p99={_fmt_ms(d.get('latency_p99_s'))}  "
+            f"window={d.get('window_requests', 0)} reqs"
+        )
+        if d.get("streamed"):
+            print(
+                f"  streamed={d['streamed']}  items={d.get('items', 0)}"
+            )
+    return 0
+
+
+def cmd_slo(args) -> int:
+    """Per-deployment serve SLO rollup: TTFT/latency percentiles over
+    the sliding window, attainment vs SERVE_SLO_TTFT_S /
+    SERVE_SLO_LATENCY_S, and the burn-rate alert state (the head's
+    serve:ingress-span accounting; same data as /api/serve)."""
+    from ray_tpu.util import state
+
+    _connect(args.address, getattr(args, "session_dir", None))
+    deployments = state.serve_stats().get("deployments", {})
+    return print_slo(deployments, as_json=args.json)
 
 
 def cmd_ckpt(args) -> int:
@@ -551,6 +604,11 @@ def main(argv=None) -> int:
     gp = sub.add_parser("goodput")
     gp.add_argument("--json", action="store_true",
                     help="raw per-job stats as JSON")
+    slo = sub.add_parser("slo",
+                         help="per-deployment serve SLO attainment "
+                              "(TTFT/latency percentiles + alert)")
+    slo.add_argument("--json", action="store_true",
+                     help="raw per-deployment stats as JSON")
     cp = sub.add_parser("ckpt",
                         help="in-cluster shard-store checkpoints")
     cp.add_argument("action", choices=["ls", "verify"],
@@ -584,6 +642,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "metrics": cmd_metrics,
         "goodput": cmd_goodput,
+        "slo": cmd_slo,
         "ckpt": cmd_ckpt,
         "logs": cmd_logs,
         "dashboard": cmd_dashboard,

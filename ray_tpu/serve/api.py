@@ -6,6 +6,7 @@
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any
 
@@ -14,6 +15,8 @@ from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.controller import ServeController
 from ray_tpu.serve.deployment import Application, Deployment
 from ray_tpu.serve.handle import CONTROLLER_NAME, DeploymentHandle
+
+logger = logging.getLogger("ray_tpu.serve")
 
 PROXY_NAME = "_SERVE_PROXY"
 
@@ -161,7 +164,10 @@ def shutdown():
         try:
             ray_tpu.get(controller.graceful_shutdown.remote(), timeout=10)
         except Exception:  # noqa: BLE001
-            pass
+            logger.debug(
+                "graceful controller shutdown failed; killing it",
+                exc_info=True,
+            )
         ray_tpu.kill(controller)
     from ray_tpu.serve.grpc_ingress import GRPC_INGRESS_NAME
 

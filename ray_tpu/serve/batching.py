@@ -24,11 +24,26 @@ class _BatchQueue:
         self._timeout = timeout_s
         self._pending: list[tuple] = []  # (arg, future)
         self._flusher: asyncio.Task | None = None
+        # Telemetry label: the deployment this queue batches for when
+        # known (first submit runs under the request context), else the
+        # wrapped function's name — bounded either way.
+        self._label = getattr(fn, "__qualname__", "batch")
 
     async def submit(self, arg):
+        from ray_tpu.serve import telemetry as stel
+        from ray_tpu.serve.context import get_request_context
+
+        dep = get_request_context().deployment
+        if dep:
+            self._label = dep
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._pending.append((arg, fut))
+        if stel.enabled():
+            stel.BATCH_OCCUPANCY.set(
+                len(self._pending) / max(1, self._max),
+                tags={"deployment": self._label},
+            )
         if len(self._pending) >= self._max:
             self._flush_now()
         elif self._flusher is None or self._flusher.done():
@@ -49,7 +64,12 @@ class _BatchQueue:
         asyncio.ensure_future(self._run_batch(batch))
 
     async def _run_batch(self, batch: list[tuple]):
+        import time
+
+        from ray_tpu.serve import telemetry as stel
+
         args = [a for a, _ in batch]
+        start = time.time()
         try:
             if self._self_arg is not None:
                 results = self._fn(self._self_arg, args)
@@ -65,6 +85,26 @@ class _BatchQueue:
             for (_, fut), r in zip(batch, results):
                 if not fut.done():
                     fut.set_result(r)
+            if stel.enabled():
+                # One sampled span per flush: occupancy + wait are the
+                # signals that tune max_batch_size/batch_wait_timeout_s.
+                from ray_tpu.collective import flight_recorder
+                from ray_tpu.util import tracing
+
+                dur = time.time() - start
+                emit, n = flight_recorder.span_sample(
+                    self._label, "serve:batch", dur
+                )
+                if emit:
+                    attrs = {
+                        "deployment": self._label,
+                        "batch_size": len(batch),
+                        "occupancy": round(len(batch) / max(1, self._max), 3),
+                    }
+                    if n > 1:
+                        attrs["sample_rate"] = n
+                    tracing.emit_span("serve:batch", start, dur, **attrs)
+        # tpulint: allow(broad-except reason=the batch failure is fanned out to every caller's future - nothing is swallowed)
         except Exception as e:  # noqa: BLE001 - fan the error out
             for _, fut in batch:
                 if not fut.done():

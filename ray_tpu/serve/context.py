@@ -15,6 +15,9 @@ class RequestContext:
     multiplexed_model_id: str = ""
     route: str = ""
     app_name: str = ""
+    # Deployment this request was routed to (the bounded label serve
+    # telemetry keys its histograms/gauges by).
+    deployment: str = ""
 
 
 _request_context: contextvars.ContextVar[RequestContext] = (

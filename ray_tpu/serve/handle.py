@@ -15,6 +15,7 @@ replicas, the controller).
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import time
 import uuid
@@ -23,6 +24,8 @@ from dataclasses import dataclass
 
 from ray_tpu import api as core_api
 from ray_tpu.runtime.core_worker import ActorSubmitTarget
+
+logger = logging.getLogger("ray_tpu.serve")
 
 CONTROLLER_NAME = "_SERVE_CONTROLLER"
 _REFRESH_S = 2.0
@@ -150,11 +153,22 @@ class _Router:
         """Report demand while there is any; exit after a short idle
         period (a final 0 report) so dropped handles don't leak an
         eternal task + RPC stream."""
+        from ray_tpu.serve import telemetry as stel
+
         router_id = self._router_id
         idle_since = None
+        tel_on = stel.enabled()
         try:
             while True:
                 demand = self._demand()
+                if tel_on:
+                    # Same cadence as the autoscaling demand report: the
+                    # queue-depth gauge IS that signal, scrapeable.
+                    stel.QUEUE_DEPTH.set(
+                        demand,
+                        tags={"app": self.app_name,
+                              "deployment": self.deployment_name},
+                    )
                 controller = await self._resolve_controller()
                 await self._call_actor(
                     controller,
@@ -173,7 +187,11 @@ class _Router:
                     idle_since = None
                 await asyncio.sleep(0.3)
         except Exception:  # noqa: BLE001 - controller gone; stop quietly
-            pass
+            logger.debug(
+                "handle demand reporter for %s/%s stopped "
+                "(controller unreachable)",
+                self.app_name, self.deployment_name, exc_info=True,
+            )
 
     async def _core(self):
         core = core_api._runtime.core
@@ -270,6 +288,43 @@ class _Router:
             else b
         )
 
+    def _request_ctx(self, model_id: str) -> dict:
+        """Per-call request context shipped to the replica. When serve
+        telemetry is on and a trace context is active (a proxy ingress
+        span, or any caller running under a span), it rides along so
+        the replica's spans join the same tree."""
+        ctx = {
+            "request_id": uuid.uuid4().hex[:16],
+            "multiplexed_model_id": model_id,
+            "app_name": self.app_name,
+            "deployment": self.deployment_name,
+        }
+        from ray_tpu.serve import telemetry as stel
+
+        if stel.enabled():
+            from ray_tpu.util import tracing
+
+            active = tracing.active_context()
+            if active is not None:
+                ctx["trace"] = list(active)
+        return ctx
+
+    async def _acquire_replica_traced(self, model_id: str) -> _ReplicaTarget:
+        """_acquire_replica plus a ``serve:queue`` span covering the
+        wait for a replica slot — the queueing phase of the request
+        span tree (sampled under storm, see telemetry.record_queue_wait)."""
+        from ray_tpu.serve import telemetry as stel
+
+        if not stel.enabled():
+            return await self._acquire_replica(model_id)
+        q_start = time.time()
+        replica = await self._acquire_replica(model_id)
+        stel.record_queue_wait(
+            self.app_name, self.deployment_name, q_start,
+            time.time() - q_start,
+        )
+        return replica
+
     async def _acquire_replica(self, model_id: str) -> _ReplicaTarget:
         waiting = False
         try:
@@ -303,15 +358,11 @@ class _Router:
             k: (await v if isinstance(v, DeploymentResponse) else v)
             for k, v in kwargs.items()
         }
-        ctx = {
-            "request_id": uuid.uuid4().hex[:16],
-            "multiplexed_model_id": model_id,
-            "app_name": self.app_name,
-        }
+        ctx = self._request_ctx(model_id)
         self._ensure_reporter()
         deaths = 0
         while True:
-            replica = await self._acquire_replica(model_id)
+            replica = await self._acquire_replica_traced(model_id)
             self._inflight[replica.actor_id] = (
                 self._inflight.get(replica.actor_id, 0) + 1
             )
@@ -376,16 +427,12 @@ class _Router:
             k: (await v if isinstance(v, DeploymentResponse) else v)
             for k, v in kwargs.items()
         }
-        ctx = {
-            "request_id": uuid.uuid4().hex[:16],
-            "multiplexed_model_id": model_id,
-            "app_name": self.app_name,
-        }
+        ctx = self._request_ctx(model_id)
         self._ensure_reporter()
         core = await self._core()
         deaths = 0
         while True:
-            replica = await self._acquire_replica(model_id)
+            replica = await self._acquire_replica_traced(model_id)
             self._inflight[replica.actor_id] = (
                 self._inflight.get(replica.actor_id, 0) + 1
             )

@@ -265,65 +265,97 @@ class ProxyActor:
     ) -> bool:
         # Everything below must produce an HTTP response, never a bare
         # connection drop (streaming manages its own error framing).
-        try:
-            await self._refresh_routes()
-            parsed = urllib.parse.urlparse(target)
-            match = self._match_route(parsed.path)
-            if match is None:
-                # A just-deployed app may not be in the cached table yet.
-                await self._refresh_routes(force=True)
-                match = self._match_route(parsed.path)
-            if match is None:
-                await self._respond(writer, 404, b"no route", keep_alive)
-                return keep_alive
+        # Request-path telemetry: mint (or adopt from traceparent /
+        # x-request-id) a trace context at ingress; everything awaited
+        # inside the `with tel:` scope — handle dispatch, replica,
+        # engine — parents its spans under the serve:ingress root.
+        from ray_tpu.serve import telemetry as stel
 
-            query = dict(urllib.parse.parse_qsl(parsed.query))
-            payload: object = body
-            if body:
-                try:
-                    payload = json.loads(body)
-                except ValueError:
-                    payload = body
-            request = {
-                "method": method,
-                "path": parsed.path,
-                "query": query,
-                "headers": headers,
-                "body": payload,
-            }
-            want_stream = (
-                "text/event-stream" in headers.get("accept", "")
-                or query.get("stream", "").lower() in ("1", "true")
-                or (isinstance(payload, dict) and bool(payload.get("stream")))
-            )
-            handle, timeout_s = self._handle_for(match)
-            if want_stream:
-                self._stats["streams"] += 1
-                # A long-lived stream buffers nothing after this point;
-                # holding the slot for its whole duration would let 256
-                # legitimate SSE clients starve every unary request.
-                release()
-                return await self._respond_stream(
-                    writer, handle, request, keep_alive, timeout_s
+        tel = stel.begin_request(headers)
+        app_name = dep_name = route = ""
+        with tel:
+            try:
+                await self._refresh_routes()
+                parsed = urllib.parse.urlparse(target)
+                match = self._match_route(parsed.path)
+                if match is None:
+                    # A just-deployed app may not be in the cached table
+                    # yet.
+                    await self._refresh_routes(force=True)
+                    match = self._match_route(parsed.path)
+                if match is None:
+                    # Unmatched requests never reach a deployment: no
+                    # SLO sample, no span (an unbounded scan of bogus
+                    # paths must not pollute the ledger).
+                    await self._respond(writer, 404, b"no route", keep_alive)
+                    return keep_alive
+
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                payload: object = body
+                if body:
+                    try:
+                        payload = json.loads(body)
+                    except ValueError:
+                        payload = body
+                request = {
+                    "method": method,
+                    "path": parsed.path,
+                    "query": query,
+                    "headers": headers,
+                    "body": payload,
+                }
+                want_stream = (
+                    "text/event-stream" in headers.get("accept", "")
+                    or query.get("stream", "").lower() in ("1", "true")
+                    or (isinstance(payload, dict)
+                        and bool(payload.get("stream")))
                 )
-            result = await asyncio.wait_for(
-                handle.remote(request), timeout_s
-            )
-            if isinstance(result, bytes):
-                out = result
-            elif isinstance(result, str):
-                out = result.encode()
-            else:
-                out = json.dumps(result).encode()
-        except asyncio.TimeoutError:
-            self._stats["errors"] += 1
-            await self._respond(writer, 408, b"request timed out", keep_alive)
-            return keep_alive
-        # tpulint: allow(broad-except reason=the failure is propagated to the client as the 500 body and counted in proxy stats)
-        except Exception as e:  # noqa: BLE001 - user/routing error → 500
-            self._stats["errors"] += 1
-            await self._respond(writer, 500, str(e).encode(), keep_alive)
-            return keep_alive
+                handle, timeout_s = self._handle_for(match)
+                app_name = handle.app_name
+                dep_name = handle.deployment_name
+                route = match
+                if want_stream:
+                    self._stats["streams"] += 1
+                    # A long-lived stream buffers nothing after this
+                    # point; holding the slot for its whole duration
+                    # would let 256 legitimate SSE clients starve every
+                    # unary request.
+                    release()
+                    info = {"status": 200, "items": 0}
+                    ka = await self._respond_stream(
+                        writer, handle, request, keep_alive, timeout_s,
+                        tel, info,
+                    )
+                    tel.finish(
+                        app_name, dep_name, route, info["status"],
+                        streamed=True, items=info["items"],
+                    )
+                    return ka
+                result = await asyncio.wait_for(
+                    handle.remote(request), timeout_s
+                )
+                if isinstance(result, bytes):
+                    out = result
+                elif isinstance(result, str):
+                    out = result.encode()
+                else:
+                    out = json.dumps(result).encode()
+            except asyncio.TimeoutError:
+                self._stats["errors"] += 1
+                if dep_name:
+                    tel.finish(app_name, dep_name, route, 408)
+                await self._respond(
+                    writer, 408, b"request timed out", keep_alive
+                )
+                return keep_alive
+            # tpulint: allow(broad-except reason=the failure is propagated to the client as the 500 body and counted in proxy stats)
+            except Exception as e:  # noqa: BLE001 - user/routing error → 500
+                self._stats["errors"] += 1
+                if dep_name:
+                    tel.finish(app_name, dep_name, route, 500)
+                await self._respond(writer, 500, str(e).encode(), keep_alive)
+                return keep_alive
+            tel.finish(app_name, dep_name, route, 200)
         await self._respond(writer, 200, out, keep_alive)
         return keep_alive
 
@@ -382,10 +414,17 @@ class ProxyActor:
         request: dict,
         keep_alive: bool,
         timeout_s: float = _REQUEST_TIMEOUT_S,
+        tel=None,
+        info: dict | None = None,
     ) -> bool:
         """Stream the handle call as SSE over chunked transfer encoding.
         Headers are written only once the first item (or first error)
-        arrives, so pre-stream failures still get a clean HTTP status."""
+        arrives, so pre-stream failures still get a clean HTTP status.
+        ``tel``/``info`` (serve telemetry): first_byte() marks TTFT on
+        the first frame; item count and effective status land in
+        ``info`` for the ingress span."""
+        if info is None:
+            info = {}
         stream: DeploymentStreamResponse = handle.options(stream=True).remote(
             request
         )
@@ -419,10 +458,12 @@ class ProxyActor:
                     if not started:
                         # Mirror the unary path: a pre-first-item timeout
                         # is a clean 408, not an empty 500.
+                        info["status"] = 408
                         await self._respond(
                             writer, 408, b"request timed out", keep_alive
                         )
                         return keep_alive
+                    info["status"] = 500
                     err = json.dumps({"error": "stream item timed out"})
                     writer.write(
                         _chunk(f"event: error\ndata: {err}\n\n".encode())
@@ -432,7 +473,10 @@ class ProxyActor:
                     return False
                 if not started:
                     started = True
+                    if tel is not None:
+                        tel.first_byte()
                     writer.write(_sse_headers())
+                info["items"] = info.get("items", 0) + 1
                 writer.write(_chunk(_sse_frame(item)))
                 await writer.drain()
             if not started:
@@ -444,11 +488,13 @@ class ProxyActor:
             return keep_alive
         except (ConnectionResetError, BrokenPipeError):
             # Client went away: stop the replica-side generator.
+            info["status"] = 499  # nginx convention: client closed
             await agen.aclose()
             return False
         # tpulint: allow(broad-except reason=the failure reaches the client — as a 500 before the stream starts, as a terminal SSE error event mid-stream — and is counted in proxy stats)
         except Exception as e:  # noqa: BLE001
             self._stats["errors"] += 1
+            info["status"] = 500
             await agen.aclose()
             if not started:
                 await self._respond(writer, 500, str(e).encode(), keep_alive)

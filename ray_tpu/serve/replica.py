@@ -13,10 +13,36 @@ router's job via in-flight caps.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import contextvars
 import inspect
 
 from ray_tpu.serve.context import RequestContext, set_request_context
+
+
+def _replica_scope(deployment_name: str, request_context: dict | None):
+    """Span scope for one replica call: when the router shipped a trace
+    context (serve telemetry on, ingress span upstream), run the user
+    code under a ``serve:replica`` span parented to it — engine spans
+    emitted inside (prefill/decode) then chain under this replica span.
+    Returns (scope_cm, context_kwargs): the kwargs are the RequestContext
+    fields with the transport-only "trace" key stripped."""
+    ctx = dict(request_context or {})
+    trace = ctx.pop("trace", None)
+    if not trace:
+        return contextlib.nullcontext(), ctx
+    from ray_tpu.util import tracing
+
+    return (
+        tracing.linked_span(
+            "serve:replica",
+            parent=(trace[0], trace[1]),
+            deployment=deployment_name,
+            app=ctx.get("app_name", ""),
+            request_id=ctx.get("request_id", ""),
+        ),
+        ctx,
+    )
 
 
 class ReplicaActor:
@@ -59,21 +85,26 @@ class ReplicaActor:
         request_context: dict | None = None,
     ):
         self._num_ongoing += 1
+        scope, ctx_kwargs = _replica_scope(
+            self.deployment_name, request_context
+        )
         try:
-            set_request_context(RequestContext(**(request_context or {})))
-            if inspect.isfunction(self._callable):
-                fn = self._callable  # function deployment
-            else:
-                fn = getattr(self._callable, method_name)
-            if inspect.iscoroutinefunction(fn):
-                return await fn(*request_args, **request_kwargs)
-            # Run sync user code off the event loop, propagating the
-            # request contextvars into the executor thread.
-            ctx = contextvars.copy_context()
-            loop = asyncio.get_running_loop()
-            return await loop.run_in_executor(
-                None, lambda: ctx.run(fn, *request_args, **request_kwargs)
-            )
+            with scope:
+                set_request_context(RequestContext(**ctx_kwargs))
+                if inspect.isfunction(self._callable):
+                    fn = self._callable  # function deployment
+                else:
+                    fn = getattr(self._callable, method_name)
+                if inspect.iscoroutinefunction(fn):
+                    return await fn(*request_args, **request_kwargs)
+                # Run sync user code off the event loop, propagating the
+                # request contextvars into the executor thread.
+                ctx = contextvars.copy_context()
+                loop = asyncio.get_running_loop()
+                return await loop.run_in_executor(
+                    None,
+                    lambda: ctx.run(fn, *request_args, **request_kwargs),
+                )
         finally:
             self._num_ongoing -= 1
             self._num_served += 1
@@ -91,40 +122,45 @@ class ReplicaActor:
         produced; a non-generator result yields exactly once, so the
         router can use one call shape for both."""
         self._num_ongoing += 1
+        scope, ctx_kwargs = _replica_scope(
+            self.deployment_name, request_context
+        )
         try:
-            set_request_context(RequestContext(**(request_context or {})))
-            if inspect.isfunction(self._callable):
-                fn = self._callable
-            else:
-                fn = getattr(self._callable, method_name)
-            if inspect.isasyncgenfunction(fn):
-                result = fn(*request_args, **request_kwargs)
-            elif inspect.iscoroutinefunction(fn):
-                result = await fn(*request_args, **request_kwargs)
-            else:
-                ctx = contextvars.copy_context()
-                loop = asyncio.get_running_loop()
-                result = await loop.run_in_executor(
-                    None,
-                    lambda: ctx.run(fn, *request_args, **request_kwargs),
-                )
-            if inspect.isasyncgen(result):
-                async for item in result:
-                    yield item
-            elif inspect.isgenerator(result):
-                # Drive sync generators off-loop so user compute between
-                # yields doesn't stall this replica's other requests.
-                loop = asyncio.get_running_loop()
-                _done = object()
-                while True:
-                    item = await loop.run_in_executor(
-                        None, lambda: next(result, _done)
+            with scope:
+                set_request_context(RequestContext(**ctx_kwargs))
+                if inspect.isfunction(self._callable):
+                    fn = self._callable
+                else:
+                    fn = getattr(self._callable, method_name)
+                if inspect.isasyncgenfunction(fn):
+                    result = fn(*request_args, **request_kwargs)
+                elif inspect.iscoroutinefunction(fn):
+                    result = await fn(*request_args, **request_kwargs)
+                else:
+                    ctx = contextvars.copy_context()
+                    loop = asyncio.get_running_loop()
+                    result = await loop.run_in_executor(
+                        None,
+                        lambda: ctx.run(fn, *request_args, **request_kwargs),
                     )
-                    if item is _done:
-                        break
-                    yield item
-            else:
-                yield result
+                if inspect.isasyncgen(result):
+                    async for item in result:
+                        yield item
+                elif inspect.isgenerator(result):
+                    # Drive sync generators off-loop so user compute
+                    # between yields doesn't stall this replica's other
+                    # requests.
+                    loop = asyncio.get_running_loop()
+                    _done = object()
+                    while True:
+                        item = await loop.run_in_executor(
+                            None, lambda: next(result, _done)
+                        )
+                        if item is _done:
+                            break
+                        yield item
+                else:
+                    yield result
         finally:
             self._num_ongoing -= 1
             self._num_served += 1

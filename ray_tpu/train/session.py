@@ -165,6 +165,7 @@ def _own_node_notice() -> dict | None:
 
         core = getattr(api._runtime, "core", None)
         node_addr = getattr(core, "node_addr", None) if core else None
+    # tpulint: allow(broad-except reason=drain-notice probe from a session that may have no runtime at all; None means no notice, which is the correct answer there)
     except Exception:  # noqa: BLE001 - session without a runtime
         node_addr = None
     return drain.for_node_addr(node_addr)

@@ -59,6 +59,14 @@ STEPS_TOTAL = Counter(
     "completed train steps",
     tag_keys=("job",),
 )
+COMM_EXPOSED_RATIO = Gauge(
+    "ray_tpu_train_comm_exposed_ratio",
+    "fraction of the most recent step spent in collective ops NOT "
+    "overlapped with compute (flight-recorder op intervals intersected "
+    "with the step's compute phase) — the baseline the compute-"
+    "collective overlap work must move",
+    tag_keys=("job",),
+)
 
 
 def telemetry_enabled() -> bool:
@@ -72,6 +80,7 @@ def peak_flops_per_chip() -> float:
         import jax
 
         kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    # tpulint: allow(broad-except reason=device probing for an MFU denominator; any jax/backend failure falls back to the documented proxy peak rather than failing the step)
     except Exception:  # noqa: BLE001 - no jax/devices: proxy peak
         return DEFAULT_PEAK_FLOPS
     for name, flops in PEAK_FLOPS.items():
@@ -136,6 +145,70 @@ class StepTimer:
         return time.perf_counter() - self._t0
 
 
+def _merge_intervals(
+    intervals: list[tuple[float, float]],
+) -> list[tuple[float, float]]:
+    """Union of possibly-overlapping (start, end) intervals (concurrent
+    collective ops must not double-count wall time)."""
+    out: list[list[float]] = []
+    for s, e in sorted(i for i in intervals if i[1] > i[0]):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _overlap_seconds(
+    a: list[tuple[float, float]], b: list[tuple[float, float]]
+) -> float:
+    """Total measure of the intersection of two MERGED interval lists."""
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def comm_attribution(
+    step_start: float,
+    step_end: float,
+    compute_events: list[tuple[str, float, float]],
+) -> tuple[float, float]:
+    """(comm_exposed_s, comm_overlapped_s) for one step: drain the
+    flight recorder's completed-op intervals, clamp them to the step
+    window, and split their union by intersection with the union of the
+    step's ``compute`` phase intervals. An op fully inside compute is
+    overlapped (hidden behind the math); everything else is exposed
+    stall. With today's serial step loop the overlap is ~0 — recorded
+    honestly, which is exactly what makes it a movable baseline."""
+    from ray_tpu.collective import flight_recorder
+
+    ops = flight_recorder.take_op_intervals()
+    clamped = [
+        (max(s, step_start), min(e, step_end))
+        for s, e in ops
+        if e > step_start and s < step_end
+    ]
+    if not clamped:
+        return 0.0, 0.0
+    op_union = _merge_intervals(clamped)
+    total = sum(e - s for s, e in op_union)
+    compute = _merge_intervals(
+        [(wall, wall + d) for name, wall, d in compute_events
+         if name == "compute"]
+    )
+    overlapped = _overlap_seconds(op_union, compute)
+    return max(0.0, total - overlapped), overlapped
+
+
 def compute_mfu(flops: float | None, dur: float) -> float | None:
     if not flops or dur <= 0:
         return None
@@ -143,6 +216,7 @@ def compute_mfu(flops: float | None, dur: float) -> float | None:
         import jax
 
         n_chips = max(1, len(jax.devices()))
+    # tpulint: allow(broad-except reason=chip counting for an MFU denominator; any jax/backend failure degrades to single-chip math rather than failing the step)
     except Exception:  # noqa: BLE001
         n_chips = 1
     return flops / (dur * peak_flops_per_chip() * n_chips)
@@ -162,9 +236,15 @@ def finish_step(ctx, timer: StepTimer) -> None:
     mfu = compute_mfu(timer.flops, dur)
     if mfu is not None:
         MFU_GAUGE.set(mfu, tags={"job": job})
+    exposed, overlapped = comm_attribution(
+        timer.start, timer.start + dur, timer._events
+    )
+    if (exposed or overlapped) and dur > 0:
+        COMM_EXPOSED_RATIO.set(exposed / dur, tags={"job": job})
     _emit_step_span(
         ctx, timer.start, dur, phases=dict(timer.phases), mfu=mfu,
         degraded_frac=_take_degraded_frac(ctx),
+        comm_exposed_s=exposed, comm_overlapped_s=overlapped,
     )
     from ray_tpu.util import tracing
 
@@ -200,9 +280,16 @@ def implicit_step(ctx, now: float, metrics: dict) -> None:
         STEP_PHASE_SECONDS.observe(
             ckpt_s, tags={"job": job, "phase": "checkpoint"}
         )
+    # No phase events on the implicit path — with nothing marked as
+    # compute, every collective second in the window is exposed, which
+    # is the honest reading of an unannotated loop.
+    exposed, overlapped = comm_attribution(base, now, [])
+    if exposed and dur > 0:
+        COMM_EXPOSED_RATIO.set(exposed / dur, tags={"job": job})
     _emit_step_span(
         ctx, base, dur, phases=phases, mfu=mfu,
         degraded_frac=_take_degraded_frac(ctx),
+        comm_exposed_s=exposed, comm_overlapped_s=overlapped,
     )
     ctx._step_index += 1
 
@@ -220,7 +307,10 @@ def _take_degraded_frac(ctx) -> float:
     return frac
 
 
-def _emit_step_span(ctx, start, dur, phases, mfu, degraded_frac=0.0) -> None:
+def _emit_step_span(
+    ctx, start, dur, phases, mfu, degraded_frac=0.0,
+    comm_exposed_s=0.0, comm_overlapped_s=0.0,
+) -> None:
     from ray_tpu.util import tracing
 
     attrs = dict(
@@ -234,4 +324,7 @@ def _emit_step_span(ctx, start, dur, phases, mfu, degraded_frac=0.0) -> None:
         attrs["mfu"] = round(mfu, 6)
     if degraded_frac:
         attrs["degraded_frac"] = round(degraded_frac, 6)
+    if comm_exposed_s or comm_overlapped_s:
+        attrs["comm_exposed_s"] = round(comm_exposed_s, 6)
+        attrs["comm_overlapped_s"] = round(comm_overlapped_s, 6)
     tracing.emit_span("train:step", start, dur, **attrs)

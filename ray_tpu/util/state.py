@@ -173,6 +173,14 @@ def train_stats() -> dict:
     return _call_head("train_stats")
 
 
+def serve_stats() -> dict:
+    """Per-deployment serve SLO ledger from the head: request/error
+    counts, sliding-window TTFT/latency p50/p99, SLO attainment, and
+    the burn-rate alert state. Backs the dashboard's /api/serve and the
+    `ray_tpu slo` CLI."""
+    return _call_head("serve_stats")
+
+
 def list_checkpoints(run: str | None = None) -> dict:
     """In-cluster shard-store checkpoints per run (step, world,
     completeness, bytes, chunk count, min replica count). Backs the
@@ -190,6 +198,12 @@ _SPAN_ARG_KEYS = (
     "trace_id", "span_id", "parent_id", "group", "verb", "backend",
     "bytes", "dtype", "bus_bytes_per_s", "train_job", "train_attempt",
     "train_rank", "train_step", "phases", "mfu",
+    "comm_exposed_s", "comm_overlapped_s", "degraded_frac",
+    # serve request-path spans: the ids/attrs that make one request's
+    # span tree reconstructable from the chrome trace
+    "app", "deployment", "route", "status", "ttft_s", "request_id",
+    "streamed", "items", "tokens", "batch_size", "occupancy",
+    "queue_s", "sample_rate",
 )
 
 

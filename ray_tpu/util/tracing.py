@@ -50,6 +50,13 @@ def current_context() -> tuple[str, str] | None:
     return _current.get()
 
 
+def active_context() -> tuple[str, str] | None:
+    """Public view of the active span — the contextvar when set, else
+    this thread's scope (see _active). What serve's request path ships
+    across the handle→replica hop so spans parent correctly."""
+    return _active()
+
+
 def _active() -> tuple[str, str] | None:
     """Current span: the contextvar when set, else this thread's scope
     (span() on driver threads; the worker sets it per executor thread via
@@ -139,6 +146,46 @@ def span(name: str):
         )
 
 
+@contextlib.contextmanager
+def trace_scope(ctx: tuple[str, str] | None):
+    """Install ``ctx`` as the active trace context for the body without
+    recording a span of its own (the caller records one with explicit
+    ids via record_span). Contextvar-based, so it is async-safe: set
+    inside a coroutine it propagates through that task's awaits and
+    cannot leak into concurrent tasks. A None ctx is a no-op."""
+    if ctx is None:
+        yield
+        return
+    token = _current.set(ctx)
+    try:
+        yield
+    finally:
+        _current.reset(token)
+
+
+@contextlib.contextmanager
+def linked_span(name: str, parent: tuple[str, str] | None = None, **attrs):
+    """Measure the body as a span parented under ``parent`` (or the
+    active context), installing itself as active so nested spans chain.
+    Ungated like emit_span — the serve request path calls it only when
+    serve telemetry is on AND an upstream trace context exists, so the
+    gating lives at the ingress, not here. Yields the span's
+    (trace_id, span_id) so callers can ship it across process hops."""
+    cur = parent if parent is not None else _active()
+    trace_id = cur[0] if cur else uuid.uuid4().hex[:16]
+    span_id = uuid.uuid4().hex[:16]
+    token = _current.set((trace_id, span_id))
+    start = time.time()
+    try:
+        yield (trace_id, span_id)
+    finally:
+        _current.reset(token)
+        record_span(
+            trace_id, span_id, cur[1] if cur else "", name, start,
+            time.time() - start, **attrs,
+        )
+
+
 def record_span(trace_id, span_id, parent_id, name, start, dur, **attrs):
     """Spans ride the task-event buffer (flushed to the head like any
     task state transition, core_worker._flush_events_loop). Extra
@@ -148,6 +195,7 @@ def record_span(trace_id, span_id, parent_id, name, start, dur, **attrs):
         import ray_tpu.api as api
 
         core = api._runtime.core
+    # tpulint: allow(broad-except reason=span recording must never fail the traced operation; without a runtime there is no event pipeline to record into, so dropping is the contract)
     except Exception:  # noqa: BLE001 - no runtime, drop the span
         return
     if core is None:

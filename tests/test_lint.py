@@ -69,6 +69,20 @@ def test_fixture_rpc():
     assert _hits("bad_rpc.py") == [("TPU501", 16)]
 
 
+def test_fixture_labels():
+    # 19 is pragma'd (reasoned allow): the escape hatch must work for
+    # TPU403 like every other rule; bounded tags (lines 6/8/12) and the
+    # clean route label never fire.
+    assert _hits("bad_labels.py") == [
+        ("TPU403", 7),
+        ("TPU403", 13),
+        ("TPU403", 14),
+        ("TPU403", 15),
+        ("TPU403", 16),
+        ("TPU403", 17),
+    ]
+
+
 def test_lock_order_cycle_cross_file(tmp_path):
     # The acquisition graph is global: each half of the inversion lives
     # in its own module.
@@ -330,7 +344,7 @@ def test_cli_select_and_json(capsys):
 
 @pytest.mark.parametrize("fixture", [
     "bad_collective.py", "bad_locks.py", "bad_except.py",
-    "bad_metrics.py", "bad_rpc.py",
+    "bad_metrics.py", "bad_rpc.py", "bad_labels.py",
 ])
 def test_fixtures_parse_as_valid_python(fixture):
     import ast

@@ -487,6 +487,365 @@ def test_chronic_straggler_surfaces_to_autoscaler(cluster):
         rt.run(rt.core.head.call("collective_deregister", group="sg"))
 
 
+# ---------------------------------------------------------------------
+# Serve request-path observability (PR 9): end-to-end trace trees, the
+# head SLO ledger, comm-exposure attribution, and the disabled-path
+# perf floor.
+# ---------------------------------------------------------------------
+
+
+def test_hier_busbw_derives_from_wire_bytes_only():
+    """hier_allreduce busbw must come from MEASURED wire bytes; without
+    them the gauge falls back to algbw (bytes/dur), never the flat
+    2(n-1)/n factor that over-reports under int8-DCN compression."""
+    import numpy as np
+
+    from ray_tpu.collective import flight_recorder as fr
+
+    arr = np.ones(1024, np.float32)  # 4096 logical bytes
+    fr.record_op(
+        "bw_hier1", "hier_allreduce", "xla_mesh", 8, arr,
+        time.time(), 0.001, wire_bytes=2048,
+    )
+    tags = {"group": "bw_hier1", "verb": "hier_allreduce",
+            "dtype": "float32"}
+    assert fr.BUS_BANDWIDTH.value(tags=tags) == pytest.approx(
+        2048 / 0.001
+    )
+    fr.record_op(
+        "bw_hier2", "hier_allreduce", "xla_mesh", 8, arr,
+        time.time(), 0.001,
+    )
+    tags2 = {"group": "bw_hier2", "verb": "hier_allreduce",
+             "dtype": "float32"}
+    assert fr.BUS_BANDWIDTH.value(tags=tags2) == pytest.approx(
+        4096 / 0.001
+    )
+    # The factor table no longer speaks for the hierarchical op at all.
+    assert "hier_allreduce" not in fr._BUS_FACTORS
+
+
+def test_comm_exposed_attribution(cluster):
+    """A collective op inside a step but OUTSIDE the compute phase is
+    exposed; interval math handles overlap; the gauge and head ledger
+    both report it."""
+    import numpy as np
+
+    import ray_tpu.train as train
+    from ray_tpu import collective as col
+    from ray_tpu.collective import flight_recorder as fr
+    from ray_tpu.train import session, telemetry
+    from ray_tpu.train.session import TrainContext
+
+    # Interval units.
+    assert telemetry._merge_intervals([(0, 2), (1, 3), (5, 6)]) == [
+        (0, 3), (5, 6)
+    ]
+    assert telemetry._overlap_seconds([(0, 3), (5, 6)], [(1, 2), (5.5, 8)]) \
+        == pytest.approx(1.5)
+    exposed, overlapped = 0.0, 0.0
+
+    fr.take_op_intervals()  # drain earlier tests' ops
+    col.init_collective_group(1, 0, backend="cpu", group_name="ce1")
+    session._set_context(TrainContext(experiment_name="comm_exp"))
+    try:
+        with train.step_span(flops=1e6) as s:
+            with s.phase("compute"):
+                time.sleep(0.02)
+            with s.phase("collective"):
+                col.allreduce(np.ones(256, np.float32), group_name="ce1")
+    finally:
+        session._set_context(None)
+        col.destroy_collective_group("ce1")
+    ratio = telemetry.COMM_EXPOSED_RATIO.value(tags={"job": "comm_exp"})
+    assert ratio is not None and ratio > 0
+    rt = ray_tpu.api._runtime
+    rt.run(rt.core.flush_observability())
+    job = None
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        job = state.train_stats().get("jobs", {}).get("comm_exp")
+        if job and job.get("comm_exposed_s", 0) > 0:
+            break
+        time.sleep(0.3)
+    assert job, "head never saw the comm_exp job"
+    assert job["comm_exposed_s"] > 0
+    assert job["comm_overlapped_s"] == pytest.approx(0.0)
+    assert 0 < job["comm_exposed_ratio"] <= 1
+
+
+def _sse_request(port, path, body, headers=None, timeout=60):
+    """Minimal raw-socket SSE client: returns the data-frame payloads."""
+    import socket
+
+    payload = json.dumps(body).encode()
+    req = (
+        f"POST {path} HTTP/1.1\r\n"
+        f"Host: 127.0.0.1\r\n"
+        f"Accept: text/event-stream\r\n"
+        f"Content-Length: {len(payload)}\r\n"
+    )
+    for k, v in (headers or {}).items():
+        req += f"{k}: {v}\r\n"
+    req += "\r\n"
+    raw = b""
+    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+        s.sendall(req.encode() + payload)
+        while b"data: [DONE]" not in raw and b"event: error" not in raw:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            raw += chunk
+    assert b"200 OK" in raw, raw[:200]
+    return [
+        ln[len("data: "):]
+        for ln in raw.decode("utf-8", "replace").splitlines()
+        if ln.startswith("data: ")
+    ]
+
+
+def test_serve_request_tracing_end_to_end(cluster):
+    """A streamed LLM request through proxy → replica → engine yields
+    ONE connected trace (shared trace_id, correct parentage) whose
+    prefill span and TTFT are bounded below by the injected prefill
+    delay, with per-deployment TTFT percentiles visible via the
+    serve_stats RPC."""
+    from ray_tpu import serve
+    from ray_tpu._private import config as _config
+    from ray_tpu.llm.serve_integration import build_llm_deployment
+    from ray_tpu.util import tracing
+
+    delay = 0.6
+    try:
+        app = build_llm_deployment(
+            "tiny",
+            # prefill_delay_s: deterministic TTFT injection (the engine
+            # kwarg reaches the replica regardless of worker reuse; the
+            # RAY_TPU_LLM_PREFILL_DELAY env knob is its cluster-level
+            # twin).
+            engine_kwargs={"max_batch": 2, "prefill_delay_s": delay},
+            ray_actor_options={"num_cpus": 0.1},
+        )
+        serve.run(app, name="llm_obs", route_prefix="/llmobs",
+                  timeout_s=180)
+        port = serve.start_http()
+        # Warmup pays the first-compile cost so the timed request's
+        # TTFT is delay-dominated, not compile-dominated.
+        _sse_request(
+            port, "/llmobs",
+            {"prompt": "warm", "max_tokens": 4, "stream": True},
+        )
+        rid = "e2e-trace-0001"
+        frames = _sse_request(
+            port, "/llmobs",
+            {"prompt": "hello", "max_tokens": 8, "stream": True},
+            headers={"X-Request-Id": rid},
+        )
+        assert frames[-1] == "[DONE]"
+
+        wanted = {"serve:ingress", "serve:queue", "serve:replica",
+                  "serve:prefill", "serve:decode"}
+        tree = {}
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            spans = tracing.get_trace_events(limit=5000)
+            ingress = next(
+                (s for s in spans
+                 if s.get("name") == "serve:ingress"
+                 and s.get("request_id") == rid), None,
+            )
+            if ingress is not None:
+                same = [
+                    s for s in spans
+                    if s.get("trace_id") == ingress["trace_id"]
+                ]
+                if wanted <= {s.get("name") for s in same}:
+                    tree = {s["name"]: s for s in same}
+                    break
+            time.sleep(0.4)
+        assert tree, "connected request span tree never reached the head"
+
+        ingress = tree["serve:ingress"]
+        assert ingress["parent_id"] == ""
+        assert ingress["deployment"] == "LLMServer"
+        assert ingress["app"] == "llm_obs"
+        assert ingress["status"] == 200 and ingress["streamed"]
+        # Parentage: queue + replica under ingress; engine phases under
+        # the replica span.
+        assert tree["serve:queue"]["parent_id"] == ingress["span_id"]
+        replica = tree["serve:replica"]
+        assert replica["parent_id"] == ingress["span_id"]
+        assert tree["serve:prefill"]["parent_id"] == replica["span_id"]
+        assert tree["serve:decode"]["parent_id"] == replica["span_id"]
+        # TTFT bounded by the injected prefill delay (tolerance covers
+        # a warm prefill + routing, never a cold compile).
+        assert ingress["ttft_s"] >= delay
+        assert ingress["ttft_s"] < delay + 5.0
+        assert tree["serve:prefill"]["dur"] >= delay
+        assert tree["serve:decode"]["tokens"] == 8
+
+        # timeline() renders the request tree (span args included).
+        tl = next(
+            e for e in state.timeline()
+            if e["name"] == "serve:ingress"
+            and e["args"].get("request_id") == rid
+        )
+        assert tl["args"]["trace_id"] == ingress["trace_id"]
+
+        # Per-deployment ledger via the serve_stats RPC.
+        dep = state.serve_stats()["deployments"].get("llm_obs/LLMServer")
+        assert dep is not None and dep["requests"] >= 2
+        assert dep["streamed"] >= 2
+        assert dep["ttft_p50_s"] is not None
+        assert dep["ttft_p99_s"] >= delay
+    finally:
+        serve.delete("llm_obs")
+
+
+def test_serve_slo_alert_transitions(cluster):
+    """The head SLO ledger flips ray_tpu_serve_slo_alert OFF→ON under
+    sustained SLO misses (injected backlog) and clears once the window
+    drains to attaining traffic."""
+    from ray_tpu._private import config as _config
+
+    rt = ray_tpu.api._runtime
+
+    def feed(n, ts, ttft, status=200):
+        events = [
+            {
+                "task_id": f"span:slo{ts}-{i}",
+                "name": "serve:ingress",
+                "state": "SPAN",
+                "ts": ts + i * 0.01,
+                "dur": ttft,
+                "deployment": "dep1",
+                "app": "slo_app",
+                "status": status,
+                "ttft_s": ttft,
+                "streamed": True,
+                "items": 1,
+            }
+            for i in range(n)
+        ]
+        rt.run(rt.core.head.call("add_task_events", events=events))
+
+    def dep_stats():
+        return rt.run(rt.core.head.call("serve_stats"))["deployments"][
+            "slo_app/dep1"
+        ]
+
+    _config.set_system_config({
+        "SERVE_SLO_TTFT_S": 0.1,
+        "SERVE_SLO_TARGET": 0.9,
+        "SERVE_SLO_WINDOW_S": 10.0,
+    })
+    try:
+        base = time.time()
+        feed(10, base, ttft=0.01)  # healthy traffic
+        st = dep_stats()
+        assert st["alert"] is False and st["attainment"] == 1.0
+        # Sustained backlog: TTFT blows through the target → ON.
+        feed(10, base + 1, ttft=2.0)
+        st = dep_stats()
+        assert st["alert"] is True
+        assert st["attainment"] == pytest.approx(0.5)
+        assert st["ttft_p99_s"] >= 2.0
+        # The alert gauge reaches the Prometheus surface from the head.
+        text = state.prometheus_metrics()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("ray_tpu_serve_slo_alert")
+            and 'deployment="slo_app/dep1"' in ln
+        )
+        assert line.endswith(" 1.0")
+        # Backlog drains: a window of attaining requests past the
+        # cutoff evicts the misses → OFF.
+        feed(20, base + 30, ttft=0.01)
+        st = dep_stats()
+        assert st["alert"] is False and st["attainment"] == 1.0
+    finally:
+        _config.clear_system_config(
+            "SERVE_SLO_TTFT_S", "SERVE_SLO_TARGET", "SERVE_SLO_WINDOW_S"
+        )
+
+
+# Disabled-path budget for serve request telemetry: begin_request +
+# scope enter/exit + first_byte + finish with RAY_TPU_SERVE_TELEMETRY=0
+# — the exact hooks the proxy runs per request. 50µs is <5% of even a
+# 1ms echo round trip (the proxy's floor is ~2ms), mirroring PR 2's
+# step-telemetry budget.
+SERVE_TELEMETRY_DISABLED_CEILING_S = 50e-6
+
+
+def test_serve_telemetry_disabled_perf_floor():
+    from ray_tpu._private import config as _config
+    from ray_tpu.serve import telemetry as stel
+
+    headers = {"accept": "text/event-stream", "x-request-id": "perf"}
+    _config.set_system_config({"SERVE_TELEMETRY": False})
+    try:
+        for _ in range(100):  # warmup (lazy imports, bytecode)
+            tel = stel.begin_request(headers)
+            with tel:
+                pass
+            tel.first_byte()
+            tel.finish("a", "d", "/r", 200)
+        assert stel.begin_request(headers) is stel.NOOP_REQUEST
+        n = 2000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tel = stel.begin_request(headers)
+            with tel:
+                pass
+            tel.first_byte()
+            tel.finish("a", "d", "/r", 200)
+        per_req = (time.perf_counter() - t0) / n
+    finally:
+        _config.clear_system_config("SERVE_TELEMETRY")
+    assert per_req < SERVE_TELEMETRY_DISABLED_CEILING_S, (
+        f"disabled-path serve telemetry costs {per_req * 1e6:.1f}µs/req "
+        f"(budget {SERVE_TELEMETRY_DISABLED_CEILING_S * 1e6:.0f}µs) — "
+        "instrumentation is taxing the request path"
+    )
+
+
+def test_serve_api_and_slo_cli_smoke(cluster, capsys, monkeypatch):
+    """Tier-1 smoke: dashboard /api/serve returns schema-complete JSON
+    and `ray_tpu slo` renders the same ledger (both fed by the SLO
+    test's synthetic traffic earlier in this module)."""
+    import urllib.request
+
+    from ray_tpu import scripts
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard()
+    try:
+        with urllib.request.urlopen(dash.url + "/api/serve") as r:
+            body = json.loads(r.read())
+    finally:
+        dash.stop()
+    assert "deployments" in body and body["deployments"]
+    required = {
+        "requests", "errors", "streamed", "items", "window_requests",
+        "ttft_p50_s", "ttft_p99_s", "latency_p50_s", "latency_p99_s",
+        "attainment", "alert", "first_ts", "last_ts",
+    }
+    for name, dep in body["deployments"].items():
+        assert required <= set(dep), (name, sorted(dep))
+    assert "slo_app/dep1" in body["deployments"]
+
+    # CLI wiring: `ray_tpu slo` end to end minus the daemon connect.
+    monkeypatch.setattr(scripts, "_connect", lambda *a, **k: None)
+    rc = scripts.main(["slo"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "slo_app/dep1" in out
+    assert "attainment=" in out and "ttft p50=" in out
+    rc = scripts.main(["slo", "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and "slo_app/dep1" in out
+
+
 def test_job_driver_connects_to_cluster(cluster, tmp_path):
     """A submitted driver can init against the running cluster via env."""
     from ray_tpu.job import JobSubmissionClient

@@ -174,6 +174,12 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                                "scale block of the "
                                                "int8 collective "
                                                "codec"),
+    "COLLECTIVE_BUCKET_MB": (float, 4.0, "target gradient bucket size "
+                                         "(MiB) for the bucketed "
+                                         "overlap sync (collective/"
+                                         "bucketer.py); ScalingConfig("
+                                         "grad_bucket_mb=) overrides "
+                                         "per trainer"),
     "STRAGGLER_DELAY": (str, "", "chaos spec: comma-separated "
                                  "'rank:seconds' — the named collective "
                                  "ranks sleep that long before every "

@@ -47,6 +47,8 @@ from ray_tpu.collective.types import (
     CollectiveGroupDestroyedError,
     CollectiveMemberDiedError,
     CollectiveTimeoutError,
+    CollectiveWork,
+    FutureCollectiveWork,
     PartialResult,
     ReduceOp,
 )
@@ -492,6 +494,133 @@ def reducescatter(
     )
 
 
+def _dispatch_async(
+    name: str, group_name: str, tensor, **kw
+) -> CollectiveWork:
+    """Dispatch a verb asynchronously, returning a typed
+    :class:`CollectiveWork` handle.
+
+    cpu backend: the op coroutine is scheduled on the runtime loop
+    (run_coroutine_threadsafe) — the existing hub/mailbox protocol runs
+    unchanged on that background thread while the caller's thread keeps
+    computing; the op's flight-recorder interval is its real
+    dispatch→completion window on the loop. XLA backends: the group's
+    own ``<verb>_async`` (mesh — XLA async dispatch; dist — the
+    group's dispatch thread). Async handles do not auto-reform: a
+    failure surfaces typed from ``wait()``."""
+    g = get_group(group_name)
+    if (
+        getattr(g, "expects_per_rank_tensors", False)
+        and tensor is not None
+        and not isinstance(tensor, (list, tuple))
+    ):
+        raise TypeError(
+            f"group {group_name!r} uses the single-controller xla_mesh "
+            f"backend: pass a list of {g.world} per-rank tensors, one per "
+            "device (each rank is a local device, not a process)"
+        )
+    fn = getattr(g, name, None)
+    import inspect
+
+    if fn is not None and inspect.iscoroutinefunction(fn):
+        import asyncio
+
+        from ray_tpu.util import tracing
+
+        rt = _runtime()
+        coro = fn(tensor, **kw)
+        ctx = tracing._active()
+        if ctx is not None:
+            coro = tracing.carry_context(coro, ctx)
+        return FutureCollectiveWork(
+            asyncio.run_coroutine_threadsafe(coro, rt.loop),
+            group_name=group_name,
+            verb=name,
+            finalize=_note_partial,
+        )
+    async_fn = getattr(g, f"{name}_async", None)
+    if async_fn is None:
+        raise ValueError(
+            f"backend {type(g).__name__} does not support async {name}"
+        )
+    work = async_fn(tensor, **kw)
+    work._finalize_cb = _note_partial
+    return work
+
+
+def _async_kwargs(
+    op, timeout_s, min_ranks, grace_s, compression, algo, with_op=True
+) -> dict:
+    kw: dict = {"timeout_s": timeout_s}
+    if with_op:
+        kw["op"] = ReduceOp(op)
+    if min_ranks is not None:
+        kw["min_ranks"] = min_ranks
+        kw["grace_s"] = grace_s
+    if compression is not None:
+        kw["compression"] = compression
+    if algo is not None:
+        kw["algo"] = algo
+    return kw
+
+
+def allreduce_async(
+    tensor,
+    group_name: str = "default",
+    op=ReduceOp.SUM,
+    timeout_s=None,
+    min_ranks: int | None = None,
+    grace_s: float | None = None,
+    compression: str | None = None,
+    algo: str | None = None,
+) -> CollectiveWork:
+    """Asynchronous :func:`allreduce`: the op is in flight when this
+    returns; ``.wait()`` joins it (same result, same typed errors, same
+    PartialResult envelope in partial mode) and ``.done()`` probes
+    completion. The overlap primitive the gradient bucketer builds on —
+    issue bucket syncs during remaining backward compute, join before
+    the optimizer update. Composes with ``min_ranks=``/``grace_s=``,
+    ``compression=`` and ``algo=`` exactly like the sync verb."""
+    return _dispatch_async(
+        "allreduce", group_name, tensor,
+        **_async_kwargs(op, timeout_s, min_ranks, grace_s, compression,
+                        algo),
+    )
+
+
+def reducescatter_async(
+    tensor,
+    group_name: str = "default",
+    op=ReduceOp.SUM,
+    timeout_s=None,
+    min_ranks: int | None = None,
+    grace_s: float | None = None,
+    compression: str | None = None,
+) -> CollectiveWork:
+    """Asynchronous :func:`reducescatter` — see :func:`allreduce_async`."""
+    return _dispatch_async(
+        "reducescatter", group_name, tensor,
+        **_async_kwargs(op, timeout_s, min_ranks, grace_s, compression,
+                        None),
+    )
+
+
+def allgather_async(
+    tensor,
+    group_name: str = "default",
+    timeout_s=None,
+    min_ranks: int | None = None,
+    grace_s: float | None = None,
+    compression: str | None = None,
+) -> CollectiveWork:
+    """Asynchronous :func:`allgather` — see :func:`allreduce_async`."""
+    return _dispatch_async(
+        "allgather", group_name, tensor,
+        **_async_kwargs(None, timeout_s, min_ranks, grace_s, compression,
+                        None, with_op=False),
+    )
+
+
 def barrier(group_name: str = "default", timeout_s=None):
     return _dispatch("barrier", group_name, timeout_s=timeout_s)
 
@@ -537,6 +666,10 @@ __all__ = [
     "barrier",
     "send",
     "recv",
+    "CollectiveWork",
+    "allreduce_async",
+    "reducescatter_async",
+    "allgather_async",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import threading
 import time
 from functools import partial
 from typing import Any, Sequence
@@ -39,6 +40,8 @@ from ray_tpu.collective.flight_recorder import record_op, record_partial
 from ray_tpu.collective.types import (
     CollectiveMemberDiedError,
     CollectiveTimeoutError,
+    CollectiveWork,
+    FutureCollectiveWork,
     PartialResult,
     ReduceOp,
 )
@@ -79,6 +82,30 @@ def _check_partial_args(op, dtype, min_ranks, world):
         raise ValueError(
             f"min_ranks {min_ranks} out of range 1..{world}"
         )
+
+
+class _RecordStateMixin:
+    """Per-THREAD flight-recorder state: the reentrancy flag and the
+    analytic wire-byte drop box. Thread-local because the async
+    dispatch thread runs verbs concurrently with the issuing thread —
+    a shared flag would let one thread's in-flight op suppress or
+    clobber the other's recording."""
+
+    @property
+    def _in_recorded_op(self) -> bool:
+        return getattr(self._rec_tl, "flag", False)
+
+    @_in_recorded_op.setter
+    def _in_recorded_op(self, v: bool) -> None:
+        self._rec_tl.flag = v
+
+    @property
+    def _last_wire_bytes(self):
+        return getattr(self._rec_tl, "wire", None)
+
+    @_last_wire_bytes.setter
+    def _last_wire_bytes(self, v) -> None:
+        self._rec_tl.wire = v
 
 
 def _recorded(verb: str):
@@ -192,7 +219,64 @@ def _compression_block() -> int:
     return int(config.get("COLLECTIVE_COMPRESSION_BLOCK"))
 
 
-class XlaMeshGroup:
+class XlaCollectiveWork(CollectiveWork):
+    """Async handle over XLA's asynchronous dispatch: the compiled
+    program is already launched when the handle exists, and the handle
+    OWNS the result device buffers — ``wait()`` blocks until they are
+    ready (``jax.block_until_ready``) and returns the same value the
+    synchronous verb would have. The flight-recorder entry is written
+    once, at completion, with the dispatch→completion wall interval and
+    the op's analytic wire bytes, so overlapped device time is
+    attributed honestly instead of as a near-zero dispatch blip."""
+
+    __slots__ = ("_xgroup", "_out", "_wall_start", "_t0", "_wire_bytes",
+                 "_payload")
+
+    def __init__(self, group, verb, out, wall_start, t0, wire_bytes,
+                 payload):
+        super().__init__(group_name=group.name, verb=verb)
+        self._xgroup = group
+        self._out = out
+        self._wall_start = wall_start
+        self._t0 = t0
+        self._wire_bytes = wire_bytes
+        self._payload = payload
+
+    def _leaves(self) -> list:
+        val = (
+            self._out.value
+            if isinstance(self._out, PartialResult)
+            else self._out
+        )
+        return list(val) if isinstance(val, (list, tuple)) else [val]
+
+    def _join(self, timeout_s):
+        # In-process device programs complete or raise — there is no
+        # remote member to wait on, so the local deadline is moot
+        # (API parity with the process-backed handles).
+        del timeout_s
+        jax.block_until_ready(self._leaves())
+        record_op(
+            self._xgroup.name, self.verb, self._xgroup.backend_tag,
+            self._xgroup.world, self._payload, self._wall_start,
+            time.perf_counter() - self._t0,
+            wire_bytes=self._wire_bytes,
+        )
+        return self._out
+
+    def _probe(self) -> bool:
+        try:
+            return all(
+                leaf.is_ready()
+                for leaf in self._leaves()
+                if hasattr(leaf, "is_ready")
+            )
+        # tpulint: allow(broad-except reason=is_ready probing across jax versions/array types; a probe failure means "treat as ready" so wait() resolves it definitively)
+        except Exception:
+            return True
+
+
+class XlaMeshGroup(_RecordStateMixin):
     """Eager collectives over the devices visible to this process.
 
     Single-controller semantics: every verb takes a *sequence* of
@@ -212,8 +296,7 @@ class XlaMeshGroup:
         self.name = name
         self.mesh = Mesh(np.array(self.devices), ("ranks",))
         self._programs: dict[tuple, Any] = {}
-        self._in_recorded_op = False
-        self._last_wire_bytes: int | None = None
+        self._rec_tl = threading.local()
 
     # ------------------------------------------------------------ plumbing
     def _stack(self, tensors: Sequence[Any]) -> jax.Array:
@@ -462,6 +545,44 @@ class XlaMeshGroup:
         sharding = NamedSharding(self.mesh, P("ranks"))
         return jax.device_put(w, sharding)
 
+    # ------------------------------------------------------ async verbs
+    def _verb_async(self, verb: str, args, kw) -> CollectiveWork:
+        """Dispatch a verb through XLA's async dispatch and hand back a
+        handle owning the result buffers. The synchronous verb body only
+        *launches* compiled programs (unstacking reads shard handles,
+        not host values), so calling it here returns at dispatch; the
+        flight-recorder entry moves to the handle's completion."""
+        wall_start = time.time()
+        t0 = time.perf_counter()
+        prev = self._in_recorded_op
+        self._in_recorded_op = True  # the handle records, not the verb
+        if not prev:
+            self._last_wire_bytes = None
+        try:
+            out = getattr(self, verb)(*args, **kw)
+        finally:
+            self._in_recorded_op = prev
+        return XlaCollectiveWork(
+            self, verb, out, wall_start, t0, self._last_wire_bytes,
+            args[0] if args else None,
+        )
+
+    def allreduce_async(self, tensors: Sequence[Any], **kw) -> CollectiveWork:
+        """Async :meth:`allreduce`: returns a :class:`CollectiveWork`
+        immediately; composes with every sync kwarg (op/min_ranks/
+        skip_ranks/compression/algo) — partial mode resolves its mask at
+        dispatch (the skip set is explicit on this backend), so
+        ``wait()`` returns the same PartialResult envelope."""
+        return self._verb_async("allreduce", (tensors,), kw)
+
+    def reducescatter_async(
+        self, tensors: Sequence[Any], **kw
+    ) -> CollectiveWork:
+        return self._verb_async("reducescatter", (tensors,), kw)
+
+    def allgather_async(self, tensors: Sequence[Any], **kw) -> CollectiveWork:
+        return self._verb_async("allgather", (tensors,), kw)
+
     @_recorded("broadcast")
     def broadcast(
         self, tensors: Sequence[Any], root: int = 0, timeout_s=None
@@ -657,7 +778,7 @@ class XlaMeshGroup:
         self.allreduce(ones)
 
 
-class XlaDistGroup:
+class XlaDistGroup(_RecordStateMixin):
     """Multi-host eager collectives: rank = process, data over ICI + DCN.
 
     Standard multi-host JAX pattern: every process calls the same verb
@@ -687,7 +808,7 @@ class XlaDistGroup:
         self.base_name = name
         self.epoch = 0
         self.core = core  # CoreWorker, for head membership deregistration
-        self._in_recorded_op = False
+        self._rec_tl = threading.local()
         # Poison state, fed by the head's death fan-out (see
         # _on_member_dead): the deadline-bounded sync polls this BETWEEN
         # bounded waits, so a fan-out interrupts a wedged compiled
@@ -709,6 +830,10 @@ class XlaDistGroup:
         self.mesh = Mesh(np.array(self.devices), ("ranks",))
         self._programs: dict[tuple, Any] = {}
         self._sync_pool: Any = None  # lazy single-thread deadline pool
+        # Lazy single-thread async-dispatch pool: one thread keeps the
+        # issue order of handle-based ops identical across ranks (a
+        # reordered collective is a deadlock on a real mesh).
+        self._dispatch_pool: Any = None
         self._gate_seq = 0  # partial-mode pre-op gate sequence
         self._last_wire_bytes: int | None = None
 
@@ -754,10 +879,15 @@ class XlaDistGroup:
     async def destroy(self):
         """Deregister from the head's membership table and release the
         sync pool; the jax.distributed runtime itself has no per-group
-        teardown (re-init covers reform)."""
+        teardown (re-init covers reform). Queued-but-unstarted async
+        dispatches are cancelled — their handles fail typed
+        (CollectiveGroupDestroyedError) instead of hanging."""
         if self._sync_pool is not None:
             self._sync_pool.shutdown(wait=False)
             self._sync_pool = None
+        if self._dispatch_pool is not None:
+            self._dispatch_pool.shutdown(wait=False, cancel_futures=True)
+            self._dispatch_pool = None
         if self.core is not None:
             try:
                 await self.core.head.call(
@@ -1198,6 +1328,63 @@ class XlaDistGroup:
         full = self.allreduce(tensor, op=op, timeout_s=timeout_s)
         chunk = full.shape[0] // self.world
         return full[self.rank * chunk : (self.rank + 1) * chunk]
+
+    # ------------------------------------------------------ async verbs
+    def _verb_async(self, verb: str, args, kw) -> CollectiveWork:
+        """Dispatch a verb on the group's background dispatch thread
+        and return a :class:`FutureCollectiveWork`. Unlike the mesh
+        group, the dist verbs block internally (the deadline-bounded
+        device sync, partial-gate host reads), so true async needs a
+        thread; one thread per group keeps handle-based ops issued in
+        program order across ranks. The op records its own
+        dispatch→completion interval from inside the thread."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.util import tracing
+
+        if self._dispatch_pool is None:
+            self._dispatch_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="xla_col_dispatch"
+            )
+        wall_start = time.time()
+        t0 = time.perf_counter()
+        ctx = tracing.active_context()
+        payload = args[0] if args else None
+
+        def run():
+            prev = self._in_recorded_op
+            self._in_recorded_op = True  # record here, not in the verb
+            self._last_wire_bytes = None
+            with tracing.thread_trace(ctx):
+                try:
+                    out = getattr(self, verb)(*args, **kw)
+                finally:
+                    self._in_recorded_op = prev
+                record_op(
+                    self.name, verb, self.backend_tag, self.world,
+                    payload, wall_start, time.perf_counter() - t0,
+                    wire_bytes=self._last_wire_bytes,
+                )
+            return out
+
+        return FutureCollectiveWork(
+            self._dispatch_pool.submit(run),
+            group_name=self.name,
+            verb=verb,
+        )
+
+    def allreduce_async(self, tensor, **kw) -> CollectiveWork:
+        """Async :meth:`allreduce` (handle-based): composes with
+        min_ranks/grace_s, compression and algo exactly like the sync
+        verb — the partial gate prices the contribution at dispatch
+        time on the dispatch thread."""
+        return self._verb_async("allreduce", (tensor,), kw)
+
+    def reducescatter_async(self, tensor, **kw) -> CollectiveWork:
+        return self._verb_async("reducescatter", (tensor,), kw)
+
+    def allgather_async(self, tensor, **kw) -> CollectiveWork:
+        return self._verb_async("allgather", (tensor,), kw)
 
     @_recorded("barrier")
     def barrier(self, timeout_s=None):

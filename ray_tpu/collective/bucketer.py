@@ -1,0 +1,376 @@
+"""Gradient bucketer: size-targeted buckets, issued as grads become
+ready, synced through async collective handles.
+
+T3 (arXiv:2401.16677) shows that fine-grained tracking-and-triggering
+of collectives against remaining compute recovers most of the exposed
+communication time in a training step. This module is the host-side
+half of that idea (the DDP-bucket lineage): gradients are flattened
+into ~``COLLECTIVE_BUCKET_MB`` buckets in **reverse-layer order** — the
+order backward produces them — and each full bucket's allreduce is
+dispatched immediately via :func:`collective.allreduce_async`, so the
+first buckets' sync overlaps the remaining backward compute (and the
+join tail overlaps the per-bucket optimizer math). The step loop joins
+the handles just before the optimizer update.
+
+Composition: every gradient-sync knob rides the per-bucket op —
+``compression="int8"`` (block-scaled codec, optionally with
+**error feedback**: the per-bucket quantization residual is added into
+the next step's payload before quantizing, so repeated-compression
+bias stops accumulating), ``min_ranks=``/``grace_s=`` (K-of-N partial;
+skipped ranks surface aggregated on the :class:`PendingSync`), and
+per-bucket **algorithm selection** via
+:func:`algo.choose_algorithm(nbytes, world, n_slices)` — small buckets
+take the latency-optimal tree, large buckets the bandwidth-optimal
+ring, closing the "wire the selector into the trainer's gradient sync
+by bucket size" follow-up.
+
+Two group shapes are supported: process-backed groups (cpu /
+xla_dist — one local gradient tree per process) and the
+single-controller mesh group (``expects_per_rank_tensors`` — a list of
+per-rank gradient trees, one per device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+from ray_tpu.collective import algo as colalgo
+from ray_tpu.collective import codec
+from ray_tpu.collective.types import CollectiveWork, PartialResult
+
+
+def default_bucket_bytes() -> int:
+    from ray_tpu._private import config
+
+    return int(float(config.get("COLLECTIVE_BUCKET_MB")) * (1 << 20))
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One issued bucket: its leaves (issue order), payload size, and
+    the data-plane algorithm the selector picked for it."""
+
+    index: int
+    names: list[str]
+    nbytes: int
+    dtype: str
+    algo: str | None
+    compression: str | None
+    # (name, offset, size, shape) per leaf within the flat payload
+    layout: list[tuple[str, int, int, tuple]] = dataclasses.field(
+        default_factory=list
+    )
+
+
+class PendingSync:
+    """The in-flight gradient sync: one :class:`CollectiveWork` handle
+    per issued bucket. ``wait()`` joins the handles **in issue order**
+    (later buckets keep progressing while earlier ones are joined),
+    scatters the reduced flat payloads back into leaf shapes, and
+    returns ``{name: array}`` (per-rank lists of arrays for the
+    single-controller mesh shape). Partial-mode skips are aggregated:
+    ``skipped`` is the union of ranks any bucket skipped."""
+
+    def __init__(self, buckets, handles, per_rank: bool):
+        self._buckets: list[Bucket] = buckets
+        self._handles: list[CollectiveWork] = handles
+        self._per_rank = per_rank
+        self.partials: list[PartialResult] = []
+
+    @property
+    def buckets(self) -> list[Bucket]:
+        return list(self._buckets)
+
+    @property
+    def skipped(self) -> list[int]:
+        out: set[int] = set()
+        for p in self.partials:
+            out |= set(p.skipped)
+        return sorted(out)
+
+    def done(self) -> bool:
+        return all(h.done() for h in self._handles)
+
+    def wait(self, timeout_s: float | None = None) -> dict:
+        """Join every bucket handle and return the synced leaves
+        ``{name: array}``; for per-rank (mesh) syncs each value is the
+        list of per-rank arrays. Typed collective errors propagate
+        from the failing bucket's handle."""
+        out: dict[str, Any] = {}
+        for bucket, handle in zip(self._buckets, self._handles):
+            res = handle.wait(timeout_s)
+            if isinstance(res, PartialResult):
+                self.partials.append(res)
+                res = res.value
+            if self._per_rank:
+                flats = [np.asarray(v).reshape(-1) for v in res]
+                for name, off, size, shape in bucket.layout:
+                    out[name] = [
+                        f[off:off + size].reshape(shape) for f in flats
+                    ]
+            else:
+                flat = np.asarray(res).reshape(-1)
+                for name, off, size, shape in bucket.layout:
+                    out[name] = flat[off:off + size].reshape(shape)
+        return out
+
+
+class BucketStream:
+    """Incremental add-as-ready interface: ``add()`` one leaf at a time
+    in the order backward produces them (reverse layer order); a bucket
+    whose payload crosses the size target is dispatched on the spot —
+    its collective overlaps whatever compute follows. ``finish()``
+    flushes the stragglers and hands back the :class:`PendingSync`."""
+
+    def __init__(self, bucketer: "GradBucketer"):
+        self._b = bucketer
+        # dtype → [names, segments (per leaf; per-rank: list of lists),
+        # running element count]
+        self._open: dict[str, list] = {}
+        self._buckets: list[Bucket] = []
+        self._handles: list[CollectiveWork] = []
+        self._per_rank: bool | None = None
+
+    def add(self, name: str, value) -> None:
+        """Queue one gradient leaf. ``value`` is this process's array
+        — or, for a single-controller mesh group, the sequence of
+        per-rank arrays. Full buckets are issued immediately."""
+        per_rank = self._b._per_rank_group
+        if self._per_rank is None:
+            self._per_rank = per_rank
+        if per_rank:
+            arrs = [np.asarray(v) for v in value]
+            first = arrs[0]
+        else:
+            first = np.asarray(value)
+            arrs = [first]
+        key = str(first.dtype)
+        entry = self._open.get(key)
+        if entry is None:
+            entry = self._open[key] = [[], [], 0]
+        names, segs, count = entry
+        names.append((name, first.shape))
+        segs.append([a.reshape(-1) for a in arrs])
+        entry[2] = count + int(first.size)
+        if entry[2] * first.dtype.itemsize >= self._b.bucket_bytes:
+            self._flush(key)
+
+    def _flush(self, dtype_key: str) -> None:
+        names, segs, count = self._open.pop(dtype_key)
+        if not names:
+            return
+        per_rank = bool(self._per_rank) and self._b._per_rank_group
+        nbytes = count * np.dtype(dtype_key).itemsize
+        floating = np.issubdtype(np.dtype(dtype_key), np.floating)
+        compression = self._b.compression if floating else None
+        index = len(self._buckets)
+        bucket = Bucket(
+            index=index,
+            names=[n for n, _shape in names],
+            nbytes=int(nbytes),
+            dtype=dtype_key,
+            algo=self._b._bucket_algo(nbytes),
+            compression=compression,
+        )
+        off = 0
+        for name, shape in names:
+            size = int(np.prod(shape)) if shape else 1
+            bucket.layout.append((name, off, size, tuple(shape)))
+            off += size
+        ranks = len(segs[0])
+        payloads = []
+        for r in range(ranks):
+            flat = np.concatenate([s[r] for s in segs]) if len(
+                segs
+            ) > 1 else segs[0][r]
+            payloads.append(np.ascontiguousarray(flat))
+        if compression is not None and self._b.error_feedback:
+            # Residual keyed by (bucket index, rank): deterministic as
+            # long as the model (and therefore the bucket layout) is —
+            # a layout change resets the residual inside ErrorFeedback.
+            payloads = [
+                self._b._ef.apply((index, r), p)
+                for r, p in enumerate(payloads)
+            ]
+        value = payloads if per_rank else payloads[0]
+        self._handles.append(self._b._issue(value, bucket))
+        self._buckets.append(bucket)
+
+    def finish(self) -> PendingSync:
+        """Flush every open bucket and return the pending sync."""
+        for key in list(self._open):
+            self._flush(key)
+        pending = PendingSync(
+            self._buckets, self._handles,
+            per_rank=bool(self._per_rank) and self._b._per_rank_group,
+        )
+        self._b.last_plan = pending.buckets
+        return pending
+
+
+class GradBucketer:
+    """Configured bucketed-sync factory for one collective group.
+
+    ``group_name`` routes through the process-wide group registry;
+    ``group`` passes a backend group object directly (driver-side
+    :class:`XlaMeshGroup` use). ``algo="auto"`` (default) runs the
+    per-bucket :func:`collective.algo.choose_algorithm` selection;
+    an explicit algo pins every bucket; ``algo=None`` keeps each
+    backend's default data plane. Partial mode always takes the
+    default plane — on the cpu backend only the hub owns the grace
+    timer."""
+
+    def __init__(
+        self,
+        group_name: str = "default",
+        group=None,
+        bucket_bytes: int | None = None,
+        compression: str | None = None,
+        min_ranks: int | None = None,
+        grace_s: float | None = None,
+        algo: str | None = colalgo.AUTO,
+        error_feedback: bool = False,
+        n_slices: int = 1,
+        timeout_s: float | None = None,
+    ):
+        self.group_name = group_name
+        self.group = group
+        self.bucket_bytes = (
+            int(bucket_bytes) if bucket_bytes else default_bucket_bytes()
+        )
+        self.compression = codec.check_codec(compression)
+        self.min_ranks = min_ranks
+        self.grace_s = grace_s
+        self.algo = algo
+        self.n_slices = max(1, int(n_slices))
+        self.timeout_s = timeout_s
+        if error_feedback and self.compression is None:
+            raise ValueError(
+                "error_feedback compensates compression error; it "
+                "needs compression= set"
+            )
+        self.error_feedback = bool(error_feedback)
+        self._ef = codec.ErrorFeedback() if error_feedback else None
+        self.last_plan: list[Bucket] = []
+
+    # --------------------------------------------------------- plumbing
+    def _group_obj(self):
+        if self.group is not None:
+            return self.group
+        from ray_tpu import collective as col
+
+        return col.get_group(self.group_name)
+
+    @property
+    def _per_rank_group(self) -> bool:
+        return bool(
+            getattr(self._group_obj(), "expects_per_rank_tensors", False)
+        )
+
+    @property
+    def world(self) -> int:
+        return int(self._group_obj().world)
+
+    def _bucket_algo(self, nbytes: int) -> str | None:
+        if self.algo is None:
+            return None
+        if self.min_ranks is not None:
+            # Partial K-of-N needs the backend's default plane (the cpu
+            # hub owns the grace timer; ring/tree reject min_ranks).
+            return None
+        if self.algo != colalgo.AUTO:
+            return self.algo
+        chosen = colalgo.choose_algorithm(
+            int(nbytes), self.world, n_slices=self.n_slices
+        )
+        # The hierarchical two-level op is a driver-side function, not
+        # a group verb — multi-slice meshes fall back to ring here.
+        return colalgo.RING if chosen == colalgo.HIERARCHICAL else chosen
+
+    def _issue(self, value, bucket: Bucket) -> CollectiveWork:
+        kw: dict = {"timeout_s": self.timeout_s}
+        if bucket.compression is not None:
+            kw["compression"] = bucket.compression
+        if self.min_ranks is not None:
+            kw["min_ranks"] = self.min_ranks
+            kw["grace_s"] = self.grace_s
+        if bucket.algo is not None:
+            kw["algo"] = bucket.algo
+        if self.group is not None:
+            return self.group.allreduce_async(value, **kw)
+        from ray_tpu import collective as col
+
+        return col.allreduce_async(
+            value, group_name=self.group_name, **kw
+        )
+
+    # ------------------------------------------------------------- API
+    def stream(self) -> BucketStream:
+        """Incremental interface: feed leaves as backward produces
+        them; full buckets dispatch immediately."""
+        return BucketStream(self)
+
+    def sync_async(self, grads) -> PendingSync:
+        """Bucket and dispatch a whole gradient pytree (leaves issued
+        in reverse flatten order — the order backward produced them).
+        ``grads`` is this process's tree, or a sequence of per-rank
+        trees for a single-controller mesh group. Returns the
+        :class:`PendingSync`; reassemble the tree from ``wait()`` with
+        :meth:`unflatten`."""
+        import jax
+
+        st = self.stream()
+        if self._per_rank_group:
+            flat_per_rank = [
+                jax.tree_util.tree_flatten(t)[0] for t in grads
+            ]
+            paths, _treedef = self._paths_and_def(grads[0])
+            for i in reversed(range(len(paths))):
+                st.add(
+                    paths[i], [leaves[i] for leaves in flat_per_rank]
+                )
+        else:
+            paths, _treedef = self._paths_and_def(grads)
+            leaves = jax.tree_util.tree_flatten(grads)[0]
+            for i in reversed(range(len(paths))):
+                st.add(paths[i], leaves[i])
+        return st.finish()
+
+    def sync(self, grads):
+        """Synchronous convenience: bucket, dispatch, join, reassemble
+        — the serial baseline the overlap bench compares against (the
+        per-bucket knobs still apply; nothing overlaps)."""
+        return self.unflatten(grads, self.sync_async(grads).wait())
+
+    def _paths_and_def(self, tree):
+        import jax
+
+        leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(
+            tree
+        )
+        paths = [
+            jax.tree_util.keystr(path) for path, _leaf in leaves_with_path
+        ]
+        return paths, treedef
+
+    def unflatten(self, like, synced: dict):
+        """Rebuild the gradient tree (or the list of per-rank trees)
+        from a :meth:`PendingSync.wait` result."""
+        import jax
+
+        if self._per_rank_group:
+            paths, treedef = self._paths_and_def(like[0])
+            ranks = len(like)
+            return [
+                jax.tree_util.tree_unflatten(
+                    treedef, [synced[p][r] for p in paths]
+                )
+                for r in range(ranks)
+            ]
+        paths, treedef = self._paths_and_def(like)
+        return jax.tree_util.tree_unflatten(
+            treedef, [synced[p] for p in paths]
+        )

@@ -17,6 +17,12 @@ loss. This module is the codec both backends share:
   A reduce over K contributors with one requantize of the result is
   bounded by ``sum_k scale_k/2 + scale_result/2``.
 
+- **Error feedback** (:func:`quantization_residual` /
+  :class:`ErrorFeedback`): a sender can carry the per-payload
+  quantization error into the next step's payload before quantizing,
+  so repeated compression bias stops accumulating across steps — the
+  gradient bucketer turns this on with ``error_feedback=True``.
+
 The numpy half serializes to a plain dict (``to_wire``/``from_wire``) so
 it rides the existing collective RPC serializer; the jax half
 (:func:`quantize_jax` / :func:`dequantize_jax`) is shape-static and
@@ -148,6 +154,55 @@ def from_wire(d: dict) -> Quantized:
         dtype=str(d["dtype"]),
         block=int(d["block"]),
     )
+
+
+def quantization_residual(
+    arr: Any, block: int | None = None
+) -> np.ndarray:
+    """The local error one wire round trip of this codec would commit:
+    ``x - dequantize(quantize(x))``, fp32.
+
+    This is the error-feedback primitive (1-bit SGD / EF-SGD lineage):
+    a sender that adds this residual into the NEXT step's payload
+    before quantizing stops repeated-compression bias from
+    accumulating — each step transmits what the previous step's
+    quantizer dropped. The quantizer here mirrors the wire path
+    exactly for the cpu hub (same codec, same block size); the XLA
+    backends' in-program quantizer differs only in chunk-boundary
+    padding, so the residual remains a faithful first-order
+    correction there too."""
+    if block is None:
+        from ray_tpu._private import config
+
+        block = int(config.get("COLLECTIVE_COMPRESSION_BLOCK"))
+    x = np.asarray(arr, np.float32)
+    return x - dequantize(quantize(x, block=block))
+
+
+class ErrorFeedback:
+    """Per-key residual accumulator for repeated compressed syncs.
+
+    One instance per sender; ``apply(key, x)`` returns the
+    residual-compensated payload to hand to the compressed collective
+    and updates the stored residual to the error the codec will commit
+    on it. A key whose payload changes shape (re-bucketing, elastic
+    resize) resets silently — stale residuals must not leak across
+    layouts."""
+
+    def __init__(self, block: int | None = None):
+        self.block = block
+        self._residuals: dict = {}
+
+    def apply(self, key, x: np.ndarray) -> np.ndarray:
+        x = np.asarray(x, np.float32)
+        r = self._residuals.get(key)
+        if r is not None and r.shape == x.shape:
+            x = x + r
+        self._residuals[key] = quantization_residual(x, self.block)
+        return x
+
+    def reset(self) -> None:
+        self._residuals.clear()
 
 
 # ------------------------------------------------------------------ jax

@@ -64,6 +64,132 @@ class PartialResult:
         return bool(self.skipped)
 
 
+class CollectiveWork:
+    """Typed handle for an asynchronously dispatched collective op
+    (``allreduce_async()`` and friends — the T3-style overlap
+    primitive, arXiv:2401.16677).
+
+    The op is in flight the moment the handle exists; ``wait()`` joins
+    it and returns exactly what the synchronous verb would have
+    (including a :class:`PartialResult` envelope in partial mode), or
+    raises the same typed fault-tolerance errors. ``done()`` is a
+    non-blocking completion probe. Handles are single-op: ``wait()``
+    may be called repeatedly (later calls return the cached result),
+    and out-of-order waits across handles are legal — each handle owns
+    its own result buffers.
+
+    Flight-recorder contract: the op's recorded wall interval spans
+    *dispatch → completion* (not the issuing call window and not the
+    ``wait()`` call window), so the comm-exposure attribution credits
+    time genuinely hidden behind compute as overlapped."""
+
+    __slots__ = ("group_name", "verb", "_result", "_error", "_finished",
+                 "_finalize_cb")
+
+    def __init__(self, group_name: str = "", verb: str = ""):
+        self.group_name = group_name
+        self.verb = verb
+        self._result = None
+        self._error: BaseException | None = None
+        self._finished = False
+        # Applied once to the successful result on the waiter's thread
+        # (the dispatch layer hangs partial-result bookkeeping here).
+        self._finalize_cb = None
+
+    # Subclasses implement _join(timeout_s) -> result and _probe() ->
+    # bool; the caching/raise discipline lives here once.
+    def _join(self, timeout_s: float | None):  # pragma: no cover
+        raise NotImplementedError
+
+    def _probe(self) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+    def wait(self, timeout_s: float | None = None):
+        """Block until the op completes; return its result (or raise
+        its typed error). Idempotent — repeat calls replay the cached
+        outcome."""
+        if not self._finished:
+            try:
+                out = self._join(timeout_s)
+                if self._finalize_cb is not None:
+                    out = self._finalize_cb(out)
+                self._result = out
+            except BaseException as e:
+                # A *local* wait deadline is not op completion: the op
+                # is still in flight and a later wait() may join it —
+                # only terminal outcomes are cached.
+                if not getattr(e, "transient_wait", False):
+                    self._error = e
+                    self._finished = True
+                raise
+            self._finished = True
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def done(self) -> bool:
+        """Non-blocking: has the op completed (successfully or not)?"""
+        if self._finished:
+            return True
+        return self._probe()
+
+    def __repr__(self):
+        state = (
+            "error" if self._error is not None
+            else "done" if self._finished
+            else "pending"
+        )
+        return (
+            f"<CollectiveWork {self.verb} group={self.group_name!r} "
+            f"{state}>"
+        )
+
+
+class FutureCollectiveWork(CollectiveWork):
+    """CollectiveWork over a ``concurrent.futures.Future`` — the shape
+    both process-backed backends produce (the cpu hub's op coroutine
+    scheduled on the runtime loop; the xla_dist dispatch thread).
+    ``finalize`` runs once on the successful result on the waiter's
+    thread (partial-result bookkeeping and similar)."""
+
+    __slots__ = ("_future",)
+
+    def __init__(self, future, group_name: str = "", verb: str = "",
+                 finalize=None):
+        super().__init__(group_name=group_name, verb=verb)
+        self._future = future
+        self._finalize_cb = finalize
+
+    def _join(self, timeout_s: float | None):
+        from concurrent.futures import CancelledError as _FutCancelled
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        try:
+            out = self._future.result(timeout_s)
+        except _FutCancelled:
+            # The group was destroyed under this handle (queued dispatch
+            # cancelled): fail typed, like every other in-flight op.
+            raise CollectiveGroupDestroyedError(
+                self.group_name, self.verb
+            ) from None
+        except _FutTimeout:
+            err = CollectiveTimeoutError(
+                self.group_name,
+                self.verb,
+                timeout_s,
+                detail="wait() deadline elapsed before the dispatched "
+                       "op completed (the op itself is still bounded "
+                       "by its own deadline; this handle can be "
+                       "waited again)",
+            )
+            err.transient_wait = True
+            raise err from None
+        return out
+
+    def _probe(self) -> bool:
+        return self._future.done()
+
+
 class CollectiveError(RayTpuError):
     """Base for collective fault-tolerance errors. All subclasses keep
     their fields in ``args`` so they survive the task-error pickle path

@@ -14,6 +14,7 @@ ObjectRefs; values stay in worker memory / the shared-memory store.
 from __future__ import annotations
 
 import collections
+import logging
 from typing import Iterator
 
 import numpy as np
@@ -21,6 +22,8 @@ import numpy as np
 import ray_tpu
 from ray_tpu.data import block as B
 from ray_tpu.data import plan as P
+
+logger = logging.getLogger("ray_tpu.data")
 
 _FUSABLE = {"map_batches", "map", "filter", "flat_map", "add_column",
             "drop_columns", "select_columns"}
@@ -48,7 +51,10 @@ class DataContext:
             return self.default_parallelism
         try:
             cpus = int(ray_tpu.cluster_resources().get("CPU", 0))
-        except Exception:
+        except Exception:  # noqa: BLE001
+            logger.debug(
+                "cluster resource probe failed; using default parallelism"
+            )
             cpus = 0
         return max(self.min_parallelism, cpus or 4)
 
@@ -383,6 +389,7 @@ def execute(plan: P.LogicalPlan, ctx: DataContext | None = None) -> Iterator:
                     for a in _actors:
                         try:
                             ray_tpu.kill(a)
+                        # tpulint: allow(broad-except reason=stage teardown; an actor that already died released its lease, which is all kill is for here)
                         except Exception:  # noqa: BLE001
                             pass
 

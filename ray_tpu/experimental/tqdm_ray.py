@@ -101,6 +101,7 @@ class tqdm:
                 asyncio.ensure_future(coro)
             else:
                 rt.run(coro, timeout=5)
+        # tpulint: allow(broad-except reason=progress publishing is best-effort; raising or logging from inside the bar-update path would corrupt the very output it decorates)
         except Exception:  # noqa: BLE001 - progress is best-effort
             pass
 

@@ -117,14 +117,30 @@ def composed_trainer_loop(config):
         group_name=gname,
     )
     loss = None
+    # Bucketed overlap gradient sync (ScalingConfig.grad_overlap): the
+    # step loop issues per-bucket async allreduces for the REAL grads
+    # eagerly inside the compute phase and joins the handles just
+    # before the optimizer update — the canonical overlapped-step
+    # shape the dryrun drives end to end through JaxTrainer.fit().
+    overlap = bool(train.grad_sync_opts().get("overlap"))
+    n_buckets = 0
     try:
         for step in range(int(config.get("steps", 2))):
             with train.step_span() as sp:
+                pending = None
                 with sp.phase("compute"):
                     loss, grads = composed_value_and_grad(params, mesh)
-                    params = jax.tree.map(
-                        lambda p, g: p - 0.1 * g, params, grads
-                    )
+                    if overlap:
+                        bucketer = train.grad_bucketer(group_name=gname)
+                        pending = bucketer.sync_async(grads)
+                        # In-flight buckets overlap this remaining
+                        # compute (host-side grad-norm probe).
+                        gnorm = float(
+                            np.sqrt(sum(
+                                float(jax.numpy.sum(g * g))
+                                for g in jax.tree.leaves(grads)
+                            ))
+                        )
                 with sp.phase("collective"):
                     # Cross-worker loss mean through the recorded
                     # collective path (the compiled program's psums are
@@ -134,6 +150,19 @@ def composed_trainer_loop(config):
                         np.asarray([float(loss)], np.float32),
                         group_name=gname,
                     )[0] / max(1, ctx.get_world_size())
+                    if pending is not None:
+                        # Join tail: only what did not finish during
+                        # compute shows up as exposed comm.
+                        synced = bucketer.unflatten(grads, pending.wait())
+                        world = max(1, ctx.get_world_size())
+                        grads = jax.tree.map(
+                            lambda g: np.asarray(g) / world, synced
+                        )
+                        n_buckets = len(pending.buckets)
+                with sp.phase("compute"):
+                    params = jax.tree.map(
+                        lambda p, g: p - 0.1 * g, params, grads
+                    )
             ckpt = None
             if ctx.get_world_rank() == 0:
                 ckpt = tempfile.mkdtemp(prefix="composed_ck_")
@@ -141,10 +170,13 @@ def composed_trainer_loop(config):
                     os.path.join(ckpt, "params.npz"),
                     **{k: np.asarray(v) for k, v in params.items()},
                 )
-            train.report(
-                {"loss": float(mean_loss), "step": step,
-                 "mesh": {"pp": 2, "ep": 2, "fsdp": int(config["fsdp"])}},
-                checkpoint=ckpt,
-            )
+            metrics = {
+                "loss": float(mean_loss), "step": step,
+                "mesh": {"pp": 2, "ep": 2, "fsdp": int(config["fsdp"])},
+            }
+            if overlap:
+                metrics["grad_buckets"] = n_buckets
+                metrics["grad_norm"] = gnorm
+            train.report(metrics, checkpoint=ckpt)
     finally:
         col.destroy_collective_group(gname)

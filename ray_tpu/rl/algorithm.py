@@ -110,6 +110,7 @@ class Algorithm:
         for r in self.runners.runners:
             try:
                 ray_tpu.kill(r)
+            # tpulint: allow(broad-except reason=stop() kills best-effort; a runner that already died is exactly the state stop wants)
             except Exception:
                 pass
 

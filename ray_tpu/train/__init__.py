@@ -22,6 +22,7 @@ from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
     get_dataset_shard,
+    grad_bucketer,
     grad_sync_opts,
     partial_collective_opts,
     preemption_notice,
@@ -53,6 +54,7 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "get_dataset_shard",
+    "grad_bucketer",
     "grad_sync_opts",
     "partial_collective_opts",
     "preemption_notice",

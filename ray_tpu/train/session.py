@@ -40,6 +40,14 @@ class TrainContext:
     # codec name grad_sync_opts() forwards to the gradient collective
     # ("int8" = block-scaled int8 wire format, fp32 accumulation).
     grad_compression: str | None = None
+    # Bucketed overlap gradient sync (ScalingConfig.grad_overlap /
+    # grad_bucket_mb / grad_error_feedback): grad_sync_opts() reports
+    # overlap=True and grad_bucketer() hands the loop a configured
+    # collective.bucketer.GradBucketer (cached per attempt).
+    grad_overlap: bool = False
+    grad_bucket_mb: float | None = None
+    grad_error_feedback: bool = False
+    _grad_bucketer: object = None
     # This worker's node "slice" label (None off-slice): the fault
     # domain it dies with. Resolved by TrainWorker.setup through the
     # head node table; the RAY_TPU_SLICE_FAIL chaos knob and slice-
@@ -126,12 +134,71 @@ def grad_sync_opts(world: int | None = None) -> dict:
     compression codec (``grad_compression``) — so train loops can write
     ``col.allreduce(grads, **train.grad_sync_opts())`` unconditionally
     and pick up both knobs. ``{}`` when neither is configured (the
-    collective then runs its classic byte-identical path)."""
+    collective then runs its classic byte-identical path).
+
+    With ``ScalingConfig(grad_overlap=True)`` the dict additionally
+    carries ``overlap: True`` (plus ``bucket_bytes`` and
+    ``error_feedback`` when configured). ``overlap`` is NOT an
+    allreduce kwarg — it is the step loop's signal to switch to the
+    bucketed async path::
+
+        opts = train.grad_sync_opts()
+        if opts.pop("overlap", False):
+            pending = train.grad_bucketer().sync_async(grads)
+            ...                      # remaining backward / other compute
+            grads = train.grad_bucketer().unflatten(
+                grads, pending.wait())   # join just before the update
+        else:
+            grads = col.allreduce(grads, **opts)
+    """
     opts = partial_collective_opts(world)
     ctx = get_context()
     if ctx.grad_compression:
         opts["compression"] = ctx.grad_compression
+    if ctx.grad_overlap:
+        opts["overlap"] = True
+        if ctx.grad_bucket_mb is not None:
+            opts["bucket_bytes"] = int(ctx.grad_bucket_mb * (1 << 20))
+        if ctx.grad_error_feedback:
+            opts["error_feedback"] = True
     return opts
+
+
+def grad_bucketer(group_name: str | None = None, world: int | None = None):
+    """The configured :class:`~ray_tpu.collective.bucketer.GradBucketer`
+    for this worker group's bucketed overlap sync — every
+    ``ScalingConfig`` gradient-sync knob applied (bucket size, int8
+    codec + error feedback, partial K-of-N, per-bucket algo
+    selection). Cached on the context: the error-feedback residuals
+    must persist across steps. ``group_name`` defaults to the
+    trainer's collective group."""
+    ctx = get_context()
+    gname = group_name or ctx.collective_group
+    if not gname:
+        raise RuntimeError(
+            "no collective group for the gradient bucketer: pass "
+            "group_name= or start the trainer with "
+            "ScalingConfig(distributed=True)"
+        )
+    cached = ctx._grad_bucketer
+    if cached is not None and cached.group_name == gname:
+        return cached
+    from ray_tpu.collective.bucketer import GradBucketer
+
+    popts = partial_collective_opts(world)
+    ctx._grad_bucketer = GradBucketer(
+        group_name=gname,
+        bucket_bytes=(
+            int(ctx.grad_bucket_mb * (1 << 20))
+            if ctx.grad_bucket_mb is not None
+            else None
+        ),
+        compression=ctx.grad_compression,
+        min_ranks=popts.get("min_ranks"),
+        grace_s=popts.get("grace_s"),
+        error_feedback=ctx.grad_error_feedback,
+    )
+    return ctx._grad_bucketer
 
 
 def slice_label() -> str | None:

@@ -74,6 +74,18 @@ class ScalingConfig:
     # allow_partial_grads: the compressed program carries the partial
     # mask. None keeps gradient sync byte-identical to today.
     grad_compression: str | None = None
+    # Bucketed overlap gradient sync (T3-style): with grad_overlap on,
+    # session.grad_sync_opts() reports overlap=True and the step loop
+    # issues per-bucket async allreduces (collective/bucketer.py)
+    # eagerly — in reverse-layer order, ~grad_bucket_mb MiB per bucket
+    # (None = config COLLECTIVE_BUCKET_MB), per-bucket ring/tree
+    # selection by size — joining the handles just before the optimizer
+    # update so the collectives hide behind remaining compute.
+    # grad_error_feedback carries each bucket's int8 quantization
+    # residual into the next step (needs grad_compression).
+    grad_overlap: bool = False
+    grad_bucket_mb: float | None = None
+    grad_error_feedback: bool = False
 
     def bundle(self) -> dict:
         b = {"CPU": 1.0}
@@ -239,6 +251,7 @@ class TrainWorker:
         grad_compression = (
             backend_env.get("RAY_TPU_TRAIN_GRAD_COMPRESSION") or None
         )
+        grad_bucket_mb = backend_env.get("RAY_TPU_TRAIN_GRAD_BUCKET_MB")
         # The slice fault domain this worker dies with: its node's
         # "slice" label (None off-slice). Resolved once at setup so the
         # loop (and the SLICE_FAIL chaos knob) never pays a head RPC
@@ -274,6 +287,15 @@ class TrainWorker:
             ),
             partial_grace_s=float(partial_grace) if partial_grace else None,
             grad_compression=grad_compression,
+            grad_overlap=(
+                backend_env.get("RAY_TPU_TRAIN_GRAD_OVERLAP") == "1"
+            ),
+            grad_bucket_mb=(
+                float(grad_bucket_mb) if grad_bucket_mb else None
+            ),
+            grad_error_feedback=(
+                backend_env.get("RAY_TPU_TRAIN_GRAD_ERROR_FEEDBACK") == "1"
+            ),
             slice_label=slice_label,
         )
         return True
@@ -631,6 +653,14 @@ class JaxTrainer:
             env["RAY_TPU_TRAIN_GRAD_COMPRESSION"] = str(
                 self.scaling.grad_compression
             )
+        if self.scaling.grad_overlap:
+            env["RAY_TPU_TRAIN_GRAD_OVERLAP"] = "1"
+        if self.scaling.grad_bucket_mb is not None:
+            env["RAY_TPU_TRAIN_GRAD_BUCKET_MB"] = str(
+                self.scaling.grad_bucket_mb
+            )
+        if self.scaling.grad_error_feedback:
+            env["RAY_TPU_TRAIN_GRAD_ERROR_FEEDBACK"] = "1"
         if self.scaling.distributed and n > 1:
             env["RAY_TPU_TRAIN_DISTRIBUTED"] = "1"
         return env

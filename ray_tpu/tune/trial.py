@@ -10,6 +10,7 @@ stop them through ordinary actor calls.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import threading
@@ -18,6 +19,8 @@ import traceback
 from typing import Any, Callable
 
 import ray_tpu
+
+logger = logging.getLogger("ray_tpu.tune")
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
@@ -123,6 +126,7 @@ class TrialActor:
                 pass
             except Exception:  # noqa: BLE001 - reported via poll
                 self.error = traceback.format_exc()
+                logger.warning("trial failed:\n%s", self.error)
             finally:
                 self.done = True
                 tune_mod._set_session(None)

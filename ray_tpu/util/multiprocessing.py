@@ -55,6 +55,7 @@ class AsyncResult:
         try:
             self.get(timeout=0)
             return True
+        # tpulint: allow(broad-except reason=stdlib AsyncResult.successful() contract: ANY task error means False; the error itself is re-raised by get())
         except Exception:  # noqa: BLE001
             return False
 

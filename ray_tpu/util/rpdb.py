@@ -20,10 +20,13 @@ no-auth caveat as the node agent).
 
 from __future__ import annotations
 
+import logging
 import os
 import pdb
 import socket
 import sys
+
+logger = logging.getLogger("ray_tpu.rpdb")
 
 
 class _SocketFile:
@@ -150,4 +153,7 @@ def _maybe_post_mortem(tb=None) -> bool:
         post_mortem(tb)
         return True
     except Exception:  # noqa: BLE001 - debugging must not mask the error
+        logger.warning(
+            "post-mortem debugger failed to attach", exc_info=True
+        )
         return False

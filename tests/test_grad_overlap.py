@@ -1,0 +1,476 @@
+"""Bucketed gradient sync + async collective handles (T3-style
+compute–collective overlap, arXiv:2401.16677).
+
+Covers the CollectiveWork handle contract (wait/done, idempotent and
+out-of-order waits, partial results through a handle, typed failure on
+group destroy), the gradient bucketer (reverse-layer order, size
+targets, per-bucket ring/tree selection, int8 + error-feedback and
+partial K-of-N composition), the comm-exposure attribution fix for
+handle-based ops (dispatch→completion intervals), and the train
+session's overlap knobs."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective import algo as colalgo
+from ray_tpu.collective.backends.xla_group import XlaMeshGroup
+from ray_tpu.collective.bucketer import GradBucketer
+from ray_tpu.collective.types import (
+    CollectiveTimeoutError,
+    CollectiveWork,
+    FutureCollectiveWork,
+    PartialResult,
+)
+
+
+@pytest.fixture(scope="module")
+def xg():
+    return XlaMeshGroup(name="overlap_test")
+
+
+def _rank_trees(world, seed=0):
+    return [
+        {
+            "a": np.random.default_rng(seed + r).normal(
+                size=(300,)
+            ).astype(np.float32),
+            "b": {
+                "w": np.random.default_rng(seed + 100 + r).normal(
+                    size=(64, 64)
+                ).astype(np.float32),
+            },
+        }
+        for r in range(world)
+    ]
+
+
+def _tree_sum(trees):
+    import jax
+
+    return jax.tree.map(
+        lambda *xs: np.sum(np.stack([np.asarray(x) for x in xs]), axis=0),
+        *trees,
+    )
+
+
+# ------------------------------------------------------ handle contract
+def test_future_work_wait_timeout_is_transient():
+    """A local wait() deadline raises typed but does NOT poison the
+    handle: the op is still in flight and a later wait() joins it."""
+    from concurrent.futures import Future
+
+    fut = Future()
+    work = FutureCollectiveWork(fut, group_name="g", verb="allreduce")
+    assert not work.done()
+    with pytest.raises(CollectiveTimeoutError, match="waited again"):
+        work.wait(timeout_s=0.01)
+    fut.set_result(41)
+    assert work.wait(timeout_s=1) == 41
+    assert work.done()
+    assert work.wait() == 41  # cached, idempotent
+
+
+def test_future_work_cancel_is_destroy_typed():
+    from concurrent.futures import Future
+
+    from ray_tpu.collective.types import CollectiveGroupDestroyedError
+
+    fut = Future()
+    fut.cancel()
+    work = FutureCollectiveWork(fut, group_name="g", verb="allreduce")
+    with pytest.raises(CollectiveGroupDestroyedError):
+        work.wait(timeout_s=1)
+
+
+def test_mesh_async_out_of_order_waits(xg):
+    xs = [np.full((512,), r, np.float32) for r in range(xg.world)]
+    h1 = xg.allreduce_async(xs)
+    h2 = xg.allreduce_async([x * 2 for x in xs])
+    h3 = xg.allgather_async([np.full((2,), r, np.float32)
+                             for r in range(xg.world)])
+    assert all(isinstance(h, CollectiveWork) for h in (h1, h2, h3))
+    expect = np.sum(xs, axis=0)
+    # Join in reverse issue order: each handle owns its buffers.
+    np.testing.assert_array_equal(
+        np.asarray(h3.wait()[0]),
+        np.concatenate(
+            [np.full((2,), r, np.float32) for r in range(xg.world)]
+        ),
+    )
+    np.testing.assert_allclose(np.asarray(h2.wait()[0]), expect * 2,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(h1.wait()[0]), expect,
+                               rtol=1e-5)
+    out = h1.wait()
+    assert out is h1.wait()  # cached result, repeat waits legal
+    assert h1.done() and h2.done() and h3.done()
+
+
+def test_mesh_async_partial_through_handle(xg):
+    xs = [np.full((128,), float(r + 1), np.float32)
+          for r in range(xg.world)]
+    h = xg.allreduce_async(xs, min_ranks=2, skip_ranks=[0, 3])
+    res = h.wait()
+    assert isinstance(res, PartialResult)
+    assert res.skipped == [0, 3]
+    assert res.world == xg.world
+    contributed = [r + 1 for r in range(xg.world) if r not in (0, 3)]
+    expect = sum(contributed) * xg.world / len(contributed)
+    np.testing.assert_allclose(
+        np.asarray(res.value[0]), np.full((128,), expect), rtol=1e-5
+    )
+
+
+def test_mesh_async_reducescatter_and_compressed(xg):
+    xs = [np.full((xg.world * 4,), float(r), np.float32)
+          for r in range(xg.world)]
+    rs = xg.reducescatter_async(xs).wait()
+    total = sum(range(xg.world))
+    np.testing.assert_allclose(np.asarray(rs[0]),
+                               np.full((4,), total), rtol=1e-5)
+    big = [np.linspace(-1, 1, 4096).astype(np.float32) * (r + 1)
+           for r in range(xg.world)]
+    out = xg.allreduce_async(big, compression="int8").wait()
+    expect = np.sum(np.stack(big), axis=0)
+    scale = np.max(np.abs(expect))
+    assert np.max(np.abs(np.asarray(out[0]) - expect)) / scale < 0.05
+
+
+def test_async_interval_spans_dispatch_to_completion(xg):
+    """The comm-attribution fix for handle-based ops: the recorded op
+    interval is dispatch→completion, so an async op issued AND joined
+    inside the compute phase counts fully as overlapped — while a
+    serial op outside compute stays fully exposed."""
+    from ray_tpu.collective import flight_recorder
+    from ray_tpu.train import telemetry
+
+    xs = [np.random.default_rng(r).normal(size=(1 << 16,)).astype(
+        np.float32) for r in range(xg.world)]
+    flight_recorder.take_op_intervals()  # drain
+    timer = telemetry.StepTimer()
+    with timer.phase("compute"):
+        h = xg.allreduce_async(xs)
+        time.sleep(0.05)  # backward-compute stand-in
+        h.wait()
+    dur = timer.elapsed()
+    exposed, overlapped = telemetry.comm_attribution(
+        timer.start, timer.start + dur, timer._events
+    )
+    assert overlapped > 0.0
+    assert exposed == pytest.approx(0.0, abs=1e-6)
+
+    # Serial contrast: the same op joined outside any compute phase is
+    # all exposed.
+    timer2 = telemetry.StepTimer()
+    with timer2.phase("compute"):
+        time.sleep(0.01)
+    with timer2.phase("collective"):
+        xg.allreduce(xs)
+    dur2 = timer2.elapsed()
+    exposed2, overlapped2 = telemetry.comm_attribution(
+        timer2.start, timer2.start + dur2, timer2._events
+    )
+    assert exposed2 > 0.0
+    assert overlapped2 == pytest.approx(0.0, abs=1e-6)
+
+
+# ------------------------------------------------------------- bucketer
+def test_bucketer_reverse_order_and_parity(xg):
+    trees = _rank_trees(xg.world)
+    b = GradBucketer(group=xg, bucket_bytes=8 << 10)
+    pending = b.sync_async(trees)
+    # Reverse flatten order: the LAST leaf ('b.w') leads the first
+    # bucket — the order backward produces gradients.
+    first = pending.buckets[0]
+    assert first.names[0] == "['b']['w']"
+    out = pending.wait()
+    synced = b.unflatten(trees, out)
+    expect = _tree_sum(trees)
+    for r in range(xg.world):
+        np.testing.assert_allclose(
+            np.asarray(synced[r]["a"]), expect["a"], rtol=1e-4,
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(synced[r]["b"]["w"]), expect["b"]["w"],
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+def test_bucketer_algo_selection_small_vs_large(xg):
+    """Per-bucket choose_algorithm wiring: a bucket below the world's
+    tree→ring crossover takes the tree, above it the ring — and
+    partial mode pins the backend's default plane."""
+    crossover = colalgo.crossover_bytes(xg.world)
+    # Reverse flatten order is ['zbig', 'asmall']: the big leaf fills
+    # (and flushes) its own bucket immediately; the small one flushes
+    # at finish().
+    big_leaf = np.zeros((2 * crossover // 4,), np.float32)  # 2x over
+    small_leaf = np.zeros((16,), np.float32)
+    trees = [
+        {"zbig": big_leaf + r, "asmall": small_leaf + r}
+        for r in range(xg.world)
+    ]
+    b = GradBucketer(group=xg, bucket_bytes=crossover)
+    pending = b.sync_async(trees)
+    algos = {
+        bucket.names[0]: bucket.algo for bucket in pending.buckets
+    }
+    pending.wait()
+    assert algos["['asmall']"] == colalgo.TREE
+    assert algos["['zbig']"] == colalgo.RING
+    # Partial K-of-N needs the default data plane (the grace timer
+    # lives there on the cpu backend): the selector steps aside.
+    bp = GradBucketer(group=xg, bucket_bytes=crossover, min_ranks=2)
+    pp = bp.sync_async(trees)
+    assert all(bucket.algo is None for bucket in pp.buckets)
+    pp.wait()
+
+
+def test_bucketer_compressed_int8(xg):
+    """Dedicated bucketed + compression="int8" composition: every
+    bucket rides the compressed program, result within codec
+    tolerance."""
+    trees = _rank_trees(xg.world, seed=7)
+    b = GradBucketer(group=xg, bucket_bytes=8 << 10, compression="int8")
+    pending = b.sync_async(trees)
+    assert all(bk.compression == "int8" for bk in pending.buckets)
+    synced = b.unflatten(trees, pending.wait())
+    expect = _tree_sum(trees)
+    scale = np.max(np.abs(expect["b"]["w"]))
+    assert (
+        np.max(np.abs(np.asarray(synced[0]["b"]["w"]) - expect["b"]["w"]))
+        / scale
+        < 0.05
+    )
+
+
+def test_bucketer_error_feedback_kills_repeated_bias(xg):
+    """Error-feedback satellite: repeated compressed syncs of a
+    gradient with a sub-quantum systematic component accumulate a
+    linear bias without EF; with EF the residual carries over and the
+    accumulated mean stays within ~one quantum of the truth."""
+    rng = np.random.default_rng(0)
+    g = rng.normal(size=(4096,)).astype(np.float32)
+    quantum = np.abs(g).max() / 127.0
+    g[::2] = 0.3 * quantum  # dropped by the quantizer every step
+    trees = [{"g": g.copy()} for _ in range(xg.world)]
+
+    def accumulate(error_feedback):
+        b = GradBucketer(
+            group=xg, bucket_bytes=1 << 26, compression="int8",
+            error_feedback=error_feedback,
+        )
+        acc = np.zeros_like(g)
+        for _ in range(20):
+            out = b.sync_async(trees).wait()
+            acc += np.asarray(out["['g']"][0]) / xg.world
+        return acc
+
+    true = g * 20
+    bias_plain = np.abs(accumulate(False) - true)[::2].mean()
+    bias_ef = np.abs(accumulate(True) - true)[::2].mean()
+    assert bias_ef < bias_plain / 5, (bias_plain, bias_ef)
+
+
+def test_bucketer_error_feedback_requires_compression():
+    with pytest.raises(ValueError, match="needs compression"):
+        GradBucketer(group_name="x", error_feedback=True)
+
+
+# ------------------------------------------------- train session knobs
+def test_grad_sync_opts_overlap_mode():
+    from ray_tpu import train
+    from ray_tpu.train.session import TrainContext, _set_context
+
+    ctx = TrainContext(
+        world_size=4,
+        collective_group="gg",
+        allow_partial_grads=True,
+        partial_min_fraction=0.5,
+        grad_compression="int8",
+        grad_overlap=True,
+        grad_bucket_mb=2.0,
+        grad_error_feedback=True,
+    )
+    _set_context(ctx)
+    try:
+        opts = train.grad_sync_opts()
+        assert opts["overlap"] is True
+        assert opts["bucket_bytes"] == 2 << 20
+        assert opts["error_feedback"] is True
+        assert opts["compression"] == "int8"
+        assert opts["min_ranks"] == 2
+        b = train.grad_bucketer()
+        assert b.group_name == "gg"
+        assert b.bucket_bytes == 2 << 20
+        assert b.compression == "int8"
+        assert b.min_ranks == 2
+        assert b.error_feedback is True
+        # Cached per attempt: the EF residuals must persist.
+        assert train.grad_bucketer() is b
+    finally:
+        _set_context(None)
+
+
+def test_grad_sync_opts_default_has_no_overlap():
+    from ray_tpu import train
+    from ray_tpu.train.session import TrainContext, _set_context
+
+    _set_context(TrainContext(world_size=4))
+    try:
+        assert train.grad_sync_opts() == {}
+    finally:
+        _set_context(None)
+
+
+# ------------------------------------------------- cpu backend (actors)
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Member:
+    def setup(self, world, rank, group, env=None):
+        import os
+
+        import ray_tpu.collective as col
+
+        if env:
+            os.environ.update(env)
+        col.init_collective_group(
+            world, rank, backend="cpu", group_name=group, timeout_s=30
+        )
+        return rank
+
+    def async_pair(self, group, value):
+        import numpy as np
+
+        import ray_tpu.collective as col
+
+        h1 = col.allreduce_async(
+            np.full((8,), value, np.float32), group_name=group
+        )
+        h2 = col.allreduce_async(
+            np.full((8,), value * 10, np.float32), group_name=group
+        )
+        r2 = np.asarray(h2.wait(timeout_s=30))
+        r1 = np.asarray(h1.wait(timeout_s=30))
+        return {
+            "r1": float(r1[0]),
+            "r2": float(r2[0]),
+            "done": h1.done() and h2.done(),
+        }
+
+    def bucketed_partial(self, group, value, min_ranks, grace_s):
+        import numpy as np
+
+        from ray_tpu.collective.bucketer import GradBucketer
+
+        tree = {
+            "a": np.full((300,), value, np.float32),
+            "b": np.full((200,), value * 2, np.float32),
+        }
+        b = GradBucketer(
+            group_name=group, bucket_bytes=1 << 20,
+            min_ranks=min_ranks, grace_s=grace_s,
+        )
+        pending = b.sync_async(tree)
+        synced = b.unflatten(tree, pending.wait(timeout_s=30))
+        return {
+            "skipped": pending.skipped,
+            "partials": len(pending.partials),
+            "a0": float(synced["a"][0]),
+            "b0": float(synced["b"][0]),
+        }
+
+    def abandoned_handle(self, group):
+        import time as _time
+
+        import numpy as np
+
+        import ray_tpu.collective as col
+
+        h = col.allreduce_async(
+            np.ones((4,), np.float32), group_name=group
+        )
+        _time.sleep(0.3)  # let the dispatch reach the hub and pend
+        col.destroy_collective_group(group)
+        try:
+            h.wait(timeout_s=10)
+            return {"raised": None}
+        except col.CollectiveError as e:
+            return {"raised": type(e).__name__}
+
+
+def test_cpu_async_handles_across_actors(cluster):
+    members = [Member.remote() for _ in range(2)]
+    ray_tpu.get(
+        [m.setup.remote(2, i, "ga") for i, m in enumerate(members)],
+        timeout=30,
+    )
+    outs = ray_tpu.get(
+        [m.async_pair.remote("ga", float(i + 1)) for i, m in
+         enumerate(members)],
+        timeout=30,
+    )
+    for o in outs:
+        assert o["r1"] == pytest.approx(3.0)
+        assert o["r2"] == pytest.approx(30.0)
+        assert o["done"]
+
+
+def test_cpu_bucketed_partial_with_straggler(cluster):
+    """Dedicated bucketed + partial (min_ranks=) composition: rank 2
+    is 2s late (chaos knob); every bucket completes within the grace
+    window, PendingSync aggregates the skip, and the value is the
+    world/K-rescaled contributor sum."""
+    world = 3
+    members = [Member.remote() for _ in range(world)]
+    ray_tpu.get(
+        [
+            m.setup.remote(
+                world, i, "gbp",
+                {"RAY_TPU_STRAGGLER_DELAY": "2:2.0"} if i == 2 else None,
+            )
+            for i, m in enumerate(members)
+        ],
+        timeout=30,
+    )
+    refs = [
+        m.bucketed_partial.remote("gbp", float(i + 1), 2, 0.3)
+        for i, m in enumerate(members)
+    ]
+    fast = ray_tpu.get(refs[:2], timeout=30)
+    for o in fast:
+        assert o["skipped"] == [2]
+        assert o["partials"] >= 1
+        # (1+2) * world/K = 3 * 3/2
+        assert o["a0"] == pytest.approx(4.5)
+        assert o["b0"] == pytest.approx(9.0)
+    late = ray_tpu.get(refs[2], timeout=30)  # straggler rejoins typed
+    assert late["a0"] == pytest.approx(4.5)
+
+
+def test_cpu_async_handle_fails_typed_on_destroy(cluster):
+    """A handle abandoned in flight when the group is destroyed fails
+    typed (PR-1 destroy semantics), never hangs."""
+    world = 2
+    members = [Member.remote() for _ in range(world)]
+    ray_tpu.get(
+        [m.setup.remote(world, i, "gd") for i, m in enumerate(members)],
+        timeout=30,
+    )
+    # Only rank 0 contributes: the op pends at the hub until destroy.
+    out = ray_tpu.get(members[0].abandoned_handle.remote("gd"),
+                      timeout=30)
+    assert out["raised"] in (
+        "CollectiveGroupDestroyedError",
+        "CollectiveMemberDiedError",
+    ), out

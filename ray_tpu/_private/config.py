@@ -256,6 +256,30 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                       "admission (deterministic TTFT "
                                       "injection for serve-tracing "
                                       "tests)"),
+    "MEM_TELEMETRY": (bool, True, "device/host memory sampling + "
+                                  "subsystem byte registration + OOM "
+                                  "forensics (always-cheap; 0 makes "
+                                  "the per-step sample and track() "
+                                  "pinned-budget no-ops)"),
+    "MEM_HEADROOM_ALERT_FRACTION": (float, 0.1, "headroom alert "
+                                                "threshold: warn (log "
+                                                "+ ray_tpu_mem_"
+                                                "headroom_alert) when "
+                                                "free device memory "
+                                                "drops below this "
+                                                "fraction of "
+                                                "capacity"),
+    "MEM_OOM_REPORT_DIR": (str, "", "directory for persisted OOM "
+                                    "forensics JSON reports (default: "
+                                    "<tmpdir>/ray_tpu_mem)"),
+    "FAKE_HBM_GB": (float, 0.0, "chaos spec: cap the memory sampler's "
+                                "reported device capacity at this many "
+                                "GiB (0 = off) so headroom alerts and "
+                                "the OOM-forensics path are "
+                                "deterministically drivable without "
+                                "real HBM pressure; sampled usage "
+                                "above the cap raises an injected "
+                                "ResourceExhausted at step close"),
     "ADDRESS": (str, "", "default cluster address for init()"),
 }
 

@@ -332,6 +332,7 @@ def object_plane_bench(quick: bool = False) -> list[dict]:
         for node in nodes:
             try:
                 rt.run(node.stop())
+            # tpulint: allow(broad-except reason=bench teardown of throwaway nodes; the rows are already collected and shutdown() reaps leftovers)
             except Exception:  # noqa: BLE001
                 pass
         for d in dirs:
@@ -382,6 +383,7 @@ def dag_pipeline_bench(quick: bool = False) -> list[dict]:
         for s in stages:
             try:
                 ray_tpu.kill(s)
+            # tpulint: allow(broad-except reason=bench teardown of throwaway stage actors; the measurement is already taken)
             except Exception:  # noqa: BLE001
                 pass
     rate = n_exec / dt

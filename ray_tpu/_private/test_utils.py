@@ -166,6 +166,21 @@ def own_slice_index() -> "int | None":
         return None
 
 
+def fake_hbm_cap_bytes() -> "int | None":
+    """The RAY_TPU_FAKE_HBM_GB chaos cap in bytes (None = off). Read
+    per call so tests can flip the knob at runtime: the memory sampler
+    (runtime/memory.py) reports this as device capacity, driving
+    headroom alerts and — when sampled usage exceeds it — the injected
+    ResourceExhausted that exercises OOM forensics without real HBM
+    pressure."""
+    from ray_tpu._private import config
+
+    gb = config.get("FAKE_HBM_GB")
+    if not gb or gb <= 0:
+        return None
+    return int(float(gb) * (1 << 30))
+
+
 def parse_preempt_spec(spec: str) -> "tuple[float, str]":
     """Parse the RAY_TPU_PREEMPT_AFTER_S chaos spec (same env-spec
     family as RAY_TPU_RPC_FAILURE): ``"<delay_s>[@<substr>]"`` — a
@@ -258,6 +273,7 @@ class WorkerKillerActor:
                 try:
                     conn = await rt.core._connect(addr)
                     reply = await conn.call("list_workers")
+                # tpulint: allow(broad-except reason=chaos actor probing nodes it may itself have killed; an unreachable node is skipped, which is the point)
                 except Exception:  # noqa: BLE001 - node may be gone
                     continue
                 victims = [
@@ -274,6 +290,7 @@ class WorkerKillerActor:
                         "kill_worker", worker_id=victim["worker_id"]
                     )
                     self.kills.append(victim["worker_id"])
+                # tpulint: allow(broad-except reason=chaos kill racing the victim's own death; a lost race means the worker is already dead, try the next node)
                 except Exception:  # noqa: BLE001
                     continue
                 break
@@ -311,6 +328,7 @@ class NodeKillerActor:
                     await conn.call("kill_worker", worker_id=w["worker_id"])
                 self.killed.append(addr)
                 return addr
+            # tpulint: allow(broad-except reason=chaos actor tearing down a node that may already be half-dead; the next target is tried)
             except Exception:  # noqa: BLE001
                 continue
         return None

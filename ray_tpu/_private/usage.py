@@ -53,6 +53,7 @@ def usage_stats() -> dict:
                 record["backend"] = jax_mod.default_backend()
                 record["device_count"] = jax_mod.device_count()
                 record["device_kind"] = jax_mod.devices()[0].device_kind
+        # tpulint: allow(broad-except reason=jax-internals probe for optional usage fields; any layout shift just omits them from the record)
         except Exception:  # noqa: BLE001 - internal layout may shift
             pass
     try:
@@ -67,6 +68,7 @@ def usage_stats() -> dict:
                 for k, v in n.get("resources", {}).items():
                     totals[k] = totals.get(k, 0) + v
             record["cluster_resources"] = totals
+    # tpulint: allow(broad-except reason=cluster-shape probe for optional usage fields; a process without a cluster simply reports none)
     except Exception:  # noqa: BLE001 - no cluster is fine
         pass
     return record

@@ -144,6 +144,16 @@ class AsyncCheckpointer:
         self._err: Exception | None = None
         # Stats of the last completed persist (tests + dashboards).
         self.last: dict = {}
+        # Host-side byte claim in the device-memory ledger: the
+        # double-buffered snapshot arrays are the checkpoint
+        # subsystem's big host tenant (one full model copy in RAM).
+        from ray_tpu.runtime import memory as _rmem
+
+        self._mem_reg = _rmem.track(
+            f"checkpoint.saver.{self.run}.r{self.rank}",
+            kind="ckpt_host_buffer",
+            device=False,
+        )
         _live.add(self)
 
     # ------------------------------------------------------------- save
@@ -186,6 +196,13 @@ class AsyncCheckpointer:
                     (idx, dst) for (_, dst), (idx, _) in zip(bufs, shards)
                 ]
             snapshot.append((key, global_shape, bufs))
+        self._mem_reg.update(
+            sum(
+                buf.nbytes
+                for _key, _shape, bufs in snapshot
+                for _idx, buf in bufs
+            )
+        )
         snap_s = time.perf_counter() - t0
         _add_stall(snap_s)
         PHASE_SECONDS.observe(snap_s, tags={"job": self.run, "phase": "snapshot"})

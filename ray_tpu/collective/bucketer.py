@@ -63,6 +63,10 @@ class Bucket:
     layout: list[tuple[str, int, int, tuple]] = dataclasses.field(
         default_factory=list
     )
+    # host scratch bytes this bucket pins while in flight (flat
+    # payloads + codec temporaries); released at join, reported to the
+    # device-memory ledger as collective_scratch
+    scratch_bytes: int = 0
 
 
 class PendingSync:
@@ -74,10 +78,11 @@ class PendingSync:
     single-controller mesh shape). Partial-mode skips are aggregated:
     ``skipped`` is the union of ranks any bucket skipped."""
 
-    def __init__(self, buckets, handles, per_rank: bool):
+    def __init__(self, buckets, handles, per_rank: bool, owner=None):
         self._buckets: list[Bucket] = buckets
         self._handles: list[CollectiveWork] = handles
         self._per_rank = per_rank
+        self._owner = owner
         self.partials: list[PartialResult] = []
 
     @property
@@ -102,6 +107,9 @@ class PendingSync:
         out: dict[str, Any] = {}
         for bucket, handle in zip(self._buckets, self._handles):
             res = handle.wait(timeout_s)
+            if self._owner is not None and bucket.scratch_bytes:
+                self._owner._scratch_release(bucket.scratch_bytes)
+                bucket.scratch_bytes = 0  # idempotent re-waits
             if isinstance(res, PartialResult):
                 self.partials.append(res)
                 res = res.value
@@ -195,6 +203,12 @@ class BucketStream:
                 self._b._ef.apply((index, r), p)
                 for r, p in enumerate(payloads)
             ]
+        scratch = sum(int(p.nbytes) for p in payloads)
+        if compression is not None:
+            # int8 wire payload + per-block scales (~0.26x of f32).
+            scratch += int(0.26 * scratch)
+        bucket.scratch_bytes = scratch
+        self._b._scratch_add(scratch)
         value = payloads if per_rank else payloads[0]
         self._handles.append(self._b._issue(value, bucket))
         self._buckets.append(bucket)
@@ -206,6 +220,7 @@ class BucketStream:
         pending = PendingSync(
             self._buckets, self._handles,
             per_rank=bool(self._per_rank) and self._b._per_rank_group,
+            owner=self._b,
         )
         self._b.last_plan = pending.buckets
         return pending
@@ -255,6 +270,24 @@ class GradBucketer:
         self.error_feedback = bool(error_feedback)
         self._ef = codec.ErrorFeedback() if error_feedback else None
         self.last_plan: list[Bucket] = []
+        # In-flight bucket scratch reported to the device-memory ledger
+        # (runtime/memory.py): flat payloads + codec temporaries pinned
+        # between dispatch and join.
+        from ray_tpu.runtime import memory as _rmem
+
+        self._scratch_bytes = 0
+        self._mem_reg = _rmem.track(
+            f"collective.bucketer.{group_name}",
+            kind="collective_scratch",
+        )
+
+    def _scratch_add(self, nbytes: int) -> None:
+        self._scratch_bytes += int(nbytes)
+        self._mem_reg.update(self._scratch_bytes)
+
+    def _scratch_release(self, nbytes: int) -> None:
+        self._scratch_bytes = max(0, self._scratch_bytes - int(nbytes))
+        self._mem_reg.update(self._scratch_bytes)
 
     # --------------------------------------------------------- plumbing
     def _group_obj(self):

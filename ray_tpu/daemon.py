@@ -21,11 +21,14 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import logging
 import json
 import os
 import signal
 import sys
 import tempfile
+
+logger = logging.getLogger("ray_tpu.daemon")
 
 DEFAULT_SESSION_DIR = os.path.join(
     tempfile.gettempdir(), "ray_tpu_cluster"
@@ -71,7 +74,7 @@ async def _serve_until_signal(stoppables, node=None) -> None:
         try:
             await asyncio.wait_for(node.self_drain("sigterm"), 2.0)
         except Exception:  # noqa: BLE001 - head may already be gone
-            pass
+            logger.debug("sigterm self-drain notify failed", exc_info=True)
         linger = config.get("DRAIN_SIGTERM_LINGER_S")
         if linger > 0:
             stop.clear()
@@ -83,7 +86,7 @@ async def _serve_until_signal(stoppables, node=None) -> None:
         try:
             await s.stop()
         except Exception:  # noqa: BLE001 - best-effort teardown
-            pass
+            logger.debug("daemon component stop failed", exc_info=True)
 
 
 _LOOPBACK = ("127.0.0.1", "localhost", "::1")
@@ -232,7 +235,7 @@ async def _run_head(args) -> None:
             target=usage.report_if_enabled, daemon=True
         ).start()
     except Exception:  # noqa: BLE001 - observability must not block boot
-        pass
+        logger.debug("usage reporting setup failed", exc_info=True)
     # The daemon's stdout lands in a log file under the session dir —
     # never print the token itself here (the 0600 token file is the
     # secret's only resting place; the CLI prints the join command to

@@ -67,6 +67,14 @@ def _serve():
     return state.serve_stats()
 
 
+@_route("/api/memory")
+def _memory():
+    """Head device-memory ledger (mem:sample span accounting): per-node
+    used/peak/capacity/headroom with per-subsystem byte attribution and
+    the headroom alert state, plus per-job peaks."""
+    return state.mem_stats()
+
+
 @_route("/api/checkpoints")
 def _checkpoints():
     """In-cluster shard-store checkpoints: per-run steps with

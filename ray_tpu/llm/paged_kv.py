@@ -54,10 +54,22 @@ def init_paged_kv(
     cfg: LlamaConfig, num_pages: int, page_size: int = 64
 ) -> PagedKV:
     shape = (cfg.n_layers, num_pages, cfg.n_kv_heads, page_size, cfg.head_dim)
-    return {
+    kv = {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
     }
+    # Claim the pool in the device-memory ledger (runtime/memory.py):
+    # the KV pages are serving's big fixed HBM tenant (the token-budget
+    # analogue of the trainer's param/optimizer claim). One tag per
+    # process — a re-created pool replaces the previous claim.
+    from ray_tpu.runtime import memory as _rmem
+
+    _rmem.track(
+        "llm.paged_kv", kind="kv_cache",
+        nbytes=int(kv["k"].nbytes + kv["v"].nbytes),
+    )
+    _rmem.tag_arrays("llm.paged_kv", "kv_cache", kv)
+    return kv
 
 
 class PageAllocator:

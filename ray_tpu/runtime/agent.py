@@ -14,6 +14,7 @@ Endpoints:
     /api/logs/<wid>  one worker's log (raw text, ?tail=N bytes)
     /api/train       per-job train goodput (head passthrough)
     /api/serve       per-deployment serve SLO ledger (head passthrough)
+    /api/memory      per-node device-memory ledger (head passthrough)
     /api/checkpoints shard-store checkpoint table (head passthrough)
     /metrics         node-local Prometheus text
 """
@@ -147,6 +148,13 @@ class NodeAgent:
             return {"error": "node has no head connection"}
         return await self.node.head.call("serve_stats")
 
+    async def _memory(self, query) -> dict:
+        """Head passthrough: device-memory ledger (same data as the
+        dashboard's /api/memory)."""
+        if self.node.head is None:
+            return {"error": "node has no head connection"}
+        return await self.node.head.call("mem_stats")
+
     def _metrics(self, query) -> str:
         s = self._stats(query)
         lines = [
@@ -214,6 +222,11 @@ class NodeAgent:
             elif path == "/api/serve":
                 body, ctype = (
                     json.dumps(await self._serve(query)),
+                    "application/json",
+                )
+            elif path == "/api/memory":
+                body, ctype = (
+                    json.dumps(await self._memory(query)),
                     "application/json",
                 )
             elif path == "/metrics":

@@ -82,6 +82,13 @@ class HeadService:
         # (ray_tpu_serve_slo_alert) with an OFF→ON warn log. Keyed
         # "app/deployment".
         self.serve_runs: dict[str, dict] = {}
+        # Device-memory ledger, folded from "mem:sample" SPAN events
+        # the same way the goodput/SLO ledgers fold theirs: per-node
+        # current/peak used bytes, capacity, headroom alert state (with
+        # OFF→ON warn log), and per-job peaks — surfaced via the
+        # mem_stats RPC, /api/memory, and `ray_tpu mem`.
+        self.mem_nodes: dict[str, dict] = {}
+        self.mem_jobs: dict[str, dict] = {}
         # Collective-group membership (the fault-tolerance layer's view):
         # group → {"epoch": int, "members": {rank: {addr, node_addr,
         # worker_id, dead}}}. Node/worker death fans out to survivors on
@@ -2144,6 +2151,10 @@ class HeadService:
                     and ev.get("deployment")
                 ):
                     self._serve_request_event(ev)
+                # Per-node memory samples additionally drive the head
+                # memory ledger.
+                elif ev.get("name") == "mem:sample" and ev.get("mem_node"):
+                    self._mem_event(ev)
                 continue
             if tid:
                 prev = self.task_latest.pop(tid, None)
@@ -2466,6 +2477,155 @@ class HeadService:
             }
         }
 
+    # --------------------------------------------------- memory ledger
+    def _mem_event(self, ev: dict) -> None:
+        """Fold one ``mem:sample`` span into the per-node (and per-job)
+        memory ledger — the memory twin of _train_step_event /
+        _serve_request_event. Headroom below
+        MEM_HEADROOM_ALERT_FRACTION of capacity flips the node's alert
+        with an OFF→ON warn log."""
+        node = str(ev["mem_node"])
+        rec = self.mem_nodes.get(node)
+        if rec is None:
+            if len(self.mem_nodes) >= 500:
+                oldest = min(
+                    self.mem_nodes,
+                    key=lambda n: self.mem_nodes[n]["first_ts"],
+                )
+                del self.mem_nodes[oldest]
+            rec = self.mem_nodes[node] = {
+                "used_bytes": 0,
+                "peak_bytes": 0,
+                "capacity_bytes": None,
+                "headroom_bytes": None,
+                "host_rss_bytes": None,
+                "by_kind": {},
+                "samples": 0,
+                "alert": False,
+                "first_ts": float(ev.get("ts") or 0.0),
+                "last_ts": None,
+            }
+        try:
+            used = int(ev.get("mem_used_bytes") or 0)
+            peak = int(ev.get("mem_peak_bytes") or used)
+        except (TypeError, ValueError):
+            return
+        cap = ev.get("mem_capacity_bytes")
+        try:
+            cap = int(cap) if cap is not None else None
+        except (TypeError, ValueError):
+            cap = None
+        rec["used_bytes"] = used
+        rec["peak_bytes"] = max(rec["peak_bytes"], peak)
+        rec["capacity_bytes"] = cap
+        rec["headroom_bytes"] = cap - used if cap is not None else None
+        rss = ev.get("mem_host_rss_bytes")
+        rec["host_rss_bytes"] = int(rss) if isinstance(rss, int) else None
+        by_kind = ev.get("mem_by_kind")
+        # Keep the last non-empty attribution: the emitter drops zero
+        # kinds, so an idle sample's {} must not wipe what we know
+        # about who owned the bytes.
+        if isinstance(by_kind, dict) and by_kind:
+            rec["by_kind"] = {
+                str(k): int(v)
+                for k, v in by_kind.items()
+                if isinstance(v, (int, float))
+            }
+        rec["samples"] += 1
+        rec["last_ts"] = float(ev.get("ts") or 0.0)
+        from ray_tpu._private import config
+
+        frac = config.get("MEM_HEADROOM_ALERT_FRACTION")
+        alert = bool(
+            cap and rec["headroom_bytes"] is not None
+            and rec["headroom_bytes"] < cap * frac
+        )
+        if alert and not rec["alert"]:
+            top = sorted(
+                rec["by_kind"].items(), key=lambda kv: -kv[1]
+            )[:3]
+            logger.warning(
+                "node %s device memory headroom low: %.2f GiB free of "
+                "%.2f GiB (alert below %.0f%%) — top kinds: %s",
+                node, (rec["headroom_bytes"] or 0) / (1 << 30),
+                cap / (1 << 30), 100.0 * frac,
+                ", ".join(
+                    f"{k}={v / (1 << 30):.2f}GiB" for k, v in top
+                ) or "none registered",
+            )
+        rec["alert"] = alert
+        job = ev.get("mem_job")
+        if job:
+            jrec = self.mem_jobs.get(str(job))
+            if jrec is None:
+                if len(self.mem_jobs) >= 200:
+                    oldest = min(
+                        self.mem_jobs,
+                        key=lambda j: self.mem_jobs[j]["first_ts"],
+                    )
+                    del self.mem_jobs[oldest]
+                jrec = self.mem_jobs[str(job)] = {
+                    "peak_bytes": 0,
+                    "used_bytes": 0,
+                    "nodes": [],
+                    "first_ts": float(ev.get("ts") or 0.0),
+                    "last_ts": None,
+                }
+            jrec["peak_bytes"] = max(jrec["peak_bytes"], peak)
+            jrec["used_bytes"] = used
+            if node not in jrec["nodes"]:
+                jrec["nodes"].append(node)
+            jrec["last_ts"] = float(ev.get("ts") or 0.0)
+
+    async def _on_mem_stats(self, conn):
+        """Per-node and per-job memory rollup (dashboard /api/memory,
+        agent passthrough, `ray_tpu mem`)."""
+        return {
+            "nodes": {n: dict(rec) for n, rec in self.mem_nodes.items()},
+            "jobs": {j: dict(rec) for j, rec in self.mem_jobs.items()},
+        }
+
+    def _mem_metrics_snapshot(self) -> dict | None:
+        """Head-owned memory gauges in worker-snapshot format (the
+        memory twin of _serve_metrics_snapshot): per-node used/peak/
+        headroom-alert, surviving the workers they were sampled at."""
+        if not self.mem_nodes:
+            return None
+        from ray_tpu.util.metrics import escape_label_value as _esc
+
+        used: dict[str, float] = {}
+        peak: dict[str, float] = {}
+        alert: dict[str, float] = {}
+        for node, rec in self.mem_nodes.items():
+            tag = f'node="{_esc(node)}"'
+            used[tag] = float(rec["used_bytes"])
+            peak[tag] = float(rec["peak_bytes"])
+            alert[tag] = 1.0 if rec["alert"] else 0.0
+        return {
+            "ray_tpu_mem_node_used_bytes": {
+                "kind": "gauge",
+                "description": "device bytes in use at each node's "
+                               "last memory sample",
+                "series": used,
+                "boundaries": None,
+            },
+            "ray_tpu_mem_node_peak_bytes": {
+                "kind": "gauge",
+                "description": "peak device bytes in use each node has "
+                               "reported",
+                "series": peak,
+                "boundaries": None,
+            },
+            "ray_tpu_mem_headroom_alert": {
+                "kind": "gauge",
+                "description": "1 when a node's device headroom is "
+                               "below MEM_HEADROOM_ALERT_FRACTION of "
+                               "capacity",
+                "series": alert,
+                "boundaries": None,
+            },
+        }
+
     def _serve_metrics_snapshot(self) -> dict | None:
         """Head-owned serve SLO gauges in worker-snapshot format (the
         serving twin of _train_metrics_snapshot): attainment + alert per
@@ -2582,6 +2742,7 @@ class HeadService:
         workers = {w: rec["snap"] for w, rec in self.metrics.items()}
         head_snap = dict(self._train_metrics_snapshot() or {})
         head_snap.update(self._serve_metrics_snapshot() or {})
+        head_snap.update(self._mem_metrics_snapshot() or {})
         if head_snap:
             workers["head"] = head_snap
         return {"workers": workers}

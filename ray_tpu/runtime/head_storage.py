@@ -24,6 +24,8 @@ import struct
 import tempfile
 from typing import Any, Iterator
 
+logger = logging.getLogger("ray_tpu.head")
+
 _HDR = struct.Struct("<I")
 
 
@@ -108,7 +110,7 @@ class FileJournal:
                     try:
                         yield pickle.loads(data)
                     except Exception:  # noqa: BLE001 - corrupt frame
-                        logging.getLogger("ray_tpu.head").warning(
+                        logger.warning(
                             "journal replay stopped at a corrupt frame "
                             "(state up to this point is restored)"
                         )

@@ -220,6 +220,62 @@ def cmd_slo(args) -> int:
     return print_slo(deployments, as_json=args.json)
 
 
+def _fmt_gib(v) -> str:
+    return f"{v / (1 << 30):.2f}GiB" if v is not None else "—"
+
+
+def print_mem(stats: dict, as_json: bool = False) -> int:
+    """Render the head memory ledger (factored out of cmd_mem so
+    tier-1 can smoke the exact CLI output path without a daemonized
+    cluster)."""
+    if as_json:
+        json.dump(stats, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    nodes = stats.get("nodes", {})
+    jobs = stats.get("jobs", {})
+    if not nodes:
+        print("no nodes have reported memory samples")
+        return 0
+    for name, n in sorted(nodes.items()):
+        alert = "  ALERT" if n.get("alert") else ""
+        print(
+            f"{name}: used={_fmt_gib(n.get('used_bytes'))}  "
+            f"peak={_fmt_gib(n.get('peak_bytes'))}  "
+            f"capacity={_fmt_gib(n.get('capacity_bytes'))}  "
+            f"headroom={_fmt_gib(n.get('headroom_bytes'))}{alert}"
+        )
+        by_kind = n.get("by_kind") or {}
+        if by_kind:
+            kinds = "  ".join(
+                f"{k}={_fmt_gib(v)}"
+                for k, v in sorted(by_kind.items(), key=lambda kv: -kv[1])
+                if v
+            )
+            if kinds:
+                print(f"  by kind: {kinds}")
+        if n.get("host_rss_bytes"):
+            print(f"  host rss={_fmt_gib(n['host_rss_bytes'])}")
+    for name, j in sorted(jobs.items()):
+        print(
+            f"job {name}: peak={_fmt_gib(j.get('peak_bytes'))}  "
+            f"current={_fmt_gib(j.get('used_bytes'))}  "
+            f"nodes={len(j.get('nodes') or [])}"
+        )
+    return 0
+
+
+def cmd_mem(args) -> int:
+    """Per-node device-memory rollup: current/peak used bytes vs
+    capacity, per-subsystem attribution, headroom alert state, and
+    per-job peaks (the head's mem:sample accounting; same data as the
+    dashboard's /api/memory)."""
+    from ray_tpu.util import state
+
+    _connect(args.address, getattr(args, "session_dir", None))
+    return print_mem(state.mem_stats(), as_json=args.json)
+
+
 def cmd_ckpt(args) -> int:
     """Shard-store checkpoints: `ckpt ls` lists per-run manifests with
     dedup'd sizes and replica health; `ckpt verify` probes every chunk
@@ -609,6 +665,12 @@ def main(argv=None) -> int:
                               "(TTFT/latency percentiles + alert)")
     slo.add_argument("--json", action="store_true",
                      help="raw per-deployment stats as JSON")
+    mp = sub.add_parser("mem",
+                        help="per-node device-memory ledger "
+                             "(used/peak/headroom + per-subsystem "
+                             "attribution + alert)")
+    mp.add_argument("--json", action="store_true",
+                    help="raw per-node/per-job stats as JSON")
     cp = sub.add_parser("ckpt",
                         help="in-cluster shard-store checkpoints")
     cp.add_argument("action", choices=["ls", "verify"],
@@ -643,6 +705,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "goodput": cmd_goodput,
         "slo": cmd_slo,
+        "mem": cmd_mem,
         "ckpt": cmd_ckpt,
         "logs": cmd_logs,
         "dashboard": cmd_dashboard,

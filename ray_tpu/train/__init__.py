@@ -30,6 +30,7 @@ from ray_tpu.train.session import (
     slice_label,
     step_span,
 )
+from ray_tpu.train.memory import MemoryPlan, plan as plan_memory
 from ray_tpu.train.trainer import (
     ElasticScalingPolicy,
     FailureConfig,
@@ -62,6 +63,8 @@ __all__ = [
     "report",
     "slice_label",
     "step_span",
+    "MemoryPlan",
+    "plan_memory",
     "ElasticScalingPolicy",
     "FailureConfig",
     "JaxTrainer",

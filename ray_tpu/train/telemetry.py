@@ -256,6 +256,13 @@ def finish_step(ctx, timer: StepTimer) -> None:
     ctx._step_index += 1
     ctx._used_step_timer = True
     ctx._last_report_wall = time.time()
+    # Per-step memory sample (device by_kind + headroom + host RSS →
+    # mem:sample span → head memory ledger). Last: it may raise the
+    # RAY_TPU_FAKE_HBM_GB injected ResourceExhausted, and the step's
+    # own accounting must already be closed when it does.
+    from ray_tpu.runtime import memory as _mem
+
+    _mem.step_sample(ctx)
 
 
 def implicit_step(ctx, now: float, metrics: dict) -> None:
@@ -292,6 +299,9 @@ def implicit_step(ctx, now: float, metrics: dict) -> None:
         comm_exposed_s=exposed, comm_overlapped_s=overlapped,
     )
     ctx._step_index += 1
+    from ray_tpu.runtime import memory as _mem
+
+    _mem.step_sample(ctx)
 
 
 def _take_degraded_frac(ctx) -> float:

@@ -314,6 +314,21 @@ class TrainWorker:
             else:
                 train_loop()
         except Exception as e:
+            # OOM forensics: a ResourceExhausted (real backend OOM or
+            # the RAY_TPU_FAKE_HBM_GB injection) must answer "what ate
+            # the HBM" before the attempt dies — ranked live-buffer
+            # report as a mem:oom span + persisted JSON (idempotent:
+            # the injection path may have already filed it).
+            from ray_tpu.runtime import memory as _mem
+
+            if _mem.is_resource_exhausted(e):
+                try:
+                    _mem.on_resource_exhausted(
+                        e, job=self.ctx.experiment_name
+                    )
+                # tpulint: allow(broad-except reason=forensics on an attempt that is already dying of OOM; the OOM is the error that must propagate)
+                except Exception:  # noqa: BLE001
+                    logger.debug("OOM forensics failed", exc_info=True)
             # Collective abort (a group member died / an op timed out
             # mid-step): tear down this worker's groups so their pending
             # futures fail instead of leaking, then fail the attempt —

@@ -181,6 +181,14 @@ def serve_stats() -> dict:
     return _call_head("serve_stats")
 
 
+def mem_stats() -> dict:
+    """Device-memory ledger from the head: per-node current/peak used
+    bytes, capacity, headroom alert state, and per-subsystem byte
+    attribution, plus per-job peaks. Backs the dashboard's /api/memory
+    and the `ray_tpu mem` CLI."""
+    return _call_head("mem_stats")
+
+
 def list_checkpoints(run: str | None = None) -> dict:
     """In-cluster shard-store checkpoints per run (step, world,
     completeness, bytes, chunk count, min replica count). Backs the

@@ -106,5 +106,6 @@ class NativeTokenLoader:
     def __del__(self):
         try:
             self.close()
+        # tpulint: allow(broad-except reason=__del__ during interpreter teardown must never raise; the ctypes handle may already be torn down and there is no logger left to tell)
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass

@@ -238,6 +238,7 @@ class ShmPool:
         try:
             if self._h:
                 self._lib.shm_release_at(self._h, abs_off)
+        # tpulint: allow(broad-except reason=runs from buffer-finalizer callbacks during interpreter teardown where the pool handle may already be freed; raising would abort unrelated GC)
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass
 

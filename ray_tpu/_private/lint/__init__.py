@@ -1,18 +1,32 @@
 """tpulint: ray_tpu-specific static analysis.
 
-Five AST passes grounded in this codebase's real failure classes (the
-bug shapes PRs 1-3 spent ~3k LoC defending against at runtime):
+Ten passes grounded in this codebase's real failure classes (the bug
+shapes PRs 1-11 spent thousands of LoC defending against at runtime),
+the flow-sensitive ones built on the v2 interprocedural dataflow
+engine (``dataflow.py``: module symbol tables + call graph + alias
+sets + a branch/loop/early-return-aware abstract interpreter):
 
 - ``collective-divergence`` (TPU101/TPU102): collective ops under
   rank-dependent control flow — the SPMD deadlock shape.
+- ``rank-divergence-flow`` (TPU103): the same hazard hidden behind
+  helper calls, tracked through the call graph and per-path guards.
+- ``dropped-handle`` (TPU104): ``*_async()`` CollectiveWork handles
+  discarded, never ``wait()``ed on a path, or overwritten pending.
 - ``lock-discipline`` (TPU201/TPU202): blocking calls while a
   ``threading.Lock`` with-block is open, plus cross-function
   lock-order cycles.
+- ``async-lock`` (TPU203): threading locks held across ``await``,
+  blocking calls inside ``asyncio.Lock`` sections, unbalanced manual
+  acquires in ``async def``.
+- ``lock-alias`` (TPU204): locks passed as arguments / stored in
+  attributes or containers joining the TPU202 order graph.
 - ``broad-except`` (TPU301): ``except Exception``/bare ``except``
   that neither re-raises, logs, nor carries an allow pragma.
-- ``metric-hygiene`` (TPU401/TPU402): metric constructors inside
-  functions (re-registration churn) and span APIs used without a
-  context manager.
+- ``metric-hygiene`` (TPU401/TPU402/TPU403): metric constructors in
+  functions, span CMs never entered, unbounded metric labels.
+- ``resource-pairing`` (TPU404): ``memory.track()`` registrations
+  never closed, span ``__enter__`` without exception-safe
+  ``__exit__`` — checked path-sensitively.
 - ``rpc-reentrancy`` (TPU501): RPC handlers that call back into an
   RPC handled by their own process (self-deadlock).
 
@@ -20,8 +34,11 @@ Violations are suppressed line-by-line with::
 
     # tpulint: allow(<rule> reason=<why this is deliberate>)
 
-and pre-existing debt is pinned in ``lint_baseline.json`` — only NEW
-violations fail CI (``ray_tpu lint --baseline lint_baseline.json``).
+The tree is clean — there is no checked-in baseline anymore — but the
+baseline plumbing (``--baseline``/``--update-baseline``) remains for
+third-party trees adopting the linter with existing debt. Use
+``ray_tpu lint --changed`` on the pre-commit path: it lints only the
+files in ``git diff`` plus their call-graph neighbors.
 """
 
 from ray_tpu._private.lint.core import (  # noqa: F401

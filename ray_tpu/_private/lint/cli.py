@@ -3,6 +3,12 @@
 Exit codes: 0 clean (or everything baselined), 1 new violations,
 2 usage/IO error. `--update-baseline` rewrites the baseline from the
 current tree and always exits 0.
+
+`--changed [REF]` (pre-commit mode) lints only the files in `git diff
+REF` (default HEAD, plus untracked files) — but ANALYZES their
+import-graph neighbors too, so the interprocedural rules
+(TPU103/TPU202/TPU204) still see helpers and lock owners defined in
+unchanged files. Only violations in changed files are reported.
 """
 
 from __future__ import annotations
@@ -11,6 +17,8 @@ import argparse
 import collections
 import json
 import os
+import re
+import subprocess
 import sys
 import time
 
@@ -18,6 +26,88 @@ from ray_tpu._private.lint import baseline as baseline_mod
 from ray_tpu._private.lint import core
 
 DEFAULT_BASELINE = "lint_baseline.json"
+
+_IMPORT_RE = re.compile(
+    r"^\s*(?:from\s+([\w\.]+)\s+import|import\s+([\w\.]+))", re.MULTILINE)
+
+
+def _git(root: str, *args: str) -> list[str]:
+    out = subprocess.run(
+        ["git", "-C", root, *args],
+        capture_output=True, text=True, timeout=30, check=True,
+    )
+    return [ln for ln in out.stdout.splitlines() if ln.strip()]
+
+
+def _changed_files(paths: list[str], ref: str) -> tuple[list[str], str]:
+    """Absolute paths of changed+untracked .py files under ``paths``,
+    plus the git root. Raises CalledProcessError outside a repo."""
+    probe = os.path.abspath(paths[0])
+    if os.path.isfile(probe):
+        probe = os.path.dirname(probe)
+    root = _git(probe, "rev-parse", "--show-toplevel")[0]
+    rel = _git(root, "diff", "--name-only", ref, "--", "*.py")
+    rel += _git(root, "ls-files", "--others", "--exclude-standard",
+                "--", "*.py")
+    roots = [os.path.abspath(p) for p in paths]
+    out = []
+    for r in rel:
+        p = os.path.join(root, r)
+        if not os.path.exists(p):
+            continue  # deleted file
+        ap = os.path.abspath(p)
+        if any(ap == rt or ap.startswith(rt + os.sep) for rt in roots):
+            out.append(ap)
+    return sorted(set(out)), root
+
+
+def _module_tail(path: str) -> str:
+    base = os.path.basename(path)
+    if base == "__init__.py":
+        return os.path.basename(os.path.dirname(path))
+    return base[:-3] if base.endswith(".py") else base
+
+
+def _imported_tails(path: str) -> set[str]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except OSError:
+        return set()
+    tails = set()
+    for m in _IMPORT_RE.finditer(src):
+        mod = m.group(1) or m.group(2)
+        tails.add(mod.split(".")[-1])
+    return tails
+
+
+def _expand_neighbors(changed: list[str], paths: list[str],
+                      excludes) -> list[str]:
+    """changed ∪ one import-graph hop in both directions — the files
+    whose symbols the interprocedural passes must see to judge the
+    changed ones (and vice versa)."""
+    tree = list(core.iter_python_files(paths, excludes=excludes))
+    by_tail: dict[str, list[str]] = {}
+    imports: dict[str, set[str]] = {}
+    for f in tree:
+        af = os.path.abspath(f)
+        by_tail.setdefault(_module_tail(af), []).append(af)
+        imports[af] = _imported_tails(af)
+    changed_set = set(changed)
+    changed_tails = {_module_tail(f) for f in changed_set}
+    out = set(changed_set)
+    for f in tree:
+        af = os.path.abspath(f)
+        if af in out:
+            continue
+        # f imports a changed module, or a changed file imports f
+        if imports[af] & changed_tails:
+            out.add(af)
+            continue
+        tail = _module_tail(af)
+        if any(tail in imports[c] for c in changed_set):
+            out.add(af)
+    return sorted(out)
 
 
 def _find_default_baseline(paths: list[str]) -> str | None:
@@ -61,6 +151,13 @@ def main(argv=None) -> int:
     p.add_argument("--relative-to", default=None,
                    help="report paths relative to this directory "
                         "(default: cwd)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="lint only files changed vs REF (default "
+                        "HEAD) plus untracked files; their "
+                        "import-graph neighbors are analyzed (not "
+                        "reported) so interprocedural rules stay "
+                        "sound — the fast pre-commit path")
     args = p.parse_args(argv)
 
     paths = args.paths
@@ -75,8 +172,32 @@ def main(argv=None) -> int:
 
     rel = args.relative_to or os.getcwd()
     t0 = time.monotonic()
+    report_only: set[str] | None = None
+    n_changed = n_analyzed = None
+    if args.changed is not None:
+        try:
+            changed, _git_root = _changed_files(paths, args.changed)
+        except (subprocess.CalledProcessError, OSError,
+                subprocess.TimeoutExpired) as e:
+            print(f"error: --changed needs git: {e}", file=sys.stderr)
+            return 2
+        if not changed:
+            print("tpulint: no changed .py files; nothing to lint",
+                  file=sys.stderr)
+            return 0
+        analyze = _expand_neighbors(changed, paths,
+                                    core.DEFAULT_EXCLUDES)
+        report_only = {os.path.abspath(c) for c in changed}
+        n_changed, n_analyzed = len(changed), len(analyze)
+        paths = analyze
     violations, errors = core.analyze_paths(paths, relative_to=rel)
     elapsed = time.monotonic() - t0
+
+    if report_only is not None:
+        violations = [
+            v for v in violations
+            if os.path.abspath(os.path.join(rel, v.path)) in report_only
+        ]
 
     if args.select:
         keep = {t.strip() for t in args.select.split(",") if t.strip()}
@@ -112,7 +233,7 @@ def main(argv=None) -> int:
             violations, base)
 
     if args.as_json:
-        print(json.dumps({
+        out = {
             "violations": [v.to_dict() for v in reported],
             "total_found": len(violations),
             "baseline": baseline_path,
@@ -121,7 +242,14 @@ def main(argv=None) -> int:
             "parse_errors": [
                 {"path": p_, "error": e} for p_, e in errors],
             "elapsed_s": round(elapsed, 3),
-        }, indent=2))
+        }
+        if n_changed is not None:
+            out["changed"] = {
+                "ref": args.changed,
+                "changed_files": n_changed,
+                "analyzed_files": n_analyzed,
+            }
+        print(json.dumps(out, indent=2))
     else:
         for v in reported:
             print(v.format())
@@ -131,9 +259,13 @@ def main(argv=None) -> int:
         summary = ", ".join(
             f"{r}={n}" for r, n in sorted(by_rule.items())) or "none"
         pinned = len(violations) - len(reported)
+        scope_note = ""
+        if n_changed is not None:
+            scope_note = (f" [--changed: {n_changed} changed, "
+                          f"{n_analyzed} analyzed]")
         print(
             f"tpulint: {len(reported)} new violation(s) ({summary}); "
-            f"{pinned} baselined; {elapsed:.2f}s",
+            f"{pinned} baselined; {elapsed:.2f}s{scope_note}",
             file=sys.stderr,
         )
         if stale:

@@ -3,8 +3,12 @@
 The passes are deliberately heuristic — name-based lock detection,
 token-based rank detection — tuned against THIS codebase's idioms
 (``self._lock``, ``col.allreduce``, ``_on_<method>`` RPC handlers).
-Precision comes from the pragma + baseline escape hatches, not from
-whole-program analysis; cross-file alias tracking is a ROADMAP item.
+Since the v2 engine (``dataflow.py``) the flow-sensitive passes
+(TPU103/104/203/204/404) add interprocedural reach — call-graph
+closure, argument/attribute/container lock aliasing, and a
+branch/loop/early-return-aware abstract interpreter — but names are
+still unified syntactically; precision ultimately comes from the
+pragma escape hatch, and runtime truth from ``sanitize.py``.
 """
 
 from __future__ import annotations
@@ -22,12 +26,17 @@ PRAGMA_RE = re.compile(
 RULES = {
     "TPU101": "collective-divergence",
     "TPU102": "collective-divergence",
+    "TPU103": "rank-divergence-flow",
+    "TPU104": "dropped-handle",
     "TPU201": "blocking-under-lock",
     "TPU202": "lock-order",
+    "TPU203": "async-lock",
+    "TPU204": "lock-alias",
     "TPU301": "broad-except",
     "TPU401": "metric-in-function",
     "TPU402": "span-leak",
     "TPU403": "unbounded-metric-label",
+    "TPU404": "resource-pairing",
     "TPU501": "rpc-reentrancy",
 }
 
@@ -211,14 +220,20 @@ def _passes():
     # a pass module is mid-edit (and to keep import cost off the
     # non-lint path).
     from ray_tpu._private.lint import (
+        pass_async_locks,
         pass_collective,
         pass_exceptions,
+        pass_handles,
+        pass_lock_alias,
         pass_locks,
         pass_metrics,
+        pass_pairing,
+        pass_rank_flow,
         pass_rpc,
     )
     return [pass_collective, pass_exceptions, pass_locks, pass_metrics,
-            pass_rpc]
+            pass_rpc, pass_rank_flow, pass_handles, pass_async_locks,
+            pass_lock_alias, pass_pairing]
 
 
 def analyze_source(source: str, path: str = "<string>") -> list[Violation]:

@@ -23,6 +23,10 @@ from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name
 COLLECTIVE_NAMES = frozenset({
     "allreduce", "allgather", "reduce", "reducescatter", "reduce_scatter",
     "broadcast", "barrier", "send", "recv", "sendrecv",
+    # PR 10's async verbs dispatch the op immediately — a guarded
+    # dispatch diverges exactly like a guarded sync verb.
+    "allreduce_async", "reducescatter_async", "allgather_async",
+    "hierarchical_allreduce",
 })
 # Attribute-form calls (x.barrier()) need the receiver to look like a
 # collective module/group — `sock.send()` must not trip the pass.

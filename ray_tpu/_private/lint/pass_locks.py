@@ -1,10 +1,12 @@
 """TPU201/TPU202 — lock discipline.
 
 - TPU201: a blocking call (RPC, ``time.sleep``, subprocess, socket,
-  ``.result()``, collective op, ``await``) issued while a
+  ``.result()``, collective op) issued while a
   ``threading.Lock``/``RLock`` ``with``-block is open. Holding a head
   or node lock across a blocking call is how one slow peer stalls the
   whole control plane (and how PR 3's drain fan-out got delayed).
+  (``await`` under a held threading lock is TPU203's — the async-lock
+  discipline pass.)
 - TPU202: cross-function lock-order cycles. Each file contributes a
   static lock-acquisition graph (lock held → lock acquired, including
   one level of call-graph propagation: ``self.foo()`` / module-level
@@ -197,17 +199,9 @@ class _Visitor(ScopeVisitor):
         for _ in acquired:
             self._held.pop()
 
-    def visit_Await(self, node: ast.Await):
-        if self._held:
-            self.ctx.report(
-                "TPU201", node,
-                f"`await` while holding threading lock "
-                f"`{self._held[-1]}`: the lock is held across an "
-                "arbitrary suspension, stalling every other thread "
-                "that needs it",
-                scope=self.scope,
-            )
-        self.generic_visit(node)
+    # NOTE: `await` under a held threading lock moved to TPU203
+    # (pass_async_locks) — the async-lock discipline pass owns every
+    # event-loop/lock interaction now.
 
     def visit_Call(self, node: ast.Call):
         fn = self._fn_qual()

@@ -155,6 +155,7 @@ class _Visitor(ScopeVisitor):
         # Call nodes that ARE properly entered: with-items and
         # enter_context(...) arguments.
         self._entered: set[int] = set()
+        manually_entered: set[str] = set()
         for node in ast.walk(ctx.tree):
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
@@ -164,6 +165,20 @@ class _Visitor(ScopeVisitor):
                 if name.endswith("enter_context"):
                     for arg in node.args:
                         self._entered.add(id(arg))
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "__enter__"
+                        and isinstance(node.func.value, ast.Name)):
+                    manually_entered.add(node.func.value.id)
+        # `s = tracing.span(...)` followed by `s.__enter__()` IS
+        # entered — whether the pairing balances on every path is
+        # TPU404's (path-sensitive) question, not TPU402's.
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id in manually_entered
+                    and isinstance(node.value, ast.Call)):
+                self._entered.add(id(node.value))
 
     def visit_Call(self, node: ast.Call):
         ctor = _metric_ctor(node)

@@ -15,14 +15,31 @@ lock-order graph:
   (default 100) logs a warning with the hold duration — the
   blocking-while-holding shape TPU201 flags statically.
 
+Runtime twins of the v2 flow-sensitive lint passes:
+
+- **async-lock awareness (TPU203's twin)**: :class:`InstrumentedLock`
+  warns when a *blocking* acquire happens on a thread that is running
+  an asyncio event loop (the loop freezes for every coroutine), and
+  :class:`InstrumentedAsyncLock` puts ``asyncio.Lock`` acquisitions
+  into the SAME order graph as the threading locks — a sync/async
+  lock inversion is still an inversion.
+- **leak reporter (TPU104/TPU404's twin)**: :func:`watch_work` /
+  :func:`watch_registration` attach a ``weakref.finalize`` to a
+  :class:`~ray_tpu.collective.types.CollectiveWork` or a
+  ``memory.Registration``; if the object is garbage-collected
+  un-``wait()``ed / un-``close()``d, a warning names what was
+  dropped. The static passes catch the paths they can see — this
+  catches the handles that escaped into data structures.
+
 Opt-in: ``RAY_TPU_SANITIZE=1`` makes :func:`maybe_lock` /
-:func:`maybe_rlock` hand out instrumented locks, and
-:func:`install` monkeypatches ``threading.Lock``/``RLock`` so locks
-allocated by ray_tpu code during the install window are instrumented
-(allocation-site filtered: third-party/stdlib locks are left alone —
-their internal ordering conventions are not ours to police).
-``tests/conftest.py`` installs it for the chaos / fault-tolerance
-modules.
+:func:`maybe_rlock` / :func:`maybe_async_lock` hand out instrumented
+locks, enables the leak watchers, and :func:`install` monkeypatches
+``threading.Lock``/``RLock`` so locks allocated by ray_tpu code during
+the install window are instrumented (allocation-site filtered:
+third-party/stdlib locks are left alone — their internal ordering
+conventions are not ours to police). ``RAY_TPU_SANITIZE_LEAKS=1``
+enables just the leak watchers. ``tests/conftest.py`` installs the
+lock side for the chaos / fault-tolerance modules.
 """
 
 from __future__ import annotations
@@ -74,6 +91,9 @@ class _OrderGraph:
         self._names: dict[int, str] = {}
         self.cycles_detected = 0
         self.long_holds = 0
+        self.loop_thread_acquires = 0
+        self.work_leaks = 0
+        self.registration_leaks = 0
 
     def reset(self):
         with self._guard:
@@ -81,6 +101,9 @@ class _OrderGraph:
             self._names.clear()
             self.cycles_detected = 0
             self.long_holds = 0
+            self.loop_thread_acquires = 0
+            self.work_leaks = 0
+            self.registration_leaks = 0
 
     def check_and_add(self, held_id: int, held_name: str,
                       new_id: int, new_name: str) -> list[str] | None:
@@ -149,6 +172,17 @@ class InstrumentedLock:
     def acquire(self, blocking: bool = True, timeout: float = -1):
         me = _thread.get_ident()
         stack = _held_stack()
+        if blocking and _on_event_loop_thread():
+            # TPU203's runtime twin: a blocking lock acquire on the
+            # loop thread freezes every coroutine until it's granted.
+            _graph.loop_thread_acquires += 1
+            logger.warning(
+                "sanitizer: blocking acquire of %s on an event-loop "
+                "thread (%s) — the loop (and every coroutine on it) "
+                "stalls until the lock is granted; use asyncio.Lock "
+                "or run the critical section in an executor",
+                self.name, threading.current_thread().name,
+            )
         if self.reentrant and self._depth.get(me, 0) > 0:
             got = self._inner.acquire(blocking, timeout)
             if got:
@@ -223,6 +257,174 @@ _ORIG_RLOCK = threading.RLock
 _install_count = 0
 
 
+def _on_event_loop_thread() -> bool:
+    try:
+        import asyncio
+        return asyncio.events._get_running_loop() is not None
+    # tpulint: allow(broad-except reason=the loop probe is best-effort diagnostics; any asyncio internals change must degrade to "not on a loop", never break lock acquisition)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+class InstrumentedAsyncLock:
+    """``asyncio.Lock`` twin of :class:`InstrumentedLock`: acquisitions
+    join the SAME global order graph (checked against both the locks
+    this *task* holds and the threading locks this *thread* holds — a
+    sync/async inversion deadlocks just as hard), and holds longer
+    than the threshold warn on release."""
+
+    def __init__(self, name: str | None = None,
+                 hold_threshold_s: float | None = None):
+        import asyncio
+
+        self._inner = asyncio.Lock()
+        self.name = name or f"alock@{id(self):#x}"
+        self._hold_threshold_s = (
+            hold_threshold_s if hold_threshold_s is not None
+            else _hold_threshold_s()
+        )
+        self._acquired_at: float | None = None
+
+    def _check_order(self):
+        holders = list(_task_held_stack()) + list(_held_stack())
+        for held in holders:
+            if held is self:
+                continue
+            cycle = _graph.check_and_add(
+                id(held), held.name, id(self), self.name)
+            if cycle is not None:
+                raise LockOrderViolation(
+                    cycle,
+                    holder_hint=(
+                        f"task holds {held.name}, wants {self.name}"
+                    ),
+                )
+
+    async def acquire(self):
+        self._check_order()
+        got = await self._inner.acquire()
+        _task_held_stack().append(self)
+        self._acquired_at = time.monotonic()
+        return got
+
+    def release(self):
+        stack = _task_held_stack()
+        if self in stack:
+            stack.remove(self)
+        t0, self._acquired_at = self._acquired_at, None
+        self._inner.release()
+        if t0 is not None:
+            held_s = time.monotonic() - t0
+            if held_s > self._hold_threshold_s:
+                _graph.long_holds += 1
+                logger.warning(
+                    "sanitizer: %s held for %.0f ms (> %.0f ms) — was "
+                    "something blocking inside the async critical "
+                    "section?",
+                    self.name, held_s * 1e3,
+                    self._hold_threshold_s * 1e3,
+                )
+
+    def locked(self):
+        return self._inner.locked()
+
+    async def __aenter__(self):
+        await self.acquire()
+        return self
+
+    async def __aexit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<InstrumentedAsyncLock {self.name!r}>"
+
+
+# Per-task held stacks for async locks; keyed by task id, pruned on
+# release (a finished task's entry dies with its last release — tasks
+# that leak a lock leak one list entry, which the leak warning already
+# shouts about).
+_task_held: dict[int, list] = {}
+
+
+def _task_held_stack() -> list:
+    try:
+        import asyncio
+        task = asyncio.current_task()
+    # tpulint: allow(broad-except reason=outside a running loop there is no task; order checks then cover only thread-held locks)
+    except Exception:  # noqa: BLE001
+        task = None
+    if task is None:
+        return _held_stack()
+    key = id(task)
+    stack = _task_held.get(key)
+    if stack is None:
+        stack = _task_held[key] = []
+    elif not stack:
+        # opportunistic prune of empty entries from finished tasks
+        for k in [k for k, v in _task_held.items() if not v and k != key]:
+            del _task_held[k]
+    return stack
+
+
+# --------------------------------------------------------- leak reporter
+def leaks_enabled() -> bool:
+    return enabled() or os.environ.get(
+        "RAY_TPU_SANITIZE_LEAKS", "") == "1"
+
+
+def watch_work(handle) -> None:
+    """Warn if ``handle`` (a CollectiveWork) is GC'd before any
+    ``wait()`` reached a terminal outcome: the dispatched collective's
+    result — and any typed fault — was silently dropped. Wired into
+    ``CollectiveWork.__init__`` when :func:`leaks_enabled`."""
+    import weakref
+
+    box = {
+        "closed": False,
+        "desc": f"{handle.verb or 'op'} group="
+                f"{handle.group_name or '?'}",
+    }
+    handle._leak_box = box
+    weakref.finalize(handle, _report_work_leak, box)
+
+
+def _report_work_leak(box):
+    if box["closed"]:
+        return
+    _graph.work_leaks += 1
+    logger.warning(
+        "sanitizer: CollectiveWork (%s) garbage-collected without a "
+        "completed wait() — the dispatched collective's result and "
+        "typed errors were silently dropped (TPU104's runtime twin)",
+        box["desc"],
+    )
+
+
+def watch_registration(reg) -> None:
+    """Warn if a memory-ledger Registration is GC'd still open: the
+    byte claim silently outlives its subsystem and the device-memory
+    ledger over-reports. Wired into ``memory.track()`` when
+    :func:`leaks_enabled`."""
+    import weakref
+
+    box = {"closed": False, "desc": f"{reg.tag} kind={reg.kind}"}
+    reg._leak_box = box
+    weakref.finalize(reg, _report_registration_leak, box)
+
+
+def _report_registration_leak(box):
+    if box["closed"]:
+        return
+    _graph.registration_leaks += 1
+    logger.warning(
+        "sanitizer: memory Registration (%s) garbage-collected while "
+        "still open — the byte claim was never close()d and the "
+        "device-memory ledger over-reports (TPU404's runtime twin)",
+        box["desc"],
+    )
+
+
 def maybe_lock(name: str | None = None):
     """threading.Lock(), instrumented when RAY_TPU_SANITIZE=1."""
     if enabled() or _install_count:
@@ -234,6 +436,15 @@ def maybe_rlock(name: str | None = None):
     if enabled() or _install_count:
         return InstrumentedLock(name=name, reentrant=True)
     return _ORIG_RLOCK()
+
+
+def maybe_async_lock(name: str | None = None):
+    """asyncio.Lock(), instrumented when RAY_TPU_SANITIZE=1."""
+    if enabled() or _install_count:
+        return InstrumentedAsyncLock(name=name)
+    import asyncio
+
+    return asyncio.Lock()
 
 
 def _caller_module(depth: int = 2) -> str:
@@ -300,5 +511,8 @@ def stats() -> dict:
     return {
         "cycles_detected": _graph.cycles_detected,
         "long_holds": _graph.long_holds,
+        "loop_thread_acquires": _graph.loop_thread_acquires,
+        "work_leaks": _graph.work_leaks,
+        "registration_leaks": _graph.registration_leaks,
         "edges": sum(len(v) for v in _graph._edges.values()),
     }

@@ -221,7 +221,8 @@ def shutdown() -> None:
 
     try:
         _runtime.run(_teardown(), timeout=10)
-    except Exception:
+    # tpulint: allow(broad-except reason=shutdown is best-effort by contract; a half-dead runtime loop must not prevent the store destroy and process exit below)
+    except Exception:  # noqa: BLE001
         pass
     if _runtime.mode in ("driver", "client"):
         # Driver (observer, client) sessions own their store dir; worker
@@ -470,12 +471,14 @@ class ObjectRefGenerator:
             # there), blocking would deadlock the loop — fire and forget.
             if threading.current_thread() is not _runtime.thread:
                 fut.result(timeout=2)
+        # tpulint: allow(broad-except reason=generator close is best-effort cleanup; the runtime loop may already be stopped and the task gone — both fine outcomes of closing)
         except Exception:  # noqa: BLE001 - best-effort cleanup
             pass
 
     def __del__(self):
         try:
             self.close()
+        # tpulint: allow(broad-except reason=__del__ during interpreter teardown must never raise; close() already degrades gracefully while alive)
         except Exception:  # noqa: BLE001 - interpreter teardown
             pass
 

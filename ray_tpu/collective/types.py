@@ -84,7 +84,7 @@ class CollectiveWork:
     time genuinely hidden behind compute as overlapped."""
 
     __slots__ = ("group_name", "verb", "_result", "_error", "_finished",
-                 "_finalize_cb")
+                 "_finalize_cb", "_leak_box", "__weakref__")
 
     def __init__(self, group_name: str = "", verb: str = ""):
         self.group_name = group_name
@@ -95,6 +95,13 @@ class CollectiveWork:
         # Applied once to the successful result on the waiter's thread
         # (the dispatch layer hangs partial-result bookkeeping here).
         self._finalize_cb = None
+        # Sanitizer leak box: set by sanitize.watch_work; wait() marks
+        # it closed so a GC'd un-waited handle warns (TPU104's twin).
+        self._leak_box = None
+        from ray_tpu._private import sanitize
+
+        if sanitize.leaks_enabled():
+            sanitize.watch_work(self)
 
     # Subclasses implement _join(timeout_s) -> result and _probe() ->
     # bool; the caching/raise discipline lives here once.
@@ -121,8 +128,12 @@ class CollectiveWork:
                 if not getattr(e, "transient_wait", False):
                     self._error = e
                     self._finished = True
+                    if self._leak_box is not None:
+                        self._leak_box["closed"] = True
                 raise
             self._finished = True
+            if self._leak_box is not None:
+                self._leak_box["closed"] = True
         if self._error is not None:
             raise self._error
         return self._result

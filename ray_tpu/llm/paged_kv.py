@@ -49,6 +49,10 @@ _NEG_INF = -2.0e38
 
 PagedKV = dict[str, jnp.ndarray]  # {"k","v": [L, num_pages, Hkv, P, Dh]}
 
+# The live memory-ledger claim for this process's KV pool (one pool
+# per process); init_paged_kv closes and replaces it on re-init.
+_KV_REG = None
+
 
 def init_paged_kv(
     cfg: LlamaConfig, num_pages: int, page_size: int = 64
@@ -60,11 +64,16 @@ def init_paged_kv(
     }
     # Claim the pool in the device-memory ledger (runtime/memory.py):
     # the KV pages are serving's big fixed HBM tenant (the token-budget
-    # analogue of the trainer's param/optimizer claim). One tag per
-    # process — a re-created pool replaces the previous claim.
+    # analogue of the trainer's param/optimizer claim). The live
+    # Registration is retained module-level so the claim has an owner:
+    # a re-created pool explicitly retires the previous claim instead
+    # of relying on tag replacement (TPU404).
     from ray_tpu.runtime import memory as _rmem
 
-    _rmem.track(
+    global _KV_REG
+    if _KV_REG is not None:
+        _KV_REG.close()
+    _KV_REG = _rmem.track(
         "llm.paged_kv", kind="kv_cache",
         nbytes=int(kv["k"].nbytes + kv["v"].nbytes),
     )

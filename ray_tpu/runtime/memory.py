@@ -151,7 +151,8 @@ class Registration:
     attribute store (hot-path cheap; gauges are set only at sample
     time); ``close()`` retires the claim."""
 
-    __slots__ = ("tag", "kind", "device", "nbytes", "_provider", "_closed")
+    __slots__ = ("tag", "kind", "device", "nbytes", "_provider",
+                 "_closed", "_leak_box", "__weakref__")
 
     def __init__(self, tag, kind, device, nbytes, provider):
         self.tag = tag
@@ -160,6 +161,9 @@ class Registration:
         self.nbytes = int(nbytes)
         self._provider = provider
         self._closed = False
+        # Sanitizer leak box (sanitize.watch_registration): close()
+        # marks it, a GC while open warns — TPU404's runtime twin.
+        self._leak_box = None
 
     def update(self, nbytes: int) -> None:
         self.nbytes = int(nbytes)
@@ -179,9 +183,20 @@ class Registration:
     def close(self) -> None:
         if not self._closed:
             self._closed = True
+            if self._leak_box is not None:
+                self._leak_box["closed"] = True
             with _reg_lock:
                 if _registry.get(self.tag) is self:
                     del _registry[self.tag]
+
+    # Context-manager support: `with memory.track(...):` is the
+    # structurally paired form TPU404 never flags.
+    def __enter__(self) -> "Registration":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
 
 class _NoopRegistration:
@@ -204,6 +219,12 @@ class _NoopRegistration:
 
     def close(self) -> None:
         pass
+
+    def __enter__(self) -> "_NoopRegistration":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
 
 
 NOOP_REG = _NoopRegistration()
@@ -237,8 +258,17 @@ def track(
     if not enabled():
         return NOOP_REG
     reg = Registration(tag, kind, device, nbytes, provider)
+    from ray_tpu._private import sanitize
+
+    if sanitize.leaks_enabled():
+        sanitize.watch_registration(reg)
     with _reg_lock:
+        old = _registry.get(tag)
         _registry[tag] = reg
+    if old is not None and old is not reg:
+        # Re-tracking a tag replaces the claim; retire the old one so
+        # its leak box doesn't cry wolf when it is collected.
+        old.close()
     return reg
 
 

@@ -257,6 +257,7 @@ class GrpcIngressActor:
                 grpc.StatusCode.DEADLINE_EXCEEDED,
                 f"no reply within {timeout}s",
             )
+        # tpulint: allow(broad-except reason=user-code failure becomes a gRPC INTERNAL status via context.abort — the error reaches the caller typed, not swallowed)
         except Exception as e:  # noqa: BLE001 - becomes a gRPC status
             await context.abort(
                 grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
@@ -298,6 +299,7 @@ class GrpcIngressActor:
                 )
             except grpc.aio.AbortError:
                 raise
+            # tpulint: allow(broad-except reason=stream failure becomes a gRPC INTERNAL status via context.abort — the error reaches the caller typed, not swallowed)
             except Exception as e:  # noqa: BLE001 - becomes a gRPC status
                 await context.abort(
                     grpc.StatusCode.INTERNAL, f"{type(e).__name__}: {e}"
@@ -347,6 +349,7 @@ class GrpcIngressActor:
                     )
                 except grpc.aio.AbortError:
                     raise
+                # tpulint: allow(broad-except reason=turn failure becomes a gRPC INTERNAL status via context.abort — the error reaches the caller typed, not swallowed)
                 except Exception as e:  # noqa: BLE001 - gRPC status
                     await context.abort(
                         grpc.StatusCode.INTERNAL,

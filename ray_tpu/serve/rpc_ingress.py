@@ -57,6 +57,7 @@ class RpcIngressActor:
                 *kw.get("args", ()), **kw.get("kwargs", {})
             )
             return {"ok": True, "result": result}
+        # tpulint: allow(broad-except reason=handler failure is encoded into the RPC reply envelope and travels to the caller typed — not swallowed)
         except Exception as e:  # noqa: BLE001 - travels to the caller
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 

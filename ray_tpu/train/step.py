@@ -82,6 +82,13 @@ def init_train_state(
     return state
 
 
+# Live memory-ledger claims for the resident train state, keyed by
+# tag. Retained so re-initialization (elastic resize, new attempt)
+# explicitly retires the previous claim instead of leaning on
+# tag-replacement (TPU404), and so teardown CAN close them.
+_STATE_REGS: dict[str, object] = {}
+
+
 def _register_state_memory(state: TrainState) -> None:
     """Claim the resident train state in the device-memory ledger
     (runtime/memory.py): params and optimizer moments are the two
@@ -102,16 +109,16 @@ def _register_state_memory(state: TrainState) -> None:
             )
         )
 
-    rmem.track(
-        "train.state.params", kind="params",
-        nbytes=_tree_bytes(state.params),
-    )
-    rmem.track(
-        "train.state.optimizer", kind="optimizer",
-        nbytes=_tree_bytes(state.opt_state),
-    )
-    rmem.tag_arrays("train.state.params", "params", state.params)
-    rmem.tag_arrays("train.state.optimizer", "optimizer", state.opt_state)
+    for tag, kind, tree in (
+        ("train.state.params", "params", state.params),
+        ("train.state.optimizer", "optimizer", state.opt_state),
+    ):
+        old = _STATE_REGS.get(tag)
+        if old is not None:
+            old.close()
+        _STATE_REGS[tag] = rmem.track(
+            tag, kind=kind, nbytes=_tree_bytes(tree))
+        rmem.tag_arrays(tag, kind, tree)
 
 
 class _Box:

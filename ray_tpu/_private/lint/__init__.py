@@ -1,10 +1,10 @@
 """tpulint: ray_tpu-specific static analysis.
 
-Ten passes grounded in this codebase's real failure classes (the bug
-shapes PRs 1-11 spent thousands of LoC defending against at runtime),
-the flow-sensitive ones built on the v2 interprocedural dataflow
-engine (``dataflow.py``: module symbol tables + call graph + alias
-sets + a branch/loop/early-return-aware abstract interpreter):
+Fifteen passes grounded in this codebase's real failure classes (the
+bug shapes PRs 1-11 spent thousands of LoC defending against at
+runtime), the flow-sensitive ones built on the v2 interprocedural
+dataflow engine (``dataflow.py``: module symbol tables + call graph +
+alias sets + a branch/loop/early-return-aware abstract interpreter):
 
 - ``collective-divergence`` (TPU101/TPU102): collective ops under
   rank-dependent control flow — the SPMD deadlock shape.
@@ -29,6 +29,24 @@ sets + a branch/loop/early-return-aware abstract interpreter):
   ``__exit__`` — checked path-sensitively.
 - ``rpc-reentrancy`` (TPU501): RPC handlers that call back into an
   RPC handled by their own process (self-deadlock).
+- ``host-sync-in-hot-path`` (TPU601): ``block_until_ready`` /
+  ``device_get`` / ``.item()`` (and, in compute-phase spans,
+  ``float()``/``np.asarray()``) reached — transitively — from a step
+  loop or compute span; the PR-10 ``wait()`` tail join is exempt.
+- ``jit-side-effect`` (TPU602): metrics/logging/span emission/
+  closure-append inside a jit-traced body — runs once at trace time
+  and silently lies thereafter.
+- ``recompilation-hazard`` (TPU603): loop-varying scalars or
+  data-dependent shapes fed to a jitted callee, unhashable
+  ``static_argnums`` values.
+- ``donation-misuse`` (TPU604): an argument named in
+  ``donate_argnums`` read on any path after the donating call.
+- ``jit-boundary-divergence`` (TPU605): a rank-/slice-dependent
+  branch selecting WHICH compiled program runs — the in-program
+  collective deadlock TPU103 cannot see.
+
+The TPU60x rules have runtime twins in ``ray_tpu/_private/sanitize.py``
+(the jit compile watch and the host-sync tracer, ``RAY_TPU_SANITIZE=1``).
 
 Violations are suppressed line-by-line with::
 

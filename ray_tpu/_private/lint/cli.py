@@ -81,11 +81,23 @@ def _imported_tails(path: str) -> set[str]:
     return tails
 
 
+DEFAULT_CHANGED_HOPS = 3
+
+
 def _expand_neighbors(changed: list[str], paths: list[str],
-                      excludes) -> list[str]:
-    """changed ∪ one import-graph hop in both directions — the files
-    whose symbols the interprocedural passes must see to judge the
-    changed ones (and vice versa)."""
+                      excludes, hops: int = DEFAULT_CHANGED_HOPS
+                      ) -> list[str]:
+    """changed ∪ up to ``hops`` import-graph hops in both directions —
+    the files whose symbols the interprocedural passes must see to
+    judge the changed ones (and vice versa).
+
+    TRANSITIVE (PR-12's caveat closed): a 2-hop helper chain
+    ``caller → middle → issuer`` with an unchanged ``middle`` used to
+    hide a TPU103/TPU601 from the pre-commit path, because one hop from
+    ``caller`` never loaded ``issuer``'s definition. BFS over the
+    undirected import graph, bounded (default 3 hops,
+    ``--changed-hops=`` overrides) so one edit never degenerates into a
+    full-tree analysis on a densely imported package."""
     tree = list(core.iter_python_files(paths, excludes=excludes))
     by_tail: dict[str, list[str]] = {}
     imports: dict[str, set[str]] = {}
@@ -93,21 +105,66 @@ def _expand_neighbors(changed: list[str], paths: list[str],
         af = os.path.abspath(f)
         by_tail.setdefault(_module_tail(af), []).append(af)
         imports[af] = _imported_tails(af)
-    changed_set = set(changed)
-    changed_tails = {_module_tail(f) for f in changed_set}
-    out = set(changed_set)
-    for f in tree:
-        af = os.path.abspath(f)
-        if af in out:
-            continue
-        # f imports a changed module, or a changed file imports f
-        if imports[af] & changed_tails:
-            out.add(af)
-            continue
-        tail = _module_tail(af)
-        if any(tail in imports[c] for c in changed_set):
-            out.add(af)
+    out = set(changed)
+    frontier = set(changed)
+    for _ in range(max(0, hops)):
+        if not frontier:
+            break
+        frontier_tails = {_module_tail(f) for f in frontier}
+        nxt: set[str] = set()
+        for f in tree:
+            af = os.path.abspath(f)
+            if af in out:
+                continue
+            # f imports a frontier module, or a frontier file imports f
+            if imports[af] & frontier_tails:
+                nxt.add(af)
+                continue
+            tail = _module_tail(af)
+            if any(tail in imports[c] for c in frontier):
+                nxt.add(af)
+        out |= nxt
+        frontier = nxt
     return sorted(out)
+
+
+_HOOK_BODY = """\
+#!/bin/sh
+# tpulint pre-commit hook (installed by `ray_tpu lint --install-hook`).
+# Lints only the files changed vs HEAD, expanding import-graph
+# neighbors so the interprocedural rules stay sound. Bypass a single
+# commit with `git commit --no-verify`.
+exec {python} -m ray_tpu._private.lint {target} --changed
+"""
+
+
+def _install_hook(paths: list[str]) -> int:
+    """Write .git/hooks/pre-commit running `lint --changed` over the
+    first target path's repository."""
+    probe = os.path.abspath(paths[0])
+    if os.path.isfile(probe):
+        probe = os.path.dirname(probe)
+    try:
+        root = _git(probe, "rev-parse", "--show-toplevel")[0]
+    except (subprocess.CalledProcessError, OSError,
+            subprocess.TimeoutExpired) as e:
+        print(f"error: --install-hook needs a git repo: {e}",
+              file=sys.stderr)
+        return 2
+    hooks_dir = os.path.join(root, ".git", "hooks")
+    os.makedirs(hooks_dir, exist_ok=True)
+    hook = os.path.join(hooks_dir, "pre-commit")
+    if os.path.exists(hook):
+        print(f"error: {hook} already exists — remove it first (or "
+              "chain scripts/pre-commit.sample from it)",
+              file=sys.stderr)
+        return 2
+    target = os.path.relpath(os.path.abspath(paths[0]), root)
+    with open(hook, "w", encoding="utf-8") as f:
+        f.write(_HOOK_BODY.format(python=sys.executable, target=target))
+    os.chmod(hook, 0o755)
+    print(f"installed {hook}: runs `lint {target} --changed` per commit")
+    return 0
 
 
 def _find_default_baseline(paths: list[str]) -> str | None:
@@ -158,6 +215,16 @@ def main(argv=None) -> int:
                         "import-graph neighbors are analyzed (not "
                         "reported) so interprocedural rules stay "
                         "sound — the fast pre-commit path")
+    p.add_argument("--changed-hops", type=int,
+                   default=DEFAULT_CHANGED_HOPS, metavar="N",
+                   help="import-graph hops to expand around changed "
+                        f"files (default {DEFAULT_CHANGED_HOPS}): "
+                        "helpers-of-helpers N levels deep stay "
+                        "visible to the interprocedural rules")
+    p.add_argument("--install-hook", action="store_true",
+                   help="write .git/hooks/pre-commit running "
+                        "`lint --changed` against the staged tree, "
+                        "then exit")
     args = p.parse_args(argv)
 
     paths = args.paths
@@ -169,6 +236,9 @@ def main(argv=None) -> int:
         if not os.path.exists(path):
             print(f"error: no such path: {path}", file=sys.stderr)
             return 2
+
+    if args.install_hook:
+        return _install_hook(paths)
 
     rel = args.relative_to or os.getcwd()
     t0 = time.monotonic()
@@ -186,7 +256,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 0
         analyze = _expand_neighbors(changed, paths,
-                                    core.DEFAULT_EXCLUDES)
+                                    core.DEFAULT_EXCLUDES,
+                                    hops=args.changed_hops)
         report_only = {os.path.abspath(c) for c in changed}
         n_changed, n_analyzed = len(changed), len(analyze)
         paths = analyze

@@ -38,6 +38,11 @@ RULES = {
     "TPU403": "unbounded-metric-label",
     "TPU404": "resource-pairing",
     "TPU501": "rpc-reentrancy",
+    "TPU601": "host-sync-in-hot-path",
+    "TPU602": "jit-side-effect",
+    "TPU603": "recompilation-hazard",
+    "TPU604": "donation-misuse",
+    "TPU605": "jit-boundary-divergence",
 }
 
 # Generated / vendored files nobody hand-edits.
@@ -222,18 +227,25 @@ def _passes():
     from ray_tpu._private.lint import (
         pass_async_locks,
         pass_collective,
+        pass_donation,
         pass_exceptions,
         pass_handles,
+        pass_host_sync,
+        pass_jit_divergence,
+        pass_jit_effects,
         pass_lock_alias,
         pass_locks,
         pass_metrics,
         pass_pairing,
         pass_rank_flow,
+        pass_recompile,
         pass_rpc,
     )
     return [pass_collective, pass_exceptions, pass_locks, pass_metrics,
             pass_rpc, pass_rank_flow, pass_handles, pass_async_locks,
-            pass_lock_alias, pass_pairing]
+            pass_lock_alias, pass_pairing, pass_host_sync,
+            pass_jit_effects, pass_recompile, pass_donation,
+            pass_jit_divergence]
 
 
 def analyze_source(source: str, path: str = "<string>") -> list[Violation]:

@@ -1,0 +1,234 @@
+"""Shared jit-boundary detection for the TPU60x rule family.
+
+TPU602/603/604/605 all need the same three facts about a module:
+
+- which FUNCTIONS are traced by XLA (``@jax.jit`` / ``@partial(jax.jit,
+  ...)`` decorated defs, plus functions passed BY REFERENCE into a
+  ``jit(...)`` call — their bodies run exactly once, at trace time),
+- which NAMES are bound to compiled callables (``self._prefill =
+  jax.jit(..., donate_argnums=(2,))`` — the call through the name is a
+  compiled-program invocation, and the donate/static metadata travels
+  with it),
+- which functions are jit FACTORIES (their ``return`` is a ``jit(...)``
+  call — ``jit_train_step()`` hands its caller a donated compiled step,
+  so ``step = jit_train_step(...)`` makes ``step`` a donated callable
+  in another file entirely).
+
+Collected once per module and cached on the FileContext so the four
+passes share one walk, exactly like ``dataflow.index``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from ray_tpu._private.lint import dataflow
+from ray_tpu._private.lint.core import FileContext, dotted_name
+
+#: Names that create a compiled callable when called.
+JIT_NAMES = frozenset({"jit", "pjit"})
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """Metadata for one jit(...) creation site."""
+
+    line: int
+    #: positional indexes named in donate_argnums, () when absent and
+    #: None when present but not statically evaluable (conditional
+    #: tuples etc. — unknown must never report).
+    donate: tuple | None = ()
+    #: positional indexes named in static_argnums (same None semantics).
+    static: tuple | None = ()
+    #: qualname of the wrapped function when jit() received a
+    #: resolvable reference (jit(step) / jit(partial(step, ...))).
+    wrapped: str | None = None
+
+
+def _int_tuple(node: ast.AST) -> tuple | None:
+    """Statically evaluate an int / tuple-of-ints argnums expression;
+    None when it cannot be evaluated (conditional, computed)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def jit_call_info(call: ast.Call,
+                  mi: dataflow.ModuleIndex | None = None,
+                  class_name: str | None = None) -> JitInfo | None:
+    """JitInfo when ``call`` is a jit/pjit invocation, else None.
+
+    ``partial(jax.jit, static_argnums=...)`` (the decorator-factory
+    idiom) is treated as the jit call itself — its keywords ARE the jit
+    keywords.
+    """
+    fname = dotted_name(call.func)
+    tail = fname.split(".")[-1] if fname else ""
+    inner = None
+    if tail == "partial" and call.args:
+        first = dotted_name(call.args[0])
+        if first and first.split(".")[-1] in JIT_NAMES:
+            inner = call
+            tail = first.split(".")[-1]
+    if tail not in JIT_NAMES:
+        return None
+
+    info = JitInfo(line=call.lineno)
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            info.donate = _int_tuple(kw.value)
+        elif kw.arg == "static_argnums":
+            info.static = _int_tuple(kw.value)
+
+    # The wrapped function: jit(step) / jit(partial(step, cfg=...)).
+    args = call.args if inner is None else call.args[1:]
+    if args:
+        target = args[0]
+        if isinstance(target, ast.Call):
+            tf = dotted_name(target.func)
+            if tf and tf.split(".")[-1] == "partial" and target.args:
+                target = target.args[0]
+        tname = dotted_name(target)
+        if tname and mi is not None:
+            # Resolve through the module's import map so a wrapped
+            # foreign function unifies with its definition.
+            info.wrapped = mi.qualify(tname, class_name)
+    return info
+
+
+def _is_jit_decorator(dec: ast.AST) -> JitInfo | None:
+    """JitInfo for @jax.jit / @jit / @partial(jax.jit, ...) /
+    @jax.jit(...) decorator nodes."""
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        tail = fname.split(".")[-1] if fname else ""
+        if tail in JIT_NAMES:
+            info = JitInfo(line=dec.lineno)
+            for kw in dec.keywords:
+                if kw.arg == "donate_argnums":
+                    info.donate = _int_tuple(kw.value)
+                elif kw.arg == "static_argnums":
+                    info.static = _int_tuple(kw.value)
+            return info
+        if tail == "partial" and dec.args:
+            first = dotted_name(dec.args[0])
+            if first and first.split(".")[-1] in JIT_NAMES:
+                info = JitInfo(line=dec.lineno)
+                for kw in dec.keywords:
+                    if kw.arg == "donate_argnums":
+                        info.donate = _int_tuple(kw.value)
+                    elif kw.arg == "static_argnums":
+                        info.static = _int_tuple(kw.value)
+                return info
+        return None
+    name = dotted_name(dec)
+    if name and name.split(".")[-1] in JIT_NAMES:
+        return JitInfo(line=getattr(dec, "lineno", 1))
+    return None
+
+
+class ModuleJitIndex:
+    """Per-module jit facts, cached on the FileContext."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.mi = dataflow.index(ctx)
+        #: fn qual -> JitInfo for jit-DECORATED defs
+        self.jit_defs: dict[str, JitInfo] = {}
+        #: canonical var/attr name -> JitInfo for `v = jit(...)` binds
+        self.jit_vars: dict[str, JitInfo] = {}
+        #: fn qual -> JitInfo for functions RETURNING a jit(...) call
+        self.factories: dict[str, JitInfo] = {}
+        #: quals of functions passed by reference into a jit() call
+        #: (their bodies are traced)
+        self.wrapped: set[str] = set()
+        #: canonical var name -> callee qual, for `v = some_factory()`
+        #: binds whose factory-ness is only known program-wide
+        self.maybe_factory_vars: dict[str, str] = {}
+        # No textual prefilter: a CALLER of a jit factory has no "jit"
+        # token anywhere — factory-var binds must be collected in every
+        # file or the cross-file TPU604/605 events never form.
+        self._collect()
+
+    # ----------------------------------------------------------- collect
+    def _collect(self) -> None:
+        has_jit = "jit" in self.ctx.source
+        for qual, info in self.mi.functions.items():
+            if not has_jit:
+                break
+            node = info.node
+            for dec in getattr(node, "decorator_list", []):
+                ji = _is_jit_decorator(dec)
+                if ji is not None:
+                    self.jit_defs[qual] = ji
+            for child in ast.walk(node):
+                if isinstance(child, ast.Return) and isinstance(
+                        child.value, ast.Call):
+                    ji = jit_call_info(child.value, self.mi,
+                                       info.class_name)
+                    if ji is not None:
+                        # First donated return wins (multiple returns
+                        # share the factory's contract in practice).
+                        if qual not in self.factories or ji.donate:
+                            self.factories[qual] = ji
+                        if ji.wrapped:
+                            self.wrapped.add(ji.wrapped)
+
+        def walk_assigns(node, class_name):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk_assigns(child, child.name)
+                    continue
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    walk_assigns(child, class_name)
+                    continue
+                if isinstance(child, ast.Assign) and isinstance(
+                        child.value, ast.Call):
+                    ji = jit_call_info(child.value, self.mi, class_name)
+                    for target in child.targets:
+                        tname = dotted_name(target)
+                        if not tname:
+                            continue
+                        canon = self.mi.qualify(tname, class_name)
+                        if ji is not None:
+                            self.jit_vars[canon] = ji
+                            if ji.wrapped:
+                                self.wrapped.add(ji.wrapped)
+                        else:
+                            callee = self.mi.resolve_call(
+                                child.value, class_name)
+                            if callee is not None:
+                                self.maybe_factory_vars[canon] = callee
+                walk_assigns(child, class_name)
+
+        walk_assigns(self.ctx.tree, None)
+
+    # ------------------------------------------------------------ lookup
+    def lookup_callable(self, call: ast.Call,
+                        class_name: str | None) -> JitInfo | None:
+        """JitInfo when ``call`` invokes a module-local jit-bound name
+        (``self._prefill(...)`` / ``step(...)``)."""
+        name = dotted_name(call.func)
+        if not name:
+            return None
+        canon = self.mi.qualify(name, class_name)
+        return self.jit_vars.get(canon)
+
+
+def jit_index(ctx: FileContext) -> ModuleJitIndex:
+    cached = getattr(ctx, "_jit_index", None)
+    if cached is None:
+        cached = ModuleJitIndex(ctx)
+        ctx._jit_index = cached
+    return cached

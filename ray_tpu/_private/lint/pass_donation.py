@@ -1,0 +1,312 @@
+"""TPU604 — donated buffer read after the call.
+
+``jax.jit(step, donate_argnums=(0,))`` hands the argument's device
+buffer to XLA for in-place reuse: after the call the old array is
+DELETED, and touching it raises (best case) or reads freed memory
+through a stale alias (worst case, under the nonstandard backends the
+bench notes document). The correct idiom rebinds in the same statement
+(``state, metrics = step(state, batch)``). The pass is TPU104's
+path-sensitive sibling:
+
+- a call through a donated-jit callable marks each Name/attribute
+  argument at a donated position,
+- any READ of a marked name on any path before it is rebound reports
+  (including the loop-carried shape: donated at the bottom of iteration
+  N, read at the top of N+1 — the walker's double loop walk sees it),
+- rebinding (any assignment target covering the name) clears the mark.
+
+Donated callables come from three channels: a module-local
+``v = jax.jit(..., donate_argnums=...)`` bind (``self._prefill``), a
+jit-decorated def with donate, and — cross-file, resolved in
+``finalize`` — a variable bound from a jit FACTORY (``step =
+jit_train_step(...)``: the factory's return is the donated jit)."""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.lint import dataflow, jit_util
+from ray_tpu._private.lint.core import FileContext, dotted_name
+
+
+def _read_names(expr: ast.AST):
+    """Dotted names READ in an expression (loads only; call receivers
+    included — `state.params` reads `state`)."""
+    out = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.append(node.id)
+        elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load):
+            name = dotted_name(node)
+            if name:
+                out.append(name)
+    return out
+
+
+def _covers(read: str, donated: str) -> bool:
+    """Reading `state` or `state.params` hits a donated `state`;
+    reading `self` alone does not hit a donated `self.cache`."""
+    return read == donated or read.startswith(donated + ".")
+
+
+class _State(dataflow.PathState):
+    __slots__ = ("donated",)
+
+    def __init__(self):
+        # dotted name -> (line, callable display name, resolved|callee)
+        self.donated: dict[str, tuple] = {}
+
+    def fork(self):
+        st = _State()
+        st.donated = dict(self.donated)
+        return st
+
+    def merge(self, other):
+        # A name donated on EITHER path is unsafe at the join.
+        for name, rec in other.donated.items():
+            self.donated.setdefault(name, rec)
+
+
+class _Walker(dataflow.FlowWalker):
+    def __init__(self, ctx: FileContext, ji: jit_util.ModuleJitIndex,
+                 info: dataflow.FunctionInfo, st: "_PassState"):
+        self.ctx = ctx
+        self.ji = ji
+        self.info = info
+        self.st = st
+        self._reported: set[tuple] = set()
+
+    def _scope(self):
+        if self.info.class_name:
+            return f"{self.info.class_name}.{self.info.node.name}"
+        return self.info.node.name
+
+    # --------------------------------------------------------- reads
+    def _check_reads(self, expr, state, skip_call=None):
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if node is skip_call:
+                continue
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                self._hit(node.id, node.lineno, state)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load):
+                name = dotted_name(node)
+                if name:
+                    self._hit(name, node.lineno, state)
+
+    def _hit(self, read, line, state):
+        for donated, (dline, cname, resolved) in state.donated.items():
+            if not _covers(read, donated):
+                continue
+            key = (donated, line)
+            if key in self._reported:
+                continue
+            self._reported.add(key)
+            if resolved is True:
+                self.ctx.report(
+                    "TPU604", _node(line),
+                    f"`{read}` read after `{cname}(...)` (line {dline}) "
+                    f"donated `{donated}`'s buffer: donation hands the "
+                    "buffer to XLA for in-place reuse — the old array "
+                    "is deleted and this read raises or aliases freed "
+                    "memory. Rebind the result over the argument "
+                    "(`x, ... = f(x, ...)`) before any further use",
+                    scope=self._scope(),
+                )
+            else:
+                # Factory-produced callable: donation only known once
+                # the program-wide factory table exists.
+                self.st.events.append((
+                    self.ctx, resolved, read, donated, dline, cname,
+                    line, self._scope()))
+
+    # --------------------------------------------------------- events
+    def on_stmt(self, stmt, state):
+        # Reads are checked on the statement's own expressions, BEFORE
+        # the call marks new donations: `x2 = step(x, b)` must not
+        # self-report x's use as the donating argument.
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._check_reads(stmt.value, state)
+            if isinstance(stmt, ast.AugAssign):
+                self._check_reads(stmt.target, state)
+        elif isinstance(stmt, ast.Expr):
+            self._check_reads(stmt.value, state)
+        elif isinstance(stmt, (ast.Return, ast.Raise)):
+            self._check_reads(getattr(stmt, "value", None)
+                              or getattr(stmt, "exc", None), state)
+        elif isinstance(stmt, ast.If):
+            self._check_reads(stmt.test, state)
+        elif isinstance(stmt, ast.While):
+            self._check_reads(stmt.test, state)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_reads(stmt.iter, state)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_reads(item.context_expr, state)
+        elif isinstance(stmt, (ast.Assert, ast.Delete)):
+            self._check_reads(stmt, state)
+
+    def on_call(self, call, state):
+        klass = self.info.class_name
+        info = self.ji.lookup_callable(call, klass)
+        cname = dotted_name(call.func)
+        resolved = True
+        if info is None:
+            callee = self.ji.mi.resolve_call(call, klass)
+            if callee is not None and callee in self.ji.jit_defs:
+                info = self.ji.jit_defs[callee]
+            else:
+                # var bound from an unresolved-here factory call?
+                if cname:
+                    canon = self.ji.mi.qualify(cname, klass)
+                    fac = self.st.factory_vars.get(canon)
+                    if fac is not None:
+                        resolved = fac  # defer to finalize
+                        info = jit_util.JitInfo(line=call.lineno,
+                                                donate=None)
+        if info is None:
+            return
+        donate = info.donate
+        if resolved is True and not donate:
+            return
+        positions = donate if resolved is True else None
+        for pos, arg in enumerate(call.args):
+            if positions is not None and pos not in positions:
+                continue
+            name = dotted_name(arg)
+            if not name:
+                continue
+            if resolved is True:
+                state.donated[name] = (call.lineno, cname, True)
+            else:
+                # Record the factory + position; finalize keeps the
+                # event only if that position is donated there.
+                state.donated[name] = (
+                    call.lineno, cname, (resolved, pos))
+
+    def on_assign(self, stmt, state):
+        if isinstance(stmt, ast.AugAssign):
+            return
+        targets = stmt.targets if isinstance(
+            stmt, ast.Assign) else [stmt.target]
+        for target in targets:
+            self._clear(target, state)
+
+    def _clear(self, target, state):
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._clear(elt, state)
+            return
+        name = dotted_name(target)
+        if not name:
+            return
+        for donated in list(state.donated):
+            if _covers(name, donated) or _covers(donated, name):
+                del state.donated[donated]
+
+
+def _node(line: int):
+    class N:
+        lineno = line
+        col_offset = 0
+    return N
+
+
+class _PassState:
+    def __init__(self, ji: jit_util.ModuleJitIndex,
+                 factory_vars: dict | None = None):
+        self.ji = ji
+        self.mi = ji.mi
+        # canonical var -> callee qual, pruned to vars actually CALLED
+        # in this module (a bound-but-never-invoked result cannot
+        # donate anything).
+        self.factory_vars = factory_vars or {}
+        # (ctx, (factory_qual, pos), read, donated, donate_line, cname,
+        #  read_line, scope) — factory events needing program context
+        self.events: list[tuple] = []
+
+
+def run(ctx: FileContext):
+    ji = jit_util.jit_index(ctx)
+    # Perf prune: a factory-bound var only matters if the VAR itself is
+    # called somewhere in this module.
+    src = ctx.source
+    factory_vars = {
+        canon: q for canon, q in ji.maybe_factory_vars.items()
+        if canon.split(".")[-1] + "(" in src
+    }
+    # Walk only when something trackable exists: a donated local jit
+    # bind/def, or a called var bound from a resolvable call (it may
+    # be a cross-file jit factory — only finalize knows).
+    trackable = (
+        factory_vars
+        or any(i.donate for i in ji.jit_vars.values())
+        or any(i.donate for i in ji.jit_defs.values())
+    )
+    if not trackable and not ji.factories:
+        return None
+    st = _PassState(ji, factory_vars)
+    if trackable:
+        # Per-function prefilter: the flow walk only matters where a
+        # tracked callable's NAME is invoked in that function's text.
+        tails = {c.split(".")[-1] for c in factory_vars}
+        tails |= {c.split(".")[-1] for c, i in ji.jit_vars.items()
+                  if i.donate}
+        tails |= {q.split(".")[-1] for q, i in ji.jit_defs.items()
+                  if i.donate}
+        for info in ji.mi.functions.values():
+            node = info.node
+            end = getattr(node, "end_lineno", len(ctx.lines))
+            seg = "\n".join(ctx.lines[node.lineno - 1:end])
+            if not any(t + "(" in seg for t in tails):
+                continue
+            walker = _Walker(ctx, ji, info, st)
+            walker.walk_function(node, _State())
+    return st
+
+
+def finalize(states):
+    states = [st for st in states if st is not None]
+    if not states:
+        return []
+    factories: dict[str, jit_util.JitInfo] = {}
+    for st in states:
+        factories.update(st.ji.factories)
+    if not factories:
+        return []
+    # Tail-name fallback: `step = jit_train_step(...)` resolves to
+    # "step.jit_train_step" in the caller but the factory indexes as
+    # "step.jit_train_step" only when the module tails already match —
+    # unify on the bare function name too.
+    by_tail = {q.split(".")[-1]: info for q, info in factories.items()}
+    seen: set[tuple] = set()
+    for st in states:
+        for (ctx, fac_qual, read, donated, dline, cname, line,
+             scope) in st.events:
+            rec = None
+            if isinstance(fac_qual, tuple):
+                fac_qual, pos = fac_qual
+            else:  # pragma: no cover - defensive
+                continue
+            rec = factories.get(fac_qual) or by_tail.get(
+                fac_qual.split(".")[-1])
+            if rec is None or not rec.donate or pos not in rec.donate:
+                continue
+            key = (id(ctx), line, donated)
+            if key in seen:
+                continue
+            seen.add(key)
+            ctx.report(
+                "TPU604", _node(line),
+                f"`{read}` read after `{cname}(...)` (line {dline}) "
+                f"donated `{donated}`'s buffer (donate_argnums of the "
+                f"compiled step built by `{fac_qual}`): the buffer "
+                "was handed to XLA for reuse — rebind the result over "
+                "the argument before any further use",
+                scope=scope,
+            )
+    return []

@@ -1,0 +1,173 @@
+"""TPU605 — rank-dependent jit-boundary divergence.
+
+The compiled-program twin of TPU103. In SPMD jax every participating
+process must execute the SAME compiled program in the same order: the
+collectives live INSIDE the program (psum/all_gather lowered into the
+XLA graph), so a rank- or ``slice_label``-dependent branch that selects
+*which* jitted function runs::
+
+    if ctx.rank == 0:
+        state, m = self._step_full(state, batch)
+    else:
+        state, m = self._step_light(state, batch)
+
+deadlocks inside XLA itself — rank 0's program issues collectives rank
+1's program never joins, and none of the PR-1 host-side deadlines can
+see it (the hang is below the runtime). TPU103 cannot catch this: the
+collective verbs are invisible, lowered into the compiled graph.
+
+Flagged: a call to a known-jitted callable (module-local jit bind or
+decorated def, a var bound from a jit FACTORY cross-file, or a
+jit-wrapped function qual) under a rank-/slice-dependent branch.
+Uniform-argument dispatch (every rank picks the same branch because the
+predicate is replicated config, not rank identity) is the pragma'd
+exception — the pass cannot prove replication."""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.lint import dataflow, jit_util
+from ray_tpu._private.lint.core import FileContext, dotted_name
+from ray_tpu._private.lint.pass_rank_flow import (
+    _FLOW_TOKENS,
+    _is_divergence_test,
+)
+
+
+class _State(dataflow.PathState):
+    __slots__ = ("guards",)
+
+    def __init__(self):
+        self.guards: tuple = ()
+
+    def fork(self):
+        st = _State()
+        st.guards = self.guards
+        return st
+
+    def merge(self, other):
+        pass
+
+
+class _Walker(dataflow.FlowWalker):
+    def __init__(self, ctx: FileContext, ji: jit_util.ModuleJitIndex,
+                 info: dataflow.FunctionInfo, st: "_PassState"):
+        self.ctx = ctx
+        self.ji = ji
+        self.info = info
+        self.st = st
+
+    def _scope(self):
+        if self.info.class_name:
+            return f"{self.info.class_name}.{self.info.node.name}"
+        return self.info.node.name
+
+    def on_branch(self, test, state, taken):
+        if _is_divergence_test(test):
+            state.guards = state.guards + (test.lineno,)
+            return True
+        return None
+
+    def on_branch_exit(self, token, state):
+        if token and state.guards:
+            state.guards = state.guards[:-1]
+
+    def on_call(self, call, state):
+        if not state.guards:
+            return
+        klass = self.info.class_name
+        name = dotted_name(call.func)
+        if not name:
+            return
+        info = self.ji.lookup_callable(call, klass)
+        if info is not None:
+            self._report(call, name, state.guards[-1])
+            return
+        callee = self.ji.mi.resolve_call(call, klass)
+        if callee is not None and (callee in self.ji.jit_defs
+                                   or callee in self.ji.wrapped):
+            self._report(call, name, state.guards[-1])
+            return
+        # Var bound from a possibly-jit factory, or a call into a
+        # foreign function that may be jit-wrapped elsewhere: defer.
+        canon = self.ji.mi.qualify(name, klass)
+        fac = self.ji.maybe_factory_vars.get(canon)
+        if fac is not None:
+            self.st.events.append((
+                self.ctx, fac, name, call.lineno, state.guards[-1],
+                self._scope()))
+
+    def _report(self, call, name, guard_line):
+        self.ctx.report(
+            "TPU605", call,
+            f"jitted `{name}` invoked under a rank-/slice-dependent "
+            f"branch (guard at line {guard_line}): ranks compile and "
+            "run DIFFERENT programs, and any collective lowered into "
+            "them deadlocks inside XLA where no host-side deadline "
+            "can see it — dispatch one program and branch on data "
+            "inside it (lax.cond)",
+            scope=self._scope(),
+        )
+
+
+class _PassState:
+    def __init__(self, ji: jit_util.ModuleJitIndex):
+        self.ji = ji
+        self.mi = ji.mi
+        # (ctx, factory_qual, display name, line, guard_line, scope)
+        self.events: list[tuple] = []
+
+
+def run(ctx: FileContext):
+    src = ctx.source
+    if "jit" not in src and not any(
+            t in src.lower() for t in _FLOW_TOKENS):
+        return None
+    ji = jit_util.jit_index(ctx)
+    st = _PassState(ji)
+    if any(t in src.lower() for t in _FLOW_TOKENS):
+        for info in ji.mi.functions.values():
+            walker = _Walker(ctx, ji, info, st)
+            walker.walk_function(info.node, _State())
+    return st
+
+
+def finalize(states):
+    states = [st for st in states if st is not None]
+    if not states:
+        return []
+    factories: set[str] = set()
+    for st in states:
+        factories.update(st.ji.factories)
+    if not factories:
+        return []
+    by_tail = {q.split(".")[-1] for q in factories}
+    seen: set[tuple] = set()
+    for st in states:
+        for ctx, fac, name, line, guard_line, scope in st.events:
+            if fac not in factories and fac.split(
+                    ".")[-1] not in by_tail:
+                continue
+            key = (id(ctx), line, name)
+            if key in seen:
+                continue
+            seen.add(key)
+            ctx.report(
+                "TPU605", _FakeNode(line),
+                f"jitted `{name}` (compiled by factory `{fac}`) "
+                f"invoked under a rank-/slice-dependent branch (guard "
+                f"at line {guard_line}): ranks run different compiled "
+                "programs — collectives lowered into them deadlock "
+                "inside XLA. Dispatch one program for every rank",
+                scope=scope,
+            )
+    return []
+
+
+class _FakeNode:
+    __slots__ = ("lineno", "col_offset")
+
+    def __init__(self, lineno: int, col: int = 0):
+        self.lineno = lineno
+        self.col_offset = col

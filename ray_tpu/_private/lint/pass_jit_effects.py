@@ -1,0 +1,149 @@
+"""TPU602 — trace-time side effect under jit.
+
+A ``@jax.jit`` function's Python body runs ONCE, at trace time. A
+metric increment, a log line, a ``memory.track`` claim, or an append to
+a closure list inside it does not "run every step" — it runs exactly
+once per compilation and then silently lies forever: the counter stays
+flat while the program runs a million steps, the log says the branch
+executed when only its traced residue did. Flagged shapes:
+
+- ``print(...)`` / ``logger.info(...)`` / ``logging.warning(...)`` /
+  ``warnings.warn(...)``
+- tracing/metric emission: ``emit_span`` / ``record_span`` /
+  ``record_op``, ``.inc()`` / ``.observe()`` on a dotted receiver, and
+  ``.set()`` on an UPPERCASE receiver (the module-level metric-constant
+  convention — ``x.at[i].set(v)``, jax's functional update, has a
+  Subscript receiver and never matches)
+- ``memory.track(...)`` ledger claims
+- ``closure_list.append(...)`` where the list is not local to the
+  jitted function — the appended tracer leaks out of the trace
+
+The legitimate escape hatches stay silent: ``jax.debug.print`` /
+``jax.debug.callback`` / ``io_callback`` / ``pure_callback`` run at
+execution time by design, and a function passed INTO them is never
+walked (only direct calls in the traced body are).
+
+Scope is module-local: jit-decorated defs plus functions wrapped by a
+``jit(...)`` call in the same file (the overwhelmingly common layout
+here). The recompile sanitizer is the runtime backstop for the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.lint import jit_util
+from ray_tpu._private.lint.core import FileContext, dotted_name
+
+_LOG_METHODS = frozenset({
+    "debug", "info", "warning", "warn", "error", "exception", "critical",
+})
+_TRACE_VERBS = frozenset({"emit_span", "record_span", "record_op"})
+_CALLBACK_TAILS = frozenset({
+    "io_callback", "pure_callback", "callback", "debug_callback",
+})
+
+
+def _side_effect(call: ast.Call, local_names: set[str],
+                 params: set[str]) -> str | None:
+    """A human-readable description when ``call`` is a trace-time side
+    effect, else None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        if func.id == "print":
+            return "print(...)"
+        if func.id in _TRACE_VERBS:
+            return f"{func.id}(...) span emission"
+        return None
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = dotted_name(func.value)
+    recv_tail = recv.split(".")[-1] if recv else ""
+    head = recv.split(".")[0] if recv else ""
+    if head == "jax":
+        return None  # jax.debug.print and friends are execution-time
+    if func.attr in _LOG_METHODS and (
+            "log" in recv_tail.lower() or recv == "warnings"):
+        return f"{recv}.{func.attr}(...) logging"
+    if func.attr in _TRACE_VERBS:
+        return f"{recv}.{func.attr}(...) span emission"
+    if func.attr in ("inc", "observe") and recv:
+        return f"{recv}.{func.attr}(...) metric update"
+    if func.attr == "set" and recv_tail and recv_tail.isupper():
+        return f"{recv}.set(...) metric update"
+    if func.attr == "track" and ("mem" in recv_tail.lower()
+                                 or recv_tail == "memory"):
+        return f"{recv}.track(...) memory-ledger claim"
+    if func.attr == "append" and isinstance(func.value, ast.Name):
+        name = func.value.id
+        if name not in local_names and name not in params:
+            return (f"append to closure/global list `{name}`: the "
+                    "traced value leaks out of the trace")
+    return None
+
+
+def _local_stores(fn_node) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for t in ast.walk(node.target):
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _walk_traced(fn_node):
+    """Yield Call nodes in the traced body: skip nested def/lambda
+    bodies only when they are ARGUMENTS to a callback wrapper (they run
+    at execution time); a plain nested helper def still traces when
+    called, so its body is walked."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            tail = fname.split(".")[-1] if fname else ""
+            if tail in _CALLBACK_TAILS:
+                # Walk only the non-callable args (shapes, operands).
+                for arg in node.args:
+                    if not isinstance(arg, (ast.Lambda, ast.Name)):
+                        stack.append(arg)
+                continue
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def run(ctx: FileContext):
+    if "jit" not in ctx.source:
+        return None
+    ji = jit_util.jit_index(ctx)
+    traced = set(ji.jit_defs) | (ji.wrapped & set(ji.mi.functions))
+    if not traced:
+        return None
+    for qual in sorted(traced):
+        info = ji.mi.functions[qual]
+        params = set(info.params)
+        local_names = _local_stores(info.node)
+        scope = (f"{info.class_name}.{info.node.name}"
+                 if info.class_name else info.node.name)
+        for call in _walk_traced(info.node):
+            desc = _side_effect(call, local_names, params)
+            if desc is not None:
+                ctx.report(
+                    "TPU602", call,
+                    f"{desc} inside jit-traced `{qual}`: this runs "
+                    "ONCE at trace time, not per step — the compiled "
+                    "program carries no trace of it and the signal it "
+                    "claims to emit silently flatlines. Hoist it to "
+                    "the caller or route it through jax.debug/"
+                    "io_callback",
+                    scope=scope,
+                )
+    return None
+
+
+def finalize(states):
+    return []

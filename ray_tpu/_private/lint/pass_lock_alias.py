@@ -22,8 +22,11 @@ the same order graph TPU202 cycles over:
 - **attributes**: ``self._lk = lk`` unifies ``Class._lk`` with
   whatever each constructor call passes.
 - **containers**: ``self._locks[k] = Lock()`` / ``with
-  self._locks[k]:`` collapse to one summary node per container
-  (``Class._locks[]``) — one dict, one order-graph node.
+  self._locks[k]:`` with a VARIABLE key collapse to one summary node
+  per container (``Class._locks[]``); a STRING-LITERAL key gets its
+  own node (``Class._locks["a"]``), so the ordering between
+  ``self._locks["a"]`` and ``self._locks["b"]`` is visible — the
+  PR-12 per-container-summary caveat, closed.
 
 Cycles whose every edge was already visible to TPU202 stay TPU202;
 only cycles that NEED an aliased edge report here, so one deadlock
@@ -57,6 +60,27 @@ _MAX_BINDINGS = 3
 def _is_lockish(name: str) -> bool:
     last = name.split(".")[-1].lower()
     return any(t in last for t in _LOCKISH)
+
+
+def _is_container_node(canon: str) -> bool:
+    """Summary (``C._locks[]``) or per-key (``C._locks["a"]``) node."""
+    return canon.endswith("]")
+
+
+def _container_summary(canon: str) -> str | None:
+    """``C._locks["a"]`` → ``C._locks[]``; None for non-key nodes."""
+    if canon.endswith("]") and not canon.endswith("[]"):
+        return canon[: canon.index("[")] + "[]"
+    return None
+
+
+def _subscript_node(base: str, sl: ast.AST) -> str:
+    """Container node name for ``base[sl]``: per-constant-key when the
+    subscript is a string literal, the per-container summary otherwise
+    (a variable key could be ANY key — one node, soundly merged)."""
+    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+        return f'{base}["{sl.value}"]'
+    return base + "[]"
 
 
 @dataclasses.dataclass
@@ -123,7 +147,8 @@ class _Visitor(ScopeVisitor):
         if isinstance(expr, ast.Subscript):
             base = dotted_name(expr.value)
             if base:
-                return ("L", self._qualify(base) + "[]")
+                return ("L", _subscript_node(
+                    self._qualify(base), expr.slice))
         return None
 
     def _loc(self, node) -> _Loc:
@@ -201,7 +226,7 @@ class _Visitor(ScopeVisitor):
         elif isinstance(target, ast.Subscript):
             base = dotted_name(target.value)
             if base:
-                tgt = self._qualify(base) + "[]"
+                tgt = _subscript_node(self._qualify(base), target.slice)
         if tgt is None:
             return
         if isinstance(value, ast.Call):
@@ -336,7 +361,7 @@ def finalize(states):
             return True
         c = item[1]
         return (_is_lockish(c) or c in lock_names_early
-                or c in alias_targets or c.endswith("[]"))
+                or c in alias_targets or _is_container_node(c))
 
     # ---------------------------------------------------- param values
     # (fn, idx) -> set of "L" canonicals / ("P", fn', idx') refs
@@ -401,9 +426,24 @@ def finalize(states):
         if _is_lockish(c) or c in lock_names:
             lock_reps.add(uf.find(c))
 
+    # Lockhood flows between a container's summary node and its
+    # per-key nodes: `self._m["a"] = Lock()` makes a variable-key
+    # acquisition of the same dict (`self._m[k]` → `C._m[]`) a lock,
+    # and a summary-level Lock() def covers every literal key.
+    per_key_lock_containers = {
+        _container_summary(c) for c in lock_names
+        if _container_summary(c) is not None
+    }
+
     def is_lock(canon: str) -> bool:
-        return (_is_lockish(canon) or canon in lock_names
-                or uf.find(canon) in lock_reps)
+        if (_is_lockish(canon) or canon in lock_names
+                or uf.find(canon) in lock_reps):
+            return True
+        if canon.endswith("[]") and canon in per_key_lock_containers:
+            return True
+        summ = _container_summary(canon)
+        return summ is not None and (summ in lock_names
+                                     or _is_lockish(summ))
 
     # ----------------------------------------------------- acq closure
     acq: dict[str, set] = {}
@@ -438,7 +478,7 @@ def finalize(states):
                 aliased = (
                     via_alias
                     or a_item[0] == "P" or b_item[0] == "P"
-                    or a.endswith("[]") or b.endswith("[]")
+                    or _is_container_node(a) or _is_container_node(b)
                     or uf.merged(a) or uf.merged(b)
                     or not _is_lockish(a) or not _is_lockish(b)
                 )

@@ -1,0 +1,200 @@
+"""TPU603 — steady-state recompilation hazard.
+
+XLA compilation costs seconds; a train step costs milliseconds. A jit
+cache miss in the steady-state loop is therefore a 1000x hiccup, and
+the miss is invisible at the call site — the code "works", just
+intermittently three orders of magnitude slower. Statically visible
+shapes:
+
+- **loop-varying scalar**: the induction variable of a
+  ``for i in range(...)`` / ``enumerate(...)`` loop passed bare (or
+  arithmetically derived) into a jitted callable. At a
+  ``static_argnums`` position this retraces EVERY iteration by
+  construction; at a traced position it rides on weak-type caching
+  today but pins the cache to host-scalar semantics (any shape use —
+  ``jnp.arange(i)``, ``reshape(i)`` — silently becomes per-step
+  recompilation).
+- **data-dependent slice**: ``f(x[:n])`` inside a loop with a
+  non-constant bound — a new shape per distinct ``n``, a new compile
+  per shape. Pad to a bucket instead (the LLM engine's ``_bucket``
+  idiom).
+- **unhashable static**: a list/dict/set literal passed at a
+  ``static_argnums`` position — statics key the cache by VALUE and
+  must be hashable; this raises at best and retraces-by-identity at
+  worst.
+
+The runtime twin (``sanitize`` compile watch, ``RAY_TPU_SANITIZE=1``)
+catches the dynamic remainder: it counts recompiles per function after
+``RAY_TPU_SANITIZE_COMPILE_GRACE`` steady-state calls and names the
+argument signature that changed."""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.lint import jit_util
+from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name
+
+_LOOP_ITER_TAILS = frozenset({"range", "enumerate"})
+
+
+def _loop_scalar_targets(node) -> set[str]:
+    """Induction variables that are Python ints: for i in range(...) /
+    for i, x in enumerate(...)."""
+    if not isinstance(node, (ast.For, ast.AsyncFor)):
+        return set()
+    it = node.iter
+    if not isinstance(it, ast.Call):
+        return set()
+    fname = dotted_name(it.func)
+    tail = fname.split(".")[-1] if fname else ""
+    if tail not in _LOOP_ITER_TAILS:
+        return set()
+    target = node.target
+    if isinstance(target, ast.Name):
+        return {target.id}
+    if isinstance(target, ast.Tuple) and target.elts and isinstance(
+            target.elts[0], ast.Name) and tail == "enumerate":
+        return {target.elts[0].id}
+    return set()
+
+
+def _derives_from(expr: ast.AST, names: set[str]) -> str | None:
+    """The loop-var name when ``expr`` is it (or pure arithmetic over
+    it) — indexing/slicing/calls break the derivation (x[i] is a
+    constant-shape load, f(i) may normalize)."""
+    if isinstance(expr, ast.Name):
+        return expr.id if expr.id in names else None
+    if isinstance(expr, ast.BinOp):
+        return (_derives_from(expr.left, names)
+                or _derives_from(expr.right, names))
+    if isinstance(expr, ast.UnaryOp):
+        return _derives_from(expr.operand, names)
+    return None
+
+
+def _dynamic_slice_arg(expr: ast.AST) -> str | None:
+    """'x[:n]'-style description when ``expr`` slices with a
+    non-constant bound."""
+    if not isinstance(expr, ast.Subscript):
+        return None
+    sl = expr.slice
+    if not isinstance(sl, ast.Slice):
+        return None
+    for bound in (sl.lower, sl.upper):
+        if bound is None or isinstance(bound, ast.Constant):
+            continue
+        base = dotted_name(expr.value) or "<arr>"
+        bname = dotted_name(bound) or "<expr>"
+        return f"{base}[...:{bname}]"
+    return None
+
+
+class _Visitor(ScopeVisitor):
+    def __init__(self, ctx: FileContext, ji: jit_util.ModuleJitIndex):
+        super().__init__(ctx)
+        self.ji = ji
+        self._loop_vars: list[set[str]] = []
+
+    def _klass(self):
+        return self._class[-1] if self._class else None
+
+    def _visit_loop(self, node):
+        self._loop_vars.append(_loop_scalar_targets(node))
+        self.generic_visit(node)
+        self._loop_vars.pop()
+
+    def visit_For(self, node):
+        self._visit_loop(node)
+
+    def visit_AsyncFor(self, node):
+        self._visit_loop(node)
+
+    def visit_While(self, node):
+        self._loop_vars.append(set())
+        self.generic_visit(node)
+        self._loop_vars.pop()
+
+    # ------------------------------------------------------------- calls
+    def _callee_info(self, node: ast.Call):
+        """(JitInfo, display-name) for calls into known-jitted
+        callables: bound vars, decorated defs, local factories."""
+        info = self.ji.lookup_callable(node, self._klass())
+        name = dotted_name(node.func)
+        if info is not None:
+            return info, name
+        callee = self.ji.mi.resolve_call(node, self._klass())
+        if callee is None:
+            return None, name
+        if callee in self.ji.jit_defs:
+            return self.ji.jit_defs[callee], name
+        return None, name
+
+    def visit_Call(self, node: ast.Call):
+        info, name = self._callee_info(node)
+        if info is None:
+            self.generic_visit(node)
+            return
+        static = info.static or ()
+        in_loop = bool(self._loop_vars)
+        loop_names = set().union(*self._loop_vars) if in_loop else set()
+        for pos, arg in enumerate(node.args):
+            lv = _derives_from(arg, loop_names) if loop_names else None
+            if lv is not None:
+                if pos in static:
+                    self.ctx.report(
+                        "TPU603", node,
+                        f"loop variable `{lv}` feeds static_argnums "
+                        f"position {pos} of jitted `{name}`: a NEW "
+                        "compilation every iteration, by construction "
+                        "— statics key the cache by value",
+                        scope=self.scope,
+                    )
+                else:
+                    self.ctx.report(
+                        "TPU603", node,
+                        f"loop variable `{lv}` passed as a Python "
+                        f"scalar into jitted `{name}`: the cache key "
+                        "rides on weak-type semantics and any shape "
+                        "use of it inside the program means a "
+                        "recompile per iteration — pass it as a "
+                        "traced array (jnp.int32(i)) or hoist it",
+                        scope=self.scope,
+                    )
+            elif in_loop:
+                sl = _dynamic_slice_arg(arg)
+                if sl is not None:
+                    self.ctx.report(
+                        "TPU603", node,
+                        f"data-dependent slice `{sl}` passed into "
+                        f"jitted `{name}` inside a loop: every "
+                        "distinct length is a new shape and a new "
+                        "compilation — pad to a bucketed length "
+                        "instead",
+                        scope=self.scope,
+                    )
+            if pos in static and isinstance(
+                    arg, (ast.List, ast.Dict, ast.Set)):
+                kind = type(arg).__name__.lower()
+                self.ctx.report(
+                    "TPU603", node,
+                    f"unhashable {kind} literal at static_argnums "
+                    f"position {pos} of jitted `{name}`: statics must "
+                    "be hashable (use a tuple / frozen mapping)",
+                    scope=self.scope,
+                )
+        self.generic_visit(node)
+
+
+def run(ctx: FileContext):
+    if "jit" not in ctx.source:
+        return None
+    ji = jit_util.jit_index(ctx)
+    if not (ji.jit_vars or ji.jit_defs):
+        return None
+    _Visitor(ctx, ji).visit(ctx.tree)
+    return None
+
+
+def finalize(states):
+    return []

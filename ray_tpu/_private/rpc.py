@@ -551,7 +551,12 @@ class ReconnectingClient:
         self._closed = False
 
     async def connect(self) -> "ReconnectingClient":
-        self._lock = asyncio.Lock()
+        # Instrumented under RAY_TPU_SANITIZE=1 (TPU203's runtime
+        # twin): the proxy/serve control plane reconnects through this
+        # lock, so inversions against replica/model locks surface.
+        from ray_tpu._private.sanitize import maybe_async_lock
+
+        self._lock = maybe_async_lock(f"rpc.client.{self.addr}")
         self._conn = await connect(self.addr, on_push=self.on_push)
         return self
 

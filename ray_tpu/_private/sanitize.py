@@ -30,6 +30,21 @@ Runtime twins of the v2 flow-sensitive lint passes:
   un-``wait()``ed / un-``close()``d, a warning names what was
   dropped. The static passes catch the paths they can see — this
   catches the handles that escaped into data structures.
+- **jit compile watch (TPU603's twin)**: :func:`install_jax_watch`
+  monkeypatches ``jax.jit`` so every compiled callable ray_tpu/test
+  code creates is wrapped by :func:`watch_jit`: per-call abstract
+  argument signatures (shape/dtype for arrays, type for traced
+  scalars, VALUE for statics) are tracked, and a NEW signature after
+  ``RAY_TPU_SANITIZE_COMPILE_GRACE`` steady-state calls warns naming
+  the argument that changed and increments
+  ``ray_tpu_sanitize_recompiles_total{fn}`` — steady-state
+  recompilation is a 1000x step-time hiccup the call site never sees.
+- **host-sync tracer (TPU601's twin)**: the same install patches
+  ``jax.block_until_ready`` / ``jax.device_get`` to record wall-clock
+  sync intervals; ``ray_tpu/train/telemetry.py`` drains them at step
+  close and attributes the portion inside compute-phase spans as a
+  ``host_sync_exposed_s`` step-span attr, next to PR-9's
+  comm-exposure attribution.
 
 Opt-in: ``RAY_TPU_SANITIZE=1`` makes :func:`maybe_lock` /
 :func:`maybe_rlock` / :func:`maybe_async_lock` hand out instrumented
@@ -94,6 +109,8 @@ class _OrderGraph:
         self.loop_thread_acquires = 0
         self.work_leaks = 0
         self.registration_leaks = 0
+        self.recompiles = 0
+        self.host_syncs = 0
 
     def reset(self):
         with self._guard:
@@ -104,6 +121,8 @@ class _OrderGraph:
             self.loop_thread_acquires = 0
             self.work_leaks = 0
             self.registration_leaks = 0
+            self.recompiles = 0
+            self.host_syncs = 0
 
     def check_and_add(self, held_id: int, held_name: str,
                       new_id: int, new_name: str) -> list[str] | None:
@@ -501,10 +520,280 @@ def uninstall():
         threading.RLock = _ORIG_RLOCK
 
 
+# ------------------------------------------------- jit compile watch
+_COMPILE_GRACE_DEFAULT = 3
+_RECOMPILES_TOTAL = None
+
+
+def compile_grace() -> int:
+    """Steady-state call count after which a new signature is a
+    recompile WARNING rather than expected warm-up (shape buckets,
+    first batch, eval shapes all compile early by design)."""
+    try:
+        return int(os.environ.get(
+            "RAY_TPU_SANITIZE_COMPILE_GRACE", _COMPILE_GRACE_DEFAULT))
+    except ValueError:
+        return _COMPILE_GRACE_DEFAULT
+
+
+def _recompile_counter():
+    global _RECOMPILES_TOTAL
+    if _RECOMPILES_TOTAL is None:
+        from ray_tpu.util.metrics import Counter
+
+        # tpulint: allow(TPU401 reason=module-level None-guarded singleton - sanitize imports before the metrics registry on every process boot path, so the ctor is deferred to first recompile; it runs at most once)
+        _RECOMPILES_TOTAL = Counter(
+            "ray_tpu_sanitize_recompiles_total",
+            "jit recompilations observed after the steady-state grace "
+            "(RAY_TPU_SANITIZE_COMPILE_GRACE) by the sanitizer's "
+            "compile watch",
+            tag_keys=("fn",),
+        )
+    return _RECOMPILES_TOTAL
+
+
+def _sig_one(x, static: bool):
+    """Abstract signature of one argument: what the jit cache keys on.
+    Arrays by (shape, dtype); pytree containers structurally; traced
+    Python scalars by TYPE (weak-type caching is value-independent);
+    statics by VALUE (that is exactly what retraces)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(x, (list, tuple)):
+        return (type(x).__name__,
+                tuple(_sig_one(v, static) for v in x))
+    if isinstance(x, dict):
+        return ("dict", tuple(
+            (str(k), _sig_one(v, static))
+            for k, v in sorted(x.items(), key=lambda kv: str(kv[0]))))
+    if static:
+        try:
+            return ("static", repr(x)[:120])
+        # tpulint: allow(broad-except reason=a static with a throwing repr must degrade to an opaque token, never break the watched call)
+        except Exception:  # noqa: BLE001
+            return ("static", f"<unreprable {type(x).__name__}>")
+    return ("py", type(x).__name__)
+
+
+def _signature(args, kwargs, static_argnums, static_argnames):
+    parts = []
+    for i, a in enumerate(args):
+        parts.append((str(i), _sig_one(a, i in static_argnums)))
+    for k in sorted(kwargs):
+        parts.append((k, _sig_one(kwargs[k], k in static_argnames)))
+    return tuple(parts)
+
+
+def _sig_diff(old, new) -> str:
+    """Human-readable 'which argument changed' between two signatures."""
+    if old is None:
+        return "first tracked signature"
+    old_map = dict(old)
+    changes = []
+    for key, val in new:
+        prev = old_map.get(key)
+        if prev != val:
+            changes.append(f"arg {key}: {prev} -> {val}")
+    for key in old_map:
+        if key not in dict(new):
+            changes.append(f"arg {key} removed")
+    return "; ".join(changes) or "argument structure changed"
+
+
+class WatchedJit:
+    """Wrapper around a compiled callable that tracks abstract argument
+    signatures and warns on a NEW one after the steady-state grace —
+    the jit cache grew when the hot loop should be cache-stable."""
+
+    __slots__ = ("_jitted", "name", "_static_argnums",
+                 "_static_argnames", "_seen", "_calls", "_last_sig",
+                 "__weakref__")
+
+    def __init__(self, jitted, name: str,
+                 static_argnums=(), static_argnames=()):
+        self._jitted = jitted
+        self.name = name
+        self._static_argnums = frozenset(static_argnums)
+        self._static_argnames = frozenset(static_argnames)
+        self._seen: set = set()
+        self._calls = 0
+        self._last_sig = None
+
+    def __call__(self, *args, **kwargs):
+        sig = _signature(args, kwargs, self._static_argnums,
+                         self._static_argnames)
+        self._calls += 1
+        if sig not in self._seen:
+            if self._seen and self._calls > compile_grace():
+                _graph.recompiles += 1
+                diff = _sig_diff(self._last_sig, sig)
+                _recompile_counter().inc(tags={"fn": self.name})
+                logger.warning(
+                    "sanitizer: jitted %s RECOMPILED at call %d "
+                    "(signature #%d, after %d steady-state calls): %s "
+                    "— a steady-state cache miss costs seconds per "
+                    "hit; bucket the varying argument or mark it "
+                    "traced (TPU603's runtime twin)",
+                    self.name, self._calls, len(self._seen) + 1,
+                    compile_grace(), diff,
+                )
+            self._seen.add(sig)
+        self._last_sig = sig
+        return self._jitted(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._jitted, item)
+
+    def __repr__(self):
+        return f"<WatchedJit {self.name!r} signatures={len(self._seen)}>"
+
+
+def _norm_argnums(v) -> tuple:
+    if v is None:
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    return tuple(v)
+
+
+def _norm_argnames(v) -> tuple:
+    if v is None:
+        return ()
+    if isinstance(v, str):
+        return (v,)
+    return tuple(v)
+
+
+def watch_jit(jitted, name: str | None = None,
+              static_argnums=None, static_argnames=None) -> WatchedJit:
+    """Wrap an already-compiled callable in the compile watch."""
+    if name is None:
+        name = getattr(jitted, "__qualname__", None) or getattr(
+            jitted, "__name__", None) or repr(jitted)
+    return WatchedJit(
+        jitted, name,
+        static_argnums=_norm_argnums(static_argnums),
+        static_argnames=_norm_argnames(static_argnames),
+    )
+
+
+_jax_watch_count = 0
+_ORIG_JAX_JIT = None
+_ORIG_BLOCK_UNTIL_READY = None
+_ORIG_DEVICE_GET = None
+# Bounded ring of completed host-sync wall intervals, drained by the
+# train-step telemetry (host_sync_exposed_s attribution).
+_SYNC_RING_MAX = 4096
+_sync_guard = _thread.allocate_lock()
+_sync_intervals: list[tuple[float, float]] = []
+
+
+def _patched_jax_jit(fun=None, **kwargs):
+    import functools
+
+    if fun is None:
+        return functools.partial(_patched_jax_jit, **kwargs)
+    jitted = _ORIG_JAX_JIT(fun, **kwargs)
+    mod = _caller_module()
+    if not (mod.startswith("ray_tpu") or mod.startswith("test")):
+        return jitted
+    name = getattr(fun, "__qualname__", None) or getattr(
+        fun, "__name__", None) or f"{mod}.<jit>"
+    return WatchedJit(
+        jitted, f"{mod}.{name}",
+        static_argnums=_norm_argnums(kwargs.get("static_argnums")),
+        static_argnames=_norm_argnames(kwargs.get("static_argnames")),
+    )
+
+
+def _record_sync(t0: float, t1: float) -> None:
+    _graph.host_syncs += 1
+    with _sync_guard:
+        _sync_intervals.append((t0, t1))
+        if len(_sync_intervals) > _SYNC_RING_MAX:
+            del _sync_intervals[: _SYNC_RING_MAX // 2]
+
+
+def _timed_sync(orig):
+    import functools
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        t0 = time.time()
+        try:
+            return orig(*args, **kwargs)
+        finally:
+            _record_sync(t0, time.time())
+
+    return wrapper
+
+
+def take_host_sync_intervals() -> list[tuple[float, float]]:
+    """Drain the recorded block_until_ready/device_get wall intervals
+    (the telemetry's step-close attribution consumes these, exactly
+    like the flight recorder's op intervals)."""
+    with _sync_guard:
+        out, _sync_intervals[:] = list(_sync_intervals), []
+    return out
+
+
+def jax_watch_active() -> bool:
+    return _jax_watch_count > 0
+
+
+def install_jax_watch():
+    """Monkeypatch ``jax.jit`` (compile watch) and
+    ``jax.block_until_ready``/``jax.device_get`` (host-sync tracer).
+    Reference-counted like :func:`install`; a missing jax degrades to
+    a no-op so non-accelerator processes can enable RAY_TPU_SANITIZE=1
+    unconditionally."""
+    global _jax_watch_count, _ORIG_JAX_JIT
+    global _ORIG_BLOCK_UNTIL_READY, _ORIG_DEVICE_GET
+    try:
+        import jax
+    except ImportError:
+        return
+    _jax_watch_count += 1
+    if _jax_watch_count == 1:
+        _ORIG_JAX_JIT = jax.jit
+        _ORIG_BLOCK_UNTIL_READY = jax.block_until_ready
+        _ORIG_DEVICE_GET = jax.device_get
+        jax.jit = _patched_jax_jit
+        jax.block_until_ready = _timed_sync(_ORIG_BLOCK_UNTIL_READY)
+        jax.device_get = _timed_sync(_ORIG_DEVICE_GET)
+
+
+def uninstall_jax_watch():
+    global _jax_watch_count
+    if _jax_watch_count == 0:
+        return
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - install was a no-op too
+        _jax_watch_count = max(0, _jax_watch_count - 1)
+        return
+    _jax_watch_count -= 1
+    if _jax_watch_count == 0:
+        jax.jit = _ORIG_JAX_JIT
+        jax.block_until_ready = _ORIG_BLOCK_UNTIL_READY
+        jax.device_get = _ORIG_DEVICE_GET
+
+
+def maybe_install_jax_watch():
+    """Install the jit-discipline twins when RAY_TPU_SANITIZE=1 — the
+    train worker calls this once at setup."""
+    if enabled():
+        install_jax_watch()
+
+
 def reset():
     """Clear the global order graph (test isolation: one module's lock
     order must not poison the next's)."""
     _graph.reset()
+    with _sync_guard:
+        _sync_intervals.clear()
 
 
 def stats() -> dict:
@@ -514,5 +803,7 @@ def stats() -> dict:
         "loop_thread_acquires": _graph.loop_thread_acquires,
         "work_leaks": _graph.work_leaks,
         "registration_leaks": _graph.registration_leaks,
+        "recompiles": _graph.recompiles,
+        "host_syncs": _graph.host_syncs,
         "edges": sum(len(v) for v in _graph._edges.values()),
     }

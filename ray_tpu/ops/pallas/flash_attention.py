@@ -516,6 +516,7 @@ def flash_attention(
     if min(block_q, block_kv) < 128 and s > 128:
         import warnings
 
+        # tpulint: allow(TPU602 reason=once-per-compilation is the intent - the slowdown is a property of the STATIC block sizes, so trace time (one warn per compiled shape, via the jit cache) is exactly the right cadence; per-step emission would spam)
         warnings.warn(
             f"flash_attention: seq={s} only admits blocks "
             f"(q={block_q}, kv={block_kv}) < 128 — expect a severe "

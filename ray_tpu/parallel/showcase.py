@@ -134,13 +134,14 @@ def composed_trainer_loop(config):
                         bucketer = train.grad_bucketer(group_name=gname)
                         pending = bucketer.sync_async(grads)
                         # In-flight buckets overlap this remaining
-                        # compute (host-side grad-norm probe).
-                        gnorm = float(
-                            np.sqrt(sum(
-                                float(jax.numpy.sum(g * g))
-                                for g in jax.tree.leaves(grads)
-                            ))
+                        # compute (grad-norm probe): reduce on device,
+                        # pay ONE host transfer for the scalar.
+                        sq = sum(
+                            jax.numpy.sum(g * g)
+                            for g in jax.tree.leaves(grads)
                         )
+                        # tpulint: allow(TPU601 reason=deliberate - this single scalar sync IS the remaining in-phase work the in-flight buckets overlap with; the dryrun asserts comm_overlapped_s>0 against exactly this probe)
+                        gnorm = float(np.sqrt(float(sq)))
                 with sp.phase("collective"):
                     # Cross-worker loss mean through the recorded
                     # collective path (the compiled program's psums are

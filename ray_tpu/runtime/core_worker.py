@@ -294,7 +294,10 @@ class CoreWorker:
         conn = self._conns.get(addr)
         if conn is not None and not conn._closed:
             return conn
-        lock = self._conn_locks.setdefault(addr, asyncio.Lock())
+        from ray_tpu._private.sanitize import maybe_async_lock
+
+        lock = self._conn_locks.setdefault(
+            addr, maybe_async_lock(f"core_worker.conn.{addr}"))
         async with lock:
             conn = self._conns.get(addr)
             if conn is not None and not conn._closed:

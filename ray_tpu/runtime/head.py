@@ -1457,7 +1457,11 @@ class HeadService:
         actor = self.actors.get(actor_id)
         if actor is None:
             return {"ok": False, "state": "DEAD"}
-        lock = actor.setdefault("_restart_lock", asyncio.Lock())
+        from ray_tpu._private.sanitize import maybe_async_lock
+
+        lock = actor.setdefault(
+            "_restart_lock",
+            maybe_async_lock(f"head.actor_restart.{actor_id}"))
         async with lock:
             if actor["state"] == "ALIVE" and actor["addr"] != failed_addr:
                 # Another reporter already drove the restart.

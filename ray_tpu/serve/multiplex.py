@@ -7,10 +7,11 @@ with LRU eviction; the router favors replicas with the model warm.)
 
 from __future__ import annotations
 
-import asyncio
 import collections
 import functools
 import inspect
+
+from ray_tpu._private.sanitize import maybe_async_lock
 
 
 def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
@@ -32,7 +33,13 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
             if model_id in models:
                 models.move_to_end(model_id)
                 return models[model_id]
-            lock = state["locks"].setdefault(model_id, asyncio.Lock())
+            # Instrumented under RAY_TPU_SANITIZE=1: the model-load
+            # lock joins the sanitizer's global order graph, so an
+            # inversion against any other serve/control-plane lock
+            # raises at acquisition (TPU203's runtime twin).
+            lock = state["locks"].setdefault(
+                model_id, maybe_async_lock(
+                    f"serve.multiplex.{fn.__name__}.{model_id}"))
             async with lock:
                 if model_id in models:  # raced with another loader
                     models.move_to_end(model_id)

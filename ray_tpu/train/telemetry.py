@@ -209,6 +209,38 @@ def comm_attribution(
     return max(0.0, total - overlapped), overlapped
 
 
+def host_sync_attribution(
+    step_start: float,
+    step_end: float,
+    compute_events: list[tuple[str, float, float]],
+) -> float:
+    """``host_sync_exposed_s`` for one step: drain the sanitizer's
+    block_until_ready/device_get wall intervals (recorded only while
+    the jax watch is installed — RAY_TPU_SANITIZE=1) and measure the
+    portion inside this step's compute phase. A sync inside compute is
+    a pipeline stall the hot loop paid for; syncs in the declared
+    blocking phases (collective/data_wait/checkpoint) are their stated
+    semantics and are not charged. The TPU601 lint pass is the static
+    side of this number."""
+    from ray_tpu._private import sanitize
+
+    if not sanitize.jax_watch_active():
+        return 0.0
+    syncs = sanitize.take_host_sync_intervals()
+    clamped = [
+        (max(s, step_start), min(e, step_end))
+        for s, e in syncs
+        if e > step_start and s < step_end
+    ]
+    if not clamped:
+        return 0.0
+    compute = _merge_intervals(
+        [(wall, wall + d) for name, wall, d in compute_events
+         if name == "compute"]
+    )
+    return _overlap_seconds(_merge_intervals(clamped), compute)
+
+
 def compute_mfu(flops: float | None, dur: float) -> float | None:
     if not flops or dur <= 0:
         return None
@@ -241,10 +273,14 @@ def finish_step(ctx, timer: StepTimer) -> None:
     )
     if (exposed or overlapped) and dur > 0:
         COMM_EXPOSED_RATIO.set(exposed / dur, tags={"job": job})
+    sync_exposed = host_sync_attribution(
+        timer.start, timer.start + dur, timer._events
+    )
     _emit_step_span(
         ctx, timer.start, dur, phases=dict(timer.phases), mfu=mfu,
         degraded_frac=_take_degraded_frac(ctx),
         comm_exposed_s=exposed, comm_overlapped_s=overlapped,
+        host_sync_exposed_s=sync_exposed,
     )
     from ray_tpu.util import tracing
 
@@ -320,6 +356,7 @@ def _take_degraded_frac(ctx) -> float:
 def _emit_step_span(
     ctx, start, dur, phases, mfu, degraded_frac=0.0,
     comm_exposed_s=0.0, comm_overlapped_s=0.0,
+    host_sync_exposed_s=0.0,
 ) -> None:
     from ray_tpu.util import tracing
 
@@ -337,4 +374,6 @@ def _emit_step_span(
     if comm_exposed_s or comm_overlapped_s:
         attrs["comm_exposed_s"] = round(comm_exposed_s, 6)
         attrs["comm_overlapped_s"] = round(comm_overlapped_s, 6)
+    if host_sync_exposed_s:
+        attrs["host_sync_exposed_s"] = round(host_sync_exposed_s, 6)
     tracing.emit_span("train:step", start, dur, **attrs)

@@ -208,6 +208,12 @@ class TrainWorker:
                 os.environ["JAX_PLATFORMS"] = jax_platform
             else:
                 os.environ.pop("JAX_PLATFORMS", None)
+        # RAY_TPU_SANITIZE=1: install the jit-discipline twins (compile
+        # watch + host-sync tracer) BEFORE any jax.jit in this process,
+        # so the flagship train step itself is under the watch.
+        from ray_tpu._private import sanitize as _sanitize
+
+        _sanitize.maybe_install_jax_watch()
         # Watch the head's drain fan-out (the PR-1 death channel): a
         # preemption notice for any node must reach this worker BEFORE
         # the node dies so the loop can take its emergency checkpoint

@@ -1,0 +1,30 @@
+"""TPU604 fixture: donated buffers read after the call.
+
+Exact rule ids + lines are pinned in test_lint.py.
+"""
+import jax
+
+
+def _step(state, batch):
+    return state, {"loss": 0.0}
+
+
+train_step = jax.jit(_step, donate_argnums=(0,))
+
+
+def read_after_donation(state, batch):
+    new_state, metrics = train_step(state, batch)
+    loss = float(state["loss"])                 # state's buffer is gone
+    return new_state, loss
+
+
+def loop_carried_donation(state, batches):
+    for batch in batches:
+        out = train_step(state, batch)          # donated, never rebound
+    return out
+
+
+def clean_rebind(state, batches):
+    for batch in batches:
+        state, metrics = train_step(state, batch)
+    return state, metrics

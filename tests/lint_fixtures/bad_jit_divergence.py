@@ -1,0 +1,38 @@
+"""TPU605 fixture: rank-dependent branch selecting the compiled program.
+
+Exact rule ids + lines are pinned in test_lint.py.
+"""
+import jax
+
+
+def _full_step(state, batch):
+    return state
+
+
+def _light_step(state, batch):
+    return state
+
+
+full = jax.jit(_full_step)
+light = jax.jit(_light_step)
+
+
+def diverged_dispatch(rank, state, batch):
+    if rank == 0:
+        state = full(state, batch)              # rank 0's program
+    else:
+        state = light(state, batch)             # everyone else's
+    return state
+
+
+def slice_diverged(slice_label, state, batch):
+    if slice_label == "slice-0":
+        return full(state, batch)
+    return state
+
+
+def uniform_dispatch(state, batch, use_light):
+    # config-driven (not rank-identity) selection: no guard token.
+    if use_light:
+        return light(state, batch)
+    return full(state, batch)

@@ -1,0 +1,40 @@
+"""TPU602 fixture: trace-time side effects under jit.
+
+Exact rule ids + lines are pinned in test_lint.py.
+"""
+import logging
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.util.metrics import Counter
+
+logger = logging.getLogger(__name__)
+
+STEPS = Counter("fixture_steps_total", "steps")
+_seen_batches = []
+
+
+@jax.jit
+def decorated_step(state, batch):
+    logger.info("running step %s", state["step"])    # traces once
+    STEPS.inc()                                      # flatlines
+    _seen_batches.append(batch)                      # leaks a tracer
+    return {"step": state["step"] + 1}
+
+
+def _wrapped_update(params, grads):
+    print("applying update")                         # traces once
+    return jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+
+
+apply_update = jax.jit(_wrapped_update, donate_argnums=(0,))
+
+
+@jax.jit
+def clean_step(state):
+    # jax.debug runs at execution time — never a finding.
+    jax.debug.print("step {s}", s=state["step"])
+    local = []
+    local.append(state["step"])                      # local list: fine
+    return {"step": state["step"] + 1, "trace": jnp.stack(local)}

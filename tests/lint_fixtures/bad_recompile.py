@@ -1,0 +1,38 @@
+"""TPU603 fixture: steady-state recompilation hazards.
+
+Exact rule ids + lines are pinned in test_lint.py.
+"""
+import jax
+
+
+def _forward(x, n_layers):
+    return x * n_layers
+
+
+step = jax.jit(_forward, static_argnums=(1,))
+decode = jax.jit(lambda tokens: tokens + 1)
+
+
+def loop_varying_static(xs):
+    out = []
+    for i in range(10):
+        out.append(step(xs, i))                 # static pos 1 varies
+    return out
+
+
+def loop_varying_scalar(xs):
+    acc = xs
+    for i in range(10):
+        acc = decode(acc + i)                   # scalar-derived arg
+    return acc
+
+
+def data_dependent_slice(tokens, lengths):
+    outs = []
+    for n in lengths:
+        outs.append(decode(tokens[:n]))         # new shape per n
+    return outs
+
+
+def unhashable_static(xs):
+    return step(xs, [1, 2, 3])                  # list at static pos

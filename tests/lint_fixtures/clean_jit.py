@@ -1,0 +1,59 @@
+"""Negative fixture for the TPU60x family: every legitimate twin of the
+bad_* patterns. Must produce ZERO findings — pinned in test_lint.py.
+"""
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import ray_tpu.train as train
+
+logger = logging.getLogger(__name__)
+
+
+def _step(state, batch):
+    return state, {"loss": jnp.float32(0.0)}
+
+
+train_step = jax.jit(_step, donate_argnums=(0,))
+
+
+def overlapped_step_loop(state, batches, bucketer, grads):
+    """The canonical PR-10 shape: async issue in compute, tail-join
+    wait() in the collective phase, host access AFTER the span."""
+    for batch in batches:
+        with train.step_span() as sp:
+            with sp.phase("compute"):
+                state, metrics = train_step(state, batch)
+                pending = bucketer.sync_async(grads)
+            with sp.phase("collective"):
+                synced = pending.wait()          # designed join point
+                mean = float(np.sum(synced[0]))  # shielded phase
+        train.report({"loss": float(metrics["loss"])})
+    return state
+
+
+@jax.jit
+def callback_step(state):
+    """Execution-time effects are the sanctioned escape hatch."""
+    jax.debug.print("step {s}", s=state["step"])
+    jax.debug.callback(_log_step, state["step"])
+    return {"step": state["step"] + 1}
+
+
+def _log_step(step):
+    logger.info("step %d done", int(step))       # host side, not traced
+
+
+def host_access_outside_spans(state):
+    """Syncing AFTER the hot loop is the documented pattern."""
+    jax.block_until_ready(state)
+    return float(np.asarray(state["loss"]))
+
+
+def steady_shape_loop(xs):
+    """Same shapes every iteration: nothing varies, nothing recompiles."""
+    acc = xs
+    for batch in (xs, xs):
+        acc = train_step(acc, batch)[0]
+    return acc

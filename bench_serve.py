@@ -1,32 +1,32 @@
-"""Serve load-generator bench: TTFT/latency percentiles + tokens/s.
+"""Serve load-generator bench: TTFT/latency percentiles + tokens/s,
+plus the control-plane legs (replica kill, drain scale-down, autoscale
+cycle).
 
-The pinned-baseline stub for the production-serve tentpole (ROADMAP:
-"Land a load-generator bench (`bench_serve.py`) reporting p50/p99 TTFT
-+ tokens/s"). It drives real HTTP traffic through the proxy against
+The pinned baseline for the production-serve tentpole. It drives real
+HTTP traffic through the proxy against
 
 - an **echo** deployment (the request-path floor: proxy + router +
-  replica round trip), and
-- a **tiny-model LLM** deployment with an SSE token stream (the
-  continuous-batching path: prefill/decode through the engine),
+  replica round trip),
+- a **tiny-model LLM** deployment (2 replicas) with an SSE token stream
+  (the continuous-batching path: prefill/decode through the engine),
 
-measures client-side TTFT/latency percentiles, and cross-checks them
+measures client-side TTFT/latency percentiles, cross-checks them
 against the head's serve SLO ledger (`serve_stats` — the same numbers
-`ray_tpu slo` and /api/serve show), so the bench and the telemetry can
-never drift apart silently. Emits ``BENCH_serve.json``:
+`ray_tpu slo` and /api/serve show), and then exercises the serve
+control plane end to end:
 
-- ``echo``: requests, p50/p99 latency ms, requests/s
-- ``llm_stream``: requests, p50/p99 TTFT ms, p50/p99 latency ms,
-  generated tokens/s
-- ``serve_stats``: the head ledger rows for both deployments
-  (attainment, window percentiles, alert state)
+- ``scale_down_drain``: serve.scale 2→1 mid-load — the drain protocol
+  must finish every in-flight stream and re-route the rest
+  (**dropped must be 0**);
+- ``replica_kill``: SIGKILL one of two replicas mid-load — bounded p99
+  TTFT degradation, typed failures only (**hung must be 0**), recovery
+  back to two replicas;
+- ``autoscale_cycle``: an autoscaled deployment under
+  high → idle → high load — target replicas must track the load with
+  no flapping (direction changes ≤ 3 over the whole cycle).
 
-The serve tentpole PR (KV-aware routing, prefill/decode disaggregation,
-SLO autoscaling) pins its regressions against this format. A replica-
-kill leg (p50/p99 under a mid-bench kill) lands with that PR — the
-drain path it needs is already in place.
-
-Run: ``python bench_serve.py [--requests N] [--concurrency C]``
-(writes BENCH_serve.json next to this file).
+Emits ``BENCH_serve.json``. Run:
+``python bench_serve.py [--requests N] [--concurrency C]``.
 """
 
 from __future__ import annotations
@@ -36,6 +36,7 @@ import concurrent.futures
 import json
 import os
 import socket
+import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -47,6 +48,10 @@ def _percentile(values, q):
     ordered = sorted(values)
     idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
     return ordered[idx]
+
+
+def _ms(v):
+    return round(v * 1e3, 2) if v is not None else None
 
 
 def _unary(port, path, body, timeout=60):
@@ -64,7 +69,11 @@ def _unary(port, path, body, timeout=60):
 
 
 def _sse(port, path, body, timeout=120):
-    """One streamed request; returns (ttft_s, latency_s, n_tokens)."""
+    """One streamed request; returns (status, ttft_s, latency_s,
+    n_tokens) with status ∈ ok | error | hung. "hung" means the client
+    timed out waiting — the exact failure mode the typed control plane
+    exists to remove; "error" is a typed, client-visible failure (SSE
+    error frame, non-200, or dropped connection)."""
     payload = json.dumps(body).encode()
     req = (
         f"POST {path} HTTP/1.1\r\nHost: 127.0.0.1\r\n"
@@ -73,25 +82,105 @@ def _sse(port, path, body, timeout=120):
     ).encode() + payload
     t0 = time.perf_counter()
     ttft = None
-    tokens = 0
     raw = b""
-    with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
-        s.sendall(req)
-        while b"data: [DONE]" not in raw and b"event: error" not in raw:
-            chunk = s.recv(65536)
-            if not chunk:
-                break
-            if ttft is None and b"data: " in raw + chunk:
-                ttft = time.perf_counter() - t0
-            raw += chunk
+    status = "error"
+    try:
+        with socket.create_connection(
+            ("127.0.0.1", port), timeout=timeout
+        ) as s:
+            s.sendall(req)
+            while True:
+                if b"data: [DONE]" in raw:
+                    status = "ok"
+                    break
+                if b"event: error" in raw or b" 503 " in raw[:64] \
+                        or b" 500 " in raw[:64]:
+                    status = "error"
+                    break
+                chunk = s.recv(65536)
+                if not chunk:
+                    status = "error"  # connection dropped mid-stream
+                    break
+                if ttft is None and b"data: " in raw + chunk:
+                    ttft = time.perf_counter() - t0
+                raw += chunk
+    except socket.timeout:
+        status = "hung"
+    except OSError:
+        status = "error"
     latency = time.perf_counter() - t0
+    tokens = 0
     for ln in raw.decode("utf-8", "replace").splitlines():
         if ln.startswith("data: ") and ln != "data: [DONE]":
             try:
                 tokens += len(json.loads(ln[len("data: "):])["tokens"])
             except (ValueError, KeyError, TypeError):
                 pass
-    return ttft if ttft is not None else latency, latency, tokens
+    return status, (ttft if ttft is not None else latency), latency, tokens
+
+
+def _stream_load(port, n, concurrency, max_tokens, mid_hook=None,
+                 hook_at=None):
+    """Drive n SSE requests at the given concurrency; optionally fire
+    ``mid_hook()`` once, right after the ``hook_at``-th request is
+    ISSUED. Returns the list of (status, ttft, latency, tokens) rows
+    and the wall time."""
+    rows = [None] * n
+    issued = 0
+    lock = threading.Lock()
+    fired = threading.Event()
+
+    def one(i):
+        nonlocal issued
+        with lock:
+            issued += 1
+            fire = (
+                mid_hook is not None
+                and hook_at is not None
+                and issued == hook_at
+                and not fired.is_set()
+            )
+        if fire:
+            fired.set()
+            mid_hook()
+        rows[i] = _sse(
+            port, "/llm",
+            {"prompt": f"bench {i}", "max_tokens": max_tokens,
+             "stream": True},
+            timeout=60,
+        )
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(concurrency) as pool:
+        list(pool.map(one, range(n)))
+    return rows, time.perf_counter() - t0
+
+
+def _stream_leg_summary(rows, wall):
+    oks = [r for r in rows if r[0] == "ok"]
+    ttfts = [r[1] for r in oks]
+    lats = [r[2] for r in oks]
+    return {
+        "requests": len(rows),
+        "ok": len(oks),
+        "errors": sum(1 for r in rows if r[0] == "error"),
+        "hung": sum(1 for r in rows if r[0] == "hung"),
+        "ttft_p50_ms": _ms(_percentile(ttfts, 0.5)),
+        "ttft_p99_ms": _ms(_percentile(ttfts, 0.99)),
+        "latency_p50_ms": _ms(_percentile(lats, 0.5)),
+        "latency_p99_ms": _ms(_percentile(lats, 0.99)),
+        "tokens_per_s": round(sum(r[3] for r in oks) / wall, 1),
+    }
+
+
+def _wait_replicas(serve, app, dep, want, timeout_s=60):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        st = serve.status()[app][dep]
+        if st["replicas"] == want and st["draining"] == 0:
+            return time.monotonic() - t0
+        time.sleep(0.25)
+    return None
 
 
 def main() -> int:
@@ -105,9 +194,14 @@ def main() -> int:
 
     import ray_tpu
     from ray_tpu import serve
+    from ray_tpu._private import config as rconfig
+    from ray_tpu._private.test_utils import kill_one_replica
     from ray_tpu.llm.serve_integration import build_llm_deployment
     from ray_tpu.util import state
 
+    # Short down-cooldown so the autoscale leg's full down-up cycle fits
+    # a bench run (exported to env BEFORE the controller spawns).
+    rconfig.set_system_config({"SERVE_AUTOSCALE_DOWN_COOLDOWN_S": 2.0})
     ray_tpu.init(num_cpus=max(8, args.concurrency))
 
     @serve.deployment(max_ongoing_requests=64)
@@ -117,15 +211,18 @@ def main() -> int:
     serve.run(echo.bind(), name="bench_echo", route_prefix="/echo")
     llm = build_llm_deployment(
         "tiny",
+        num_replicas=2,
         engine_kwargs={"max_batch": 8},
         ray_actor_options={"num_cpus": 0.5},
     )
     serve.run(llm, name="bench_llm", route_prefix="/llm", timeout_s=180)
     port = serve.start_http()
 
-    # Warmup (route tables, first compile).
+    # Warmup (route tables, first compile — both replicas).
     _unary(port, "/echo", {"n": -1})
-    _sse(port, "/llm", {"prompt": "warm", "max_tokens": 4, "stream": True})
+    for i in range(4):
+        _sse(port, "/llm",
+             {"prompt": f"warm {i}", "max_tokens": 4, "stream": True})
 
     # ---- echo leg: unary request-path floor under concurrency
     t0 = time.perf_counter()
@@ -136,22 +233,117 @@ def main() -> int:
         ))
     echo_wall = time.perf_counter() - t0
 
-    # ---- llm leg: SSE token streaming through the batcher
+    # ---- llm leg: SSE token streaming through the batcher (baseline)
     n_llm = max(8, args.requests // 4)
-    t0 = time.perf_counter()
-    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as pool:
-        llm_rows = list(pool.map(
-            lambda i: _sse(
-                port, "/llm",
-                {"prompt": f"bench {i}", "max_tokens": args.max_tokens,
-                 "stream": True},
-            ),
-            range(n_llm),
-        ))
-    llm_wall = time.perf_counter() - t0
-    ttfts = [r[0] for r in llm_rows]
-    lats = [r[1] for r in llm_rows]
-    toks = sum(r[2] for r in llm_rows)
+    base_rows, base_wall = _stream_load(
+        port, n_llm, args.concurrency, args.max_tokens
+    )
+    base = _stream_leg_summary(base_rows, base_wall)
+
+    # ---- scale-down drain leg: 2 → 1 mid-load, ZERO drops required
+    drain_rows, drain_wall = _stream_load(
+        port, n_llm, args.concurrency, args.max_tokens,
+        mid_hook=lambda: serve.scale("LLMServer", 1,
+                                     app_name="bench_llm"),
+        hook_at=max(2, n_llm // 4),
+    )
+    drain = _stream_leg_summary(drain_rows, drain_wall)
+    drain["dropped"] = drain["errors"] + drain["hung"]
+    _wait_replicas(serve, "bench_llm", "LLMServer", 1, 60)
+    serve.scale("LLMServer", 2, app_name="bench_llm")
+    recovery = _wait_replicas(serve, "bench_llm", "LLMServer", 2, 120)
+    drain["scaled_back_up_s"] = round(recovery, 2) if recovery else None
+    # Re-warm the fresh replica's compile outside the kill leg's clock.
+    for i in range(4):
+        _sse(port, "/llm",
+             {"prompt": f"rewarm {i}", "max_tokens": 4, "stream": True})
+
+    # ---- replica-kill leg: SIGKILL 1 of 2 mid-load
+    kill_rows, kill_wall = _stream_load(
+        port, n_llm, args.concurrency, args.max_tokens,
+        mid_hook=lambda: kill_one_replica("LLMServer", "bench_llm"),
+        hook_at=max(2, n_llm // 4),
+    )
+    kill = _stream_leg_summary(kill_rows, kill_wall)
+    recovery = _wait_replicas(serve, "bench_llm", "LLMServer", 2, 120)
+    kill["recovered_replicas"] = serve.status()["bench_llm"][
+        "LLMServer"]["replicas"]
+    kill["recovery_s"] = (
+        round(recovery, 2) if recovery is not None else None
+    )
+    kill["ttft_p99_degradation_x"] = (
+        round(kill["ttft_p99_ms"] / base["ttft_p99_ms"], 2)
+        if kill.get("ttft_p99_ms") and base.get("ttft_p99_ms")
+        else None
+    )
+
+    # ---- autoscale leg: high → idle → high, target must track load
+    @serve.deployment(
+        max_ongoing_requests=2,
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3,
+            target_ongoing_requests=2, downscale_delay_s=2.0,
+        ),
+    )
+    def busy(x):
+        time.sleep(0.15)
+        return x
+
+    serve.run(busy.bind(), name="bench_auto")
+    handle = serve.get_app_handle("bench_auto")
+    handle.remote(0).result(timeout=60)
+
+    targets: list[int] = []
+    sampling = threading.Event()
+
+    def sample_targets():
+        while not sampling.is_set():
+            targets.append(
+                serve.status()["bench_auto"]["busy"]["target"]
+            )
+            time.sleep(0.2)
+
+    sampler = threading.Thread(target=sample_targets, daemon=True)
+    sampler.start()
+
+    def burst(seconds):
+        stop = time.monotonic() + seconds
+        while time.monotonic() < stop:
+            futs = [handle.remote(i) for i in range(8)]
+            for f in futs:
+                f.result(timeout=60)
+
+    burst(6.0)          # high load → scale up
+    time.sleep(6.0)     # idle → sustained-low scale down
+    burst(5.0)          # high again → scale back up
+    time.sleep(1.0)
+    sampling.set()
+    sampler.join(timeout=5)
+
+    changes = [
+        (a, b) for a, b in zip(targets, targets[1:]) if a != b
+    ]
+    direction_changes = 0
+    last_dir = 0
+    for a, b in zip(targets, targets[1:]):
+        d = (b > a) - (b < a)
+        if d and d != last_dir:
+            direction_changes += 1
+            last_dir = d
+    autoscale = {
+        "targets": targets,
+        "peak_target": max(targets) if targets else None,
+        "trough_target": min(targets) if targets else None,
+        "transitions": changes,
+        "direction_changes": direction_changes,
+        "flapping": direction_changes > 3,
+        "tracked_load": bool(
+            targets
+            and max(targets) >= 2
+            and min(targets[len(targets) // 3:]) == 1
+            and max(targets[2 * len(targets) // 3:]) >= 2
+        ),
+    }
 
     # Give the 1 Hz span flush a beat, then read the head ledger — the
     # cross-check that keeps client-side and telemetry numbers honest.
@@ -160,7 +352,7 @@ def main() -> int:
     while time.time() < deadline:
         ledger = state.serve_stats().get("deployments", {})
         got = ledger.get("bench_llm/LLMServer", {}).get("requests", 0)
-        if got >= n_llm:
+        if got >= 3 * n_llm:
             break
         time.sleep(0.5)
 
@@ -170,22 +362,19 @@ def main() -> int:
         "concurrency": args.concurrency,
         "echo": {
             "requests": args.requests,
-            "latency_p50_ms": round(_percentile(echo_lat, 0.5) * 1e3, 2),
-            "latency_p99_ms": round(_percentile(echo_lat, 0.99) * 1e3, 2),
+            "latency_p50_ms": _ms(_percentile(echo_lat, 0.5)),
+            "latency_p99_ms": _ms(_percentile(echo_lat, 0.99)),
             "requests_per_s": round(args.requests / echo_wall, 1),
         },
-        "llm_stream": {
-            "requests": n_llm,
-            "max_tokens": args.max_tokens,
-            "ttft_p50_ms": round(_percentile(ttfts, 0.5) * 1e3, 2),
-            "ttft_p99_ms": round(_percentile(ttfts, 0.99) * 1e3, 2),
-            "latency_p50_ms": round(_percentile(lats, 0.5) * 1e3, 2),
-            "latency_p99_ms": round(_percentile(lats, 0.99) * 1e3, 2),
-            "tokens_per_s": round(toks / llm_wall, 1),
-        },
+        "llm_stream": {"max_tokens": args.max_tokens, **base},
+        "scale_down_drain": drain,
+        "replica_kill": kill,
+        "autoscale_cycle": autoscale,
         "serve_stats": {
             k: v for k, v in ledger.items()
-            if k.startswith(("bench_echo/", "bench_llm/"))
+            if k.startswith(
+                ("bench_echo/", "bench_llm/", "bench_auto/")
+            )
         },
     }
     with open(args.output, "w") as f:
@@ -193,9 +382,25 @@ def main() -> int:
     print(json.dumps(out, indent=1))
     print(f"wrote {args.output}")
 
+    failures = []
+    if drain["dropped"] != 0:
+        failures.append(
+            f"scale_down_drain dropped {drain['dropped']} requests"
+        )
+    if kill["hung"] != 0:
+        failures.append(f"replica_kill hung {kill['hung']} requests")
+    if kill["recovered_replicas"] != 2:
+        failures.append("replica_kill did not recover to 2 replicas")
+    if autoscale["flapping"]:
+        failures.append("autoscale target flapped")
+    if not autoscale["tracked_load"]:
+        failures.append("autoscale target did not track load")
+    for f in failures:
+        print(f"FAIL: {f}")
+
     serve.shutdown()
     ray_tpu.shutdown()
-    return 0
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -251,6 +251,83 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "SERVE_SLO_WINDOW_S": (float, 60.0, "sliding window for serve SLO "
                                         "attainment and the burn-rate "
                                         "alert"),
+    # --- serve control plane (autoscaling / drain / self-healing)
+    "SERVE_AUTOSCALE": (bool, True, "controller policy loop consumes the "
+                                    "serve signal plane (handle demand + "
+                                    "head SLO ledger) and adjusts replica "
+                                    "counts for deployments with an "
+                                    "autoscaling_config; 0 freezes every "
+                                    "target at its configured value"),
+    "SERVE_AUTOSCALE_INTERVAL_S": (float, 1.0, "cadence of the "
+                                               "controller's head serve-"
+                                               "ledger poll (attainment "
+                                               "+ request rate feeding "
+                                               "scale decisions)"),
+    "SERVE_AUTOSCALE_UP_COOLDOWN_S": (float, 0.0, "minimum seconds "
+                                                  "between scale-UPs of "
+                                                  "one deployment "
+                                                  "(per-deployment "
+                                                  "upscale_delay_s "
+                                                  "raises it)"),
+    "SERVE_AUTOSCALE_DOWN_COOLDOWN_S": (float, 2.0, "desired must stay "
+                                                    "below target for "
+                                                    "this long before a "
+                                                    "scale-down (per-"
+                                                    "deployment "
+                                                    "downscale_delay_s "
+                                                    "raises it); the "
+                                                    "anti-flap window"),
+    "SERVE_AUTOSCALE_HYSTERESIS": (float, 0.1, "dead-band fraction: a "
+                                               "desired count within "
+                                               "hysteresis*target of "
+                                               "the current target is "
+                                               "treated as equal, so "
+                                               "demand noise cannot "
+                                               "flap large "
+                                               "deployments"),
+    "SERVE_AUTOSCALE_SLO_BOOST": (bool, True, "scale one replica above "
+                                              "the demand-derived count "
+                                              "while the head reports "
+                                              "the deployment's SLO "
+                                              "alert ON (bounded by "
+                                              "max_replicas)"),
+    "SERVE_DRAIN_TIMEOUT_S": (float, 30.0, "scale-down drain bound: a "
+                                           "retiring replica stops "
+                                           "accepting, finishes in-"
+                                           "flight requests up to this "
+                                           "long, then is killed "
+                                           "(DeploymentConfig."
+                                           "drain_timeout_s overrides "
+                                           "per deployment)"),
+    "SERVE_RETRY_MAX": (int, 3, "router re-dispatch cap after typed "
+                                "replica deaths for one request "
+                                "(at-least-once; non-idempotent callers "
+                                "opt out via retry_on_failure=False)"),
+    "SERVE_RETRY_BACKOFF_S": (float, 0.05, "base of the router's "
+                                           "exponential per-retry "
+                                           "backoff after a replica "
+                                           "death (doubles per retry, "
+                                           "capped at 1s)"),
+    "SERVE_BREAKER_FAILURES": (int, 3, "consecutive typed failures that "
+                                       "OPEN a replica's circuit "
+                                       "breaker (the router stops "
+                                       "picking it)"),
+    "SERVE_BREAKER_RESET_S": (float, 2.0, "seconds an open breaker "
+                                          "waits before HALF-OPEN (one "
+                                          "probe request; success "
+                                          "closes, failure re-opens)"),
+    "SERVE_UNAVAILABLE_TIMEOUT_S": (float, 5.0, "how long the router "
+                                                "waits with NO routable "
+                                                "replica (none known, "
+                                                "or all dead/draining/"
+                                                "breaker-open) before "
+                                                "raising the typed "
+                                                "NoReplicaAvailableError"
+                                                " the proxy maps to 503 "
+                                                "+ Retry-After; "
+                                                "saturated-but-alive "
+                                                "replicas keep queueing "
+                                                "instead"),
     "LLM_PREFILL_DELAY": (float, 0.0, "chaos spec: sleep this long "
                                       "inside every LLM engine prefill "
                                       "admission (deterministic TTFT "

@@ -218,6 +218,56 @@ class FakePreemptionSource:
         return ("synthetic-preemption", config.get("DRAIN_DEADLINE_S"))
 
 
+def kill_one_replica(
+    deployment_name: str, app_name: str = "default",
+    index: int = 0,
+) -> str:
+    """SIGKILL the worker process hosting one serve replica — the
+    deterministic replica-death chaos the serve control-plane tests and
+    bench_serve's kill leg use (the serving twin of sigkill_pid's
+    collective-rank kill). Picks the ``index``-th replica of the
+    deployment's current routed list, reads the hosting worker's pid
+    from its own get_stats, and SIGKILLs it. Returns the killed
+    replica's actor id. Refuses to kill the calling process (inproc
+    worker mode would take the test down with the replica)."""
+    import os
+
+    import ray_tpu
+    from ray_tpu.runtime.core_worker import ActorSubmitTarget
+    from ray_tpu.serve.handle import CONTROLLER_NAME
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    _version, replicas = ray_tpu.get(
+        controller.get_replicas.remote(deployment_name, app_name)
+    )
+    if not replicas:
+        raise RuntimeError(
+            f"no replicas of {app_name}/{deployment_name} to kill"
+        )
+    actor_id, addr, _max_ongoing = replicas[index % len(replicas)]
+
+    import ray_tpu.api as api
+
+    rt = api._runtime
+    refs = rt.run(
+        rt.core.submit_task(
+            "get_stats", (), {}, num_returns=1,
+            actor=ActorSubmitTarget(actor_id, addr),
+        )
+    )
+    stats = rt.run(rt.core.get(refs, timeout=10))[0]
+    pid = stats.get("pid")
+    if not pid:
+        raise RuntimeError("replica reported no pid (old replica code?)")
+    if pid == os.getpid():
+        raise RuntimeError(
+            "refusing to SIGKILL the calling process (inproc worker "
+            "mode); run replica-kill chaos with subprocess workers"
+        )
+    sigkill_pid(int(pid))
+    return actor_id
+
+
 def sigkill_pid(pid: int) -> None:
     """SIGKILL one worker process — the targeted mid-op member killer
     the collective chaos tests use (WorkerKillerActor kills *random*

@@ -395,6 +395,21 @@ class Autoscaler:
                 self.provider.terminate_node(pid)
                 del self._tracked[pid]
 
+        # Serve replica deficits (controller autoscale reports relayed
+        # through cluster_status): a deployment below target means its
+        # replica leases are or will be in the demand list above — the
+        # deficit view ties the two control loops together for the
+        # operator (`ray_tpu status`, last_status asserts in tests).
+        serve_deficits = {
+            key: {
+                "target": rec.get("target", 0),
+                "replicas": rec.get("replicas", 0),
+                "missing": rec.get("target", 0) - rec.get("replicas", 0),
+            }
+            for key, rec in (status.get("serve_autoscale") or {}).items()
+            if rec.get("target", 0) > rec.get("replicas", 0)
+        }
+
         self.last_status = {
             "demand": demand,
             "added": to_add,
@@ -403,5 +418,6 @@ class Autoscaler:
             },
             "draining": {nid: dict(d) for nid, d in draining.items()},
             "chronic_stragglers": chronic,
+            "serve_deficits": serve_deficits,
         }
         return self.last_status

@@ -82,6 +82,12 @@ class HeadService:
         # (ray_tpu_serve_slo_alert) with an OFF→ON warn log. Keyed
         # "app/deployment".
         self.serve_runs: dict[str, dict] = {}
+        # Controller autoscale reports ("app/deployment" → target/
+        # desired/replicas/draining/reason/ts): the decisions the serve
+        # control loop derived from this ledger, surfaced back through
+        # serve_stats and the head-owned target-replicas gauge so they
+        # survive controller restarts.
+        self.serve_autoscale: dict[str, dict] = {}
         # Device-memory ledger, folded from "mem:sample" SPAN events
         # the same way the goodput/SLO ledgers fold theirs: per-node
         # current/peak used bytes, capacity, headroom alert state (with
@@ -417,6 +423,13 @@ class HeadService:
             },
             "slices": {
                 sid: dict(rec) for sid, rec in self.slices.items()
+            },
+            # Serve control-plane state (controller autoscale reports):
+            # rides the same poll so the cluster autoscaler sees replica
+            # deficits next to the node demand that will absorb them.
+            "serve_autoscale": {
+                key: dict(rec)
+                for key, rec in self.serve_autoscale.items()
             },
             "nodes": {
                 nid: {
@@ -2227,6 +2240,10 @@ class HeadService:
                 # overlapped remainder, and the step-second denominator.
                 "comm_exposed_s": 0.0,
                 "comm_overlapped_s": 0.0,
+                # Host-sync exposure (PR 13's sanitizer tracer): wall
+                # seconds of block_until_ready/device_get inside the
+                # compute phase — the host-side twin of comm_exposed_s.
+                "host_sync_exposed_s": 0.0,
                 "step_s": 0.0,
                 "first_ts": float(ev.get("ts") or 0.0),
                 "last_end_ts": None,
@@ -2281,7 +2298,9 @@ class HeadService:
         rec["degraded_s"] += degraded
         rec["stall_s"] += in_step_lost
         rec["step_s"] += dur
-        for key in ("comm_exposed_s", "comm_overlapped_s"):
+        for key in (
+            "comm_exposed_s", "comm_overlapped_s", "host_sync_exposed_s",
+        ):
             try:
                 rec[key] += max(0.0, float(ev.get(key) or 0.0))
             except (TypeError, ValueError):
@@ -2338,6 +2357,11 @@ class HeadService:
             "comm_overlapped_s": rec.get("comm_overlapped_s", 0.0),
             "comm_exposed_ratio": (
                 exposed / step_s if step_s > 0 else 0.0
+            ),
+            "host_sync_exposed_s": rec.get("host_sync_exposed_s", 0.0),
+            "host_sync_exposed_ratio": (
+                rec.get("host_sync_exposed_s", 0.0) / step_s
+                if step_s > 0 else 0.0
             ),
             "steps": rec["steps"],
             "attempts": rec["attempts_seen"],
@@ -2450,17 +2474,25 @@ class HeadService:
         idx = min(len(ordered) - 1, int(q * (len(ordered) - 1) + 0.5))
         return ordered[idx]
 
-    def _serve_deployment_public(self, rec: dict) -> dict:
+    def _serve_deployment_public(self, key: str, rec: dict) -> dict:
+        from ray_tpu._private import config
+
         ttfts = [w[2] for w in rec["window"]]
         lats = [w[1] for w in rec["window"]]
         attained = sum(1 for w in rec["window"] if w[3])
         n = len(rec["window"])
+        window_s = config.get("SERVE_SLO_WINDOW_S")
         return {
             "requests": rec["requests"],
             "errors": rec["errors"],
             "streamed": rec["streamed"],
             "items": rec["items"],
             "window_requests": n,
+            # The autoscaler's rate signal: requests finishing per
+            # second over the SLO window.
+            "request_rate_per_s": (
+                n / window_s if window_s > 0 else 0.0
+            ),
             "ttft_p50_s": self._percentile(ttfts, 0.50),
             "ttft_p99_s": self._percentile(ttfts, 0.99),
             "latency_p50_s": self._percentile(lats, 0.50),
@@ -2469,17 +2501,69 @@ class HeadService:
             "alert": rec["alert"],
             "first_ts": rec["first_ts"],
             "last_ts": rec["last_ts"],
+            # The control loop's last word on this deployment (None
+            # until a controller reports).
+            "autoscale": self.serve_autoscale.get(key),
         }
 
     async def _on_serve_stats(self, conn):
         """Per-deployment serve SLO rollup (dashboard /api/serve, agent
-        passthrough, `ray_tpu slo`)."""
-        return {
-            "deployments": {
-                key: self._serve_deployment_public(rec)
-                for key, rec in self.serve_runs.items()
-            }
+        passthrough, `ray_tpu slo`) — the ledger-read API the serve
+        control loop polls for attainment/alert/request-rate, plus the
+        autoscale decisions it reported back."""
+        out = {
+            key: self._serve_deployment_public(key, rec)
+            for key, rec in self.serve_runs.items()
         }
+        # Deployments that reported autoscale state but have no ledger
+        # rows yet (no proxy traffic since boot) still surface their
+        # targets — schema-complete, so /api/serve consumers see one
+        # row shape.
+        for key, asc in self.serve_autoscale.items():
+            if key not in out:
+                out[key] = {
+                    "requests": 0, "errors": 0, "streamed": 0,
+                    "items": 0, "window_requests": 0,
+                    "request_rate_per_s": 0.0,
+                    "ttft_p50_s": None, "ttft_p99_s": None,
+                    "latency_p50_s": None, "latency_p99_s": None,
+                    "attainment": 1.0, "alert": False,
+                    "first_ts": None, "last_ts": None,
+                    "autoscale": asc,
+                }
+        return {"deployments": out}
+
+    async def _on_serve_autoscale_report(
+        self,
+        conn,
+        app: str,
+        deployment: str,
+        target: int,
+        replicas: int = 0,
+        draining: int = 0,
+        desired: "int | None" = None,
+        reason: "str | None" = None,
+    ):
+        """Controller → head: one deployment's current autoscale state
+        (target, live/draining replica counts, last decision). Folded
+        into serve_stats and the ray_tpu_serve_target_replicas gauge."""
+        key = f"{app or 'default'}/{deployment}"
+        if key not in self.serve_autoscale and \
+                len(self.serve_autoscale) >= 200:
+            oldest = min(
+                self.serve_autoscale,
+                key=lambda k: self.serve_autoscale[k]["ts"],
+            )
+            del self.serve_autoscale[oldest]
+        self.serve_autoscale[key] = {
+            "target": int(target),
+            "replicas": int(replicas),
+            "draining": int(draining),
+            "desired": desired if desired is None else int(desired),
+            "reason": reason,
+            "ts": time.time(),
+        }
+        return {"ok": True}
 
     # --------------------------------------------------- memory ledger
     def _mem_event(self, ev: dict) -> None:
@@ -2634,18 +2718,36 @@ class HeadService:
         """Head-owned serve SLO gauges in worker-snapshot format (the
         serving twin of _train_metrics_snapshot): attainment + alert per
         deployment, surviving the proxies they were measured at."""
-        if not self.serve_runs:
+        if not self.serve_runs and not self.serve_autoscale:
             return None
         from ray_tpu.util.metrics import escape_label_value as _esc
 
         attain: dict[str, float] = {}
         alert: dict[str, float] = {}
         for key, rec in self.serve_runs.items():
-            pub = self._serve_deployment_public(rec)
+            pub = self._serve_deployment_public(key, rec)
             tag = f'deployment="{_esc(key)}"'
             attain[tag] = round(pub["attainment"], 6)
             alert[tag] = 1.0 if rec["alert"] else 0.0
+        target: dict[str, float] = {}
+        for key, asc in self.serve_autoscale.items():
+            target[f'deployment="{_esc(key)}"'] = float(asc["target"])
+        out_extra = (
+            {
+                "ray_tpu_serve_target_replicas": {
+                    "kind": "gauge",
+                    "description": "controller-reported target replica "
+                                   "count per deployment (the "
+                                   "autoscaler's output)",
+                    "series": target,
+                    "boundaries": None,
+                },
+            }
+            if target
+            else {}
+        )
         return {
+            **out_extra,
             "ray_tpu_serve_slo_attainment": {
                 "kind": "gauge",
                 "description": "fraction of requests meeting their "

@@ -165,6 +165,12 @@ def cmd_goodput(args) -> int:
                 f"overlapped={j.get('comm_overlapped_s', 0.0):.2f}s  "
                 f"exposed_ratio={j.get('comm_exposed_ratio', 0.0):.3f}"
             )
+        if j.get("host_sync_exposed_s"):
+            print(
+                f"  host_sync: exposed="
+                f"{j['host_sync_exposed_s']:.2f}s  exposed_ratio="
+                f"{j.get('host_sync_exposed_ratio', 0.0):.3f}"
+            )
         if j.get("phase_s"):
             phases = "  ".join(
                 f"{k}={v:.2f}s" for k, v in sorted(j["phase_s"].items())
@@ -191,19 +197,31 @@ def print_slo(deployments: dict, as_json: bool = False) -> int:
     for name, d in sorted(deployments.items()):
         alert = "  ALERT" if d.get("alert") else ""
         print(
-            f"{name}: requests={d['requests']}  errors={d['errors']}  "
-            f"attainment={d['attainment']:.3f}{alert}"
+            f"{name}: requests={d.get('requests', 0)}  "
+            f"errors={d.get('errors', 0)}  "
+            f"attainment={d.get('attainment', 1.0):.3f}{alert}"
         )
-        print(
-            f"  ttft p50={_fmt_ms(d.get('ttft_p50_s'))} "
-            f"p99={_fmt_ms(d.get('ttft_p99_s'))}  "
-            f"latency p50={_fmt_ms(d.get('latency_p50_s'))} "
-            f"p99={_fmt_ms(d.get('latency_p99_s'))}  "
-            f"window={d.get('window_requests', 0)} reqs"
-        )
+        if d.get("window_requests") is not None:
+            print(
+                f"  ttft p50={_fmt_ms(d.get('ttft_p50_s'))} "
+                f"p99={_fmt_ms(d.get('ttft_p99_s'))}  "
+                f"latency p50={_fmt_ms(d.get('latency_p50_s'))} "
+                f"p99={_fmt_ms(d.get('latency_p99_s'))}  "
+                f"window={d.get('window_requests', 0)} reqs "
+                f"({d.get('request_rate_per_s', 0.0):.1f}/s)"
+            )
         if d.get("streamed"):
             print(
                 f"  streamed={d['streamed']}  items={d.get('items', 0)}"
+            )
+        asc = d.get("autoscale")
+        if asc:
+            print(
+                f"  autoscale: target={asc.get('target')}  "
+                f"replicas={asc.get('replicas')}  "
+                f"draining={asc.get('draining')}  "
+                f"desired={asc.get('desired')}  "
+                f"reason={asc.get('reason')}"
             )
     return 0
 

@@ -27,6 +27,7 @@ from ray_tpu.serve.api import (
     get_app_handle,
     get_deployment_handle,
     run,
+    scale,
     shutdown,
     start_grpc,
     start_http,
@@ -57,6 +58,7 @@ __all__ = [
     "grpc_stream",
     "rpc_request",
     "run",
+    "scale",
     "shutdown",
     "start_grpc",
     "start_http",

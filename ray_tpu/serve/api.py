@@ -152,6 +152,23 @@ def status() -> dict:
     return ray_tpu.get(controller.get_status.remote())
 
 
+def scale(
+    deployment_name: str, target: int, app_name: str = "default"
+) -> int:
+    """Set a deployment's target replica count directly (operator/bench
+    entry point). Scale-down retires victims through the drain protocol
+    — they stop accepting, finish in-flight requests, then exit — so
+    this never drops a request. For autoscaled deployments the value is
+    clamped to [min_replicas, max_replicas] and the policy loop keeps
+    adjusting from it. Returns the applied target."""
+    controller = _get_controller()
+    if controller is None:
+        raise RuntimeError("serve is not running")
+    return ray_tpu.get(
+        controller.update_target.remote(app_name, deployment_name, target)
+    )
+
+
 def delete(name: str):
     controller = _get_controller()
     if controller is not None:

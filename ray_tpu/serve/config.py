@@ -31,6 +31,11 @@ class DeploymentConfig:
     # (the gap between yields), not an end-to-end cap. Reference:
     # Serve's request_timeout_s in HTTPOptions (serve/config.py).
     request_timeout_s: float | None = None
+    # Scale-down drain bound for this deployment's replicas: a retiring
+    # replica stops accepting, finishes in-flight requests up to this
+    # long, then is killed (None → SERVE_DRAIN_TIMEOUT_S). Reference:
+    # Serve's graceful_shutdown_timeout_s (serve/config.py).
+    drain_timeout_s: float | None = None
     autoscaling_config: AutoscalingConfig | None = None
     ray_actor_options: dict = field(default_factory=dict)
     user_config: dict | None = None
@@ -40,6 +45,7 @@ class DeploymentConfig:
             "num_replicas": self.num_replicas,
             "max_ongoing_requests": self.max_ongoing_requests,
             "request_timeout_s": self.request_timeout_s,
+            "drain_timeout_s": self.drain_timeout_s,
             "autoscaling": None
             if self.autoscaling_config is None
             else vars(self.autoscaling_config),

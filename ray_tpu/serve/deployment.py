@@ -26,6 +26,7 @@ class Deployment:
         num_replicas: int | None = None,
         max_ongoing_requests: int | None = None,
         request_timeout_s: float | None = None,
+        drain_timeout_s: float | None = None,
         autoscaling_config: AutoscalingConfig | dict | None = None,
         ray_actor_options: dict | None = None,
         user_config: dict | None = None,
@@ -39,6 +40,10 @@ class Deployment:
             if request_timeout_s <= 0:
                 raise ValueError("request_timeout_s must be positive")
             cfg.request_timeout_s = request_timeout_s
+        if drain_timeout_s is not None:
+            if drain_timeout_s < 0:
+                raise ValueError("drain_timeout_s must be >= 0")
+            cfg.drain_timeout_s = drain_timeout_s
         if autoscaling_config is not None:
             if isinstance(autoscaling_config, dict):
                 autoscaling_config = AutoscalingConfig(**autoscaling_config)

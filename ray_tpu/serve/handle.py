@@ -38,6 +38,61 @@ class _ReplicaTarget:
     max_ongoing: int
 
 
+class _Breaker:
+    """Per-replica circuit breaker (router-side). CLOSED routes
+    normally; SERVE_BREAKER_FAILURES consecutive typed failures OPEN it
+    (the replica is skipped by ``_pick``); after SERVE_BREAKER_RESET_S
+    it goes HALF-OPEN and admits exactly one probe request — success
+    CLOSES it, failure re-OPENS it. All state lives on the runtime
+    event loop, like the rest of the router."""
+
+    __slots__ = ("failures", "opened_at", "probing")
+
+    def __init__(self):
+        self.failures = 0
+        self.opened_at: float | None = None
+        self.probing = False
+
+    def state(self, now: float, reset_s: float) -> str:
+        if self.opened_at is None:
+            return "closed"
+        if now - self.opened_at >= reset_s:
+            return "half_open"
+        return "open"
+
+    def allow(self, now: float, reset_s: float) -> bool:
+        """May a request be dispatched to this replica right now?
+        Half-open admits a single in-flight probe."""
+        st = self.state(now, reset_s)
+        if st == "closed":
+            return True
+        if st == "open":
+            return False
+        if self.probing:
+            return False
+        self.probing = True
+        return True
+
+    def routable(self, now: float, reset_s: float) -> bool:
+        """Pure check (no probe consumed) for the router's
+        no-replica-available clock: an open breaker that has not yet
+        reached half-open is the only unroutable state."""
+        return self.state(now, reset_s) != "open"
+
+    def record_failure(self, now: float, threshold: int) -> None:
+        self.failures += 1
+        self.probing = False
+        if self.opened_at is not None:
+            self.opened_at = now  # half-open probe failed: re-open
+        elif self.failures >= threshold:
+            self.opened_at = now  # closed → open
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.opened_at = None
+        self.probing = False
+
+
 class DeploymentResponse:
     """Future-like result of a handle call (reference: handle.py
     DeploymentResponse). ``result()`` from sync code; ``await`` from
@@ -120,6 +175,22 @@ class DeploymentStreamResponse:
             asyncio.ensure_future(self._agen.aclose())
 
 
+def _is_draining_refusal(e: Exception) -> bool:
+    """Did a replica refuse the request because it is draining? The
+    typed error arrives either directly or wrapped in RayTaskError
+    (with the original in .cause, or stringified when the cause could
+    not travel)."""
+    from ray_tpu.exceptions import RayTaskError, ReplicaDrainingError
+
+    if isinstance(e, ReplicaDrainingError):
+        return True
+    if isinstance(e, RayTaskError):
+        return isinstance(
+            getattr(e, "cause", None), ReplicaDrainingError
+        ) or "ReplicaDrainingError" in str(e)
+    return False
+
+
 class _Router:
     def __init__(self, deployment_name: str, app_name: str):
         self.deployment_name = deployment_name
@@ -141,6 +212,18 @@ class _Router:
         # model_id → (version, replicas ordered by affinity hash); the
         # order only changes when the replica set does.
         self._affinity: dict[str, tuple[int, list[_ReplicaTarget]]] = {}
+        # actor_id → circuit breaker. Keyed by actor id (not list
+        # position) so a dead replica the controller still lists for a
+        # few missed polls stays skipped across refreshes.
+        self._breakers: dict[str, _Breaker] = {}
+        # Serializes the controller get_replicas RPC: N queued requests
+        # forcing refreshes at once must produce ONE poll, not N
+        # (instrumented under RAY_TPU_SANITIZE=1).
+        from ray_tpu._private import sanitize
+
+        self._refresh_lock = sanitize.maybe_async_lock(
+            "serve.handle.refresh"
+        )
 
     def _demand(self) -> int:
         return self._queued + sum(self._inflight.values())
@@ -224,32 +307,175 @@ class _Router:
         # Forced refreshes (saturation, replica death) are still rate
         # limited so N queued requests don't hammer the controller with
         # N/20ms get_replicas calls exactly when the system is loaded.
-        now = time.monotonic()
         min_interval = 0.1 if force else _REFRESH_S
-        if now - self._last_refresh < min_interval:
+        if time.monotonic() - self._last_refresh < min_interval:
             return
-        controller = await self._resolve_controller()
-        version, replicas = await self._call_actor(
-            controller, "get_replicas", self.deployment_name, self.app_name
+        async with self._refresh_lock:
+            # Re-check under the lock: the poll a concurrent waiter just
+            # finished IS this waiter's refresh.
+            if time.monotonic() - self._last_refresh < min_interval:
+                return
+            controller = await self._resolve_controller()
+            version, replicas = await self._call_actor(
+                controller, "get_replicas", self.deployment_name,
+                self.app_name,
+            )
+            self._last_refresh = time.monotonic()
+            if version != self._version:
+                self._version = version
+                self._replicas = [_ReplicaTarget(*r) for r in replicas]
+                self._inflight = {
+                    r.actor_id: self._inflight.get(r.actor_id, 0)
+                    for r in self._replicas
+                }
+                # Orderings cached against the old replica set are dead
+                # weight now; dropping the whole map also bounds its
+                # growth across high-cardinality model ids.
+                self._affinity.clear()
+                # Breakers for replicas the controller no longer lists
+                # are dead weight too — but entries for still-listed
+                # replicas survive (a dead replica stays listed for a
+                # few missed polls; its open breaker is what keeps it
+                # skipped meanwhile).
+                listed = {r.actor_id for r in self._replicas}
+                for aid in list(self._breakers):
+                    if aid not in listed:
+                        del self._breakers[aid]
+
+    def _breaker_allows(self, actor_id: str, now: float,
+                        reset_s: float) -> bool:
+        """Pure pick-eligibility: open → no; half-open with a probe
+        already in flight → no. The probe itself is consumed only for
+        the replica ``_pick`` actually returns (``_consume_probe``)."""
+        br = self._breakers.get(actor_id)
+        if br is None:
+            return True
+        st = br.state(now, reset_s)
+        return st == "closed" or (st == "half_open" and not br.probing)
+
+    def _consume_probe(self, replica: _ReplicaTarget, now: float,
+                       reset_s: float) -> _ReplicaTarget:
+        br = self._breakers.get(replica.actor_id)
+        if br is not None:
+            br.allow(now, reset_s)  # half-open: claims the single probe
+        return replica
+
+    def _has_routable(self) -> bool:
+        """Any replica a request could EVER land on right now —
+        saturation (in-flight at cap) still counts as routable (the
+        request queues), only dead/open-breaker replicas don't. The
+        guard deciding whether a slot wait is queueing or an outage."""
+        if not self._replicas:
+            return False
+        from ray_tpu._private import config
+
+        now = time.monotonic()
+        reset_s = config.get("SERVE_BREAKER_RESET_S")
+        return any(
+            br is None or br.routable(now, reset_s)
+            for br in (
+                self._breakers.get(r.actor_id) for r in self._replicas
+            )
         )
-        self._last_refresh = time.monotonic()
-        if version != self._version:
-            self._version = version
-            self._replicas = [_ReplicaTarget(*r) for r in replicas]
-            self._inflight = {
-                r.actor_id: self._inflight.get(r.actor_id, 0)
-                for r in self._replicas
-            }
-            # Orderings cached against the old replica set are dead
-            # weight now; dropping the whole map also bounds its growth
-            # across high-cardinality model ids.
-            self._affinity.clear()
+
+    def _record_replica_failure(self, actor_id: str):
+        from ray_tpu._private import config
+
+        br = self._breakers.setdefault(actor_id, _Breaker())
+        br.record_failure(
+            time.monotonic(), config.get("SERVE_BREAKER_FAILURES")
+        )
+        self._update_breaker_gauge()
+
+    def _record_replica_success(self, actor_id: str):
+        br = self._breakers.get(actor_id)
+        if br is not None and (br.opened_at is not None or br.failures):
+            br.record_success()
+            self._update_breaker_gauge()
+
+    def _update_breaker_gauge(self):
+        from ray_tpu.serve import telemetry as stel
+
+        if not stel.enabled():
+            return
+        stel.BREAKER_OPEN.set(
+            sum(
+                1 for br in self._breakers.values()
+                if br.opened_at is not None
+            ),
+            tags={"app": self.app_name,
+                  "deployment": self.deployment_name},
+        )
+
+    @staticmethod
+    def _is_replica_death(e: Exception) -> bool:
+        """Typed replica-death detection: the actor's worker is gone or
+        its connection dropped mid-call. User exceptions (RayTaskError)
+        are NOT deaths — they propagate to the caller untouched."""
+        from ray_tpu.exceptions import ActorDiedError
+        from ray_tpu._private import rpc
+
+        return isinstance(
+            e, (ActorDiedError, rpc.ConnectionLost, rpc.RpcError)
+        )
+
+    @staticmethod
+    def _retry_max() -> int:
+        from ray_tpu._private import config
+
+        return config.get("SERVE_RETRY_MAX")
+
+    @staticmethod
+    def _retry_backoff(attempt: int) -> float:
+        """Exponential per-retry backoff, capped at 1s: the surviving
+        replicas are absorbing the dead one's load exactly now — a
+        stampede of instant retries is the last thing they need."""
+        from ray_tpu._private import config
+
+        base = config.get("SERVE_RETRY_BACKOFF_S")
+        return min(1.0, base * (2 ** max(0, attempt - 1)))
+
+    def _count_retry(self, reason: str):
+        from ray_tpu.serve import telemetry as stel
+
+        if stel.enabled():
+            stel.RETRIES.inc(
+                tags={"app": self.app_name,
+                      "deployment": self.deployment_name,
+                      "reason": reason},
+            )
+
+    def _count_death(self):
+        from ray_tpu.serve import telemetry as stel
+
+        if stel.enabled():
+            stel.REPLICA_DEATHS.inc(
+                tags={"app": self.app_name,
+                      "deployment": self.deployment_name},
+            )
+
+    def _drop_replica(self, actor_id: str):
+        """Forget a replica ahead of the controller (typed death or
+        draining refusal observed first-hand): stop picking it NOW; the
+        next version bump reconciles the authoritative list."""
+        self._replicas = [
+            r for r in self._replicas if r.actor_id != actor_id
+        ]
+        # The controller may not bump the version for several missed
+        # polls; cached affinity orderings still point at the dead
+        # replica until then.
+        self._affinity.clear()
 
     def _pick(self, model_id: str) -> _ReplicaTarget | None:
+        from ray_tpu._private import config
+
+        now = time.monotonic()
+        reset_s = config.get("SERVE_BREAKER_RESET_S")
         avail = [
             r
             for r in self._replicas
             if self._inflight.get(r.actor_id, 0) < r.max_ongoing
+            and self._breaker_allows(r.actor_id, now, reset_s)
         ]
         if not avail:
             return None
@@ -275,17 +501,19 @@ class _Router:
             else:
                 ordered = cached[1]
             for r in ordered:
-                if self._inflight.get(r.actor_id, 0) < r.max_ongoing:
-                    return r
+                if self._inflight.get(r.actor_id, 0) < r.max_ongoing \
+                        and self._breaker_allows(r.actor_id, now, reset_s):
+                    return self._consume_probe(r, now, reset_s)
             return None
         if len(avail) == 1:
-            return avail[0]
+            return self._consume_probe(avail[0], now, reset_s)
         a, b = random.sample(avail, 2)
-        return (
+        return self._consume_probe(
             a
             if self._inflight.get(a.actor_id, 0)
             <= self._inflight.get(b.actor_id, 0)
-            else b
+            else b,
+            now, reset_s,
         )
 
     def _request_ctx(self, model_id: str) -> dict:
@@ -326,13 +554,42 @@ class _Router:
         return replica
 
     async def _acquire_replica(self, model_id: str) -> _ReplicaTarget:
+        """Wait for a replica slot. Saturated-but-alive replicas queue
+        indefinitely (backpressure, reported as autoscaling demand);
+        NO routable replica at all — none known, or every one dead,
+        draining, or breaker-open — for SERVE_UNAVAILABLE_TIMEOUT_S
+        raises the typed NoReplicaAvailableError instead of hanging
+        (the proxy's 503 + Retry-After)."""
+        from ray_tpu._private import config
+
         waiting = False
+        unroutable_since: float | None = None
         try:
             while True:
                 await self._refresh()
                 replica = self._pick(model_id)
                 if replica is not None:
                     return replica
+                if self._has_routable():
+                    unroutable_since = None
+                else:
+                    now = time.monotonic()
+                    if unroutable_since is None:
+                        unroutable_since = now
+                    bound = config.get("SERVE_UNAVAILABLE_TIMEOUT_S")
+                    if now - unroutable_since >= bound:
+                        from ray_tpu.exceptions import (
+                            NoReplicaAvailableError,
+                        )
+
+                        raise NoReplicaAvailableError(
+                            self.deployment_name,
+                            self.app_name,
+                            retry_after_s=max(
+                                1.0,
+                                config.get("SERVE_BREAKER_RESET_S"),
+                            ),
+                        )
                 if not waiting:
                     waiting = True
                     self._queued += 1
@@ -361,13 +618,14 @@ class _Router:
         ctx = self._request_ctx(model_id)
         self._ensure_reporter()
         deaths = 0
+        drain_hops = 0
         while True:
             replica = await self._acquire_replica_traced(model_id)
             self._inflight[replica.actor_id] = (
                 self._inflight.get(replica.actor_id, 0) + 1
             )
             try:
-                return await self._call_actor(
+                result = await self._call_actor(
                     ActorSubmitTarget(replica.actor_id, replica.addr),
                     "handle_request",
                     method_name,
@@ -375,33 +633,39 @@ class _Router:
                     kwargs,
                     ctx,
                 )
+                self._record_replica_success(replica.actor_id)
+                return result
             except Exception as e:  # noqa: BLE001
-                from ray_tpu.exceptions import ActorDiedError
-                from ray_tpu._private import rpc
-
-                if (
-                    retry_on_failure
-                    and isinstance(
-                        e, (ActorDiedError, rpc.ConnectionLost, rpc.RpcError)
-                    )
-                    and deaths < 3
-                ):
-                    # Replica died mid-request: drop it and re-route.
-                    # NOTE: at-least-once — the dead replica may already
-                    # have executed the request. Non-idempotent callers
-                    # opt out via .options(retry_on_failure=False).
-                    deaths += 1
-                    self._replicas = [
-                        r
-                        for r in self._replicas
-                        if r.actor_id != replica.actor_id
-                    ]
-                    # The controller may not bump the version for several
-                    # missed polls; cached affinity orderings still point
-                    # at the dead replica until then.
-                    self._affinity.clear()
-                    await self._refresh(force=True)
-                    continue
+                if _is_draining_refusal(e):
+                    # The replica is retiring (scale-down drain) and
+                    # REFUSED the request before starting it — always
+                    # safe to re-dispatch, even for non-idempotent
+                    # calls, and it never burns a death retry. Bounded
+                    # anyway: an entire replica set draining at once
+                    # must end in NoReplicaAvailableError, not a spin.
+                    drain_hops += 1
+                    if drain_hops <= 10:
+                        self._drop_replica(replica.actor_id)
+                        self._count_retry("draining")
+                        await self._refresh(force=True)
+                        continue
+                    raise
+                if self._is_replica_death(e):
+                    # Replica died mid-request: open/advance its
+                    # breaker, drop it, and re-route with backoff.
+                    # NOTE: at-least-once — the dead replica may
+                    # already have executed the request. Non-idempotent
+                    # callers opt out via
+                    # .options(retry_on_failure=False).
+                    self._record_replica_failure(replica.actor_id)
+                    self._count_death()
+                    if retry_on_failure and deaths < self._retry_max():
+                        deaths += 1
+                        self._drop_replica(replica.actor_id)
+                        self._count_retry("death")
+                        await asyncio.sleep(self._retry_backoff(deaths))
+                        await self._refresh(force=True)
+                        continue
                 raise
             finally:
                 if replica.actor_id in self._inflight:
@@ -431,6 +695,7 @@ class _Router:
         self._ensure_reporter()
         core = await self._core()
         deaths = 0
+        drain_hops = 0
         while True:
             replica = await self._acquire_replica_traced(model_id)
             self._inflight[replica.actor_id] = (
@@ -449,6 +714,7 @@ class _Router:
                     while True:
                         entry = await core.next_generator_item(task_id)
                         if entry[0] == "done":
+                            self._record_replica_success(replica.actor_id)
                             return
                         if entry[0] == "error":
                             raise entry[1]
@@ -467,26 +733,34 @@ class _Router:
             except GeneratorExit:
                 raise
             except Exception as e:  # noqa: BLE001
-                from ray_tpu.exceptions import ActorDiedError
-                from ray_tpu._private import rpc
-
-                if (
-                    retry_on_failure
-                    and not yielded
-                    and isinstance(
-                        e, (ActorDiedError, rpc.ConnectionLost, rpc.RpcError)
-                    )
-                    and deaths < 3
-                ):
-                    deaths += 1
-                    self._replicas = [
-                        r
-                        for r in self._replicas
-                        if r.actor_id != replica.actor_id
-                    ]
-                    self._affinity.clear()
-                    await self._refresh(force=True)
-                    continue
+                if not yielded and _is_draining_refusal(e):
+                    # Retiring replica refused before starting the
+                    # stream: always re-routable (see route_and_call).
+                    drain_hops += 1
+                    if drain_hops <= 10:
+                        self._drop_replica(replica.actor_id)
+                        self._count_retry("draining")
+                        await self._refresh(force=True)
+                        continue
+                    raise
+                if self._is_replica_death(e):
+                    self._record_replica_failure(replica.actor_id)
+                    self._count_death()
+                    # Re-route only before the first yield: a consumer
+                    # that already saw items cannot be transparently
+                    # replayed — it gets the TYPED death (fail fast,
+                    # never a hang) and decides about a fresh request.
+                    if (
+                        retry_on_failure
+                        and not yielded
+                        and deaths < self._retry_max()
+                    ):
+                        deaths += 1
+                        self._drop_replica(replica.actor_id)
+                        self._count_retry("death")
+                        await asyncio.sleep(self._retry_backoff(deaths))
+                        await self._refresh(force=True)
+                        continue
                 raise
             finally:
                 if replica.actor_id in self._inflight:

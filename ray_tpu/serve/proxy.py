@@ -29,6 +29,7 @@ import urllib.parse
 
 logger = logging.getLogger("ray_tpu.serve")
 
+from ray_tpu.exceptions import NoReplicaAvailableError
 from ray_tpu.serve.handle import (
     DeploymentHandle,
     DeploymentStreamResponse,
@@ -348,6 +349,22 @@ class ProxyActor:
                     writer, 408, b"request timed out", keep_alive
                 )
                 return keep_alive
+            except NoReplicaAvailableError as e:
+                # Every replica is dead/draining/circuit-open — the
+                # ONLY case the proxy answers 503 for a routed request.
+                # Retry-After tells well-behaved clients when the
+                # breaker window reopens.
+                self._stats["errors"] += 1
+                if dep_name:
+                    tel.finish(app_name, dep_name, route, 503)
+                await self._respond(
+                    writer, 503, str(e).encode(), keep_alive,
+                    extra_headers={
+                        "Retry-After":
+                            str(max(1, int(e.retry_after_s + 0.999))),
+                    },
+                )
+                return keep_alive
             # tpulint: allow(broad-except reason=the failure is propagated to the client as the 500 body and counted in proxy stats)
             except Exception as e:  # noqa: BLE001 - user/routing error → 500
                 self._stats["errors"] += 1
@@ -360,14 +377,23 @@ class ProxyActor:
         return keep_alive
 
     async def _respond(
-        self, writer, status: int, payload: bytes, keep_alive: bool = False
+        self,
+        writer,
+        status: int,
+        payload: bytes,
+        keep_alive: bool = False,
+        extra_headers: dict | None = None,
     ):
         reason = _REASONS.get(status, "Unknown")
         conn = "keep-alive" if keep_alive else "close"
+        extras = "".join(
+            f"{k}: {v}\r\n" for k, v in (extra_headers or {}).items()
+        )
         writer.write(
             (
                 f"HTTP/1.1 {status} {reason}\r\n"
                 f"Content-Length: {len(payload)}\r\n"
+                f"{extras}"
                 f"Connection: {conn}\r\n\r\n"
             ).encode()
             + payload
@@ -491,11 +517,23 @@ class ProxyActor:
             info["status"] = 499  # nginx convention: client closed
             await agen.aclose()
             return False
-        # tpulint: allow(broad-except reason=the failure reaches the client — as a 500 before the stream starts, as a terminal SSE error event mid-stream — and is counted in proxy stats)
+        # tpulint: allow(broad-except reason=the failure reaches the client — as a 500/503 before the stream starts, as a terminal SSE error event mid-stream — and is counted in proxy stats)
         except Exception as e:  # noqa: BLE001
             self._stats["errors"] += 1
-            info["status"] = 500
             await agen.aclose()
+            if not started and isinstance(e, NoReplicaAvailableError):
+                # Mirror the unary path: pre-stream unavailability is a
+                # clean 503 + Retry-After, not an empty 500.
+                info["status"] = 503
+                await self._respond(
+                    writer, 503, str(e).encode(), keep_alive,
+                    extra_headers={
+                        "Retry-After":
+                            str(max(1, int(e.retry_after_s + 0.999))),
+                    },
+                )
+                return keep_alive
+            info["status"] = 500
             if not started:
                 await self._respond(writer, 500, str(e).encode(), keep_alive)
                 return keep_alive

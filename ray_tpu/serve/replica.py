@@ -57,6 +57,7 @@ class ReplicaActor:
         self.deployment_name = deployment_name
         self._num_ongoing = 0
         self._num_served = 0
+        self._draining = False
         if isinstance(user_callable, type):
             self._callable = user_callable(*init_args, **init_kwargs)
         else:
@@ -77,6 +78,25 @@ class ReplicaActor:
         self._reconfigure(user_config)
         return True
 
+    def prepare_drain(self) -> int:
+        """Scale-down retirement, step 1 (controller-driven): stop
+        accepting new requests, keep serving in-flight ones. Returns
+        the in-flight count so the controller can kill immediately when
+        the replica is already idle. Idempotent."""
+        self._draining = True
+        return self._num_ongoing
+
+    def _check_draining(self):
+        """Admission gate: a draining replica refuses NEW requests with
+        the typed error the router re-routes on. Routers holding a
+        replica list from before the scale-down version bump race this
+        window — the typed refusal (instead of a served request) is
+        what makes the drain a hard barrier."""
+        if self._draining:
+            from ray_tpu.exceptions import ReplicaDrainingError
+
+            raise ReplicaDrainingError(self.deployment_name)
+
     async def handle_request(
         self,
         method_name: str,
@@ -84,6 +104,7 @@ class ReplicaActor:
         request_kwargs: dict,
         request_context: dict | None = None,
     ):
+        self._check_draining()
         self._num_ongoing += 1
         scope, ctx_kwargs = _replica_scope(
             self.deployment_name, request_context
@@ -121,6 +142,7 @@ class ReplicaActor:
         ObjectRefGenerator). Yields the user method's items as they are
         produced; a non-generator result yields exactly once, so the
         router can use one call shape for both."""
+        self._check_draining()
         self._num_ongoing += 1
         scope, ctx_kwargs = _replica_scope(
             self.deployment_name, request_context
@@ -166,9 +188,16 @@ class ReplicaActor:
             self._num_served += 1
 
     def get_stats(self) -> dict:
+        import os
+
         return {
             "num_ongoing_requests": self._num_ongoing,
             "num_served": self._num_served,
+            "draining": self._draining,
+            # The hosting worker's pid: the deterministic handle the
+            # replica-SIGKILL chaos path (test_utils.kill_one_replica)
+            # and bench_serve's kill leg grab a victim by.
+            "pid": os.getpid(),
         }
 
     def check_health(self) -> bool:

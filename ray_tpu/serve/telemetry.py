@@ -70,6 +70,37 @@ QUEUE_DEPTH = Gauge(
     "autoscaling demand signal)",
     tag_keys=("app", "deployment"),
 )
+TARGET_REPLICAS = Gauge(
+    "ray_tpu_serve_target_replicas",
+    "the controller's current target replica count per deployment (the "
+    "autoscaler's output signal)",
+    tag_keys=("app", "deployment"),
+)
+REPLICA_DEATHS = Counter(
+    "ray_tpu_serve_replica_deaths_total",
+    "typed replica deaths observed by handle routers (the request was "
+    "re-dispatched unless retries were exhausted or opted out)",
+    tag_keys=("app", "deployment"),
+)
+RETRIES = Counter(
+    "ray_tpu_serve_retries_total",
+    "handle-router request re-dispatches after a typed replica "
+    "death or draining refusal",
+    tag_keys=("app", "deployment", "reason"),
+)
+BREAKER_OPEN = Gauge(
+    "ray_tpu_serve_breaker_open_replicas",
+    "replicas this handle router currently holds an OPEN circuit "
+    "breaker for (skipped by routing until half-open probes succeed)",
+    tag_keys=("app", "deployment"),
+)
+DRAINED_REPLICAS = Counter(
+    "ray_tpu_serve_drained_replicas_total",
+    "replicas retired through the scale-down drain protocol, by how "
+    "the drain ended (clean = in-flight hit zero, timeout = "
+    "SERVE_DRAIN_TIMEOUT_S expired, dead = died mid-drain)",
+    tag_keys=("app", "deployment", "outcome"),
+)
 BATCH_OCCUPANCY = Gauge(
     "ray_tpu_serve_batch_occupancy",
     "occupied fraction of the most recent batch (engine decode slots "

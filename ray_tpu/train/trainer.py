@@ -148,6 +148,16 @@ class ElasticScalingPolicy(ScalingPolicy):
         spread = scaling.placement_strategy in ("SPREAD", "STRICT_SPREAD")
         fit = 0
         for avail in cluster_free:
+            # Slice-labeled nodes count by WHOLE SURVIVING SLICES, not
+            # bundles: a slice with a draining/dead sibling is atomic —
+            # its survivors die with it (GCE reaps the slice as a unit),
+            # so bundles placed there would size an attempt that loses
+            # them mid-rendezvous. _cluster_free marks members of such
+            # slices with _slice_whole=False.
+            if avail.get("_slice") is not None and not avail.get(
+                "_slice_whole", True
+            ):
+                continue
             per_node = min(
                 (
                     int(avail.get(k, 0.0) // v)
@@ -577,16 +587,37 @@ class JaxTrainer:
         """Per-live-node available resources (the scaling policy's view
         of what an attempt can place). Draining nodes are excluded —
         counting a preempting node's capacity would size an attempt the
-        placement layer can no longer satisfy."""
+        placement layer can no longer satisfy. Slice-labeled nodes
+        additionally carry ``_slice`` (the fault-domain id) and
+        ``_slice_whole`` (False when ANY sibling of the slice is
+        draining/dead/unhealthy): a slice dies as a unit, so the
+        elastic policy must count whole surviving slices, not the
+        stray healthy bundles of a condemned one."""
         try:
             rt = ray_tpu.api._runtime
             status = rt.run(rt.core.head.call("cluster_status"))
             draining = set(status.get("draining") or {})
-            return [
-                dict(n.get("available", {}))
-                for nid, n in status.get("nodes", {}).items()
-                if nid not in draining
-            ]
+            node_slice: dict[str, str] = {}
+            whole: dict[str, bool] = {}
+            for sid, rec in (status.get("slices") or {}).items():
+                members = list(rec.get("nodes") or [])
+                for nid in members:
+                    node_slice[nid] = sid
+                whole[sid] = (
+                    rec.get("state") == "healthy"
+                    and not any(nid in draining for nid in members)
+                )
+            out = []
+            for nid, n in status.get("nodes", {}).items():
+                if nid in draining:
+                    continue
+                avail = dict(n.get("available", {}))
+                sid = node_slice.get(nid)
+                if sid is not None:
+                    avail["_slice"] = sid
+                    avail["_slice_whole"] = whole.get(sid, False)
+                out.append(avail)
+            return out
         except Exception:  # noqa: BLE001 - policy falls back to config
             logger.debug(
                 "cluster_status unavailable; scaling policy sees an "

@@ -23,10 +23,12 @@ The legitimate escape hatches stay silent: ``jax.debug.print`` /
 execution time by design, and a function passed INTO them is never
 walked (only direct calls in the traced body are).
 
-Scope is module-local: jit-decorated defs plus functions wrapped by a
-``jit(...)`` call in the same file (the overwhelmingly common layout
-here). The recompile sanitizer is the runtime backstop for the rest.
-"""
+Scope: jit-decorated defs, functions wrapped by a ``jit(...)`` call in
+the same file, and — resolved in ``finalize`` against the program-wide
+function table — functions ``jit()``-wrapped FROM ANOTHER FILE (the
+ZeRO step layout: ``step.py`` defines the body, the trainer wraps it).
+The recompile sanitizer remains the runtime backstop for layouts the
+import-map unification can't see (dynamic dispatch, getattr)."""
 
 from __future__ import annotations
 
@@ -116,34 +118,87 @@ def _walk_traced(fn_node):
         stack.extend(ast.iter_child_nodes(node))
 
 
+def _check_function(ctx: FileContext, info, qual: str,
+                    via: str | None = None) -> None:
+    """Walk one traced function's body for trace-time side effects and
+    report them against ITS file (pragmas land where the code is)."""
+    params = set(info.params)
+    local_names = _local_stores(info.node)
+    scope = (f"{info.class_name}.{info.node.name}"
+             if info.class_name else info.node.name)
+    origin = f" (jit()-wrapped in {via})" if via else ""
+    for call in _walk_traced(info.node):
+        desc = _side_effect(call, local_names, params)
+        if desc is not None:
+            ctx.report(
+                "TPU602", call,
+                f"{desc} inside jit-traced `{qual}`{origin}: this runs "
+                "ONCE at trace time, not per step — the compiled "
+                "program carries no trace of it and the signal it "
+                "claims to emit silently flatlines. Hoist it to "
+                "the caller or route it through jax.debug/"
+                "io_callback",
+                scope=scope,
+            )
+
+
+class _PassState:
+    def __init__(self, ctx: FileContext, ji, checked: set[str]):
+        self.ctx = ctx
+        self.ji = ji
+        self.checked = checked
+
+
 def run(ctx: FileContext):
-    if "jit" not in ctx.source:
-        return None
+    # Files WITHOUT any jit token still contribute their function table
+    # to finalize: a side-effectful helper defined here may be
+    # jit()-wrapped from another file entirely.
     ji = jit_util.jit_index(ctx)
-    traced = set(ji.jit_defs) | (ji.wrapped & set(ji.mi.functions))
-    if not traced:
-        return None
-    for qual in sorted(traced):
-        info = ji.mi.functions[qual]
-        params = set(info.params)
-        local_names = _local_stores(info.node)
-        scope = (f"{info.class_name}.{info.node.name}"
-                 if info.class_name else info.node.name)
-        for call in _walk_traced(info.node):
-            desc = _side_effect(call, local_names, params)
-            if desc is not None:
-                ctx.report(
-                    "TPU602", call,
-                    f"{desc} inside jit-traced `{qual}`: this runs "
-                    "ONCE at trace time, not per step — the compiled "
-                    "program carries no trace of it and the signal it "
-                    "claims to emit silently flatlines. Hoist it to "
-                    "the caller or route it through jax.debug/"
-                    "io_callback",
-                    scope=scope,
-                )
-    return None
+    checked: set[str] = set()
+    if "jit" in ctx.source:
+        traced = set(ji.jit_defs) | (ji.wrapped & set(ji.mi.functions))
+        for qual in sorted(traced):
+            _check_function(ctx, ji.mi.functions[qual], qual)
+            checked.add(qual)
+    return _PassState(ctx, ji, checked)
 
 
 def finalize(states):
+    """Cross-file closure: a function jit()-wrapped in module A but
+    DEFINED in module B is walked in B's context (module-local run()
+    can't see it — the carried PR-13 blind spot)."""
+    states = [st for st in states if st is not None]
+    if len(states) < 2:
+        return []
+    # Program-wide function table (exact quals + bare-tail fallback,
+    # the pass_donation unification).
+    functions: dict[str, tuple] = {}
+    by_tail: dict[str, tuple] = {}
+    checked: set[str] = set()
+    for st in states:
+        checked |= st.checked
+        for qual, info in st.ji.mi.functions.items():
+            functions.setdefault(qual, (st.ctx, info))
+            by_tail.setdefault(qual.split(".")[-1], (st.ctx, info, qual))
+    done: set[tuple] = set()
+    for st in states:
+        for wrapped in sorted(st.ji.wrapped):
+            if wrapped in st.ji.mi.functions:
+                continue  # module-local: run() covered it
+            rec = functions.get(wrapped)
+            qual = wrapped
+            if rec is None:
+                tail_rec = by_tail.get(wrapped.split(".")[-1])
+                if tail_rec is None:
+                    continue
+                rec = (tail_rec[0], tail_rec[1])
+                qual = tail_rec[2]
+            if qual in checked:
+                continue
+            ctx, info = rec
+            key = (id(ctx), qual)
+            if key in done:
+                continue
+            done.add(key)
+            _check_function(ctx, info, qual, via=st.ctx.module)
     return []

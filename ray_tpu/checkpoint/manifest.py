@@ -53,18 +53,39 @@ def _is_process_sharded(leaf: Any) -> bool:
 
 
 def owned_items(
-    tree: Any, rank: int, world: int
+    tree: Any,
+    rank: int,
+    world: int,
+    local_prefixes: tuple[str, ...] = (),
 ) -> list[tuple[str, Any]]:
     """The (key, leaf) items THIS rank persists: its round-robin slice of
     the replicated leaves plus every process-sharded leaf (each process
-    then persists only its addressable windows)."""
+    then persists only its addressable windows).
+
+    ``local_prefixes`` marks subtrees that are ALREADY a disjoint
+    per-rank shard (the ZeRO-sharded optimizer state, train/zero.py:
+    each rank's tree holds only the leaves it owns): every present leaf
+    under such a prefix is persisted unconditionally — round-robin
+    re-partitioning a per-rank-distinct key set would be inconsistent
+    across ranks. The head merges all ranks' entries by key, so the
+    committed manifest carries the full sharded state with no gather."""
     items = flatten_with_keys(tree)
+    # Round-robin indexes count only the replicated (non-local) leaves
+    # so the partition stays consistent whatever each rank's local
+    # shard happens to contain.
     out = []
-    for i, (key, leaf) in enumerate(items):
+    i = 0
+    for key, leaf in items:
+        if local_prefixes and any(
+            key.startswith(p) for p in local_prefixes
+        ):
+            out.append((key, leaf))
+            continue
         if _is_process_sharded(leaf) or i % max(1, world) == rank % max(
             1, world
         ):
             out.append((key, leaf))
+        i += 1
     return out
 
 

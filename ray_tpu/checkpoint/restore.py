@@ -90,12 +90,20 @@ def restore(
     *,
     target=None,
     shardings=None,
+    keys=None,
 ):
     """Restore a committed checkpoint. ``target`` (pytree of arrays or
     anything with shape/dtype) pins structure; ``shardings`` (matching
     pytree) places each leaf on the current mesh — pass the NEW mesh's
     shardings to resume elastically on a different layout. Without
-    ``target`` returns ``{leaf_key: np.ndarray}``."""
+    ``target`` returns ``{leaf_key: np.ndarray}``; ``keys`` narrows
+    that form to a subset of leaves.
+
+    Chunk pulls are scoped to the leaves actually assembled (the
+    ``target``'s keys or the ``keys`` filter) — a ZeRO-sharded restore
+    (train/zero.py) therefore pulls only this rank's shard of the
+    optimizer state, never materializing the full fp32 state on any
+    one chip."""
     rt = _runtime()
     reply = rt.run(rt.core.head.call("ckpt_manifest", run=run, step=step))
     if not reply.get("ok"):
@@ -106,7 +114,24 @@ def restore(
         )
     entries: dict[str, dict] = reply["entries"]
     locations: dict[str, list[str]] = reply.get("locations", {})
-    hashes = sorted(_manifest.manifest_chunks(entries))
+
+    if target is not None:
+        import jax
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+        wanted = [jax.tree_util.keystr(path) for path, _leaf in flat]
+    elif keys is not None:
+        wanted = sorted(keys)
+        missing = [k for k in wanted if k not in entries]
+        if missing:
+            raise KeyError(
+                f"checkpoint for run {run!r} has no leaves "
+                f"{missing[:4]}; saved leaves: {sorted(entries)[:8]}…"
+            )
+    else:
+        wanted = sorted(entries)
+    needed = {k: entries[k] for k in wanted if k in entries}
+    hashes = sorted(_manifest.manifest_chunks(needed))
     chunks = rt.run(_fetch_chunks(rt, hashes, locations))
 
     def assemble(key: str):
@@ -116,11 +141,8 @@ def restore(
         )
 
     if target is None:
-        return {key: assemble(key) for key in sorted(entries)}
+        return {key: assemble(key) for key in wanted}
 
-    import jax
-
-    flat, treedef = jax.tree_util.tree_flatten_with_path(target)
     values = []
     for path, leaf in flat:
         key = jax.tree_util.keystr(path)
@@ -142,6 +164,6 @@ def restore(
     return state
 
 
-def restore_uri(uri: str, *, target=None, shardings=None):
+def restore_uri(uri: str, *, target=None, shardings=None, keys=None):
     run, step = parse_uri(uri)
-    return restore(run, step, target=target, shardings=shardings)
+    return restore(run, step, target=target, shardings=shardings, keys=keys)

@@ -121,6 +121,7 @@ class AsyncCheckpointer:
         replication: int | None = None,
         rank: int | None = None,
         world: int | None = None,
+        local_prefixes: tuple[str, ...] = (),
     ):
         from ray_tpu._private import config
         from ray_tpu.train import session
@@ -136,6 +137,10 @@ class AsyncCheckpointer:
             if replication is not None
             else config.get("CKPT_REPLICATION")
         )
+        # Subtree prefixes that are already per-rank shards (the ZeRO
+        # optimizer state): persisted as-held, never re-partitioned
+        # (manifest.owned_items local_prefixes semantics).
+        self.local_prefixes = tuple(local_prefixes)
         # key → list[(index_spec, host buffer)]: the double buffer. save()
         # only runs while no persist is in flight, so the writer thread
         # and the copy never touch the same buffers concurrently.
@@ -165,7 +170,10 @@ class AsyncCheckpointer:
         t0 = time.perf_counter()
         self.wait()
         snapshot: list[tuple[str, tuple, list]] = []
-        for key, leaf in _manifest.owned_items(state, self.rank, self.world):
+        for key, leaf in _manifest.owned_items(
+            state, self.rank, self.world,
+            local_prefixes=self.local_prefixes,
+        ):
             # Global shape comes from the LEAF (a process-sharded
             # array's local windows may not reach the far edge); a
             # shapeless leaf (python scalar/list) uses its host copy's.

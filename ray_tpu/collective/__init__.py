@@ -453,16 +453,21 @@ def allgather(
     min_ranks: int | None = None,
     grace_s: float | None = None,
     compression: str | None = None,
+    algo: str | None = None,
 ):
     """Partial mode (cpu backend): skipped ranks' entries come back
     zero-filled with the skip list in the PartialResult envelope.
-    ``compression="int8"`` gathers block-scaled int8 payloads."""
+    ``compression="int8"`` gathers block-scaled int8 payloads;
+    ``algo=`` selects the data plane ("ring"/"auto" — the crossover
+    routing the ZeRO weight-allgather hop uses)."""
     kw: dict = {}
     if min_ranks is not None:
         kw["min_ranks"] = min_ranks
         kw["grace_s"] = grace_s
     if compression is not None:
         kw["compression"] = compression
+    if algo is not None:
+        kw["algo"] = algo
     return _note_partial(
         _dispatch("allgather", group_name, tensor, timeout_s=timeout_s, **kw)
     )
@@ -476,16 +481,21 @@ def reducescatter(
     min_ranks: int | None = None,
     grace_s: float | None = None,
     compression: str | None = None,
+    algo: str | None = None,
 ):
     """Partial mode (cpu backend): SUM rescaled by world/contributors
     like allreduce; each rank still receives its own chunk.
-    ``compression="int8"`` ships and returns block-scaled int8."""
+    ``compression="int8"`` ships and returns block-scaled int8;
+    ``algo=`` selects the data plane ("ring"/"auto" — the crossover
+    routing the ZeRO grad reduce-scatter hop uses)."""
     kw: dict = {}
     if min_ranks is not None:
         kw["min_ranks"] = min_ranks
         kw["grace_s"] = grace_s
     if compression is not None:
         kw["compression"] = compression
+    if algo is not None:
+        kw["algo"] = algo
     return _note_partial(
         _dispatch(
             "reducescatter", group_name, tensor, op=ReduceOp(op),
@@ -596,12 +606,13 @@ def reducescatter_async(
     min_ranks: int | None = None,
     grace_s: float | None = None,
     compression: str | None = None,
+    algo: str | None = None,
 ) -> CollectiveWork:
     """Asynchronous :func:`reducescatter` — see :func:`allreduce_async`."""
     return _dispatch_async(
         "reducescatter", group_name, tensor,
         **_async_kwargs(op, timeout_s, min_ranks, grace_s, compression,
-                        None),
+                        algo),
     )
 
 
@@ -612,12 +623,13 @@ def allgather_async(
     min_ranks: int | None = None,
     grace_s: float | None = None,
     compression: str | None = None,
+    algo: str | None = None,
 ) -> CollectiveWork:
     """Asynchronous :func:`allgather` — see :func:`allreduce_async`."""
     return _dispatch_async(
         "allgather", group_name, tensor,
         **_async_kwargs(None, timeout_s, min_ranks, grace_s, compression,
-                        None, with_op=False),
+                        algo, with_op=False),
     )
 
 

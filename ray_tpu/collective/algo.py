@@ -83,21 +83,31 @@ def choose_algorithm(
     world: int,
     n_slices: int = 1,
     override: str | None = None,
+    verb: str = "allreduce",
 ) -> str:
-    """Pick the allreduce algorithm for a payload of ``nbytes``/rank.
+    """Pick the data-plane algorithm for a payload of ``nbytes``/rank.
 
     ``override`` short-circuits (any explicit non-AUTO algo wins).
     Multi-slice topologies always take the hierarchical two-level path —
     keeping the DCN hop at 1/m of the bytes beats either flat algorithm
     whenever more than one ICI domain is involved. Otherwise: tree below
-    the crossover size, ring above."""
+    the crossover size, ring above.
+
+    ``verb`` extends the crossover routing to the reduce-scatter /
+    all-gather hops of the ZeRO-sharded gradient path: the same
+    size-vs-latency tradeoff applies (ring moves (n-1)/n of the bytes
+    over n-1 latency-bound hops; the latency-optimal plane — hub star
+    on the cpu backend, one-shot lowering on the compiled backends,
+    both mapped from TREE — moves more bytes in O(1)/O(log n) rounds),
+    minus the hierarchical route, which is an allreduce-only driver
+    op."""
     if override is not None and override != AUTO:
         if override not in ALGOS:
             raise ValueError(
                 f"unknown collective algo {override!r}; known: {ALGOS}"
             )
         return override
-    if n_slices > 1:
+    if n_slices > 1 and verb == "allreduce":
         return HIERARCHICAL
     if world <= 2:
         # Two ranks: ring and tree degenerate to the same exchange; call
@@ -112,9 +122,13 @@ def wire_bytes_per_rank(
     world: int,
     n_slices: int = 1,
     compressed_nbytes: int | None = None,
+    verb: str = "allreduce",
 ) -> int:
-    """Per-rank bytes an allreduce moves on the wire under ``algo``.
+    """Per-rank bytes ``verb`` moves on the wire under ``algo``.
 
+    ``nbytes`` is the op's LOGICAL per-rank payload by the flight
+    recorder's convention: the full flat payload for allreduce and
+    reducescatter, this rank's contribution for allgather.
     ``compressed_nbytes`` substitutes the quantized payload size (int8
     data + scales) for the phases that ship compressed data. These are
     the analytic counts the flight recorder's wire counter uses for ops
@@ -126,6 +140,32 @@ def wire_bytes_per_rank(
                   else nbytes)
     if n == 1:
         return 0
+    if verb == "reducescatter":
+        if algo == RING:
+            # n-1 hops, each shipping one 1/n chunk.
+            return int((n - 1) / n * payload)
+        if algo == HUB:
+            # full contribution up, the 1/n chunk back down.
+            return payload + payload // n
+        if algo == TREE:
+            # one-shot / reduce-then-slice: the reduce tree's bytes.
+            return int(math.ceil(math.log2(n)) * payload)
+        raise ValueError(
+            f"unknown reducescatter algo {algo!r}; known: {ALGOS}"
+        )
+    if verb == "allgather":
+        if algo == RING:
+            # n-1 hops, each forwarding one rank's contribution.
+            return (n - 1) * payload
+        if algo == HUB:
+            # contribution up, the n gathered chunks back down.
+            return (n + 1) * payload
+        if algo == TREE:
+            # recursive-doubling broadcast of the growing gather.
+            return int(math.ceil(math.log2(n)) * n * payload)
+        raise ValueError(
+            f"unknown allgather algo {algo!r}; known: {ALGOS}"
+        )
     if algo == HUB:
         return 2 * payload  # one round trip: contribution up, result down
     if algo == RING:

@@ -997,20 +997,31 @@ class CpuGroup:
             )
         return out
 
-    def _resolve_algo(self, algo: str | None, nbytes: int) -> str:
+    def _resolve_algo(
+        self, algo: str | None, nbytes: int, verb: str = "allreduce"
+    ) -> str:
         """None → the hub (the default data plane, byte-identical to
         before algo= existed); "auto" → ring/tree by message size via
-        the crossover table; explicit names pass through validated."""
+        the crossover table; explicit names pass through validated.
+
+        For the reducescatter/allgather verbs (the ZeRO-sharded path's
+        two hops) the latency-optimal plane IS the hub star — TREE maps
+        to it — so "auto" routes small payloads through the hub and
+        large ones onto the ring data plane."""
         if algo is None:
             return colalgo.HUB
         if algo == colalgo.AUTO:
-            return colalgo.choose_algorithm(nbytes, self.world)
-        if algo not in (colalgo.HUB, colalgo.RING, colalgo.TREE):
+            chosen = colalgo.choose_algorithm(nbytes, self.world, verb=verb)
+        elif algo not in (colalgo.HUB, colalgo.RING, colalgo.TREE):
             raise ValueError(
                 f"cpu backend supports algo hub/ring/tree/auto, "
                 f"got {algo!r}"
             )
-        return algo
+        else:
+            chosen = algo
+        if verb != "allreduce" and chosen == colalgo.TREE:
+            return colalgo.HUB
+        return chosen
 
     async def allreduce(
         self,
@@ -1067,16 +1078,31 @@ class CpuGroup:
         min_ranks: int | None = None,
         grace_s: float | None = None,
         compression: str | None = None,
+        algo: str | None = None,
     ):
         """Partial mode (``min_ranks=K``) returns the gathered list with
         zero-filled entries for skipped ranks — the PartialResult's
-        ``skipped`` list, not the zeros, is the authoritative signal."""
+        ``skipped`` list, not the zeros, is the authoritative signal.
+        ``algo=`` picks the data plane (hub default; ring for large
+        payloads under "auto" — the ZeRO allgather hop's routing)."""
+        arr = np.asarray(tensor)
+        compression = codec.check_codec(compression)
+        chosen = self._resolve_algo(algo, arr.nbytes, "allgather")
+        if chosen == colalgo.RING and self.world > 1:
+            if min_ranks is not None:
+                raise ValueError(
+                    "partial mode (min_ranks=) requires the hub "
+                    "algorithm: the ring has no central grace timer"
+                )
+            return await self._algo_scatter_gather(
+                "allgather", arr, None, timeout_s, compression
+            )
         meta: dict = {}
-        if codec.check_codec(compression) is not None:
+        if compression is not None:
             meta["compression"] = compression
         self._partial_meta(meta, min_ranks, grace_s)
         out = await self._op(
-            "allgather", np.asarray(tensor), timeout_s=timeout_s, **meta
+            "allgather", arr, timeout_s=timeout_s, **meta
         )
         return self._wrap_partial(out, min_ranks)
 
@@ -1088,15 +1114,30 @@ class CpuGroup:
         min_ranks: int | None = None,
         grace_s: float | None = None,
         compression: str | None = None,
+        algo: str | None = None,
     ):
         """Partial mode rescales SUM by world/K like allreduce; each
-        rank still receives its own chunk of the partial reduction."""
+        rank still receives its own chunk of the partial reduction.
+        ``algo=`` picks the data plane (hub default; ring for large
+        payloads under "auto" — the ZeRO reduce hop's routing)."""
+        arr = np.asarray(tensor)
+        compression = codec.check_codec(compression)
+        chosen = self._resolve_algo(algo, arr.nbytes, "reducescatter")
+        if chosen == colalgo.RING and self.world > 1:
+            if min_ranks is not None:
+                raise ValueError(
+                    "partial mode (min_ranks=) requires the hub "
+                    "algorithm: the ring has no central grace timer"
+                )
+            return await self._algo_scatter_gather(
+                "reducescatter", arr, op, timeout_s, compression
+            )
         meta: dict = {"op": op.value}
-        if codec.check_codec(compression) is not None:
+        if compression is not None:
             meta["compression"] = compression
         self._partial_meta(meta, min_ranks, grace_s)
         out = await self._op(
-            "reducescatter", np.asarray(tensor), timeout_s=timeout_s, **meta
+            "reducescatter", arr, timeout_s=timeout_s, **meta
         )
         return self._wrap_partial(out, min_ranks)
 
@@ -1149,6 +1190,95 @@ class CpuGroup:
             wall_start, time.perf_counter() - t0, wire_bytes=wire[0],
         )
         return result
+
+    async def _algo_scatter_gather(
+        self, verb, arr, op, timeout_s, compression
+    ):
+        """Shared driver for the ring reducescatter / allgather data
+        planes (the two hops the ZeRO-sharded gradient path issues):
+        deadline, straggler chaos, typed starvation, and honest
+        measured wire bytes, mirroring :meth:`_algo_allreduce`."""
+        self._check_alive(verb)
+        t = self.timeout_s if timeout_s is None else float(timeout_s)
+        from ray_tpu._private.test_utils import straggler_delay_for_rank
+
+        delay = straggler_delay_for_rank(self.rank)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        wall_start = time.time()
+        t0 = time.perf_counter()
+        self._algo_seq += 1
+        tag_base = f"_{colalgo.RING}{verb[0]}{self._algo_seq}"
+        wire = [0]
+        run = (
+            self._ring_reducescatter(arr, op, tag_base, compression, wire)
+            if verb == "reducescatter"
+            else self._ring_allgather(arr, tag_base, compression, wire)
+        )
+        try:
+            result = await asyncio.wait_for(run, t)
+        except asyncio.TimeoutError:
+            missing = sorted(set(range(self.world)) - {self.rank})
+            self._probe_missing(missing)
+            raise CollectiveTimeoutError(
+                self.base_name, verb, t,
+                detail="ring algorithm starved waiting on a peer hop",
+            )
+        record_op(
+            self.base_name, verb, "cpu", self.world, arr,
+            wall_start, time.perf_counter() - t0, wire_bytes=wire[0],
+        )
+        return result
+
+    async def _ring_reducescatter(
+        self, arr, op, tag_base, compression, wire
+    ):
+        """Ring reduce-scatter: the first phase of the ring allreduce —
+        n-1 hops, each shipping one 1/n chunk — after which rank r holds
+        the fully reduced chunk r (matching the hub's
+        ``np.array_split(result, world, axis=0)[r]`` contract)."""
+        n, r = self.world, self.rank
+        combine = _COMBINERS[op]
+        acc_dtype = np.float32 if compression is not None else arr.dtype
+        chunks = [
+            np.asarray(c, acc_dtype)
+            for c in np.array_split(np.asarray(arr), n, axis=0)
+        ]
+        right, left = (r + 1) % n, (r - 1) % n
+        # After hop s, chunk (r-s-1) mod n holds the running reduction
+        # of s+2 ranks; n-1 hops leave rank r owning chunk r's total
+        # (the classic schedule ends at (r+1) mod n — start one step
+        # earlier so ownership lands on r itself).
+        for s in range(n - 1):
+            send_idx = (r - s - 1) % n
+            recv_idx = (r - s - 2) % n
+            got = await self._exchange(
+                right, left, f"{tag_base}:rs{s}", chunks[send_idx],
+                compression, wire,
+            )
+            chunks[recv_idx] = combine(
+                chunks[recv_idx], np.asarray(got, acc_dtype)
+            )
+        return chunks[r].astype(arr.dtype, copy=False)
+
+    async def _ring_allgather(self, arr, tag_base, compression, wire):
+        """Ring all-gather: n-1 hops, each forwarding the chunk received
+        on the previous hop; returns the per-rank list (the hub
+        allgather contract). Chunk shapes may differ per rank (the
+        array_split remainder) — the mailbox ships arrays, not fixed
+        frames, so unequal hops are fine."""
+        n, r = self.world, self.rank
+        entries: list = [None] * n
+        entries[r] = np.asarray(arr)
+        right, left = (r + 1) % n, (r - 1) % n
+        cur = entries[r]
+        for s in range(n - 1):
+            got = await self._exchange(
+                right, left, f"{tag_base}:ag{s}", cur, compression, wire
+            )
+            cur = np.asarray(got, entries[r].dtype)
+            entries[(r - s - 1) % n] = cur
+        return entries
 
     async def _exchange(self, dst, src, tag, value, compression, wire):
         """One algorithm hop: send ``value`` to ``dst`` while receiving

@@ -595,9 +595,13 @@ class XlaMeshGroup(_RecordStateMixin):
     def allgather(
         self, tensors: Sequence[Any], timeout_s=None,
         compression: str | None = None,
+        algo: str | None = None,
     ) -> list:
         del timeout_s
         x = self._stack(tensors)
+        # all_gather has one compiled lowering (ring on ICI); algo= is
+        # accepted for selector parity and prices the wire honestly.
+        del algo
         if codec.check_codec(compression) is not None:
             return self._compressed_allgather(x)
         key = ("allgather", x.shape, str(x.dtype))
@@ -611,6 +615,12 @@ class XlaMeshGroup(_RecordStateMixin):
                 ],
                 donate=False,
             ),
+        )
+        nbytes = int(np.prod(x.shape[1:]) * x.dtype.itemsize) if (
+            x.ndim > 1
+        ) else x.dtype.itemsize
+        self._last_wire_bytes = colalgo.wire_bytes_per_rank(
+            colalgo.RING, nbytes, self.world, verb="allgather"
         )
         return self._unstack(prog(x))
 
@@ -662,22 +672,71 @@ class XlaMeshGroup(_RecordStateMixin):
     def reducescatter(
         self, tensors: Sequence[Any], op=ReduceOp.SUM, timeout_s=None,
         compression: str | None = None,
+        algo: str | None = None,
+        min_ranks: int | None = None,
+        grace_s=None,
+        skip_ranks: Sequence[int] | None = None,
     ) -> list:
-        del timeout_s
+        del timeout_s, grace_s
         x = self._stack(tensors)
         if x.shape[1] % self.world:
             raise ValueError(
                 f"reducescatter dim0 {x.shape[1]} not divisible by world "
                 f"{self.world}"
             )
+        nbytes = int(np.prod(x.shape[1:]) * x.dtype.itemsize) if (
+            x.ndim > 1
+        ) else x.dtype.itemsize
         if codec.check_codec(compression) is not None:
             if op is not ReduceOp.SUM:
                 raise ValueError(
                     "compressed reducescatter supports ReduceOp.SUM only"
                 )
+            if min_ranks is not None or skip_ranks:
+                raise ValueError(
+                    "compressed reducescatter does not compose with "
+                    "partial mode yet: drop min_ranks/skip_ranks or "
+                    "compression"
+                )
             return self._compressed_reducescatter(x)
-        key = ("reducescatter", x.shape, str(x.dtype), op)
+        if min_ranks is not None or skip_ranks:
+            # Partial K-of-N on the reduce hop (the ZeRO reduce-scatter
+            # composes with allow_partial_grads): masked psum_scatter —
+            # skipped ranks contribute weight 0, SUM rescaled world/Σw.
+            return self._partial_reducescatter(
+                x, op, min_ranks, skip_ranks
+            )
         if op is ReduceOp.SUM:
+            chosen = colalgo.RING
+            if algo is not None:
+                chosen = colalgo.choose_algorithm(
+                    nbytes, self.world, override=algo,
+                    verb="reducescatter",
+                )
+            if chosen == colalgo.TREE:
+                # Latency-optimal one-shot: full psum, keep our slice.
+                # The small-payload branch of the selector — one
+                # compiled reduction instead of n-1 scatter hops.
+                key = ("rs_tree", x.shape, str(x.dtype))
+                chunk = x.shape[1] // self.world
+
+                def build():
+                    def fn(s):
+                        full = jax.lax.psum(s, "ranks")
+                        idx = jax.lax.axis_index("ranks")
+                        return jax.lax.dynamic_slice_in_dim(
+                            full[0], idx * chunk, chunk, axis=0
+                        )[None]
+
+                    return self._shmap(fn)
+
+                prog = self._program(key, build)
+                self._last_wire_bytes = colalgo.wire_bytes_per_rank(
+                    colalgo.TREE, nbytes, self.world,
+                    verb="reducescatter",
+                )
+                return self._unstack(prog(x))
+            key = ("reducescatter", x.shape, str(x.dtype), op)
             psum_scatter = partial(jax.lax.psum_scatter, axis_name="ranks")
             prog = self._program(
                 key,
@@ -687,6 +746,9 @@ class XlaMeshGroup(_RecordStateMixin):
                     )[None]
                 ),
             )
+            self._last_wire_bytes = colalgo.wire_bytes_per_rank(
+                colalgo.RING, nbytes, self.world, verb="reducescatter"
+            )
             return self._unstack(prog(x))
         # Non-sum reductions: reduce via the matching allreduce, then each
         # rank keeps its slice (no fused primitive for max/min/product).
@@ -695,6 +757,56 @@ class XlaMeshGroup(_RecordStateMixin):
         return [
             r[i * chunk : (i + 1) * chunk] for i, r in enumerate(reduced)
         ]
+
+    def _partial_reducescatter(
+        self, x, op, min_ranks, skip_ranks
+    ) -> PartialResult:
+        """Masked psum_scatter: contribution r is weighted w_r (0 for
+        skipped ranks), the scattered SUM rescaled by world/Σw — the
+        same semantics as :meth:`_partial_allreduce` applied to the
+        ZeRO reduce hop. The gather hop never runs partial (a skipped
+        OWNER would zero its weight shard, not merely degrade it)."""
+        _check_partial_args(op, x.dtype, min_ranks, self.world)
+        skipped = sorted({int(r) for r in (skip_ranks or ())})
+        contributed = [r for r in range(self.world) if r not in skipped]
+        if len(contributed) < int(min_ranks or 1):
+            raise CollectiveTimeoutError(
+                self.name,
+                "reducescatter",
+                None,
+                missing_ranks=skipped,
+                detail=f"masking left {len(contributed)} contributors, "
+                       f"below min_ranks {min_ranks}",
+            )
+        world = self.world
+        key = ("partial_reducescatter", x.shape, str(x.dtype))
+
+        def build():
+            def fn(s, w):
+                wb = w.reshape((1,) + (1,) * (s.ndim - 1))
+                shard = jax.lax.psum_scatter(
+                    (s * wb)[0], "ranks", scatter_dimension=0, tiled=True
+                )
+                cnt = jax.lax.psum(w, "ranks")
+                return (shard * (world / jnp.maximum(cnt, 1.0)))[None]
+
+            mapped = shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(P("ranks"), P("ranks")),
+                out_specs=P("ranks"),
+            )
+            return jax.jit(mapped)
+
+        prog = self._program(key, build)
+        w = np.ones((world,), dtype=x.dtype)
+        w[skipped] = 0
+        out = self._unstack(prog(x, jnp.asarray(w)))
+        if skipped:
+            record_partial(self.name, "reducescatter", skipped)
+        return PartialResult(
+            value=out, contributed=contributed, skipped=skipped, world=world
+        )
 
     def _compressed_reducescatter(self, x) -> list:
         """Quantized chunks → all_to_all int8 → fp32 dequant-accumulate:
@@ -1135,6 +1247,73 @@ class XlaDistGroup(_RecordStateMixin):
             value=out, contributed=contributed, skipped=skipped, world=world
         )
 
+    def _partial_reducescatter_dist(
+        self, tensor, op, min_ranks, grace_s, timeout_s
+    ):
+        """Masked psum_scatter over ICI/DCN — the ZeRO reduce hop under
+        allow_partial_grads on the multi-process backend: the same
+        pre-op gate as :meth:`_partial_allreduce` prices each rank's
+        contribution (w∈{0,1}), the scattered SUM rescales by
+        world/Σw inside the compiled program, and the gather hop
+        stays all-N (a skipped OWNER would zero weight shards)."""
+        grace = (
+            float(grace_s) if grace_s is not None
+            else _default_partial_grace()
+        )
+        x = self._global(tensor)
+        if x.shape[1] % self.world:
+            raise ValueError(
+                f"reducescatter dim0 {x.shape[1]} not divisible by "
+                f"world {self.world}"
+            )
+        _check_partial_args(op, x.dtype, min_ranks, self.world)
+        w_self = self._gate_weight(grace)
+        w = self._global(jnp.asarray(w_self, x.dtype))
+        world = self.world
+        key = ("partial_reducescatter", x.shape, str(x.dtype))
+        prog = self._programs.get(key)
+        if prog is None:
+
+            def fn(s, wv):
+                wb = wv.reshape((1,) + (1,) * (s.ndim - 1))
+                shard = jax.lax.psum_scatter(
+                    (s * wb)[0], "ranks", scatter_dimension=0,
+                    tiled=True,
+                )
+                cnt = jax.lax.psum(wv, "ranks")
+                mask = jax.lax.all_gather(wv[0], "ranks")
+                return (
+                    (shard * (world / jnp.maximum(cnt, 1.0)))[None],
+                    mask[None],
+                )
+
+            mapped = shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(P("ranks"), P("ranks")),
+                out_specs=(P("ranks"), P("ranks")),
+            )
+            prog = self._programs[key] = jax.jit(mapped)
+        out, mask = prog(x, w)
+        out = self._local(self._sync(out, "reducescatter", timeout_s))
+        maskv = np.asarray(self._local(mask))
+        contributed = [r for r in range(world) if maskv[r] > 0]
+        skipped = [r for r in range(world) if maskv[r] <= 0]
+        if len(contributed) < int(min_ranks):
+            raise CollectiveTimeoutError(
+                self.name,
+                "reducescatter",
+                grace,
+                missing_ranks=skipped,
+                detail=f"only {len(contributed)} contributions beat the "
+                       f"partial grace window, below min_ranks {min_ranks}",
+            )
+        if skipped and self.rank == 0:
+            record_partial(self.name, "reducescatter", skipped)
+        return PartialResult(
+            value=out, contributed=contributed, skipped=skipped, world=world
+        )
+
     def _compressed_allreduce_dist(
         self, tensor, op, min_ranks, grace_s, timeout_s
     ):
@@ -1206,8 +1385,12 @@ class XlaDistGroup(_RecordStateMixin):
 
     @_recorded("allgather")
     def allgather(self, tensor, timeout_s=None,
-                  compression: str | None = None):
+                  compression: str | None = None,
+                  algo: str | None = None):
         self._check_poisoned("allgather")
+        # One compiled lowering (ring over ICI/DCN); algo= accepted for
+        # selector parity, the wire estimate below stays honest.
+        del algo
         x = self._global(tensor)
         if codec.check_codec(compression) is not None:
             if not jnp.issubdtype(x.dtype, jnp.inexact):
@@ -1255,6 +1438,12 @@ class XlaDistGroup(_RecordStateMixin):
             ],
             x,
         )
+        nbytes = int(np.prod(x.shape[1:]) * x.dtype.itemsize) if (
+            x.ndim > 1
+        ) else x.dtype.itemsize
+        self._last_wire_bytes = colalgo.wire_bytes_per_rank(
+            colalgo.RING, nbytes, self.world, verb="allgather"
+        )
         return self._local(self._sync(out, "allgather", timeout_s))
 
     @_recorded("broadcast")
@@ -1266,8 +1455,20 @@ class XlaDistGroup(_RecordStateMixin):
 
     @_recorded("reducescatter")
     def reducescatter(self, tensor, op=ReduceOp.SUM, timeout_s=None,
-                      compression: str | None = None):
+                      compression: str | None = None,
+                      algo: str | None = None,
+                      min_ranks: int | None = None,
+                      grace_s: float | None = None):
         self._check_poisoned("reducescatter")
+        if min_ranks is not None:
+            if codec.check_codec(compression) is not None:
+                raise ValueError(
+                    "compressed reducescatter does not compose with "
+                    "partial mode yet: drop min_ranks or compression"
+                )
+            return self._partial_reducescatter_dist(
+                tensor, op, min_ranks, grace_s, timeout_s
+            )
         x = self._global(tensor)
         if codec.check_codec(compression) is not None:
             if op is not ReduceOp.SUM:
@@ -1316,13 +1517,35 @@ class XlaDistGroup(_RecordStateMixin):
             return self._local(
                 self._sync(out, "reducescatter", timeout_s)
             )
+        nbytes = int(np.prod(x.shape[1:]) * x.dtype.itemsize) if (
+            x.ndim > 1
+        ) else x.dtype.itemsize
         if op is ReduceOp.SUM:
+            chosen = colalgo.RING
+            if algo is not None:
+                chosen = colalgo.choose_algorithm(
+                    nbytes, self.world, override=algo,
+                    verb="reducescatter",
+                )
+            if chosen == colalgo.TREE:
+                # Small payload: one-shot psum then keep our slice — the
+                # latency-optimal branch of the selector.
+                full = self.allreduce(tensor, op=op, timeout_s=timeout_s)
+                self._last_wire_bytes = colalgo.wire_bytes_per_rank(
+                    colalgo.TREE, nbytes, self.world,
+                    verb="reducescatter",
+                )
+                chunk = full.shape[0] // self.world
+                return full[self.rank * chunk : (self.rank + 1) * chunk]
             out = self._run(
                 ("reducescatter", x.shape, str(x.dtype), op),
                 lambda s: jax.lax.psum_scatter(
                     s[0], "ranks", scatter_dimension=0, tiled=True
                 )[None],
                 x,
+            )
+            self._last_wire_bytes = colalgo.wire_bytes_per_rank(
+                colalgo.RING, nbytes, self.world, verb="reducescatter"
             )
             return self._local(self._sync(out, "reducescatter", timeout_s))
         full = self.allreduce(tensor, op=op, timeout_s=timeout_s)

@@ -8,8 +8,12 @@ from ray_tpu.train.step import (
     make_optimizer,
     make_train_step,
     init_train_state,
+    init_zero_train_state,
+    jit_grad_step,
     state_logical_axes,
 )
+from ray_tpu.train import zero
+from ray_tpu.train.zero import ZeroOptimizer
 from ray_tpu.train.dataloader import TokenDataset
 from ray_tpu.train.checkpoint import (
     CheckpointManager,
@@ -29,6 +33,7 @@ from ray_tpu.train.session import (
     report,
     slice_label,
     step_span,
+    zero_optimizer,
 )
 from ray_tpu.train.memory import MemoryPlan, plan as plan_memory
 from ray_tpu.train.trainer import (
@@ -50,7 +55,12 @@ __all__ = [
     "make_optimizer",
     "make_train_step",
     "init_train_state",
+    "init_zero_train_state",
+    "jit_grad_step",
     "state_logical_axes",
+    "zero",
+    "ZeroOptimizer",
+    "zero_optimizer",
     "collective_group_name",
     "get_checkpoint",
     "get_context",

@@ -13,7 +13,8 @@ validated against BENCH_8B's empirical boundary (all seven configs) in
 tier-1 and pinned in BENCH_8B.json's ``planner`` block.
 
 Byte model (per chip, dp replicas shard only the batch, fsdp shards
-params/optimizer/grads ZeRO-3 style):
+params/optimizer/grads ZeRO-3 style, zero shards the optimizer state
+across dp replicas — arXiv:2004.13336, train/zero.py):
 
 - params: fp32 master weights (models/llama.py init_params), 4 B/param
 - optimizer: adamw mu (``mu_dtype``, bf16 halves it) + fp32 nu
@@ -130,6 +131,7 @@ def plan(
     mu_dtype="bfloat16",
     hbm_gb: float | None = None,
     fsdp: int = 1,
+    zero: int = 1,
     grad_bucket_mb: float | None = None,
     compression: str | None = None,
     reserve_bytes: int = XLA_RESERVE_BYTES,
@@ -137,14 +139,21 @@ def plan(
     """Price one train-step config (a models.llama LlamaConfig plus
     batch/seq) against a chip's HBM and return the
     :class:`MemoryPlan` verdict. ``fsdp`` divides the resident state
-    (params/optimizer/grads) ZeRO-3 style; ``hbm_gb`` overrides
-    capacity detection; ``grad_bucket_mb``/``compression`` price the
-    bucketed-overlap scratch when the sync path uses it."""
+    (params/optimizer/grads) ZeRO-3 style; ``zero`` divides the
+    OPTIMIZER state only — the cross-replica weight-update sharding of
+    arXiv:2004.13336 (train/zero.py): params stay full (the allgather
+    rebuilds them) and grads still materialize tree-wide in backward,
+    so only the adamw moments shrink. This lever is a measured claim:
+    bench_zero.py pins the ledger's resident bytes against it.
+    ``hbm_gb`` overrides capacity detection;
+    ``grad_bucket_mb``/``compression`` price the bucketed-overlap
+    scratch when the sync path uses it."""
     n_params = int(cfg.num_params())
     shard = max(1, int(fsdp))
+    opt_shard = shard * max(1, int(zero))
     params_bytes = n_params * PARAM_BYTES // shard
-    mu_bytes = n_params * _dtype_bytes(mu_dtype) // shard
-    optimizer_bytes = mu_bytes + n_params * NU_BYTES // shard
+    mu_bytes = n_params * _dtype_bytes(mu_dtype) // opt_shard
+    optimizer_bytes = mu_bytes + n_params * NU_BYTES // opt_shard
     grads_bytes = n_params * GRAD_BYTES // shard
     act_dtype = _dtype_bytes(cfg.dtype)
     boundary = cfg.n_layers * batch * seq * cfg.d_model * act_dtype

@@ -48,6 +48,13 @@ class TrainContext:
     grad_bucket_mb: float | None = None
     grad_error_feedback: bool = False
     _grad_bucketer: object = None
+    # ZeRO-sharded weight update (ScalingConfig.zero_sharding,
+    # arXiv:2004.13336): grad_sync_opts() reports zero=True and the
+    # step loop flips from allreduce-then-full-update to
+    # reduce-scatter → zero_optimizer().apply → allgather weights,
+    # holding ~1/world of the optimizer state resident per rank.
+    zero_sharding: bool = False
+    _zero_optimizer: object = None
     # This worker's node "slice" label (None off-slice): the fault
     # domain it dies with. Resolved by TrainWorker.setup through the
     # head node table; the RAY_TPU_SLICE_FAIL chaos knob and slice-
@@ -161,7 +168,48 @@ def grad_sync_opts(world: int | None = None) -> dict:
             opts["bucket_bytes"] = int(ctx.grad_bucket_mb * (1 << 20))
         if ctx.grad_error_feedback:
             opts["error_feedback"] = True
+    if ctx.zero_sharding:
+        # Like "overlap", "zero" is the step loop's signal, not an
+        # allreduce kwarg: pop it and switch to the sharded dataplane
+        # (grad_bucketer().sync_sharded_async + zero_optimizer()).
+        opts["zero"] = True
     return opts
+
+
+def zero_optimizer(optimizer=None, params=None):
+    """The cached :class:`~ray_tpu.train.zero.ZeroOptimizer` for this
+    worker group's ZeRO-sharded weight update
+    (``ScalingConfig(zero_sharding=True)``). The first call must pass
+    ``optimizer=`` and ``params=`` (shard-local state is initialized
+    from them, claiming ~1/world of the adamw bytes in the HBM
+    ledger); later calls return the cache — and, when ``params`` is
+    given and the context's (rank, world) moved under it (elastic
+    reform inside one process), repartition deterministically, closing
+    the stale shard's memory claim."""
+    ctx = get_context()
+    if not ctx.zero_sharding:
+        raise RuntimeError(
+            "zero sharding is off: start the trainer with "
+            "ScalingConfig(zero_sharding=True)"
+        )
+    cached = ctx._zero_optimizer
+    if cached is not None:
+        if params is not None and (
+            cached.world != ctx.world_size or cached.rank != ctx.rank
+        ):
+            cached.repartition(ctx.rank, ctx.world_size, params)
+        return cached
+    if optimizer is None or params is None:
+        raise RuntimeError(
+            "first zero_optimizer() call must pass optimizer= and "
+            "params= to initialize the shard-local state"
+        )
+    from ray_tpu.train.zero import ZeroOptimizer
+
+    ctx._zero_optimizer = ZeroOptimizer(
+        optimizer, params, ctx.rank, ctx.world_size
+    )
+    return ctx._zero_optimizer
 
 
 def grad_bucketer(group_name: str | None = None, world: int | None = None):

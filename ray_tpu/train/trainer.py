@@ -86,6 +86,18 @@ class ScalingConfig:
     grad_overlap: bool = False
     grad_bucket_mb: float | None = None
     grad_error_feedback: bool = False
+    # ZeRO-sharded weight update (arXiv:2004.13336): with zero_sharding
+    # on, session.grad_sync_opts() reports zero=True and the step loop
+    # flips gradient sync from allreduce → full update on every rank to
+    # reduce-scatter → shard-local optimizer update (train/zero.py,
+    # ~1/world of the adamw state resident per rank — the BENCH_8B
+    # capacity wall) → allgather updated weights. Leaf ownership is the
+    # checkpoint manifest's round-robin partition, so saving the
+    # sharded state via AsyncCheckpointer(local_prefixes=
+    # (zero.CKPT_PREFIX,)) needs no gather. Composes with
+    # grad_compression (+error feedback) and allow_partial_grads on
+    # the reduce hop; the gather hop ships exact weights, all-N.
+    zero_sharding: bool = False
 
     def bundle(self) -> dict:
         b = {"CPU": 1.0}
@@ -311,6 +323,9 @@ class TrainWorker:
             ),
             grad_error_feedback=(
                 backend_env.get("RAY_TPU_TRAIN_GRAD_ERROR_FEEDBACK") == "1"
+            ),
+            zero_sharding=(
+                backend_env.get("RAY_TPU_TRAIN_ZERO_SHARDING") == "1"
             ),
             slice_label=slice_label,
         )
@@ -713,6 +728,8 @@ class JaxTrainer:
             )
         if self.scaling.grad_error_feedback:
             env["RAY_TPU_TRAIN_GRAD_ERROR_FEEDBACK"] = "1"
+        if self.scaling.zero_sharding:
+            env["RAY_TPU_TRAIN_ZERO_SHARDING"] = "1"
         if self.scaling.distributed and n > 1:
             env["RAY_TPU_TRAIN_DISTRIBUTED"] = "1"
         return env

@@ -184,6 +184,53 @@ def test_rank_flow_through_helper_cross_file(tmp_path):
     assert [(v.rule, v.line) for v in violations] == [("TPU103", 4)]
 
 
+def test_jit_effects_wrapped_cross_file(tmp_path):
+    """TPU602's carried blind spot, closed: the side-effectful body is
+    defined in one module and jit()-wrapped in ANOTHER — the ZeRO step
+    layout (step.py defines the grad fn, the trainer wraps it). The
+    report lands in the DEFINING file, where the pragma would go."""
+    (tmp_path / "body.py").write_text(
+        "import logging\n"
+        "log = logging.getLogger('x')\n"
+        "def grad_step(params, batch):\n"
+        "    log.info('stepping')\n"
+        "    return params\n"
+    )
+    (tmp_path / "wrapper.py").write_text(
+        "import jax\n"
+        "from body import grad_step\n"
+        "step = jax.jit(grad_step)\n"
+    )
+    violations, errors = analyze_paths([str(tmp_path)])
+    assert not errors
+    hits = [(v.rule, v.path.split("/")[-1], v.line) for v in violations
+            if v.rule == "TPU602"]
+    assert hits == [("TPU602", "body.py", 4)]
+    assert "jit()-wrapped in wrapper" in violations[0].message
+    # Module-local wrapping still reports exactly once (no finalize
+    # double-count when run() already covered it).
+    (tmp_path / "wrapper.py").write_text(
+        "import jax\n"
+        "from body import grad_step\n"
+        "step = jax.jit(grad_step)\n"
+    )
+    (tmp_path / "local.py").write_text(
+        "import jax\n"
+        "import logging\n"
+        "log = logging.getLogger('y')\n"
+        "def fn(x):\n"
+        "    log.info('hi')\n"
+        "    return x\n"
+        "g = jax.jit(fn)\n"
+    )
+    violations, _ = analyze_paths([str(tmp_path)])
+    hits = sorted(
+        (v.path.split("/")[-1], v.line)
+        for v in violations if v.rule == "TPU602"
+    )
+    assert hits == [("body.py", 4), ("local.py", 5)]
+
+
 def test_fixture_labels():
     # 19 is pragma'd (reasoned allow): the escape hatch must work for
     # TPU403 like every other rule; bounded tags (lines 6/8/12) and the

@@ -1,0 +1,561 @@
+"""ZeRO-style sharded optimizer (arXiv:2004.13336): the tier-1 twin.
+
+Covers the ownership partition (the checkpoint manifest's round-robin,
+shared verbatim by the bucketer and the optimizer), the sharded
+dataplane on the mesh backend (reduce-scatter → shard-local update →
+allgather, composing with int8/EF, partial K-of-N, and per-hop
+ring/tree selection), the elastic-resize repartition (deterministic,
+no leaked memory Registration), the session/trainer knobs, the planner
+``zero=`` lever, the cpu-backend loss-parity + wire-floor twin that
+regression-guards BENCH_zero's capacity claim without TPU hardware —
+and a slow-marked run of bench_zero.py itself."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective import algo as colalgo
+from ray_tpu.collective.backends.xla_group import XlaMeshGroup
+from ray_tpu.collective.bucketer import GradBucketer
+from ray_tpu.train import zero
+
+
+@pytest.fixture(scope="module")
+def xg():
+    return XlaMeshGroup(name="zero_test")
+
+
+def _rank_trees(world, seed=0):
+    return [
+        {
+            f"w{li}": np.random.default_rng(seed + 10 * li + r).normal(
+                size=(32, 32)
+            ).astype(np.float32)
+            for li in range(8)
+        }
+        for r in range(world)
+    ]
+
+
+def _tree_sum(trees):
+    import jax
+
+    return jax.tree.map(
+        lambda *xs: np.sum(np.stack([np.asarray(x) for x in xs]), axis=0),
+        *trees,
+    )
+
+
+# ----------------------------------------------------------- partition
+def test_partition_matches_checkpoint_manifest():
+    """One partition, two consumers: the leaf set a rank owns under
+    zero.partition IS the set manifest.owned_items assigns it — the
+    property that makes sharded checkpoints gather-free."""
+    from ray_tpu.checkpoint import manifest
+
+    tree = {f"w{i}": np.zeros((2,), np.float32) for i in range(11)}
+    keys = [k for k, _ in manifest.flatten_with_keys(tree)]
+    for world in (1, 2, 3, 8):
+        owners = zero.partition(keys, world)
+        for rank in range(world):
+            manifest_keys = [
+                k for k, _ in manifest.owned_items(tree, rank, world)
+            ]
+            assert manifest_keys == [
+                k for k in keys if owners[k] == rank
+            ], (world, rank)
+
+
+def test_partition_deterministic_under_resize():
+    keys = [f"['x{i}']" for i in range(20)]
+    assert zero.partition(keys, 4) == zero.partition(list(reversed(keys)), 4)
+    # A resize is a pure function of (keys, world): every worker
+    # recomputes the same ownership with no coordination.
+    before = zero.partition(keys, 4)
+    after = zero.partition(keys, 3)
+    assert {k for k, o in after.items() if o == 2} == {
+        k for i, k in enumerate(sorted(keys)) if i % 3 == 2
+    }
+    assert before != after
+
+
+# ------------------------------------------------- sharded sync (mesh)
+def test_sharded_sync_owner_segments_and_parity(xg):
+    trees = _rank_trees(xg.world)
+    b = GradBucketer(group=xg, bucket_bytes=4 * 32 * 32 * 4)
+    pending = b.sync_sharded_async(trees)
+    expect = _tree_sum(trees)
+    owners = b.zero_owners([f"['w{li}']" for li in range(8)])
+    # Every bucket's layout places each leaf in its owner's segment.
+    for bucket in pending.buckets:
+        for name, owner, off, size, _shape in bucket.layout:
+            assert owner == owners[name]
+            assert off + size <= bucket.seg_len
+    owned = pending.wait()
+    # Single-controller mesh: the controller sees every owner's chunk.
+    assert sorted(owned) == sorted(owners)
+    for li in range(8):
+        np.testing.assert_allclose(
+            np.asarray(owned[f"['w{li}']"]), expect[f"w{li}"],
+            rtol=1e-4, atol=1e-5,
+        )
+    # Gather the "updated" weights (mean grads) and rebuild the tree.
+    updated = {k: np.asarray(v) / xg.world for k, v in owned.items()}
+    gathered = pending.allgather_updated(updated).wait()
+    tree = b.zero_unflatten(trees, gathered)
+    for li in range(8):
+        np.testing.assert_allclose(
+            tree[f"w{li}"], expect[f"w{li}"] / xg.world,
+            rtol=1e-4, atol=1e-5,
+        )
+    # In-flight scratch fully released at the joins.
+    assert b._scratch_bytes == 0
+
+
+def test_sharded_sync_algo_selection_both_hops(xg):
+    """The crossover selector routes BOTH hops: small buckets take the
+    latency plane (tree), large ones the ring — and partial mode pins
+    the reduce hop to the default plane while the gather keeps its
+    selection (it never runs partial)."""
+    crossover = colalgo.crossover_bytes(xg.world)
+    big = np.zeros((xg.world * crossover // 4,), np.float32)
+    small = np.zeros((16,), np.float32)
+    trees = [
+        {"zbig": big + r, "asmall": small + r} for r in range(xg.world)
+    ]
+    b = GradBucketer(group=xg, bucket_bytes=crossover)
+    pending = b.sync_sharded_async(trees)
+    by_leaf = {bk.names[0]: bk for bk in pending.buckets}
+    pending.wait()
+    assert by_leaf["['asmall']"].algo_rs == colalgo.TREE
+    assert by_leaf["['zbig']"].algo_rs == colalgo.RING
+    bp = GradBucketer(group=xg, bucket_bytes=crossover, min_ranks=2)
+    pp = bp.sync_sharded_async(trees)
+    assert all(bk.algo_rs is None for bk in pp.buckets)
+    assert all(bk.algo_ag is not None for bk in pp.buckets)
+    pp.wait()
+
+
+def test_sharded_sync_partial_reduce_hop(xg):
+    """min_ranks + skip_ranks compose on the reduce-scatter hop: the
+    masked psum_scatter rescales by world/K and the PendingZeroSync
+    aggregates the skips; the weight gather stays exact all-N."""
+    trees = [
+        {f"w{li}": np.full((32,), float(r + 1), np.float32)
+         for li in range(4)}
+        for r in range(xg.world)
+    ]
+    b = GradBucketer(group=xg, bucket_bytes=1 << 20, min_ranks=2)
+    pending = b.sync_sharded_async(trees)
+    # Mesh partial is explicit-skip (drain notices / chaos): re-issue
+    # through the group to exercise the mask, then check the envelope.
+    from ray_tpu.collective.types import PartialResult
+
+    payload = [np.full((xg.world * 8,), float(r + 1), np.float32)
+               for r in range(xg.world)]
+    res = xg.reducescatter(payload, min_ranks=2, skip_ranks=[1])
+    assert isinstance(res, PartialResult)
+    assert res.skipped == [1]
+    contributed = [r + 1 for r in range(xg.world) if r != 1]
+    expect = sum(contributed) * xg.world / len(contributed)
+    np.testing.assert_allclose(
+        np.asarray(res.value[0]), np.full((8,), expect), rtol=1e-5
+    )
+    pending.wait()
+
+
+def test_sharded_sync_compressed_with_error_feedback(xg):
+    trees = _rank_trees(xg.world, seed=3)
+    b = GradBucketer(
+        group=xg, bucket_bytes=1 << 20, compression="int8",
+        error_feedback=True,
+    )
+    pending = b.sync_sharded_async(trees)
+    assert all(bk.compression == "int8" for bk in pending.buckets)
+    owned = pending.wait()
+    expect = _tree_sum(trees)
+    arr = np.asarray(owned["['w0']"])
+    scale = np.max(np.abs(expect["w0"]))
+    assert np.max(np.abs(arr - expect["w0"])) / scale < 0.05
+
+
+# ------------------------------------------- ZeroOptimizer + resize
+def test_zero_optimizer_apply_and_repartition_no_leaked_claim():
+    """Satellite: a world-size change re-partitions ownership
+    deterministically, keeps still-owned states, and REPLACES the
+    memory claim — the stale shard's Registration is closed, never
+    leaked, and the ledger's optimizer bytes track the new shard."""
+    import optax
+
+    from ray_tpu.runtime import memory as rmem
+
+    rmem.clear_registry()
+    params = {f"w{i}": np.ones((64,), np.float32) for i in range(8)}
+    zo = zero.ZeroOptimizer(optax.adam(1e-2), params, rank=0, world=4)
+    try:
+        assert len(zo.states) == 2  # 8 leaves / 4 ranks
+        first_reg = zo._mem_reg
+        assert first_reg is not None
+        assert rmem.registered_bytes()["optimizer"] == zo.shard_bytes()
+
+        grads = {k: np.full((64,), 2.0, np.float32)
+                 for k in zo.owned_keys()}
+        updated = zo.apply(grads, params)
+        assert sorted(updated) == sorted(zo.owned_keys())
+        kept_key = next(iter(zo.owned_keys()))
+        kept_state = zo.states[kept_key]
+
+        zo.repartition(0, 2, params)  # world 4 -> 2
+        assert len(zo.states) == 4
+        # Still-owned leaf keeps its moments (the restore-free case).
+        assert zo.states[kept_key] is kept_state
+        # Deterministic: a fresh instance at the same (rank, world)
+        # owns the same keys (distinct tag: same-tag tracking would
+        # replace the live claim under test).
+        zo2 = zero.ZeroOptimizer(
+            optax.adam(1e-2), params, 0, 2, mem_tag="test.zero2"
+        )
+        assert zo2.owned_keys() == zo.owned_keys()
+        zo2.close()
+        # The old Registration was closed and replaced, not leaked.
+        assert first_reg._closed
+        regs = [
+            r for r in rmem._registry.values()
+            if r.tag == "train.state.optimizer"
+        ]
+        assert len(regs) == 1
+        assert rmem.registered_bytes()["optimizer"] == zo.shard_bytes()
+    finally:
+        zo.close()
+        rmem.clear_registry()
+
+
+def test_zero_optimizer_missing_grad_raises():
+    import optax
+
+    params = {"a": np.ones((4,), np.float32),
+              "b": np.ones((4,), np.float32)}
+    zo = zero.ZeroOptimizer(optax.adam(1e-2), params, 0, 1)
+    try:
+        with pytest.raises(KeyError, match="no gradient for owned"):
+            zo.apply({}, params)
+    finally:
+        zo.close()
+
+
+def test_init_zero_train_state_ledger_attribution():
+    """train/step.py init_zero_train_state claims params at full size
+    and the optimizer at SHARD size in the memory ledger."""
+    import jax
+
+    from ray_tpu.runtime import memory as rmem
+    from ray_tpu.models import PRESETS
+    from ray_tpu.train.step import init_zero_train_state, make_optimizer
+
+    rmem.clear_registry()
+    cfg = PRESETS["tiny"]
+    opt = make_optimizer(total_steps=10)
+    params, zo = init_zero_train_state(
+        jax.random.key(0), cfg, opt, rank=0, world=4
+    )
+    try:
+        by_kind = rmem.registered_bytes()
+        import numpy as _np
+
+        params_bytes = sum(
+            _np.asarray(v).nbytes for v in zo.leaf_map(params).values()
+        )
+        assert by_kind["params"] == params_bytes
+        assert by_kind["optimizer"] == zo.shard_bytes()
+        # The shard is a strict fraction of the replicated state.
+        assert 0 < by_kind["optimizer"] < 1.5 * params_bytes
+    finally:
+        zo.close()
+        rmem.clear_registry()
+
+
+# ------------------------------------------------- session / trainer
+def test_grad_sync_opts_zero_mode_and_accessor():
+    import optax
+
+    from ray_tpu import train
+    from ray_tpu.train.session import TrainContext, _set_context
+
+    ctx = TrainContext(world_size=4, rank=1, zero_sharding=True)
+    _set_context(ctx)
+    try:
+        opts = train.grad_sync_opts()
+        assert opts.pop("zero") is True
+        assert opts == {}
+        params = {f"w{i}": np.ones((8,), np.float32) for i in range(8)}
+        with pytest.raises(RuntimeError, match="first zero_optimizer"):
+            train.zero_optimizer()
+        zo = train.zero_optimizer(optax.adam(1e-2), params)
+        assert zo.rank == 1 and zo.world == 4
+        assert train.zero_optimizer() is zo
+        # Context resize → the accessor repartitions the cached shard.
+        ctx.world_size = 2
+        ctx.rank = 0
+        zo2 = train.zero_optimizer(params=params)
+        assert zo2 is zo
+        assert zo.world == 2 and zo.rank == 0
+        zo.close()
+    finally:
+        _set_context(None)
+
+
+def test_grad_sync_opts_default_has_no_zero():
+    from ray_tpu import train
+    from ray_tpu.train.session import TrainContext, _set_context
+
+    _set_context(TrainContext(world_size=4))
+    try:
+        assert "zero" not in train.grad_sync_opts()
+    finally:
+        _set_context(None)
+
+
+def test_scaling_config_env_plumbing():
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    t = JaxTrainer(
+        lambda: None,
+        scaling_config=ScalingConfig(num_workers=2, zero_sharding=True),
+    )
+    env = t._backend_env(0)
+    assert env["RAY_TPU_TRAIN_ZERO_SHARDING"] == "1"
+    t2 = JaxTrainer(lambda: None)
+    assert "RAY_TPU_TRAIN_ZERO_SHARDING" not in t2._backend_env(0)
+
+
+# ------------------------------------------------------- planner lever
+def test_planner_zero_lever():
+    """plan(zero=N) divides the optimizer state ONLY (params and grads
+    stay full — ZeRO-1 honesty) and flips [6,1] to fits, the BENCH_8B
+    wall the sharded optimizer removes."""
+    import dataclasses as dc
+
+    from ray_tpu.models import PRESETS
+    from ray_tpu.train.memory import plan
+
+    cfg = dc.replace(
+        PRESETS["llama3_8b"], n_layers=6, vocab_size=8192,
+        attn_impl="flash", remat="full",
+    )
+    base = plan(cfg, 1, 4096, mu_dtype="bfloat16", hbm_gb=16.0)
+    sharded = plan(cfg, 1, 4096, mu_dtype="bfloat16", hbm_gb=16.0,
+                   zero=8)
+    assert sharded.params_bytes == base.params_bytes
+    assert sharded.grads_bytes == base.grads_bytes
+    assert sharded.optimizer_bytes == pytest.approx(
+        base.optimizer_bytes / 8, rel=1e-6
+    )
+    assert sharded.fits and not base.fits
+
+
+def test_bench_zero_json_pins_capacity_and_parity():
+    """BENCH_zero.json is the acceptance artifact: a larger config
+    than BENCH_8B's [4,2] fits the same 16 GB chip (measured peak +
+    planner match on every row, worst owner included), wire bytes/step
+    of the sharded path ≤ the allreduce path, and the sharded loss is
+    EXACTLY the unsharded loss on the hub plane."""
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "BENCH_zero.json"
+    )
+    rec = json.loads(open(path).read())
+    assert rec["ok"] is True
+    cap = rec["capacity"]
+    assert cap["config"] == [6, 1]  # > BENCH_8B's [4,2]
+    assert cap["fits_16gb"] is True
+    assert cap["peak_hbm_gb"] is not None
+    assert cap["peak_hbm_gb"] < 16.0
+    assert cap["opt_shard_max_gb"] < cap["opt_replicated_gb"]
+    pb = rec["planner"]
+    assert pb["all_match"] is True
+    assert any("WORST owner" in row["config"] for row in pb["configs"])
+    for row in pb["configs"]:
+        assert row["match"] is True
+    dp = rec["dataplane"]
+    assert dp["loss_parity_exact"] is True
+    assert dp["loss_gap_hub"] == 0.0
+    assert dp["wire_le_allreduce"] is True
+    assert dp["wire_ratio_zero_vs_allreduce"] <= 1.0
+
+
+# --------------------------------------------- cpu-backend parity twin
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class ZeroMember:
+    def setup(self, world, rank, group):
+        import ray_tpu.collective as col
+
+        col.init_collective_group(
+            world, rank, backend="cpu", group_name=group, timeout_s=30
+        )
+        self.world, self.rank, self.group = world, rank, group
+        return rank
+
+    def train(self, mode, steps, algo):
+        """Two deterministic SGD steps on a toy quadratic; returns the
+        final params checksum and measured wire bytes per step."""
+        import numpy as np
+
+        from ray_tpu.collective.bucketer import GradBucketer
+        from ray_tpu.collective.flight_recorder import WIRE_BYTES
+        from ray_tpu.train.zero import ZeroOptimizer
+
+        class _Sgd:
+            @staticmethod
+            def init(leaf):
+                return ()
+
+        def wire(verbs):
+            return sum(
+                WIRE_BYTES.value(
+                    {"group": self.group, "verb": v, "dtype": "float32"},
+                    default=0.0,
+                ) or 0.0
+                for v in verbs
+            )
+
+        rng = np.random.default_rng(11)  # same init on every rank
+        params = {
+            f"w{i}": rng.normal(size=(512,)).astype(np.float32)
+            for i in range(8)
+        }
+        b = GradBucketer(
+            group_name=self.group, bucket_bytes=4 * 512 * 4, algo=algo
+        )
+        zo = (
+            ZeroOptimizer(_Sgd(), params, self.rank, self.world)
+            if mode == "zero" else None
+        )
+        verbs = (
+            ("allreduce",) if mode == "allreduce"
+            else ("reducescatter", "allgather")
+        )
+        w0 = wire(verbs)
+        for _ in range(steps):
+            grads = {
+                k: (v * 0.1 + self.rank).astype(np.float32)
+                for k, v in params.items()
+            }
+            if mode == "allreduce":
+                synced = b.unflatten(
+                    grads, b.sync_async(grads).wait(timeout_s=30)
+                )
+                # Same fp op order as the zero leg's grad_scale
+                # multiply: scale first, then the SGD step.
+                params = {
+                    k: (
+                        params[k]
+                        - 0.1 * (
+                            np.asarray(synced[k]) * (1.0 / self.world)
+                        )
+                    ).astype(np.float32)
+                    for k in params
+                }
+            else:
+                pending = b.sync_sharded_async(grads)
+                owned = pending.wait(timeout_s=30)
+                updated = zo.apply(
+                    owned, params, grad_scale=1.0 / self.world,
+                    update_fn=lambda _k, g, _st, p: (
+                        (p - 0.1 * g).astype(np.float32), ()
+                    ),
+                )
+                params = b.zero_unflatten(
+                    params,
+                    pending.allgather_updated(
+                        updated, timeout_s=30
+                    ).wait(timeout_s=30),
+                )
+        return {
+            "checksum": [
+                float(np.asarray(params[k], np.float64).sum())
+                for k in sorted(params)
+            ],
+            "wire_per_step": (wire(verbs) - w0) / steps,
+            "opt_leaves": (
+                len(zo.states) if zo is not None else len(params)
+            ),
+        }
+
+
+def test_cpu_twin_loss_parity_and_wire_floor(cluster):
+    """The BENCH_zero regression guard in tier-1: on the hub plane the
+    sharded schedule is bitwise the allreduce schedule (gap == 0); on
+    the ring planes its two hops move no more bytes than the ring
+    allreduce — and each rank holds only its share of optimizer
+    state. world=4 with 4 same-size leaves per bucket is the
+    owner-BALANCED layout the wire property is specified for (an
+    unbalanced bucket pays segment padding — see sync_sharded_async)."""
+    world = 4
+    members = [ZeroMember.remote() for _ in range(world)]
+    ray_tpu.get(
+        [m.setup.remote(world, i, "zerotwin") for i, m in
+         enumerate(members)],
+        timeout=30,
+    )
+    out = {}
+    for mode, algo in (
+        ("allreduce", None), ("zero", None),
+        ("allreduce", "ring"), ("zero", "ring"),
+    ):
+        out[(mode, algo)] = ray_tpu.get(
+            [m.train.remote(mode, 2, algo) for m in members], timeout=60
+        )
+    # Hub plane: EXACT parity, every rank.
+    for a, z in zip(out[("allreduce", None)], out[("zero", None)]):
+        assert a["checksum"] == z["checksum"]
+    # Ring plane: wire floor (sharded <= allreduce) + close parity.
+    ar = out[("allreduce", "ring")]
+    zr = out[("zero", "ring")]
+    for a, z in zip(ar, zr):
+        assert z["wire_per_step"] <= a["wire_per_step"]
+        np.testing.assert_allclose(
+            z["checksum"], a["checksum"], rtol=1e-6
+        )
+    # 8 leaves over 4 ranks: shard size 2 everywhere, never the full 8.
+    sizes = sorted(z["opt_leaves"] for z in zr)
+    assert sizes == [2, 2, 2, 2]
+    assert all(a["opt_leaves"] == 8 for a in ar)
+
+
+@pytest.mark.slow
+def test_bench_zero_runs_end_to_end(tmp_path):
+    """Slow gate: bench_zero.py itself (dataplane leg — the capacity
+    leg needs ~5 min of fwd+bwd on a real llama config and is covered
+    by the pinned JSON + planner tests above)."""
+    import subprocess
+    import sys
+
+    env = dict(
+        os.environ,
+        BENCH_ZERO_SKIP_CAPACITY="1",
+        BENCH_ZERO_OUT=os.path.join(str(tmp_path), "BENCH_zero.json"),
+        JAX_PLATFORMS="cpu",
+    )
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "bench_zero.py")],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=560,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(
+        open(os.path.join(str(tmp_path), "BENCH_zero.json")).read()
+    )
+    assert rec["dataplane"]["loss_parity_exact"] is True

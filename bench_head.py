@@ -1,0 +1,141 @@
+"""Head-survival bench: the simulated-1000-node harness with the
+acceptance pins applied.
+
+Thin wrapper over ``ray_tpu._private.scale_sim`` (which does the real
+work: registration storm, idle + contended control-RTT baselines, an
+unthrottled overdrive flood that calibrates fold throughput and proves
+the bounded queue sheds, a throttled 2x-overload leg where control-RPC
+p99 must hold, a 32-node slice mass death whose fan-out must coalesce,
+and a mid-load head SIGKILL with journal-replay + jittered-backoff
+recovery). This wrapper runs it at full scale in a subprocess, applies
+the pinned pass/fail criteria, and writes ``BENCH_head.json``.
+
+Pins (FAIL lines + exit 1 on violation):
+
+- overdrive overload_factor >= 2 with shed_total > 0 and the overload
+  alert observed — the queue is genuinely bounded;
+- 2x-overload control p99 within 5x baseline (idle or contended,
+  whichever is kinder: on a single shared core the load generator's own
+  CPU burn inflates every RTT, and the contended baseline exists to
+  subtract exactly that) while still shedding;
+- mass-death fan-out pushed frames << logical msgs x subscribers
+  (coalesce ratio <= 0.25 — measured ~0.02);
+- SIGKILL recovery: first RPC answered <= 15 s, every surviving node
+  re-registered <= 60 s, journal records replayed, backoff jitter
+  spread observed (> 50 ms across the reconnect storm).
+
+Run: ``python bench_head.py [--nodes N] [--overload-s S]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def run_sim(args) -> dict:
+    out = os.path.join(tempfile.mkdtemp(prefix="bench-head-"),
+                       "scale.json")
+    cmd = [
+        sys.executable, "-m", "ray_tpu._private.scale_sim",
+        "--nodes", str(args.nodes),
+        "--slice-nodes", str(args.slice_nodes),
+        "--subscribers", str(args.subscribers),
+        "--overload-s", str(args.overload_s),
+        "--journal-keys", str(args.journal_keys),
+        "--out", out,
+    ]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(cmd, env=env, cwd=REPO)
+    if proc.returncode != 0:
+        raise SystemExit(f"scale_sim failed (exit {proc.returncode})")
+    with open(out) as f:
+        return json.load(f)
+
+
+def apply_pins(doc: dict) -> list[str]:
+    failures: list[str] = []
+
+    def pin(ok: bool, msg: str):
+        if not ok:
+            failures.append(msg)
+
+    ov = doc.get("overload", {})
+    pin(ov.get("overload_factor", 0) >= 2.0,
+        f"overdrive factor {ov.get('overload_factor')} < 2x")
+    pin(ov.get("shed_total", 0) > 0, "overdrive leg never shed")
+    pin(bool(ov.get("alert_seen")), "overload alert never fired")
+
+    o2 = doc.get("overload_2x", {})
+    vs = min(o2.get("p99_vs_idle", 1e9),
+             o2.get("p99_vs_contended", 1e9))
+    pin(vs <= 5.0,
+        f"2x-overload control p99 {o2.get('control_p99_ms')}ms is "
+        f"{vs}x baseline (> 5x)")
+    pin(o2.get("shed_total", 0) > 0, "2x-overload leg never shed")
+    pin(o2.get("overload_factor", 0) >= 1.5,
+        f"2x leg realized factor {o2.get('overload_factor')} — "
+        f"head was not meaningfully overloaded")
+
+    md = doc.get("mass_death", {})
+    ratio = md.get("coalesce_ratio", 1.0)
+    pin(ratio <= 0.25,
+        f"death fan-out coalesce ratio {ratio} > 0.25 "
+        f"({md.get('pushed_frames')} frames for "
+        f"{md.get('naive_frames')} naive)")
+
+    rc = doc.get("sigkill_recovery", {})
+    pin(rc.get("first_rpc_s", 1e9) <= 15.0,
+        f"head answered first RPC {rc.get('first_rpc_s')}s after "
+        f"restart (> 15s)")
+    pin(rc.get("full_reconnect_s", 1e9) <= 60.0,
+        f"full re-registration took {rc.get('full_reconnect_s')}s "
+        f"(> 60s)")
+    pin(rc.get("reconnected") == rc.get("expected"),
+        f"only {rc.get('reconnected')}/{rc.get('expected')} nodes "
+        f"re-registered")
+    pin(rc.get("replayed_records", 0) > 0,
+        "journal replayed zero records after SIGKILL")
+    pin(rc.get("backoff_spread_s", 0) > 0.05,
+        f"reconnect backoff spread {rc.get('backoff_spread_s')}s — "
+        f"jitter not observed")
+
+    pin(bool(doc.get("ok")), "harness reported not-ok")
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1000)
+    ap.add_argument("--slice-nodes", type=int, default=32)
+    ap.add_argument("--subscribers", type=int, default=8)
+    ap.add_argument("--overload-s", type=float, default=5.0)
+    ap.add_argument("--journal-keys", type=int, default=2000)
+    ap.add_argument("--output",
+                    default=os.path.join(REPO, "BENCH_head.json"))
+    args = ap.parse_args()
+
+    doc = run_sim(args)
+    failures = apply_pins(doc)
+    doc["pins"] = {"failures": failures, "passed": not failures}
+
+    with open(args.output, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc["pins"], indent=1))
+    print(f"wrote {args.output}")
+    for msg in failures:
+        print(f"FAIL: {msg}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -67,6 +67,45 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                             "grows past this size"),
     "HEAD_RECONNECT_S": (float, 20.0, "how long clients retry head calls "
                                       "across a head restart"),
+    # --- control-plane overload protection
+    "HEAD_FOLD_QUEUE_MAX": (int, 20000, "bounded head telemetry fold "
+                                        "queue: add_task_events batches "
+                                        "queue here and fold off the "
+                                        "dispatch path; when full the "
+                                        "OLDEST events shed "
+                                        "(ray_tpu_head_shed_total) "
+                                        "rather than stall control RPCs"),
+    "HEAD_SNAPSHOT_WATERMARK_BYTES": (int, 4 << 20, "journal bytes "
+                                      "appended since the last snapshot "
+                                      "before compaction fires "
+                                      "regardless of the 2x floor "
+                                      "guard — bounds restart-replay "
+                                      "depth when the tables themselves "
+                                      "are large (1000-node regime)"),
+    "RPC_BACKOFF_BASE_S": (float, 0.2, "reconnect backoff base: attempt "
+                                       "n sleeps uniform(0, min(cap, "
+                                       "base*2^n)) — full jitter so a "
+                                       "head restart's re-dial herd "
+                                       "spreads instead of spiking"),
+    "RPC_BACKOFF_MAX_S": (float, 5.0, "per-sleep cap on the jittered "
+                                      "exponential reconnect backoff"),
+    "RPC_RECONNECT_ATTEMPTS": (int, 64, "cap on reconnect attempts per "
+                                        "call (0 = bounded only by the "
+                                        "HEAD_RECONNECT_S deadline)"),
+    "HEAD_NICE": (int, 0, "daemonized head only: renice the head "
+                          "process to this value (e.g. -5) so control "
+                          "RPCs win CPU contention against co-located "
+                          "data-plane work; 0 = leave priority alone; "
+                          "negative values need privileges and degrade "
+                          "to a warning without them"),
+    "HEAD_GC_FREEZE": (bool, True, "daemonized head only: gc.freeze() "
+                                   "after boot + raised gen0 threshold "
+                                   "— at 100k+ telemetry events/s the "
+                                   "default (700,10,10) cadence runs "
+                                   "full gen2 passes ~2/s, each "
+                                   "scanning every module object plus "
+                                   "the queued event dicts: tens-of-ms "
+                                   "control-RPC tail spikes"),
     # --- rpc hardening
     "AUTH_TOKEN": (str, "", "shared-secret connection token; empty "
                             "disables auth (the start CLI generates one "
@@ -134,6 +173,13 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: comma-separated method:prob "
                              "list ('*' matches any method)"),
+    "HEAD_STALL": (str, "", "chaos spec: comma-separated "
+                            "'method:seconds' — the head sleeps that "
+                            "long inside each matching RPC handler "
+                            "('*' matches any method, 'fold' stalls "
+                            "the telemetry fold worker instead); "
+                            "deterministic overload/starvation "
+                            "injection for the admission-class tests"),
     "PREEMPT_AFTER_S": (str, "", "chaos spec: '<delay_s>[@<substr>]' — "
                                  "synthetic preemption notice: a node "
                                  "whose node_id/addr contains <substr> "

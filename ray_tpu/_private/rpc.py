@@ -329,8 +329,19 @@ class Connection:
         fut = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         try:
-            _write_frame(self.writer, (REQ, req_id, (method, kw)))
-            await self.writer.drain()
+            try:
+                _write_frame(self.writer, (REQ, req_id, (method, kw)))
+                await self.writer.drain()
+            except OSError as e:
+                # A transport torn down between the recv loop noticing
+                # and this send surfaces as a raw ConnectionResetError
+                # from drain(); reconnecting callers key on
+                # ConnectionLost, and a raw OSError would skip their
+                # retry loop. sent stays unknowable (default True):
+                # bytes queued before the loss may have been delivered.
+                raise ConnectionLost(
+                    f"connection to {self.peer} lost mid-call: {e}"
+                ) from e
             return await asyncio.wait_for(fut, timeout)
         finally:
             # Covers encode failures too (strict msgpack raising on a
@@ -514,12 +525,45 @@ async def connect(
             # ConnectionLost so retry loops keyed on RpcError survive
             # transient outages.
             last = e
-            await asyncio.sleep(retry_delay * (2**attempt))
+            # Jittered: a herd of clients dialing a restarted peer must
+            # not re-knock in lockstep.
+            await asyncio.sleep(
+                retry_delay * (2**attempt) * (0.5 + random.random())
+            )
     err = ConnectionLost(f"cannot connect to {addr}: {last}")
     # A failed dial provably never put the request on the wire: let
     # at-most-once callers (retry=False) safely re-send later.
     err.sent = False
     raise err
+
+
+def backoff_delay(
+    attempt: int,
+    base: float | None = None,
+    cap: float | None = None,
+    rng: "random.Random | None" = None,
+) -> float:
+    """Full-jitter exponential backoff: uniform(0, min(cap, base*2^n)).
+
+    The jitter is the point, not a refinement: after a head restart
+    every node, driver, and replica re-dials through
+    ReconnectingClient at once, and a deterministic schedule (the old
+    fixed 0.3s) re-knocks in lockstep — a thundering herd that can
+    re-crash the head exactly when it is replaying its journal. A
+    uniform draw over the whole window spreads the herd across it.
+    """
+    from ray_tpu._private import config
+
+    if base is None:
+        base = config.get("RPC_BACKOFF_BASE_S")
+    if cap is None:
+        cap = config.get("RPC_BACKOFF_MAX_S")
+    # 2**min(n, 16) keeps the ceiling finite for pathological attempt
+    # counts; the cap dominates long before that.
+    ceiling = min(float(cap), float(base) * (2 ** min(max(attempt, 0), 16)))
+    if ceiling <= 0:
+        return 0.0
+    return (rng or random).uniform(0.0, ceiling)
 
 
 class ReconnectingClient:
@@ -593,7 +637,10 @@ class ReconnectingClient:
         at-least-once)."""
         import time as _time
 
+        from ray_tpu._private import config
+
         deadline = _time.monotonic() + self.reconnect_timeout
+        attempts = 0
         while True:
             try:
                 conn = await self._ensure()
@@ -611,7 +658,15 @@ class ReconnectingClient:
                     self._conn is None or self._conn._closed
                 ):
                     raise
-                await asyncio.sleep(0.3)
+                attempts += 1
+                max_attempts = config.get("RPC_RECONNECT_ATTEMPTS")
+                if max_attempts and attempts >= max_attempts:
+                    raise
+                # Jittered exponential backoff (not the old fixed
+                # 0.3s): a cluster-wide reconnect herd after a head
+                # restart spreads instead of spiking — see
+                # backoff_delay.
+                await asyncio.sleep(backoff_delay(attempts - 1))
 
     async def close(self):
         self._closed = True

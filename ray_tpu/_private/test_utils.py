@@ -43,6 +43,40 @@ def straggler_delay_for_rank(rank: int) -> float:
     return parse_straggler_spec(spec).get(rank, 0.0)
 
 
+def parse_head_stall_spec(spec: str) -> "dict[str, float]":
+    """Parse the RAY_TPU_HEAD_STALL chaos spec (same comma-separated
+    env-spec family as RAY_TPU_STRAGGLER_DELAY):
+    ``"method:seconds[,method:seconds,…]"`` — the head sleeps that long
+    inside each matching RPC handler before dispatch. ``"*"`` matches
+    any method; the pseudo-method ``"fold"`` stalls the background
+    telemetry fold worker instead (the deterministic way to back up the
+    bounded fold queue). Malformed entries are ignored (chaos must
+    never crash the head)."""
+    out: dict[str, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        method, _, secs = entry.partition(":")
+        try:
+            out[method] = float(secs)
+        except ValueError:
+            continue
+    return out
+
+
+def head_stall_for(method: str) -> float:
+    """Injected latency for one head RPC (0.0 = none). Read per call so
+    tests can flip RAY_TPU_HEAD_STALL at runtime."""
+    from ray_tpu._private import config
+
+    spec = config.get("HEAD_STALL")
+    if not spec:
+        return 0.0
+    stalls = parse_head_stall_spec(spec)
+    return stalls.get(method, stalls.get("*", 0.0))
+
+
 def parse_slice_fail_spec(spec: str) -> "dict[int, tuple[str, float]]":
     """Parse the RAY_TPU_SLICE_FAIL chaos spec (same comma-separated
     env-spec family as RAY_TPU_STRAGGLER_DELAY): ``"slice:when[,…]"``

@@ -242,25 +242,43 @@ class Autoscaler:
                 self._launch(ntype)
                 counts[ntype] = counts.get(ntype, 0) + 1
         # Reap provider-owned drained nodes. Ignores min_workers — the
-        # replacement is already tracked against the same type.
+        # replacement is already tracked against the same type. Nodes
+        # sharing a draining SLICE reap as ONE provider call
+        # (terminate_nodes) once the whole unit is empty/expired: the
+        # slice tears down as the unit it was provisioned as, not N
+        # per-host API round-trips.
+        unit_members: dict[str, list[str]] = {}
+        unit_ready: dict[str, list[str]] = {}
         for pid, tracked in list(self._tracked.items()):
             rid = self.provider.runtime_node_id(pid)
             if rid is None or rid not in draining:
                 continue
             node = nodes.get(rid)
+            unit = self._drain_unit(rid, node or {})
+            unit_members.setdefault(unit, []).append(pid)
             emptied = node is not None and not node.get("pending") and all(
                 node["available"].get(k, 0) >= v
                 for k, v in node["resources"].items()
             )
             expired = now_wall > draining[rid].get("deadline_ts", 0.0)
             if node is None or emptied or expired:
-                logger.info(
-                    "terminating drained node %s (%s)", pid, tracked.node_type
-                )
-                try:
-                    self.provider.terminate_node(pid)
-                finally:
-                    del self._tracked[pid]
+                unit_ready.setdefault(unit, []).append(pid)
+        for unit, pids in unit_ready.items():
+            if len(pids) < len(unit_members[unit]):
+                # Part of the slice still holds work inside its notice
+                # window: the unit reaps together on a later tick (the
+                # drain deadline bounds the wait).
+                continue
+            logger.info(
+                "terminating drained %s as one unit: %s",
+                unit if unit.startswith("slice:") else f"node {unit[:12]}",
+                pids,
+            )
+            try:
+                self.provider.terminate_nodes(pids)
+            finally:
+                for pid in pids:
+                    self._tracked.pop(pid, None)
         # Forget replacement markers for units no longer draining/alive.
         self._drain_replaced &= {
             self._drain_unit(nid, nodes.get(nid, {})) for nid in draining

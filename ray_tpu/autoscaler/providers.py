@@ -23,6 +23,14 @@ class NodeProvider:
     def terminate_node(self, provider_node_id: str) -> None:
         raise NotImplementedError
 
+    def terminate_nodes(self, provider_node_ids: "list[str]") -> None:
+        """Terminate a batch in one shot — the autoscaler reaps a
+        fully-drained slice through this so providers with a unit-level
+        API (queued resources, MIG deleteInstances) tear the slice down
+        as ONE call. Default: per-node teardown."""
+        for pid in provider_node_ids:
+            self.terminate_node(pid)
+
     def non_terminated_nodes(self) -> dict[str, str]:
         """provider_node_id → node_type."""
         raise NotImplementedError

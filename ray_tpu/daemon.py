@@ -206,6 +206,31 @@ async def _run_head(args) -> None:
     )
     head = HeadService(journal_path=journal)
     addr = await head.start(host=args.host, port=args.port)
+    if config.get("HEAD_GC_FREEZE"):
+        # Tail-latency discipline for the dedicated head process: after
+        # boot + journal restore, move everything live so far into the
+        # permanent generation (gen2 passes then scan only post-boot
+        # garbage, not every module object) and raise gen0 so a
+        # telemetry flood's allocation churn doesn't cascade collector
+        # passes into the RPC dispatch path. Cyclic garbage still gets
+        # collected — just on an amortized cadence.
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        gc.set_threshold(50_000, 25, 25)
+    nice_target = config.get("HEAD_NICE")
+    if nice_target:
+        # On a shared host the head competes with data-plane work for
+        # CPU; when both are saturated, every control RPC waits a full
+        # scheduler rotation behind its neighbours. Elevated priority
+        # keeps the control plane responsive — best effort (negative
+        # values need CAP_SYS_NICE).
+        try:
+            os.setpriority(os.PRIO_PROCESS, 0, nice_target)
+        except OSError as e:
+            logger.warning("HEAD_NICE=%s not applied: %s",
+                           nice_target, e)
     # Workers this node spawns need the journal off (only the head
     # process owns it) but the cluster address on.
     config.set_system_config({"ADDRESS": addr})

@@ -75,6 +75,14 @@ def _memory():
     return state.mem_stats()
 
 
+@_route("/api/head")
+def _head():
+    """Head control-plane load: telemetry fold-queue depth, shed
+    counter, overload alert, pubsub coalescing counters, and journal
+    size/compaction state."""
+    return state.head_stats()
+
+
 @_route("/api/checkpoints")
 def _checkpoints():
     """In-cluster shard-store checkpoints: per-run steps with

@@ -111,6 +111,27 @@ class tqdm:
 _display: dict = {}
 
 
+def _render_payload(payload):
+    if payload.get("channel") != "tqdm":
+        return
+    # Coalesced ticks arrive as a "batch" list; render each.
+    for msg in payload.get("batch") or [payload.get("msg", {})]:
+        _render_msg(msg)
+
+
+def _render_msg(msg):
+    total = msg.get("total")
+    frac = (
+        f"{msg['n']}/{total}" if total else str(msg.get("n", 0))
+    )
+    state = "done" if msg.get("done") else "…"
+    print(
+        f"[{msg.get('desc') or msg.get('uuid', '?')}] {frac} {state}",
+        file=_display.get("out", sys.stderr),
+        flush=True,
+    )
+
+
 def enable_display(out=None) -> None:
     """Driver-side: subscribe to the tqdm channel and print progress
     lines as they arrive. Safe to call again — the latest ``out`` wins,
@@ -122,25 +143,12 @@ def enable_display(out=None) -> None:
     if _display.get("head_addr") == rt.core.head_addr:
         return  # already subscribed on this cluster; sink swapped above
 
-    def render(payload):
-        msg = payload.get("msg", {})
-        if payload.get("channel") != "tqdm":
-            return
-        total = msg.get("total")
-        frac = (
-            f"{msg['n']}/{total}" if total else str(msg.get("n", 0))
-        )
-        state = "done" if msg.get("done") else "…"
-        print(
-            f"[{msg.get('desc') or msg.get('uuid', '?')}] {frac} {state}",
-            file=_display.get("out", sys.stderr),
-            flush=True,
-        )
-
     async def subscribe():
         from ray_tpu._private import rpc
 
-        conn = await rpc.connect(rt.core.head_addr, on_push=render)
+        conn = await rpc.connect(
+            rt.core.head_addr, on_push=_render_payload
+        )
         await conn.call("subscribe", channel="tqdm")
         return conn
 

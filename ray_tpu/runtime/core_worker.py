@@ -252,10 +252,18 @@ class CoreWorker:
         return self.addr
 
     def _on_head_push(self, payload):
-        """PUSH frame from the head (pubsub delivery)."""
+        """PUSH frame from the head (pubsub delivery). A "batch" frame
+        carries a whole coalesced tick of messages in publish order
+        (the head batches mass-death/drain fan-out); handlers still see
+        one message at a time."""
         try:
             handler = self._push_handlers.get(payload.get("channel"))
-            if handler is not None:
+            if handler is None:
+                return
+            if "batch" in payload:
+                for msg in payload["batch"]:
+                    handler(msg)
+            else:
                 handler(payload.get("msg"))
         except Exception:  # noqa: BLE001 - a bad handler must not kill recv
             logger.warning(

@@ -141,7 +141,44 @@ class HeadService:
         # (the hot path under actor/PG storms) scans these instead of
         # per-node Python dicts — profiled 50→100-node sublinearity was
         # dominated by that scan (PROFILE_r05.md). None = rebuild.
+        # Drain/undrain/death flip an `eligible` mask in place (O(1))
+        # instead of invalidating — a mass-drain storm interleaved with
+        # picks was O(nodes²) in rebuilds.
         self._sched_cols: dict | None = None
+        # --- control-plane overload protection ---
+        # Admission classes on the dispatch path: control RPCs
+        # (keepalive/register/sync/probes) execute immediately;
+        # telemetry (add_task_events) enqueues here and a background
+        # worker folds it, so a span flood can never starve liveness.
+        # Bounded: under sustained overload the OLDEST events shed
+        # (freshest telemetry wins) with ray_tpu_head_shed_total
+        # counting and an OFF→ON overload alert.
+        self._fold_queue: collections.deque = collections.deque()
+        self._fold_wakeup = asyncio.Event()
+        self._fold_task: asyncio.Task | None = None
+        self._shed_total = 0
+        self._folded_total = 0
+        self._overload_alert = False
+        # Pubsub coalescing: publishes buffer per channel and flush once
+        # per event-loop tick (or per _pub_batch section), so a
+        # correlated-failure storm costs O(subscribers) PUSH frames
+        # instead of O(events × subscribers).
+        self._pub_pending: dict[str, list] = {}
+        self._pub_flush_scheduled = False
+        self._pub_batch_depth = 0
+        self._pub_msgs_total = 0    # logical messages published
+        self._pub_pushes_total = 0  # PUSH frames actually sent
+        # node_id → slice label reverse index: _slice_of was an
+        # O(slices × nodes) scan and mass death makes it hot.
+        self._slice_index: dict[str, str] = {}
+        # Journal accounting (watermark-driven snapshot cadence +
+        # the head_stats surface).
+        self._journal_floor = 0
+        self._compacting = False
+        self._last_compaction_ts: float | None = None
+        self._replayed_records = 0
+        self._replay_s = 0.0
+        self._started_ts = time.time()
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         if self.journal is not None:
@@ -164,11 +201,21 @@ class HeadService:
         from ray_tpu._private import config
 
         size = self.journal.size_bytes
-        if (
+        floor = getattr(self, "_journal_floor", 0)
+        due = (
             size > config.get("JOURNAL_COMPACT_BYTES")
-            and size > 2 * getattr(self, "_journal_floor", 0)
-            and not getattr(self, "_compacting", False)
-        ):
+            and size > 2 * floor
+        )
+        # Table-size watermark: when the snapshot itself is large (the
+        # 1000-node regime), the 2× floor guard alone lets the replay
+        # TAIL grow to `floor` bytes before compacting — restart replay
+        # then costs snapshot + an equally large tail. Compacting once
+        # the tail alone passes the watermark bounds replay depth
+        # independently of table size.
+        watermark = config.get("HEAD_SNAPSHOT_WATERMARK_BYTES")
+        if watermark > 0 and size - floor > watermark:
+            due = True
+        if due and not getattr(self, "_compacting", False):
             self._compacting = True
             asyncio.ensure_future(self._compact_bg())
 
@@ -182,6 +229,7 @@ class HeadService:
             # needs 2× further growth, so a persistently failing disk
             # doesn't re-trigger a full-snapshot pickle on every append.
             self._journal_floor = self.journal.size_bytes
+            self._last_compaction_ts = time.time()
             self._compacting = False
 
     def _restore_from_journal(self) -> None:
@@ -189,7 +237,10 @@ class HeadService:
         snapshot. Node/subscriber state is NOT persisted: nodes
         re-register through their reconnecting heartbeat (the
         NotifyGCSRestart equivalent) and re-dial their subscriptions."""
+        t0 = time.monotonic()
+        replayed = 0
         for table, op, payload in self.journal.replay():
+            replayed += 1
             if table == "snapshot" and op == "set":
                 self.kv = dict(payload["kv"])
                 self.actors = {
@@ -259,6 +310,15 @@ class HeadService:
                     self.placement_groups.pop(payload["pg_id"], None)
         self.journal.compact(self._snapshot())
         self._journal_floor = self.journal.size_bytes
+        self._last_compaction_ts = time.time()
+        self._replayed_records = replayed
+        self._replay_s = time.monotonic() - t0
+        # Restored slice membership repopulates the reverse index.
+        self._slice_index = {
+            nid: sid
+            for sid, rec in self.slices.items()
+            for nid in rec.get("nodes", ())
+        }
 
     def _snapshot(self) -> dict:
         return {
@@ -296,17 +356,76 @@ class HeadService:
     async def stop(self):
         if self._reaper:
             self._reaper.cancel()
+        if self._fold_task:
+            self._fold_task.cancel()
         await self.server.stop()
         if self.journal is not None:
             self.journal.close()
 
     # ------------------------------------------------------------ pubsub
     def publish(self, channel: str, msg: Any):
-        for conn in list(self.subs.get(channel, ())):
-            conn.push({"channel": channel, "msg": msg})
+        """Queue one pubsub message. Delivery coalesces per channel per
+        event-loop tick: N messages to a channel inside one tick reach
+        each subscriber as ONE batched PUSH frame (subscribers unpack
+        in order), so a 32-node slice death costs O(subscribers)
+        frames, not O(nodes × subscribers)."""
+        self._pub_msgs_total += 1
+        if not self.subs.get(channel):
+            return
+        self._pub_pending.setdefault(channel, []).append(msg)
+        if self._pub_flush_scheduled or self._pub_batch_depth > 0:
+            return
+        self._pub_flush_scheduled = True
+        try:
+            asyncio.get_running_loop().call_soon(self._flush_publishes)
+        except RuntimeError:
+            # No running loop (handlers driven directly in unit tests):
+            # deliver inline.
+            self._flush_publishes()
+
+    def _pub_batch(self):
+        """Context manager holding pubsub flushes open across an
+        await-ful multi-node event (slice drain escalation, mass reap)
+        so the whole storm coalesces even though the loop runs between
+        its awaits."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def hold():
+            self._pub_batch_depth += 1
+            try:
+                yield
+            finally:
+                self._pub_batch_depth -= 1
+                if self._pub_batch_depth == 0 and self._pub_pending:
+                    self._flush_publishes()
+
+        return hold()
+
+    def _flush_publishes(self) -> None:
+        self._pub_flush_scheduled = False
+        if self._pub_batch_depth > 0:
+            return  # a batch section is open; it flushes on exit
+        pending, self._pub_pending = self._pub_pending, {}
+        for channel, msgs in pending.items():
+            subs = list(self.subs.get(channel, ()))
+            if not subs:
+                continue
+            if len(msgs) == 1:
+                frame = {"channel": channel, "msg": msgs[0]}
+            else:
+                frame = {"channel": channel, "batch": msgs}
+            for conn in subs:
+                self._pub_pushes_total += 1
+                conn.push(frame)
 
     # ----------------------------------------------------------- handler
     async def _handle(self, method: str, kw: dict, conn: rpc.Connection):
+        from ray_tpu._private.test_utils import head_stall_for
+
+        stall = head_stall_for(method)
+        if stall > 0:
+            await asyncio.sleep(stall)
         fn = getattr(self, f"_on_{method}", None)
         if fn is None:
             raise rpc.RpcError(f"head: unknown method {method!r}")
@@ -337,7 +456,27 @@ class HeadService:
             "conn": conn,
         }
         conn.state["node_id"] = node_id
-        self._sched_cols = None  # membership changed
+        # A RE-registration (reconnect storm after a head restart)
+        # updates the maintained columns in place; only a genuinely new
+        # node or resource kind forces a rebuild — a 1000-node
+        # registration herd with interleaved picks must not rebuild
+        # O(nodes)-sized columns per register.
+        cols = self._sched_cols
+        if cols is not None:
+            i = cols["idx"].get(node_id)
+            node = self.nodes[node_id]
+            kinds = set(node["resources"]) | set(node["available"])
+            if i is not None and all(k in cols["total"] for k in kinds):
+                for k in cols["total"]:
+                    cols["total"][k][i] = float(
+                        node["resources"].get(k, 0)
+                    )
+                    cols["avail"][k][i] = float(
+                        node["available"].get(k, 0)
+                    )
+                cols["eligible"][i] = node_id not in self.draining
+            else:
+                self._sched_cols = None  # membership changed
         self._slice_register(node_id, labels or {})
         old = self._node_conns.pop(node_id, None)
         if old is not None:
@@ -390,10 +529,9 @@ class HeadService:
         node["res_version"] = version
         node["available"] = available
         node["pending"] = pending or []
-        if node_id in self.draining:
-            # Draining nodes are excluded from the scheduling columns;
-            # their syncs must not trigger a rebuild (i would be None).
-            return {"ok": True}
+        # Draining nodes stay IN the columns behind the eligible mask
+        # (drain/undrain flip one bit instead of invalidating), so
+        # their syncs update in place like everyone else's.
         cols = self._sched_cols
         if cols is not None:
             i = cols["idx"].get(node_id)
@@ -492,7 +630,7 @@ class HeadService:
         self._journal_append(
             "drain", "put", {"node_id": node_id, "fields": dict(rec)}
         )
-        self._sched_cols = None  # schedulable set changed
+        self._sched_set_eligible(node_id, False)
         self.publish(
             "node",
             {
@@ -534,7 +672,7 @@ class HeadService:
         if rec is None:
             return {"ok": False}
         self._journal_append("drain", "del", {"node_id": node_id})
-        self._sched_cols = None
+        self._sched_set_eligible(node_id, True)
         node = self.nodes.get(node_id)
         addr = node["addr"] if node else None
         self.publish(
@@ -601,10 +739,21 @@ class HeadService:
         if node_id not in rec["nodes"]:
             rec["nodes"].append(node_id)
             self._slice_journal(slice_id)
+        self._slice_index[node_id] = slice_id
 
     def _slice_of(self, node_id: str) -> str | None:
+        # O(1) via the maintained reverse index (the full scan was
+        # O(slices × nodes) and mass death makes this hot); the scan
+        # below only runs to self-heal a stale miss.
+        sid = self._slice_index.get(node_id)
+        if sid is not None:
+            rec = self.slices.get(sid)
+            if rec is not None and node_id in rec["nodes"]:
+                return sid
+            self._slice_index.pop(node_id, None)
         for sid, rec in self.slices.items():
             if node_id in rec["nodes"]:
+                self._slice_index[node_id] = sid
                 return sid
         return None
 
@@ -636,26 +785,32 @@ class HeadService:
             "slice (%d hosts)",
             slice_id, node_id[:12], reason, len(rec["nodes"]),
         )
-        self.publish(
-            "collective",
-            {
-                "event": "slice_draining",
-                "slice_id": slice_id,
-                "nodes": list(rec["nodes"]),
-                "reason": reason,
-            },
-        )
-        # The anchor node is included too when not already draining
-        # (the death path escalates via a SURVIVING sibling as anchor).
-        for sibling in list(rec["nodes"]):
-            if sibling in self.draining or sibling not in self.nodes:
-                continue
-            await self._on_drain_node(
-                None,
-                node_id=sibling,
-                reason=f"slice {slice_id} fault domain: {reason}",
-                deadline_s=deadline_s,
+        # One batch section for the whole escalation: the slice notice
+        # plus every sibling's draining events reach each subscriber as
+        # one coalesced PUSH per channel, not O(hosts × subscribers)
+        # frames.
+        with self._pub_batch():
+            self.publish(
+                "collective",
+                {
+                    "event": "slice_draining",
+                    "slice_id": slice_id,
+                    "nodes": list(rec["nodes"]),
+                    "reason": reason,
+                },
             )
+            # The anchor node is included too when not already draining
+            # (the death path escalates via a SURVIVING sibling as
+            # anchor).
+            for sibling in list(rec["nodes"]):
+                if sibling in self.draining or sibling not in self.nodes:
+                    continue
+                await self._on_drain_node(
+                    None,
+                    node_id=sibling,
+                    reason=f"slice {slice_id} fault domain: {reason}",
+                    deadline_s=deadline_s,
+                )
 
     def _slice_node_gone(self, node_id: str) -> tuple[str, dict] | None:
         """Drop a dead node from its slice's membership; returns the
@@ -667,6 +822,7 @@ class HeadService:
             return None
         rec = self.slices[slice_id]
         rec["nodes"].remove(node_id)
+        self._slice_index.pop(node_id, None)
         if not rec["nodes"]:
             rec["state"] = "dead"
             rec["since"] = time.time()
@@ -1291,16 +1447,17 @@ class HeadService:
     def _sched_columns(self) -> dict:
         """(Re)build the vectorized scheduling columns from self.nodes:
         a stable node list plus per-resource-kind total/available numpy
-        arrays. Membership changes invalidate; _on_sync writes in
-        place."""
+        arrays and an `eligible` mask. Only genuine membership growth
+        (new node, new resource kind) invalidates; _on_sync writes
+        values in place and drain/undrain/death flip eligibility bits
+        (_sched_set_eligible) — O(1) per churn event, where the old
+        rebuild-on-every-change made a mass-drain storm interleaved
+        with picks O(nodes²)."""
         cols = self._sched_cols
         if cols is None:
             import numpy as np
 
-            # Draining nodes never enter the columns (drain/undrain and
-            # membership changes all invalidate), so the hot label-free
-            # pick stays exclusion-free at scan time.
-            nids = [nid for nid in self.nodes if nid not in self.draining]
+            nids = list(self.nodes)
             kinds: set[str] = set()
             for nid in nids:
                 kinds.update(self.nodes[nid]["resources"])
@@ -1308,6 +1465,10 @@ class HeadService:
             cols = self._sched_cols = {
                 "nids": nids,
                 "idx": {nid: i for i, nid in enumerate(nids)},
+                "eligible": np.array(
+                    [nid not in self.draining for nid in nids], bool
+                ),
+                "dead": 0,
                 "total": {
                     k: np.array(
                         [
@@ -1329,6 +1490,34 @@ class HeadService:
             }
         return cols
 
+    def _sched_set_eligible(self, node_id: str, eligible: bool) -> None:
+        """O(1) schedulability flip on the maintained columns. Dead
+        rows (removed nodes) stay masked-out in place; once they are
+        the majority the next pick rebuilds compactly."""
+        cols = self._sched_cols
+        if cols is None:
+            return
+        i = cols["idx"].get(node_id)
+        if i is None:
+            if eligible:
+                self._sched_cols = None  # unknown node joining the pool
+            return
+        cols["eligible"][i] = eligible
+
+    def _sched_drop_node(self, node_id: str) -> None:
+        """Mask a removed node out of the columns (O(1)); rebuild only
+        when dead rows dominate."""
+        cols = self._sched_cols
+        if cols is None:
+            return
+        i = cols["idx"].get(node_id)
+        if i is None:
+            return
+        cols["eligible"][i] = False
+        cols["dead"] += 1
+        if cols["dead"] * 2 > len(cols["nids"]):
+            self._sched_cols = None
+
     def _pick_node_fast(self, resources: dict) -> str | None:
         """Label-free hybrid pick over the vectorized columns — same
         ranking as the general path (feasible → available-now class →
@@ -1342,7 +1531,7 @@ class HeadService:
         n = len(cols["nids"])
         if n == 0:
             return None
-        feasible = np.ones(n, bool)
+        feasible = cols["eligible"].copy()
         avail_now = np.ones(n, bool)
         util = np.zeros(n)
         for k, v in resources.items():
@@ -2151,44 +2340,127 @@ class HeadService:
         "FINISHED": 2, "FAILED": 2, "CANCELLED": 2,
     }
 
+    # Telemetry admission class: add_task_events only ENQUEUES (O(1)
+    # amortized per event) and a background worker folds — a span flood
+    # from 1000 nodes used to fold ledgers inline on the dispatch path,
+    # monopolizing the loop and starving keepalives/registrations (the
+    # control class). The queue is bounded: under sustained overload
+    # the OLDEST events shed with an OFF→ON alert instead of unbounded
+    # memory growth or latency collapse. The chunk is the fold loop's
+    # scheduling quantum: control-RPC p99 under telemetry overload is
+    # roughly a few chunks' worth of fold work, so it stays small.
+    _FOLD_CHUNK = 64
+
     async def _on_add_task_events(self, conn, events: list):
-        for ev in events:
-            self.task_events.append(ev)
-            tid = ev.get("task_id")
-            if ev.get("state") == "SPAN":
-                # Spans live in the raw stream only, not the merged task
-                # table (they would evict real task states). Rank-0 train
-                # step spans additionally drive per-job goodput.
-                if ev.get("name") == "train:step" and ev.get("train_job"):
-                    self._train_step_event(ev)
-                # Ingress spans additionally drive the per-deployment
-                # serve SLO ledger.
-                elif (
-                    ev.get("name") == "serve:ingress"
-                    and ev.get("deployment")
-                ):
-                    self._serve_request_event(ev)
-                # Per-node memory samples additionally drive the head
-                # memory ledger.
-                elif ev.get("name") == "mem:sample" and ev.get("mem_node"):
-                    self._mem_event(ev)
-                continue
-            if tid:
-                prev = self.task_latest.pop(tid, None)
-                merged = dict(prev or {})
-                # Events from different processes arrive out of order
-                # (driver flushes FINISHED; the worker's RUNNING may land
-                # later) — never let a terminal state regress.
-                old_state = merged.get("state")
-                merged.update(ev)
-                if old_state is not None and self._STATE_RANK.get(
-                    ev.get("state"), 0
-                ) < self._STATE_RANK.get(old_state, 0):
-                    merged["state"] = old_state
-                self.task_latest[tid] = merged
-                while len(self.task_latest) > 20000:
-                    self.task_latest.popitem(last=False)
-        return {"ok": True}
+        return self._enqueue_task_events(events)
+
+    def _enqueue_task_events(self, events: list) -> dict:
+        from ray_tpu._private import config
+
+        qmax = config.get("HEAD_FOLD_QUEUE_MAX")
+        q = self._fold_queue
+        if (qmax if qmax > 0 else None) != q.maxlen:
+            # Bound change (config override mid-run): rebuild keeping
+            # the newest records, same as the shed policy.
+            q = self._fold_queue = collections.deque(
+                q, maxlen=qmax if qmax > 0 else None
+            )
+        before = len(q)
+        # A maxlen deque drops from the LEFT on append — the
+        # oldest-first shed is a single C-speed extend, not a Python
+        # pop-per-event loop (which itself became a head hotspot at
+        # 100k+ events/s of sustained overload).
+        q.extend(events)
+        shed = (
+            max(0, before + len(events) - qmax) if qmax > 0 else 0
+        )
+        if shed:
+            self._shed_total += shed
+            if not self._overload_alert:
+                self._overload_alert = True
+                logger.warning(
+                    "head overload: telemetry fold queue hit its "
+                    "HEAD_FOLD_QUEUE_MAX=%d bound; shedding oldest "
+                    "events (ray_tpu_head_shed_total)", qmax,
+                )
+        self._fold_wakeup.set()
+        if self._fold_task is None or self._fold_task.done():
+            self._fold_task = asyncio.ensure_future(self._fold_loop())
+        return {"ok": True, "queued": len(q), "shed": shed}
+
+    async def _fold_loop(self):
+        """Background telemetry folder: drains the bounded queue in
+        chunks, yielding to the event loop between chunks so control
+        RPCs interleave even under a sustained span flood."""
+        from ray_tpu._private.test_utils import head_stall_for
+
+        while True:
+            if not self._fold_queue:
+                self._fold_wakeup.clear()
+                if self._overload_alert:
+                    # OFF transition: the backlog fully drained.
+                    self._overload_alert = False
+                    logger.info(
+                        "head overload cleared: telemetry fold queue "
+                        "drained (lifetime shed total %d)",
+                        self._shed_total,
+                    )
+                await self._fold_wakeup.wait()
+            stall = head_stall_for("fold")
+            if stall > 0:
+                await asyncio.sleep(stall)
+            n = 0
+            q = self._fold_queue
+            while q and n < self._FOLD_CHUNK:
+                self._fold_one(q.popleft())
+                n += 1
+            await asyncio.sleep(0)
+
+    def _drain_folds(self) -> None:
+        """Fold everything queued NOW. Read-your-writes for the state
+        surfaces: a worker that flushed telemetry and then queries
+        stats/events must see it folded, queue or no queue."""
+        q = self._fold_queue
+        while q:
+            self._fold_one(q.popleft())
+
+    def _fold_one(self, ev: dict) -> None:
+        self._folded_total += 1
+        self.task_events.append(ev)
+        tid = ev.get("task_id")
+        if ev.get("state") == "SPAN":
+            # Spans live in the raw stream only, not the merged task
+            # table (they would evict real task states). Rank-0 train
+            # step spans additionally drive per-job goodput.
+            if ev.get("name") == "train:step" and ev.get("train_job"):
+                self._train_step_event(ev)
+            # Ingress spans additionally drive the per-deployment
+            # serve SLO ledger.
+            elif (
+                ev.get("name") == "serve:ingress"
+                and ev.get("deployment")
+            ):
+                self._serve_request_event(ev)
+            # Per-node memory samples additionally drive the head
+            # memory ledger.
+            elif ev.get("name") == "mem:sample" and ev.get("mem_node"):
+                self._mem_event(ev)
+            return
+        if tid:
+            prev = self.task_latest.pop(tid, None)
+            merged = dict(prev or {})
+            # Events from different processes arrive out of order
+            # (driver flushes FINISHED; the worker's RUNNING may land
+            # later) — never let a terminal state regress.
+            old_state = merged.get("state")
+            merged.update(ev)
+            if old_state is not None and self._STATE_RANK.get(
+                ev.get("state"), 0
+            ) < self._STATE_RANK.get(old_state, 0):
+                merged["state"] = old_state
+            self.task_latest[tid] = merged
+            while len(self.task_latest) > 20000:
+                self.task_latest.popitem(last=False)
 
     async def _on_list_task_events(
         self,
@@ -2200,6 +2472,7 @@ class HeadService:
         """`state` filters BEFORE `limit` applies: a span query must not
         come back empty just because busy task traffic fills the
         newest-N window."""
+        self._drain_folds()  # read-your-writes past the fold queue
         if raw:
             events = list(self.task_events)
             if state is not None:
@@ -2376,6 +2649,7 @@ class HeadService:
     async def _on_train_stats(self, conn):
         """Per-job goodput/MFU rollup (dashboard /api/train, agent
         passthrough, `ray_tpu goodput`)."""
+        self._drain_folds()  # read-your-writes past the fold queue
         return {
             "jobs": {
                 job: self._train_job_public(rec)
@@ -2511,6 +2785,7 @@ class HeadService:
         passthrough, `ray_tpu slo`) — the ledger-read API the serve
         control loop polls for attainment/alert/request-rate, plus the
         autoscale decisions it reported back."""
+        self._drain_folds()  # read-your-writes past the fold queue
         out = {
             key: self._serve_deployment_public(key, rec)
             for key, rec in self.serve_runs.items()
@@ -2668,6 +2943,7 @@ class HeadService:
     async def _on_mem_stats(self, conn):
         """Per-node and per-job memory rollup (dashboard /api/memory,
         agent passthrough, `ray_tpu mem`)."""
+        self._drain_folds()  # read-your-writes past the fold queue
         return {
             "nodes": {n: dict(rec) for n, rec in self.mem_nodes.items()},
             "jobs": {j: dict(rec) for j, rec in self.mem_jobs.items()},
@@ -2842,6 +3118,7 @@ class HeadService:
         # drivers, dead workers) age out — otherwise the map grows with
         # every short-lived job and dead gauges report forever.
         now = time.monotonic()
+        self._drain_folds()  # ledger gauges must reflect queued spans
         for w, rec in list(self.metrics.items()):
             if now - rec["ts"] > self.METRICS_TTL_S:
                 del self.metrics[w]
@@ -2849,9 +3126,83 @@ class HeadService:
         head_snap = dict(self._train_metrics_snapshot() or {})
         head_snap.update(self._serve_metrics_snapshot() or {})
         head_snap.update(self._mem_metrics_snapshot() or {})
+        head_snap.update(self._head_metrics_snapshot())
         if head_snap:
             workers["head"] = head_snap
         return {"workers": workers}
+
+    def _head_metrics_snapshot(self) -> dict:
+        """Head-load gauges in worker-snapshot format: the overload-
+        protection surface (shed counter + OFF→ON alert + queue depth)
+        and pubsub coalescing counters, attributed to the head pseudo-
+        worker like the ledger gauges above."""
+        tag = 'node="head"'
+        return {
+            "ray_tpu_head_shed_total": {
+                "kind": "gauge",
+                "description": "telemetry events shed by the bounded "
+                               "head fold queue (lifetime; >0 means "
+                               "the head ran past HEAD_FOLD_QUEUE_MAX)",
+                "series": {tag: float(self._shed_total)},
+                "boundaries": None,
+            },
+            "ray_tpu_head_overload": {
+                "kind": "gauge",
+                "description": "1 while the head is shedding telemetry "
+                               "(OFF→ON transition warn-logged; clears "
+                               "when the fold queue drains)",
+                "series": {tag: 1.0 if self._overload_alert else 0.0},
+                "boundaries": None,
+            },
+            "ray_tpu_head_fold_queue_depth": {
+                "kind": "gauge",
+                "description": "telemetry events waiting in the head "
+                               "fold queue",
+                "series": {tag: float(len(self._fold_queue))},
+                "boundaries": None,
+            },
+        }
+
+    async def _on_head_stats(self, conn):
+        """Control-plane load/health surface (`ray_tpu head`, dashboard
+        /api/head): admission/fold-queue state, shed counter, overload
+        alert, pubsub coalescing counters, and journal size/compaction
+        — the numbers BENCH_head.json pins and operators watch at
+        scale."""
+        from ray_tpu._private import config
+
+        journal = None
+        if self.journal is not None:
+            journal = {
+                "path": self.journal.path,
+                "size_bytes": self.journal.size_bytes,
+                "floor_bytes": self._journal_floor,
+                "compacting": bool(self._compacting),
+                "last_compaction_ts": self._last_compaction_ts,
+                "replayed_records": self._replayed_records,
+                "replay_s": self._replay_s,
+                "watermark_bytes": config.get(
+                    "HEAD_SNAPSHOT_WATERMARK_BYTES"
+                ),
+            }
+        return {
+            "uptime_s": time.time() - self._started_ts,
+            "nodes": len(self.nodes),
+            "draining": len(self.draining),
+            "slices": len(self.slices),
+            "actors": len(self.actors),
+            "subscriptions": {
+                ch: len(s) for ch, s in self.subs.items() if s
+            },
+            "fold_queue_depth": len(self._fold_queue),
+            "fold_queue_max": config.get("HEAD_FOLD_QUEUE_MAX"),
+            "folded_total": self._folded_total,
+            "shed_total": self._shed_total,
+            "overload_alert": self._overload_alert,
+            "pub_msgs_total": self._pub_msgs_total,
+            "pub_pushes_total": self._pub_pushes_total,
+            "journal": journal,
+        }
 
     # ----------------------------------------------------------- health
     async def _remove_node(self, nid: str):
@@ -2866,7 +3217,7 @@ class HeadService:
             # The drain completed in death; a journal replay must not
             # carry the tombstone forward.
             self._journal_append("drain", "del", {"node_id": nid})
-        self._sched_cols = None  # membership changed
+        self._sched_drop_node(nid)
         conn = self._node_conns.pop(nid, None)
         if conn is not None:
             await conn.close()
@@ -2908,7 +3259,14 @@ class HeadService:
                 min(5.0, config.get("HEALTH_TIMEOUT_S") / 3)
             )
             now = time.monotonic()
-            for nid, node in list(self.nodes.items()):
-                if now - node["last_seen"] > config.get("HEALTH_TIMEOUT_S"):
-                    await self._remove_node(nid)
+            # One batch section per reap tick: a correlated failure
+            # (whole slice, whole rack) that times out together fans
+            # out as one coalesced PUSH per channel per subscriber.
+            with self._pub_batch():
+                for nid, node in list(self.nodes.items()):
+                    if (
+                        now - node["last_seen"]
+                        > config.get("HEALTH_TIMEOUT_S")
+                    ):
+                        await self._remove_node(nid)
             self._schedule_ckpt_repair()

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 
 def _connect(address: str | None, session_dir: str | None = None):
@@ -292,6 +293,62 @@ def cmd_mem(args) -> int:
 
     _connect(args.address, getattr(args, "session_dir", None))
     return print_mem(state.mem_stats(), as_json=args.json)
+
+
+def print_head(stats: dict, as_json: bool = False) -> int:
+    """Render the head control-plane load stats (factored out of
+    cmd_head so tier-1 can smoke the exact CLI output path without a
+    daemonized cluster)."""
+    if as_json:
+        json.dump(stats, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    alert = "  OVERLOAD" if stats.get("overload_alert") else ""
+    print(
+        f"head: uptime={stats.get('uptime_s', 0.0):.0f}s  "
+        f"nodes={stats.get('nodes', 0)}  "
+        f"draining={stats.get('draining', 0)}  "
+        f"slices={stats.get('slices', 0)}  "
+        f"actors={stats.get('actors', 0)}{alert}"
+    )
+    print(
+        f"  fold queue: depth={stats.get('fold_queue_depth', 0)}/"
+        f"{stats.get('fold_queue_max', 0)}  "
+        f"folded={stats.get('folded_total', 0)}  "
+        f"shed={stats.get('shed_total', 0)}"
+    )
+    print(
+        f"  pubsub: msgs={stats.get('pub_msgs_total', 0)}  "
+        f"pushes={stats.get('pub_pushes_total', 0)}  "
+        f"channels={len(stats.get('subscriptions') or {})}"
+    )
+    j = stats.get("journal")
+    if j:
+        last = j.get("last_compaction_ts")
+        ago = f"{time.time() - last:.0f}s ago" if last else "never"
+        print(
+            f"  journal: size={j.get('size_bytes', 0)}B  "
+            f"floor={j.get('floor_bytes', 0)}B  "
+            f"watermark={j.get('watermark_bytes', 0)}B  "
+            f"compaction={ago}"
+            + ("  (compacting)" if j.get("compacting") else "")
+        )
+        print(
+            f"  replay: records={j.get('replayed_records', 0)}  "
+            f"took={j.get('replay_s', 0.0):.3f}s"
+        )
+    return 0
+
+
+def cmd_head(args) -> int:
+    """Head control-plane load rollup: telemetry fold-queue depth and
+    shed counter, overload alert state, pubsub coalescing counters, and
+    journal size/compaction/replay accounting (same data as the
+    dashboard's /api/head)."""
+    from ray_tpu.util import state
+
+    _connect(args.address, getattr(args, "session_dir", None))
+    return print_head(state.head_stats(), as_json=args.json)
 
 
 def cmd_ckpt(args) -> int:
@@ -689,6 +746,12 @@ def main(argv=None) -> int:
                              "attribution + alert)")
     mp.add_argument("--json", action="store_true",
                     help="raw per-node/per-job stats as JSON")
+    hp = sub.add_parser("head",
+                        help="head control-plane load (fold-queue "
+                             "depth, shed counter, overload alert, "
+                             "journal size/compaction)")
+    hp.add_argument("--json", action="store_true",
+                    help="raw head stats as JSON")
     cp = sub.add_parser("ckpt",
                         help="in-cluster shard-store checkpoints")
     cp.add_argument("action", choices=["ls", "verify"],
@@ -724,6 +787,7 @@ def main(argv=None) -> int:
         "goodput": cmd_goodput,
         "slo": cmd_slo,
         "mem": cmd_mem,
+        "head": cmd_head,
         "ckpt": cmd_ckpt,
         "logs": cmd_logs,
         "dashboard": cmd_dashboard,

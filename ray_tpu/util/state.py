@@ -189,6 +189,14 @@ def mem_stats() -> dict:
     return _call_head("mem_stats")
 
 
+def head_stats() -> dict:
+    """Head control-plane load stats: telemetry fold-queue depth, shed
+    counter, overload alert state, pubsub coalescing counters, and
+    journal size/compaction. Backs the dashboard's /api/head and the
+    `ray_tpu head` CLI."""
+    return _call_head("head_stats")
+
+
 def list_checkpoints(run: str | None = None) -> dict:
     """In-cluster shard-store checkpoints per run (step, world,
     completeness, bytes, chunk count, min replica count). Backs the

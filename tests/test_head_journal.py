@@ -1,0 +1,189 @@
+"""Bounded crash recovery: snapshot-watermark compaction cadence and
+sidecar durability through a crash mid-online-compaction.
+
+Deterministic twins of the bench_head SIGKILL-recovery leg: restart
+replay depth must stay bounded by HEAD_SNAPSHOT_WATERMARK_BYTES no
+matter how much KV churn accumulates, and a SIGKILL landing between the
+sidecar write and the post-compaction rename must lose zero records.
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+from ray_tpu._private import config as _config
+from ray_tpu._private import rpc
+from ray_tpu.runtime.head_storage import FileJournal
+
+
+def _clear(*names):
+    for n in names:
+        _config._overrides.pop(n, None)
+        os.environ.pop(f"RAY_TPU_{n}", None)
+
+
+def test_snapshot_watermark_bounds_replay_depth(tmp_path):
+    """With the size-threshold compaction effectively disabled
+    (JOURNAL_COMPACT_BYTES huge), the table-size watermark alone must
+    keep compacting, so a restart replays snapshot + a small tail —
+    not the whole churn history."""
+    path = str(tmp_path / "head.journal")
+    n_puts = 400
+    value = b"x" * 512  # ~512B/record: 400 puts ≈ 200KB of churn
+    _config.set_system_config(
+        {
+            "JOURNAL_COMPACT_BYTES": 1 << 30,
+            "HEAD_SNAPSHOT_WATERMARK_BYTES": 16 * 1024,
+        }
+    )
+    try:
+
+        async def churn():
+            from ray_tpu.runtime.head import HeadService
+
+            head = HeadService(journal_path=path)
+            addr = await head.start()
+            conn = await rpc.connect(addr)
+            try:
+                for i in range(n_puts):
+                    await conn.call(
+                        "kv_put", key=f"k{i}", value=value
+                    )
+                # Let any in-flight background compaction finish.
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline and head._compacting:
+                    await asyncio.sleep(0.05)
+                assert head._last_compaction_ts is not None, (
+                    "watermark never triggered an online compaction"
+                )
+            finally:
+                await conn.close()
+                await head.stop()
+
+        asyncio.run(churn())
+
+        async def restart():
+            from ray_tpu.runtime.head import HeadService
+
+            head = HeadService(journal_path=path)
+            addr = await head.start()
+            conn = await rpc.connect(addr)
+            try:
+                # All state survived...
+                assert (
+                    await conn.call("kv_get", key=f"k{n_puts - 1}")
+                )["value"] == value
+                assert (await conn.call("kv_get", key="k0"))[
+                    "value"
+                ] == value
+                # ...but replay depth is snapshot + watermark-bounded
+                # tail, NOT the full churn history.
+                replayed = head._replayed_records
+                assert 0 < replayed < n_puts // 2, (
+                    f"replayed {replayed} records — watermark did not "
+                    f"bound the tail (churned {n_puts})"
+                )
+                return True
+            finally:
+                await conn.close()
+                await head.stop()
+
+        assert asyncio.run(restart())
+    finally:
+        _clear("JOURNAL_COMPACT_BYTES", "HEAD_SNAPSHOT_WATERMARK_BYTES")
+
+
+_CRASH_CHILD = textwrap.dedent(
+    """
+    import asyncio, os, signal, sys, threading
+    from ray_tpu.runtime.head_storage import FileJournal
+
+    path = sys.argv[1]
+    j = FileJournal(path)
+    for i in range(100):
+        j.append(("kv", "put", {"key": f"k{i}", "value": i}))
+
+    entered = threading.Event()
+    proceed = threading.Event()
+
+    def crash_write(data):
+        # Stand-in for the snapshot rewrite: wait until the parent
+        # task has appended the mid-compaction records (they land in
+        # the sidecar), then die WITHOUT renaming — the crash window
+        # between sidecar write and post-compaction rename.
+        entered.set()
+        proceed.wait(10)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    j._write_snapshot = crash_write
+
+    async def go():
+        task = asyncio.ensure_future(j.compact_async({"kv": {}}))
+        await asyncio.to_thread(entered.wait, 10)
+        for i in range(20):
+            j.append(("kv", "put", {"key": f"late{i}", "value": i}))
+        assert os.path.exists(j._sidecar_path), "sidecar missing"
+        proceed.set()
+        await task  # never returns — SIGKILL lands first
+
+    asyncio.run(go())
+    """
+)
+
+
+def test_crash_between_sidecar_write_and_rename_loses_nothing(
+    tmp_path,
+):
+    """SIGKILL mid-online-compaction — after the sidecar has absorbed
+    concurrent appends but before the snapshot rename: replay() must
+    fold the sidecar after the main file, losing zero records."""
+    path = str(tmp_path / "head.journal")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _CRASH_CHILD, path],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode,
+        proc.stdout,
+        proc.stderr,
+    )
+    # The crash left the pre-compaction main file plus a sidecar.
+    assert os.path.exists(path + ".compacting")
+
+    records = list(FileJournal(path).replay())
+    keys = [
+        r[2]["key"] for r in records if r[0] == "kv" and r[1] == "put"
+    ]
+    # Every pre-compaction record survived (rename never happened)...
+    assert [k for k in keys if not k.startswith("late")] == [
+        f"k{i}" for i in range(100)
+    ]
+    # ...and every mid-compaction append came back from the sidecar,
+    # ordered strictly after the main file.
+    assert keys[-20:] == [f"late{i}" for i in range(20)]
+
+    # A successful restart-style compaction folds the sidecar into the
+    # snapshot and removes it.
+    j = FileJournal(path)
+    state = {}
+    for table, op, payload in j.replay():
+        if table == "kv" and op == "put":
+            state[payload["key"]] = payload["value"]
+    j.compact({"kv": state})
+    assert not os.path.exists(path + ".compacting")
+    snap = list(FileJournal(path).replay())
+    assert len(snap) == 1 and snap[0][0] == "snapshot"
+    assert len(snap[0][2]["kv"]) == 120

@@ -1,0 +1,112 @@
+"""Jittered reconnect backoff: full-jitter window, herd spread, and
+the ReconnectingClient attempt cap.
+
+The deterministic twins of the bench_head SIGKILL-recovery leg's
+backoff observations (spread > 0 across the 1000-node reconnect
+storm).
+"""
+
+import asyncio
+import os
+import random
+import statistics
+
+import pytest
+
+from ray_tpu._private import config as _config
+from ray_tpu._private import rpc
+
+
+def _clear(*names):
+    for n in names:
+        _config._overrides.pop(n, None)
+        os.environ.pop(f"RAY_TPU_{n}", None)
+
+
+def test_backoff_delay_window_and_growth():
+    """Every draw lands in [0, min(cap, base * 2^attempt)] and the
+    window grows exponentially until the cap dominates."""
+    rng = random.Random(42)
+    base, cap = 0.25, 4.0
+    for attempt in range(12):
+        ceiling = min(cap, base * 2**attempt)
+        draws = [
+            rpc.backoff_delay(attempt, base=base, cap=cap, rng=rng)
+            for _ in range(200)
+        ]
+        assert all(0.0 <= d <= ceiling for d in draws), (
+            attempt,
+            max(draws),
+        )
+        # The draws actually use the window (full jitter, not
+        # equal-jitter or fixed): something lands in the top half.
+        assert max(draws) > 0.5 * ceiling, attempt
+    # Degenerate inputs stay safe.
+    assert rpc.backoff_delay(-3, base=base, cap=cap, rng=rng) <= base
+    assert rpc.backoff_delay(5, base=0.0, cap=0.0, rng=rng) == 0.0
+    # Huge attempt counts don't overflow: the cap dominates.
+    assert rpc.backoff_delay(10_000, base=base, cap=cap, rng=rng) <= cap
+
+
+def test_backoff_jitter_spreads_reconnect_herd():
+    """The reason jitter exists: N clients re-dialing after a head
+    restart must NOT share a schedule. N same-attempt draws spread
+    across the window instead of clustering on one deadline."""
+    base, cap = 0.2, 5.0
+    herd = [
+        rpc.backoff_delay(2, base=base, cap=cap, rng=random.Random(i))
+        for i in range(200)
+    ]
+    window = min(cap, base * 4)
+    spread = max(herd) - min(herd)
+    assert spread > 0.5 * window, f"herd spread {spread:.3f}s"
+    assert statistics.pstdev(herd) > 0.1 * window
+    # No more than a few collisions when bucketed to 10ms — a fixed
+    # schedule would put all 200 in ONE bucket.
+    buckets = {round(d, 2) for d in herd}
+    assert len(buckets) > 50
+
+
+def test_reconnecting_client_attempt_cap(monkeypatch):
+    """With the peer gone for good, the retry loop gives up after
+    RPC_RECONNECT_ATTEMPTS jittered-backoff attempts instead of
+    spinning until the deadline."""
+    _config.set_system_config({"RPC_RECONNECT_ATTEMPTS": 3})
+    try:
+
+        async def go():
+            server = rpc.Server(lambda m, kw, c: None)
+            port = await server.start("127.0.0.1", 0)
+            client = await rpc.ReconnectingClient(
+                f"127.0.0.1:{port}", reconnect_timeout=30.0
+            ).connect()
+            await server.stop()
+
+            dial_attempts = []
+
+            async def refused(addr, on_push=None, retries=5):
+                dial_attempts.append(addr)
+                err = rpc.ConnectionLost(f"refused: {addr}")
+                err.sent = False
+                raise err
+
+            sleeps = []
+
+            def no_jitter(attempt, *a, **kw):
+                sleeps.append(attempt)
+                return 0.0
+
+            monkeypatch.setattr(rpc, "connect", refused)
+            monkeypatch.setattr(rpc, "backoff_delay", no_jitter)
+            with pytest.raises(rpc.ConnectionLost):
+                await client.call("kv_get", key="x")
+            await client.close()
+            return dial_attempts, sleeps
+
+        dial_attempts, sleeps = asyncio.run(go())
+        # Attempts 1 and 2 back off (attempt numbers 0, 1); attempt 3
+        # hits the cap and raises without another sleep.
+        assert sleeps == [0, 1]
+        assert len(dial_attempts) <= 3
+    finally:
+        _clear("RPC_RECONNECT_ATTEMPTS")

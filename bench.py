@@ -66,19 +66,25 @@ def run(batch_size: int, seq: int, steps: int = 30) -> dict:
     )
     batch = {"tokens": tokens}
 
-    # Warmup (compile + 5 steps — the first post-compile steps run a
+    # One AOT compile shared by the bench loop and the profiler block.
+    # lower().compile() and the jit call path do NOT share an
+    # executable cache; letting the profiler recompile the flagship
+    # step would double the dominant cost of this script.
+    compiled = step.lower(state, batch).compile()
+
+    # Warmup (5 post-compile steps — the first post-compile steps run a
     # slightly cold device; steady state is the meaningful training
     # number). Sync via host transfer of an updated param — on the axon
     # TPU platform block_until_ready does not reliably wait, and loss
     # alone would leave the update tail overlapping into the timed
     # region.
     for _ in range(6):
-        state, metrics = step(state, batch)
+        state, metrics = compiled(state, batch)
         float(state.params["final_norm"][0])
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, metrics = step(state, batch)
+        state, metrics = compiled(state, batch)
     # Each step consumes the previous state; materializing an *updated
     # parameter* of the final step forces the whole chain including the
     # last backward + adamw update (loss alone would leave the final
@@ -93,7 +99,7 @@ def run(batch_size: int, seq: int, steps: int = 30) -> dict:
     tokens_per_sec_per_chip = tokens_per_sec / n_chips
     flops_per_token = cfg.flops_per_token(seq)
     mfu = tokens_per_sec_per_chip * flops_per_token / _peak_flops()
-    return {
+    result = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/s/chip",
@@ -106,6 +112,46 @@ def run(batch_size: int, seq: int, steps: int = 30) -> dict:
         "step_time_s": round(dt / steps, 4),
         "device_kind": jax.devices()[0].device_kind,
     }
+    # Compiled-program profiler block: where the MFU gap goes. The
+    # analytic half (HLO roofline floors) always; a short on-device
+    # capture joins it into the measured decomposition — the numbers
+    # the BENCH_r rounds record to judge the in-program overlap work.
+    # A profiler failure must never cost the headline number.
+    try:
+        from ray_tpu._private import config as _config
+        from ray_tpu.train import profile as _profile
+        from ray_tpu.util import tracing as _tracing
+
+        static = _profile.analyze_compiled(compiled)
+        static["model_flops_per_step"] = (
+            flops_per_token * tokens_per_step
+        )
+        result["profile_sig"] = static["sig"]
+        result["ideal_step_s"] = round(static["ideal_step_s"], 6)
+        result["analytic_floor_s"] = {
+            k: round(v["floor_s"], 6)
+            for k, v in static["categories"].items()
+        }
+        cap_steps = _config.get("PROFILE_CAPTURE_STEPS")
+        t0 = time.perf_counter()
+        with _tracing.jax_profile() as cap:
+            for _ in range(cap_steps):
+                state, metrics = compiled(state, batch)
+            float(state.params["final_norm"][0])
+        wall = time.perf_counter() - t0
+        measured = (
+            _profile._read_capture(cap.path) if cap.path else None
+        )
+        if measured is not None:
+            rep = _profile.attribution_report(
+                measured, wall, cap_steps, static=static
+            )
+            result["mfu_decomposition"] = rep["shares"]
+            result["dominant_gap"] = rep["dominant_gap"]
+    # tpulint: allow(broad-except reason=profiling is best-effort; the failure is surfaced in the profile_error field and must never cost the headline number)
+    except Exception as e:  # noqa: BLE001 - profiling is best-effort
+        result["profile_error"] = f"{type(e).__name__}: {e}"[:300]
+    return result
 
 
 def main() -> None:

@@ -395,6 +395,25 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
     "MEM_OOM_REPORT_DIR": (str, "", "directory for persisted OOM "
                                     "forensics JSON reports (default: "
                                     "<tmpdir>/ray_tpu_mem)"),
+    # --- compiled-program profiler
+    "PROFILE": (bool, True, "compiled-program profiler plane: the "
+                            "per-step capture hook + profile:step "
+                            "reporting (always-cheap; 0 makes the "
+                            "step hook a pinned-budget no-op and "
+                            "ignores capture requests)"),
+    "PROFILE_DIR": (str, "", "directory for jax_profile / capture "
+                             "traces (default: <tmpdir>/ray_tpu_"
+                             "profile)"),
+    "PROFILE_CAPTURE_STEPS": (int, 3, "steps wrapped in one on-device "
+                                      "trace per profile_capture "
+                                      "request"),
+    "PROFILE_REGRESSION_PCT": (float, 25.0, "relative drift (percent) "
+                                            "of any decomposition "
+                                            "category's share vs the "
+                                            "journaled fingerprint "
+                                            "that flips ray_tpu_"
+                                            "profile_regression_alert "
+                                            "ON for the job"),
     "FAKE_HBM_GB": (float, 0.0, "chaos spec: cap the memory sampler's "
                                 "reported device capacity at this many "
                                 "GiB (0 = off) so headroom alerts and "

@@ -625,7 +625,8 @@ class WatchedJit:
         sig = _signature(args, kwargs, self._static_argnums,
                          self._static_argnames)
         self._calls += 1
-        if sig not in self._seen:
+        known = sig in self._seen
+        if not known:
             if self._seen and self._calls > compile_grace():
                 _graph.recompiles += 1
                 diff = _sig_diff(self._last_sig, sig)
@@ -641,7 +642,27 @@ class WatchedJit:
                 )
             self._seen.add(sig)
         self._last_sig = sig
-        return self._jitted(*args, **kwargs)
+        # Cache-EVICTION recompiles hide from signature tracking: the
+        # signature was seen, but XLA's compilation cache dropped the
+        # executable and the call silently recompiled. jax.monitoring's
+        # backend-compile duration event fires exactly then (and not on
+        # cache hits), so a counter advance during an already-seen call
+        # past the grace is an eviction recompile.
+        before = _backend_compiles
+        try:
+            return self._jitted(*args, **kwargs)
+        finally:
+            if (known and self._calls > compile_grace()
+                    and _backend_compiles > before):
+                _graph.recompiles += 1
+                _recompile_counter().inc(tags={"fn": self.name})
+                logger.warning(
+                    "sanitizer: jitted %s RECOMPILED at call %d for an "
+                    "ALREADY-SEEN signature — the XLA compilation "
+                    "cache evicted it (cache thrash, not a new shape); "
+                    "raise the cache budget or reduce live programs",
+                    self.name, self._calls,
+                )
 
     def __getattr__(self, item):
         return getattr(self._jitted, item)
@@ -683,6 +704,47 @@ _jax_watch_count = 0
 _ORIG_JAX_JIT = None
 _ORIG_BLOCK_UNTIL_READY = None
 _ORIG_DEVICE_GET = None
+# Backend-compile monitor: jax emits this duration event on every real
+# backend compilation (and NOT on jit-cache hits), which is what lets
+# the compile watch see cache-eviction recompiles of already-seen
+# signatures. The literal is the fallback for jax versions that don't
+# export BACKEND_COMPILE_EVENT from jax._src.dispatch.
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_backend_compiles = 0
+_compile_monitor_registered = False
+
+
+def _register_compile_monitor() -> None:
+    """Register the jax.monitoring listener once per process.
+    jax.monitoring has no unregister, so the callback itself gates on
+    the watch refcount instead of being torn down."""
+    global _compile_monitor_registered
+    if _compile_monitor_registered:
+        return
+    try:
+        from jax import monitoring
+        try:
+            from jax._src import dispatch as _dispatch
+
+            event = getattr(
+                _dispatch, "BACKEND_COMPILE_EVENT",
+                _BACKEND_COMPILE_EVENT,
+            )
+        except ImportError:
+            event = _BACKEND_COMPILE_EVENT
+
+        def _on_compile_duration(evt, _duration, **_kw):
+            global _backend_compiles
+            if evt == event and _jax_watch_count > 0:
+                _backend_compiles += 1
+
+        monitoring.register_event_duration_secs_listener(
+            _on_compile_duration
+        )
+    # tpulint: allow(broad-except reason=the monitoring hook is best-effort hardening of the compile watch; any jax-internals drift degrades to signature-only tracking, never breaks install)
+    except Exception:  # noqa: BLE001
+        return
+    _compile_monitor_registered = True
 # Bounded ring of completed host-sync wall intervals, drained by the
 # train-step telemetry (host_sync_exposed_s attribution).
 _SYNC_RING_MAX = 4096
@@ -757,6 +819,7 @@ def install_jax_watch():
         return
     _jax_watch_count += 1
     if _jax_watch_count == 1:
+        _register_compile_monitor()
         _ORIG_JAX_JIT = jax.jit
         _ORIG_BLOCK_UNTIL_READY = jax.block_until_ready
         _ORIG_DEVICE_GET = jax.device_get

@@ -1,0 +1,507 @@
+"""XLA program analysis: HLO cost walking and xplane trace parsing.
+
+The two halves of the compiled-program profiler (train/profile.py):
+
+**Static** — :func:`analyze_hlo_text` walks the post-optimization HLO
+module text of a compiled executable and buckets every instruction into
+the profiler's category taxonomy (matmul / collective /
+elementwise_fusion / layout), accumulating analytic FLOPs and HBM bytes
+per bucket. ``compiled.cost_analysis()`` alone is NOT enough: XLA's
+aggregate counts each ``while`` body ONCE, so a layer scan of L
+transformer blocks under-reports matmul FLOPs by ~L×. The walker
+recurses through called computations and multiplies a while body's cost
+by its trip count (parsed from the ``compare(..., constant(N))`` in the
+condition region).
+
+**Empirical** — :func:`parse_xplane` reads the ``*.xplane.pb`` files the
+jax/XLA profiler writes. The shipped ``tensorboard_plugin_profile``
+wheel exposes no ``xplane_pb2`` module, so this is a minimal pure-Python
+protobuf wire parser over the handful of field numbers the profiler
+needs (XSpace.planes=1; XPlane name=2/lines=3/event_metadata=4; XLine
+name=2/events=4; XEvent metadata_id=1/duration_ps=3; XEventMetadata
+id=1/name=2). :func:`measured_category_seconds` then sums leaf HLO-op
+event durations per category, skipping infrastructure wrappers
+(ThunkExecutor, profiler spans) and control-flow shells (``while``/
+``call``/``conditional``) whose children appear as their own events.
+
+Everything here is backend-agnostic text/bytes processing — no device
+access, importable on any host.
+"""
+
+from __future__ import annotations
+
+import re
+
+CATEGORIES = ("matmul", "collective", "elementwise_fusion", "layout")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_COLLECTIVE_OPS = {
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+    "all-reduce-start", "all-gather-start", "collective-permute-start",
+}
+# *-done halves of async collective pairs: the cost was charged at the
+# start op; counting both would double every async collective.
+_COLLECTIVE_DONE_OPS = {
+    "all-reduce-done", "all-gather-done", "collective-permute-done",
+}
+_MATMUL_OPS = {"dot", "convolution"}
+_LAYOUT_OPS = {
+    "copy", "copy-start", "transpose", "reshape", "slice",
+    "dynamic-slice", "dynamic-update-slice", "concatenate", "pad",
+    "gather", "scatter", "broadcast", "reverse", "iota",
+}
+# Zero-cost bookkeeping: no bytes move, no math runs.
+_FREE_OPS = {
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+    "after-all", "copy-done", "partition-id", "replica-id", "domain",
+    "opt-barrier", "custom-call-done",
+}
+_CONTROL_OPS = {"while", "call", "conditional", "fusion", "async-start"}
+
+_SHAPE_RE = re.compile(
+    r"(pred|[suf]\d+|bf16|f8\w*|c64|c128)\[([0-9,]*)\](?:\{[^}]*\})?"
+)
+# `%name = <shape> opcode(` — the shape is a single token or a
+# parenthesized tuple (one nesting level is enough for real modules).
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"(\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
+    r"([a-z][a-z0-9\-]*)\((.*)$"
+)
+_COMP_HEADER_RE = re.compile(
+    r"^(ENTRY\s+)?%?([\w.\-]+)\s+\(.*\)\s+->\s+.*\{\s*$"
+)
+_DIMS_ATTR_RE = {
+    key: re.compile(key + r"=\{([0-9,]*)\}")
+    for key in (
+        "lhs_contracting_dims", "rhs_contracting_dims",
+        "lhs_batch_dims", "rhs_batch_dims",
+    )
+}
+_CALLED_RE = re.compile(r"(condition|body|calls)=%?([\w.\-]+)")
+_CONST_RE = re.compile(r"constant\((\d+)\)")
+_REPLICA_GROUPS_RE = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
+_CUSTOM_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
+
+
+def shape_bytes(token: str) -> int:
+    """Total bytes of one shape token (``f32[2,128]{1,0}`` → 1024);
+    tuples sum their members; unparseable tokens cost 0."""
+    total = 0
+    for m in _SHAPE_RE.finditer(token):
+        total += _dtype_dims_bytes(m.group(1), m.group(2))
+    return total
+
+
+def _dtype_dims_bytes(dtype: str, dims_str: str) -> int:
+    n = 1
+    for d in dims_str.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def _shape_dims(token: str) -> list[int]:
+    m = _SHAPE_RE.search(token)
+    if m is None:
+        return []
+    return [int(d) for d in m.group(2).split(",") if d]
+
+
+def _dot_flops(operands: str, attrs: str) -> float:
+    """2·batch·M·K·N from the dot's operand shapes and dimension
+    numbers (the first two shape tokens in the operand list are lhs and
+    rhs)."""
+    shapes = _SHAPE_RE.findall(operands)
+    if len(shapes) < 2:
+        return 0.0
+    lhs = [int(d) for d in shapes[0][1].split(",") if d]
+    rhs = [int(d) for d in shapes[1][1].split(",") if d]
+
+    def dims_of(key: str) -> set[int]:
+        m = _DIMS_ATTR_RE[key].search(attrs)
+        if m is None:
+            return set()
+        return {int(d) for d in m.group(1).split(",") if d}
+
+    lc, lb = dims_of("lhs_contracting_dims"), dims_of("lhs_batch_dims")
+    rc, rb = dims_of("rhs_contracting_dims"), dims_of("rhs_batch_dims")
+    batch = 1
+    for i in lb:
+        if i < len(lhs):
+            batch *= lhs[i]
+    m_size = 1
+    for i, d in enumerate(lhs):
+        if i not in lc and i not in lb:
+            m_size *= d
+    k_size = 1
+    for i in lc:
+        if i < len(lhs):
+            k_size *= lhs[i]
+    n_size = 1
+    for i, d in enumerate(rhs):
+        if i not in rc and i not in rb:
+            n_size *= d
+    return 2.0 * batch * m_size * k_size * n_size
+
+
+def categorize_opcode(opcode: str) -> str | None:
+    """Category of a plain (non-fusion, non-control) opcode; None for
+    free bookkeeping ops."""
+    if opcode in _FREE_OPS:
+        return None
+    if opcode in _MATMUL_OPS:
+        return "matmul"
+    if opcode in _COLLECTIVE_OPS:
+        return "collective"
+    if opcode in _COLLECTIVE_DONE_OPS:
+        return None
+    if opcode in _LAYOUT_OPS:
+        return "layout"
+    # Everything else that touches data is elementwise-ish (add,
+    # multiply, reduce, select, compare, convert, exp, rsqrt, ...).
+    return "elementwise_fusion"
+
+
+_EVENT_SKIP_PREFIXES = ("$", "(")
+_EVENT_CONTROL = {"while", "call", "conditional", "tuple", "async"}
+
+
+def categorize_event_name(name: str) -> str | None:
+    """Category of one xplane event by its HLO instruction name
+    (``dot.6``, ``broadcast_add_fusion``, ``while.808``). None = not a
+    leaf HLO op (infrastructure wrapper or control-flow shell whose
+    children are their own events) — uncounted."""
+    if "::" in name or name.startswith(_EVENT_SKIP_PREFIXES):
+        return None  # ThunkExecutor::Execute, $profiler.py spans, ...
+    base = name.split(".")[0]
+    if base in _EVENT_CONTROL:
+        return None
+    if "fusion" in name:
+        if re.search(r"\b(dot|conv|matmul|gemm)", name):
+            return "matmul"
+        return "elementwise_fusion"
+    return categorize_opcode(base)
+
+
+# ------------------------------------------------------ HLO walking
+class _Instr:
+    __slots__ = ("name", "opcode", "out_bytes", "operands", "attrs")
+
+    def __init__(self, name, opcode, out_bytes, operands, attrs):
+        self.name = name
+        self.opcode = opcode
+        self.out_bytes = out_bytes
+        self.operands = operands
+        self.attrs = attrs
+
+
+def _parse_computations(text: str) -> tuple[dict, str]:
+    """HLO text → {computation name: [instructions]}, entry name."""
+    comps: dict[str, list[_Instr]] = {}
+    entry = ""
+    current: list[_Instr] | None = None
+    for line in text.splitlines():
+        if current is None:
+            m = _COMP_HEADER_RE.match(line)
+            if m is not None:
+                current = comps[m.group(2)] = []
+                if m.group(1):
+                    entry = m.group(2)
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        name, shape, opcode, rest = m.groups()
+        # Split the remainder at the operand-closing paren: dimension
+        # attributes follow it. Splitting on "), " is robust enough —
+        # shapes/attrs inside the operand list contain no "), ".
+        cut = rest.find("), ")
+        operands = rest[:cut] if cut >= 0 else rest.rstrip(")")
+        attrs = rest[cut + 3:] if cut >= 0 else ""
+        current.append(
+            _Instr(name, opcode, shape_bytes(shape), operands, attrs)
+        )
+    return comps, entry
+
+
+def _trip_count(comp: list[_Instr]) -> int:
+    """Trip count of a while loop from its condition computation: the
+    induction variable compares against ``constant(N)``. LT/GT bound N
+    trips; LE/GE one more. Unparseable conditions cost 1 (never 0 —
+    undercounting is the failure mode this exists to fix)."""
+    bound = None
+    direction = "LT"
+    for ins in comp:
+        if ins.opcode == "constant":
+            # The instruction regex consumes "constant(" as the
+            # opcode, leaving the literal as the bare operand.
+            m = re.match(r"\s*(\d+)\s*$", ins.operands)
+            if m is not None:
+                bound = max(bound or 0, int(m.group(1)))
+            continue
+        if ins.opcode == "compare":
+            m = re.search(r"direction=(\w+)", ins.attrs)
+            if m is None:
+                m = re.search(r"direction=(\w+)", ins.operands)
+            if m is not None:
+                direction = m.group(1)
+        m = _CONST_RE.search(ins.operands) or _CONST_RE.search(ins.attrs)
+        if m is not None:
+            bound = max(bound or 0, int(m.group(1)))
+    if bound is None:
+        return 1
+    return bound + 1 if direction in ("LE", "GE") else max(1, bound)
+
+
+def _has_matmul(comp: list[_Instr]) -> bool:
+    return any(i.opcode in _MATMUL_OPS for i in comp)
+
+
+def _group_size(attrs: str) -> int | None:
+    m = _REPLICA_GROUPS_RE.search(attrs)
+    if m is None:
+        return None
+    return len(m.group(1).split(","))
+
+
+def analyze_hlo_text(text: str) -> dict:
+    """Walk an optimized HLO module and price every instruction into
+    the category taxonomy.
+
+    Returns ``{"categories": {cat: {"flops", "bytes", "ops"}},
+    "collective_ops": [{"op", "bytes", "group"}],
+    "while_trips": {name: trip}}``. Bytes are the HBM traffic proxy
+    (operands + output at each instruction/fusion boundary); fusion
+    internals cost nothing HBM-wise, but a dot inside a fused
+    computation is still charged its FLOPs under matmul.
+    """
+    comps, entry = _parse_computations(text)
+    cats = {c: {"flops": 0.0, "bytes": 0.0, "ops": 0} for c in CATEGORIES}
+    collective_ops: list[dict] = []
+    while_trips: dict[str, int] = {}
+
+    def charge(cat: str, flops: float, nbytes: float) -> None:
+        cats[cat]["flops"] += flops
+        cats[cat]["bytes"] += nbytes
+        cats[cat]["ops"] += 1
+
+    def fused_dot_flops(comp_name: str, mult: float) -> float:
+        total = 0.0
+        for ins in comps.get(comp_name, ()):
+            if ins.opcode == "dot":
+                total += _dot_flops(ins.operands, ins.attrs) * mult
+        return total
+
+    def walk(comp_name: str, mult: float, stack: tuple) -> None:
+        if comp_name in stack:  # defensive: HLO call graphs are acyclic
+            return
+        stack = stack + (comp_name,)
+        for ins in comps.get(comp_name, ()):
+            boundary = ins.out_bytes + shape_bytes(ins.operands)
+            if ins.opcode == "while":
+                called = dict(
+                    (k, v) for k, v in _CALLED_RE.findall(ins.attrs)
+                )
+                cond = called.get("condition")
+                body = called.get("body")
+                trips = _trip_count(comps.get(cond, [])) if cond else 1
+                while_trips[ins.name] = trips
+                if body:
+                    walk(body, mult * trips, stack)
+                if cond:
+                    walk(cond, mult * trips, stack)
+            elif ins.opcode in ("call", "conditional", "async-start"):
+                for _kind, target in _CALLED_RE.findall(ins.attrs):
+                    walk(target, mult, stack)
+            elif ins.opcode == "fusion":
+                called = [t for _k, t in _CALLED_RE.findall(ins.attrs)]
+                target = called[0] if called else None
+                if target and _has_matmul(comps.get(target, [])):
+                    charge(
+                        "matmul",
+                        fused_dot_flops(target, mult),
+                        boundary * mult,
+                    )
+                else:
+                    charge("elementwise_fusion", 0.0, boundary * mult)
+            elif ins.opcode == "custom-call":
+                m = _CUSTOM_TARGET_RE.search(ins.attrs) or (
+                    _CUSTOM_TARGET_RE.search(ins.operands)
+                )
+                target = (m.group(1) if m else "").lower()
+                if re.search(r"dot|matmul|gemm|conv", target):
+                    charge("matmul", 0.0, boundary * mult)
+                elif re.search(r"all.?reduce|all.?gather|all.?to.?all|"
+                               r"reduce.?scatter|collective", target):
+                    charge("collective", 0.0, boundary * mult)
+                    collective_ops.append({
+                        "op": target, "bytes": boundary * mult,
+                        "group": _group_size(ins.attrs),
+                    })
+                else:
+                    charge("elementwise_fusion", 0.0, boundary * mult)
+            elif ins.opcode in _COLLECTIVE_OPS:
+                charge("collective", 0.0, ins.out_bytes * mult)
+                collective_ops.append({
+                    "op": ins.opcode.replace("-start", ""),
+                    "bytes": ins.out_bytes * mult,
+                    "group": _group_size(ins.attrs),
+                })
+            elif ins.opcode == "dot":
+                charge(
+                    "matmul",
+                    _dot_flops(ins.operands, ins.attrs) * mult,
+                    boundary * mult,
+                )
+            elif ins.opcode == "convolution":
+                # No convs in the flagship; charge bytes, skip flops.
+                charge("matmul", 0.0, boundary * mult)
+            else:
+                cat = categorize_opcode(ins.opcode)
+                if cat is not None:
+                    charge(cat, 0.0, boundary * mult)
+
+    if entry:
+        walk(entry, 1.0, ())
+    return {
+        "categories": cats,
+        "collective_ops": collective_ops,
+        "while_trips": while_trips,
+    }
+
+
+# --------------------------------------------------- xplane parsing
+def _varint(buf: bytes, i: int) -> tuple[int, int]:
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _fields(buf: bytes):
+    """(field_number, wire_type, value) triples of one message."""
+    i = 0
+    n = len(buf)
+    while i < n:
+        key, i = _varint(buf, i)
+        wt = key & 7
+        if wt == 0:
+            v, i = _varint(buf, i)
+        elif wt == 2:
+            ln, i = _varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = buf[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = buf[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        yield key >> 3, wt, v
+
+
+def parse_xplane(data: bytes) -> list[dict]:
+    """XSpace bytes → [{"plane", "line", "name", "dur_ps", "count"}]
+    aggregated per (plane, line, event name)."""
+    out: dict[tuple, list] = {}
+    for fnum, wt, plane in _fields(data):
+        if fnum != 1 or wt != 2:
+            continue
+        pname = ""
+        lines = []
+        meta: dict[int, str] = {}
+        for f2, w2, v2 in _fields(plane):
+            if f2 == 2 and w2 == 2:
+                pname = v2.decode("utf-8", "replace")
+            elif f2 == 3 and w2 == 2:
+                lines.append(v2)
+            elif f2 == 4 and w2 == 2:
+                entry = None
+                for f3, _w3, v3 in _fields(v2):
+                    if f3 == 2:
+                        entry = v3
+                if entry is None:
+                    continue
+                mid = None
+                mname = ""
+                for f4, _w4, v4 in _fields(entry):
+                    if f4 == 1:
+                        mid = v4
+                    elif f4 == 2:
+                        mname = v4.decode("utf-8", "replace")
+                if mid is not None:
+                    meta[mid] = mname
+        for raw in lines:
+            lname = ""
+            events = []
+            for f2, w2, v2 in _fields(raw):
+                if f2 == 2 and w2 == 2:
+                    lname = v2.decode("utf-8", "replace")
+                elif f2 == 4 and w2 == 2:
+                    events.append(v2)
+            for ev in events:
+                mid = None
+                dur = 0
+                for f3, _w3, v3 in _fields(ev):
+                    if f3 == 1:
+                        mid = v3
+                    elif f3 == 3:
+                        dur = v3
+                key = (pname, lname, meta.get(mid, "?"))
+                rec = out.setdefault(key, [0, 0])
+                rec[0] += dur
+                rec[1] += 1
+    return [
+        {"plane": p, "line": ln, "name": nm, "dur_ps": d, "count": c}
+        for (p, ln, nm), (d, c) in out.items()
+    ]
+
+
+def _is_device_line(plane: str, line: str) -> bool:
+    """Lines carrying device op execution: TPU device planes entirely;
+    on the CPU backend, the host plane's ``tf_XLA*`` executor lines."""
+    if "/device:" in plane:
+        return True
+    return line.startswith("tf_XLA")
+
+
+def measured_category_seconds(data: bytes) -> dict:
+    """One capture's per-category measured seconds: sum of leaf HLO op
+    event durations on device lines. ``device_busy_s`` is the same sum
+    including uncategorizable leaf ops. On a multi-threaded CPU backend
+    concurrent leaf ops on one executor line can sum past wall clock —
+    the attribution layer normalizes against the step wall."""
+    cats = {c: 0.0 for c in CATEGORIES}
+    busy = 0.0
+    events = 0
+    for rec in parse_xplane(data):
+        if not _is_device_line(rec["plane"], rec["line"]):
+            continue
+        cat = categorize_event_name(rec["name"])
+        if cat is None:
+            continue
+        secs = rec["dur_ps"] / 1e12
+        cats[cat] += secs
+        busy += secs
+        events += rec["count"]
+    return {"categories": cats, "device_busy_s": busy, "events": events}

@@ -106,6 +106,14 @@ async def _ensure_death_watch(core) -> None:
             from ray_tpu.runtime import drain
 
             drain.clear(msg.get("node_id"))
+        elif event == "profile_capture":
+            # Head-triggered compiled-program capture: arm this
+            # process's per-step profiler hook (train/profile.py). The
+            # same fan-out channel reaches every rank with a live
+            # CoreWorker, so one head RPC captures the whole job.
+            from ray_tpu.train import profile
+
+            profile.note_capture_request(msg)
 
     await core.subscribe("collective", _on_event)
 

@@ -48,6 +48,23 @@ def default_bucket_bytes() -> int:
     return int(float(config.get("COLLECTIVE_BUCKET_MB")) * (1 << 20))
 
 
+def bucket_analytic_cost_s(
+    nbytes: int, world: int, verb: str = "allreduce"
+) -> float:
+    """Roofline wire time of one bucket's collective on this chip
+    generation's ICI (profile.py's bandwidth table + standard ring
+    wire factors). The per-bucket analytic floor the in-program
+    comm_in_program decomposition compares measured collective time
+    against — and what the T3-style overlap scheduler will use to
+    decide how much compute a bucket needs to hide behind."""
+    from ray_tpu.train import profile
+
+    factor = profile.collective_wire_factor(verb, world)
+    if factor <= 0.0:
+        return 0.0
+    return nbytes * factor / profile.ici_bandwidth_per_chip()
+
+
 @dataclasses.dataclass
 class Bucket:
     """One issued bucket: its leaves (issue order), payload size, and

@@ -75,6 +75,15 @@ def _memory():
     return state.mem_stats()
 
 
+@_route("/api/profile")
+def _profile():
+    """Compiled-program profiler ledger (profile:step span
+    accounting): per-job MFU decomposition shares, the dominant
+    non-compute gap, and the regression-sentinel state with its
+    journaled per-signature fingerprints."""
+    return state.profile_stats()
+
+
 @_route("/api/head")
 def _head():
     """Head control-plane load: telemetry fold-queue depth, shed

@@ -95,6 +95,16 @@ class HeadService:
         # mem_stats RPC, /api/memory, and `ray_tpu mem`.
         self.mem_nodes: dict[str, dict] = {}
         self.mem_jobs: dict[str, dict] = {}
+        # Compiled-program profiler ledger, folded from rank-0
+        # "profile:step" SPAN events: per-job latest MFU decomposition
+        # (compute_floor/comm_in_program/hbm_bound/host_gap/
+        # unattributed shares + dominant gap), surfaced next to the
+        # goodput numbers. profile_fp holds the per-step-signature
+        # baseline fingerprints the regression sentinel compares new
+        # captures against — journaled, so a head restart cannot
+        # forget what "normal" looked like.
+        self.profile_runs: dict[str, dict] = {}
+        self.profile_fp: dict[str, dict] = {}
         # Collective-group membership (the fault-tolerance layer's view):
         # group → {"epoch": int, "members": {rank: {addr, node_addr,
         # worker_id, dead}}}. Node/worker death fans out to survivors on
@@ -271,6 +281,19 @@ class HeadService:
                     sid: dict(rec)
                     for sid, rec in payload.get("slices", {}).items()
                 }
+                self.profile_fp = {
+                    sig: dict(rec)
+                    for sig, rec in payload.get(
+                        "profile_fp", {}
+                    ).items()
+                }
+            elif table == "profile":
+                if op == "put":
+                    self.profile_fp[payload["sig"]] = dict(
+                        payload["fields"]
+                    )
+                else:
+                    self.profile_fp.pop(payload["sig"], None)
             elif table == "slice":
                 if op == "put":
                     self.slices[payload["slice_id"]] = dict(
@@ -345,6 +368,10 @@ class HeadService:
             },
             "slices": {
                 sid: dict(rec) for sid, rec in self.slices.items()
+            },
+            "profile_fp": {
+                sig: dict(rec)
+                for sig, rec in self.profile_fp.items()
             },
         }
 
@@ -2445,6 +2472,13 @@ class HeadService:
             # memory ledger.
             elif ev.get("name") == "mem:sample" and ev.get("mem_node"):
                 self._mem_event(ev)
+            # Capture reports additionally drive the MFU-decomposition
+            # ledger and the profile regression sentinel.
+            elif (
+                ev.get("name") == "profile:step"
+                and ev.get("train_job")
+            ):
+                self._profile_step_event(ev)
             return
         if tid:
             prev = self.task_latest.pop(tid, None)
@@ -2648,13 +2682,189 @@ class HeadService:
 
     async def _on_train_stats(self, conn):
         """Per-job goodput/MFU rollup (dashboard /api/train, agent
-        passthrough, `ray_tpu goodput`)."""
+        passthrough, `ray_tpu goodput`). The ONE fold path joining the
+        goodput ledger with the profiler's in-program decomposition:
+        a job with a capture report carries it under "profile"."""
+        self._drain_folds()  # read-your-writes past the fold queue
+        jobs = {}
+        for job, rec in self.train_runs.items():
+            pub = self._train_job_public(rec)
+            prof = self.profile_runs.get(job)
+            if prof is not None:
+                pub["profile"] = self._profile_public(prof)
+            jobs[job] = pub
+        return {"jobs": jobs}
+
+    # ------------------------------------- compiled-program profiler
+    def _profile_step_event(self, ev: dict) -> None:
+        """Fold one rank-0 ``profile:step`` span (train/profile.py's
+        capture report) into the decomposition ledger and run the
+        regression sentinel against the journaled fingerprint for the
+        step signature. First sight of a signature RECORDS the
+        fingerprint; later captures compare against it."""
+        if ev.get("train_rank") != 0:
+            return
+        job = str(ev["train_job"])
+        shares = ev.get("profile_shares")
+        if not isinstance(shares, dict):
+            return
+        clean: dict[str, float] = {}
+        for cat, v in shares.items():
+            if isinstance(v, (int, float)):
+                clean[str(cat)] = float(v)
+        if not clean:
+            return
+        sig = str(ev.get("profile_sig") or job)
+        try:
+            step_s = float(ev.get("profile_step_s") or 0.0)
+            steps = int(ev.get("profile_steps") or 0)
+            ts = float(ev.get("ts") or 0.0)
+        except (TypeError, ValueError):
+            return
+        rec = {
+            "sig": sig,
+            "shares": clean,
+            "step_s": step_s,
+            "steps": steps,
+            "dominant_gap": str(ev.get("profile_dominant") or ""),
+            "path": str(ev.get("path") or ""),
+            "ts": ts,
+            "alert": False,
+            "drift": {},
+        }
+        baseline = self.profile_fp.get(sig)
+        if baseline is None:
+            fp = {
+                "job": job,
+                "shares": dict(clean),
+                "step_s": step_s,
+                "ts": ts,
+            }
+            self.profile_fp[sig] = fp
+            self._journal_append(
+                "profile", "put", {"sig": sig, "fields": fp}
+            )
+        else:
+            self._profile_regression_check(job, rec, baseline)
+        if job not in self.profile_runs and len(self.profile_runs) >= 200:
+            oldest = min(
+                self.profile_runs,
+                key=lambda j: self.profile_runs[j]["ts"],
+            )
+            del self.profile_runs[oldest]
+        self.profile_runs[job] = rec
+
+    def _profile_regression_check(
+        self, job: str, rec: dict, baseline: dict
+    ) -> None:
+        """Flag category shares that drifted past
+        PROFILE_REGRESSION_PCT relative to the fingerprint. Shares
+        under 2% on both sides are noise, not regressions; the
+        denominator is floored at 2% so a tiny baseline can't turn
+        rounding into an alert. Warn-log fires on the OFF→ON
+        transition only; the gauge tracks current state."""
+        from ray_tpu._private import config
+
+        pct = config.get("PROFILE_REGRESSION_PCT") / 100.0
+        drift: dict[str, float] = {}
+        cats = set(baseline.get("shares", {})) | set(rec["shares"])
+        for cat in cats:
+            base = float(baseline.get("shares", {}).get(cat, 0.0))
+            cur = rec["shares"].get(cat, 0.0)
+            if base < 0.02 and cur < 0.02:
+                continue
+            d = (cur - base) / max(base, 0.02)
+            if abs(d) > pct:
+                drift[cat] = round(d, 4)
+        rec["drift"] = drift
+        rec["alert"] = bool(drift)
+        prev = self.profile_runs.get(job)
+        if rec["alert"] and not (prev and prev.get("alert")):
+            logger.warning(
+                "train job %r: profile regression vs fingerprint %s — "
+                "category share drift past %.0f%%: %s",
+                job, rec["sig"], 100.0 * pct, drift,
+            )
+
+    @staticmethod
+    def _profile_public(rec: dict) -> dict:
+        return {
+            "sig": rec["sig"],
+            "shares": dict(rec["shares"]),
+            "step_s": rec["step_s"],
+            "steps": rec["steps"],
+            "dominant_gap": rec["dominant_gap"],
+            "drift": dict(rec["drift"]),
+            "alert": rec["alert"],
+            "path": rec["path"],
+            "ts": rec["ts"],
+        }
+
+    async def _on_profile_stats(self, conn):
+        """Per-job MFU decomposition + fingerprints (dashboard
+        /api/profile, `ray_tpu profile`)."""
         self._drain_folds()  # read-your-writes past the fold queue
         return {
             "jobs": {
-                job: self._train_job_public(rec)
-                for job, rec in self.train_runs.items()
-            }
+                job: self._profile_public(rec)
+                for job, rec in self.profile_runs.items()
+            },
+            "fingerprints": {
+                sig: dict(rec)
+                for sig, rec in self.profile_fp.items()
+            },
+        }
+
+    async def _on_profile_capture(self, conn, steps: int | None = None):
+        """Fan a capture request out to every rank: riders of the
+        "collective" channel (the same fan-out that delivers member
+        death and drain notices) arm their local per-step profiler
+        hook; reports come back as ``profile:step`` spans on the
+        ordinary telemetry pipeline."""
+        msg = {"event": "profile_capture"}
+        if steps is not None:
+            msg["steps"] = int(steps)
+        self.publish("collective", msg)
+        return {"ok": True, "steps": steps}
+
+    def _profile_metrics_snapshot(self) -> dict | None:
+        """Head-owned profiler gauges in worker-snapshot format: the
+        per-category MFU decomposition and the regression-sentinel
+        alert, attributed to the head pseudo-worker like the goodput
+        gauges."""
+        if not self.profile_runs:
+            return None
+        from ray_tpu.util.metrics import escape_label_value as _esc
+
+        decomp: dict[str, float] = {}
+        alert: dict[str, float] = {}
+        for job, rec in self.profile_runs.items():
+            jtag = f'job="{_esc(job)}"'
+            for cat, share in rec["shares"].items():
+                decomp[f'{jtag},category="{_esc(cat)}"'] = round(
+                    share, 6
+                )
+            alert[jtag] = 1.0 if rec["alert"] else 0.0
+        return {
+            "ray_tpu_train_mfu_decomposition": {
+                "kind": "gauge",
+                "description": "share of the measured step wall per "
+                               "profiler category (compute_floor/"
+                               "comm_in_program/hbm_bound/host_gap/"
+                               "unattributed), from the latest "
+                               "compiled-program capture",
+                "series": decomp,
+                "boundaries": None,
+            },
+            "ray_tpu_profile_regression_alert": {
+                "kind": "gauge",
+                "description": "1 when a category's share drifted "
+                               "past PROFILE_REGRESSION_PCT vs the "
+                               "journaled fingerprint for the job's "
+                               "step signature",
+                "series": alert,
+                "boundaries": None,
+            },
         }
 
     # --------------------------------------------------- serve SLO ledger
@@ -3126,6 +3336,7 @@ class HeadService:
         head_snap = dict(self._train_metrics_snapshot() or {})
         head_snap.update(self._serve_metrics_snapshot() or {})
         head_snap.update(self._mem_metrics_snapshot() or {})
+        head_snap.update(self._profile_metrics_snapshot() or {})
         head_snap.update(self._head_metrics_snapshot())
         if head_snap:
             workers["head"] = head_snap

@@ -172,12 +172,77 @@ def cmd_goodput(args) -> int:
                 f"{j['host_sync_exposed_s']:.2f}s  exposed_ratio="
                 f"{j.get('host_sync_exposed_ratio', 0.0):.3f}"
             )
+        prof = j.get("profile")
+        if prof:
+            shares = "  ".join(
+                f"{k}={v:.3f}"
+                for k, v in sorted(prof.get("shares", {}).items())
+            )
+            alert = "  ALERT" if prof.get("alert") else ""
+            print(
+                f"  in_program: {shares}  "
+                f"dominant_gap={prof.get('dominant_gap', '')}{alert}"
+            )
         if j.get("phase_s"):
             phases = "  ".join(
                 f"{k}={v:.2f}s" for k, v in sorted(j["phase_s"].items())
             )
             print(f"  phases: {phases}")
     return 0
+
+
+def print_profile(stats: dict, as_json: bool = False) -> int:
+    """Render the compiled-program profile ledger (factored out of
+    cmd_profile so tier-1 can smoke the exact CLI output path without
+    a daemonized cluster)."""
+    if as_json:
+        json.dump(stats, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    jobs = stats.get("jobs", {})
+    if not jobs:
+        print(
+            "no profile captures have been reported (trigger one with "
+            "`ray_tpu profile --capture`)"
+        )
+        return 0
+    for name, rec in sorted(jobs.items()):
+        alert = "  ALERT" if rec.get("alert") else ""
+        print(
+            f"{name}: step={rec.get('step_s', 0.0) * 1e3:.1f}ms  "
+            f"steps={rec.get('steps', 0)}  "
+            f"sig={rec.get('sig', '')}{alert}"
+        )
+        shares = "  ".join(
+            f"{k}={v:.3f}"
+            for k, v in sorted(rec.get("shares", {}).items())
+        )
+        print(f"  shares: {shares}")
+        print(f"  dominant_gap: {rec.get('dominant_gap', '')}")
+        if rec.get("drift"):
+            drifts = "  ".join(
+                f"{k}={v:+.2f}"
+                for k, v in sorted(rec["drift"].items())
+            )
+            print(f"  drift vs fingerprint: {drifts}")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Compiled-program profiler surface: per-job MFU decomposition
+    from the latest capture (the head's profile:step accounting; same
+    data as the dashboard's /api/profile). --capture fans a capture
+    request out to every rank first."""
+    from ray_tpu.util import state
+
+    _connect(args.address, getattr(args, "session_dir", None))
+    if args.capture:
+        reply = state.profile_capture(steps=args.steps)
+        print(
+            f"capture requested (steps={reply.get('steps') or 'default'})"
+        )
+        return 0
+    return print_profile(state.profile_stats(), as_json=args.json)
 
 
 def _fmt_ms(v) -> str:
@@ -735,6 +800,18 @@ def main(argv=None) -> int:
     gp = sub.add_parser("goodput")
     gp.add_argument("--json", action="store_true",
                     help="raw per-job stats as JSON")
+    pf = sub.add_parser("profile",
+                        help="compiled-program MFU decomposition from "
+                             "the latest capture (+ regression-"
+                             "sentinel drift)")
+    pf.add_argument("--json", action="store_true",
+                    help="raw profile stats as JSON")
+    pf.add_argument("--capture", action="store_true",
+                    help="fan a capture request out to every rank "
+                         "instead of printing")
+    pf.add_argument("--steps", type=int, default=None,
+                    help="steps per capture (default "
+                         "PROFILE_CAPTURE_STEPS)")
     slo = sub.add_parser("slo",
                          help="per-deployment serve SLO attainment "
                               "(TTFT/latency percentiles + alert)")
@@ -785,6 +862,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "metrics": cmd_metrics,
         "goodput": cmd_goodput,
+        "profile": cmd_profile,
         "slo": cmd_slo,
         "mem": cmd_mem,
         "head": cmd_head,

@@ -189,6 +189,23 @@ def mem_stats() -> dict:
     return _call_head("mem_stats")
 
 
+def profile_stats() -> dict:
+    """Per-job compiled-program profile from the head: the latest MFU
+    decomposition (category shares + dominant gap) and the journaled
+    per-signature fingerprints the regression sentinel compares new
+    captures against. Backs the dashboard's /api/profile and the
+    `ray_tpu profile` CLI."""
+    return _call_head("profile_stats")
+
+
+def profile_capture(steps: int | None = None) -> dict:
+    """Ask the head to fan a compiled-program capture request out to
+    every rank (collective-channel riders arm their per-step profiler
+    hook; reports land in profile_stats after the next
+    PROFILE_CAPTURE_STEPS steps)."""
+    return _call_head("profile_capture", steps=steps)
+
+
 def head_stats() -> dict:
     """Head control-plane load stats: telemetry fold-queue depth, shed
     counter, overload alert state, pubsub coalescing counters, and
@@ -220,6 +237,9 @@ _SPAN_ARG_KEYS = (
     "app", "deployment", "route", "status", "ttft_s", "request_id",
     "streamed", "items", "tokens", "batch_size", "occupancy",
     "queue_s", "sample_rate",
+    # compiled-program profiler spans (profile:step / profile:capture)
+    "profile_sig", "profile_shares", "profile_step_s", "profile_steps",
+    "profile_dominant", "path",
 )
 
 

@@ -258,15 +258,69 @@ def get_trace_events(limit: int = 2000) -> list[dict]:
     return [e for e in reply["events"] if e.get("state") == "SPAN"]
 
 
+class ProfileCapture:
+    """Handle yielded by jax_profile: `path` resolves to the session
+    directory the profiler wrote (``<log_dir>/plugins/profile/<run>``)
+    after the context exits, None when nothing was written."""
+
+    __slots__ = ("log_dir", "path")
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self.path: str | None = None
+
+
+def _resolve_capture_path(log_dir: str) -> str | None:
+    """Newest run directory under <log_dir>/plugins/profile/ — where
+    jax.profiler.stop_trace lands the xplane.pb + tool files."""
+    root = os.path.join(log_dir, "plugins", "profile")
+    try:
+        runs = [
+            os.path.join(root, d)
+            for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        ]
+    except OSError:
+        return None
+    if not runs:
+        return None
+    return max(runs, key=os.path.getmtime)
+
+
 @contextlib.contextmanager
-def jax_profile(log_dir: str):
+def jax_profile(log_dir: str | None = None):
     """On-device profiling via the jax/XLA profiler (xprof): wraps
     jax.profiler.start_trace/stop_trace. View with tensorboard or
-    xprof. The TPU-native replacement for the reference's NVTX ranges."""
+    xprof. The TPU-native replacement for the reference's NVTX ranges.
+
+    Yields a :class:`ProfileCapture` whose ``path`` is filled in after
+    the body exits (the run directory holding the ``*.xplane.pb``), and
+    emits a ``profile:capture`` span so captures are discoverable from
+    ``timeline()``. ``log_dir`` defaults to ``RAY_TPU_PROFILE_DIR``
+    (falling back to ``<tmpdir>/ray_tpu_profile``) and is created if
+    missing."""
+    import tempfile
+
     import jax
 
+    from ray_tpu._private import config
+
+    if log_dir is None:
+        log_dir = config.get("PROFILE_DIR") or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_profile"
+        )
+    os.makedirs(log_dir, exist_ok=True)
+    cap = ProfileCapture(log_dir)
+    start = time.time()
     jax.profiler.start_trace(log_dir)
     try:
-        yield
+        yield cap
     finally:
         jax.profiler.stop_trace()
+        cap.path = _resolve_capture_path(log_dir)
+        emit_span(
+            "profile:capture",
+            start,
+            time.time() - start,
+            path=cap.path or log_dir,
+        )

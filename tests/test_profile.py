@@ -1,0 +1,601 @@
+"""Compiled-program profiler: HLO roofline walking, capture
+attribution, the regression sentinel, and the disabled-path floor.
+
+Reference test models: the goodput/memory-plane test suites (synthetic
+SPAN feeding, journal-restart twins, disabled-path perf pins).
+"""
+
+import asyncio
+import json
+import time
+import types
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import config as _config
+from ray_tpu._private import rpc, xla_profile
+from ray_tpu.train import profile
+from ray_tpu.util import state
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    info = ray_tpu.init(num_cpus=4)
+    yield info
+    ray_tpu.shutdown()
+
+
+# A minimal but structurally honest HLO module: a trip-4 while whose
+# body runs a 64x64x64 dot, a fused dot, an all-reduce over 4 replicas,
+# and a layout copy. Shapes/attrs follow real post-optimization dumps.
+_DOT = (
+    "dot(f32[64,64] %x, f32[64,64] %x), "
+    "lhs_contracting_dims={1}, rhs_contracting_dims={0}"
+)
+SYNTHETIC_HLO = f"""HloModule synthetic
+
+%wbody (p.1: (s32[], f32[64,64])) -> (s32[], f32[64,64]) {{
+  %p.1 = (s32[], f32[64,64]) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[64,64]) %p.1), index=0
+  %one = s32[] constant(1)
+  %ni = s32[] add(s32[] %i, s32[] %one)
+  %x = f32[64,64] get-tuple-element((s32[], f32[64,64]) %p.1), index=1
+  %d = f32[64,64] {_DOT}
+  ROOT %t = (s32[], f32[64,64]) tuple(s32[] %ni, f32[64,64] %d)
+}}
+
+%wcond (p.2: (s32[], f32[64,64])) -> pred[] {{
+  %p.2 = (s32[], f32[64,64]) parameter(0)
+  %i.2 = s32[] get-tuple-element((s32[], f32[64,64]) %p.2), index=0
+  %n = s32[] constant(4)
+  ROOT %lt = pred[] compare(s32[] %i.2, s32[] %n), direction=LT
+}}
+
+%fused_dot (fp: f32[64,64]) -> f32[64,64] {{
+  %fp = f32[64,64] parameter(0)
+  ROOT %fd = f32[64,64] dot(f32[64,64] %fp, f32[64,64] %fp), lhs_contracting_dims={{1}}, rhs_contracting_dims={{0}}
+}}
+
+ENTRY %main (a: f32[64,64]) -> f32[64,64] {{
+  %a = f32[64,64] parameter(0)
+  %zero = s32[] constant(0)
+  %init = (s32[], f32[64,64]) tuple(s32[] %zero, f32[64,64] %a)
+  %w = (s32[], f32[64,64]) while((s32[], f32[64,64]) %init), condition=%wcond, body=%wbody
+  %r = f32[64,64] get-tuple-element((s32[], f32[64,64]) %w), index=1
+  %fu = f32[64,64] fusion(f32[64,64] %r), kind=kOutput, calls=%fused_dot
+  %ar = f32[64,64] all-reduce(f32[64,64] %fu), replica_groups={{{{0,1,2,3}}}}, to_apply=%sum
+  ROOT %c = f32[64,64] copy(f32[64,64] %ar)
+}}
+"""
+
+_DOT_FLOPS = 2.0 * 64 * 64 * 64  # one 64x64x64 f32 dot
+_MAT_BYTES = 64 * 64 * 4
+
+
+# ------------------------------------------------------ static half
+def test_hlo_walker_trip_counts_and_categories():
+    """The walker multiplies while-body cost by the parsed trip count
+    and buckets every instruction into the category taxonomy —
+    aggregate cost_analysis alone would count the loop body once."""
+    walk = xla_profile.analyze_hlo_text(SYNTHETIC_HLO)
+    assert walk["while_trips"] == {"w": 4}
+    cats = walk["categories"]
+    # 4 trips of the body dot + the fused dot outside the loop; ops
+    # count instruction SITES (the body is walked once, cost x trips).
+    assert cats["matmul"]["flops"] == pytest.approx(5 * _DOT_FLOPS)
+    assert cats["matmul"]["ops"] == 2  # body dot + fusion site
+    assert cats["layout"]["ops"] == 1  # the ROOT copy
+    assert cats["elementwise_fusion"]["ops"] == 2  # loop add + compare
+    [coll] = walk["collective_ops"]
+    assert coll["op"] == "all-reduce"
+    assert coll["group"] == 4
+    assert coll["bytes"] == _MAT_BYTES
+
+
+def test_shape_bytes_and_event_categorization():
+    assert xla_profile.shape_bytes("f32[2,128]{1,0}") == 1024
+    assert xla_profile.shape_bytes("(s32[], f32[64,64])") == 4 + _MAT_BYTES
+    assert xla_profile.shape_bytes("bf16[8,2048]") == 8 * 2048 * 2
+    # xplane event names: leaf HLO ops categorize, wrappers and
+    # control-flow shells return None (their children are the events).
+    assert xla_profile.categorize_event_name("dot.6") == "matmul"
+    assert xla_profile.categorize_event_name("copy.3") == "layout"
+    assert (
+        xla_profile.categorize_event_name("all-reduce-start.1")
+        == "collective"
+    )
+    assert (
+        xla_profile.categorize_event_name("broadcast_add_fusion")
+        == "elementwise_fusion"
+    )
+    assert xla_profile.categorize_event_name("while.808") is None
+    assert (
+        xla_profile.categorize_event_name("ThunkExecutor::Execute")
+        is None
+    )
+    assert xla_profile.categorize_event_name("$profiler_overhead") is None
+
+
+def test_roofline_pricing_and_wire_factors():
+    """price_categories turns the walk into per-category floor seconds
+    against explicit peaks; collectives pay the ring wire factor."""
+    assert profile.collective_wire_factor("all-reduce", 4) == 1.5
+    assert profile.collective_wire_factor("all-gather", 4) == 0.75
+    assert profile.collective_wire_factor("reduce-scatter", 2) == 0.5
+    assert profile.collective_wire_factor("collective-permute", 4) == 1.0
+    assert profile.collective_wire_factor("all-reduce", 1) == 0.0
+    walk = xla_profile.analyze_hlo_text(SYNTHETIC_HLO)
+    floors = profile.price_categories(
+        walk, peak_flops=1e12, hbm_bps=1e9, ici_bps=1e9
+    )
+    mat = walk["categories"]["matmul"]
+    assert floors["matmul"] == pytest.approx(
+        max(mat["flops"] / 1e12, mat["bytes"] / 1e9)
+    )
+    assert floors["collective"] == pytest.approx(
+        _MAT_BYTES * 1.5 / 1e9
+    )
+    assert floors["elementwise_fusion"] > 0 and floors["layout"] > 0
+
+
+def test_static_fingerprint_deterministic():
+    """The per-step-signature fingerprint hashes the category shape of
+    the program, not the HLO text — stable across re-analysis (and so
+    across processes, where instruction ids differ)."""
+    s1 = profile._finish_static(
+        xla_profile.analyze_hlo_text(SYNTHETIC_HLO), {}
+    )
+    s2 = profile._finish_static(
+        xla_profile.analyze_hlo_text(SYNTHETIC_HLO), {}
+    )
+    assert s1["sig"] == s2["sig"]
+    assert len(s1["sig"]) == 16
+    assert s1["ideal_step_s"] == pytest.approx(
+        sum(c["floor_s"] for c in s1["categories"].values())
+    )
+
+
+def test_static_analysis_flagship_tiny():
+    """Acceptance (static half): on the flagship jit_train_step the
+    walker's trip-multiplied matmul FLOPs match the model's analytic
+    flops_per_token formula — the layer scan's while body is counted
+    n_layers times, not once. Without trip multiplication this ratio
+    measured 0.62 on the tiny preset."""
+    jax = pytest.importorskip("jax")
+    from ray_tpu.models import PRESETS
+
+    # conftest forces 8 host devices; the dp mesh needs batch % 8 == 0.
+    static = profile.analyze_train_step(
+        PRESETS["tiny"], batch_size=8, seq=128
+    )
+    cats = static["categories"]
+    assert set(cats) == set(xla_profile.CATEGORIES)
+    # The compiled module is the per-device SPMD partition: compare
+    # against the model formula's per-chip slice.
+    model = static["model_flops_per_step"] / len(jax.devices())
+    assert model > 0
+    assert 0.9 * model <= cats["matmul"]["flops"] <= 1.5 * model
+    # matmul dominates the program's analytic FLOPs.
+    total = sum(c["flops"] for c in cats.values())
+    assert cats["matmul"]["flops"] >= 0.9 * total
+    assert static["sig"] and static["ideal_step_s"] > 0
+    assert static["while_trips"], "layer scan produced no while loops"
+    # XLA's own aggregate counts while bodies ONCE — the walker must
+    # be >= it (the under-counting this module exists to fix).
+    agg = static["cost_analysis"]
+    if agg.get("flops"):
+        assert cats["matmul"]["flops"] >= 0.95 * agg["flops"]
+
+
+# -------------------------------------------------- measured half
+def test_capture_attribution_cpu_acceptance():
+    """Acceptance: a real capture of the flagship step on the CPU
+    backend decomposes the measured step wall into shares that sum to
+    1 within 10%, and names the dominant non-compute consumer."""
+    pytest.importorskip("jax")
+    from ray_tpu.models import PRESETS
+
+    rep = profile.profile_train_step(
+        PRESETS["tiny"], batch_size=8, seq=128, steps=3
+    )
+    shares = rep["shares"]
+    assert set(shares) == set(profile.CATEGORIES)
+    assert all(v >= 0.0 for v in shares.values())
+    assert abs(sum(shares.values()) - 1.0) <= 0.10
+    assert rep["dominant_gap"] in profile.CATEGORIES
+    assert rep["dominant_gap"] != "compute_floor"
+    assert rep["sig"] == rep["static"]["sig"]
+    assert rep["steps"] == 3 and rep["step_s"] > 0
+    assert rep["mfu"] > 0
+    # compute_floor is the analytic floor when it undercuts measured
+    # matmul time — it can never exceed the whole step.
+    assert rep["seconds"]["compute_floor"] <= rep["step_s"] * 1.01
+
+
+def test_attribution_report_math():
+    """Pure-function pin of the decomposition semantics: analytic
+    floor substitution, host gap as wall minus busy, clamped
+    remainder, and the CPU busy-oversumming normalization."""
+    measured = {
+        "categories": {
+            "matmul": 0.6, "collective": 0.2,
+            "elementwise_fusion": 0.6, "layout": 0.0,
+        },
+        "device_busy_s": 1.6,
+        "events": 100,
+    }
+    static = {
+        "categories": {"matmul": {"floor_s": 0.15}},
+        "sig": "sigtest",
+    }
+    rep = profile.attribution_report(measured, 2.0, 2, static=static)
+    sec = rep["seconds"]
+    # busy 0.8/step < wall 1.0/step: no scaling; floor 0.15 < measured
+    # matmul 0.3 so the floor is the compute share, the 0.15 excess
+    # lands in unattributed.
+    assert sec["compute_floor"] == pytest.approx(0.15)
+    assert sec["comm_in_program"] == pytest.approx(0.1)
+    assert sec["hbm_bound"] == pytest.approx(0.3)
+    assert sec["host_gap"] == pytest.approx(0.2)
+    assert sec["unattributed"] == pytest.approx(0.25)
+    assert sum(rep["shares"].values()) == pytest.approx(1.0, abs=1e-4)
+    assert rep["dominant_gap"] == "hbm_bound"
+    assert rep["sig"] == "sigtest"
+    # Oversumming backend: busy 4.0 > wall 1.0/step scales by 0.25 and
+    # host_gap collapses to 0.
+    over = dict(measured, device_busy_s=8.0)
+    rep2 = profile.attribution_report(over, 2.0, 2, static=None)
+    assert rep2["seconds"]["host_gap"] == pytest.approx(0.0)
+    assert sum(rep2["shares"].values()) == pytest.approx(1.0, abs=1e-4)
+
+
+# --------------------------------------------- capture state machine
+def _ctx(job="j", rank=0):
+    return types.SimpleNamespace(
+        experiment_name=job, rank=rank, attempt=0
+    )
+
+
+PROFILE_DISABLED_CEILING_S = 50e-6
+
+
+def test_disabled_path_floor():
+    """The per-step hook while disarmed is the cost every training
+    step pays forever: pinned under 50µs (it is two branches)."""
+    profile._reset_for_tests()
+    ctx = _ctx()
+    for _ in range(100):  # warmup
+        profile.step_hook(ctx, 0.01)
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        profile.step_hook(ctx, 0.01)
+    per_step = (time.perf_counter() - t0) / n
+    assert per_step < PROFILE_DISABLED_CEILING_S, (
+        f"disarmed profile.step_hook costs {per_step * 1e6:.1f}µs/"
+        f"step (budget {PROFILE_DISABLED_CEILING_S * 1e6:.0f}µs)"
+    )
+
+
+def test_profile_kill_switch_and_arming():
+    """RAY_TPU_PROFILE=0 turns capture requests into a warning no-op;
+    the pubsub fan-out entry point arms with the requested depth."""
+    profile._reset_for_tests()
+    _config.set_system_config({"PROFILE": False})
+    try:
+        profile.request_capture(steps=2)
+        assert profile._armed is False
+    finally:
+        _config.clear_system_config("PROFILE")
+    profile.note_capture_request({"steps": 2})
+    assert profile._armed is True
+    assert profile._pending_steps == 2
+    profile._reset_for_tests()
+
+
+def test_capture_failure_degrades_to_warning(monkeypatch, caplog):
+    """The acceptance contract: a capture-path failure costs one
+    warning and disarms — never an exception in the step loop."""
+    profile._reset_for_tests()
+    profile.request_capture(steps=2)
+    assert profile._armed is True
+    from ray_tpu.util import tracing
+
+    def boom(*a, **k):
+        raise RuntimeError("tracer unavailable")
+
+    monkeypatch.setattr(tracing, "jax_profile", boom)
+    with caplog.at_level("WARNING", logger="ray_tpu.train.profile"):
+        profile.step_hook(_ctx(), 0.01)  # must not raise
+    assert profile._armed is False
+    assert any(
+        "profile capture failed" in r.message for r in caplog.records
+    )
+    profile._reset_for_tests()
+
+
+# ------------------------------------------------- head fold + sentinel
+BASE_SHARES = {
+    "compute_floor": 0.3, "comm_in_program": 0.0,
+    "hbm_bound": 0.4, "host_gap": 0.1, "unattributed": 0.2,
+}
+DRIFT_SHARES = {
+    "compute_floor": 0.3, "comm_in_program": 0.0,
+    "hbm_bound": 0.1, "host_gap": 0.4, "unattributed": 0.2,
+}
+
+
+def _profile_span(job, sig, shares, ts, rank=0, dominant="hbm_bound"):
+    return {
+        "task_id": f"span:profile-{job}-{ts}",
+        "name": "profile:step",
+        "state": "SPAN",
+        "ts": ts,
+        "dur": 0.06,
+        "train_job": job,
+        "train_rank": rank,
+        "train_attempt": 0,
+        "profile_sig": sig,
+        "profile_steps": 3,
+        "profile_step_s": 0.02,
+        "profile_shares": shares,
+        "profile_dominant": dominant,
+        "path": "/tmp/capture",
+    }
+
+
+def test_fingerprint_journal_survives_restart(tmp_path):
+    """First sight of a step signature journals its fingerprint; a
+    head restart replays it, so a later drifted capture alerts against
+    the PRE-restart baseline."""
+    path = str(tmp_path / "head.journal")
+
+    async def first():
+        from ray_tpu.runtime.head import HeadService
+
+        head = HeadService(journal_path=path)
+        addr = await head.start()
+        conn = await rpc.connect(addr)
+        try:
+            await conn.call("add_task_events", events=[
+                _profile_span("jobA", "sigX", BASE_SHARES, time.time()),
+                # non-rank-0 reports are ignored (one fingerprint per
+                # job, not one per rank)
+                _profile_span(
+                    "jobB", "sigY", BASE_SHARES, time.time(), rank=1
+                ),
+            ])
+            stats = await conn.call("profile_stats")
+            assert stats["fingerprints"]["sigX"]["shares"][
+                "hbm_bound"] == pytest.approx(0.4)
+            assert stats["jobs"]["jobA"]["alert"] is False
+            assert "jobB" not in stats["jobs"]
+            assert "sigY" not in stats["fingerprints"]
+        finally:
+            await conn.close()
+            await head.stop()
+
+    asyncio.run(first())
+
+    async def second():
+        from ray_tpu.runtime.head import HeadService
+
+        head = HeadService(journal_path=path)
+        addr = await head.start()
+        conn = await rpc.connect(addr)
+        try:
+            stats = await conn.call("profile_stats")
+            assert "sigX" in stats["fingerprints"]  # survived restart
+            await conn.call("add_task_events", events=[
+                _profile_span(
+                    "jobA", "sigX", DRIFT_SHARES, time.time()
+                ),
+            ])
+            stats = await conn.call("profile_stats")
+            rec = stats["jobs"]["jobA"]
+            assert rec["alert"] is True
+            assert "hbm_bound" in rec["drift"]
+            assert "host_gap" in rec["drift"]
+            assert "compute_floor" not in rec["drift"]
+        finally:
+            await conn.close()
+            await head.stop()
+
+    asyncio.run(second())
+
+
+def _feed_profile(rt, job, sig, shares, ts):
+    rt.run(rt.core.head.call("add_task_events", events=[
+        _profile_span(job, sig, shares, ts)
+    ]))
+
+
+def test_regression_alert_off_on_off(cluster):
+    """The sentinel gauge tracks current state: baseline capture OFF,
+    drifted capture ON, recovered capture OFF again — next to the
+    per-category decomposition gauges."""
+    rt = ray_tpu.api._runtime
+    base = time.time()
+    _feed_profile(rt, "profjob", "sigP", BASE_SHARES, base)
+    stats = state.profile_stats()
+    assert stats["jobs"]["profjob"]["alert"] is False
+    alert_series = 'ray_tpu_profile_regression_alert{job="profjob",worker="head"}'
+    text = state.prometheus_metrics()
+    assert (
+        'ray_tpu_train_mfu_decomposition{job="profjob",'
+        'category="hbm_bound",worker="head"} 0.4' in text
+    )
+    assert f"{alert_series} 0.0" in text
+
+    _feed_profile(rt, "profjob", "sigP", DRIFT_SHARES, base + 1)
+    assert state.profile_stats()["jobs"]["profjob"]["alert"] is True
+    text = state.prometheus_metrics()
+    assert f"{alert_series} 1.0" in text
+
+    _feed_profile(rt, "profjob", "sigP", BASE_SHARES, base + 2)
+    assert state.profile_stats()["jobs"]["profjob"]["alert"] is False
+    text = state.prometheus_metrics()
+    assert f"{alert_series} 0.0" in text
+
+
+def test_api_profile_and_capture_fanout(cluster):
+    """Dashboard /api/profile serves the same ledger; profile_capture
+    fans the request over the collective channel and acks."""
+    from ray_tpu.dashboard import start_dashboard
+
+    rt = ray_tpu.api._runtime
+    _feed_profile(
+        rt, "apijob", "sigAPI", BASE_SHARES, time.time()
+    )
+    dash = start_dashboard()
+    try:
+        with urllib.request.urlopen(dash.url + "/api/profile") as r:
+            body = json.loads(r.read())
+    finally:
+        dash.stop()
+    assert "jobs" in body and "fingerprints" in body
+    rec = body["jobs"]["apijob"]
+    for key in ("sig", "shares", "step_s", "steps", "dominant_gap",
+                "drift", "alert", "path", "ts"):
+        assert key in rec
+    assert rec["dominant_gap"] == "hbm_bound"
+    reply = state.profile_capture(steps=2)
+    assert reply["ok"] is True and reply["steps"] == 2
+
+
+# ----------------------------------------------------------- surfaces
+def test_cli_profile_schema(monkeypatch, capsys):
+    """Tier-1 smoke of the exact `ray_tpu profile` output path."""
+    from ray_tpu import scripts
+
+    monkeypatch.setattr(scripts, "_connect", lambda *a, **k: None)
+    stats = {
+        "jobs": {
+            "jobZ": {
+                "sig": "sigZ", "shares": dict(BASE_SHARES),
+                "step_s": 0.0213, "steps": 3,
+                "dominant_gap": "hbm_bound",
+                "drift": {"hbm_bound": -0.75}, "alert": True,
+                "path": "/tmp/capture", "ts": 1.0,
+            },
+        },
+        "fingerprints": {"sigZ": {"job": "jobZ"}},
+    }
+    monkeypatch.setattr(state, "profile_stats", lambda: stats)
+    assert scripts.main(["profile"]) == 0
+    out = capsys.readouterr().out
+    assert "jobZ" in out and "sig=sigZ" in out and "ALERT" in out
+    assert "step=21.3ms" in out
+    assert "compute_floor=0.300" in out
+    assert "dominant_gap: hbm_bound" in out
+    assert "drift vs fingerprint" in out
+
+    assert scripts.main(["profile", "--json"]) == 0
+    body = json.loads(capsys.readouterr().out)
+    assert body["jobs"]["jobZ"]["dominant_gap"] == "hbm_bound"
+
+    monkeypatch.setattr(
+        state, "profile_capture", lambda steps=None: {
+            "ok": True, "steps": steps
+        }
+    )
+    assert scripts.main(["profile", "--capture", "--steps", "4"]) == 0
+    assert "capture requested (steps=4)" in capsys.readouterr().out
+
+    monkeypatch.setattr(state, "profile_stats", lambda: {"jobs": {}})
+    assert scripts.main(["profile"]) == 0
+    assert "no profile captures" in capsys.readouterr().out
+
+
+def test_cli_goodput_decomposition_columns(monkeypatch, capsys):
+    """`ray_tpu goodput` prints the in-program decomposition next to
+    the exposure ratios — one fold path, one print path."""
+    from ray_tpu import scripts
+
+    monkeypatch.setattr(scripts, "_connect", lambda *a, **k: None)
+    job = {
+        "goodput": 0.91, "steps": 120, "attempts": 1, "mfu": 0.42,
+        "productive_s": 100.0, "stall_s": 5.0, "restart_lost_s": 0.0,
+        "comm_exposed_s": 2.0, "comm_overlapped_s": 8.0,
+        "comm_exposed_ratio": 0.2,
+        "profile": {
+            "shares": dict(BASE_SHARES), "dominant_gap": "hbm_bound",
+            "alert": True, "sig": "sigG", "step_s": 0.02, "steps": 3,
+            "drift": {}, "path": "", "ts": 0.0,
+        },
+    }
+    monkeypatch.setattr(
+        state, "train_stats", lambda: {"jobs": {"gjob": job}}
+    )
+    assert scripts.main(["goodput"]) == 0
+    out = capsys.readouterr().out
+    assert "in_program:" in out
+    assert "hbm_bound=0.400" in out
+    assert "dominant_gap=hbm_bound" in out
+    assert "ALERT" in out
+    # Without a capture the goodput rollup prints exactly as before.
+    monkeypatch.setattr(
+        state, "train_stats",
+        lambda: {"jobs": {"gjob": {
+            k: v for k, v in job.items() if k != "profile"
+        }}},
+    )
+    assert scripts.main(["goodput"]) == 0
+    assert "in_program:" not in capsys.readouterr().out
+
+
+# --------------------------------------------- sanitizer follow-up
+def test_sanitizer_counts_cache_eviction_recompiles(caplog):
+    """A backend compile during an ALREADY-SEEN signature past the
+    grace is an XLA cache-eviction recompile: signature tracking alone
+    is blind to it, the jax.monitoring compile event is not."""
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from ray_tpu._private import sanitize
+
+    sanitize.reset()
+    sanitize._register_compile_monitor()
+    fire = {"on": False}
+
+    def fake_jitted(x):
+        if fire["on"]:
+            jax.monitoring.record_event_duration_secs(
+                sanitize._BACKEND_COMPILE_EVENT, 0.01
+            )
+        return x
+
+    f = sanitize.watch_jit(fake_jitted, name="t.evict")
+    sanitize._jax_watch_count += 1  # gate the listener open
+    try:
+        for _ in range(5):
+            f(jnp.zeros((4,)))
+        assert sanitize.stats()["recompiles"] == 0
+        fire["on"] = True  # simulate the evicted-executable recompile
+        with caplog.at_level(
+            "WARNING", logger="ray_tpu._private.sanitize"
+        ):
+            f(jnp.zeros((4,)))
+        assert sanitize.stats()["recompiles"] == 1
+        msgs = [
+            r.getMessage() for r in caplog.records
+            if "ALREADY-SEEN" in r.message
+        ]
+        assert len(msgs) == 1
+        assert "t.evict" in msgs[0] and "evicted" in msgs[0]
+        assert sanitize._recompile_counter().value(
+            tags={"fn": "t.evict"}) == 1
+        # The listener is gated: with no watch installed the event
+        # does not count.
+        sanitize._jax_watch_count -= 1
+        before = sanitize._backend_compiles
+        jax.monitoring.record_event_duration_secs(
+            sanitize._BACKEND_COMPILE_EVENT, 0.01
+        )
+        assert sanitize._backend_compiles == before
+        sanitize._jax_watch_count += 1
+    finally:
+        sanitize._jax_watch_count -= 1
+        sanitize.reset()

@@ -170,6 +170,40 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                          "between chunk writes and the "
                                          "manifest commit open this "
                                          "long (kill-mid-save tests)"),
+    "CKPT_ERASURE": (str, "", "'k,m' enables chunk-level erasure coding: "
+                              "k data + m parity shards per group, "
+                              "placed on distinct slices; any m losses "
+                              "reconstruct ((k+m)/k bytes vs "
+                              "replication's Nx). Empty = off"),
+    "CKPT_VERIFY_READS": (bool, True, "re-hash every chunk on get_chunk; "
+                                      "a mismatch is treated as a "
+                                      "missing replica (corruption "
+                                      "detection on the read path)"),
+    "CKPT_CORRUPT": (str, "", "chaos spec: 'prefix:prob' — chunk reads "
+                              "whose hash starts with prefix are "
+                              "bit-flipped with probability prob "
+                              "(deterministic per chunk), driving the "
+                              "detect→reconstruct path"),
+    "CKPT_REMOTE_TIER": (str, "", "remote spill tier for committed "
+                                  "checkpoints: a directory path or "
+                                  "file:// URI (FileTier), or gs:// "
+                                  "(GCS, requires the cloud SDK). "
+                                  "Empty = in-cluster only"),
+    "CKPT_REMOTE_TIMEOUT_S": (float, 10.0, "deadline per remote-tier "
+                                           "call; a slow or dead tier "
+                                           "becomes a typed "
+                                           "RemoteTierError, never a "
+                                           "hang"),
+    "REMOTE_TIER_FAIL": (str, "", "chaos spec: 'outage' (every tier call "
+                                  "raises) or 'latency:<s>' (every tier "
+                                  "call sleeps that long first; the "
+                                  "deadline still applies)"),
+    "OBJECT_DRAIN_EVACUATION": (bool, True, "on a drain notice, owners "
+                                            "push sole-primary objects "
+                                            "off the draining node to a "
+                                            "healthy peer (or the "
+                                            "remote tier when no peer "
+                                            "fits)"),
     # --- misc
     "RPC_FAILURE": (str, "", "chaos spec: comma-separated method:prob "
                              "list ('*' matches any method)"),

@@ -172,3 +172,28 @@ def manifest_chunks(entries: dict | list) -> set[str]:
         for sh in e.get("shards", ()):
             out.update(sh.get("chunks", ()))
     return out
+
+
+# Parity groups ride the manifest next to the entries: each record is
+# {"data": [hash...], "parity": [hash...], "lens": [int...]} — the k
+# data members (in matrix-row order), the m parity chunk hashes, and
+# the true byte length of each data member (parity is computed over
+# zero-padded equal-width rows; lens trims the reconstruction).
+
+def parity_chunks(parity: list | None) -> set[str]:
+    """Every PARITY chunk hash recorded by a manifest's parity groups
+    (the data members are already covered by manifest_chunks)."""
+    out: set[str] = set()
+    for g in parity or ():
+        out.update(g.get("parity", ()))
+    return out
+
+
+def parity_group_index(parity: list | None) -> dict[str, dict]:
+    """chunk hash → its parity-group record, for every member (data and
+    parity) of every group. First group wins on (rare) dedup overlap."""
+    out: dict[str, dict] = {}
+    for g in parity or ():
+        for h in list(g.get("data", ())) + list(g.get("parity", ())):
+            out.setdefault(h, g)
+    return out

@@ -24,8 +24,9 @@ import weakref
 
 import numpy as np
 
+from ray_tpu.checkpoint import erasure as _erasure
 from ray_tpu.checkpoint import manifest as _manifest
-from ray_tpu.checkpoint.store import ShardStore, make_uri
+from ray_tpu.checkpoint.store import ShardStore, chunk_hash, make_uri
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 logger = logging.getLogger("ray_tpu.checkpoint")
@@ -52,6 +53,23 @@ PHASE_SECONDS = Histogram(
     "step loop pays)",
     boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
     tag_keys=("job", "phase"),
+)
+REMOTE_LAG = Gauge(
+    "ray_tpu_ckpt_remote_lag_seconds",
+    "snapshot-offload to remote-tier-upload latency of the last "
+    "checkpoint (the replication-lag twin for the durable tier)",
+    tag_keys=("job",),
+)
+REMOTE_ERRORS = Counter(
+    "ray_tpu_ckpt_remote_errors_total",
+    "remote-tier upload failures (saves keep committing in-cluster)",
+    tag_keys=("job",),
+)
+REMOTE_ALERT = Gauge(
+    "ray_tpu_ckpt_remote_alert",
+    "1 while the newest committed checkpoint has NOT reached the remote "
+    "tier (outage / lag alert), 0 once it has",
+    tag_keys=("job",),
 )
 
 # Live checkpointers in this process: the emergency-unwind barrier
@@ -122,6 +140,7 @@ class AsyncCheckpointer:
         rank: int | None = None,
         world: int | None = None,
         local_prefixes: tuple[str, ...] = (),
+        erasure: str | tuple[int, int] | None = None,
     ):
         from ray_tpu._private import config
         from ray_tpu.train import session
@@ -137,6 +156,16 @@ class AsyncCheckpointer:
             if replication is not None
             else config.get("CKPT_REPLICATION")
         )
+        # (k, m) or None. With erasure on, each group's k data + m parity
+        # chunks land on DISTINCT nodes (slice-diverse order) at
+        # `replication` copies each — replication=1 is the intended
+        # pairing: (k+m)/k bytes, any m node losses reconstructible.
+        if erasure is None:
+            self.erasure = _erasure.parse_spec(config.get("CKPT_ERASURE"))
+        elif isinstance(erasure, str):
+            self.erasure = _erasure.parse_spec(erasure)
+        else:
+            self.erasure = erasure
         # Subtree prefixes that are already per-rank shards (the ZeRO
         # optimizer state): persisted as-held, never re-partitioned
         # (manifest.owned_items local_prefixes semantics).
@@ -302,6 +331,11 @@ class AsyncCheckpointer:
                     "shards": shards,
                 }
             )
+        parity: list[dict] = []
+        if self.erasure:
+            parity = self._encode_parity(
+                shard_store, list(locations), own_addr, locations
+            )
         write_s = time.perf_counter() - t0
         delay = config.get("CKPT_PERSIST_DELAY_S")
         if delay:
@@ -312,7 +346,15 @@ class AsyncCheckpointer:
 
         t1 = time.perf_counter()
         all_chunks = list(locations)
-        replicated = self._replicate(rt, all_chunks, own_addr, locations)
+        deletable: list[str] = []
+        if self.erasure:
+            replicated, deletable = self._distribute(
+                rt, own_addr, locations, parity
+            )
+        else:
+            replicated = self._replicate(
+                rt, all_chunks, own_addr, locations
+            )
         repl_s = time.perf_counter() - t1
 
         t2 = time.perf_counter()
@@ -324,11 +366,22 @@ class AsyncCheckpointer:
                 rank=self.rank,
                 world=self.world,
                 entries=entries,
+                parity=parity,
                 locations=locations,
                 metrics=metrics,
             )
         )
         commit_s = time.perf_counter() - t2
+        remote = self._remote_offload(
+            shard_store, step, entries, parity, all_chunks, metrics,
+            t_offloaded,
+        )
+        # Erasure placement frees the writer's copy of chunks that landed
+        # elsewhere — that is where the ≤(k+m)/k stored-bytes ratio comes
+        # from. Deletion strictly AFTER commit + remote upload: until
+        # then the local copy is the only confirmed-readable one.
+        for h in deletable:
+            shard_store.delete_chunk(h)
         lag = time.time() - t_offloaded
 
         tags = {"job": self.run}
@@ -361,11 +414,172 @@ class AsyncCheckpointer:
             "logical_bytes": logical,
             "new_bytes": new_bytes,
             "chunks": len(all_chunks),
+            "parity_groups": len(parity),
             "replicas": replicated,
             "complete": bool(reply.get("complete")),
             "persist_s": write_s + repl_s + commit_s,
             "replication_lag_s": lag,
+            "remote": remote,
         }
+
+    # ---------------------------------------------------------- erasure
+    def _encode_parity(
+        self, shard_store, data_hashes, own_addr, locations
+    ) -> list[dict]:
+        """Group this rank's chunks k at a time and store m parity
+        chunks per group (content-addressed like any other chunk, so a
+        repeated save dedups its parity too). Returns the manifest
+        parity-group records: {"data", "parity", "lens"}."""
+        k, m = self.erasure
+        groups: list[dict] = []
+        for grp in _erasure.plan_groups(data_hashes, k):
+            datas = []
+            for h in grp:
+                d = shard_store.get_chunk(h)
+                if d is None:
+                    # Only reachable under the corrupt-chunk chaos knob:
+                    # put_bytes just wrote these. Skip the group — its
+                    # members keep plain replication protection.
+                    logger.warning(
+                        "parity encode: chunk %s unreadable, skipping "
+                        "group", h[:12]
+                    )
+                    datas = None
+                    break
+                datas.append(d)
+            if datas is None:
+                continue
+            phashes = []
+            for p in _erasure.encode(datas, m):
+                ph = chunk_hash(p)
+                shard_store.put_chunk(ph, p)
+                locations.setdefault(ph, [own_addr])
+                phashes.append(ph)
+            groups.append(
+                {
+                    "data": list(grp),
+                    "parity": phashes,
+                    "lens": [len(d) for d in datas],
+                }
+            )
+        return groups
+
+    def _distribute(
+        self, rt, own_addr, locations, parity_groups
+    ) -> tuple[int, list[str]]:
+        """Erasure placement: spread each group's k+m members over
+        DISTINCT nodes (the peer-candidate order is slice-interleaved,
+        so consecutive targets sit on different slices — any m node OR
+        slice losses leave ≥k members). Each member gets
+        ``self.replication`` copies (1 is the intended pairing).
+
+        Returns (peer pushes confirmed, chunks whose local copy became
+        redundant and can be deleted after commit)."""
+        targets = [own_addr] + self._peer_candidates(rt, own_addr)
+        if len(targets) == 1:
+            return 0, []  # single node: everything stays local
+        assigned: dict[str, list[str]] = {}
+        for g, grp in enumerate(parity_groups):
+            members = list(grp["data"]) + list(grp["parity"])
+            for i, h in enumerate(members):
+                if h in assigned:
+                    continue  # dedup across groups
+                assigned[h] = [
+                    targets[(g + i + r) % len(targets)]
+                    for r in range(min(self.replication, len(targets)))
+                ]
+        # Chunks outside any group (corrupt-chaos skip) stay local.
+        per_target: dict[str, list[str]] = {}
+        for h, tgts in assigned.items():
+            for t in tgts:
+                if t != own_addr:
+                    per_target.setdefault(t, []).append(h)
+        pushed: dict[str, set[str]] = {}
+        confirmed = 0
+        for peer, hs in per_target.items():
+            try:
+                conn = rt.run(rt.core._connect(peer))
+                reply = rt.run(
+                    conn.call(
+                        "prefetch_objects", oids=hs, owner_addr=own_addr
+                    )
+                )
+            except Exception as e:  # noqa: BLE001 - peer died: chunks
+                logger.warning(     # stay local, head repair replaces
+                    "erasure placement to %s failed: %r", peer, e
+                )
+                continue
+            results = reply.get("results", {})
+            ok = {h for h in hs if results.get(h)}
+            if ok:
+                confirmed += 1
+            pushed[peer] = ok
+        deletable: list[str] = []
+        for h, tgts in assigned.items():
+            landed = [
+                t
+                for t in tgts
+                if t == own_addr or h in pushed.get(t, ())
+            ]
+            if landed and own_addr not in tgts:
+                locations[h] = sorted(landed)
+                deletable.append(h)
+            else:
+                locations[h] = sorted({own_addr, *landed})
+        return confirmed, deletable
+
+    # ------------------------------------------------------ remote tier
+    def _remote_offload(
+        self, shard_store, step, entries, parity, chunks, metrics,
+        t_offloaded,
+    ) -> dict | None:
+        """Upload the committed manifest + chunks to the remote spill
+        tier (CKPT_REMOTE_TIER), after in-cluster replication. Failure
+        is ALERT + retry-next-save, never a save failure: the cluster
+        copy committed, only cross-cluster durability lags."""
+        from ray_tpu.checkpoint import remote as _remote
+
+        tags = {"job": self.run}
+        tier = _remote.get_tier()
+        if tier is None:
+            return None
+        try:
+            uploaded = 0
+            for h in chunks:
+                if tier.has_chunk(h):
+                    continue
+                data = shard_store.get_chunk(h)
+                if data is None:
+                    continue
+                tier.put_chunk(h, data)
+                uploaded += 1
+            tier.put_manifest(
+                self.run,
+                int(step),
+                self.rank,
+                {
+                    "run": self.run,
+                    "step": int(step),
+                    "rank": self.rank,
+                    "world": self.world,
+                    "entries": entries,
+                    "parity": parity,
+                    "metrics": metrics,
+                    "ts": time.time(),
+                },
+            )
+        except _remote.RemoteTierError as e:
+            REMOTE_ERRORS.inc(1, tags=tags)
+            REMOTE_ALERT.set(1.0, tags=tags)
+            logger.warning(
+                "remote tier offload failed for %s step %s: %s "
+                "(saves continue in-cluster)", self.run, step, e,
+            )
+            return {"ok": False, "error": str(e)}
+        lag = time.time() - t_offloaded
+        REMOTE_LAG.set(lag, tags=tags)
+        REMOTE_ALERT.set(0.0, tags=tags)
+        return {"ok": True, "chunks_uploaded": uploaded, "lag_s": lag}
 
     # -------------------------------------------------------- replicate
     def _pick_peers(self, rt, own_addr: str) -> list[str]:
@@ -375,6 +589,14 @@ class AsyncCheckpointer:
         when the cluster has them (one peer per slice, round-robin),
         before doubling up within a slice; same-slice-as-us and
         draining nodes come last."""
+        return self._peer_candidates(rt, own_addr)[
+            : max(0, self.replication - 1)
+        ]
+
+    def _peer_candidates(self, rt, own_addr: str) -> list[str]:
+        """Every peer node addr, ordered slice-diverse-first (one addr
+        per slice per round), then same-slice/draining fallbacks, with a
+        deterministic per-rank rotation."""
         try:
             status = rt.run(rt.core.head.call("cluster_status"))
         except Exception as e:  # noqa: BLE001 - degraded head: local-only
@@ -418,7 +640,7 @@ class AsyncCheckpointer:
         if candidates:
             shift = self.rank % len(candidates)
             candidates = candidates[shift:] + candidates[:shift]
-        return candidates[: max(0, self.replication - 1)]
+        return candidates
 
     def _replicate(
         self, rt, chunks: list[str], own_addr: str, locations: dict

@@ -14,9 +14,18 @@ spill-to-disk machinery all apply to checkpoint data for free.
 from __future__ import annotations
 
 import hashlib
+import logging
 
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import Serialized
+from ray_tpu.util.metrics import Counter
+
+logger = logging.getLogger(__name__)
+
+CORRUPT_CHUNKS = Counter(
+    "ray_tpu_ckpt_corrupt_chunks_total",
+    "checkpoint chunks whose stored bytes failed the content-hash check",
+)
 
 # Chunk keys are truncated sha256 digests widened to the ObjectID wire
 # format so every existing object RPC can carry them.
@@ -57,6 +66,28 @@ def default_chunk_bytes() -> int:
     return int(config.get("CKPT_CHUNK_BYTES"))
 
 
+def _maybe_corrupt(hex_hash: str, data: bytes) -> bytes:
+    """Chaos hook: CKPT_CORRUPT='prefix:prob' flips a byte in matching
+    chunks. The decision is a deterministic hash of the chunk id, so a
+    corrupted chunk stays corrupted across retries — the reader can
+    never win by re-reading, only by reconstructing."""
+    from ray_tpu._private import config
+
+    spec = config.get("CKPT_CORRUPT")
+    if not spec:
+        return data
+    prefix, _, prob = spec.partition(":")
+    if prefix and not hex_hash.startswith(prefix):
+        return data
+    die = int(hashlib.sha256(("corrupt:" + hex_hash).encode()).hexdigest()[:8], 16)
+    if die / 0xFFFFFFFF >= float(prob or 1.0):
+        return data
+    buf = bytearray(data)
+    if buf:
+        buf[len(buf) // 2] ^= 0xFF
+    return bytes(buf)
+
+
 class ShardStore:
     """Thin content-addressed facade over one node's ObjectStore."""
 
@@ -88,16 +119,29 @@ class ShardStore:
         return self._store.contains(chunk_oid(hex_hash))
 
     def get_chunk(self, hex_hash: str) -> bytes | None:
+        from ray_tpu._private import config
+
         oid = chunk_oid(hex_hash)
         view = self._store.get(oid)
         if view is None:
             return None
         try:
-            return bytes(view.inband)
+            data = bytes(view.inband)
         finally:
             # Checkpoint restores touch thousands of chunks; pinning
             # every mmap would hold the whole checkpoint in shm.
             self._store.release(oid)
+        data = _maybe_corrupt(hex_hash, data)
+        if config.get("CKPT_VERIFY_READS") and chunk_hash(data) != hex_hash:
+            # Bit rot (or the chaos knob above). A corrupt local copy is
+            # indistinguishable from a missing one to callers: they fall
+            # through to peers / parity reconstruction, which re-caches a
+            # good copy over this one.
+            CORRUPT_CHUNKS.inc()
+            logger.warning("ckpt chunk %s failed content-hash check; "
+                           "treating as missing", hex_hash[:12])
+            return None
+        return data
 
     def put_chunk(self, hex_hash: str, data: bytes) -> int:
         oid = chunk_oid(hex_hash)

@@ -113,6 +113,8 @@ class CoreWorker:
         # after caching; reference: ownership_object_directory.h location
         # updates). Lets later pulls stripe across many sources.
         self._locations: dict[str, set] = {}
+        # Drain-time evacuation watch (armed on first in_store record).
+        self._drain_evac_armed = False
 
         # function table
         self._exported: dict[int, str] = {}  # id(fn) → fn_id hex
@@ -409,6 +411,12 @@ class CoreWorker:
     # ------------------------------------------------------ memory store
     def _store_result(self, oid_hex: str, record: tuple):
         self.memory[oid_hex] = record
+        if record and record[0] == "in_store":
+            # Store-resident bytes can sit on a node that later drains:
+            # start watching drain fan-out the first time we own one, so
+            # we can push sole copies to a healthy peer before the node
+            # retires (reference: the raylet's spill-before-exit path).
+            self._arm_drain_evacuation()
         for fut in self._waiters.pop(oid_hex, []):
             if not fut.done():
                 fut.set_result(None)
@@ -505,6 +513,9 @@ class CoreWorker:
                     raise
                 except (rpc.ConnectionLost, rpc.RpcError, ObjectLostError) as e:
                     if not await self._reconstruct(oid_hex, remaining()):
+                        hit = await self._remote_tier_fetch(oid_hex)
+                        if hit is not None:
+                            return hit[1]
                         raise ObjectLostError(
                             f"object {oid_hex[:12]}… lost (holder "
                             f"{need.holder_addr} unreachable) and not "
@@ -512,7 +523,187 @@ class CoreWorker:
                         ) from e
             except ObjectLostError:
                 if not await self._reconstruct(oid_hex, remaining()):
+                    hit = await self._remote_tier_fetch(oid_hex)
+                    if hit is not None:
+                        return hit[1]
                     raise
+
+    # ------------------------------------------- drain-time evacuation
+    def _arm_drain_evacuation(self) -> None:
+        """Idempotently subscribe to drain fan-out (via the collective
+        death watch — pubsub allows one handler per channel, so drain
+        notices reach us through drain.add_listener, not a second
+        subscription)."""
+        if self._drain_evac_armed or not config.get(
+            "OBJECT_DRAIN_EVACUATION"
+        ):
+            return
+        if self.head is None or self.mode == "client":
+            return  # client drivers can't pull from node stores anyway
+        self._drain_evac_armed = True
+        from ray_tpu.runtime import drain
+
+        drain.add_listener(self._on_drain_notice)
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        from ray_tpu import collective as _coll
+
+        t = loop.create_task(_coll._ensure_death_watch(self))
+        t.add_done_callback(lambda t: t.exception())
+
+    def _on_drain_notice(self, notice: dict) -> None:
+        """drain.record() callback (sync, runs in the pubsub handler):
+        schedule the actual evacuation on the loop."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        t = loop.create_task(self._evacuate_for_drain(notice))
+        t.add_done_callback(lambda t: t.exception())
+
+    async def _evacuate_for_drain(self, notice: dict) -> None:
+        """Push owned objects whose ONLY copies live on the draining
+        node to a healthy peer (or, with no peer, to the remote spill
+        tier) while the node is still alive to serve pulls. Without
+        this, every sole-copy object on the node becomes a lineage
+        reconstruction — or a loss — the moment it retires."""
+        drain_addr = notice.get("node_addr")
+        if not drain_addr or self.head is None:
+            return
+        victims: list[str] = []
+        for oid_hex, rec in list(self.memory.items()):
+            if not rec or rec[0] != "in_store":
+                continue
+            primary = rec[1] if len(rec) > 1 else None
+            locs = set(self._locations.get(oid_hex) or ())
+            locs.add(primary or self.node_addr)
+            locs.discard(None)
+            if locs and locs <= {drain_addr}:
+                victims.append(oid_hex)
+        if not victims:
+            return
+        from ray_tpu.runtime.drain import EVACUATED
+
+        try:
+            status = await self.head.call("cluster_status")
+        except (rpc.ConnectionLost, rpc.RpcError):
+            return
+        draining = set(status.get("draining") or {})
+        peers = [
+            n["addr"]
+            for nid, n in sorted((status.get("nodes") or {}).items())
+            if n.get("addr")
+            and n["addr"] != drain_addr
+            and nid not in draining
+        ]
+        if peers:
+            try:
+                peer_addr = peers[0]
+                peer = await self._connect(peer_addr, retries=1)
+                reply = await peer.call(
+                    "prefetch_objects", oids=victims, owner_addr=self.addr
+                )
+            except (rpc.ConnectionLost, rpc.RpcError) as e:
+                EVACUATED.inc(len(victims), tags={"outcome": "failed"})
+                logger.warning(
+                    "drain evacuation to peer %s failed: %s", peers[0], e
+                )
+                return
+            results = reply.get("results") or {}
+            for oid_hex in victims:
+                if results.get(oid_hex):
+                    self._locations.setdefault(oid_hex, set()).add(
+                        peer_addr
+                    )
+                    rec = self.memory.get(oid_hex)
+                    if rec and rec[0] == "in_store":
+                        # Re-point the primary off the doomed node so
+                        # reads never even try it post-retirement. A
+                        # holder-less record means OUR node's store —
+                        # which is the one draining, or the object
+                        # wouldn't be a victim.
+                        self.memory[oid_hex] = ("in_store", peer_addr)
+                    self._locations[oid_hex].discard(drain_addr)
+                    EVACUATED.inc(1, tags={"outcome": "peer"})
+                else:
+                    EVACUATED.inc(1, tags={"outcome": "failed"})
+            return
+        # No healthy peer: spill to the remote tier (the node-side
+        # sweep covers objects in ITS store; this covers records whose
+        # holder is the draining node but we own the directory entry).
+        from ray_tpu.checkpoint import remote as _remote
+
+        tier = _remote.get_tier()
+        if tier is None:
+            EVACUATED.inc(len(victims), tags={"outcome": "failed"})
+            return
+        from ray_tpu.runtime import transfer
+
+        for oid_hex in victims:
+            try:
+                conn = await self._connect(drain_addr, retries=1)
+                inband, buffers = await transfer.pull_object(
+                    oid_hex, [conn], 60.0,
+                    chunk_bytes=self.PULL_CHUNK_BYTES,
+                )
+                seg_lens = [len(inband)] + [len(b) for b in buffers]
+                payload = bytes(inband) + b"".join(
+                    bytes(b) for b in buffers
+                )
+                blob = _remote.pack_object(seg_lens, payload)
+                await asyncio.to_thread(tier.put_object, oid_hex, blob)
+                EVACUATED.inc(1, tags={"outcome": "remote_tier"})
+            except (
+                rpc.ConnectionLost,
+                rpc.RpcError,
+                ObjectLostError,
+                _remote.RemoteTierError,
+            ) as e:
+                EVACUATED.inc(1, tags={"outcome": "failed"})
+                logger.warning(
+                    "drain evacuation of %s to remote tier failed: %s",
+                    oid_hex[:12], e,
+                )
+
+    async def _remote_tier_fetch(
+        self, oid_hex: str
+    ) -> tuple[str, Any] | None:
+        """Last rung of the resolution ladder: a drain-evacuated copy in
+        the remote spill tier. Returns ("hit", value) or None — the
+        object's value may itself be None, so a sentinel tuple
+        disambiguates."""
+        from ray_tpu.checkpoint import remote as _remote
+
+        try:
+            tier = _remote.get_tier()
+            if tier is None:
+                return None
+            blob = await asyncio.to_thread(tier.get_object, oid_hex)
+        except _remote.RemoteTierError as e:
+            logger.debug("remote-tier fetch of %s failed: %s",
+                         oid_hex[:12], e)
+            return None
+        if blob is None:
+            return None
+        seg_lens, payload = _remote.unpack_object(blob)
+        mv, segs, pos = memoryview(payload), [], 0
+        for n in seg_lens:
+            segs.append(bytes(mv[pos:pos + n]))
+            pos += n
+        inband, buffers = segs[0], segs[1:]
+        try:
+            self.store.put(
+                ObjectID.from_hex(oid_hex), Serialized(inband, buffers)
+            )
+            self.memory[oid_hex] = ("in_store",)
+        # tpulint: allow(broad-except reason=local re-cache is best-effort; the tier copy stays authoritative and the value is returned regardless)
+        except Exception:
+            pass
+        logger.info("restored object %s… from the remote tier",
+                    oid_hex[:12])
+        return ("hit", deserialize(inband, buffers))
 
     # -------------------------------------------------------------- put
     async def put(self, value: Any):
@@ -682,6 +873,9 @@ class CoreWorker:
                         return await self._get_one(
                             oid_hex, owner_addr, remaining(), _recon - 1
                         )
+                hit = await self._remote_tier_fetch(oid_hex)
+                if hit is not None:
+                    return hit[1]
                 raise ObjectLostError(
                     f"object {oid_hex[:12]}… lost and not "
                     f"reconstructable by its owner: {e}"

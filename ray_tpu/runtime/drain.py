@@ -15,7 +15,21 @@ node's notice and lets callers filter by node address.
 
 from __future__ import annotations
 
+import logging
 import time
+from typing import Callable
+
+from ray_tpu.util.metrics import Counter
+
+logger = logging.getLogger(__name__)
+
+EVACUATED = Counter(
+    "ray_tpu_objects_evacuated_total",
+    "general (non-checkpoint) objects moved off a draining node, by "
+    "outcome: 'peer' (owner pushed to a healthy node), 'remote_tier' "
+    "(no peer fit), 'failed'",
+    tag_keys=("outcome",),
+)
 
 # Keep an expired notice in the registry for a while (forensics: WHY is
 # my node about to die / why did it drain), but stop reporting it as
@@ -26,6 +40,26 @@ _ACTIVE_GRACE_S = 10.0
 
 # node_id → {node_id, node_addr, reason, deadline_ts, since}
 _notices: dict[str, dict] = {}
+
+# Callbacks invoked with each freshly recorded notice (object owners
+# hook drain-time evacuation here without stealing the one-per-channel
+# pubsub handler slot the collective death watch owns).
+_listeners: list[Callable[[dict], None]] = []
+
+
+def add_listener(fn: Callable[[dict], None]) -> None:
+    """Register a callback for future drain notices. Idempotent per
+    function object; exceptions are logged, never propagated into the
+    pubsub handler."""
+    if fn not in _listeners:
+        _listeners.append(fn)
+
+
+def remove_listener(fn: Callable[[dict], None]) -> None:
+    try:
+        _listeners.remove(fn)
+    except ValueError:
+        pass
 
 
 def record(msg: dict) -> None:
@@ -44,6 +78,12 @@ def record(msg: dict) -> None:
         "deadline_ts": float(deadline_ts),
         "since": now,
     }
+    for fn in list(_listeners):
+        try:
+            fn(dict(_notices[str(node_id)]))
+        # tpulint: allow(broad-except reason=a listener bug must not break the registry or the pubsub handler that feeds it)
+        except Exception:
+            logger.warning("drain listener %r failed", fn, exc_info=True)
 
 
 def clear(node_id: str | None) -> None:
@@ -90,6 +130,7 @@ def any_notice() -> dict | None:
 
 
 def reset() -> None:
-    """Test hook: forget every notice (process-local state otherwise
-    leaks across in-process cluster fixtures)."""
+    """Test hook: forget every notice and listener (process-local state
+    otherwise leaks across in-process cluster fixtures)."""
     _notices.clear()
+    _listeners.clear()

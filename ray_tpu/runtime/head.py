@@ -930,6 +930,11 @@ class HeadService:
             self.ckpt_locations.setdefault(
                 payload["chunk"], set()
             ).update(payload["addrs"])
+        elif op == "loc_many":
+            for chunk in payload["chunks"]:
+                self.ckpt_locations.setdefault(chunk, set()).add(
+                    payload["addr"]
+                )
         elif op == "loc_del":
             locs = self.ckpt_locations.get(payload["chunk"])
             if locs is not None:
@@ -944,7 +949,8 @@ class HeadService:
                     self.checkpoints.pop(payload["run"], None)
 
     def _ckpt_apply_commit(
-        self, run, step, rank, world, entries, metrics=None, ts=None
+        self, run, step, rank, world, entries, metrics=None, ts=None,
+        parity=None,
     ) -> bool:
         """Fold one rank's manifest; returns True when this commit
         COMPLETES the checkpoint (every rank of its world committed)."""
@@ -961,6 +967,7 @@ class HeadService:
             rec["complete_ts"] = None
         rec["ranks"][int(rank)] = {
             "entries": list(entries),
+            "parity": list(parity or ()),
             "metrics": dict(metrics or {}),
             "ts": ts if ts is not None else time.time(),
         }
@@ -981,13 +988,15 @@ class HeadService:
         entries: list,
         locations: dict | None = None,
         metrics: dict | None = None,
+        parity: list | None = None,
     ):
         """Commit one rank's shard manifest. The checkpoint becomes
         visible to restore only once all ranks commit — this is the
         consistency protocol: manifest commit = checkpoint exists."""
         now = time.time()
         completed = self._ckpt_apply_commit(
-            run, int(step), int(rank), int(world), entries, metrics, now
+            run, int(step), int(rank), int(world), entries, metrics, now,
+            parity,
         )
         self._journal_append(
             "ckpt",
@@ -998,6 +1007,7 @@ class HeadService:
                 "rank": int(rank),
                 "world": int(world),
                 "entries": list(entries),
+                "parity": list(parity or ()),
                 "metrics": dict(metrics or {}),
                 "ts": now,
             },
@@ -1021,14 +1031,48 @@ class HeadService:
         }
 
     def _ckpt_referenced_chunks(self) -> set[str]:
-        from ray_tpu.checkpoint.manifest import manifest_chunks
+        from ray_tpu.checkpoint.manifest import manifest_chunks, parity_chunks
 
         out: set[str] = set()
         for steps in self.checkpoints.values():
             for rec in steps.values():
                 for r in rec["ranks"].values():
                     out |= manifest_chunks(r["entries"])
+                    # Parity chunks are referenced too: GC'ing them
+                    # would silently strip the erasure protection.
+                    out |= parity_chunks(r.get("parity"))
         return out
+
+    def _ckpt_parity_index(self) -> dict[str, dict]:
+        """chunk → its parity-group record across every retained
+        manifest (the repair loop's reconstruction lookup)."""
+        from ray_tpu.checkpoint.manifest import parity_group_index
+
+        out: dict[str, dict] = {}
+        for steps in self.checkpoints.values():
+            for rec in steps.values():
+                for r in rec["ranks"].values():
+                    for h, g in parity_group_index(r.get("parity")).items():
+                        out.setdefault(h, g)
+        return out
+
+    async def _on_ckpt_locations_add(
+        self, conn, addr: str, chunks: list[str]
+    ):
+        """Batched location report: a node that cached chunks it pulled
+        (or reconstructed) during restore registers itself as a replica
+        so peers can discover the copy and GC knows to collect it."""
+        fresh = []
+        for chunk in chunks:
+            known = self.ckpt_locations.setdefault(chunk, set())
+            if addr not in known:
+                known.add(addr)
+                fresh.append(chunk)
+        if fresh:
+            self._journal_append(
+                "ckpt", "loc_many", {"addr": addr, "chunks": fresh}
+            )
+        return {"ok": True, "added": len(fresh)}
 
     def _ckpt_prune(self, run: str) -> None:
         """Retention: keep the newest CKPT_KEEP complete checkpoints per
@@ -1055,11 +1099,14 @@ class HeadService:
             return
         from ray_tpu.checkpoint.manifest import manifest_chunks
 
+        from ray_tpu.checkpoint.manifest import parity_chunks
+
         victim_chunks: set[str] = set()
         for s in victims:
             rec = steps.pop(s)
             for r in rec["ranks"].values():
                 victim_chunks |= manifest_chunks(r["entries"])
+                victim_chunks |= parity_chunks(r.get("parity"))
             self._journal_append(
                 "ckpt", "prune", {"run": run, "step": s}
             )
@@ -1108,11 +1155,13 @@ class HeadService:
                 rec = steps[s]
                 chunks: set[str] = set()
                 nbytes = 0
+                n_groups = 0
                 for r in rec["ranks"].values():
                     chunks |= manifest_chunks(r["entries"])
                     nbytes += sum(
                         entry_bytes(e) for e in r["entries"]
                     )
+                    n_groups += len(r.get("parity") or ())
                 replicas = [
                     len(self.ckpt_locations.get(h, ())) for h in chunks
                 ]
@@ -1126,6 +1175,10 @@ class HeadService:
                         "bytes": nbytes,
                         "chunks": len(chunks),
                         "min_replicas": min(replicas, default=0),
+                        # Erasure durability at a glance: >0 parity
+                        # groups means losses up to m per group decode
+                        # instead of going to the repair/lost path.
+                        "parity_groups": n_groups,
                     }
                 )
             out[rname] = rows
@@ -1169,13 +1222,20 @@ class HeadService:
                     # Process-sharded leaf: every rank holds disjoint
                     # windows of the same key; restore stitches them.
                     cur["shards"].extend(e["shards"])
+        parity: list = []
+        for rank in sorted(rec["ranks"]):
+            parity.extend(rec["ranks"][rank].get("parity") or ())
         chunks = manifest_chunks(entries)
+        from ray_tpu.checkpoint.manifest import parity_chunks
+
+        chunks |= parity_chunks(parity)
         return {
             "ok": True,
             "run": run,
             "step": s,
             "world": rec["world"],
             "entries": entries,
+            "parity": parity,
             "locations": {
                 h: sorted(self.ckpt_locations.get(h, ()))
                 for h in chunks
@@ -1206,9 +1266,14 @@ class HeadService:
             for s, rec in sorted(steps.items()):
                 if rec["complete_ts"] is None:
                     continue
+                from ray_tpu.checkpoint.manifest import parity_chunks
+
                 chunks: set[str] = set()
+                groups: list[dict] = []
                 for r in rec["ranks"].values():
                     chunks |= manifest_chunks(r["entries"])
+                    groups.extend(r.get("parity") or ())
+                    chunks |= parity_chunks(r.get("parity"))
                 healthy_counts: dict[str, int] = {}
                 healthy_holders: dict[str, list[str]] = {}
                 for h in sorted(chunks):
@@ -1249,6 +1314,30 @@ class HeadService:
                             by_slice[sl] = by_slice.get(sl, 0) + 1
                     if any(v >= 2 for v in by_slice.values()):
                         colocated.append(h)
+                # Erasure-group health: a group is intact while every
+                # member has a healthy replica, degraded (but fully
+                # reconstructable) while ≤m members are down, lost once
+                # more than m are — degraded is the repair loop's work
+                # queue, lost is the alarm.
+                g_intact = g_degraded = g_lost = 0
+                reconstructable: set[str] = set()
+                for g in groups:
+                    members = list(g.get("data", ())) + list(
+                        g.get("parity", ())
+                    )
+                    m_tol = len(g.get("parity", ()))
+                    down = [
+                        h
+                        for h in members
+                        if healthy_counts.get(h, 0) == 0
+                    ]
+                    if not down:
+                        g_intact += 1
+                    elif len(down) <= m_tol:
+                        g_degraded += 1
+                        reconstructable.update(down)
+                    else:
+                        g_lost += 1
                 target = min(want, max(1, len(alive)))
                 reports.append(
                     {
@@ -1271,6 +1360,12 @@ class HeadService:
                             for h, v in healthy_counts.items()
                             if v == 0
                         ),
+                        "reconstructable": sorted(reconstructable),
+                        "groups": {
+                            "intact": g_intact,
+                            "degraded": g_degraded,
+                            "lost": g_lost,
+                        },
                         "colocated": sorted(colocated),
                     }
                 )
@@ -1334,9 +1429,13 @@ class HeadService:
         referenced = self._ckpt_referenced_chunks()
         # (source, target) → chunks: one batched prefetch per pair.
         plan: dict[tuple[str, str], list[str]] = {}
+        # Chunks with ZERO live replicas: unrecoverable by copying, but
+        # an erasure group with ≥k surviving members can re-encode them.
+        zero_replica: list[str] = []
         for chunk in referenced:
             locs = self.ckpt_locations.get(chunk)
             if not locs:
+                zero_replica.append(chunk)
                 continue
             live = locs & set(alive)
             healthy = live - draining_addrs
@@ -1353,7 +1452,9 @@ class HeadService:
                 continue
             sources = sorted(healthy) or sorted(live)
             if not sources:
-                continue  # every replica gone until a holder returns
+                # Every replica gone: reconstruction is the only move.
+                zero_replica.append(chunk)
+                continue
             held_slices = {
                 addr_slice.get(a) for a in live if addr_slice.get(a)
             }
@@ -1387,6 +1488,81 @@ class HeadService:
                     self._journal_append(
                         "ckpt", "loc", {"chunk": chunk, "addrs": [tgt]}
                     )
+        if zero_replica:
+            await self._ckpt_reconstruct_lost(
+                zero_replica, alive, healthy_addrs
+            )
+
+    async def _ckpt_reconstruct_lost(
+        self, chunks: list[str], alive: dict, healthy_addrs: set[str]
+    ) -> None:
+        """Erasure-aware repair: a chunk with zero live replicas is
+        re-ENCODED on a healthy node from its parity group's survivors
+        (k member pulls + a small GF solve) instead of being written
+        off — the whole point of paying the m/k parity bytes."""
+        group_of = self._ckpt_parity_index()
+        for chunk in chunks:
+            g = group_of.get(chunk)
+            if g is None:
+                continue  # no parity group: stays lost until a holder returns
+            members = list(g.get("data", ())) + list(g.get("parity", ()))
+            k = len(g.get("data", ()))
+            rows = []
+            for idx, mh in enumerate(members):
+                if mh == chunk:
+                    continue
+                holders = sorted(
+                    a
+                    for a in self.ckpt_locations.get(mh, ())
+                    if a in alive
+                )
+                if holders:
+                    rows.append(
+                        {"member": idx, "hash": mh, "addrs": holders}
+                    )
+            if len(rows) < k:
+                logger.warning(
+                    "ckpt chunk %s lost: only %d/%d group members "
+                    "survive", chunk[:12], len(rows), k,
+                )
+                continue
+            # Run the decode where the most survivors already live:
+            # fewest cross-node member pulls.
+            held: dict[str, int] = {}
+            for r in rows:
+                for a in r["addrs"]:
+                    if a in healthy_addrs:
+                        held[a] = held.get(a, 0) + 1
+            tgt = max(
+                sorted(healthy_addrs), key=lambda a: held.get(a, 0)
+            )
+            node_conn = self._node_conns.get(alive.get(tgt, ""))
+            if node_conn is None:
+                continue
+            try:
+                reply = await node_conn.call(
+                    "ckpt_reconstruct",
+                    chunk=chunk,
+                    k=k,
+                    m=len(g.get("parity", ())),
+                    member=members.index(chunk),
+                    rows=rows[: k + 2],
+                    lens=g.get("lens"),
+                )
+            except Exception as e:  # noqa: BLE001 - target died
+                logger.debug(        # mid-repair: next tick replans
+                    "reconstruct %s on %s failed: %r", chunk[:12], tgt, e
+                )
+                continue
+            if reply.get("ok"):
+                self.ckpt_locations.setdefault(chunk, set()).add(tgt)
+                self._journal_append(
+                    "ckpt", "loc", {"chunk": chunk, "addrs": [tgt]}
+                )
+                logger.info(
+                    "reconstructed lost ckpt chunk %s on %s from its "
+                    "parity group", chunk[:12], tgt,
+                )
 
     async def _on_pick_node(
         self,

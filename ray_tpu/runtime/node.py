@@ -909,12 +909,19 @@ class NodeManager:
         """Head-pushed drain flag (the head is the authority; this flag
         makes the node's OWN lease path refuse work, which is what
         diverts local-first task/actor placement to other nodes)."""
+        was_draining = self.draining
         self.draining = bool(draining)
         self.drain_info = (
             {"reason": reason, "deadline_ts": deadline_ts}
             if draining
             else None
         )
+        if draining and not was_draining:
+            # Drain-window evacuation, node side: owners push their
+            # sole-primary objects to healthy peers; when NO healthy
+            # peer exists this store is the last copy of everything in
+            # it, so sweep it to the remote tier before retiring.
+            asyncio.ensure_future(self._drain_evacuate_store())
         if draining:
             # Queued-but-ungranted leases bounce now — their callers
             # should spill to a node that will outlive them.
@@ -931,6 +938,58 @@ class NodeManager:
             self._pending = []
             self._bump_resources()
         return {"ok": True}
+
+    async def _drain_evacuate_store(self) -> None:
+        """No-healthy-peer endgame of drain evacuation: push every
+        store-resident object to the remote tier (owners cover the
+        push-to-peer case; with no peer to push to, the tier is the only
+        place the bytes can outlive this node)."""
+        from ray_tpu._private import config
+
+        if not config.get("OBJECT_DRAIN_EVACUATION"):
+            return
+        from ray_tpu.checkpoint import remote as _remote
+        from ray_tpu.runtime.drain import EVACUATED
+
+        tier = _remote.get_tier()
+        if tier is None or self.head is None:
+            return
+        try:
+            status = await self.head.call("cluster_status")
+        except rpc.RpcError:
+            return
+        draining = set(status.get("draining") or {})
+        peers = [
+            n
+            for nid, n in (status.get("nodes") or {}).items()
+            if n.get("addr") and n["addr"] != self.addr
+            and nid not in draining
+        ]
+        if peers:
+            return  # owners evacuate to peers; nothing for the tier
+        store = self._store()
+        for oid in store.iter_ids():
+            view = store.get(oid)
+            if view is None:
+                continue
+            try:
+                seg_lens = [len(view.inband)] + [
+                    len(b) for b in view.buffers
+                ]
+                payload = bytes(view.inband) + b"".join(
+                    bytes(b) for b in view.buffers
+                )
+                blob = _remote.pack_object(seg_lens, payload)
+                await asyncio.to_thread(tier.put_object, oid.hex(), blob)
+                EVACUATED.inc(1, tags={"outcome": "remote_tier"})
+            except _remote.RemoteTierError as e:
+                EVACUATED.inc(1, tags={"outcome": "failed"})
+                logger.warning(
+                    "drain evacuation of %s to remote tier failed: %s",
+                    oid.hex()[:12], e,
+                )
+            finally:
+                store.release(oid)
 
     async def self_drain(
         self, reason: str, deadline_s: float | None = None
@@ -1243,6 +1302,73 @@ class NodeManager:
             except ValueError:
                 continue
         return {"ok": True, "deleted": deleted}
+
+    async def _on_ckpt_reconstruct(
+        self,
+        conn,
+        chunk: str,
+        k: int,
+        m: int,
+        member: int,
+        rows: list,
+        lens: list | None = None,
+    ):
+        """Erasure repair executor: gather ≥k surviving members of a
+        parity group (local store first, then their recorded holders),
+        decode the lost member, verify it by content hash, and keep the
+        result in THIS node's store. The head picks the node already
+        holding the most survivors, so most member reads are local."""
+        from ray_tpu.checkpoint import erasure
+        from ray_tpu.checkpoint.store import ShardStore, chunk_hash
+        from ray_tpu.runtime import transfer
+
+        store = ShardStore(self._store())
+        if store.has_chunk(chunk):
+            return {"ok": True, "cached": True}
+        present: dict[int, bytes] = {}
+        for row in rows:
+            if len(present) >= int(k):
+                break
+            mh = row["hash"]
+            data = store.get_chunk(mh)
+            if data is None:
+                for addr in row.get("addrs", ()):
+                    if addr == self.addr:
+                        continue
+                    try:
+                        peer = await self._connect_peer(addr, retries=1)
+                        data, _bufs = await transfer.pull_object(
+                            mh, [peer]
+                        )
+                    # tpulint: allow(broad-except reason=dead survivor holder mid-repair is expected; the next addr or the next repair tick covers it)
+                    except Exception:
+                        data = None
+                        continue
+                    if data is not None and chunk_hash(data) == mh:
+                        break
+                    data = None
+            if data is not None:
+                present[int(row["member"])] = data
+        if len(present) < int(k):
+            return {
+                "ok": False,
+                "error": f"only {len(present)}/{k} group members "
+                "reachable",
+            }
+        try:
+            data = erasure.recover_member(
+                int(k), int(m), present, int(member), lens
+            )
+        # tpulint: allow(broad-except reason=a singular survivor set or corrupt member must report as a typed per-chunk failure to the head, not kill the RPC server)
+        except Exception as e:
+            return {"ok": False, "error": f"decode failed: {e!r}"}
+        if chunk_hash(data) != chunk:
+            return {
+                "ok": False,
+                "error": "reconstructed bytes fail content-hash check",
+            }
+        store.put_chunk(chunk, data)
+        return {"ok": True, "cached": False}
 
     async def _on_get_object_meta(self, conn, oid_hex: str):
         from ray_tpu._private.ids import ObjectID

@@ -290,6 +290,30 @@ class ObjectStore:
         self._views.pop(object_id, None)
         return freed
 
+    def iter_ids(self) -> list[ObjectID]:
+        """Every object resident in this store — pool, file-backed, and
+        spilled copies. This is the drain-evacuation sweep's work list:
+        anything here is a primary some consumer may still resolve to."""
+        seen: set[ObjectID] = set()
+        if self.pool is not None:
+            for id_bytes, _size, _lru in self.pool.scan():
+                try:
+                    seen.add(ObjectID(id_bytes))
+                except ValueError:
+                    continue
+        for name, _size in self.list_objects():
+            try:
+                seen.add(ObjectID.from_hex(name))
+            except ValueError:
+                continue
+        if self.spill_dir.exists():
+            for p in self.spill_dir.iterdir():
+                try:
+                    seen.add(ObjectID.from_hex(p.name))
+                except ValueError:
+                    continue
+        return sorted(seen, key=lambda o: o.hex())
+
     def list_objects(self) -> list[tuple[str, int]]:
         """(object_id hex, size) pairs. Best-effort: covers the
         file-backed objects; the native pool does not expose a scan."""

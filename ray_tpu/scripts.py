@@ -419,10 +419,40 @@ def cmd_head(args) -> int:
 def cmd_ckpt(args) -> int:
     """Shard-store checkpoints: `ckpt ls` lists per-run manifests with
     dedup'd sizes and replica health; `ckpt verify` probes every chunk
-    on its recorded holders and reports under-replicated/lost ones."""
+    on its recorded holders and reports under-replicated/lost ones;
+    `ckpt push`/`ckpt pull` copy a committed checkpoint to/from the
+    remote spill tier (portable across cluster teardowns)."""
     from ray_tpu.util import state
 
     _connect(args.address, getattr(args, "session_dir", None))
+    if args.action in ("push", "pull"):
+        from ray_tpu.checkpoint import remote as _remote
+
+        if not args.run:
+            print("ckpt push/pull requires --run", file=sys.stderr)
+            return 2
+        try:
+            tier = _remote.get_tier(args.tier) if args.tier else None
+            fn = (
+                _remote.push_checkpoint
+                if args.action == "push"
+                else _remote.pull_checkpoint
+            )
+            out = fn(args.run, step=args.step, tier=tier)
+        except _remote.RemoteTierError as e:
+            print(f"ckpt {args.action} failed: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            json.dump(out, sys.stdout, indent=2, default=str)
+            print()
+            return 0
+        moved = out.get("uploaded", out.get("inserted", 0))
+        verb = "uploaded" if args.action == "push" else "inserted"
+        print(
+            f"{out['run']} step {out['step']}: {out['chunks']} chunks, "
+            f"{moved} {verb}"
+        )
+        return 0
     if args.action == "verify":
         report = state.verify_checkpoints(run=args.run)
         if args.json:
@@ -470,10 +500,15 @@ def cmd_ckpt(args) -> int:
             status = "complete" if r["complete"] else (
                 f"partial {len(r['ranks'])}/{r['world']}"
             )
+            ec = (
+                f"  parity_groups={r['parity_groups']}"
+                if r.get("parity_groups")
+                else ""
+            )
             print(
                 f"{run} step {r['step']}: {status}  world={r['world']}  "
                 f"bytes={r['bytes']}  chunks={r['chunks']}  "
-                f"min_replicas={r['min_replicas']}"
+                f"min_replicas={r['min_replicas']}{ec}"
             )
     return 0
 
@@ -831,10 +866,16 @@ def main(argv=None) -> int:
                     help="raw head stats as JSON")
     cp = sub.add_parser("ckpt",
                         help="in-cluster shard-store checkpoints")
-    cp.add_argument("action", choices=["ls", "verify"],
+    cp.add_argument("action", choices=["ls", "verify", "push", "pull"],
                     help="ls: list checkpoints; verify: probe every "
-                         "chunk replica on its holders")
+                         "chunk replica on its holders; push/pull: copy "
+                         "a checkpoint to/from the remote spill tier")
     cp.add_argument("--run", default=None, help="restrict to one run")
+    cp.add_argument("--step", type=int, default=None,
+                    help="push/pull: checkpoint step (default: newest)")
+    cp.add_argument("--tier", default=None,
+                    help="push/pull: tier spec (path or gs://…); "
+                         "default: RAY_TPU_CKPT_REMOTE_TIER")
     cp.add_argument("--json", action="store_true",
                     help="raw head reply as JSON")
     lg = sub.add_parser("logs")

@@ -65,6 +65,19 @@ def fast_health_cluster():
     os.environ.pop("RAY_TPU_HEALTH_TIMEOUT_S", None)
 
 
+@pytest.fixture
+def steady_health_cluster():
+    """Health timeout ABOVE the 5s sync keepalive: only genuinely dead
+    nodes get reaped. fast_health_cluster's 2s timeout reaps idle nodes
+    between keepalives (they silently re-register) — fine for repair
+    races, fatal for tests that assert a node's drain record persists."""
+    ray_tpu.init(num_cpus=2, _system_config={"HEALTH_TIMEOUT_S": 6.0})
+    yield
+    ray_tpu.shutdown()
+    _config._overrides.pop("HEALTH_TIMEOUT_S", None)
+    os.environ.pop("RAY_TPU_HEALTH_TIMEOUT_S", None)
+
+
 # -------------------------------------------------- save/restore basics
 def test_roundtrip_and_elastic_reshard(cluster):
     """A sharded state round-trips through the shard store and restores
@@ -475,3 +488,147 @@ def test_restore_latest_valid_logs_and_store_fallback(
     path, state = got
     assert path == "ckpt://fb_run/7"
     assert float(state["x"]) == 3.5
+
+
+# --------------------------------------------- integrity + locations
+def test_get_chunk_verifies_content_hash(cluster):
+    """Integrity on READ: a chunk whose bytes no longer match its
+    content hash is treated as missing (counted + logged), never served.
+    The CKPT_CORRUPT chaos knob flips a byte deterministically, so
+    re-reads can't accidentally pass."""
+    from ray_tpu.checkpoint.store import (
+        CORRUPT_CHUNKS,
+        ShardStore,
+        chunk_hash,
+    )
+
+    rt = core_api._runtime
+    store = ShardStore(rt.core.store)
+    hashes, _ = store.put_bytes(b"payload" * 4096, 1 << 20)
+    h = hashes[0]
+    assert store.get_chunk(h) is not None
+    before = CORRUPT_CHUNKS.value() or 0.0
+    _config._overrides["CKPT_CORRUPT"] = f"{h[:6]}:1.0"
+    try:
+        assert store.get_chunk(h) is None  # corrupt == missing
+        assert store.get_chunk(h) is None  # deterministically so
+        assert (CORRUPT_CHUNKS.value() or 0.0) >= before + 2
+    finally:
+        _config._overrides.pop("CKPT_CORRUPT", None)
+    assert store.get_chunk(h) is not None  # disk bytes were never harmed
+
+    # Verification off: the knob's corruption would pass through, so
+    # the default-on check is what stands between a flipped bit and a
+    # silently wrong restore.
+    _config._overrides["CKPT_CORRUPT"] = f"{h[:6]}:1.0"
+    _config._overrides["CKPT_VERIFY_READS"] = False
+    try:
+        data = store.get_chunk(h)
+        assert data is not None and chunk_hash(data) != h
+    finally:
+        _config._overrides.pop("CKPT_CORRUPT", None)
+        _config._overrides.pop("CKPT_VERIFY_READS", None)
+
+
+def test_restore_reports_pulled_replicas_to_head(
+    fast_health_cluster, tmp_path
+):
+    """The pull-path bugfix pinned: chunks a restore pulls from peers
+    are cached locally AND reported to the head's location table — the
+    next repair/verify sees the new replica instead of a stale map."""
+    rt = core_api._runtime
+    node = _add_node(tmp_path, "locrep", {"CPU": 1.0})
+    try:
+        state = {"w": np.arange(400_000, dtype=np.float32)}
+        cp = dc.AsyncCheckpointer(run="locrep_run", replication=2)
+        cp.save(0, state)
+        cp.wait()
+        from ray_tpu.checkpoint.store import ShardStore
+
+        local = ShardStore(rt.core.store)
+        locs = _holder_addrs("locrep_run")
+        own = rt.core.node_addr
+        for h in locs:
+            local.delete_chunk(h)
+            # Make the head's map honest about the wipe (the stale-map
+            # half of the bug is covered by verify's probing): the
+            # interesting half is that the RESTORE re-adds us.
+            rt.head.ckpt_locations.get(h, set()).discard(own)
+        locs = _holder_addrs("locrep_run")
+        assert not any(own in v for v in locs.values())
+        out = dc.restore("locrep_run", target=state)
+        np.testing.assert_array_equal(out["w"], state["w"])
+        # The head's map now lists this node for every pulled chunk.
+        locs = _holder_addrs("locrep_run")
+        assert all(own in v for v in locs.values()), locs
+        assert all(local.has_chunk(h) for h in locs)
+    finally:
+        _stop_node(node)
+
+
+def test_repair_survives_concurrent_drain_and_death(
+    steady_health_cluster, tmp_path
+):
+    """Satellite for the repair loop's worst hour: one holder DRAINS
+    while another DIES in the same window. Every chunk heals to the
+    replication target on the healthy set, nothing is lost, and repair
+    is idempotent — a repeated drain notice adds no extra copies."""
+    rt = core_api._runtime
+    nodes = [
+        _add_node(tmp_path, f"cc{i}", {"CPU": 1.0}) for i in range(3)
+    ]
+    try:
+        cp = dc.AsyncCheckpointer(run="cc_run", replication=2)
+        cp.save(0, {"w": np.arange(500_000, dtype=np.float32)})
+        cp.wait()
+        locs = _holder_addrs("cc_run")
+        holders = [
+            n for n in nodes
+            if any(n.addr in v for v in locs.values())
+        ]
+        drainee = holders[0] if holders else nodes[0]
+        victim = next(n for n in nodes if n is not drainee)
+        assert _head_call(
+            "drain_node", node_id=drainee.node_id,
+            reason="preempt", deadline_s=60,
+        )["ok"]
+        _stop_node(victim)  # concurrent death
+
+        healthy = {rt.core.node_addr} | {
+            n.addr for n in nodes if n not in (drainee, victim)
+        }
+        deadline = time.time() + 30
+        healed = False
+        while time.time() < deadline:
+            locs = _holder_addrs("cc_run")
+            if all(
+                len([a for a in v if a in healthy]) >= 2
+                for v in locs.values()
+            ):
+                healed = True
+                break
+            time.sleep(0.3)
+        assert healed, f"never healed on the healthy set: {locs}"
+        ver = _head_call("ckpt_verify", run="cc_run")["checkpoints"][0]
+        assert not ver["lost"]
+
+        # Idempotency: the SAME drain notice again must not stack more
+        # replicas (journal loc ops replay-safe, no double-replication).
+        counts = {
+            h: len([a for a in v if a in healthy])
+            for h, v in locs.items()
+        }
+        assert _head_call(
+            "drain_node", node_id=drainee.node_id,
+            reason="preempt", deadline_s=60,
+        )["ok"]
+        time.sleep(3.0)
+        locs = _holder_addrs("cc_run")
+        for h, v in locs.items():
+            n_healthy = len([a for a in v if a in healthy])
+            assert n_healthy <= max(counts[h], 2) + 1, (
+                f"replica runaway on {h[:12]}: {counts[h]} -> {n_healthy}"
+            )
+    finally:
+        for n in nodes:
+            _stop_node(n)

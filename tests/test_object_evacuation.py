@@ -1,0 +1,163 @@
+"""Drain-time evacuation of GENERAL objects (the non-checkpoint plane).
+
+When a node enters DRAINING, owners push sole-copy store-resident
+objects to a healthy peer while the node can still serve pulls; with no
+healthy peer, the bytes spill to the remote tier, and reads fall back to
+the tier after the node retires. Zero lost objects is the acceptance
+bar.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import api as core_api
+from ray_tpu._private import config as _config
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.checkpoint import remote as remote_mod
+from ray_tpu.runtime.drain import EVACUATED
+
+
+def _head_call(method, **kw):
+    rt = core_api._runtime
+    return rt.run(rt.core.head.call(method, **kw))
+
+
+def _add_node(tmp_path, name, resources):
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+
+    async def launch():
+        node = NodeManager(
+            rt.core.head_addr,
+            str(tmp_path / f"{name}_store"),
+            resources=resources,
+        )
+        await node.start()
+        return node
+
+    return rt.run(launch())
+
+
+def _stop_node(node):
+    try:
+        core_api._runtime.run(node.stop())
+    except Exception:  # noqa: BLE001 - may already be dead
+        pass
+
+
+def _own_node_id():
+    rt = core_api._runtime
+    status = _head_call("cluster_status")
+    return next(
+        nid
+        for nid, n in status["nodes"].items()
+        if n.get("addr") == rt.core.node_addr
+    )
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def tier_dir(tmp_path):
+    root = tmp_path / "tier"
+    _config._overrides["CKPT_REMOTE_TIER"] = str(root)
+    remote_mod.reset_tier_cache()
+    yield root
+    _config._overrides.pop("CKPT_REMOTE_TIER", None)
+    remote_mod.reset_tier_cache()
+
+
+def test_drain_pushes_owned_objects_to_peer(cluster, tmp_path):
+    """Owner-side evacuation: draining the only node holding a put()
+    object moves the bytes to a healthy peer BEFORE retirement — the
+    read survives losing the original copy entirely."""
+    rt = core_api._runtime
+    peer = _add_node(tmp_path, "evpeer", {"CPU": 1.0})
+    try:
+        value = np.arange(200_000, dtype=np.float32)  # > inline cutoff
+        ref = ray_tpu.put(value)
+        oid_hex = ref.hex
+        assert rt.core.memory[oid_hex][0] == "in_store"
+        before = EVACUATED.value(tags={"outcome": "peer"}) or 0.0
+
+        assert _head_call(
+            "drain_node", node_id=_own_node_id(),
+            reason="preempt", deadline_s=60,
+        )["ok"]
+        deadline = time.time() + 20
+        moved = False
+        while time.time() < deadline:
+            if peer.addr in (rt.core._locations.get(oid_hex) or ()):
+                moved = True
+                break
+            time.sleep(0.2)
+        assert moved, "object never evacuated to the healthy peer"
+        assert (EVACUATED.value(tags={"outcome": "peer"}) or 0.0) > before
+        # The record's primary moved off the doomed node too.
+        assert rt.core.memory[oid_hex] == ("in_store", peer.addr)
+
+        # The drained node's copy is now expendable: wipe it and read.
+        rt.core.store.delete(ObjectID.from_hex(oid_hex))
+        np.testing.assert_array_equal(ray_tpu.get(ref), value)
+    finally:
+        _stop_node(peer)
+
+
+def test_drain_spills_to_remote_tier_without_peer(cluster, tier_dir):
+    """No healthy peer exists: the draining node sweeps its store to the
+    remote tier, and a later read of the lost object resolves from the
+    tier (the last rung of the resolution ladder) — zero lost objects."""
+    rt = core_api._runtime
+    value = {"tensor": np.arange(150_000, dtype=np.float32), "tag": "x"}
+    ref = ray_tpu.put(value)
+    oid_hex = ref.hex
+    before = EVACUATED.value(tags={"outcome": "remote_tier"}) or 0.0
+
+    assert _head_call(
+        "drain_node", node_id=_own_node_id(),
+        reason="preempt", deadline_s=60,
+    )["ok"]
+    obj_path = tier_dir / "objects" / oid_hex
+    deadline = time.time() + 20
+    while time.time() < deadline and not obj_path.exists():
+        time.sleep(0.2)
+    assert obj_path.exists(), "store sweep never reached the tier"
+    assert (
+        EVACUATED.value(tags={"outcome": "remote_tier"}) or 0.0
+    ) > before
+
+    # Simulate the node retiring with the bytes: local copy gone, no
+    # peer ever held one. The tier copy must serve the read.
+    rt.core.store.delete(ObjectID.from_hex(oid_hex))
+    got = ray_tpu.get(ref)
+    np.testing.assert_array_equal(got["tensor"], value["tensor"])
+    assert got["tag"] == "x"
+
+
+def test_evacuation_disabled_by_knob(cluster, tmp_path):
+    """RAY_TPU_OBJECT_DRAIN_EVACUATION=0 turns the whole plane off: a
+    drain notice moves nothing."""
+    rt = core_api._runtime
+    _config._overrides["OBJECT_DRAIN_EVACUATION"] = False
+    peer = _add_node(tmp_path, "offpeer", {"CPU": 1.0})
+    try:
+        ref = ray_tpu.put(np.arange(150_000, dtype=np.float32))
+        assert _head_call(
+            "drain_node", node_id=_own_node_id(),
+            reason="preempt", deadline_s=60,
+        )["ok"]
+        time.sleep(2.0)
+        assert peer.addr not in (rt.core._locations.get(ref.hex) or ())
+    finally:
+        _config._overrides.pop("OBJECT_DRAIN_EVACUATION", None)
+        _stop_node(peer)

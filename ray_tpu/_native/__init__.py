@@ -14,6 +14,7 @@ import subprocess
 import threading
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+# tpulint: allow(TPU703 reason=build-cache dir is resolved at import time of the native loader — before any config registry exists to consult)
 _CACHE = os.environ.get(
     "RAY_TPU_NATIVE_CACHE",
     os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu", "native"),

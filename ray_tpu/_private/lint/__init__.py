@@ -1,6 +1,6 @@
 """tpulint: ray_tpu-specific static analysis.
 
-Fifteen passes grounded in this codebase's real failure classes (the
+Twenty passes grounded in this codebase's real failure classes (the
 bug shapes PRs 1-11 spent thousands of LoC defending against at
 runtime), the flow-sensitive ones built on the v2 interprocedural
 dataflow engine (``dataflow.py``: module symbol tables + call graph +
@@ -44,9 +44,29 @@ alias sets + a branch/loop/early-return-aware abstract interpreter):
 - ``jit-boundary-divergence`` (TPU605): a rank-/slice-dependent
   branch selecting WHICH compiled program runs — the in-program
   collective deadlock TPU103 cannot see.
+- ``rpc-contract-drift`` (TPU701): every ``*.call("m", **kw)`` site
+  bound cross-file to its ``async def _on_m(self, conn, ...)``
+  handler — unknown methods, kwargs ``tolerant_kwargs`` would silently
+  drop, required params never passed, positional payloads that become
+  the transport ``timeout``.
+- ``journal-replay-completeness`` (TPU702): every ``(table, op)``
+  written via ``_journal_append`` needs a replay branch in
+  ``_restore_from_journal`` and a snapshot field; replayed payload
+  keys must be a subset of the keys every append writes.
+- ``knob-discipline`` (TPU703): ``config.get`` keys absent from
+  ``CONFIG_DEFS``, raw ``RAY_TPU_*`` env reads outside the config
+  layer, dead declared-but-never-read knobs, README doc drift.
+- ``pubsub-channel-discipline`` (TPU704): publishes nobody hears,
+  subscriptions to never-published channels, push handlers blind to
+  the coalesced ``{"batch": [...]}`` frame shape.
+- ``metric-schema-drift`` (TPU705): one metric name registered with
+  differing label sets or types across modules.
 
 The TPU60x rules have runtime twins in ``ray_tpu/_private/sanitize.py``
-(the jit compile watch and the host-sync tracer, ``RAY_TPU_SANITIZE=1``).
+(the jit compile watch and the host-sync tracer, ``RAY_TPU_SANITIZE=1``);
+TPU701 has one too (``sanitize.check_rpc_contract``, armed in
+``Connection.call`` by the same switch — the runtime backstop for the
+dynamic-method and ``**kw``-splat sites the static pass must skip).
 
 Violations are suppressed line-by-line with::
 

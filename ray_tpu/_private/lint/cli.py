@@ -8,7 +8,14 @@ current tree and always exits 0.
 REF` (default HEAD, plus untracked files) — but ANALYZES their
 import-graph neighbors too, so the interprocedural rules
 (TPU103/TPU202/TPU204) still see helpers and lock owners defined in
-unchanged files. Only violations in changed files are reported.
+unchanged files, plus the protocol-anchor files (RPC handler modules,
+`config.py`, the journal restore) so the TPU70x contract rules always
+judge a changed caller against the real handler table. Only
+violations in changed files are reported.
+
+`--strict` additionally reports call sites the protocol tier cannot
+resolve statically (dynamic RPC method names); `--knob-docs` renders
+the CONFIG_DEFS registry as markdown and exits.
 """
 
 from __future__ import annotations
@@ -132,10 +139,32 @@ _HOOK_BODY = """\
 #!/bin/sh
 # tpulint pre-commit hook (installed by `ray_tpu lint --install-hook`).
 # Lints only the files changed vs HEAD, expanding import-graph
-# neighbors so the interprocedural rules stay sound. Bypass a single
+# neighbors — plus the protocol anchors (RPC handler modules,
+# config.py, the journal restore) — so the interprocedural rules and
+# the TPU70x distributed-protocol tier stay sound. Bypass a single
 # commit with `git commit --no-verify`.
 exec {python} -m ray_tpu._private.lint {target} --changed
 """
+
+# Files that DEFINE a distributed contract: RPC handler tables, the
+# config registry, the journal replay. Always analyzed (never
+# reported) in --changed mode — a changed caller must be judged
+# against the real contract even when the defining file is far away
+# in the import graph.
+_ANCHOR_RE = re.compile(
+    r"async def _on_\w+\(|CONFIG_DEFS\s*[:=]|def _restore_from_journal\(")
+
+
+def _protocol_anchors(paths: list[str], excludes) -> set[str]:
+    out: set[str] = set()
+    for f in core.iter_python_files(paths, excludes=excludes):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                if _ANCHOR_RE.search(fh.read()):
+                    out.add(os.path.abspath(f))
+        except OSError:
+            continue
+    return out
 
 
 def _install_hook(paths: list[str]) -> int:
@@ -165,6 +194,35 @@ def _install_hook(paths: list[str]) -> int:
     os.chmod(hook, 0o755)
     print(f"installed {hook}: runs `lint {target} --changed` per commit")
     return 0
+
+
+def knob_docs_markdown() -> str:
+    """The CONFIG_DEFS registry rendered as a markdown table — the
+    generator behind README's "Config registry" appendix, kept here so
+    docs and registry can never drift (TPU703's doc-drift sub-check
+    closes the loop in the other direction)."""
+    from ray_tpu._private import config
+
+    def esc(text: str) -> str:
+        return str(text).replace("|", "\\|")
+
+    lines = [
+        "## Config registry",
+        "",
+        "<!-- generated: python -m ray_tpu._private.lint --knob-docs -->",
+        "",
+        "Every knob resolves override → `RAY_TPU_<NAME>` env var → "
+        "default (see `ray_tpu/_private/config.py`).",
+        "",
+        "| knob | type | default | doc |",
+        "|---|---|---|---|",
+    ]
+    for name in sorted(config.CONFIG_DEFS):
+        typ, default, doc = config.CONFIG_DEFS[name]
+        lines.append(
+            f"| `{name}` | {typ.__name__} | `{default!r}` | "
+            f"{esc(' '.join(doc.split()))} |")
+    return "\n".join(lines) + "\n"
 
 
 def _find_default_baseline(paths: list[str]) -> str | None:
@@ -225,7 +283,19 @@ def main(argv=None) -> int:
                    help="write .git/hooks/pre-commit running "
                         "`lint --changed` against the staged tree, "
                         "then exit")
+    p.add_argument("--strict", action="store_true",
+                   help="also report protocol call sites that cannot "
+                        "be resolved statically (dynamic RPC method "
+                        "names) — covered at runtime by the contract "
+                        "sanitizer instead")
+    p.add_argument("--knob-docs", action="store_true", dest="knob_docs",
+                   help="render the CONFIG_DEFS registry (name, env "
+                        "var, type, default, doc) as markdown and exit")
     args = p.parse_args(argv)
+
+    if args.knob_docs:
+        print(knob_docs_markdown(), end="")
+        return 0
 
     paths = args.paths
     if not paths:
@@ -258,10 +328,13 @@ def main(argv=None) -> int:
         analyze = _expand_neighbors(changed, paths,
                                     core.DEFAULT_EXCLUDES,
                                     hops=args.changed_hops)
+        anchors = _protocol_anchors(paths, core.DEFAULT_EXCLUDES)
+        analyze = sorted(set(analyze) | anchors)
         report_only = {os.path.abspath(c) for c in changed}
         n_changed, n_analyzed = len(changed), len(analyze)
         paths = analyze
-    violations, errors = core.analyze_paths(paths, relative_to=rel)
+    violations, errors = core.analyze_paths(paths, relative_to=rel,
+                                            strict=args.strict)
     elapsed = time.monotonic() - t0
 
     if report_only is not None:

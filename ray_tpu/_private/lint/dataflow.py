@@ -35,7 +35,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 
-from ray_tpu._private.lint.core import FileContext, dotted_name
+from ray_tpu._private.lint.core import FileContext, dotted_name, iter_tree, iter_children
 
 # --------------------------------------------------------------------------
 # Module indexing
@@ -89,7 +89,7 @@ class ModuleIndex:
 
     # ------------------------------------------------------------- imports
     def _collect_imports(self, tree: ast.Module) -> None:
-        for node in ast.walk(tree):
+        for node in self.ctx.nodes:
             if isinstance(node, ast.ImportFrom) and node.module:
                 src = node.module.split(".")[-1]
                 for alias in node.names:
@@ -105,7 +105,7 @@ class ModuleIndex:
     # -------------------------------------------------------------- types
     def _collect_types(self, tree: ast.Module) -> None:
         def walk(node, class_name):
-            for child in ast.iter_child_nodes(node):
+            for child in iter_children(node):
                 if isinstance(child, ast.ClassDef):
                     walk(child, child.name)
                     continue
@@ -132,7 +132,7 @@ class ModuleIndex:
     # ----------------------------------------------------------- functions
     def _collect_functions(self, tree: ast.Module) -> None:
         def walk(node, class_name: str | None):
-            for child in ast.iter_child_nodes(node):
+            for child in iter_children(node):
                 if isinstance(child, ast.ClassDef):
                     walk(child, child.name)
                 elif isinstance(child, (ast.FunctionDef,
@@ -157,7 +157,7 @@ class ModuleIndex:
         walk(tree, None)
 
     def _collect_calls(self, info: FunctionInfo) -> None:
-        for node in ast.walk(info.node):
+        for node in iter_tree(info.node):
             if not isinstance(node, ast.Call):
                 continue
             callee = self.resolve_call(node, info.class_name)
@@ -379,7 +379,7 @@ class FlowWalker:
                 self.on_call(n, state)
             elif isinstance(n, ast.Await):
                 self.on_await(n, state)
-            stack.extend(ast.iter_child_nodes(n))
+            stack.extend(iter_children(n))
 
     def _walk_body(self, stmts, state):
         for stmt in stmts:

@@ -24,7 +24,7 @@ import ast
 import dataclasses
 
 from ray_tpu._private.lint import dataflow
-from ray_tpu._private.lint.core import FileContext, dotted_name
+from ray_tpu._private.lint.core import FileContext, dotted_name, iter_tree, iter_children
 
 #: Names that create a compiled callable when called.
 JIT_NAMES = frozenset({"jit", "pjit"})
@@ -171,7 +171,7 @@ class ModuleJitIndex:
                 ji = _is_jit_decorator(dec)
                 if ji is not None:
                     self.jit_defs[qual] = ji
-            for child in ast.walk(node):
+            for child in iter_tree(node):
                 if isinstance(child, ast.Return) and isinstance(
                         child.value, ast.Call):
                     ji = jit_call_info(child.value, self.mi,
@@ -185,7 +185,7 @@ class ModuleJitIndex:
                             self.wrapped.add(ji.wrapped)
 
         def walk_assigns(node, class_name):
-            for child in ast.iter_child_nodes(node):
+            for child in iter_children(node):
                 if isinstance(child, ast.ClassDef):
                     walk_assigns(child, child.name)
                     continue

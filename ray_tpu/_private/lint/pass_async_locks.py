@@ -29,7 +29,7 @@ from __future__ import annotations
 import ast
 
 from ray_tpu._private.lint import dataflow
-from ray_tpu._private.lint.core import FileContext
+from ray_tpu._private.lint.core import FileContext, iter_tree
 from ray_tpu._private.lint.pass_locks import _lock_expr_name
 
 
@@ -69,7 +69,7 @@ class _Walker(dataflow.FlowWalker):
         # by construction — `await client.call(...)` must not read as a
         # blocking RPC.
         self._awaited: set[int] = set()
-        for node in ast.walk(fn_node):
+        for node in iter_tree(fn_node):
             if isinstance(node, ast.Await) and isinstance(
                     node.value, ast.Call):
                 self._awaited.add(id(node.value))

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import ast
 
-from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name
+from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name, iter_tree
 
 COLLECTIVE_NAMES = frozenset({
     "allreduce", "allgather", "reduce", "reducescatter", "reduce_scatter",
@@ -38,7 +38,7 @@ def _collective_modules(tree: ast.Module) -> tuple[set[str], set[str]]:
     """(aliases of ray_tpu.collective, names imported from it)."""
     aliases: set[str] = set()
     names: set[str] = set()
-    for node in ast.walk(tree):
+    for node in iter_tree(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name.split(".")[-1] == "collective":
@@ -53,7 +53,7 @@ def _collective_modules(tree: ast.Module) -> tuple[set[str], set[str]]:
 
 
 def is_rank_dependent(test: ast.AST) -> bool:
-    for node in ast.walk(test):
+    for node in iter_tree(test):
         name = ""
         if isinstance(node, ast.Name):
             name = node.id
@@ -152,6 +152,15 @@ class _Visitor(ScopeVisitor):
 
 
 def run(ctx: FileContext):
+    # Every detectable call site names a collective verb textually —
+    # attribute form at the call, from-import form on the import line
+    # (even when aliased) — and both TPU101 and TPU102 additionally
+    # need a rank-dependent test, whose name carries a rank token.
+    if not any(name in ctx.source for name in COLLECTIVE_NAMES):
+        return None
+    lowered = ctx.source.lower()
+    if not any(t in lowered for t in _RANK_TOKENS):
+        return None
     _Visitor(ctx).visit(ctx.tree)
     return None
 

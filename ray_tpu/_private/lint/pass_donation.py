@@ -26,14 +26,14 @@ from __future__ import annotations
 import ast
 
 from ray_tpu._private.lint import dataflow, jit_util
-from ray_tpu._private.lint.core import FileContext, dotted_name
+from ray_tpu._private.lint.core import FileContext, dotted_name, iter_tree
 
 
 def _read_names(expr: ast.AST):
     """Dotted names READ in an expression (loads only; call receivers
     included — `state.params` reads `state`)."""
     out = []
-    for node in ast.walk(expr):
+    for node in iter_tree(expr):
         if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
             out.append(node.id)
         elif isinstance(node, ast.Attribute) and isinstance(
@@ -86,7 +86,7 @@ class _Walker(dataflow.FlowWalker):
     def _check_reads(self, expr, state, skip_call=None):
         if expr is None:
             return
-        for node in ast.walk(expr):
+        for node in iter_tree(expr):
             if node is skip_call:
                 continue
             if isinstance(node, ast.Name) and isinstance(

@@ -86,6 +86,8 @@ class _Visitor(ScopeVisitor):
 
 
 def run(ctx: FileContext):
+    if "except" not in ctx.source:
+        return None
     _Visitor(ctx).visit(ctx.tree)
     return None
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 import ast
 
 from ray_tpu._private.lint import dataflow
-from ray_tpu._private.lint.core import FileContext
+from ray_tpu._private.lint.core import FileContext, iter_tree
 
 ASYNC_VERBS = frozenset({
     "allreduce_async", "reducescatter_async", "allgather_async",
@@ -80,7 +80,7 @@ class _Walker(dataflow.FlowWalker):
         # this function's paths.
         self._globals: set[str] = set()
         if fn_node is not None:
-            for n in ast.walk(fn_node):
+            for n in iter_tree(fn_node):
                 if isinstance(n, (ast.Global, ast.Nonlocal)):
                     self._globals.update(n.names)
 
@@ -184,7 +184,7 @@ class _Walker(dataflow.FlowWalker):
     def _escape_names(self, expr, state):
         if expr is None:
             return
-        for n in ast.walk(expr):
+        for n in iter_tree(expr):
             if isinstance(n, ast.Name) and n.id in state.vars:
                 rec = state.vars[n.id]
                 state.vars[n.id] = (_ESCAPED, rec[1], rec[2])

@@ -32,7 +32,7 @@ from __future__ import annotations
 import ast
 
 from ray_tpu._private.lint import dataflow
-from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name
+from ray_tpu._private.lint.core import FileContext, ScopeVisitor, dotted_name, iter_tree
 
 #: Explicit sync verbs (fire in any hot region, and seed the closure).
 STRONG_SYNCS = frozenset({"block_until_ready", "device_get"})
@@ -80,7 +80,7 @@ def _is_weak(kind: str) -> bool:
 def _is_step_loop(node: ast.AST) -> bool:
     """A loop that drives the train-step machinery: its body contains a
     ``step_span``/``phase`` span entry or a ``report()`` call."""
-    for child in ast.walk(node):
+    for child in iter_tree(node):
         if not isinstance(child, ast.Call):
             continue
         func = child.func
@@ -254,7 +254,7 @@ def run(ctx: FileContext):
         for qual, info in mi.functions.items():
             if qual.split(".")[-1] in WAIT_EXEMPT:
                 continue  # the designed join points never taint callers
-            for node in ast.walk(info.node):
+            for node in iter_tree(info.node):
                 if isinstance(node, ast.Call):
                     kind = _sync_kind(node)
                     if kind is not None and not _is_weak(kind):

@@ -35,7 +35,7 @@ from __future__ import annotations
 import ast
 
 from ray_tpu._private.lint import jit_util
-from ray_tpu._private.lint.core import FileContext, dotted_name
+from ray_tpu._private.lint.core import FileContext, dotted_name, iter_tree
 
 _LOG_METHODS = frozenset({
     "debug", "info", "warning", "warn", "error", "exception", "critical",
@@ -86,12 +86,12 @@ def _side_effect(call: ast.Call, local_names: set[str],
 
 def _local_stores(fn_node) -> set[str]:
     out: set[str] = set()
-    for node in ast.walk(fn_node):
+    for node in iter_tree(fn_node):
         if isinstance(node, ast.Name) and isinstance(
                 node.ctx, (ast.Store, ast.Del)):
             out.add(node.id)
         elif isinstance(node, (ast.For, ast.AsyncFor)):
-            for t in ast.walk(node.target):
+            for t in iter_tree(node.target):
                 if isinstance(t, ast.Name):
                     out.add(t.id)
     return out

@@ -85,7 +85,7 @@ class _Visitor(ScopeVisitor):
         # references here must unify with a's own, or a cross-FILE
         # inversion could never close its cycle.
         self._imports: dict[str, str] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, ast.ImportFrom) and node.module:
                 src = node.module.split(".")[-1]
                 for alias in node.names:

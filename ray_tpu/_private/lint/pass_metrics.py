@@ -156,7 +156,7 @@ class _Visitor(ScopeVisitor):
         # enter_context(...) arguments.
         self._entered: set[int] = set()
         manually_entered: set[str] = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if isinstance(node, (ast.With, ast.AsyncWith)):
                 for item in node.items:
                     self._entered.add(id(item.context_expr))
@@ -172,7 +172,7 @@ class _Visitor(ScopeVisitor):
         # `s = tracing.span(...)` followed by `s.__enter__()` IS
         # entered — whether the pairing balances on every path is
         # TPU404's (path-sensitive) question, not TPU402's.
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes:
             if (isinstance(node, ast.Assign)
                     and len(node.targets) == 1
                     and isinstance(node.targets[0], ast.Name)
@@ -229,7 +229,17 @@ class _Visitor(ScopeVisitor):
         self.generic_visit(node)
 
 
+_GATE_TOKENS = ("Counter", "Gauge", "Histogram", "span(", "thread_trace",
+                "activate(", "jax_profile(", "tags")
+
+
 def run(ctx: FileContext):
+    # Every reportable shape carries one of these tokens textually:
+    # metric ctors their class name, span CMs their method name plus
+    # the opening paren of the call, and the .inc/.set/.observe label
+    # check its `tags=` keyword.
+    if not any(t in ctx.source for t in _GATE_TOKENS):
+        return None
     _Visitor(ctx).visit(ctx.tree)
     return None
 

@@ -27,7 +27,7 @@ from __future__ import annotations
 import ast
 
 from ray_tpu._private.lint import dataflow
-from ray_tpu._private.lint.core import FileContext, dotted_name
+from ray_tpu._private.lint.core import FileContext, dotted_name, iter_tree
 
 # Receivers that make a bare `.track(...)` the memory-ledger call.
 _MEM_RECEIVERS = ("memory", "rmem", "_rmem", "mem")
@@ -85,7 +85,7 @@ class _Walker(dataflow.FlowWalker):
         # claims are closed by whoever replaces them.
         self._globals: set[str] = set()
         if fn_node is not None:
-            for n in ast.walk(fn_node):
+            for n in iter_tree(fn_node):
                 if isinstance(n, (ast.Global, ast.Nonlocal)):
                     self._globals.update(n.names)
         # names whose __exit__/close happened outside any finally while
@@ -223,7 +223,7 @@ class _Walker(dataflow.FlowWalker):
     def _escape_names(self, expr, state):
         if expr is None:
             return
-        for n in ast.walk(expr):
+        for n in iter_tree(expr):
             if isinstance(n, ast.Name) and n.id in state.vars:
                 rec = state.vars[n.id]
                 state.vars[n.id] = (_ESCAPED, rec[1], rec[2], rec[3])
@@ -241,7 +241,7 @@ def run(ctx: FileContext):
     if "track" not in src and "__enter__" not in src:
         return None
     imported_track = False
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.ImportFrom) and node.module:
             if node.module.split(".")[-1] == "memory":
                 for a in node.names:

@@ -22,7 +22,7 @@ from __future__ import annotations
 import ast
 
 from ray_tpu._private.lint import dataflow
-from ray_tpu._private.lint.core import FileContext
+from ray_tpu._private.lint.core import FileContext, iter_tree
 from ray_tpu._private.lint.pass_collective import (
     COLLECTIVE_NAMES,
     _RANK_TOKENS,
@@ -39,7 +39,7 @@ _FLOW_TOKENS = tuple(_RANK_TOKENS) + ("slice_label", "slice_index")
 def _is_divergence_test(test: ast.AST) -> bool:
     if is_rank_dependent(test):
         return True
-    for node in ast.walk(test):
+    for node in iter_tree(test):
         name = ""
         if isinstance(node, ast.Name):
             name = node.id
@@ -141,10 +141,10 @@ class _PassState:
         self.direct: set[str] = set()
 
 
-def _collective_import_context(tree: ast.Module):
+def _collective_import_context(nodes):
     aliases: set[str] = set()
     names: set[str] = set()
-    for node in ast.walk(tree):
+    for node in nodes:
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name.split(".")[-1] == "collective":
@@ -170,10 +170,10 @@ def run(ctx: FileContext):
         interesting = True
     mi = dataflow.index(ctx)
     st = _PassState(mi)
-    aliases, imported = _collective_import_context(ctx.tree)
+    aliases, imported = _collective_import_context(ctx.nodes)
     for qual, info in mi.functions.items():
         if interesting:
-            for node in ast.walk(info.node):
+            for node in iter_tree(info.node):
                 if isinstance(node, ast.Call) and _is_direct_collective(
                         node, imported, aliases):
                     st.direct.add(qual)

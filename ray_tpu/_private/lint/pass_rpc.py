@@ -14,12 +14,12 @@ from __future__ import annotations
 
 import ast
 
-from ray_tpu._private.lint.core import FileContext, ScopeVisitor
+from ray_tpu._private.lint.core import FileContext, ScopeVisitor, iter_tree
 
 
 def _handler_names(tree: ast.Module) -> set[str]:
     out = set()
-    for node in ast.walk(tree):
+    for node in iter_tree(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             if node.name.startswith("_on_"):
                 out.add(node.name[len("_on_"):])
@@ -57,6 +57,10 @@ class _Visitor(ScopeVisitor):
 
 
 def run(ctx: FileContext):
+    # Reentrancy needs BOTH a local `_on_<method>` handler and a
+    # `.call(` site in the same file.
+    if "_on_" not in ctx.source or ".call(" not in ctx.source:
+        return None
     _Visitor(ctx).visit(ctx.tree)
     return None
 

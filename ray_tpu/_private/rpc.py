@@ -324,6 +324,12 @@ class Connection:
             err = ConnectionLost(f"chaos: dropped {method}")
             err.sent = False
             raise err
+        from ray_tpu._private import sanitize
+        if sanitize.enabled():
+            # Runtime twin of TPU701: surface contract misses the
+            # static pass can't resolve (dynamic method names,
+            # kwargs-dict splats) before tolerant_kwargs eats them.
+            sanitize.check_rpc_contract(method, kw)
         self._next_id += 1
         req_id = self._next_id
         fut = asyncio.get_running_loop().create_future()

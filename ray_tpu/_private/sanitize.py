@@ -83,12 +83,14 @@ class LockOrderViolation(RuntimeError):
 
 
 def enabled() -> bool:
+    # tpulint: allow(TPU703 reason=the sanitizer is the independent backstop — its gate must not depend on the config machinery it may be diagnosing)
     return os.environ.get("RAY_TPU_SANITIZE", "") == "1"
 
 
 def _hold_threshold_s() -> float:
     try:
         return float(
+            # tpulint: allow(TPU703 reason=sanitizer knobs stay env-only so the backstop works even when config loading is itself broken)
             os.environ.get("RAY_TPU_SANITIZE_HOLD_MS", _DEFAULT_HOLD_MS)
         ) / 1000.0
     except ValueError:
@@ -111,6 +113,7 @@ class _OrderGraph:
         self.registration_leaks = 0
         self.recompiles = 0
         self.host_syncs = 0
+        self.rpc_contract_misses = 0
 
     def reset(self):
         with self._guard:
@@ -123,6 +126,7 @@ class _OrderGraph:
             self.registration_leaks = 0
             self.recompiles = 0
             self.host_syncs = 0
+            self.rpc_contract_misses = 0
 
     def check_and_add(self, held_id: int, held_name: str,
                       new_id: int, new_name: str) -> list[str] | None:
@@ -388,6 +392,7 @@ def _task_held_stack() -> list:
 
 # --------------------------------------------------------- leak reporter
 def leaks_enabled() -> bool:
+    # tpulint: allow(TPU703 reason=sanitizer knobs stay env-only so the backstop works even when config loading is itself broken)
     return enabled() or os.environ.get(
         "RAY_TPU_SANITIZE_LEAKS", "") == "1"
 
@@ -530,6 +535,7 @@ def compile_grace() -> int:
     recompile WARNING rather than expected warm-up (shape buckets,
     first batch, eval shapes all compile early by design)."""
     try:
+        # tpulint: allow(TPU703 reason=sanitizer knobs stay env-only so the backstop works even when config loading is itself broken)
         return int(os.environ.get(
             "RAY_TPU_SANITIZE_COMPILE_GRACE", _COMPILE_GRACE_DEFAULT))
     except ValueError:
@@ -851,12 +857,91 @@ def maybe_install_jax_watch():
         install_jax_watch()
 
 
+# ---------------------------------------------- rpc contract twin
+# Runtime twin of the TPU701 static pass: validate Connection.call
+# kwargs against the handler signature table the lint model exports.
+# The static pass catches drift it can resolve at analysis time; this
+# catches the call sites it can't (f-string methods, kwargs-dict
+# splats) — warn-only, because tolerant_kwargs dropping unknown kwargs
+# IS the deployed version-skew behavior; the sanitizer's job is to
+# make the silence visible.
+
+_contract_table: dict | None = None
+_contract_warned: set = set()
+_contract_guard = _thread.allocate_lock()
+# Mirrors lint.protocol.TRANSPORT_KWARGS without importing the lint
+# package at module load.
+_TRANSPORT_KWARGS = frozenset({"timeout", "retry"})
+
+
+def _handler_table() -> dict:
+    """Lazily build (once) the package-wide handler signature table.
+    Any failure degrades to an empty table — the sanitizer must never
+    turn a working RPC path into a crash."""
+    global _contract_table
+    if _contract_table is None:
+        try:
+            from ray_tpu._private.lint import protocol
+            _contract_table = protocol.handler_signature_table()
+        except Exception as e:
+            logger.debug("rpc contract table build failed "
+                         "(contract checks disabled): %s", e)
+            _contract_table = {}
+    return _contract_table
+
+
+def check_rpc_contract(method: str, kw: dict) -> None:
+    """Warn (once per method+kind, counting every miss) when a call's
+    method or kwargs don't match any known ``_on_<method>`` handler."""
+    if ":" in method:
+        return  # extension namespaces (col_op:<name>) are dynamic
+    table = _handler_table()
+    sig = table.get(method)
+    problems: list[tuple[str, str]] = []
+    if sig is None:
+        problems.append((
+            "unknown-method",
+            f"rpc contract: call({method!r}) matches no _on_{method} "
+            "handler anywhere in the package — the server will raise "
+            "unknown-method at dispatch",
+        ))
+    else:
+        unknown = set(kw) - sig["params"] - _TRANSPORT_KWARGS
+        if unknown and not sig["varkw"]:
+            problems.append((
+                "unknown-kwarg",
+                f"rpc contract: call({method!r}) passes "
+                f"{sorted(unknown)} which _on_{method} does not "
+                "accept — tolerant_kwargs silently DROPS them on the "
+                "server",
+            ))
+        missing = sig["required"] - set(kw)
+        if missing:
+            problems.append((
+                "missing-required",
+                f"rpc contract: call({method!r}) omits required "
+                f"parameter(s) {sorted(missing)} of _on_{method} — "
+                "the handler raises TypeError at dispatch",
+            ))
+    if not problems:
+        return
+    with _contract_guard:
+        _graph.rpc_contract_misses += len(problems)
+        fresh = [(kind, msg) for kind, msg in problems
+                 if (method, kind) not in _contract_warned]
+        _contract_warned.update((method, kind) for kind, _ in fresh)
+    for _, msg in fresh:
+        logger.warning(msg)
+
+
 def reset():
     """Clear the global order graph (test isolation: one module's lock
     order must not poison the next's)."""
     _graph.reset()
     with _sync_guard:
         _sync_intervals.clear()
+    with _contract_guard:
+        _contract_warned.clear()
 
 
 def stats() -> dict:
@@ -868,5 +953,6 @@ def stats() -> dict:
         "registration_leaks": _graph.registration_leaks,
         "recompiles": _graph.recompiles,
         "host_syncs": _graph.host_syncs,
+        "rpc_contract_misses": _graph.rpc_contract_misses,
         "edges": sum(len(v) for v in _graph._edges.values()),
     }

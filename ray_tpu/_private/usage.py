@@ -86,6 +86,7 @@ def report_if_enabled(timeout: float = 5.0) -> bool:
     """POST the record to RAY_TPU_USAGE_REPORT_URL. OPT-IN: with the
     env var unset (the default) this is a no-op and nothing ever
     leaves the machine. Returns whether a report was sent."""
+    # tpulint: allow(TPU703 reason=opt-in telemetry gate is deliberately env-only — unset means provably nothing leaves the machine, no config layer can flip it)
     url = os.environ.get("RAY_TPU_USAGE_REPORT_URL", "")
     if not url:
         return False

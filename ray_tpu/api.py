@@ -193,6 +193,7 @@ def init(
     _runtime.mode = "client" if client else "driver"
     _runtime.session = session
     atexit.register(shutdown)
+    # tpulint: allow(TPU703 reason=opt-in telemetry gate is deliberately env-only — unset means provably nothing leaves the machine, no config layer can flip it)
     if os.environ.get("RAY_TPU_USAGE_REPORT_URL"):
         # Opt-in usage POST (reference: usage_lib report on init) —
         # fire-and-forget off-thread, never on the init path.

@@ -165,6 +165,7 @@ class LLMEngine:
             # on CPU runs the kernel interpreted (parity tests).
             import os
 
+            # tpulint: allow(TPU703 reason=emergency kernel off-switch read in library code that must work without a live runtime or config registry)
             env_flag = os.environ.get("RAY_TPU_PAGED_ATTN", "").strip()
             if env_flag in ("0", "1"):
                 use_kernel = env_flag == "1"

@@ -26,9 +26,15 @@ async def main() -> None:
     from ray_tpu.runtime.core_worker import CoreWorker
     import ray_tpu.api as api
 
+    # Process bootstrap: env is the only channel the spawning node
+    # agent has into a fresh worker — no config registry exists yet.
+    # tpulint: allow(TPU703 reason=worker bootstrap vars are passed by the spawner via env before any config exists)
     head_addr = os.environ["RAY_TPU_HEAD_ADDR"]
+    # tpulint: allow(TPU703 reason=worker bootstrap vars are passed by the spawner via env before any config exists)
     node_addr = os.environ["RAY_TPU_NODE_ADDR"]
+    # tpulint: allow(TPU703 reason=worker bootstrap vars are passed by the spawner via env before any config exists)
     store_dir = os.environ["RAY_TPU_STORE_DIR"]
+    # tpulint: allow(TPU703 reason=worker bootstrap vars are passed by the spawner via env before any config exists)
     worker_id = os.environ["RAY_TPU_WORKER_ID"]
 
     core = CoreWorker(

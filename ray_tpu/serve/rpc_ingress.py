@@ -84,6 +84,7 @@ def rpc_request(
 
     async def call():
         conn = await rt.core._connect(addr)
+        # tpulint: allow(TPU701 reason=the ingress is a raw dispatcher — rpc.Server routes serve_request inside _on_rpc itself, deliberately outside the _on_<method> convention)
         return await conn.call(
             "serve_request",
             deployment=deployment,

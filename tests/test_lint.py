@@ -315,14 +315,14 @@ def test_full_tree_clean_zero_baseline(capsys):
     """THE gate: `python -m ray_tpu._private.lint ray_tpu` exits 0 with
     ZERO violations and ZERO baseline entries — the baseline file was
     deleted once the debt hit 0 (PR 12). If this fails you introduced a
-    violation with one of the fifteen passes: fix it or pragma it with
+    violation with one of the twenty passes: fix it or pragma it with
     a reason. Do NOT reintroduce a baseline for first-party code.
 
     The <10s perf floor rides the SAME sweep (one full-tree analysis,
     not two — the suite lives within a wall-clock budget too): the
     analyzer must stay cheap enough for tier-1 with the whole
-    interprocedural + jit-discipline tier on (currently ~6-8s for all
-    fifteen passes)."""
+    interprocedural + jit-discipline + distributed-protocol tier on
+    (all twenty passes)."""
     assert not os.path.exists(
         os.path.join(REPO_ROOT, "lint_baseline.json")
     ), "lint_baseline.json came back — first-party debt must stay 0"
@@ -331,8 +331,8 @@ def test_full_tree_clean_zero_baseline(capsys):
     ])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0, (
-        "new tpulint violations (all fifteen passes, TPU60x jit tier "
-        "included):\n" + "\n".join(
+        "new tpulint violations (all twenty passes, TPU60x jit and "
+        "TPU70x protocol tiers included):\n" + "\n".join(
             f"{v['path']}:{v['line']}: {v['rule']} {v['message']}"
             for v in out["violations"])
     )
@@ -533,7 +533,9 @@ def test_cli_select_and_json(capsys):
     "bad_lock_alias.py", "bad_pairing.py", "clean_interprocedural.py",
     "bad_host_sync.py", "bad_jit_effects.py", "bad_recompile.py",
     "bad_donation.py", "bad_jit_divergence.py", "clean_jit.py",
-    "bad_lock_alias_keys.py",
+    "bad_lock_alias_keys.py", "bad_rpc_contract.py", "bad_journal.py",
+    "bad_knobs.py", "bad_pubsub.py", "bad_metric_schema.py",
+    "clean_protocol.py",
 ])
 def test_fixtures_parse_as_valid_python(fixture):
     import ast
@@ -952,6 +954,9 @@ def test_install_hook(tmp_path, capsys):
     assert os.access(str(hook), os.X_OK)
     body = hook.read_text()
     assert "--changed" in body and "ray_tpu._private.lint" in body
+    # The sample documents the protocol tier riding --changed's
+    # anchor expansion (handlers / CONFIG_DEFS / journal replay).
+    assert "TPU70" in body
     # Second install refuses rather than clobbering.
     rc = lint_main([str(pkg), "--install-hook"])
     capsys.readouterr()
@@ -1144,3 +1149,254 @@ def test_multiplex_lock_inversion_through_proxy_path(monkeypatch):
     assert len(caught) == 1
     assert any("m1" in name for name in caught[0].cycle)
     assert sanitize.stats()["cycles_detected"] == 1
+
+
+# --------------------------------- v4 distributed-protocol fixtures
+def test_fixture_rpc_contract():
+    """TPU701: unknown method, missing required param, unknown kwarg,
+    positional payload. The dynamic-method site stays silent by
+    default and reports only under --strict (the runtime sanitizer's
+    territory)."""
+    assert _hits("bad_rpc_contract.py") == [
+        ("TPU701", 18),
+        ("TPU701", 19),
+        ("TPU701", 20),
+        ("TPU701", 21),
+    ]
+    strict = analyze_file(
+        os.path.join(FIXTURES, "bad_rpc_contract.py"), strict=True)
+    assert [(v.rule, v.line) for v in strict] == [
+        ("TPU701", 18),
+        ("TPU701", 19),
+        ("TPU701", 20),
+        ("TPU701", 21),
+        ("TPU701", 25),
+    ]
+    assert "unresolvable" in strict[-1].message
+
+
+def test_fixture_journal():
+    """TPU702: missing payload key, uncovered op, unknown table,
+    snapshot gap — one line each, in journal-append order."""
+    vs = analyze_file(os.path.join(FIXTURES, "bad_journal.py"))
+    assert [(v.rule, v.line) for v in vs] == [
+        ("TPU702", 19),
+        ("TPU702", 20),
+        ("TPU702", 21),
+        ("TPU702", 22),
+    ]
+    assert "'value'" in vs[0].message
+    assert "no replay branch" in vs[1].message
+    assert "'ghost'" in vs[2].message
+    assert "_snapshot" in vs[3].message
+
+
+def test_fixture_knobs():
+    """TPU703: dead knob at its CONFIG_DEFS line, typo'd config.get
+    key, two raw environ reads. The knobs read via config.get or a
+    raw env read do NOT double-report as dead."""
+    vs = analyze_file(os.path.join(FIXTURES, "bad_knobs.py"))
+    assert [(v.rule, v.line) for v in vs] == [
+        ("TPU703", 12),
+        ("TPU703", 26),
+        ("TPU703", 27),
+        ("TPU703", 28),
+    ]
+    assert "GAMMA_DEAD" in vs[0].message and "never" in vs[0].message
+    assert "BETA_RETRY" in vs[1].message
+    assert "RAY_TPU_ALPHA_TIMEOUT_S" in vs[2].message
+
+
+def test_fixture_pubsub():
+    """TPU704: the raw push handler that never unpacks batch frames
+    (reported at its def) and the typo'd channel subscription."""
+    vs = analyze_file(os.path.join(FIXTURES, "bad_pubsub.py"))
+    assert [(v.rule, v.line) for v in vs] == [
+        ("TPU704", 13),
+        ("TPU704", 20),
+    ]
+    assert "batch" in vs[0].message
+    assert "'metrcis'" in vs[1].message
+
+
+def test_fixture_metric_schema():
+    """TPU705: later registrations drift from the first — label-set
+    drift on line 8, type drift on line 10; the reference site never
+    reports."""
+    vs = analyze_file(os.path.join(FIXTURES, "bad_metric_schema.py"))
+    assert [(v.rule, v.line) for v in vs] == [
+        ("TPU705", 8),
+        ("TPU705", 10),
+    ]
+    assert "labels" in vs[0].message
+    assert "Gauge" in vs[1].message and "Counter" in vs[1].message
+
+
+def test_clean_protocol_zero_findings():
+    """Matched call/handler, aligned journal append/replay/snapshot,
+    read knob, published+batch-safe channel, single metric
+    registration: every TPU70x pass has a target and none fires."""
+    assert _hits("clean_protocol.py") == []
+
+
+def test_rpc_contract_cross_file(tmp_path):
+    """TPU701 binds a caller in one module to the handler table built
+    from another — and a lone caller module with NO handlers in the
+    analyzed program has no contract to check against."""
+    (tmp_path / "server.py").write_text(
+        "class Node:\n"
+        "    async def _on_frob(self, conn, key, mode='fast'):\n"
+        "        return key, mode\n"
+    )
+    (tmp_path / "caller.py").write_text(
+        "async def go(conn):\n"
+        "    await conn.call('frob', kee='x')\n"
+    )
+    violations, errors = analyze_paths([str(tmp_path)])
+    assert not errors
+    assert [(os.path.basename(v.path), v.rule) for v in violations] == [
+        ("caller.py", "TPU701"), ("caller.py", "TPU701")]
+    msgs = " ".join(v.message for v in violations)
+    assert "'kee'" in msgs and "'key'" in msgs
+    # The caller alone: no handler table, no reports.
+    violations, _ = analyze_paths([str(tmp_path / "caller.py")])
+    assert violations == []
+
+
+def test_journal_cross_file(tmp_path):
+    """TPU702 joins append sites and the replay switch across
+    modules: a writer module's payload gap is judged against the
+    restore branch defined elsewhere."""
+    (tmp_path / "writer.py").write_text(
+        "def record(head, k):\n"
+        "    head._journal_append('kv', 'put', {'key': k})\n"
+    )
+    (tmp_path / "restorer.py").write_text(
+        "class Head:\n"
+        "    def _restore_from_journal(self, table, op, payload):\n"
+        "        if table == 'kv':\n"
+        "            if op == 'put':\n"
+        "                self.kv[payload['key']] = payload['value']\n"
+    )
+    violations, errors = analyze_paths([str(tmp_path)])
+    assert not errors
+    assert [(os.path.basename(v.path), v.rule, v.line)
+            for v in violations] == [("writer.py", "TPU702", 2)]
+    assert "'value'" in violations[0].message
+    # The writer alone has no replay switch: nothing to judge against.
+    violations, _ = analyze_paths([str(tmp_path / "writer.py")])
+    assert violations == []
+
+
+def test_sanitizer_rpc_contract_check(monkeypatch, caplog):
+    """TPU701's runtime twin: a mis-kwarg'd call warns once per
+    method+kind and counts EVERY miss in stats()."""
+    monkeypatch.setenv("RAY_TPU_SANITIZE", "1")
+    sanitize.reset()
+    with caplog.at_level("WARNING", logger="ray_tpu._private.sanitize"):
+        sanitize.check_rpc_contract("kv_put", {"key": "k"})
+        sanitize.check_rpc_contract("kv_put", {"key": "k"})
+        sanitize.check_rpc_contract("no_such_method", {})
+        sanitize.check_rpc_contract("col_op:allreduce", {})  # dynamic ns
+    assert sanitize.stats()["rpc_contract_misses"] == 3
+    warned = [r.message for r in caplog.records if "rpc contract" in r.message]
+    assert len(warned) == 2  # once per (method, kind)
+    assert any("'value'" in m for m in warned)
+    assert any("no_such_method" in m for m in warned)
+
+
+def test_sanitizer_rpc_contract_over_live_connection(monkeypatch, caplog):
+    """The Connection.call hook end to end: under RAY_TPU_SANITIZE=1 a
+    drifted call against a live server warns client-side before the
+    frame is written."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    monkeypatch.setenv("RAY_TPU_SANITIZE", "1")
+    sanitize.reset()
+
+    async def go():
+        async def handler(method, kw, conn):
+            return {"ok": True}
+
+        srv = rpc.Server(handler)
+        port = await srv.start("127.0.0.1", 0)
+        conn = await rpc.connect(f"127.0.0.1:{port}")
+        reply = await conn.call("kv_put", key="a")  # missing 'value'
+        assert reply == {"ok": True}
+        await conn.close()
+        await srv.stop()
+
+    with caplog.at_level("WARNING", logger="ray_tpu._private.sanitize"):
+        asyncio.run(go())
+    assert sanitize.stats()["rpc_contract_misses"] == 1
+    assert any("omits required parameter" in r.message
+               for r in caplog.records)
+
+
+def test_knob_docs_cli(capsys):
+    """--knob-docs renders CONFIG_DEFS as the markdown table the
+    README appendix is generated from."""
+    rc = lint_main(["--knob-docs"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "## Config registry" in out
+    assert "| knob | type | default | doc |" in out
+    # Every CONFIG_DEFS knob has a row.
+    from ray_tpu._private import config
+    for knob in config.CONFIG_DEFS:
+        assert f"| `{knob}` |" in out
+
+
+@pytest.mark.skipif(
+    subprocess.run(["git", "--version"], capture_output=True).returncode
+    != 0, reason="git unavailable")
+def test_changed_mode_protocol_anchor_expansion(tmp_path, capsys):
+    """--changed + TPU701: editing only the CALLER must still resolve
+    the contract — the handler module is an anchor file, analyzed even
+    though untouched (and its own hygiene is not re-reported)."""
+    repo = tmp_path / "repo"
+    pkg = repo / "pkg"
+    pkg.mkdir(parents=True)
+
+    def g(*args):
+        subprocess.run(["git", "-C", str(repo), *args],
+                       capture_output=True, check=True)
+
+    g("init", "-q")
+    g("config", "user.email", "t@t")
+    g("config", "user.name", "t")
+    (pkg / "server.py").write_text(
+        "class Node:\n"
+        "    async def _on_frob(self, conn, key):\n"
+        "        return key\n"
+    )
+    (pkg / "caller.py").write_text(
+        "async def go(conn):\n"
+        "    await conn.call('frob', key='x')\n"
+    )
+    g("add", "-A")
+    g("commit", "-qm", "seed")
+
+    rc = lint_main([str(pkg), "--baseline", "off", "--changed",
+                    "--relative-to", str(repo)])
+    capsys.readouterr()
+    assert rc == 0
+
+    # Drift ONLY the caller: server.py is unchanged but rides along as
+    # a protocol anchor, so the kwarg typo is caught.
+    (pkg / "caller.py").write_text(
+        "async def go(conn):\n"
+        "    await conn.call('frob', kee='x')\n"
+    )
+    rc = lint_main([str(pkg), "--baseline", "off", "--changed",
+                    "--relative-to", str(repo), "--json"])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert [v["rule"] for v in out["violations"]] == [
+        "TPU701", "TPU701"]
+    assert all(v["path"].endswith("caller.py")
+               for v in out["violations"])
+    assert out["changed"]["changed_files"] == 1
+    assert out["changed"]["analyzed_files"] >= 2

@@ -1,0 +1,392 @@
+"""Sweep-engine bench: gang packing, preemption migration, PBT forks.
+
+Three legs against a fake-chip cluster (CPU-backed workers), with the
+acceptance pins applied and ``BENCH_tune.json`` written:
+
+- **Packing**: an 8-trial sweep on 4 fake chips. Gang admission packs
+  trials onto idle chips concurrently — pinned: makespan < 0.6x the
+  naive sequential sum of trial durations, and time-weighted
+  chip_idle_fraction < 0.25.
+- **Kill**: a trial's node is drained (preemption notice) and killed
+  mid-sweep; the gang takes the emergency checkpoint at the next step
+  boundary and re-admits elsewhere — pinned: <= 1 step re-run per
+  kill, and the sweep journals the migration.
+- **Fork**: a PBT exploit forks the winner's checkpoint manifest into
+  the loser's run through the content-addressed store — pinned: the
+  head reports new_bytes == 0 and the dedup assertion measures 0 new
+  chunks (ratio 1.0).
+
+Run: ``python bench_tune.py [--trials N] [--steps N]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+# ---------------------------------------------------------- trial loops
+def _packing_loop(config):
+    import time as _t
+
+    from ray_tpu import train
+
+    for step in range(config["steps"]):
+        _t.sleep(config["step_s"])
+        train.report({"loss": float(config["lr"]) / (step + 1)})
+
+
+def _kill_loop(config):
+    import json as _json
+    import os as _os
+    import time as _t
+
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    start = 0
+    ck = train.get_checkpoint()
+    if ck:
+        with open(_os.path.join(ck, "state.json")) as f:
+            start = _json.load(f)["step"] + 1
+    scratch = config["scratch"]
+    with open(
+        _os.path.join(scratch, f"start_attempt{ctx.attempt}"), "w"
+    ) as f:
+        f.write(str(start))
+    if ctx.attempt == 0 and ctx.rank == 0:
+        from ray_tpu import api as _api
+
+        with open(config["marker"], "w") as f:
+            f.write(_api._runtime.core.node_addr or "")
+    for step in range(start, config["steps"]):
+        _t.sleep(0.15)
+        with open(
+            _os.path.join(scratch, f"prog_attempt{ctx.attempt}"), "w"
+        ) as f:
+            f.write(str(step))
+        ckdir = None
+        if step % 4 == 0 or train.preemption_notice() is not None:
+            ckdir = _os.path.join(scratch, f"ck_{step}")
+            _os.makedirs(ckdir, exist_ok=True)
+            with open(_os.path.join(ckdir, "state.json"), "w") as f:
+                _json.dump({"step": step}, f)
+        train.report({"loss": 1.0 / (step + 1)}, checkpoint=ckdir)
+
+
+def _fork_loop(config):
+    import time as _t
+
+    import numpy as np
+
+    from ray_tpu import checkpoint as ckpt
+    from ray_tpu import train
+
+    start = 0
+    state = {"w": np.ones(1024, np.float32) * config["lr"]}
+    uri = train.get_checkpoint()
+    if uri and ckpt.is_ckpt_uri(uri):
+        state = ckpt.restore_uri(uri, target=state)
+        start = ckpt.parse_uri(uri)[1] + 1
+    cp = ckpt.AsyncCheckpointer()
+    for step in range(start, config["steps"]):
+        _t.sleep(0.1)
+        cp.save(step, state)
+        train.report({"loss": float(config["lr"])})
+    cp.wait()
+
+
+# ----------------------------------------------------------------- legs
+def leg_packing(trials: int, steps: int, chips: int) -> dict:
+    import ray_tpu
+    from ray_tpu import tune
+
+    os.environ["RAY_TPU_FAKE_CHIPS"] = str(chips)
+    ray_tpu.init(num_cpus=max(8, chips * 2))
+    try:
+        sweep = tune.Sweep(
+            _packing_loop,
+            {
+                "lr": tune.grid_search(
+                    [round(0.1 * (i + 1), 2) for i in range(trials)]
+                ),
+                "steps": steps,
+                "step_s": 0.1,
+            },
+            sweep_id="bench-pack",
+            config=tune.SweepConfig(
+                num_samples=1, workers_per_trial=1,
+                chips_per_worker=1.0, poll_s=0.1,
+            ),
+        )
+        res = sweep.run()
+        durations = [
+            t.ended_ts - t.started_ts
+            for t in sweep.trials
+            if t.started_ts and t.ended_ts
+        ]
+        naive = sum(durations)
+        makespan = res.stats["makespan_s"]
+        return {
+            "trials": len(res.trials),
+            "chips": chips,
+            "all_terminated": all(
+                t.state == "TERMINATED" for t in res.trials
+            ),
+            "makespan_s": round(makespan, 3),
+            "naive_sequential_s": round(naive, 3),
+            "speedup": round(naive / makespan, 2) if makespan else None,
+            "makespan_over_naive": (
+                round(makespan / naive, 3) if naive else None
+            ),
+            "chip_idle_fraction": (
+                round(res.stats["chip_idle_fraction"], 4)
+                if res.stats["chip_idle_fraction"] is not None
+                else None
+            ),
+        }
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_FAKE_CHIPS", None)
+
+
+def leg_kill(tmp: str, steps: int = 14) -> dict:
+    import ray_tpu
+    from ray_tpu import api as core_api
+    from ray_tpu import tune
+    from ray_tpu.runtime.node import NodeManager
+    from ray_tpu.util import state as util_state
+
+    ray_tpu.init(num_cpus=2, _system_config={"HEALTH_TIMEOUT_S": 4.0})
+    rt = core_api._runtime
+    nodes = []
+
+    async def launch(i):
+        node = NodeManager(
+            rt.core.head_addr,
+            os.path.join(tmp, f"slice{i}_store"),
+            resources={"CPU": 2.0, "SLICE": 1.0},
+        )
+        await node.start()
+        return node
+
+    for i in range(2):
+        nodes.append(rt.run(launch(i)))
+    try:
+        marker = os.path.join(tmp, "victim_addr")
+        scratch = os.path.join(tmp, "scratch")
+        os.makedirs(scratch, exist_ok=True)
+        sweep = tune.Sweep(
+            _kill_loop,
+            {"steps": steps, "scratch": scratch, "marker": marker},
+            sweep_id="bench-kill",
+            storage_path=os.path.join(tmp, "results"),
+            config=tune.SweepConfig(
+                num_samples=1, workers_per_trial=1,
+                resources_per_worker={"SLICE": 1.0},
+                poll_s=0.1, max_failures=3,
+            ),
+        )
+
+        def drainer():
+            deadline = time.monotonic() + 60
+            while (
+                time.monotonic() < deadline
+                and not os.path.exists(marker)
+            ):
+                time.sleep(0.05)
+            with open(marker) as f:
+                victim_addr = f.read().strip()
+            victim = next(n for n in nodes if n.addr == victim_addr)
+
+            async def drain():
+                return await rt.core.head.call(
+                    "drain_node", node_id=victim.node_id,
+                    reason="preemption-notice", deadline_s=4.0,
+                )
+
+            rt.run(drain())
+            time.sleep(4.0)
+            for w in list(victim.workers.values()):
+                proc = w.get("proc")
+                if proc and proc.poll() is None:
+                    proc.kill()
+            try:
+                rt.run(victim.stop())
+            # tpulint: allow(broad-except reason=bench teardown; the node may already be dead from the kill leg)
+            except Exception:
+                pass
+
+        th = threading.Thread(target=drainer, daemon=True)
+        th.start()
+        res = sweep.run()
+        th.join(timeout=30)
+
+        trial = res.trials[0]
+        with open(os.path.join(scratch, "prog_attempt0")) as f:
+            last_before_kill = int(f.read())
+        with open(os.path.join(scratch, "start_attempt1")) as f:
+            resumed_at = int(f.read())
+        rec = util_state.sweep_stats()["sweeps"]["bench-kill"]
+        return {
+            "steps": steps,
+            "trial_state": trial.state,
+            "attempts": trial.attempts,
+            "journaled_preemptions": rec["preemptions"],
+            "last_step_before_kill": last_before_kill,
+            "resumed_at_step": resumed_at,
+            "steps_lost_per_kill": last_before_kill - resumed_at + 1,
+        }
+    finally:
+        for node in nodes:
+            try:
+                rt.run(node.stop())
+            # tpulint: allow(broad-except reason=bench teardown; the node may already be dead from the kill leg)
+            except Exception:
+                pass
+        ray_tpu.shutdown()
+        from ray_tpu._private import config as _config
+
+        _config._overrides.pop("HEALTH_TIMEOUT_S", None)
+        os.environ.pop("RAY_TPU_HEALTH_TIMEOUT_S", None)
+
+
+def leg_fork(steps: int = 12) -> dict:
+    import ray_tpu
+    from ray_tpu import checkpoint as ckpt
+    from ray_tpu import tune
+    from ray_tpu.util import state as util_state
+
+    os.environ["RAY_TPU_FAKE_CHIPS"] = "3"
+    ray_tpu.init(num_cpus=8)
+    try:
+        sweep = tune.Sweep(
+            _fork_loop,
+            {"lr": tune.grid_search([0.1, 0.5, 0.9]), "steps": steps},
+            sweep_id="bench-fork",
+            config=tune.SweepConfig(
+                num_samples=1, workers_per_trial=1,
+                chips_per_worker=1.0,
+                pbt=tune.LedgerPBT(
+                    metric="loss", mode="min",
+                    perturbation_interval=4,
+                    hyperparam_mutations={"lr": [0.05]},
+                    quantile_fraction=0.34, seed=7,
+                ),
+                poll_s=0.15,
+            ),
+        )
+        res = sweep.run()
+        forked = [t for t in res.trials if t.forked_from]
+        out = {"forks": res.stats["forks"], "fork_recs": []}
+        for t in forked:
+            rec = util_state.sweep_stats()["sweeps"]["bench-fork"][
+                "trials"
+            ][t.trial_id]
+            share = ckpt.fork_shares_chunks(
+                f"bench-fork/{t.forked_from}",
+                f"bench-fork/{t.trial_id}",
+                rec["fork_step"],
+            )
+            out["fork_recs"].append(
+                {
+                    "loser": t.trial_id,
+                    "winner": t.forked_from,
+                    "fork_step": rec["fork_step"],
+                    **share,
+                }
+            )
+        return out
+    finally:
+        ray_tpu.shutdown()
+        os.environ.pop("RAY_TPU_FAKE_CHIPS", None)
+
+
+# ----------------------------------------------------------------- pins
+def apply_pins(doc: dict) -> list[str]:
+    failures: list[str] = []
+
+    def pin(ok: bool, msg: str) -> None:
+        if not ok:
+            failures.append(msg)
+
+    pk = doc["packing"]
+    pin(pk["all_terminated"], "packing leg left non-terminated trials")
+    pin(
+        pk["makespan_over_naive"] is not None
+        and pk["makespan_over_naive"] < 0.6,
+        f"makespan {pk['makespan_s']}s is "
+        f"{pk['makespan_over_naive']}x naive sequential (pin: < 0.6x)",
+    )
+    pin(
+        pk["chip_idle_fraction"] is not None
+        and pk["chip_idle_fraction"] < 0.25,
+        f"chip_idle_fraction {pk['chip_idle_fraction']} (pin: < 0.25)",
+    )
+
+    kl = doc["kill"]
+    pin(
+        kl["trial_state"] == "TERMINATED",
+        f"killed trial ended {kl['trial_state']}",
+    )
+    pin(kl["attempts"] >= 2, "kill leg never migrated")
+    pin(
+        kl["journaled_preemptions"] >= 1,
+        "migration missing from the journaled sweep table",
+    )
+    pin(
+        kl["steps_lost_per_kill"] <= 1,
+        f"kill re-ran {kl['steps_lost_per_kill']} steps (pin: <= 1)",
+    )
+
+    fk = doc["fork"]
+    pin(fk["forks"] >= 1, "fork leg produced no PBT exploit")
+    for rec in fk["fork_recs"]:
+        pin(
+            rec["new_chunks"] == 0 and rec["dedup_ratio"] == 1.0,
+            f"fork {rec['winner']}->{rec['loser']} moved "
+            f"{rec['new_chunks']} new chunks "
+            f"(dedup {rec['dedup_ratio']})",
+        )
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--chips", type=int, default=4)
+    ap.add_argument(
+        "--output", default=os.path.join(REPO, "BENCH_tune.json")
+    )
+    args = ap.parse_args()
+
+    import tempfile
+
+    doc = {"bench": "tune_sweep", "trials": args.trials}
+    doc["packing"] = leg_packing(args.trials, args.steps, args.chips)
+    with tempfile.TemporaryDirectory(prefix="bench-tune-") as tmp:
+        doc["kill"] = leg_kill(tmp)
+    doc["fork"] = leg_fork()
+
+    failures = apply_pins(doc)
+    doc["pins"] = {"failures": failures, "passed": not failures}
+
+    with open(args.output, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc, indent=1))
+    print(f"wrote {args.output}")
+    for msg in failures:
+        print(f"FAIL: {msg}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

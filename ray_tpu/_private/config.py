@@ -151,6 +151,23 @@ CONFIG_DEFS: dict[str, tuple[type, Any, str]] = {
                                                "checkpoint is in hand "
                                                "so the attempt resumes "
                                                "losing ≤1 step"),
+    # --- sweep engine (tune)
+    "TUNE_MAX_CONCURRENT": (int, 0, "trial gangs admitted at once "
+                                    "(0 = as many as fit the healthy "
+                                    "chip budget)"),
+    "TUNE_ADMISSION_HEADROOM": (float, 0.0, "fraction of per-chip HBM "
+                                            "the memory-planner "
+                                            "admission check must "
+                                            "leave free before a "
+                                            "trial gang is admitted"),
+    "TUNE_POLL_S": (float, 0.2, "sweep orchestrator poll interval: "
+                                "ledger reads, rung checks, admission "
+                                "retries"),
+    "TUNE_INFRA_RETRIES": (int, 2, "re-admissions granted to a trial "
+                                   "after an INFRA failure (worker/"
+                                   "actor death); preemptions retry "
+                                   "unconditionally, trial-code "
+                                   "errors never do"),
     # --- distributed checkpoints
     "CKPT_REPLICATION": (int, 2, "total in-cluster copies of each "
                                  "checkpoint chunk (1 = local store "

@@ -16,6 +16,7 @@ Four pieces (see README "Checkpointing"):
   via ``shardings=`` — no shared filesystem required.
 """
 
+from ray_tpu.checkpoint.fork import fork, fork_shares_chunks
 from ray_tpu.checkpoint.restore import (
     latest_step,
     list_checkpoints,
@@ -39,6 +40,8 @@ __all__ = [
     "AsyncCheckpointer",
     "CKPT_URI_PREFIX",
     "ShardStore",
+    "fork",
+    "fork_shares_chunks",
     "is_ckpt_uri",
     "latest_step",
     "list_checkpoints",

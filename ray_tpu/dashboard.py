@@ -59,6 +59,14 @@ def _train():
     return state.train_stats()
 
 
+@_route("/api/tune")
+def _tune():
+    """Sweep-engine ledger (head journaled sweeps table): per-trial
+    gang states, rung stops, PBT forks, preemption migrations, with
+    each trial's train-job goodput/loss row joined in."""
+    return state.sweep_stats()
+
+
 @_route("/api/serve")
 def _serve():
     """Per-deployment serve SLO ledger (head serve:ingress-span

@@ -143,6 +143,16 @@ class HeadService:
         self.checkpoints: dict[str, dict[int, dict]] = {}
         # chunk hash → set of node addrs holding a replica.
         self.ckpt_locations: dict[str, set[str]] = {}
+        # Sweep-engine table (the Tune orchestrator's durable state):
+        # sweep_id → {"trials": {trial_id: {state, config, rung, job,
+        # forked_from, node, ...}}, plus orchestrator-reported meta
+        # (scheduler, num_samples, forks/preemptions counters, ts).
+        # Journaled like the drain/slice tables — a head SIGKILL
+        # mid-sweep must not forget which trials were stopped at a rung
+        # or which manifest a fork descended from, or the restarted
+        # orchestrator would re-run killed trials and double-count
+        # population exploits.
+        self.sweeps: dict[str, dict] = {}
         self._ckpt_repairing = False
         self._ckpt_last_repair = 0.0
         # Vectorized scheduling columns: per-resource-kind numpy views
@@ -287,6 +297,39 @@ class HeadService:
                         "profile_fp", {}
                     ).items()
                 }
+                self.sweeps = {
+                    sid: {
+                        **{
+                            k: v
+                            for k, v in rec.items()
+                            if k != "trials"
+                        },
+                        "trials": {
+                            tid: dict(t)
+                            for tid, t in rec.get(
+                                "trials", {}
+                            ).items()
+                        },
+                    }
+                    for sid, rec in payload.get("sweeps", {}).items()
+                }
+            elif table == "sweep":
+                if op == "put":
+                    rec = self.sweeps.setdefault(
+                        payload["sweep_id"], {"trials": {}}
+                    )
+                    fields = dict(payload["fields"])
+                    fields.pop("trials", None)
+                    rec.update(fields)
+                elif op == "trial":
+                    rec = self.sweeps.setdefault(
+                        payload["sweep_id"], {"trials": {}}
+                    )
+                    rec["trials"].setdefault(
+                        payload["trial_id"], {}
+                    ).update(payload["fields"])
+                else:
+                    self.sweeps.pop(payload["sweep_id"], None)
             elif table == "profile":
                 if op == "put":
                     self.profile_fp[payload["sig"]] = dict(
@@ -372,6 +415,16 @@ class HeadService:
             "profile_fp": {
                 sig: dict(rec)
                 for sig, rec in self.profile_fp.items()
+            },
+            "sweeps": {
+                sid: {
+                    **{k: v for k, v in rec.items() if k != "trials"},
+                    "trials": {
+                        tid: dict(t)
+                        for tid, t in rec.get("trials", {}).items()
+                    },
+                }
+                for sid, rec in self.sweeps.items()
             },
         }
 
@@ -1028,6 +1081,66 @@ class HeadService:
             "complete": rec["complete_ts"] is not None,
             "ranks": len(rec["ranks"]),
             "world": rec["world"],
+        }
+
+    async def _on_ckpt_fork(
+        self, conn, run: str, new_run: str, step: int | None = None
+    ):
+        """Fork a complete checkpoint into a new run lineage by
+        re-committing its per-rank manifests under ``new_run``. The
+        chunk store is content-addressed, so a fork moves ZERO bulk
+        bytes — both manifests reference the same chunk hashes and the
+        replica/location tables already cover them. This is the PBT
+        exploit primitive: copy the winner's manifest, perturb the
+        hyperparameters, keep training."""
+        from ray_tpu.checkpoint.manifest import manifest_chunks
+
+        steps = self.checkpoints.get(run) or {}
+        if step is None:
+            complete = [
+                s for s, rec in steps.items()
+                if rec["complete_ts"] is not None
+            ]
+            step = max(complete) if complete else None
+        if step is None or int(step) not in steps:
+            return {"ok": False, "error": f"no complete checkpoint for {run!r}"}
+        src = steps[int(step)]
+        if src["complete_ts"] is None:
+            return {"ok": False, "error": f"{run!r} step {step} incomplete"}
+        now = time.time()
+        chunks: set[str] = set()
+        completed = False
+        for rank, r in src["ranks"].items():
+            completed = self._ckpt_apply_commit(
+                new_run, int(step), int(rank), src["world"],
+                r["entries"], r["metrics"], now, r["parity"],
+            ) or completed
+            self._journal_append(
+                "ckpt",
+                "commit",
+                {
+                    "run": new_run,
+                    "step": int(step),
+                    "rank": int(rank),
+                    "world": int(src["world"]),
+                    "entries": list(r["entries"]),
+                    "parity": list(r["parity"] or ()),
+                    "metrics": dict(r["metrics"] or {}),
+                    "ts": now,
+                },
+            )
+            chunks |= manifest_chunks(r["entries"])
+        if completed:
+            self._ckpt_prune(new_run)
+        return {
+            "ok": True,
+            "run": new_run,
+            "step": int(step),
+            "ranks": len(src["ranks"]),
+            "chunks": len(chunks),
+            # Content-addressed fork: the manifests are copied, the
+            # chunks are not. Callers assert on this.
+            "new_bytes": 0,
         }
 
     def _ckpt_referenced_chunks(self) -> set[str]:
@@ -2731,6 +2844,10 @@ class HeadService:
                 "first_ts": float(ev.get("ts") or 0.0),
                 "last_end_ts": None,
                 "mfu": None,
+                # Latest reported training loss (train:step span attr):
+                # what the sweep engine's ledger-driven schedulers rank
+                # trials by — no reporting path beyond the span fold.
+                "loss": None,
                 "phase_s": {},
                 # sliding alert window: (step_end_ts, total_s, lost_s)
                 "window": [],
@@ -2790,6 +2907,8 @@ class HeadService:
                 pass
         if isinstance(ev.get("mfu"), (int, float)):
             rec["mfu"] = float(ev["mfu"])
+        if isinstance(ev.get("loss"), (int, float)):
+            rec["loss"] = float(ev["loss"])
         rec["last_end_ts"] = max(rec["last_end_ts"] or 0.0, start + dur)
         self._goodput_alert_check(
             job, rec, start + dur, dur + gap, gap + in_step_lost + degraded
@@ -2850,6 +2969,7 @@ class HeadService:
             "attempts": rec["attempts_seen"],
             "current_attempt": rec["attempt"],
             "mfu": rec["mfu"],
+            "loss": rec.get("loss"),
             "phase_s": dict(rec["phase_s"]),
             "first_ts": rec["first_ts"],
             "last_ts": rec["last_end_ts"],
@@ -3493,6 +3613,133 @@ class HeadService:
             }
         return out
 
+    # ------------------------------------------------------ sweep table
+    async def _on_sweep_put(self, conn, sweep_id: str, fields: dict):
+        """Upsert sweep-level orchestrator state (scheduler, sample
+        count, fork/preemption counters, terminal status). Journaled:
+        the sweep table is what a restarted head — or a restarted
+        orchestrator reading sweep_stats — resumes from."""
+        rec = self.sweeps.setdefault(sweep_id, {"trials": {}})
+        clean = {k: v for k, v in dict(fields).items() if k != "trials"}
+        rec.update(clean)
+        self._journal_append(
+            "sweep", "put", {"sweep_id": sweep_id, "fields": clean}
+        )
+        return {"ok": True}
+
+    async def _on_sweep_trial(
+        self, conn, sweep_id: str, trial_id: str, fields: dict
+    ):
+        """Upsert one trial's durable record (state transitions, rung
+        promotions, fork lineage, migration target)."""
+        rec = self.sweeps.setdefault(sweep_id, {"trials": {}})
+        rec["trials"].setdefault(trial_id, {}).update(dict(fields))
+        self._journal_append(
+            "sweep",
+            "trial",
+            {
+                "sweep_id": sweep_id,
+                "trial_id": trial_id,
+                "fields": dict(fields),
+            },
+        )
+        return {"ok": True}
+
+    async def _on_sweep_stats(self, conn, sweep_id: str | None = None):
+        """Sweep table joined against the goodput ledger: each trial
+        that names a train job gets that job's public ledger row
+        (goodput, steps, restart_lost_s …) inlined, so the scheduler,
+        dashboard /api/tune, and `ray_tpu tune` read ONE surface."""
+        self._drain_folds()  # read-your-writes past the fold queue
+        out = {}
+        items = (
+            [(sweep_id, self.sweeps[sweep_id])]
+            if sweep_id is not None and sweep_id in self.sweeps
+            else list(self.sweeps.items())
+        )
+        for sid, rec in items:
+            trials = {}
+            for tid, t in rec.get("trials", {}).items():
+                pub = dict(t)
+                job = t.get("job")
+                run = self.train_runs.get(job) if job else None
+                if run is not None:
+                    pub["ledger"] = self._train_job_public(run)
+                trials[tid] = pub
+            out[sid] = {
+                **{k: v for k, v in rec.items() if k != "trials"},
+                "trials": trials,
+            }
+        return {"sweeps": out}
+
+    def _tune_metrics_snapshot(self) -> dict | None:
+        """Head-owned sweep gauges in worker-snapshot format (the tune
+        twin of _train_metrics_snapshot): per-sweep trial-state counts
+        plus fork/preemption counters, surviving the orchestrator that
+        reported them."""
+        if not self.sweeps:
+            return None
+        from ray_tpu.util.metrics import escape_label_value as _esc
+
+        running: dict[str, float] = {}
+        done: dict[str, float] = {}
+        errored: dict[str, float] = {}
+        forks: dict[str, float] = {}
+        preempt: dict[str, float] = {}
+        for sid, rec in self.sweeps.items():
+            tag = f'sweep="{_esc(sid)}"'
+            states = [
+                t.get("state") for t in rec.get("trials", {}).values()
+            ]
+            running[tag] = float(
+                sum(1 for s in states if s in ("RUNNING", "PENDING"))
+            )
+            done[tag] = float(
+                sum(1 for s in states if s == "TERMINATED")
+            )
+            errored[tag] = float(
+                sum(1 for s in states if s == "ERROR")
+            )
+            forks[tag] = float(rec.get("forks", 0))
+            preempt[tag] = float(rec.get("preemptions", 0))
+        return {
+            "ray_tpu_tune_trials_running": {
+                "kind": "gauge",
+                "description": "trials pending admission or running, "
+                               "per sweep",
+                "series": running,
+                "boundaries": None,
+            },
+            "ray_tpu_tune_trials_terminated": {
+                "kind": "gauge",
+                "description": "trials finished or stopped at a rung "
+                               "boundary, per sweep",
+                "series": done,
+                "boundaries": None,
+            },
+            "ray_tpu_tune_trials_errored": {
+                "kind": "gauge",
+                "description": "trials failed on a non-retryable "
+                               "error, per sweep",
+                "series": errored,
+                "boundaries": None,
+            },
+            "ray_tpu_tune_forks_total": {
+                "kind": "gauge",
+                "description": "PBT checkpoint forks performed (each "
+                               "a zero-byte manifest copy), per sweep",
+                "series": forks,
+                "boundaries": None,
+            },
+            "ray_tpu_tune_preemptions_total": {
+                "kind": "gauge",
+                "description": "trial preemptions/migrations absorbed "
+                               "by re-admission, per sweep",
+                "series": preempt,
+                "boundaries": None,
+            },
+        }
+
     METRICS_TTL_S = 60.0
 
     async def _on_report_metrics(self, conn, worker: str, metrics: dict):
@@ -3513,6 +3760,7 @@ class HeadService:
         head_snap.update(self._serve_metrics_snapshot() or {})
         head_snap.update(self._mem_metrics_snapshot() or {})
         head_snap.update(self._profile_metrics_snapshot() or {})
+        head_snap.update(self._tune_metrics_snapshot() or {})
         head_snap.update(self._head_metrics_snapshot())
         if head_snap:
             workers["head"] = head_snap

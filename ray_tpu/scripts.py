@@ -191,6 +191,67 @@ def cmd_goodput(args) -> int:
     return 0
 
 
+def print_sweeps(stats: dict, as_json: bool = False) -> int:
+    """Render the sweep-engine ledger (factored out of cmd_tune so
+    tier-1 can smoke the exact CLI output path without a daemonized
+    cluster)."""
+    sweeps = stats.get("sweeps", {})
+    if as_json:
+        json.dump(sweeps, sys.stdout, indent=2, default=str)
+        print()
+        return 0
+    if not sweeps:
+        print("no sweeps have been journaled")
+        return 0
+    for sid, rec in sorted(sweeps.items()):
+        trials = rec.get("trials", {})
+        states: dict[str, int] = {}
+        for t in trials.values():
+            s = t.get("state", "?")
+            states[s] = states.get(s, 0) + 1
+        state_str = "  ".join(
+            f"{k}={v}" for k, v in sorted(states.items())
+        )
+        makespan = rec.get("makespan_s")
+        print(
+            f"{sid}: state={rec.get('state', '?')}  "
+            f"trials={len(trials)}  forks={rec.get('forks', 0)}  "
+            f"preemptions={rec.get('preemptions', 0)}"
+            + (f"  makespan={makespan:.1f}s" if makespan else "")
+        )
+        if state_str:
+            print(f"  {state_str}")
+        for tid, t in sorted(trials.items()):
+            ledger = t.get("ledger") or {}
+            bits = [f"state={t.get('state', '?')}"]
+            if ledger.get("steps") is not None:
+                bits.append(f"steps={ledger['steps']}")
+            if ledger.get("loss") is not None:
+                bits.append(f"loss={ledger['loss']:.4f}")
+            if ledger.get("goodput") is not None:
+                bits.append(f"goodput={ledger['goodput']:.3f}")
+            if t.get("attempts"):
+                bits.append(f"attempts={t['attempts']}")
+            if t.get("forked_from"):
+                bits.append(f"forked_from={t['forked_from']}")
+            if t.get("stop_reason"):
+                bits.append(f"stop={t['stop_reason']}")
+            print(f"  {tid}: " + "  ".join(bits))
+    return 0
+
+
+def cmd_tune(args) -> int:
+    """Sweep-engine ledger: per-trial gang states with each trial's
+    train-job row joined in, plus fork/preemption counters (the head's
+    journaled sweeps table; same data as the dashboard's /api/tune)."""
+    from ray_tpu.util import state
+
+    _connect(args.address, getattr(args, "session_dir", None))
+    return print_sweeps(
+        state.sweep_stats(sweep_id=args.sweep), as_json=args.json
+    )
+
+
 def print_profile(stats: dict, as_json: bool = False) -> int:
     """Render the compiled-program profile ledger (factored out of
     cmd_profile so tier-1 can smoke the exact CLI output path without
@@ -835,6 +896,14 @@ def main(argv=None) -> int:
     gp = sub.add_parser("goodput")
     gp.add_argument("--json", action="store_true",
                     help="raw per-job stats as JSON")
+    tn = sub.add_parser("tune",
+                        help="sweep-engine ledger (per-trial gang "
+                             "states, rung stops, PBT forks, "
+                             "preemption migrations)")
+    tn.add_argument("--sweep", default=None,
+                    help="restrict to one sweep id")
+    tn.add_argument("--json", action="store_true",
+                    help="raw sweeps table as JSON")
     pf = sub.add_parser("profile",
                         help="compiled-program MFU decomposition from "
                              "the latest capture (+ regression-"
@@ -903,6 +972,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline,
         "metrics": cmd_metrics,
         "goodput": cmd_goodput,
+        "tune": cmd_tune,
         "profile": cmd_profile,
         "slo": cmd_slo,
         "mem": cmd_mem,

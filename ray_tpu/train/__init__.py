@@ -36,6 +36,7 @@ from ray_tpu.train.session import (
     zero_optimizer,
 )
 from ray_tpu.train.memory import MemoryPlan, plan as plan_memory
+from ray_tpu.train.admission import AdmissionTicket, admit_gang
 from ray_tpu.train.trainer import (
     ElasticScalingPolicy,
     FailureConfig,
@@ -75,6 +76,8 @@ __all__ = [
     "step_span",
     "MemoryPlan",
     "plan_memory",
+    "AdmissionTicket",
+    "admit_gang",
     "ElasticScalingPolicy",
     "FailureConfig",
     "JaxTrainer",

@@ -60,6 +60,12 @@ class TrainContext:
     # head node table; the RAY_TPU_SLICE_FAIL chaos knob and slice-
     # aware train loops read it via train.slice_label().
     slice_label: str | None = None
+    # Sweep-engine trial scoping (tune/sweep.py): the sweep and trial
+    # this worker's gang belongs to (None outside a sweep). Threaded
+    # from RunConfig through the backend env so a migrated gang keeps
+    # its identity across attempts and nodes.
+    sweep_id: str | None = None
+    trial_id: str | None = None
     # mutated by report():
     reports: list = field(default_factory=list)
     latest_metrics: dict = field(default_factory=dict)

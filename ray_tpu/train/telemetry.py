@@ -276,11 +276,13 @@ def finish_step(ctx, timer: StepTimer) -> None:
     sync_exposed = host_sync_attribution(
         timer.start, timer.start + dur, timer._events
     )
+    loss = getattr(timer, "loss", None)
     _emit_step_span(
         ctx, timer.start, dur, phases=dict(timer.phases), mfu=mfu,
         degraded_frac=_take_degraded_frac(ctx),
         comm_exposed_s=exposed, comm_overlapped_s=overlapped,
         host_sync_exposed_s=sync_exposed,
+        loss=float(loss) if isinstance(loss, (int, float)) else None,
     )
     from ray_tpu.util import tracing
 
@@ -335,10 +337,12 @@ def implicit_step(ctx, now: float, metrics: dict) -> None:
     exposed, overlapped = comm_attribution(base, now, [])
     if exposed and dur > 0:
         COMM_EXPOSED_RATIO.set(exposed / dur, tags={"job": job})
+    loss = metrics.get("loss") if isinstance(metrics, dict) else None
     _emit_step_span(
         ctx, base, dur, phases=phases, mfu=mfu,
         degraded_frac=_take_degraded_frac(ctx),
         comm_exposed_s=exposed, comm_overlapped_s=overlapped,
+        loss=float(loss) if isinstance(loss, (int, float)) else None,
     )
     ctx._step_index += 1
     from ray_tpu.runtime import memory as _mem
@@ -362,7 +366,7 @@ def _take_degraded_frac(ctx) -> float:
 def _emit_step_span(
     ctx, start, dur, phases, mfu, degraded_frac=0.0,
     comm_exposed_s=0.0, comm_overlapped_s=0.0,
-    host_sync_exposed_s=0.0,
+    host_sync_exposed_s=0.0, loss=None,
 ) -> None:
     from ray_tpu.util import tracing
 
@@ -375,6 +379,11 @@ def _emit_step_span(
     )
     if mfu is not None:
         attrs["mfu"] = round(mfu, 6)
+    if loss is not None:
+        # The sweep engine's ledger-driven schedulers read this from
+        # the head's train_stats fold — report({"loss": ...}) is the
+        # whole reporting path a trial needs.
+        attrs["loss"] = loss
     if degraded_frac:
         attrs["degraded_frac"] = round(degraded_frac, 6)
     if comm_exposed_s or comm_overlapped_s:

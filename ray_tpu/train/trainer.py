@@ -189,6 +189,16 @@ class RunConfig:
     name: str = "train_run"
     storage_path: str = "/tmp/ray_tpu_results"
     failure_config: FailureConfig = field(default_factory=FailureConfig)
+    # Sweep-engine trial scoping (tune/sweep.py sets these): carried
+    # into every worker's TrainContext so telemetry and chaos tooling
+    # can attribute a gang to its trial across migrations.
+    sweep_id: str | None = None
+    trial_id: str | None = None
+    # Seed the resume path before the FIRST attempt: a checkpoint path
+    # or ckpt:// URI, or "auto" to discover the run's newest valid
+    # checkpoint (file dir or in-cluster shard store). "auto" is how a
+    # PBT-forked trial restores the manifest forked into its run name.
+    resume_from_checkpoint: str | None = None
 
 
 @dataclass
@@ -328,6 +338,8 @@ class TrainWorker:
                 backend_env.get("RAY_TPU_TRAIN_ZERO_SHARDING") == "1"
             ),
             slice_label=slice_label,
+            sweep_id=backend_env.get("RAY_TPU_TRAIN_SWEEP_ID") or None,
+            trial_id=backend_env.get("RAY_TPU_TRAIN_TRIAL_ID") or None,
         )
         return True
 
@@ -449,6 +461,28 @@ class JaxTrainer:
         # (reference: DataConfig splits ray.data streams per worker,
         # train/v2/_internal/data_integration/).
         self.datasets = datasets or {}
+        # Sweep-engine stop hook: request_stop() kills the current
+        # attempt's gang and makes fit() return (latest checkpoint,
+        # no error) instead of retrying — an ASHA rung kill must not
+        # fight the controller's own failure policy.
+        self._stop_requested = False
+        self._live_workers: list = []
+
+    def request_stop(self) -> None:
+        """Stop this trainer from another thread: the current gang is
+        killed and fit() returns its latest checkpoint without
+        retrying. Idempotent; safe before fit() starts (the first
+        attempt is then skipped)."""
+        self._stop_requested = True
+        for w in list(self._live_workers):
+            try:
+                ray_tpu.kill(w)
+            except RayTpuError:
+                pass
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested
 
     def _split_datasets(self, n: int) -> list[dict]:
         """Materialize each dataset and deal its block refs round-robin:
@@ -474,13 +508,24 @@ class JaxTrainer:
     # ------------------------------------------------------------ fit
     def fit(self) -> Result:
         failures = 0
-        latest_checkpoint: str | None = None
+        resume = self.run_config.resume_from_checkpoint
+        latest_checkpoint: str | None = (
+            self._find_latest_checkpoint() if resume == "auto" else resume
+        )
         last_err: Exception | None = None
-        while True:
+        while not self._stop_requested:
             n = self._policy_workers(failures, last_err)
             try:
                 return self._run_attempt(latest_checkpoint, failures, n)
             except Exception as e:  # noqa: BLE001 - controller retry loop
+                if self._stop_requested:
+                    # The attempt died because request_stop() killed the
+                    # gang — that is a clean stop, not a failure.
+                    latest_checkpoint = (
+                        self._find_latest_checkpoint() or latest_checkpoint
+                    )
+                    last_err = None
+                    break
                 logger.warning(
                     "train attempt %d failed (%s: %s); %s",
                     failures,
@@ -703,6 +748,10 @@ class JaxTrainer:
         # Attempt is always exposed (not only for distributed) so train
         # loops can scope their own collective groups per attempt.
         env["RAY_TPU_TRAIN_ATTEMPT"] = str(attempt)
+        if self.run_config.sweep_id:
+            env["RAY_TPU_TRAIN_SWEEP_ID"] = self.run_config.sweep_id
+        if self.run_config.trial_id:
+            env["RAY_TPU_TRAIN_TRIAL_ID"] = self.run_config.trial_id
         if self.scaling.collective_timeout_s is not None:
             env["RAY_TPU_TRAIN_COLLECTIVE_TIMEOUT_S"] = str(
                 self.scaling.collective_timeout_s
@@ -759,6 +808,7 @@ class JaxTrainer:
                 ).remote(i, n)
                 for i in range(n)
             ]
+            self._live_workers = workers
             shards = self._split_datasets(n)
             ray_tpu.get(
                 [
@@ -788,6 +838,7 @@ class JaxTrainer:
                 path=self._run_dir(),
             )
         finally:
+            self._live_workers = []
             for w in workers:
                 try:
                     ray_tpu.kill(w)

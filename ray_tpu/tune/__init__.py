@@ -6,6 +6,11 @@ actors, searchers, schedulers, ResultGrid), reduced to the surfaces the
 rest of this framework uses: function and class trainables, grid/random
 search, ASHA / HyperBand / median-stopping / PBT schedulers, and
 TPE / Optuna / HyperOpt / BOHB searchers.
+
+The sweep engine (``Sweep``) layers gang scheduling on top: each trial
+is a JaxTrainer worker gang admitted by the memory planner + cluster
+chip tables, early-stopped by ledger-driven schedulers (``LedgerASHA``),
+and evolved by checkpoint-forked PBT (``LedgerPBT``).
 """
 
 from __future__ import annotations
@@ -14,6 +19,8 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     HyperBandScheduler,
     FIFOScheduler,
+    LedgerASHA,
+    LedgerPBT,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,
@@ -33,6 +40,7 @@ from ray_tpu.tune.search import (
     ConcurrencyLimiter,
     Domain,
     Repeater,
+    SearchAlgorithm,
     Searcher,
     TPESearcher,
     choice,
@@ -41,6 +49,7 @@ from ray_tpu.tune.search import (
     randint,
     uniform,
 )
+from ray_tpu.tune.sweep import Sweep, SweepConfig, SweepResult
 from ray_tpu.tune.trial import StopTrial, Trainable, Trial
 from ray_tpu.tune.tuner import (
     ResultGrid,
@@ -85,9 +94,11 @@ __all__ = [
     "uniform", "loguniform", "randint", "choice", "grid_search",
     "TPESearcher", "OptunaSearch", "HyperOptSearch", "BOHBSearch",
     "ConcurrencyLimiter", "Repeater",
-    "Domain", "Choice", "Searcher", "BasicVariantGenerator",
+    "Domain", "Choice", "Searcher", "SearchAlgorithm",
+    "BasicVariantGenerator",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "HyperBandScheduler",
     "MedianStoppingRule", "PopulationBasedTraining",
+    "Sweep", "SweepConfig", "SweepResult", "LedgerASHA", "LedgerPBT",
     "Callback", "JsonLoggerCallback", "WandbLoggerCallback",
     "MLflowLoggerCallback",
 ]

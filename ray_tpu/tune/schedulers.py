@@ -191,6 +191,124 @@ class MedianStoppingRule(TrialScheduler):
         return STOP if bad else CONTINUE
 
 
+class LedgerASHA:
+    """ASHA over the head's goodput ledger (tune/sweep.py's early
+    stopper). Instead of per-result callbacks, the sweep orchestrator
+    polls ``train_stats`` and feeds each trial's ledger row —
+    ``(steps, value)`` where value is the folded ``loss`` (or any
+    ledger field) — into :meth:`decide`. Rungs are step counts
+    (``grace_period * reduction_factor**k``); a trial crossing a rung
+    is stopped unless its value ranks in the top
+    ``1/reduction_factor`` of everything recorded at that rung so far.
+    No new reporting path: the values come from the ``train:step``
+    span fold."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 2, reduction_factor: int = 4,
+                 max_t: int = 10**9):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.grace, self.rf, self.max_t = (
+            grace_period, reduction_factor, max_t,
+        )
+        milestones = []
+        t = grace_period
+        while t < max_t and len(milestones) < 64:
+            milestones.append(t)
+            t *= reduction_factor
+        self._milestones = milestones
+        self._rungs: dict[int, list[float]] = {}
+        # trial_id → highest milestone already judged (each rung is
+        # crossed once, however often the ledger is polled).
+        self._judged: dict[str, int] = {}
+
+    def decide(self, trial_id: str, steps: int, value: float | None) -> str:
+        """CONTINUE or STOP for one ledger row."""
+        if steps >= self.max_t:
+            return STOP
+        if value is None:
+            return CONTINUE
+        crossed = [
+            m for m in self._milestones
+            if m <= steps and m > self._judged.get(trial_id, 0)
+        ]
+        if not crossed:
+            return CONTINUE
+        rung = crossed[-1]  # judge at the highest newly-crossed rung
+        self._judged[trial_id] = rung
+        peers = self._rungs.setdefault(rung, [])
+        peers.append(float(value))
+        k = max(1, len(peers) // self.rf)
+        top = sorted(peers, reverse=(self.mode == "max"))[:k]
+        worst_top = top[-1]
+        good = (
+            (value >= worst_top) if self.mode == "max"
+            else (value <= worst_top)
+        )
+        return CONTINUE if good else STOP
+
+
+class LedgerPBT:
+    """Population-based training over the ledger (tune/sweep.py's fork
+    driver; Jaderberg et al., arXiv:1711.09846). Every
+    ``perturbation_interval`` ledger steps a bottom-quantile trial is
+    stopped, its run FORKS the winner's checkpoint manifest (a
+    zero-byte content-addressed copy — checkpoint/fork.py), and it
+    relaunches with the winner's config perturbed."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25, seed=None):
+        assert mode in ("max", "min")
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.rng = random.Random(seed)
+        self._last_exploit: dict[str, int] = {}  # trial_id → steps
+
+    def exploit_pairs(
+        self, rows: dict[str, tuple[int, float | None]]
+    ) -> list[tuple[str, str]]:
+        """(loser, winner) pairs due for an exploit, given the current
+        ledger rows {trial_id: (steps, value)}. A loser exploits at
+        most once per interval window."""
+        scored = [
+            (v, tid) for tid, (s, v) in rows.items() if v is not None
+        ]
+        if len(scored) < 2:
+            return []
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        winners = [tid for _, tid in scored[:k]]
+        losers = {tid for _, tid in scored[-k:]}
+        out = []
+        for tid, (steps, v) in rows.items():
+            if tid not in losers or v is None:
+                continue
+            if steps - self._last_exploit.get(tid, 0) < self.interval:
+                continue
+            cands = [w for w in winners if w != tid]
+            if not cands:
+                continue
+            self._last_exploit[tid] = steps
+            out.append((tid, self.rng.choice(cands)))
+        return out
+
+    def perturb(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if callable(spec):
+                out[key] = spec()
+            elif isinstance(spec, list):
+                out[key] = self.rng.choice(spec)
+            else:  # numeric: jitter
+                factor = self.rng.choice([0.8, 1.2])
+                out[key] = out.get(key, spec) * factor
+        return out
+
+
 class PopulationBasedTraining(TrialScheduler):
     """PBT (reference: pbt.py): every perturbation_interval steps, a
     bottom-quantile trial clones a top-quantile trial's checkpoint and

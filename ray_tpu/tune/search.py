@@ -11,7 +11,22 @@ from __future__ import annotations
 
 import itertools
 import random
-from typing import Any, Callable
+from typing import Any, Callable, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class SearchAlgorithm(Protocol):
+    """Structural contract every search backend speaks — the native
+    Searcher subclasses here and the legacy wrappers (bohb_search /
+    hyperopt_search / optuna_search) alike. ``suggest`` returns a
+    config dict, ``None`` (exhausted), or the DEFER sentinel (ask again
+    later); ``on_trial_complete`` feeds the observation back."""
+
+    def suggest(self, trial_id: str) -> Any: ...
+
+    def on_trial_complete(
+        self, trial_id: str, result: dict | None
+    ) -> None: ...
 
 
 class Domain:

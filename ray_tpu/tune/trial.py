@@ -41,6 +41,9 @@ class Trial:
         self.actor = None
         self.is_class_api = False
         self.iteration = 0
+        # Infra-failure retry counter (budgeted by TUNE_INFRA_RETRIES;
+        # preemptions restart for free and don't consume it).
+        self.infra_retries = 0
 
     def __repr__(self):
         return f"Trial({self.trial_id}, {self.status}, iters={self.iteration})"

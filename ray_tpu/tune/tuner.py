@@ -18,10 +18,59 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 import ray_tpu
+from ray_tpu import exceptions as _exc
 from ray_tpu.tune import schedulers as S
 from ray_tpu.tune.search import DEFER, BasicVariantGenerator, Searcher
 
 logger = logging.getLogger("ray_tpu.tune")
+
+# Typed trial-failure classes (reference: the v2 controller's
+# failure-policy split, python/ray/train/v2/_internal/execution/
+# failure_handling) — each gets a different retry policy:
+# - "preempted": the node under the trial was reclaimed. Never the
+#   trial's fault; restart unconditionally from its last checkpoint.
+# - "infra": actor/object plumbing died (worker crash, object loss,
+#   RPC timeout). Retry up to TUNE_INFRA_RETRIES, then give up.
+# - "trial": the trainable itself raised. A user bug — retrying
+#   re-raises it, so fail fast.
+PREEMPTED = "preempted"
+INFRA = "infra"
+TRIAL = "trial"
+
+_INFRA_TYPES = (
+    _exc.WorkerDiedError,
+    _exc.ActorDiedError,
+    _exc.ObjectLostError,
+    _exc.GetTimeoutError,
+)
+
+
+def classify_failure(err: BaseException | str) -> str:
+    """Classify a trial failure as PREEMPTED, INFRA, or TRIAL.
+
+    Walks the cause chain (RayTaskError wraps the user exception in
+    ``.cause``) so a PreemptedError surfacing through task-error
+    plumbing is still recognized as a preemption, not an infra flake.
+    """
+    seen: set[int] = set()
+    cur: BaseException | None = (
+        err if isinstance(err, BaseException) else None
+    )
+    text = str(err)
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, _exc.PreemptedError):
+            return PREEMPTED
+        if isinstance(cur, _INFRA_TYPES):
+            return INFRA
+        cur = getattr(cur, "cause", None) or getattr(
+            cur, "__cause__", None
+        )
+    if "PreemptedError" in text:
+        return PREEMPTED
+    if any(t.__name__ in text for t in _INFRA_TYPES):
+        return INFRA
+    return TRIAL
 from ray_tpu.tune.trial import (
     ERROR,
     PENDING,
@@ -253,6 +302,50 @@ class _TuneController:
                 pass
             trial.actor = None
 
+    def _handle_trial_failure(self, trial: Trial, err: Exception):
+        """Apply the typed failure policy (see classify_failure)."""
+        from ray_tpu._private import config as _config
+
+        kind = classify_failure(err)
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            # tpulint: allow(broad-except reason=the failed trial's actor is usually already dead; the kill is best-effort cleanup)
+            except Exception:  # noqa: BLE001
+                pass
+            trial.actor = None
+        if kind == PREEMPTED:
+            logger.warning(
+                "trial %s preempted (attempt %d); restarting from %s",
+                trial.trial_id, trial.infra_retries + 1,
+                trial.checkpoint or "scratch",
+            )
+            self._start(trial)
+            return
+        if kind == INFRA:
+            budget = _config.get("TUNE_INFRA_RETRIES")
+            if trial.infra_retries < budget:
+                trial.infra_retries += 1
+                logger.warning(
+                    "trial %s hit infra failure %s (retry %d/%d): %s",
+                    trial.trial_id, type(err).__name__,
+                    trial.infra_retries, budget, err,
+                )
+                self._start(trial)
+                return
+            logger.error(
+                "trial %s exhausted %d infra retries; failing: %s",
+                trial.trial_id, budget, err,
+            )
+            self._finish(trial, ERROR, error=f"[infra] {err}")
+            return
+        # Trial-code bug: retrying would just re-raise it.
+        logger.error(
+            "trial %s failed in trial code; failing fast: %s",
+            trial.trial_id, err,
+        )
+        self._finish(trial, ERROR, error=f"[trial] {err}")
+
     def _running(self):
         return [t for t in self.trials if t.status == RUNNING]
 
@@ -313,9 +406,9 @@ class _TuneController:
         for t, ref in step_refs:
             try:
                 metrics = ray_tpu.get(ref)
-            # tpulint: allow(broad-except reason=the failure is recorded — the trial finishes in ERROR state carrying the stringified exception)
+            # tpulint: allow(broad-except reason=the failure is classified and either retried or recorded as the trial's terminal error)
             except Exception as e:  # noqa: BLE001
-                self._finish(t, ERROR, error=str(e))
+                self._handle_trial_failure(t, e)
                 continue
             t.iteration = metrics.get("training_iteration", t.iteration + 1)
             t.results.append(metrics)
@@ -352,9 +445,9 @@ class _TuneController:
         for t, ref in polls:
             try:
                 out = ray_tpu.get(ref)
-            # tpulint: allow(broad-except reason=the failure is recorded — the trial finishes in ERROR state carrying the stringified exception)
+            # tpulint: allow(broad-except reason=the failure is classified and either retried or recorded as the trial's terminal error)
             except Exception as e:  # noqa: BLE001
-                self._finish(t, ERROR, error=str(e))
+                self._handle_trial_failure(t, e)
                 continue
             stopped = False
             for entry in out["reports"]:
@@ -379,6 +472,27 @@ class _TuneController:
             if out["done"]:
                 t.checkpoint = out["checkpoint"] or t.checkpoint
                 if out["error"]:
-                    self._finish(t, ERROR, error=out["error"])
+                    # The fn session reports failures as strings;
+                    # classify by name so a preemption surfacing
+                    # through the session still restarts the trial.
+                    kind = classify_failure(out["error"])
+                    if kind == PREEMPTED:
+                        logger.warning(
+                            "trial %s preempted (reported); restarting "
+                            "from %s", t.trial_id,
+                            t.checkpoint or "scratch",
+                        )
+                        if t.actor is not None:
+                            try:
+                                ray_tpu.kill(t.actor)
+                            # tpulint: allow(broad-except reason=the preempted trial's actor is usually already dead; the kill is best-effort cleanup)
+                            except Exception:  # noqa: BLE001
+                                pass
+                            t.actor = None
+                        self._start(t)
+                    else:
+                        self._finish(
+                            t, ERROR, error=f"[{kind}] {out['error']}"
+                        )
                 else:
                     self._finish(t, TERMINATED)

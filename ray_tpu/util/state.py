@@ -173,6 +173,16 @@ def train_stats() -> dict:
     return _call_head("train_stats")
 
 
+def sweep_stats(sweep_id: str | None = None) -> dict:
+    """Sweep-engine ledger from the head's journaled ``sweeps`` table:
+    per-sweep trial states (gang admission → running → rung-stopped /
+    forked / migrated), fork and preemption counters, and each trial's
+    live train-job ledger row joined in. Backs the dashboard's
+    /api/tune and the `ray_tpu tune` CLI; survives head restart via
+    journal replay."""
+    return _call_head("sweep_stats", sweep_id=sweep_id)
+
+
 def serve_stats() -> dict:
     """Per-deployment serve SLO ledger from the head: request/error
     counts, sliding-window TTFT/latency p50/p99, SLO attainment, and

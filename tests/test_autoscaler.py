@@ -155,3 +155,107 @@ def test_autoscaler_min_workers_and_idle_termination(cluster):
         autoscaler.stop()
         for pid in list(provider.non_terminated_nodes()):
             provider.terminate_node(pid)
+
+
+class _CountingProvider:
+    """Synthetic provider that records every termination API call, so
+    the test can pin HOW MANY provider round-trips a teardown cost —
+    not just that the nodes went away."""
+
+    def __init__(self, runtime_ids):
+        # provider id → runtime node id
+        self._runtime_ids = dict(runtime_ids)
+        self.terminate_node_calls: list[str] = []
+        self.terminate_nodes_calls: list[list[str]] = []
+
+    def create_node(self, node_type, resources):  # pragma: no cover
+        raise AssertionError("test must not launch")
+
+    def terminate_node(self, pid):
+        self.terminate_node_calls.append(pid)
+        self._runtime_ids.pop(pid, None)
+
+    def terminate_nodes(self, pids):
+        self.terminate_nodes_calls.append(list(pids))
+        for pid in pids:
+            self._runtime_ids.pop(pid, None)
+
+    def non_terminated_nodes(self):
+        return {pid: "tpu_slice" for pid in self._runtime_ids}
+
+    def runtime_node_id(self, pid):
+        return self._runtime_ids.get(pid)
+
+
+def _drained_node(slice_label):
+    return {
+        "resources": {"CPU": 2.0, "TPU": 4.0},
+        "available": {"CPU": 2.0, "TPU": 4.0},  # emptied
+        "labels": {"slice": slice_label},
+        "pending": [],
+    }
+
+
+def test_fully_drained_slice_terminates_as_one_provider_call():
+    """A 3-host slice whose members have all drained empty reaps as
+    EXACTLY ONE terminate_nodes batch — never 3 per-host calls."""
+    from ray_tpu.autoscaler.autoscaler import _TrackedNode
+
+    provider = _CountingProvider(
+        {"p0": "n0", "p1": "n1", "p2": "n2"}
+    )
+    autoscaler = Autoscaler(
+        provider,
+        {"tpu_slice": NodeTypeConfig({"TPU": 4.0}, max_workers=3)},
+    )
+    for pid in ("p0", "p1", "p2"):
+        autoscaler._tracked[pid] = _TrackedNode(pid, "tpu_slice")
+    # Replacement already provisioned: isolate the reap path.
+    autoscaler._drain_replaced.add("slice:s0")
+
+    nodes = {nid: _drained_node("s0") for nid in ("n0", "n1", "n2")}
+    draining = {
+        nid: {"reason": "preempt", "deadline_ts": time.time() + 60}
+        for nid in nodes
+    }
+    autoscaler._handle_draining(draining, nodes, {"tpu_slice": 3})
+
+    assert len(provider.terminate_nodes_calls) == 1
+    assert sorted(provider.terminate_nodes_calls[0]) == [
+        "p0", "p1", "p2"
+    ]
+    assert provider.terminate_node_calls == []
+    assert autoscaler._tracked == {}
+
+
+def test_partially_drained_slice_waits_for_the_whole_unit():
+    """While one member still holds work inside its notice window the
+    unit must NOT tear down — no provider call at all this tick; the
+    batch fires once the straggler empties."""
+    from ray_tpu.autoscaler.autoscaler import _TrackedNode
+
+    provider = _CountingProvider({"p0": "n0", "p1": "n1"})
+    autoscaler = Autoscaler(
+        provider,
+        {"tpu_slice": NodeTypeConfig({"TPU": 4.0}, max_workers=2)},
+    )
+    for pid in ("p0", "p1"):
+        autoscaler._tracked[pid] = _TrackedNode(pid, "tpu_slice")
+    autoscaler._drain_replaced.add("slice:s0")
+
+    nodes = {nid: _drained_node("s0") for nid in ("n0", "n1")}
+    nodes["n1"]["available"] = {"CPU": 2.0, "TPU": 2.0}  # busy
+    draining = {
+        nid: {"reason": "preempt", "deadline_ts": time.time() + 60}
+        for nid in nodes
+    }
+    autoscaler._handle_draining(draining, nodes, {"tpu_slice": 2})
+    assert provider.terminate_nodes_calls == []
+    assert provider.terminate_node_calls == []
+
+    nodes["n1"]["available"] = {"CPU": 2.0, "TPU": 4.0}  # emptied
+    autoscaler._handle_draining(draining, nodes, {"tpu_slice": 2})
+    assert provider.terminate_nodes_calls == [["p0", "p1"]] or sorted(
+        provider.terminate_nodes_calls[0]
+    ) == ["p0", "p1"]
+    assert provider.terminate_node_calls == []

@@ -1,0 +1,594 @@
+"""Sweep engine: gang admission, ledger-driven early stopping,
+checkpoint-forked PBT, journaled sweep table surviving head SIGKILL,
+and preemption-tolerant trial migration.
+
+Reference test model: Tune controller/scheduler suites
+(python/ray/tune/tests/) adapted to the gang-per-trial architecture —
+trials are JaxTrainer worker gangs, decisions read the head's
+train_stats fold rather than per-result callbacks.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import api as core_api
+from ray_tpu._private import config as _config
+
+
+# ------------------------------------------------------------ admission
+def _status(nodes, draining=None, slices=None):
+    return {
+        "nodes": nodes,
+        "draining": draining or {},
+        "slices": slices or {},
+    }
+
+
+def test_admission_counts_only_healthy_chips():
+    from ray_tpu.train.admission import admit_gang, cluster_chips
+
+    nodes = {
+        "n0": {"resources": {"TPU": 4.0}, "available": {"TPU": 2.0}},
+        "n1": {"resources": {"TPU": 4.0}, "available": {"TPU": 4.0}},
+        "n2": {"resources": {"TPU": 4.0}, "available": {"TPU": 4.0}},
+    }
+    # All healthy: 10 of 12 chips free.
+    free, total = cluster_chips(_status(nodes))
+    assert (free, total) == (10.0, 12.0)
+    # A draining node's chips are condemned capacity.
+    free, total = cluster_chips(
+        _status(nodes, draining={"n1": {"reason": "preempt"}})
+    )
+    assert (free, total) == (6.0, 8.0)
+    # A sick slice condemns ALL its member nodes, drained or not.
+    free, total = cluster_chips(
+        _status(
+            nodes,
+            slices={"s0": {"state": "degraded", "nodes": ["n1", "n2"]}},
+        )
+    )
+    assert (free, total) == (2.0, 4.0)
+    # A slice with a draining member is sick as a unit.
+    free, total = cluster_chips(
+        _status(
+            nodes,
+            draining={"n1": {"reason": "preempt"}},
+            slices={"s0": {"state": "healthy", "nodes": ["n1", "n2"]}},
+        )
+    )
+    assert (free, total) == (2.0, 4.0)
+
+    ticket = admit_gang(3, 4.0, status=_status(nodes))
+    assert not ticket and "12" in ticket.reason
+    ticket = admit_gang(2, 2.0, status=_status(nodes))
+    assert ticket and ticket.required_chips == 4.0
+
+
+def test_admission_cpu_fallback():
+    """No TPU resource anywhere → CPU slots stand in, so the engine
+    packs correctly on CPU-only rigs."""
+    from ray_tpu.train.admission import cluster_chips
+
+    nodes = {
+        "n0": {"resources": {"CPU": 8.0}, "available": {"CPU": 3.0}},
+    }
+    assert cluster_chips(_status(nodes)) == (3.0, 8.0)
+
+
+def test_admission_memory_pricing():
+    """The memory planner gates admission: a config that cannot fit one
+    chip's HBM is rejected outright, independent of free chips."""
+    import dataclasses as dc
+
+    from ray_tpu.models import PRESETS
+    from ray_tpu.train.admission import admit_gang
+
+    cfg = dc.replace(
+        PRESETS["llama3_8b"], n_layers=6, vocab_size=8192,
+        attn_impl="flash", remat="full",
+    )
+    nodes = {
+        "n0": {"resources": {"TPU": 8.0}, "available": {"TPU": 8.0}},
+    }
+    big = admit_gang(
+        1, 1.0,
+        plan_kwargs={
+            "cfg": cfg, "batch": 1, "seq": 4096,
+            "mu_dtype": "bfloat16", "hbm_gb": 16.0,
+        },
+        status=_status(nodes),
+    )
+    assert not big and not big.plan.fits and "memory plan" in big.reason
+    small = admit_gang(
+        1, 1.0,
+        plan_kwargs={
+            "cfg": cfg, "batch": 1, "seq": 4096,
+            "mu_dtype": "bfloat16", "hbm_gb": 16.0, "fsdp": 8,
+        },
+        status=_status(nodes),
+    )
+    assert small and small.plan.fits
+
+
+# ----------------------------------------------------- ledger schedulers
+def test_ledger_asha_stops_bottom_of_rung():
+    from ray_tpu.tune.schedulers import CONTINUE, STOP, LedgerASHA
+
+    asha = LedgerASHA(
+        metric="loss", mode="min", grace_period=2,
+        reduction_factor=2, max_t=100,
+    )
+    # Below the grace period nothing is judged.
+    assert asha.decide("a", 1, 0.9) == CONTINUE
+    # First arrivals at a rung are top-of-rung by construction.
+    assert asha.decide("a", 2, 0.1) == CONTINUE
+    # A worse value landing at the same rung is cut...
+    assert asha.decide("b", 2, 0.9) == STOP
+    # ...a better one survives.
+    assert asha.decide("c", 2, 0.05) == CONTINUE
+    # Each rung is judged once per trial, however often polled.
+    assert asha.decide("a", 3, 5.0) == CONTINUE
+    # max_t is a hard stop.
+    assert asha.decide("a", 100, 0.0) == STOP
+
+
+def test_ledger_pbt_exploit_pairs_and_perturb():
+    from ray_tpu.tune.schedulers import LedgerPBT
+
+    pbt = LedgerPBT(
+        metric="loss", mode="min", perturbation_interval=4,
+        hyperparam_mutations={"lr": [0.1, 0.2]},
+        quantile_fraction=0.25, seed=3,
+    )
+    rows = {
+        "w": (8, 0.1), "m1": (8, 0.5), "m2": (8, 0.6), "l": (8, 0.9),
+    }
+    pairs = pbt.exploit_pairs(rows)
+    assert pairs == [("l", "w")]
+    # The loser just exploited: gated until another interval elapses.
+    assert pbt.exploit_pairs(rows) == []
+    assert pbt.exploit_pairs(
+        {**rows, "l": (12, 0.9)}
+    ) == [("l", "w")]
+    out = pbt.perturb({"lr": 0.5, "wd": 1e-4})
+    assert out["lr"] in (0.1, 0.2) and out["wd"] == 1e-4
+
+
+# ------------------------------------------------ failure classification
+def test_classify_failure_typed():
+    from ray_tpu import exceptions as E
+    from ray_tpu.tune.tuner import INFRA, PREEMPTED, TRIAL, classify_failure
+
+    assert classify_failure(E.PreemptedError("drain")) == PREEMPTED
+    assert classify_failure(E.WorkerDiedError("gone")) == INFRA
+    assert classify_failure(E.ActorDiedError("gone")) == INFRA
+    assert classify_failure(ValueError("user bug")) == TRIAL
+    # RayTaskError wrapping: the cause chain is walked.
+    wrapped = E.RayTaskError("task failed")
+    wrapped.cause = E.PreemptedError("node reclaimed")
+    assert classify_failure(wrapped) == PREEMPTED
+    # String classification (fn-session reported errors).
+    assert classify_failure("PreemptedError: slice reclaimed") == PREEMPTED
+    assert classify_failure("WorkerDiedError: oom") == INFRA
+    assert classify_failure("KeyError: 'lr'") == TRIAL
+
+
+def test_search_algorithm_protocol():
+    """Native searchers and every legacy wrapper conform to the one
+    SearchAlgorithm protocol (structural, runtime-checkable)."""
+    from ray_tpu import tune
+
+    space = {"x": tune.uniform(0, 1)}
+    algos = [
+        tune.BasicVariantGenerator(space, num_samples=2),
+        tune.TPESearcher(space, metric="loss", mode="min"),
+        tune.OptunaSearch(space, metric="loss"),
+        tune.HyperOptSearch(space, metric="loss"),
+        tune.BOHBSearch(space, metric="loss"),
+        tune.ConcurrencyLimiter(
+            tune.BasicVariantGenerator(space, num_samples=2), 1
+        ),
+        tune.Repeater(
+            tune.BasicVariantGenerator(space, num_samples=2), 2
+        ),
+    ]
+    for algo in algos:
+        assert isinstance(algo, tune.SearchAlgorithm), type(algo)
+        cfg = algo.suggest("t0")
+        assert cfg is None or cfg is tune.search.DEFER or "x" in cfg
+        algo.on_trial_complete("t0", {"loss": 0.5})
+
+
+# ------------------------------------------------------- live sweep runs
+@pytest.fixture
+def chip_cluster():
+    """Single node reporting 2 fake TPU chips (CPU-backed workers)."""
+    os.environ["RAY_TPU_FAKE_CHIPS"] = "2"
+    info = ray_tpu.init(num_cpus=8)
+    yield info
+    ray_tpu.shutdown()
+    os.environ.pop("RAY_TPU_FAKE_CHIPS", None)
+    _config._overrides.pop("FAKE_CHIPS", None)
+
+
+def _report_loop(config):
+    import time as _t
+
+    from ray_tpu import train
+
+    for step in range(config["steps"]):
+        _t.sleep(config.get("step_s", 0.05))
+        train.report({"loss": float(config["lr"]) / (step + 1)})
+
+
+def test_sweep_gangs_pack_concurrently(chip_cluster):
+    """4 single-chip gangs on 2 chips: trials pack two at a time (the
+    ledger proves overlap), every trial terminates, and the sweep table
+    is journaled on the head."""
+    from ray_tpu import tune
+    from ray_tpu.util import state
+
+    sweep = tune.Sweep(
+        _report_loop,
+        {
+            "lr": tune.grid_search([0.1, 0.9, 0.2, 0.8]),
+            "steps": 6, "step_s": 0.08,
+        },
+        sweep_id="pack",
+        config=tune.SweepConfig(
+            num_samples=1, workers_per_trial=1, chips_per_worker=1.0,
+            poll_s=0.1,
+        ),
+    )
+    res = sweep.run()
+    assert len(res.trials) == 4
+    assert all(t.state == "TERMINATED" for t in res.trials), [
+        (t.trial_id, t.state, t.error) for t in res.trials
+    ]
+    # Overlap: with 2 chips the 4 trials cannot have run serially.
+    spans = sorted(
+        (t.started_ts, t.ended_ts)
+        for t in sweep.trials
+        if t.started_ts and t.ended_ts
+    )
+    overlaps = sum(
+        1 for (s0, e0), (s1, _) in zip(spans, spans[1:]) if s1 < e0
+    )
+    assert overlaps >= 1, spans
+    # ...and the chip lease was saturated at some poll (both chips
+    # busy) while never going negative — admission packed to capacity.
+    frees = [f for _ts, f, total in sweep.utilization if total > 0]
+    assert frees and min(frees) == 0.0 and all(f >= 0 for f in frees)
+    # best() ranks by the folded ledger loss.
+    assert res.best().config["lr"] in (0.1, 0.2)
+    # The head journaled the sweep + all trials.
+    ss = state.sweep_stats(sweep_id="pack")["sweeps"]["pack"]
+    assert ss["state"] == "FINISHED"
+    assert len(ss["trials"]) == 4
+    for rec in ss["trials"].values():
+        assert rec["state"] == "TERMINATED"
+        assert rec["ledger"]["steps"] == 6
+        assert rec["ledger"]["loss"] is not None
+    # Packing efficiency was sampled for the bench.
+    assert res.stats["chip_idle_fraction"] is not None
+
+
+def _ckpt_loop(config):
+    import time as _t
+
+    import numpy as np
+
+    from ray_tpu import checkpoint as ckpt
+    from ray_tpu import train
+
+    start = 0
+    state = {"w": np.ones(4, np.float32) * config["lr"]}
+    uri = train.get_checkpoint()
+    if uri and ckpt.is_ckpt_uri(uri):
+        state = ckpt.restore_uri(uri, target=state)
+        start = ckpt.parse_uri(uri)[1] + 1
+    cp = ckpt.AsyncCheckpointer()
+    for step in range(start, config["steps"]):
+        _t.sleep(0.1)
+        cp.save(step, state)
+        train.report({"loss": float(config["lr"])})
+    cp.wait()
+
+
+def test_pbt_fork_moves_zero_bytes(chip_cluster):
+    """A PBT exploit forks the winner's manifest into the loser's run:
+    the relaunch restores it, and the dedup assertion pins that the
+    fork introduced no new chunks."""
+    from ray_tpu import checkpoint as ckpt
+    from ray_tpu import tune
+    from ray_tpu.util import state
+
+    sweep = tune.Sweep(
+        _ckpt_loop,
+        {"lr": tune.grid_search([0.1, 0.5, 0.9]), "steps": 12},
+        sweep_id="pbtfork",
+        config=tune.SweepConfig(
+            num_samples=1, workers_per_trial=1, chips_per_worker=1.0,
+            pbt=tune.LedgerPBT(
+                metric="loss", mode="min", perturbation_interval=4,
+                hyperparam_mutations={"lr": [0.05]},
+                quantile_fraction=0.34, seed=7,
+            ),
+            poll_s=0.15,
+        ),
+    )
+    res = sweep.run()
+    assert res.stats["forks"] >= 1
+    forked = [t for t in res.trials if t.forked_from]
+    assert forked
+    loser = forked[0]
+    rec = state.sweep_stats()["sweeps"]["pbtfork"]["trials"][
+        loser.trial_id
+    ]
+    assert rec["forked_from"] == loser.forked_from
+    fork_step = rec["fork_step"]
+    share = ckpt.fork_shares_chunks(
+        f"pbtfork/{loser.forked_from}",
+        f"pbtfork/{loser.trial_id}",
+        fork_step,
+    )
+    assert share["new_chunks"] == 0
+    assert share["dedup_ratio"] == 1.0
+    # The exploit perturbed the loser's config off the winner's.
+    assert loser.config["lr"] == 0.05
+
+
+# ------------------------------------------------- head-SIGKILL survival
+_SIGKILL_CHILD = textwrap.dedent(
+    """
+    import asyncio, os, signal, sys
+    from ray_tpu._private import rpc
+
+    path = sys.argv[1]
+
+    async def go():
+        from ray_tpu.runtime.head import HeadService
+
+        head = HeadService(journal_path=path)
+        addr = await head.start()
+        conn = await rpc.connect(addr)
+        await conn.call(
+            "sweep_put", sweep_id="s1",
+            fields={"state": "RUNNING", "num_samples": 2, "forks": 1},
+        )
+        await conn.call(
+            "sweep_trial", sweep_id="s1", trial_id="t0000",
+            fields={"state": "RUNNING", "job": "s1/t0000",
+                    "config": {"lr": 0.1}},
+        )
+        await conn.call(
+            "sweep_trial", sweep_id="s1", trial_id="t0001",
+            fields={"state": "TERMINATED", "job": "s1/t0001",
+                    "forked_from": "t0000"},
+        )
+        # Die WITHOUT stopping: every surviving byte is journal replay.
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    asyncio.run(go())
+    """
+)
+
+
+def test_sweep_table_survives_head_sigkill(tmp_path):
+    """sweep_put/sweep_trial journal through the head's WAL: a restart
+    after SIGKILL replays the full sweeps table — and the table also
+    round-trips the snapshot/compaction path."""
+    import asyncio
+
+    from ray_tpu._private import rpc
+
+    path = str(tmp_path / "head.journal")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _SIGKILL_CHILD, path],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == -signal.SIGKILL, (
+        proc.returncode, proc.stdout, proc.stderr,
+    )
+
+    async def restart(compact: bool):
+        from ray_tpu.runtime.head import HeadService
+
+        head = HeadService(journal_path=path)
+        addr = await head.start()
+        conn = await rpc.connect(addr)
+        try:
+            reply = await conn.call("sweep_stats")
+            if compact:
+                # Force the snapshot path and verify the next replay
+                # reads sweeps back out of the snapshot record.
+                head.journal.compact(head._snapshot())
+            return reply
+        finally:
+            await conn.close()
+            await head.stop()
+
+    for compact in (True, False):
+        reply = asyncio.run(restart(compact))
+        rec = reply["sweeps"]["s1"]
+        assert rec["state"] == "RUNNING"
+        assert rec["forks"] == 1
+        assert rec["trials"]["t0000"]["state"] == "RUNNING"
+        assert rec["trials"]["t0000"]["config"] == {"lr": 0.1}
+        assert rec["trials"]["t0001"]["forked_from"] == "t0000"
+
+
+# ------------------------------------------- preemption-tolerant sweeps
+def _add_node(tmp_path, name, resources):
+    from ray_tpu.runtime.node import NodeManager
+
+    rt = core_api._runtime
+
+    async def launch():
+        node = NodeManager(
+            rt.core.head_addr,
+            str(tmp_path / f"{name}_store"),
+            resources=resources,
+        )
+        await node.start()
+        return node
+
+    return rt.run(launch())
+
+
+def _stop_node(node):
+    rt = core_api._runtime
+    try:
+        rt.run(node.stop())
+    except Exception:  # noqa: BLE001 - may already be dead
+        pass
+
+
+def _migrate_loop(config):
+    """One step per tick with per-attempt progress files; checkpoints
+    every 4 steps and immediately on a preemption notice (the
+    emergency-checkpoint pattern), so a migration re-runs ≤1 step."""
+    import json as _json
+    import os as _os
+    import time as _t
+
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    start = 0
+    ck = train.get_checkpoint()
+    if ck:
+        with open(_os.path.join(ck, "state.json")) as f:
+            start = _json.load(f)["step"] + 1
+    scratch = config["scratch"]
+    with open(
+        _os.path.join(scratch, f"start_attempt{ctx.attempt}"), "w"
+    ) as f:
+        f.write(str(start))
+    if ctx.attempt == 0 and ctx.rank == 0:
+        from ray_tpu import api as _api
+
+        with open(config["marker"], "w") as f:
+            f.write(_api._runtime.core.node_addr or "")
+    for step in range(start, config["steps"]):
+        _t.sleep(0.15)
+        with open(
+            _os.path.join(scratch, f"prog_attempt{ctx.attempt}"), "w"
+        ) as f:
+            f.write(str(step))
+        ckdir = None
+        if step % 4 == 0 or train.preemption_notice() is not None:
+            ckdir = _os.path.join(scratch, f"ck_{step}")
+            _os.makedirs(ckdir, exist_ok=True)
+            with open(_os.path.join(ckdir, "state.json"), "w") as f:
+                _json.dump({"step": step}, f)
+        train.report({"loss": 1.0 / (step + 1)}, checkpoint=ckdir)
+
+
+@pytest.mark.chaos
+def test_sweep_trial_migrates_on_preemption(tmp_path):
+    """Drain the node under a running gang mid-sweep: the gang takes an
+    emergency checkpoint inside the notice window, unwinds typed, and
+    the sweep re-admits it elsewhere — re-running at most ONE step.
+    The sweep journals the migration (preemptions counter, attempts)."""
+    ray_tpu.init(num_cpus=2, _system_config={"HEALTH_TIMEOUT_S": 4.0})
+    nodes = [
+        _add_node(tmp_path, f"slice{i}", {"CPU": 2.0, "SLICE": 1.0})
+        for i in range(2)
+    ]
+    try:
+        from ray_tpu import tune
+        from ray_tpu.util import state
+
+        marker = str(tmp_path / "victim_addr")
+        scratch = str(tmp_path / "scratch")
+        os.makedirs(scratch, exist_ok=True)
+
+        sweep = tune.Sweep(
+            _migrate_loop,
+            {
+                "steps": 14, "scratch": scratch, "marker": marker,
+            },
+            sweep_id="mig",
+            storage_path=str(tmp_path / "results"),
+            config=tune.SweepConfig(
+                num_samples=1, workers_per_trial=1,
+                resources_per_worker={"SLICE": 1.0},
+                poll_s=0.1, max_failures=3,
+            ),
+        )
+
+        def drainer():
+            deadline = time.monotonic() + 60
+            while (
+                time.monotonic() < deadline
+                and not os.path.exists(marker)
+            ):
+                time.sleep(0.05)
+            with open(marker) as f:
+                victim_addr = f.read().strip()
+            victim = next(n for n in nodes if n.addr == victim_addr)
+            rt = core_api._runtime
+
+            async def drain():
+                return await rt.core.head.call(
+                    "drain_node", node_id=victim.node_id,
+                    reason="preemption-notice", deadline_s=5.0,
+                )
+
+            rt.run(drain())
+            time.sleep(5.0)
+            for w in list(victim.workers.values()):
+                proc = w.get("proc")
+                if proc and proc.poll() is None:
+                    proc.kill()
+            _stop_node(victim)
+
+        t = threading.Thread(target=drainer, daemon=True)
+        t.start()
+        res = sweep.run()
+        t.join(timeout=30)
+
+        trial = res.trials[0]
+        assert trial.state == "TERMINATED", (trial.state, trial.error)
+        # The gang really migrated: a second attempt ran...
+        assert trial.attempts >= 2
+        assert res.stats["preemptions"] >= 1
+        with open(os.path.join(scratch, "prog_attempt0")) as f:
+            last_before_kill = int(f.read())
+        with open(os.path.join(scratch, "start_attempt1")) as f:
+            resumed_at = int(f.read())
+        # ...re-running AT MOST one step past the emergency checkpoint.
+        lost = last_before_kill - resumed_at + 1
+        assert lost <= 1, (last_before_kill, resumed_at)
+        # All 14 steps completed across attempts.
+        prog = sorted(
+            int(open(os.path.join(scratch, p)).read())
+            for p in os.listdir(scratch)
+            if p.startswith("prog_attempt")
+        )
+        assert prog[-1] == 13
+        # The journaled sweep table carries the migration.
+        rec = state.sweep_stats()["sweeps"]["mig"]
+        assert rec["preemptions"] >= 1
+        assert rec["trials"][trial.trial_id]["attempts"] >= 2
+    finally:
+        for node in nodes:
+            _stop_node(node)
+        ray_tpu.shutdown()
+        _config._overrides.pop("HEALTH_TIMEOUT_S", None)
+        os.environ.pop("RAY_TPU_HEALTH_TIMEOUT_S", None)
